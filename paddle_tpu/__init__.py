@@ -1,0 +1,60 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle
+Fluid's capabilities (reference: jhjiangcs/Paddle, see SURVEY.md).
+
+Architecture: a Program/Block/Op IR built by a fluid-style layer DSL;
+program-level autodiff (grad-op synthesis); an Executor that lowers whole
+blocks into single XLA computations; data/model parallelism via
+jax.sharding meshes (GSPMD) instead of NCCL SSA graphs; Pallas kernels for
+ops XLA can't fuse (see paddle_tpu.pallas).
+"""
+
+from . import ops  # registers all op lowerings
+from . import initializer, layers, regularizer  # noqa
+from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,  # noqa
+                   GradientClipByValue)
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa
+from .framework import (Program, Variable, append_backward,  # noqa
+                        default_main_program, default_startup_program,
+                        global_scope, gradients, program_guard, scope_guard,
+                        Scope)
+from .framework.executor import Executor  # noqa
+from . import optimizer  # noqa
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
+
+
+class CPUPlace:
+    """ref platform/place.h:37 CPUPlace."""
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    """The TPU analog of CUDAPlace (ref platform/place.h:26): device ordinal
+    within jax.devices()."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Fluid API compat alias: CUDAPlace(n) maps to the n-th accelerator.
+CUDAPlace = TPUPlace
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from . import dataset, reader  # noqa
+from .dataloader import DataLoader  # noqa
+from .feeder import DataFeeder  # noqa

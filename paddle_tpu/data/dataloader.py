@@ -1,0 +1,99 @@
+"""Device-feeding DataLoader with background prefetch.
+
+TPU-native replacement for the reference reader stack: ``PyReader``
+(``python/paddle/fluid/reader.py:47``) pushing into a C++
+``LoDTensorBlockingQueue`` drained by ``create_py_reader`` +
+``create_double_buffer_reader`` (``operators/reader/buffered_reader.cc`` —
+prefetch to device).  Here a Python thread stages numpy batches and
+``jax.device_put`` starts the host→HBM copy ahead of compute; with a mesh it
+shards the batch across devices (the multi-device feed split the reference
+does in ``ParallelExecutor::FeedTensorsIntoLocalScopes``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+import numpy as np
+
+
+class DataLoader:
+    def __init__(self, feed_list=None, capacity=4, iterable=True,
+                 return_list=False, use_double_buffer=True):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._batch_fn: Optional[Callable] = None
+        self._places = None
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return DataLoader(feed_list, capacity, iterable, return_list,
+                          use_double_buffer)
+
+    def set_batch_generator(self, generator, places=None):
+        self._batch_fn = generator
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        from .feeder import DataFeeder
+        feeder = DataFeeder(self.feed_list)
+
+        def batches():
+            for samples in generator():
+                yield feeder.feed(samples)
+        self._batch_fn = batches
+        self._places = places
+        return self
+
+    def __iter__(self):
+        if self._batch_fn is None:
+            raise ValueError("call set_batch_generator/"
+                             "set_sample_list_generator first")
+        if not self.use_double_buffer:
+            yield from self._batch_fn()
+            return
+        yield from _prefetch_to_device(self._batch_fn, self.capacity)
+
+
+def _prefetch_to_device(batch_fn, capacity, sharding=None):
+    """Double-buffer: stage next batch to device while current one computes."""
+    class _End:
+        pass
+
+    q: queue.Queue = queue.Queue(maxsize=capacity)
+    err = []
+
+    def producer():
+        try:
+            for batch in batch_fn():
+                if isinstance(batch, dict):
+                    staged = {k: _put(v, sharding) for k, v in batch.items()}
+                else:
+                    staged = [_put(v, sharding) for v in batch]
+                q.put(staged)
+        except Exception as e:   # surfaced on next consumer get
+            err.append(e)
+        finally:
+            q.put(_End)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _End:
+            if err:
+                raise err[0]
+            break
+        yield item
+
+
+def _put(x, sharding=None):
+    if sharding is not None:
+        return jax.device_put(x, sharding)
+    return jax.device_put(np.asarray(x))

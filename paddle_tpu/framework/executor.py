@@ -1,0 +1,299 @@
+"""Executor: lowers a whole Program block to ONE jitted XLA computation.
+
+The reference Executor (``framework/executor.cc:173,398-440``) interprets a
+block op-by-op, dispatching a C++/CUDA kernel per op and garbage-collecting
+dead tensors between ops.  On TPU that per-op dispatch is precisely what you
+must NOT do — so this Executor plays the role the reference's nGraph subgraph
+engine prototyped (``operators/ngraph/ngraph_engine.cc:249-531``: capture
+block → build function → shape-keyed compiled-function cache): the *entire*
+block becomes one traced JAX function, jit-compiled by XLA, cached by
+(program fingerprint, feed shapes/dtypes, fetch set).
+
+Step signature of the lowered function::
+
+    step(feeds, persist_ro, persist_rw, seed) -> (fetches, new_persist_rw)
+
+``persist_rw`` (params + optimizer state + BN running stats — anything a
+block op writes) is donated to XLA so parameter updates alias their input
+buffers, matching the reference's in-place optimizer kernels without any
+explicit memory pass (ref ``ir/memory_optimize_pass/``— XLA buffer
+assignment subsumes it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import registry
+from .core import Block, Operator, Program, Variable, default_main_program
+from .scope import Scope, global_scope
+
+
+class LowerCtx:
+    """Per-trace context handed to op lowerings."""
+
+    is_abstract = False
+
+    def __init__(self, seed, mesh=None, is_startup=False):
+        if isinstance(seed, jax.Array) and jax.dtypes.issubdtype(
+                seed.dtype, jax.dtypes.prng_key):
+            self._key = seed
+        else:
+            self._key = jax.random.key(seed)
+        self._counter = 0
+        self.mesh = mesh
+        self.is_startup = is_startup
+
+    def rng(self):
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+
+def _seed_to_key(seed):
+    if isinstance(seed, jax.Array) and jax.dtypes.issubdtype(seed.dtype, jax.dtypes.prng_key):
+        return seed
+    return jax.random.key(seed)
+
+
+class _ExecState:
+    """SSA value environment while lowering a block."""
+
+    def __init__(self, values: Dict[str, Any]):
+        self.values = values
+        self.written: set = set()
+
+    def read(self, block: Block, name: str):
+        if name == "" or name is None:
+            return None
+        if name not in self.values:
+            raise KeyError(
+                f"op input var {name!r} has no value: not fed, not in scope, "
+                f"and not produced by a preceding op")
+        return self.values[name]
+
+    def write(self, name: str, value):
+        if name == "" or name is None:
+            return
+        self.values[name] = value
+        self.written.add(name)
+
+
+def run_block(ctx: LowerCtx, block: Block, state: _ExecState) -> None:
+    """Trace every op of ``block`` into the surrounding JAX computation.
+
+    This is the hot loop of ref ``executor.cc:432`` — except it runs once at
+    trace time, not every step.
+    """
+    for op in block.ops:
+        run_op(ctx, block, op, state)
+
+
+def run_op(ctx: LowerCtx, block: Block, op: Operator, state: _ExecState) -> None:
+    if op.type in ("feed", "fetch"):
+        return
+    if op.type.endswith("_grad") and not registry.has_op(op.type):
+        _run_generic_grad(ctx, block, op, state)
+        return
+    info = registry.get_op_info(op.type)
+    if info.raw:
+        info.lower(ctx, block, op, state)
+        return
+    ins = {slot: [state.read(block, n) for n in names]
+           for slot, names in op.inputs.items()}
+    outs = info.lower(ctx, ins, op.attrs) or {}
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if i < len(vals):
+                state.write(n, vals[i])
+
+
+def _run_generic_grad(ctx, block: Block, op: Operator, state: _ExecState):
+    ins = {}
+    for slot, names in op.inputs.items():
+        if slot.startswith("OG$"):
+            # an output grad may be absent (output unused downstream)
+            ins[slot] = [state.values.get(n) for n in names]
+        else:
+            ins[slot] = [state.read(block, n) for n in names]
+    outs = registry.generic_grad_lower(ctx, ins, op.attrs)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if n and i < len(vals) and vals[i] is not None:
+                state.write(n, vals[i])
+
+
+class _CompiledBlock:
+    """A lowered+jitted block specialized to a feed/fetch/persist signature."""
+
+    def __init__(self, program: Program, block_idx: int,
+                 feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
+                 persist_ro: Tuple[str, ...], persist_rw: Tuple[str, ...],
+                 mesh=None, in_shardings=None, donate=True):
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.persist_ro = persist_ro
+        self.persist_rw = persist_rw
+        block = program.blocks[block_idx]
+
+        def step(feeds, ro, rw, seed):
+            ctx = LowerCtx(seed, mesh=mesh)
+            values = {}
+            values.update(dict(zip(persist_ro, ro)))
+            values.update(dict(zip(persist_rw, rw)))
+            values.update(dict(zip(feed_names, feeds)))
+            state = _ExecState(values)
+            run_block(ctx, block, state)
+            fetches = [state.values[n] for n in fetch_names]
+            new_rw = [state.values[n] for n in persist_rw]
+            return fetches, new_rw
+
+        kwargs = {}
+        if donate and persist_rw:
+            kwargs["donate_argnums"] = (2,)
+        if in_shardings is not None:
+            kwargs["in_shardings"] = in_shardings
+        self.jitted = jax.jit(step, **kwargs)
+
+    def __call__(self, feeds, ro, rw, seed):
+        return self.jitted(feeds, ro, rw, seed)
+
+
+def _collect_persistables(program: Program, block: Block, scope: Scope,
+                          feed_names) -> Tuple[List[str], List[str], set]:
+    """Classify persistable vars referenced by a block into read-only vs
+    read-write (written by some op); also return the set of rw vars that are
+    READ (their scope value matters — write-only vars get dummies)."""
+    read, written = set(), set()
+    def visit(b: Block):
+        for op in b.ops:
+            for n in op.input_arg_names():
+                read.add(n)
+            for n in op.output_arg_names():
+                written.add(n)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    visit(v)
+    visit(block)
+    ro, rw = [], []
+    for name in sorted(read | written):
+        if name in feed_names or not name:
+            continue
+        if not block.has_var(name):
+            continue
+        v = block.var(name)
+        if not v.persistable:
+            continue
+        (rw if name in written else ro).append(name)
+    return ro, rw, read
+
+
+class Executor:
+    """ref ``python/paddle/fluid/executor.py:295`` Executor.
+
+    ``place`` is advisory: JAX picks the default backend (TPU when present).
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, _CompiledBlock] = {}
+        self._lock = threading.Lock()
+        self._step_seed = 0
+
+    def close(self):
+        self._cache.clear()
+
+    # -- main entry ----------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            seed: Optional[int] = None):
+        from ..compiler import CompiledProgram
+        mesh = None
+        in_shardings = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled._program
+            mesh = compiled._mesh
+            in_shardings = compiled._build_in_shardings
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else f for f in (fetch_list or []))
+        feed_names = tuple(sorted(feed))
+
+        block = program.global_block()
+        key = (program.fingerprint(), feed_names,
+               tuple((np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
+                     for n in feed_names),
+               fetch_names, id(scope), id(mesh))
+        with self._lock:
+            cb = self._cache.get(key)
+            if cb is None:
+                ro, rw, read_set = _collect_persistables(
+                    program, block, scope, feed_names)
+                shardings = None
+                if in_shardings is not None:
+                    shardings = in_shardings(feed_names, ro, rw)
+                cb = _CompiledBlock(program, 0, feed_names, fetch_names,
+                                    tuple(ro), tuple(rw), mesh=mesh,
+                                    in_shardings=shardings)
+                cb.rw_read = frozenset(n for n in rw if n in read_set)
+                self._cache[key] = cb
+
+        feeds = [_to_device(feed[n]) for n in cb.feed_names]
+        ro_vals = [_scope_fetch(scope, n) for n in cb.persist_ro]
+        # read-write persistables that are READ must be initialized (optimizer
+        # accumulators, BN stats, step counters) — a silent zero would corrupt
+        # training state; pure write-before-read vars get dummy zeros since the
+        # lowered value never depends on the input.
+        rw_vals = []
+        for n in cb.persist_rw:
+            v = _scope_fetch(scope, n, allow_missing=n not in cb.rw_read)
+            rw_vals.append(v if v is not None else jnp.zeros((), jnp.float32))
+
+        self._step_seed += 1
+        seed_val = seed if seed is not None else (
+            program.random_seed * 1000003 + self._step_seed)
+        try:
+            fetches, new_rw = cb(feeds, ro_vals, rw_vals, jnp.uint32(seed_val))
+        except Exception:
+            # never cache a block whose trace failed (a later run with a
+            # fixed scope/feed must re-lower)
+            with self._lock:
+                self._cache.pop(key, None)
+            raise
+        for n, v in zip(cb.persist_rw, new_rw):
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def infer_from_program(self, *a, **k):
+        return self.run(*a, **k)
+
+
+def _to_device(x):
+    if isinstance(x, (int, float)):
+        return jnp.asarray(x)
+    if isinstance(x, np.ndarray):
+        return jnp.asarray(x)
+    return x
+
+
+def _scope_fetch(scope: Scope, name: str, allow_missing=False):
+    v = scope.find_var(name)
+    if v is None and not allow_missing and not scope.has_var(name):
+        raise KeyError(f"persistable var {name!r} not found in scope — "
+                       f"did you run the startup program?")
+    return v

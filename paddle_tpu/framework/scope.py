@@ -1,0 +1,82 @@
+"""Scope: name → value store for persistent variables.
+
+Mirrors the reference's hierarchical ``Scope`` (``framework/scope.h:46``):
+a name→Variable map with parent fallback.  Values here are JAX Arrays living
+on device (or host numpy before first device_put); temporaries never enter a
+Scope — they are SSA values inside the lowered XLA computation, which is the
+TPU-native equivalent of the reference's local-scope + eager-deletion GC
+(``framework/executor.cc:106-141``, ``garbage_collector.h``): XLA's buffer
+liveness analysis does that job during compilation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Iterator, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self._vars: Dict[str, Any] = {}
+        self.kids = []
+
+    def var(self, name: str):
+        """Create-or-get, like ref Scope::Var."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars.get(name)
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self.kids.clear()
+
+    def local_var_names(self) -> Iterator[str]:
+        return iter(list(self._vars))
+
+    def items(self):
+        return self._vars.items()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """ref ``python/paddle/fluid/executor.py`` scope_guard."""
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()

@@ -1,0 +1,34 @@
+"""Unique name generator (reference ``python/paddle/fluid/unique_name.py``)."""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids = collections.defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        i = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{i}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        _generator = old

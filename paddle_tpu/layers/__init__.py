@@ -1,0 +1,22 @@
+"""``paddle_tpu.layers`` — the user-facing layer DSL (ref
+``python/paddle/fluid/layers/``)."""
+
+from . import control_flow, detection, io, learning_rate_scheduler  # noqa
+from . import math_ops, metric_op, nn, sequence, tensor  # noqa
+from .control_flow import (While, equal, greater_equal, greater_than,  # noqa
+                           increment, is_empty, less_equal, less_than,
+                           not_equal)
+from .io import data  # noqa
+from .math_ops import scale  # noqa
+from .metric_op import accuracy, auc  # noqa
+from .nn import *  # noqa
+from .sequence import (sequence_concat, sequence_expand, sequence_first_step,  # noqa
+                       sequence_last_step, sequence_mask, sequence_pad,
+                       sequence_pool, sequence_reverse, sequence_softmax,
+                       sequence_unpad)
+from .tensor import (argmax, argmin, argsort, assign, cast, concat,  # noqa
+                     create_global_var, create_parameter, create_tensor,
+                     diag, eye, fill_constant,
+                     fill_constant_batch_size_like, has_inf, has_nan,
+                     isfinite, linspace, ones, ones_like, range, reverse,
+                     sums, zeros, zeros_like)

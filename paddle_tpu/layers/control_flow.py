@@ -1,0 +1,155 @@
+"""Control-flow layers (ref ``python/paddle/fluid/layers/control_flow.py``).
+
+Comparison helpers plus ``increment``/``array`` utilities.  Structured loops
+(While/StaticRNN/DynamicRNN) lower to ``lax.while_loop``/``lax.scan`` — see
+``paddle_tpu.ops.control_flow_ops``.  Note the TPU-semantics difference the
+reference doesn't have: loop bodies are traced once and must be
+shape-static; reverse-mode grads flow through ``StaticRNN``/``DynamicRNN``
+(scan) but not ``While`` (while_loop), matching JAX.
+"""
+
+from __future__ import annotations
+
+from ..framework.core import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond
+
+
+class While:
+    """``while cond: body`` over a sub-block → lax.while_loop.
+
+    ref control_flow.py While / operators/controlflow/while_op.cc:43.
+    Forward-only (lax.while_loop is not reverse-differentiable); use
+    StaticRNN/DynamicRNN (scan) for differentiable recurrence.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.program = default_main_program()
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard:
+    def __init__(self, while_op: While):
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.block = self.while_op.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return False
+        program = self.while_op.program
+        inner = program.current_block()
+        program._rollback()
+        parent = program.current_block()
+        # loop-carried vars: every var read in the sub-block that lives in the
+        # parent and is written in the sub-block, plus the condition var.
+        written = set()
+        read = set()
+        for op in inner.ops:
+            for n in op.input_arg_names():
+                read.add(n)
+            for n in op.output_arg_names():
+                written.add(n)
+        carried = sorted((read | written) & set(parent.vars) | {self.while_op.cond_var.name})
+        parent.append_op(
+            "while",
+            inputs={"Condition": [self.while_op.cond_var.name],
+                    "X": sorted(read & set(parent.vars))},
+            outputs={"Out": list(carried)},
+            attrs={"sub_block": inner, "carried_vars": list(carried)})
+        return False
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "LoDTensorArray is replaced by lax.scan carries; use StaticRNN "
+        "(paddle_tpu.layers.rnn) or Python lists of Variables")
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "LoDTensorArray is replaced by lax.scan carries; use StaticRNN "
+        "(paddle_tpu.layers.rnn) or Python lists of Variables")
+
+
+def array_length(array):
+    raise NotImplementedError("see array_write")
+
+
+def create_array(dtype):
+    raise NotImplementedError("see array_write")
+
+
+class Switch:
+    """ref control_flow.py Switch — piecewise select built from masks."""
+
+    def __init__(self, name=None):
+        self.cases = []
+        self.default_assigns = None
+
+    def case(self, condition):
+        raise NotImplementedError(
+            "Switch: use layers.piecewise arithmetic-mask selects "
+            "(see learning_rate_scheduler.piecewise_decay) — data-dependent "
+            "host control flow does not exist under XLA tracing")
+
+    def default(self):
+        return self.case(None)

@@ -1,0 +1,82 @@
+"""Detection layers (ref ``python/paddle/fluid/layers/detection.py`` — 27
+exports).  Round 1 ships the box/anchor math subset; NMS-style ops that are
+host-side in every framework surface as NotImplemented with guidance."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    box = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("prior_box",
+                     inputs={"Input": [input], "Image": [image]},
+                     outputs={"Boxes": [box], "Variances": [var]},
+                     attrs={"min_sizes": list(min_sizes),
+                            "max_sizes": list(max_sizes or []),
+                            "aspect_ratios": list(aspect_ratios),
+                            "variances": list(variance), "flip": flip,
+                            "clip": clip, "step_w": steps[0],
+                            "step_h": steps[1], "offset": offset,
+                            "min_max_aspect_ratios_order":
+                                min_max_aspect_ratios_order})
+    return box, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized, "axis": axis})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("box_clip", inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype, True)
+    scores = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+                     outputs={"Boxes": [boxes], "Scores": [scores]},
+                     attrs={"anchors": list(anchors), "class_num": class_num,
+                            "conf_thresh": conf_thresh,
+                            "downsample_ratio": downsample_ratio})
+    return boxes, scores
+
+
+def multiclass_nms(*a, **k):
+    raise NotImplementedError(
+        "multiclass_nms: dynamic-output NMS is host-side; run it on fetched "
+        "numpy outputs via paddle_tpu.utils.nms.multiclass_nms_np")
+
+
+def detection_output(*a, **k):
+    raise NotImplementedError("detection_output: see multiclass_nms")

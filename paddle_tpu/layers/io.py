@@ -1,0 +1,29 @@
+"""Input layers (ref ``python/paddle/fluid/layers/io.py``): ``data`` declares
+a feed Variable.  The reference's py_reader/double_buffer pipeline is
+reimplemented TPU-style in ``paddle_tpu.data.dataloader`` (host→device
+prefetch thread ≈ ``operators/reader/buffered_reader.cc``)."""
+
+from __future__ import annotations
+
+from ..framework.core import default_main_program
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    """ref layers/io.py data — declares a fed variable.
+
+    ``append_batch_size=True`` prepends a batch dim, which we leave symbolic
+    (-1) in metadata; the executor specializes on the first fed batch shape
+    (XLA shape-keyed jit cache), so vary batch size sparingly.
+    ``lod_level`` is accepted for API parity; ragged data is carried as a
+    dense padded tensor plus an explicit length companion (SURVEY §5.7).
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    var = block.create_var(name=name, shape=shape, dtype=dtype,
+                           stop_gradient=stop_gradient)
+    var.is_data = True
+    var.lod_level = lod_level
+    return var

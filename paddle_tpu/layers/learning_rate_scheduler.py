@@ -1,0 +1,126 @@
+"""LR schedulers as graph ops over the global step counter.
+
+ref ``python/paddle/fluid/layers/learning_rate_scheduler.py`` — each decay
+builds a tiny op subgraph reading ``@LR_DECAY_COUNTER@``; here they lower
+into the same XLA computation as the train step, so the schedule costs
+nothing per step.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..framework.core import default_main_program
+from ..layer_helper import LayerHelper
+from . import nn, tensor
+
+
+def _decay_step_counter(begin=0):
+    from .nn import autoincreased_step_counter
+    counter = autoincreased_step_counter(
+        counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1)
+    return tensor.cast(counter, "float32")
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """ref learning_rate_scheduler.py:noam_decay (the Transformer schedule)."""
+    step = _decay_step_counter(1)
+    a = step ** -0.5
+    b = (warmup_steps ** -1.5) * step
+    lr = learning_rate * (d_model ** -0.5) * nn.elementwise_min(a, b)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return learning_rate * _pow_scalar(decay_rate, div)
+
+
+def _pow_scalar(base, exponent_var):
+    # base^x = exp(x * ln base)
+    return nn.exp(exponent_var * math.log(base))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return learning_rate * nn.exp(-1.0 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return learning_rate / (1.0 + decay_rate * div)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _decay_step_counter()
+    if cycle:
+        div_res = nn.ceil(step / float(decay_steps))
+        # guard zero step
+        decay_steps_var = div_res * float(decay_steps)
+        frac = step / decay_steps_var
+    else:
+        frac = nn.elementwise_min(step / float(decay_steps),
+                                  step * 0.0 + 1.0)
+    return (learning_rate - end_learning_rate) * _frac_pow(1.0 - frac, power) \
+        + end_learning_rate
+
+
+def _frac_pow(x_var, p):
+    if p == 1.0:
+        return x_var
+    return nn.exp(nn.log(nn.elementwise_max(x_var, x_var * 0.0 + 1e-12)) * p)
+
+
+def piecewise_decay(boundaries, values):
+    """piecewise-constant lr: select by comparing step to boundaries."""
+    step = _decay_step_counter()
+    lr = step * 0.0 + float(values[-1])
+    # build nested where via arithmetic masks (static unrolled, tiny)
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper("piecewise_select")
+        cond = helper.create_variable_for_type_inference("bool", True)
+        helper.append_op("less_than",
+                         inputs={"X": [step], "Y": [_const_like(step, float(b))]},
+                         outputs={"Out": [cond]})
+        mask = tensor.cast(cond, "float32")
+        lr = mask * float(v) + (1.0 - mask) * lr
+    return lr
+
+
+def _const_like(ref, value):
+    helper = LayerHelper("const")
+    out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": [], "dtype": "float32", "value": value})
+    return out
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = nn.floor(step / step_each_epoch)
+    return learning_rate * 0.5 * (nn.cos(epoch * math.pi / epochs) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _decay_step_counter()
+    helper = LayerHelper("lr_warmup")
+    cond = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("less_than",
+                     inputs={"X": [step], "Y": [_const_like(step, float(warmup_steps))]},
+                     outputs={"Out": [cond]})
+    mask = tensor.cast(cond, "float32")
+    warm = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
+    if not hasattr(learning_rate, "block"):
+        learning_rate = step * 0.0 + float(learning_rate)
+    return mask * warm + (1.0 - mask) * learning_rate

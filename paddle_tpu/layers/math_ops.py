@@ -1,0 +1,48 @@
+"""Elementwise-binary sugar used by Variable operator overloads and the
+``elementwise_*`` layer functions (ref ``python/paddle/fluid/layers/math_op_patch.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..layer_helper import LayerHelper
+
+
+def _to_variable(x, ref: Variable):
+    if isinstance(x, Variable):
+        return x
+    helper = LayerHelper("create_scalar")
+    out = helper.create_variable_for_type_inference(ref.dtype)
+    out.stop_gradient = True
+    val = float(x) if not isinstance(x, np.ndarray) else x
+    if isinstance(val, float):
+        helper.append_op("fill_constant", outputs={"Out": [out]},
+                         attrs={"shape": [], "dtype": ref.dtype, "value": val})
+    else:
+        helper.append_op("assign_value", outputs={"Out": [out]},
+                         attrs={"shape": list(val.shape), "dtype": ref.dtype,
+                                "values": val.reshape(-1).tolist()})
+    return out
+
+
+def _elementwise_binary(x: Variable, y, op_type: str, reverse=False, axis=-1,
+                        act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    y = _to_variable(y, x)
+    if reverse:
+        x, y = y, x
+    out = helper.create_variable_for_type_inference(
+        x.dtype if isinstance(x, Variable) else y.dtype)
+    helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)

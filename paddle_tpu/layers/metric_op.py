@@ -1,0 +1,44 @@
+"""Metric layers (ref ``python/paddle/fluid/layers/metric_op.py``)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from ..param_attr import ParamAttr
+from . import nn
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """ref metric_op.py accuracy → top_k + accuracy ops."""
+    helper = LayerHelper("accuracy")
+    _, topk_indices = nn.topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference("float32", True)
+    correct = correct or helper.create_variable_for_type_inference("int32", True)
+    total = total or helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("accuracy",
+                     inputs={"Out": [input], "Indices": [topk_indices],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc_out], "Correct": [correct],
+                              "Total": [total]})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """ref metric_op.py auc — streaming AUC with persistable stat buffers."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_parameter(
+        ParamAttr(trainable=False), shape=[num_thresholds + 1],
+        dtype="float32", default_initializer=ConstantInitializer(0.0))
+    stat_neg = helper.create_parameter(
+        ParamAttr(trainable=False), shape=[num_thresholds + 1],
+        dtype="float32", default_initializer=ConstantInitializer(0.0))
+    stat_pos.stop_gradient = True
+    stat_neg.stop_gradient = True
+    auc_out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("auc",
+                     inputs={"Predict": [input], "Label": [label],
+                             "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+                     outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                              "StatNegOut": [stat_neg]},
+                     attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, auc_out, [stat_pos, stat_neg]

@@ -1,0 +1,150 @@
+"""Sequence layers — the TPU-native replacement for LoD `sequence_ops`.
+
+The reference carries ragged batches as LoDTensors and provides 48
+`operators/sequence_ops/` kernels.  On TPU (static shapes!) sequences are
+dense padded tensors ``[batch, max_len, ...]`` with an explicit per-example
+length vector (SURVEY §5.7) — each layer here takes/propagates that length
+companion where the reference would read LoD offsets.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """lengths [b] → mask [b, maxlen] (ref sequence_ops/sequence_mask_op)."""
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen or -1, "out_dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False, seq_len=None):
+    """padded [b, t, ...] + lengths → pooled [b, ...]
+    (ref sequence_ops/sequence_pool_op.cc; pool_type in
+    average/sum/sqrt/max/last/first)."""
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32", True)
+    inputs = {"X": [input]}
+    seq_len = seq_len or getattr(input, "seq_len_var", None)
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op("sequence_pool", inputs=inputs,
+                     outputs={"Out": [out], "MaxIndex": [idx]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
+    """masked softmax over the time axis (ref sequence_softmax_op.cc)."""
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    seq_len = seq_len or getattr(input, "seq_len_var", None)
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op("sequence_softmax", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reverse(x, name=None, seq_len=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    seq_len = seq_len or getattr(x, "seq_len_var", None)
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op("sequence_reverse", inputs=inputs,
+                     outputs={"Y": [out]})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Broadcast per-sequence rows of x across y's time dim (padded form)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Identity in padded representation; returns (x, lengths)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64", True)
+    inputs = {"X": [x], "PadValue": [pad_value]}
+    seq_len = getattr(x, "seq_len_var", None)
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op("sequence_pad", inputs=inputs,
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs={"padded_length": maxlen or -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """Attach a length companion; data stays padded (zeros beyond length)."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_unpad", inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]})
+    out.seq_len_var = length.name if hasattr(length, "name") else length
+    return out
+
+
+def sequence_concat(input, name=None):
+    """Concat along time axis (padded)."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sequence_concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("sequence_enumerate", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand_as", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    return out

@@ -1,0 +1,36 @@
+"""MNIST models (ref ``python/paddle/fluid/tests/book/test_recognize_digits.py``
+— the BASELINE smoke config: softmax regression, MLP, and the conv-pool
+convnet at :65)."""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def softmax_regression(img):
+    return layers.fc(img, size=10, act="softmax")
+
+
+def multilayer_perceptron(img):
+    h1 = layers.fc(img, size=128, act="relu")
+    h2 = layers.fc(h1, size=64, act="relu")
+    return layers.fc(h2, size=10, act="softmax")
+
+
+def convolutional_neural_network(img):
+    """ref test_recognize_digits.py conv_net: two conv-pool blocks + fc."""
+    conv1 = layers.conv2d(img, num_filters=20, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=50, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    return layers.fc(pool2, size=10, act="softmax")
+
+
+def build_train_net(net_fn=convolutional_neural_network, img_shape=(1, 28, 28)):
+    img = layers.data("img", shape=list(img_shape), dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    prediction = net_fn(img)
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return img, label, prediction, avg_cost, acc

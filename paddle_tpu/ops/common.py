@@ -1,0 +1,64 @@
+"""Shared helpers for op lowerings."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def X(ins, slot, i=0, default=None):
+    """Fetch the i-th input of a slot, tolerating absent/empty slots."""
+    v = ins.get(slot)
+    if not v or i >= len(v) or v[i] is None:
+        return default
+    return v[i]
+
+
+def XS(ins, slot):
+    return [a for a in ins.get(slot, []) if a is not None]
+
+
+def broadcast_to_x(x, y, axis=-1):
+    """Fluid elementwise broadcast: y's shape is a contiguous slice of x's
+    starting at ``axis`` (ref ``operators/elementwise/elementwise_op_function.h``)."""
+    if y.ndim == 0 or y.shape == x.shape:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    trail = x.ndim - axis - y.ndim
+    if trail < 0:
+        return y
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * trail
+    return y.reshape(new_shape)
+
+
+def npdtype(name):
+    return jnp.dtype(name)
+
+
+def static_int(x, what, default=None):
+    """Read a compile-time integer from an optional tensor input.
+
+    XLA needs static shapes, so shape-feeding tensors (ShapeTensor, K,
+    OutSize, Num, …) must hold concrete values at trace time — feed them as
+    python ints/attrs, not as outputs of traced ops."""
+    if x is None:
+        return default
+    if isinstance(x, jax.core.Tracer):
+        raise TypeError(
+            f"{what} must be a compile-time constant under XLA; it was "
+            f"produced by a traced op. Pass a python int (attr) instead.")
+    return int(np.asarray(x))
+
+
+def canon_axis(axis, ndim):
+    return axis + ndim if axis < 0 else axis
+
+
+def reduce_axes(dim, ndim, reduce_all):
+    if reduce_all or dim is None:
+        return tuple(range(ndim))
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(canon_axis(d, ndim) for d in dim)

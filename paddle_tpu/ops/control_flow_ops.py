@@ -1,0 +1,137 @@
+"""Control-flow op lowerings: while → lax.while_loop, conditional_block →
+lax.cond, static recurrence → lax.scan.
+
+ref ``operators/controlflow/while_op.cc:43`` (sub-block per iteration into
+step scopes) and ``conditional_block_op.cc``.  On TPU the sub-block is traced
+ONCE into the loop body — no step scopes, no per-iteration dispatch; carried
+vars are the loop state.  This is the key semantic shift from the reference:
+bodies must be shape-static, and reverse-mode autodiff flows through scan
+(StaticRNN/DynamicRNN) but not while_loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _trace_subblock(ctx, sub_block, env):
+    """Run a sub-block's ops over an SSA env dict, returning the updated env."""
+    from ..framework.executor import _ExecState, run_block
+    state = _ExecState(env)
+    run_block(ctx, sub_block, state)
+    return state.values
+
+
+@register_op("while", no_grad=True, raw=True)
+def _while(ctx, block, op, state):
+    sub_block = op.attrs["sub_block"]
+    carried = op.attrs["carried_vars"]
+    cond_name = op.input("Condition")[0]
+    read_names = op.input("X")
+    consts = {n: state.values[n] for n in read_names
+              if n in state.values and n not in carried}
+    init = tuple(state.read(block, n) for n in carried)
+
+    def cond_fn(carry):
+        env = dict(consts)
+        env.update(zip(carried, carry))
+        return jnp.reshape(env[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(consts)
+        env.update(zip(carried, carry))
+        env = _trace_subblock(ctx, sub_block, env)
+        return tuple(env[n] for n in carried)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(carried, final):
+        state.write(n, v)
+
+
+@register_op("conditional_block", no_grad=True, raw=True)
+def _conditional_block(ctx, block, op, state):
+    """ref conditional_block_op.cc — both branches traced, selected by pred.
+
+    Vars written by the sub-block must pre-exist (their 'else' value is the
+    current value, or zeros if absent), mirroring the reference requirement
+    that outputs be initialized.
+    """
+    sub_block = op.attrs["sub_block"]
+    cond_name = op.input("Cond")[0] if op.input("Cond") else op.input("Condition")[0]
+    pred = jnp.reshape(state.read(block, cond_name), ()).astype(bool)
+    out_names = op.output("Out")
+    env0 = dict(state.values)
+
+    def true_fn(env_vals):
+        env = dict(env0)
+        env = _trace_subblock(ctx, sub_block, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(env_vals):
+        return tuple(
+            env0[n] if n in env0 else jnp.zeros(()) for n in out_names)
+
+    outs = jax.lax.cond(pred, true_fn, false_fn, ())
+    for n, v in zip(out_names, outs):
+        state.write(n, v)
+
+
+@register_op("static_scan", raw=True)
+def _static_scan(ctx, block, op, state):
+    """Recurrence over a leading time axis → lax.scan (differentiable).
+
+    The TPU-native realization of ``recurrent_op.cc``/StaticRNN: attrs carry
+    the sub_block, state var names (with init vars), per-step input names
+    (scanned along axis 0), and per-step outputs (stacked along axis 0).
+    """
+    sub_block = op.attrs["sub_block"]
+    state_names = op.attrs["state_vars"]        # names inside sub-block
+    init_names = op.input("Init")               # initial values (parent)
+    xs_names = op.attrs["step_input_vars"]      # names inside sub-block
+    seq_inputs = [state.read(block, n) for n in op.input("X")]
+    out_step_names = op.attrs["step_output_vars"]
+    consts = {n: v for n, v in state.values.items()
+              if n not in state_names and n not in xs_names}
+    init = tuple(state.read(block, n) for n in init_names)
+    reverse = op.attrs.get("reverse", False)
+
+    def body(carry, xs):
+        env = dict(consts)
+        env.update(zip(state_names, carry))
+        env.update(zip(xs_names, xs))
+        env = _trace_subblock(ctx, sub_block, env)
+        new_carry = tuple(env[n] for n in state_names)
+        ys = tuple(env[n] for n in out_step_names)
+        return new_carry, ys
+
+    time_major = op.attrs.get("time_major", False)
+    # scan over time axis 0: batch-major inputs [batch, time, ...] are
+    # transposed in (and their stacked outputs transposed back out)
+    xs = tuple(s if time_major else jnp.swapaxes(s, 0, 1)
+               for s in seq_inputs)
+    final, stacked = jax.lax.scan(body, init, xs, reverse=reverse)
+    for n, v in zip(op.output("FinalStates"), final):
+        state.write(n, v)
+    for n, v in zip(op.output("Out"), stacked):
+        state.write(n, v if time_major else jnp.swapaxes(v, 0, 1))
+
+
+@register_op("select_input", no_grad=True)
+def _select_input(ctx, ins, attrs):
+    from .common import X, XS
+    xs = XS(ins, "X")
+    mask = X(ins, "Mask")
+    idx = jnp.reshape(mask, ()).astype(jnp.int32)
+    stacked = jnp.stack(xs, 0)
+    return {"Out": [stacked[idx]]}
+
+
+@register_op("print", no_grad=True)
+def _print(ctx, ins, attrs):
+    from .common import X
+    x = X(ins, "In")
+    jax.debug.print(attrs.get("message", "") + "{}", x)
+    return {"Out": [x]}

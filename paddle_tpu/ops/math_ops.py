@@ -1,0 +1,343 @@
+"""Math op lowerings: elementwise binary ops, activations, matmul/mul.
+
+Reference kernels: ``operators/elementwise/`` (35 files),
+``operators/activation_op.cc`` (30 activations via
+FOR_EACH_ACTIVATION_OP, :607-636), ``operators/mul_op.cc``,
+``operators/matmul_op.cc``, ``operators/clip_op.cc`` …
+On TPU all of these are XLA elementwise/dot HLOs; the MXU takes the dots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import X, XS, broadcast_to_x
+
+# -- elementwise binary (ref operators/elementwise/*.cc) ---------------------
+
+_ELEMENTWISE = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_min": jnp.minimum,
+    "elementwise_max": jnp.maximum,
+    "elementwise_pow": jnp.power,
+    "elementwise_mod": jnp.mod,
+    "elementwise_floordiv": jnp.floor_divide,
+}
+
+
+def _make_elementwise(name, fn):
+    def lower(ctx, ins, attrs):
+        x, y = X(ins, "X"), X(ins, "Y")
+        y = broadcast_to_x(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+    register_op(name, lower)
+
+
+for _n, _f in _ELEMENTWISE.items():
+    _make_elementwise(_n, _f)
+
+
+# -- activations (ref operators/activation_op.h table) -----------------------
+
+_ACTIVATIONS = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "ceil": jnp.ceil,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "exp": jnp.exp,
+    "floor": jnp.floor,
+    "log": jnp.log,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "reciprocal": lambda x: 1.0 / x,
+    "relu": jax.nn.relu,
+    "round": jnp.round,
+    "rsqrt": jax.lax.rsqrt,
+    "sigmoid": jax.nn.sigmoid,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tanh": jnp.tanh,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "sign": jnp.sign,
+    "erf": jax.scipy.special.erf,
+}
+
+
+def _make_activation(name, fn):
+    def lower(ctx, ins, attrs):
+        return {"Out": [fn(X(ins, "X"))]}
+    register_op(name, lower)
+
+
+for _n, _f in _ACTIVATIONS.items():
+    _make_activation(_n, _f)
+
+
+@register_op("gelu")
+def _gelu(ctx, ins, attrs):
+    return {"Out": [jax.nn.gelu(X(ins, "X"),
+                                approximate=attrs.get("approximate", False))]}
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    x = X(ins, "X")
+    a = attrs.get("alpha", 0.02)
+    return {"Out": [jnp.where(x >= 0, x, a * x)]}
+
+
+@register_op("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": [jax.nn.elu(X(ins, "X"), alpha=attrs.get("alpha", 1.0))]}
+
+
+@register_op("selu")
+def _selu(ctx, ins, attrs):
+    x = X(ins, "X")
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))]}
+
+
+@register_op("relu6")
+def _relu6(ctx, ins, attrs):
+    t = attrs.get("threshold", 6.0)
+    return {"Out": [jnp.clip(X(ins, "X"), 0.0, t)]}
+
+
+@register_op("brelu")
+def _brelu(ctx, ins, attrs):
+    return {"Out": [jnp.clip(X(ins, "X"), attrs.get("t_min", 0.0),
+                             attrs.get("t_max", 24.0))]}
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    x = X(ins, "X")
+    f = X(ins, "FactorTensor")
+    factor = f if f is not None else attrs.get("factor", 1.0)
+    return {"Out": [jnp.power(x, factor)]}
+
+
+@register_op("stanh")
+def _stanh(ctx, ins, attrs):
+    x = X(ins, "X")
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": [b * jnp.tanh(a * x)]}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    x = X(ins, "X")
+    s = attrs.get("slope", 0.2)
+    o = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(s * x + o, 0.0, 1.0)]}
+
+
+@register_op("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    x = X(ins, "X")
+    t = attrs.get("threshold", 6.0)
+    s = attrs.get("scale", 6.0)
+    o = attrs.get("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + o, 0.0, t) / s]}
+
+
+@register_op("swish")
+def _swish(ctx, ins, attrs):
+    x = X(ins, "X")
+    beta = attrs.get("beta", 1.0)
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("soft_relu")
+def _soft_relu(ctx, ins, attrs):
+    x = X(ins, "X")
+    t = attrs.get("threshold", 40.0)
+    return {"Out": [jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))]}
+
+
+@register_op("softshrink")
+def _softshrink(ctx, ins, attrs):
+    x = X(ins, "X")
+    l = attrs.get("lambda", 0.5)
+    return {"Out": [jnp.where(x > l, x - l, jnp.where(x < -l, x + l, 0.0))]}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ctx, ins, attrs):
+    x = X(ins, "X")
+    t = attrs.get("threshold", 0.5)
+    return {"Out": [jnp.where(jnp.abs(x) > t, x, 0.0)]}
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(ctx, ins, attrs):
+    x = X(ins, "X")
+    t = attrs.get("threshold", 1.0)
+    return {"Out": [jnp.where(x > t, x, 0.0)]}
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = X(ins, "X"), X(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    x = X(ins, "X")  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // groups, groups, h, w).max(axis=2)]}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(X(ins, "X"), attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = X(ins, "X")
+    mn = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > mn, x * (mn / norm), x)]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.sum(jnp.square(x)).reshape(1)]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(X(ins, "X"))).reshape(())]}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [X(ins, "X") - X(ins, "Y")]}
+
+
+# -- matmul family (MXU ops) -------------------------------------------------
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """ref operators/mul_op.cc: flatten X to 2-D at x_num_col_dims, ditto Y."""
+    x, y = X(ins, "X"), X(ins, "Y")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xnc])), -1)
+    y2 = y.reshape(int(np.prod(ys[:ync])), -1)
+    out = x2 @ y2
+    out_shape = xs[:xnc] + ys[ync:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+register_op("matmul_v2", _matmul)
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = X(ins, "X"), X(ins, "Y"), X(ins, "Weight")
+    bias = X(ins, "Bias")
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if bias is not None:
+        out = out + bias
+    return {"Out": [out]}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+# -- comparisons / logical ---------------------------------------------------
+
+_COMPARE = {
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+}
+
+
+def _make_compare(name, fn):
+    def lower(ctx, ins, attrs):
+        x, y = X(ins, "X"), X(ins, "Y")
+        y = broadcast_to_x(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+    register_op(name, lower, no_grad=True)
+
+
+for _n, _f in _COMPARE.items():
+    _make_compare(_n, _f)
+
+
+_LOGICAL = {"logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+            "logical_xor": jnp.logical_xor}
+for _n, _f in _LOGICAL.items():
+    def _mk(fn):
+        def lower(ctx, ins, attrs):
+            return {"Out": [fn(X(ins, "X"), X(ins, "Y"))]}
+        return lower
+    register_op(_n, _mk(_f), no_grad=True)
+
+register_op("logical_not",
+            lambda ctx, ins, attrs: {"Out": [jnp.logical_not(X(ins, "X"))]},
+            no_grad=True)
+
+
+@register_op("is_empty", no_grad=True)
+def _is_empty(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.asarray(int(np.prod(x.shape)) == 0)]}

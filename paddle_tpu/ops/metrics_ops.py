@@ -1,0 +1,104 @@
+"""Metric op lowerings (ref ``operators/metrics/``: accuracy, auc,
+precision_recall)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import X
+
+
+@register_op("accuracy", no_grad=True)
+def _accuracy(ctx, ins, attrs):
+    """ref operators/metrics/accuracy_op.cc — Out: [topk] indices vs label."""
+    indices, label = X(ins, "Indices"), X(ins, "Label")
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    correct = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    n = indices.shape[0]
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    return {"Accuracy": [num_correct / n],
+            "Correct": [num_correct.astype(jnp.int32)],
+            "Total": [jnp.asarray(n, jnp.int32)]}
+
+
+@register_op("auc", no_grad=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC with histogram stat buffers (ref metrics/auc_op.cc)."""
+    predict, label = X(ins, "Predict"), X(ins, "Label")
+    stat_pos, stat_neg = X(ins, "StatPos"), X(ins, "StatNeg")
+    num_thresh = attrs.get("num_thresholds", 4095)
+    pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] > 1 \
+        else predict.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.float32)
+    bins = jnp.clip((pos_score * num_thresh).astype(jnp.int32), 0, num_thresh)
+    sp = stat_pos.reshape(-1).at[bins].add(lab)
+    sn = stat_neg.reshape(-1).at[bins].add(1.0 - lab)
+    # trapezoid sum over thresholds, descending
+    tp = jnp.cumsum(sp[::-1])
+    fp = jnp.cumsum(sn[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {"AUC": [auc], "StatPosOut": [sp.reshape(stat_pos.shape)],
+            "StatNegOut": [sn.reshape(stat_neg.shape)]}
+
+
+@register_op("precision_recall", no_grad=True)
+def _precision_recall(ctx, ins, attrs):
+    indices, labels = X(ins, "Indices"), X(ins, "Labels")
+    states = X(ins, "StatesInfo")
+    cls = attrs["class_number"]
+    pred = indices.reshape(-1).astype(jnp.int32)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    tp = jnp.zeros((cls,), jnp.float32).at[lab].add((pred == lab).astype(jnp.float32))
+    fp = jnp.zeros((cls,), jnp.float32).at[pred].add((pred != lab).astype(jnp.float32))
+    fn = jnp.zeros((cls,), jnp.float32).at[lab].add((pred != lab).astype(jnp.float32))
+    batch_states = jnp.stack([tp, fp, jnp.zeros_like(tp), fn], axis=1)
+    acc_states = batch_states + (states if states is not None else 0.0)
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        tps, fps, fns = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mprec = jnp.where(tps + fps > 0, tps / (tps + fps + 1e-12), 0.0)
+        mrec = jnp.where(tps + fns > 0, tps / (tps + fns + 1e-12), 0.0)
+        mf1 = jnp.where(mprec + mrec > 0,
+                        2 * mprec * mrec / (mprec + mrec + 1e-12), 0.0)
+        micro = jnp.stack([mprec, mrec, mf1])
+        return jnp.concatenate([macro, micro])
+
+    return {"BatchMetrics": [metrics(batch_states)],
+            "AccumMetrics": [metrics(acc_states)],
+            "AccumStatesInfo": [acc_states]}
+
+
+@register_op("mean_iou", no_grad=True)
+def _mean_iou(ctx, ins, attrs):
+    pred, label = X(ins, "Predictions"), X(ins, "Labels")
+    n = attrs["num_classes"]
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    inter = jnp.zeros((n,), jnp.float32).at[l].add((p == l).astype(jnp.float32))
+    area_p = jnp.zeros((n,), jnp.float32).at[p].add(1.0)
+    area_l = jnp.zeros((n,), jnp.float32).at[l].add(1.0)
+    union = area_p + area_l - inter
+    iou = jnp.where(union > 0, inter / (union + 1e-12), 0.0)
+    valid = (union > 0).astype(jnp.float32)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {"OutMeanIou": [mean_iou], "OutWrong": [(union - inter).astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+@register_op("chunk_eval", no_grad=True)
+def _chunk_eval(ctx, ins, attrs):
+    raise NotImplementedError(
+        "chunk_eval requires host-side chunk parsing; use "
+        "paddle_tpu.metrics.ChunkEvaluator on fetched numpy outputs")

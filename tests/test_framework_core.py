@@ -1,0 +1,99 @@
+"""Core IR + executor tests (≈ ref framework/program_desc_test.cc,
+executor tests, tests/unittests/test_program.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, append_backward,
+                                  default_main_program, program_guard)
+
+
+def test_program_build():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=3)
+    prog = default_main_program()
+    assert y.shape == (-1, 3) or y.shape[1] == 3
+    types = [op.type for op in prog.global_block().ops]
+    assert "mul" in types and "elementwise_add" in types
+
+
+def test_executor_forward():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=3, act="relu")
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    out, = exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[y])
+    assert out.shape == (2, 3)
+    assert (out >= 0).all()
+
+
+def test_fetch_multiple_and_feed_types():
+    x = layers.data("x", shape=[3], dtype="float32")
+    a = layers.scale(x, scale=2.0)
+    b = layers.scale(x, scale=3.0, bias=1.0)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    av, bv = exe.run(feed={"x": xv}, fetch_list=[a, b])
+    np.testing.assert_allclose(av, xv * 2)
+    np.testing.assert_allclose(bv, xv * 3 + 1)
+
+
+def test_program_guard_isolation():
+    p1, s1 = Program(), Program()
+    with program_guard(p1, s1):
+        x = layers.data("x", shape=[2])
+        layers.fc(x, size=2)
+        assert default_main_program() is p1
+    assert default_main_program() is not p1
+
+
+def test_serialize_roundtrip():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=3)
+    prog = default_main_program()
+    data = prog.serialize_to_string()
+    prog2 = Program.parse_from_string(data)
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+    assert set(prog2.global_block().vars) == set(prog.global_block().vars)
+
+
+def test_clone_for_test_flips_is_test():
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.fc(x, size=8)
+    h = layers.dropout(h, dropout_prob=0.5)
+    prog = default_main_program()
+    test_prog = prog.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops and all(op.attrs["is_test"] for op in drop_ops)
+    # original untouched
+    assert not any(op.attrs["is_test"]
+                   for op in prog.global_block().ops if op.type == "dropout")
+
+
+def test_variable_operator_overloads():
+    x = layers.data("x", shape=[3], dtype="float32")
+    y = (x + 1.0) * 2.0 - 0.5
+    z = y / 4.0
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.zeros((2, 3), np.float32)
+    out, = exe.run(feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, np.full((2, 3), ((0 + 1) * 2 - 0.5) / 4))
+
+
+def test_prune():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y1 = layers.fc(x, size=3)
+    y2 = layers.fc(x, size=5)
+    prog = default_main_program()
+    pruned = prog._prune([y1])
+    # ops feeding only y2 must be gone
+    used = {n for op in pruned.global_block().ops
+            for n in op.output_arg_names()}
+    assert y1.name in used
+    assert y2.name not in used
