@@ -1,0 +1,87 @@
+"""Benchmark: BERT-base MLM training step on one chip → MFU vs the 35%
+BASELINE target (BASELINE.md).  Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import transformer as T
+
+    dev = jax.devices()[0]
+    platform = getattr(dev, "platform", "cpu")
+    on_tpu = platform in ("tpu", "axon")
+
+    # peak dense bf16 FLOP/s per chip (TPU f32 matmuls run bf16 passes at
+    # DEFAULT precision, so bf16 peak is the right denominator)
+    PEAK = {"v5e": 197e12, "v5lite": 197e12, "v5": 197e12,
+            "v4": 275e12, "v5p": 459e12}
+    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
+    peak = next((v for k, v in PEAK.items() if k in kind), 197e12)
+
+    if on_tpu:
+        cfg = T.BertConfig()           # BERT-base
+        batch, seq_len, steps = 128, 128, 4
+    else:                              # CPU smoke fallback
+        cfg = T.BertConfig(vocab_size=1024, d_model=128, n_layer=2,
+                           n_head=4, d_inner=256, max_pos=128)
+        batch, seq_len, steps = 4, 64, 2
+        peak = 1e12
+
+    feeds, logits, loss = T.build_bert_pretrain(cfg, seq_len)
+    optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
+    optimizer.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(1, cfg.vocab_size,
+                               (batch, seq_len)).astype(np.int64),
+        "pos_ids": np.tile(np.arange(seq_len), (batch, 1)).astype(np.int64),
+        "lm_label": rng.randint(0, cfg.vocab_size,
+                                (batch, seq_len)).astype(np.int64),
+    }
+
+    # warmup (XLA compile)
+    lv, = exe.run(feed=feed, fetch_list=[loss.name])
+    float(np.asarray(lv))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv, = exe.run(feed=feed, fetch_list=[loss.name])
+    float(np.asarray(lv))              # sync
+    dt = (time.perf_counter() - t0) / steps
+
+    # matmul param count (excludes gather-only embeddings)
+    d, L, F, V = cfg.d_model, cfg.n_layer, cfg.d_inner, cfg.vocab_size
+    n_matmul = L * (4 * d * d + 2 * d * F) + V * d
+    tokens = batch * seq_len
+    flops = 6 * n_matmul * tokens + 12 * L * d * seq_len * tokens
+    mfu = flops / dt / peak
+
+    print(json.dumps({
+        "metric": "bert_base_train_mfu" if on_tpu else "bert_tiny_train_smoke",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "step_time_s": round(dt, 4),
+        "tokens_per_s": round(tokens / dt, 1),
+        "device": str(dev),
+        "batch": batch, "seq_len": seq_len,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,7 @@ ops XLA can't fuse (see paddle_tpu.pallas).
 """
 
 from . import ops  # registers all op lowerings
-from . import initializer, layers, regularizer  # noqa
+from . import amp, initializer, layers, regularizer  # noqa
 from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,  # noqa
                    GradientClipByValue)
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa

@@ -1,0 +1,124 @@
+"""Automatic mixed precision (SURVEY §5.9; ref
+``python/paddle/fluid/contrib/mixed_precision/decorator.py:27,208``,
+``fp16_lists.py``, ``fp16_utils.py``).
+
+The reference rewrites the ProgramDesc, inserting cast ops around white/black
+listed ops and wrapping the optimizer with (dynamic) loss scaling.  The
+TPU-native realization casts at lowering time instead: inputs to
+matmul-class ops ("white list") are cast to bf16 as the block is traced, and
+numerically-sensitive ops ("black list") are forced to f32.  Master weights
+stay f32 in the Scope; XLA fuses the cast pairs away, so the effect is pure
+bf16 MXU traffic with f32 accumulation — no loss scaling needed for bf16
+(the fp16 dynamic-loss-scaling API is kept for parity and for fp16 policies).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# ops whose FLOPs dominate and that are bf16-safe (ref fp16_lists.py
+# white_list)
+WHITE_LIST = {
+    "mul", "matmul", "matmul_v2", "conv2d", "depthwise_conv2d", "conv3d",
+    "conv2d_transpose", "fc", "bilinear_tensor_product",
+}
+
+# numerically-sensitive ops forced to f32 (ref fp16_lists.py black_list).
+# Norm/softmax ops are NOT here: their lowerings already compute statistics
+# in f32 internally and return the input dtype, which keeps the activation
+# stream bf16 (the reference had to blacklist them because its kernels were
+# dtype-monomorphic).
+BLACK_LIST = {
+    "softmax_with_cross_entropy", "softmax_with_cross_entropy_grad",
+    "cross_entropy", "cross_entropy2",
+    "mean", "reduce_mean", "reduce_sum", "sum", "exp", "log",
+    "squared_l2_norm", "l2_normalize", "norm",
+    "sigmoid_cross_entropy_with_logits",
+    "isfinite", "sqrt", "rsqrt", "pow", "logsumexp",
+}
+
+# big elementwise traffic (residual adds, bias adds, activations, dropout):
+# cast f32→bf16 ONLY when operating on real activation tensors (ndim≥3) so
+# scalar/LR-schedule math keeps full precision.  This keeps the residual
+# stream bf16 — HBM bandwidth is the usual TPU bottleneck.
+BF16_IF_BIG = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "dropout",
+    "gelu", "relu", "tanh", "sigmoid", "swish", "leaky_relu", "relu6",
+    "softmax", "layer_norm", "batch_norm", "group_norm", "scale", "concat",
+}
+
+_COMPUTE = jnp.bfloat16
+_FLOATS = (jnp.float32, jnp.bfloat16, jnp.float16)
+
+# norm ops carry f32 STATE inputs (running mean/var, scale/bias) that must
+# not be rounded to bf16 every step — only the activation slot is cast
+_SLOT_RESTRICT = {"batch_norm": {"X"}, "layer_norm": {"X"},
+                  "group_norm": {"X"}}
+
+
+def _cast_all(ins, target, slots=None):
+    out = {}
+    for slot, arrs in ins.items():
+        if slots is not None and slot not in slots:
+            out[slot] = arrs
+            continue
+        converted = []
+        for a in arrs:
+            if a is not None and hasattr(a, "dtype") and \
+                    a.dtype in _FLOATS and a.dtype != target:
+                a = a.astype(target)
+            converted.append(a)
+        out[slot] = converted
+    return out
+
+
+def cast_ins(op_type: str, ins):
+    """Apply the AMP policy to an op's input arrays at trace time."""
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    if base in WHITE_LIST or op_type in WHITE_LIST:
+        return _cast_all(ins, _COMPUTE)
+    if base in BLACK_LIST or op_type in BLACK_LIST:
+        return _cast_all(ins, jnp.float32)
+    if base in BF16_IF_BIG:
+        big = any(a is not None and getattr(a, "ndim", 0) >= 3
+                  for arrs in ins.values() for a in arrs)
+        if big:
+            return _cast_all(ins, _COMPUTE, _SLOT_RESTRICT.get(base))
+    return ins
+
+
+def enable(program=None):
+    """Turn on bf16 AMP for a program's lowering."""
+    from .framework.core import default_main_program
+    program = program or default_main_program()
+    program._attrs["amp"] = True
+    program._bump_version()
+    return program
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True):
+    """ref decorator.py:27 — returns an optimizer whose minimize() enables
+    bf16 AMP on the program.  bf16 needs no loss scaling (unlike the
+    reference's fp16), so the scaling knobs are accepted for API parity and
+    recorded on the wrapper."""
+
+    class _AmpOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+            self._loss_scaling = init_loss_scaling
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def minimize(self, loss, **kw):
+            enable(loss.block.program)
+            return self._inner.minimize(loss, **kw)
+
+        def backward(self, loss, **kw):
+            enable(loss.block.program)
+            return self._inner.backward(loss, **kw)
+
+    return _AmpOptimizer(optimizer)

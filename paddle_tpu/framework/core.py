@@ -102,6 +102,12 @@ class Variable:
         self.need_clip = need_clip
         # companion var name holding sequence lengths (LoD replacement)
         self.seq_len_var: Optional[str] = None
+        # GSPMD sharding annotation: tuple of mesh-axis names (or None) per
+        # dim, e.g. (None, "mp") for a column-parallel weight.  This is the
+        # TPU-native stand-in for the reference's per-var placement logic in
+        # multi_devices_graph_pass (params were only ever replicated or
+        # round-robin "Reduce"-sharded there).
+        self.dist_spec = None
 
     # -- sugar mirroring the reference Variable's operator overloads ---------
     def _binary(self, other, op, reverse=False):
@@ -120,6 +126,13 @@ class Variable:
     def __neg__(self):
         from ..layers import math_ops
         return math_ops.scale(self, scale=-1.0)
+
+    # comparisons build compare ops (==/!= are NOT overridden: Variables
+    # must stay usable in python containers)
+    def __lt__(self, o): return self._binary(o, "less_than")
+    def __le__(self, o): return self._binary(o, "less_equal")
+    def __gt__(self, o): return self._binary(o, "greater_than")
+    def __ge__(self, o): return self._binary(o, "greater_equal")
 
     def __repr__(self):
         return (f"Variable(name={self.name}, shape={self.shape}, "

@@ -1,0 +1,67 @@
+"""Program → pure-function export: lower a Program block to a callable
+``fn(params_dict, *feeds) -> fetches`` suitable for jax.jit / AOT export.
+
+This is the functional face of the Executor's block compiler — used by
+``__graft_entry__``, the inference engine, and anywhere a Program must
+compose with raw JAX transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+
+from .core import Program, Variable
+from .executor import Executor, LowerCtx, _ExecState, run_block
+from .scope import Scope, scope_guard
+
+
+def init_program_params(startup_program: Program, scope=None, seed=0):
+    """Run a startup program, returning {name: jax.Array} of persistables."""
+    scope = scope or Scope()
+    with scope_guard(scope):
+        exe = Executor()
+        exe.run(startup_program, seed=seed)
+    return {name: val for name, val in scope.items() if val is not None}
+
+
+def program_as_function(program: Program, feed_names: Sequence[str],
+                        fetch_names: Sequence[str]):
+    """Return fn(params, *feeds) -> tuple(fetches); params is {name: array}
+    of every persistable the block reads."""
+    block = program.global_block()
+    feed_names = [f.name if isinstance(f, Variable) else f for f in feed_names]
+    fetch_names = [f.name if isinstance(f, Variable) else f
+                   for f in fetch_names]
+
+    def fn(params: Dict[str, jax.Array], *feeds):
+        values = dict(params)
+        values.update(zip(feed_names, feeds))
+        state = _ExecState(values)
+        run_block(LowerCtx(jax.random.key(0)), block, state)
+        return tuple(state.values[n] for n in fetch_names)
+
+    return fn
+
+
+def program_as_train_step(program: Program, feed_names: Sequence[str],
+                          fetch_names: Sequence[str],
+                          state_names: Sequence[str]):
+    """fn(state, *feeds) -> (fetches, new_state): one full optimizer step as
+    a pure function over the training state (params + accumulators)."""
+    block = program.global_block()
+    feed_names = [f.name if isinstance(f, Variable) else f for f in feed_names]
+    fetch_names = [f.name if isinstance(f, Variable) else f
+                   for f in fetch_names]
+
+    def fn(state: Dict[str, jax.Array], *feeds, seed=0):
+        values = dict(state)
+        values.update(zip(feed_names, feeds))
+        st = _ExecState(values)
+        run_block(LowerCtx(jax.random.key(seed)), block, st)
+        fetches = tuple(st.values[n] for n in fetch_names)
+        new_state = {n: st.values[n] for n in state_names}
+        return fetches, new_state
+
+    return fn

@@ -213,6 +213,11 @@ def generic_grad_lower(ctx, ins: Dict[str, List], attrs: Dict[str, Any]):
         for slot, n in spec:
             d[slot] = list(flat[i:i + n])
             i += n
+        if getattr(ctx, "amp", False):
+            # cast INSIDE the vjp so master-weight grads come back f32
+            # while the recomputed forward hits the MXU in bf16
+            from .. import amp as _amp
+            d = _amp.cast_ins(fwd_type, d)
         outs = info.lower(ctx, d, fwd_attrs)
         flat_out = []
         for slot in og_slots:

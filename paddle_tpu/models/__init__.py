@@ -1,1 +1,1 @@
-from . import mnist  # noqa
+from . import mnist, resnet, transformer  # noqa

@@ -1,0 +1,89 @@
+"""ResNet family (BASELINE config #2: ResNet-50 ImageNet — ref fluid
+image_classification recipe / tests/unittests/dist_se_resnext.py style)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, name=None, is_test=False):
+    conv = layers.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         bias_attr=False,
+                         param_attr=ParamAttr(name=f"{name}.conv.w"))
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             param_attr=ParamAttr(name=f"{name}.bn.scale"),
+                             bias_attr=ParamAttr(name=f"{name}.bn.offset"),
+                             moving_mean_name=f"{name}.bn.mean",
+                             moving_variance_name=f"{name}.bn.var")
+
+
+def shortcut(input, ch_out, stride, name, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, name=name,
+                             is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          name=f"{name}.b0", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
+                          name=f"{name}.b1", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, name=f"{name}.b2",
+                          is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride, f"{name}.short",
+                     is_test=is_test)
+    return layers.relu(short + conv2)
+
+
+def basic_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 3, stride=stride, act="relu",
+                          name=f"{name}.b0", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, name=f"{name}.b1",
+                          is_test=is_test)
+    short = shortcut(input, num_filters, stride, f"{name}.short",
+                     is_test=is_test)
+    return layers.relu(short + conv1)
+
+
+_DEPTH_CFG = {
+    18: (basic_block, [2, 2, 2, 2]),
+    34: (basic_block, [3, 4, 6, 3]),
+    50: (bottleneck_block, [3, 4, 6, 3]),
+    101: (bottleneck_block, [3, 4, 23, 3]),
+    152: (bottleneck_block, [3, 8, 36, 3]),
+}
+
+
+def resnet(input, class_dim=1000, depth=50, is_test=False):
+    block_fn, counts = _DEPTH_CFG[depth]
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu", name="stem",
+                         is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
+    filters = [64, 128, 256, 512]
+    x = pool
+    for stage, (nf, cnt) in enumerate(zip(filters, counts)):
+        for blk in range(cnt):
+            stride = 2 if blk == 0 and stage > 0 else 1
+            x = block_fn(x, nf, stride, f"res{stage}_{blk}", is_test=is_test)
+    pool = layers.pool2d(x, global_pooling=True, pool_type="avg")
+    return layers.fc(pool, size=class_dim, act="softmax",
+                     param_attr=ParamAttr(name="fc_out.w"),
+                     bias_attr=ParamAttr(name="fc_out.b"))
+
+
+def build_resnet_train(class_dim=1000, depth=50, image_shape=(3, 224, 224),
+                       is_test=False):
+    img = layers.data("image", shape=list(image_shape), dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = resnet(img, class_dim, depth, is_test=is_test)
+    cost = layers.cross_entropy(pred, label)
+    avg_cost = layers.mean(cost)
+    acc1 = layers.accuracy(pred, label, k=1)
+    acc5 = layers.accuracy(pred, label, k=5)
+    return (img, label), pred, avg_cost, (acc1, acc5)

@@ -1,0 +1,247 @@
+"""Transformer encoder / BERT-style models built from the layer DSL.
+
+ref ``python/paddle/fluid/tests/unittests/dist_transformer.py:958,1034``
+(multi_head_attention / scaled_dot_product_attention built from fluid.layers
+— the BASELINE Transformer recipe) and the LARK BERT config (BASELINE.md).
+
+TPU-first notes: everything is dense [batch, seq, d] (no LoD); attention is
+plain batched matmul so XLA can fuse and the MXU takes the contractions.
+``annotate_tensor_parallel`` marks the canonical Megatron layout on the
+weights (QKV/FFN-in column-parallel, proj/FFN-out row-parallel) via
+``Variable.dist_spec`` — under a mesh with an ``mp`` axis GSPMD inserts the
+two all-reduces per layer; on a dp-only mesh the annotations are inert.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def multi_head_attention(queries, keys, values, d_model, n_head,
+                         dropout_rate=0.0, attn_bias=None, is_test=False,
+                         param_prefix="attn"):
+    """ref dist_transformer.py:958 multi_head_attention."""
+    d_head = d_model // n_head
+
+    def _proj(x, size, name):
+        return layers.fc(x, size=size, num_flatten_dims=2,
+                         param_attr=ParamAttr(name=f"{param_prefix}.{name}.w"),
+                         bias_attr=ParamAttr(name=f"{param_prefix}.{name}.b"))
+
+    if queries is keys and keys is values:
+        # self-attention: one fused QKV projection — bigger MXU tile, one
+        # HBM read of the activations instead of three
+        qkv = _proj(queries, 3 * d_model, "qkv")
+        q, k, v = layers.split(qkv, 3, dim=2)
+    else:
+        q = _proj(queries, d_model, "q")
+        k = _proj(keys, d_model, "k")
+        v = _proj(values, d_model, "v")
+
+    def _split_heads(x):
+        # [b, t, d] -> [b, h, t, dh]
+        y = layers.reshape(x, shape=[0, 0, n_head, d_head])
+        return layers.transpose(y, perm=[0, 2, 1, 3])
+
+    q, k, v = _split_heads(q), _split_heads(k), _split_heads(v)
+    # scaled dot-product attention (ref dist_transformer.py:1034)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=float(d_head) ** -0.5)
+    if attn_bias is not None:
+        scores = scores + attn_bias
+    weights = layers.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                 is_test=is_test,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)                       # [b, h, t, dh]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=f"{param_prefix}.out.w"),
+                     bias_attr=ParamAttr(name=f"{param_prefix}.out.b"))
+
+
+def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0, is_test=False,
+                     param_prefix="ffn", act="gelu"):
+    h = layers.fc(x, size=d_inner, num_flatten_dims=2, act=act,
+                  param_attr=ParamAttr(name=f"{param_prefix}.fc1.w"),
+                  bias_attr=ParamAttr(name=f"{param_prefix}.fc1.b"))
+    if dropout_rate:
+        h = layers.dropout(h, dropout_prob=dropout_rate, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, size=d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=f"{param_prefix}.fc2.w"),
+                     bias_attr=ParamAttr(name=f"{param_prefix}.fc2.b"))
+
+
+def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
+                  attn_bias=None, is_test=False, idx=0):
+    """post-LN residual block (ref dist_transformer encoder_layer)."""
+    attn = multi_head_attention(x, x, x, d_model, n_head, dropout_rate,
+                                attn_bias, is_test,
+                                param_prefix=f"enc_{idx}.attn")
+    if dropout_rate:
+        attn = layers.dropout(attn, dropout_prob=dropout_rate,
+                              is_test=is_test,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(x + attn, begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"enc_{idx}.ln1.w"),
+                          bias_attr=ParamAttr(name=f"enc_{idx}.ln1.b"))
+    ffn = positionwise_ffn(x, d_inner, d_model, dropout_rate, is_test,
+                           param_prefix=f"enc_{idx}.ffn")
+    if dropout_rate:
+        ffn = layers.dropout(ffn, dropout_prob=dropout_rate, is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(x + ffn, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"enc_{idx}.ln2.w"),
+                             bias_attr=ParamAttr(name=f"enc_{idx}.ln2.b"))
+
+
+def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
+            n_head, dropout_rate=0.0, attn_bias=None, is_test=False,
+            type_ids=None, n_types=2):
+    """BERT-style embedding + N encoder layers."""
+    emb = layers.embedding(src_ids, size=[vocab_size, d_model],
+                           param_attr=ParamAttr(name="word_embedding"))
+    pos = layers.embedding(pos_ids, size=[max_pos, d_model],
+                           param_attr=ParamAttr(name="pos_embedding"))
+    x = emb + pos
+    if type_ids is not None:
+        x = x + layers.embedding(type_ids, size=[n_types, d_model],
+                                 param_attr=ParamAttr(name="sent_embedding"))
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="pre_encoder.ln.w"),
+                          bias_attr=ParamAttr(name="pre_encoder.ln.b"))
+    if dropout_rate:
+        x = layers.dropout(x, dropout_prob=dropout_rate, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    for i in range(n_layer):
+        x = encoder_layer(x, d_model, d_inner, n_head, dropout_rate,
+                          attn_bias, is_test, idx=i)
+    return x
+
+
+class BertConfig:
+    """BERT-base defaults (BASELINE config #4)."""
+
+    def __init__(self, vocab_size=30522, d_model=768, n_layer=12, n_head=12,
+                 d_inner=3072, max_pos=512, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.d_inner = d_inner
+        self.max_pos = max_pos
+        self.dropout = dropout
+
+    def num_params(self):
+        V, D, L, F, P = (self.vocab_size, self.d_model, self.n_layer,
+                         self.d_inner, self.max_pos)
+        per_layer = 4 * D * D + 4 * D + 2 * D * F + F + D + 4 * D
+        return V * D + P * D + 2 * D + L * per_layer
+
+
+def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
+                        dropout=None):
+    """Masked-LM pretraining net: ids+mask-labels → mean masked CE loss.
+
+    Labels use 0 ([PAD], never a real MLM target) for unmasked positions;
+    positions with label 0 are excluded from loss and denominator — the
+    masked-LM objective of the LARK recipe."""
+    dropout = cfg.dropout if dropout is None else dropout
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    pos_ids = layers.data("pos_ids", shape=[seq_len], dtype="int64")
+    lm_label = layers.data("lm_label", shape=[seq_len], dtype="int64")
+    enc = encoder(src_ids, pos_ids, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
+                  cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
+                  is_test=is_test)
+    logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="mlm_out.w"),
+                       bias_attr=ParamAttr(name="mlm_out.b"))
+    # masked positions only: label 0 ([PAD]) is ignored
+    loss = layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(lm_label, [2]), ignore_index=0)
+    mask = layers.cast(lm_label > 0, "float32")
+    masked = layers.reduce_sum(loss * layers.unsqueeze(mask, [2]))
+    denom = layers.reduce_sum(mask) + 1e-6
+    avg_loss = masked / denom
+    return (src_ids, pos_ids, lm_label), logits, avg_loss
+
+
+def annotate_tensor_parallel(program=None):
+    """Megatron-style TP layout via dist_spec (SURVEY §2.5: TP is a
+    capability the reference LACKS — first-class here)."""
+    from ..framework.core import default_main_program
+    program = program or default_main_program()
+    for p in program.all_parameters():
+        n = p.name
+        if n.endswith((".q.w", ".k.w", ".v.w", ".qkv.w", ".fc1.w")):
+            p.dist_spec = (None, "mp")          # column parallel
+        elif n.endswith((".q.b", ".k.b", ".v.b", ".qkv.b", ".fc1.b")):
+            p.dist_spec = ("mp",)
+        elif n.endswith((".out.w", ".fc2.w")):
+            p.dist_spec = ("mp", None)          # row parallel
+        elif n == "word_embedding":
+            p.dist_spec = ("mp", None)          # vocab sharded
+        elif n == "mlm_out.w":
+            p.dist_spec = (None, "mp")
+        elif n == "mlm_out.b":
+            p.dist_spec = ("mp",)
+    return program
+
+
+# -- Transformer-base NMT (BASELINE config #3, WMT14 en-de) ------------------
+
+def build_transformer_nmt(src_vocab, trg_vocab, seq_len, d_model=512,
+                          n_layer=6, n_head=8, d_inner=2048, dropout=0.1,
+                          is_test=False):
+    """Encoder-decoder NMT Transformer (ref dist_transformer.py transformer()).
+
+    Decoder self-attention uses a causal additive bias; cross-attention
+    attends encoder output."""
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    src_pos = layers.data("src_pos", shape=[seq_len], dtype="int64")
+    trg_ids = layers.data("trg_ids", shape=[seq_len], dtype="int64")
+    trg_pos = layers.data("trg_pos", shape=[seq_len], dtype="int64")
+    label = layers.data("label", shape=[seq_len], dtype="int64")
+
+    enc_out = encoder(src_ids, src_pos, src_vocab, seq_len + 1, n_layer,
+                      d_model, d_inner, n_head, dropout, is_test=is_test)
+
+    # causal bias [1, 1, t, t]
+    causal = np.triu(np.full((seq_len, seq_len), -1e9, np.float32), k=1)
+    causal_var = layers.assign(causal.reshape(1, 1, seq_len, seq_len))
+    causal_var.stop_gradient = True
+
+    x = layers.embedding(trg_ids, size=[trg_vocab, d_model],
+                         param_attr=ParamAttr(name="trg_word_embedding"))
+    pos = layers.embedding(trg_pos, size=[seq_len + 1, d_model],
+                           param_attr=ParamAttr(name="trg_pos_embedding"))
+    x = x + pos
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    for i in range(n_layer):
+        attn = multi_head_attention(x, x, x, d_model, n_head, dropout,
+                                    attn_bias=causal_var, is_test=is_test,
+                                    param_prefix=f"dec_{i}.self")
+        x = layers.layer_norm(x + attn, begin_norm_axis=2)
+        cross = multi_head_attention(x, enc_out, enc_out, d_model, n_head,
+                                     dropout, is_test=is_test,
+                                     param_prefix=f"dec_{i}.cross")
+        x = layers.layer_norm(x + cross, begin_norm_axis=2)
+        ffn = positionwise_ffn(x, d_inner, d_model, dropout, is_test,
+                               param_prefix=f"dec_{i}.ffn", act="relu")
+        x = layers.layer_norm(x + ffn, begin_norm_axis=2)
+
+    logits = layers.fc(x, size=trg_vocab, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="nmt_out.w"),
+                       bias_attr=False)
+    loss = layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(label, [2]), ignore_index=0)
+    mask = layers.cast(label > 0, "float32")
+    avg_loss = layers.reduce_sum(loss * layers.unsqueeze(mask, [2])) / \
+        (layers.reduce_sum(mask) + 1e-6)
+    return (src_ids, src_pos, trg_ids, trg_pos, label), logits, avg_loss

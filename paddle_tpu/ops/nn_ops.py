@@ -343,7 +343,9 @@ def _lrn(ctx, ins, attrs):
 def _softmax(ctx, ins, attrs):
     x = X(ins, "X")
     axis = attrs.get("axis", -1)
-    return {"Out": [jax.nn.softmax(x, axis=axis)]}
+    # f32-stable internally, preserve input dtype (bf16 attention weights)
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis)
+    return {"Out": [out.astype(x.dtype)]}
 
 
 @register_op("log_softmax")

@@ -69,6 +69,10 @@ class Optimizer:
             name=unique_name.generate(f"{param.name}_{name}"),
             shape=shape, dtype=dtype or param.dtype, persistable=True,
             stop_gradient=True)
+        # accumulators shard like their parameter — resolved LAZILY at
+        # sharding-build time (compiler.var_shard) so TP annotations applied
+        # after minimize() still propagate
+        var.shard_like = param.name
         sb = default_startup_program().global_block()
         sb.create_var(name=var.name, shape=shape, dtype=var.dtype,
                       persistable=True)

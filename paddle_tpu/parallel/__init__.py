@@ -1,0 +1,2 @@
+from .mesh import (current_mesh, data_parallel_mesh, make_mesh, set_mesh,  # noqa
+                   sharding_for)

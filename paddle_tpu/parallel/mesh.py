@@ -1,0 +1,79 @@
+"""Device-mesh management — the TPU-native replacement for the reference's
+NCCL context plumbing (``platform/nccl_helper.h:75-300``,
+``platform/collective_helper.h:50``).
+
+Where the reference builds NCCL rings per place (flat + hierarchical
+inter/intra-node), here a single ``jax.sharding.Mesh`` carries every
+parallelism axis and XLA lays collectives onto ICI/DCN:
+
+- ``dp``  — data parallel (≈ AllReduceSSAGraphBuilder / c_allreduce ring)
+- ``mp``  — tensor/model parallel (capability the reference lacks; SURVEY §2.5)
+- ``sp``  — sequence/context parallel (ring attention axis)
+- ``pp``  — pipeline stages (≈ PipelineTrainer sections)
+- ``ep``  — expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "mp", "sp", "pp", "ep")
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; sizes must multiply to #devices.
+
+    Axis order follows AXES so dp is outermost (DCN-friendly) and mp/sp
+    innermost (ICI-friendly) — the hierarchical-allreduce layout the
+    reference approximates with inter/intra-node NCCL rings
+    (nccl_helper.h:246 InitHierarchicalCtxs).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = [a for a in AXES if a in axes] + \
+        [a for a in axes if a not in AXES]
+    sizes = [axes[a] for a in names]
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh {axes} needs {int(np.prod(sizes))} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return make_mesh({"dp": len(devs)}, devs)
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def sharding_for(mesh: Mesh, spec) -> NamedSharding:
+    """spec: None (replicated) or a tuple of axis-names/None per dim, with
+    axes absent from the mesh silently dropped (so a tp-annotated model runs
+    unchanged on a dp-only mesh)."""
+    if spec is None:
+        return NamedSharding(mesh, P())
+    clean = tuple(
+        (a if (a is not None and _axis_in(mesh, a)) else None)
+        for a in spec)
+    return NamedSharding(mesh, P(*clean))
+
+
+def _axis_in(mesh: Mesh, axis) -> bool:
+    if isinstance(axis, (tuple, list)):
+        return all(a in mesh.axis_names for a in axis)
+    return axis in mesh.axis_names

@@ -1,0 +1,52 @@
+"""Mixed-precision tests (SURVEY §5.9; ref contrib/mixed_precision tests)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+from paddle_tpu import optimizer as opt
+
+
+def test_amp_trains_and_keeps_master_weights_f32():
+    x = layers.data("x", shape=[16], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=32, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer = pt.amp.decorate(opt.SGDOptimizer(learning_rate=0.1))
+    optimizer.minimize(loss)
+    assert pt.default_main_program()._attrs.get("amp") is True
+
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16, 1).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        xv = rng.rand(32, 16).astype(np.float32)
+        losses.append(float(exe.run(feed={"x": xv, "y": xv @ w_true},
+                                    fetch_list=[loss])[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.5, losses
+
+    from paddle_tpu.framework.scope import global_scope
+    w = global_scope().find_var("fc_0.w_0")
+    assert str(w.dtype) == "float32"   # master weights stay f32
+
+
+def test_amp_policy_casts():
+    import jax.numpy as jnp
+    from paddle_tpu import amp
+    ins = {"X": [jnp.ones((4, 8, 8), jnp.float32)],
+           "Y": [jnp.ones((8, 8), jnp.float32)]}
+    out = amp.cast_ins("matmul", ins)
+    assert out["X"][0].dtype == jnp.bfloat16
+    assert out["Y"][0].dtype == jnp.bfloat16
+    # black: back to f32
+    ins_b = {"X": [jnp.ones((4, 8), jnp.bfloat16)]}
+    out_b = amp.cast_ins("reduce_sum", ins_b)
+    assert out_b["X"][0].dtype == jnp.float32
+    # scalar lr math untouched by the big-elementwise rule
+    ins_s = {"X": [jnp.ones((), jnp.float32)], "Y": [jnp.ones((), jnp.float32)]}
+    out_s = amp.cast_ins("elementwise_add", ins_s)
+    assert out_s["X"][0].dtype == jnp.float32

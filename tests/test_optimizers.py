@@ -12,7 +12,7 @@ from paddle_tpu.framework.scope import global_scope
 from paddle_tpu import optimizer as opt
 
 
-def _build_and_train(opt_factory, steps=12):
+def _build_and_train(opt_factory, steps=60):
     np.random.seed(0)
     x = layers.data("x", shape=[4], dtype="float32")
     y = layers.data("y", shape=[1], dtype="float32")
@@ -52,7 +52,8 @@ def _build_and_train(opt_factory, steps=12):
         "lamb", "lars"])
 def test_optimizer_decreases_loss(factory):
     losses = _build_and_train(factory)
-    assert losses[-1] < losses[0] * 0.9, losses
+    # per-batch losses are noisy: compare head vs tail windows
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.9, losses
 
 
 def test_sgd_exact_update():
