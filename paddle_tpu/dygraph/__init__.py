@@ -1,0 +1,17 @@
+"""Dygraph (define-by-run) mode — ref ``python/paddle/fluid/dygraph/`` +
+``paddle/fluid/imperative/`` (see SURVEY.md §2.8)."""
+
+from . import nn  # noqa
+from .base import enabled, guard, in_dygraph_mode, no_grad, to_variable  # noqa
+from .checkpoint import load_dygraph, save_dygraph  # noqa
+from .layers import Layer  # noqa
+from .learning_rate_scheduler import (CosineDecay, ExponentialDecay,  # noqa
+                                      InverseTimeDecay, LearningRateDecay,
+                                      NaturalExpDecay, NoamDecay,
+                                      PiecewiseDecay, PolynomialDecay)
+from .nn import (FC, NCE, BatchNorm, BilinearTensorProduct, Conv2D,  # noqa
+                 Conv2DTranspose, Conv3D, Dropout, Embedding, GroupNorm,
+                 GRUUnit, LayerNorm, Linear, Pool2D, PRelu, RowConv,
+                 SequenceConv, SpectralNorm, TreeConv)
+from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa
+from .tracer import Tracer, VarBase, default_tracer  # noqa

@@ -1,0 +1,69 @@
+"""Dygraph mode switch + conversion helpers.
+
+ref ``python/paddle/fluid/dygraph/base.py``: ``guard()``, ``enabled()``,
+``to_variable()``, ``no_grad``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .tracer import VarBase, default_tracer
+
+_in_dygraph = False
+
+
+def enabled() -> bool:
+    return _in_dygraph
+
+
+def in_dygraph_mode() -> bool:
+    return _in_dygraph
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """``with fluid.dygraph.guard():`` — enables eager execution."""
+    global _in_dygraph
+    prev = _in_dygraph
+    _in_dygraph = True
+    try:
+        yield
+    finally:
+        _in_dygraph = prev
+        default_tracer().tape.clear()
+
+
+def to_variable(value, name=None, zero_copy=None) -> VarBase:
+    """numpy → eager VarBase (ref dygraph/base.py to_variable)."""
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    return VarBase(arr, name=name, stop_gradient=False)
+
+
+class no_grad:
+    """Context manager AND decorator disabling grad taping
+    (ref dygraph/base.py no_grad)."""
+
+    def __enter__(self):
+        t = default_tracer()
+        self._prev = t.grad_enabled()
+        t.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        default_tracer().set_grad_enabled(self._prev)
+        return False
+
+    def __new__(cls, func=None):
+        if func is not None and callable(func):
+            @functools.wraps(func)
+            def wrapper(*args, **kwargs):
+                with no_grad():
+                    return func(*args, **kwargs)
+            return wrapper
+        return super().__new__(cls)

@@ -1,0 +1,54 @@
+"""Dygraph state-dict persistence (ref ``python/paddle/fluid/dygraph/checkpoint.py``
+save_dygraph/load_dygraph)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .tracer import VarBase
+
+_PARAMS_SUFFIX = ".pdparams"
+_OPT_SUFFIX = ".pdopt"
+
+
+def _to_numpy_dict(state: Dict) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in state.items():
+        out[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+    return out
+
+
+def save_dygraph(state_dict: Dict, model_path: str):
+    """Save a Layer.state_dict() (or optimizer state dict) to
+    ``model_path + '.pdparams'`` (ref checkpoint.py save_dygraph)."""
+    is_opt = any(not isinstance(v, (VarBase, np.ndarray)) and
+                 not hasattr(v, "shape") for v in state_dict.values())
+    suffix = _OPT_SUFFIX if is_opt else _PARAMS_SUFFIX
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {}
+    for k, v in state_dict.items():
+        payload[k] = (v.numpy() if isinstance(v, VarBase)
+                      else np.asarray(v) if hasattr(v, "shape") else v)
+    with open(model_path + suffix, "wb") as f:
+        pickle.dump(payload, f, protocol=2)
+
+
+def load_dygraph(model_path: str) -> Tuple[Optional[Dict], Optional[Dict]]:
+    """Load (param_state, opt_state); either may be None
+    (ref checkpoint.py load_dygraph)."""
+    params, opt = None, None
+    if os.path.exists(model_path + _PARAMS_SUFFIX):
+        with open(model_path + _PARAMS_SUFFIX, "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(model_path + _OPT_SUFFIX):
+        with open(model_path + _OPT_SUFFIX, "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise ValueError(f"no checkpoint found at {model_path}(.pdparams/.pdopt)")
+    return params, opt

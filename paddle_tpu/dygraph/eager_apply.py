@@ -1,0 +1,85 @@
+"""Eager block shim: lets static-graph Optimizer._append_optimize_op run
+unchanged in dygraph mode by executing each appended op immediately through
+its registered lowering (the reference's shared-kernel design —
+``imperative/prepared_operator.h`` prepares the same kernels the static
+executor dispatches)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import registry
+from ..framework.executor import LowerCtx
+from .tracer import VarBase
+
+_seed = itertools.count(10_000_000)
+
+
+class EagerBlock:
+    """Duck-types the subset of Block that optimizer _append_optimize_op and
+    clip/regularizer helpers use: append_op + create_var."""
+
+    def __init__(self, lr_value: float):
+        self.lr = lr_value
+        self._tmp: Dict[str, Any] = {}
+
+    def create_var(self, name=None, shape=None, dtype=None, **kw) -> VarBase:
+        v = VarBase(np.zeros(shape or [1], dtype or "float32"),
+                    name=name, trainable=False)
+        v.stop_gradient = True
+        return v
+
+    def _resolve(self, slot: str, v):
+        if isinstance(v, VarBase):
+            return v.value
+        if v is None and slot == "LearningRate":
+            return jnp.asarray([self.lr], jnp.float32)
+        if hasattr(v, "name") and not hasattr(v, "numpy"):
+            # a static Variable leaked in (the learning-rate var) — use the
+            # eager lr value
+            if slot == "LearningRate":
+                return jnp.asarray([self.lr], jnp.float32)
+            raise TypeError(
+                f"static Variable {v.name!r} passed to eager optimizer "
+                f"(slot {slot})")
+        return jnp.asarray(v)
+
+    def append_op(self, type: str, inputs: Optional[Dict] = None,
+                  outputs: Optional[Dict] = None,
+                  attrs: Optional[Dict] = None):
+        info = registry.get_op_info(type)
+        ins = {slot: [self._resolve(slot, v) for v in vs]
+               for slot, vs in (inputs or {}).items()}
+        outs = info.lower(LowerCtx(next(_seed)), ins, dict(attrs or {})) or {}
+        for slot, targets in (outputs or {}).items():
+            vals = outs.get(slot, [])
+            for tgt, val in zip(targets, vals):
+                if isinstance(tgt, VarBase):
+                    tgt.set_value(val)
+        return outs
+
+
+def eager_clip_grads(params_grads: List[Tuple[VarBase, Any]], grad_clip):
+    """Eager realization of the three reference clip attrs (ref clip.py)."""
+    if grad_clip is None or not params_grads:
+        return params_grads
+    name = type(grad_clip).__name__
+    if name == "GradientClipByValue":
+        return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
+                for p, g in params_grads]
+    if name == "GradientClipByNorm":
+        out = []
+        for p, g in params_grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            out.append((p, g * jnp.minimum(1.0, grad_clip.clip_norm /
+                                           jnp.maximum(n, 1e-12))))
+        return out
+    if name == "GradientClipByGlobalNorm":
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in params_grads))
+        scale = grad_clip.clip_norm / jnp.maximum(gn, grad_clip.clip_norm)
+        return [(p, g * scale) for p, g in params_grads]
+    return params_grads

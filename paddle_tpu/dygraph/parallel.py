@@ -1,0 +1,136 @@
+"""Dygraph data parallelism.
+
+ref ``python/paddle/fluid/dygraph/parallel.py`` (Env:33, DataParallel:84 with
+scale_loss:150 / apply_collective_grads:201) + ``imperative/nccl_context.h``.
+
+TPU-native realization: gradients are averaged across *processes* with
+``jax.experimental.multihost_utils`` when a multi-process JAX runtime is
+initialized (jax.distributed ≈ the reference's NCCLParallelContext bootstrap),
+and are exact no-ops single-process — the same semantics as the reference
+where world_size==1 short-circuits.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+from .tracer import VarBase
+
+
+class ParallelEnv:
+    """ref dygraph/parallel.py Env:33 — reads the launcher's env vars."""
+
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_tpus",
+                                     os.getenv("FLAGS_selected_gpus", "0")))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """Initialize the multi-process runtime (≈ NCCLParallelContext::Init:
+    exchange ids + create comms).  Uses jax.distributed when endpoints are
+    configured; single-process otherwise."""
+    env = ParallelEnv()
+    if env.nranks > 1 and env.trainer_endpoints:
+        coordinator = env.trainer_endpoints[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.nranks,
+                process_id=env.local_rank)
+        except (RuntimeError, ValueError):
+            pass  # already initialized
+    return env
+
+
+class DataParallel(Layer):
+    """ref dygraph/parallel.py:84 — wraps a Layer; after ``loss.backward()``
+    call ``apply_collective_grads()`` to average grads across ranks."""
+
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._env = ParallelEnv()
+
+    @property
+    def nranks(self):
+        return max(self._env.nranks, 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss: VarBase) -> VarBase:
+        """ref parallel.py:150 — pre-scale loss by 1/nranks so the summed
+        collective equals the global mean."""
+        if self.nranks <= 1:
+            return loss
+        return loss * (1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        """ref parallel.py:201 — allreduce-sum every trainable grad.  Uses a
+        single fused psum over the process group (the reference coalesced
+        grads into chunks for the same reason — one ring launch)."""
+        if self.nranks <= 1:
+            return
+        from jax.experimental import multihost_utils
+        params = [p for p in self._layers.parameters() if p.grad is not None]
+        if not params:
+            return
+        flat = [p.grad for p in params]
+        summed = multihost_utils.process_allgather(
+            jnp.concatenate([jnp.ravel(g) for g in flat]))
+        total = jnp.sum(summed, axis=0)
+        off = 0
+        for p in params:
+            n = int(np.prod(p.grad.shape))
+            p.grad = total[off:off + n].reshape(p.grad.shape)
+            off += n
+
+    # delegate state access
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict
+
+
+def scale_loss(loss, nranks=None):
+    n = nranks if nranks is not None else ParallelEnv().nranks
+    return loss * (1.0 / n) if n > 1 else loss

@@ -1,0 +1,258 @@
+"""Eager (define-by-run) execution: VarBase tensors + a vjp tape.
+
+TPU-native rebuild of the reference imperative engine
+(``paddle/fluid/imperative/tracer.h:31-46`` Tracer::TraceOp,
+``imperative/layer.h:55,168`` VarBase/OpBase, ``imperative/engine.h`` autograd
+Engine, ``imperative/gradient_accumulator.h``).
+
+Design departure: the reference tapes grad *op descs* and re-dispatches C++
+kernels on backward.  Here every traced op reuses the SAME registered JAX
+lowering the static executor compiles (one kernel source of truth, exactly as
+the reference shares kernels between static and dygraph), and the tape stores
+the ``jax.vjp`` closure captured at forward time — backward is then a pure
+reverse sweep accumulating cotangents (the Engine + GradientAccumulator role).
+"""
+
+from __future__ import annotations
+
+import itertools
+import weakref
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import registry
+from ..framework.core import convert_dtype
+from ..framework.executor import LowerCtx
+from ..framework import unique_name
+
+_FLOAT0 = jax.dtypes.float0
+
+
+def _is_inexact(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+
+class VarBase:
+    """Eager tensor (ref ``imperative/layer.h:55`` VarBase): a concrete JAX
+    array + grad slot + autograd metadata."""
+
+    def __init__(self, value, name: Optional[str] = None,
+                 stop_gradient: bool = False, persistable: bool = False,
+                 trainable: bool = True):
+        self._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self.grad: Optional[jax.Array] = None
+
+    # -- data access ---------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    def set_value(self, v):
+        if isinstance(v, VarBase):
+            v = v._value
+        self._value = v if isinstance(v, jax.Array) else jnp.asarray(v)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def __len__(self):
+        return self._value.shape[0]
+
+    def detach(self) -> "VarBase":
+        return VarBase(self._value, stop_gradient=True)
+
+    def astype(self, dtype):
+        return _trace_unary("cast", self, {"out_dtype": convert_dtype(dtype)})
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, retain_graph: bool = False):
+        """Reverse sweep of the global tape from this var
+        (ref ``imperative/engine.cc`` Engine::Execute)."""
+        default_tracer().backward(self, retain_graph=retain_graph)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    # -- operator sugar (same op set as static Variable) ---------------------
+    def _binary(self, other, op_type, reverse=False):
+        x, y = (other, self) if reverse else (self, other)
+        return _trace_binary(op_type, x, y)
+
+    def __add__(self, o): return self._binary(o, "elementwise_add")
+    def __radd__(self, o): return self._binary(o, "elementwise_add", True)
+    def __sub__(self, o): return self._binary(o, "elementwise_sub")
+    def __rsub__(self, o): return self._binary(o, "elementwise_sub", True)
+    def __mul__(self, o): return self._binary(o, "elementwise_mul")
+    def __rmul__(self, o): return self._binary(o, "elementwise_mul", True)
+    def __truediv__(self, o): return self._binary(o, "elementwise_div")
+    def __rtruediv__(self, o): return self._binary(o, "elementwise_div", True)
+    def __pow__(self, o): return self._binary(o, "elementwise_pow")
+    def __neg__(self): return _trace_unary("scale", self, {"scale": -1.0})
+    def __getitem__(self, idx):
+        return VarBase(self._value[idx],
+                       stop_gradient=self.stop_gradient)
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})\n"
+                f"{self.numpy()}")
+
+
+def _trace_binary(op_type, x, y):
+    t = default_tracer()
+    outs = t.trace_op(op_type, {"X": [x], "Y": [y]}, {"axis": -1})
+    return outs["Out"][0]
+
+
+def _trace_unary(op_type, x, attrs):
+    t = default_tracer()
+    outs = t.trace_op(op_type, {"X": [x]}, attrs)
+    return outs["Out"][0]
+
+
+class TapeNode:
+    """One recorded forward op: inputs, weak output refs, the vjp closure."""
+
+    __slots__ = ("inputs", "outputs", "vjp_fn", "out_meta")
+
+    def __init__(self, inputs: List[Optional[VarBase]],
+                 outputs: List[VarBase], vjp_fn, out_meta):
+        self.inputs = inputs
+        # weakrefs: a dead output can no longer receive/propagate grad
+        self.outputs = [weakref.ref(o) for o in outputs]
+        self.vjp_fn = vjp_fn
+        self.out_meta = out_meta  # [(shape, dtype)] for zero-cotangent synth
+
+
+_seed_counter = itertools.count(1)
+
+
+class Tracer:
+    """ref ``imperative/tracer.h:31`` — owns the tape + grad-enabled flag."""
+
+    def __init__(self):
+        self.tape: List[TapeNode] = []
+        self._grad_enabled = True
+
+    # -- mode ----------------------------------------------------------------
+    def grad_enabled(self) -> bool:
+        return self._grad_enabled
+
+    def set_grad_enabled(self, flag: bool):
+        self._grad_enabled = flag
+
+    # -- forward -------------------------------------------------------------
+    def trace_op(self, op_type: str, ins: Dict[str, List[Any]],
+                 attrs: Optional[Dict[str, Any]] = None,
+                 stop_gradient: bool = False) -> Dict[str, List[VarBase]]:
+        """Run one op eagerly through its registered lowering; tape it when
+        any input requires grad (ref ``Tracer::TraceOp`` + TraceBackward)."""
+        info = registry.get_op_info(op_type)
+        if info.raw:
+            raise TypeError(
+                f"op {op_type!r} is a control-flow (raw) op; use the python "
+                f"control flow of dygraph mode instead")
+        attrs = dict(attrs or {})
+        slots = list(ins.keys())
+        flat_vb: List[Optional[VarBase]] = []
+        flat_vals: List[Any] = []
+        for slot in slots:
+            for v in ins[slot]:
+                if isinstance(v, VarBase):
+                    flat_vb.append(v)
+                    flat_vals.append(v._value)
+                else:
+                    flat_vb.append(None)
+                    flat_vals.append(None if v is None else jnp.asarray(v))
+
+        ctx = LowerCtx(next(_seed_counter))
+        out_struct: Dict[str, int] = {}
+
+        def fwd(*flat):
+            it = iter(flat)
+            d = {slot: [next(it) for _ in ins[slot]] for slot in slots}
+            outs = info.lower(ctx, d, attrs) or {}
+            out_slots = sorted(outs)
+            out_struct.clear()
+            out_struct.update({s: len(outs[s]) for s in out_slots})
+            return [o for s in out_slots for o in outs[s]]
+
+        track = (self._grad_enabled and not stop_gradient and not info.no_grad
+                 and any(vb is not None and not vb.stop_gradient
+                         and _is_inexact(vb._value) for vb in flat_vb))
+        if track:
+            flat_outs, vjp_fn = jax.vjp(fwd, *flat_vals)
+        else:
+            flat_outs, vjp_fn = fwd(*flat_vals), None
+
+        out_vbs = [VarBase(o, stop_gradient=not track) for o in flat_outs]
+        if track:
+            meta = [(o.shape, o.dtype) for o in flat_outs]
+            self.tape.append(TapeNode(flat_vb, out_vbs, vjp_fn, meta))
+
+        result: Dict[str, List[VarBase]] = {}
+        i = 0
+        for slot in sorted(out_struct):
+            n = out_struct[slot]
+            result[slot] = out_vbs[i:i + n]
+            i += n
+        return result
+
+    # -- backward (the Engine) -----------------------------------------------
+    def backward(self, root: VarBase, retain_graph: bool = False):
+        if root.grad is None:
+            root.grad = jnp.ones_like(root._value)
+        for node in reversed(self.tape):
+            cts, any_grad = [], False
+            for ref, (shape, dtype) in zip(node.outputs, node.out_meta):
+                o = ref()
+                g = o.grad if o is not None else None
+                if g is not None and jnp.issubdtype(jnp.dtype(dtype),
+                                                    jnp.inexact):
+                    cts.append(g)
+                    any_grad = True
+                elif jnp.issubdtype(jnp.dtype(dtype), jnp.inexact):
+                    cts.append(jnp.zeros(shape, dtype))
+                else:
+                    cts.append(np.zeros(shape, _FLOAT0))
+            if not any_grad:
+                continue
+            in_grads = node.vjp_fn(cts)
+            for vb, g in zip(node.inputs, in_grads):
+                if vb is None or vb.stop_gradient or g is None:
+                    continue
+                if g.dtype == _FLOAT0:
+                    continue
+                # GradientAccumulator (imperative/gradient_accumulator.h)
+                vb.grad = g if vb.grad is None else vb.grad + g
+        if not retain_graph:
+            self.tape.clear()
+
+
+_default_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default_tracer

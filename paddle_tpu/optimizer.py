@@ -28,11 +28,12 @@ from .regularizer import append_regularization_ops
 
 class Optimizer:
     def __init__(self, learning_rate, regularization=None, name=None,
-                 grad_clip=None):
+                 grad_clip=None, parameter_list=None):
         self._learning_rate = learning_rate
         self.regularization = regularization
         self._grad_clip = grad_clip
         self._name = name
+        self._parameter_list = parameter_list  # dygraph mode (VarBases)
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._learning_rate_var: Optional[Variable] = None
         self.helper: Optional[LayerHelper] = None
@@ -63,6 +64,17 @@ class Optimizer:
         if name in self._accumulators and \
                 param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
+        from .dygraph import base as _dy_base
+        if _dy_base.in_dygraph_mode():
+            from .dygraph.tracer import VarBase as _VB
+            shp = list(shape if shape is not None else param.shape)
+            acc = _VB(np.full(shp, fill_value,
+                              np.dtype(dtype or param.dtype)),
+                      name=f"{param.name}_{name}", persistable=True,
+                      trainable=False)
+            acc.stop_gradient = True
+            self._accumulators.setdefault(name, {})[param.name] = acc
+            return acc
         block = default_main_program().global_block()
         shape = list(shape if shape is not None else param.shape)
         var = block.create_var(
@@ -119,10 +131,61 @@ class Optimizer:
                  no_grad_set=None, grad_clip=None):
         if grad_clip is not None:
             self._grad_clip = grad_clip
+        from .dygraph import base as _dy_base
+        if _dy_base.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path ------------------------------------------------
+    # The reference shares Optimizer between static and dygraph (the tracer
+    # executes the same optimize ops, imperative/tracer.cc).  We do the same:
+    # _append_optimize_op runs against an eager block shim that executes the
+    # op's registered lowering on the VarBase values immediately.
+
+    def _dygraph_lr_value(self) -> float:
+        lr = self._learning_rate
+        if callable(lr) and not isinstance(lr, (int, float)):
+            lr = lr()  # dygraph LearningRateDecay
+        if hasattr(lr, "numpy"):
+            lr = float(np.asarray(lr.numpy()).reshape(-1)[0])
+        return float(lr)
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        from .dygraph.eager_apply import EagerBlock, eager_clip_grads
+        params = parameter_list if parameter_list is not None \
+            else self._parameter_list
+        if params is None:
+            raise ValueError(
+                "dygraph minimize needs parameter_list (pass it to minimize "
+                "or the optimizer constructor)")
+        if loss is not None and getattr(loss, "grad", None) is None and \
+                all(p.grad is None for p in params):
+            loss.backward()
+        params_grads = [(p, p.grad) for p in params
+                        if p.grad is not None and p.trainable]
+        params_grads = eager_clip_grads(params_grads, self._grad_clip)
+        # regularization as grad += coeff * param (ref regularizer.py)
+        if self.regularization is not None:
+            coeff = getattr(self.regularization, "_coeff", 0.0)
+            is_l2 = type(self.regularization).__name__.startswith("L2")
+            new_pg = []
+            for p, g in params_grads:
+                if getattr(p, "regularizer", None) is None and coeff:
+                    g = g + (coeff * p.value if is_l2
+                             else coeff * np.sign(np.asarray(p.value)))
+                new_pg.append((p, g))
+            params_grads = new_pg
+        block = EagerBlock(self._dygraph_lr_value())
+        self._eager_block = block
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        for pg in params_grads:
+            self._append_optimize_op(block, pg)
+        self._finish_update(block, params_grads)
+        self._eager_block = None
+        return [], params_grads
 
 
 class SGDOptimizer(Optimizer):
