@@ -20,6 +20,10 @@ from .framework import (Program, Variable, append_backward,  # noqa
 from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
 from . import dygraph  # noqa
+from . import io  # noqa
+from .io import (load_inference_model, load_params, load_persistables,  # noqa
+                 load_vars, save_inference_model, save_params,
+                 save_persistables, save_vars)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
 
 

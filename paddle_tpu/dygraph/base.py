@@ -34,7 +34,8 @@ def guard(place=None):
         yield
     finally:
         _in_dygraph = prev
-        default_tracer().tape.clear()
+        if not prev:  # only the outermost guard owns/clears the tape
+            default_tracer().tape.clear()
 
 
 def to_variable(value, name=None, zero_copy=None) -> VarBase:

@@ -67,19 +67,20 @@ def eager_clip_grads(params_grads: List[Tuple[VarBase, Any]], grad_clip):
     """Eager realization of the three reference clip attrs (ref clip.py)."""
     if grad_clip is None or not params_grads:
         return params_grads
-    name = type(grad_clip).__name__
-    if name == "GradientClipByValue":
-        return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
-                for p, g in params_grads]
-    if name == "GradientClipByNorm":
+    from ..clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                        GradientClipByValue)
+    if isinstance(grad_clip, GradientClipByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in params_grads))
+        scale = grad_clip.clip_norm / jnp.maximum(gn, grad_clip.clip_norm)
+        return [(p, g * scale) for p, g in params_grads]
+    if isinstance(grad_clip, GradientClipByNorm):
         out = []
         for p, g in params_grads:
             n = jnp.sqrt(jnp.sum(jnp.square(g)))
             out.append((p, g * jnp.minimum(1.0, grad_clip.clip_norm /
                                            jnp.maximum(n, 1e-12))))
         return out
-    if name == "GradientClipByGlobalNorm":
-        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in params_grads))
-        scale = grad_clip.clip_norm / jnp.maximum(gn, grad_clip.clip_norm)
-        return [(p, g * scale) for p, g in params_grads]
+    if isinstance(grad_clip, GradientClipByValue):
+        return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
+                for p, g in params_grads]
     return params_grads

@@ -111,8 +111,8 @@ class VarBase:
     def __pow__(self, o): return self._binary(o, "elementwise_pow")
     def __neg__(self): return _trace_unary("scale", self, {"scale": -1.0})
     def __getitem__(self, idx):
-        return VarBase(self._value[idx],
-                       stop_gradient=self.stop_gradient)
+        return default_tracer().trace_fn(
+            lambda v: v[idx], [self])[0]
 
     def __repr__(self):
         return (f"VarBase(name={self.name}, shape={self.shape}, "
@@ -219,6 +219,30 @@ class Tracer:
             result[slot] = out_vbs[i:i + n]
             i += n
         return result
+
+    def trace_fn(self, fn, inputs: List[Any]) -> List[VarBase]:
+        """Tape an arbitrary jax-traceable fn(*arrays)->array|list — used for
+        python-level tensor sugar (indexing etc.) that has no op type."""
+        flat_vb = [v if isinstance(v, VarBase) else None for v in inputs]
+        flat_vals = [v._value if isinstance(v, VarBase) else jnp.asarray(v)
+                     for v in inputs]
+
+        def wrapped(*args):
+            out = fn(*args)
+            return list(out) if isinstance(out, (list, tuple)) else [out]
+
+        track = (self._grad_enabled
+                 and any(vb is not None and not vb.stop_gradient
+                         and _is_inexact(vb._value) for vb in flat_vb))
+        if track:
+            flat_outs, vjp_fn = jax.vjp(wrapped, *flat_vals)
+        else:
+            flat_outs, vjp_fn = wrapped(*flat_vals), None
+        out_vbs = [VarBase(o, stop_gradient=not track) for o in flat_outs]
+        if track:
+            meta = [(o.shape, o.dtype) for o in flat_outs]
+            self.tape.append(TapeNode(flat_vb, out_vbs, vjp_fn, meta))
+        return out_vbs
 
     # -- backward (the Engine) -----------------------------------------------
     def backward(self, root: VarBase, retain_graph: bool = False):

@@ -40,19 +40,24 @@ class LowerCtx:
     is_abstract = False
 
     def __init__(self, seed, mesh=None, is_startup=False, amp=False):
-        if isinstance(seed, jax.Array) and jax.dtypes.issubdtype(
-                seed.dtype, jax.dtypes.prng_key):
-            self._key = seed
-        else:
-            # rbg: much cheaper per-block random bits on TPU than threefry —
-            # dropout RNG was ~40% of a BERT step with the default impl
-            self._key = jax.random.key(seed, impl="rbg")
+        self._seed = seed
+        self._key = None  # derived lazily: most ops never need RNG
         self._counter = 0
         self.mesh = mesh
         self.is_startup = is_startup
         self.amp = amp
 
     def rng(self):
+        if self._key is None:
+            seed = self._seed
+            if isinstance(seed, jax.Array) and jax.dtypes.issubdtype(
+                    seed.dtype, jax.dtypes.prng_key):
+                self._key = seed
+            else:
+                # rbg: much cheaper per-block random bits on TPU than
+                # threefry — dropout RNG was ~40% of a BERT step with the
+                # default impl
+                self._key = jax.random.key(seed, impl="rbg")
         self._counter += 1
         return jax.random.fold_in(self._key, self._counter)
 

@@ -1,0 +1,14 @@
+"""Inference engine (ref ``paddle/fluid/inference/`` ~30k LoC, SURVEY §2.9).
+
+The reference stack is: AnalysisConfig → Analyzer IR passes (fusions,
+TensorRT/nGraph subgraph capture) → NaiveExecutor sequential op dispatch.
+On TPU the "analysis" is XLA itself: the whole pruned inference program
+lowers to ONE jitted computation (the nGraph-subgraph engine generalized to
+the full graph), so the predictor is a thin shape-specializing cache around
+``program_as_function`` + ``jax.jit``, with optional AOT StableHLO export
+standing in for the reference's saved TensorRT engines.
+"""
+
+from .api import (AnalysisConfig, AnalysisPredictor, PaddlePredictor,  # noqa
+                  PaddleTensor, ZeroCopyTensor, create_paddle_predictor,
+                  export_stablehlo)

@@ -1,0 +1,183 @@
+"""Predictor API (ref ``inference/api/analysis_predictor.h:46``
+AnalysisPredictor, ``inference/api/api_impl.h`` NativePaddlePredictor,
+``inference/api/analysis_config.cc`` AnalysisConfig)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Program, Variable
+from ..framework.function import program_as_function
+from ..framework.scope import Scope
+from .. import io as _io
+
+
+class AnalysisConfig:
+    """ref AnalysisConfig: model location + execution switches.  GPU/MKLDNN
+    switches are accepted for API parity; TPU/XLA is the only backend."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_tpu = True
+        self._memory_optim = True      # XLA buffer assignment — always on
+        self._ir_optim = True          # XLA fusion — always on
+        self._device_id = 0
+
+    # parity switches (ref analysis_config.cc)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def use_gpu(self):
+        return False
+
+    def model_dir_path(self):
+        return self.model_dir
+
+
+class PaddleTensor:
+    """ref paddle_api.h PaddleTensor — name + ndarray payload."""
+
+    def __init__(self, data=None, name: str = ""):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    def as_ndarray(self):
+        return self.data
+
+
+class ZeroCopyTensor:
+    """ref ZeroCopyTensor — a named slot bound to predictor input/output."""
+
+    def __init__(self, name: str, predictor: "AnalysisPredictor",
+                 is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._pred._inputs[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the array itself
+
+    def copy_to_cpu(self):
+        return np.asarray(self._pred._outputs[self.name])
+
+
+class AnalysisPredictor:
+    """ref analysis_predictor.cc AnalysisPredictor::Init/Run/ZeroCopyRun.
+
+    Compiles the loaded inference program into a single XLA executable,
+    re-specialized per input-shape signature (shape-keyed jit cache — the
+    structure the reference prototyped in
+    ``operators/ngraph/ngraph_engine.cc:482`` GetNgFunction)."""
+
+    def __init__(self, config: AnalysisConfig):
+        self.config = config
+        self.scope = Scope()
+        self.program, self.feed_names, self.fetch_names = \
+            _io.load_inference_model(
+                config.model_dir, model_filename=config.prog_file,
+                params_filename=config.params_file, scope=self.scope)
+        self._params = {name: jnp.asarray(np.asarray(val))
+                        for name, val in self.scope.items() if val is not None}
+        self._fn = program_as_function(self.program, self.feed_names,
+                                       self.fetch_names)
+        self._jitted = jax.jit(self._fn)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, Any] = {}
+
+    # -- classic Run API (ref api_impl.cc NativePaddlePredictor::Run) --------
+    def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
+        by_name = {t.name: t.data for t in inputs if t.name}
+        ordered = []
+        for i, name in enumerate(self.feed_names):
+            if name in by_name:
+                ordered.append(by_name[name])
+            elif i < len(inputs):
+                ordered.append(inputs[i].data)
+            else:
+                raise ValueError(f"missing input for feed {name!r}")
+        outs = self._jitted(self._params, *[jnp.asarray(a) for a in ordered])
+        return [PaddleTensor(np.asarray(o), name=n)
+                for n, o in zip(self.fetch_names, outs)]
+
+    # -- zero-copy API -------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self.fetch_names)
+
+    def get_input_tensor(self, name: str) -> ZeroCopyTensor:
+        return ZeroCopyTensor(name, self, True)
+
+    def get_output_tensor(self, name: str) -> ZeroCopyTensor:
+        return ZeroCopyTensor(name, self, False)
+
+    def zero_copy_run(self):
+        ordered = [jnp.asarray(self._inputs[n]) for n in self.feed_names]
+        outs = self._jitted(self._params, *ordered)
+        self._outputs = dict(zip(self.fetch_names, outs))
+
+    # -- AOT export ----------------------------------------------------------
+    def export_stablehlo(self, example_inputs: Sequence[np.ndarray],
+                         path: Optional[str] = None) -> str:
+        """Serialize the inference computation as StableHLO text — the
+        deployment artifact (≈ the reference's saved TensorRT engine /
+        frozen inference program)."""
+        lowered = jax.jit(self._fn).lower(
+            self._params, *[jnp.asarray(a) for a in example_inputs])
+        text = lowered.as_text()
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+
+# ref api naming
+PaddlePredictor = AnalysisPredictor
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """ref CreatePaddlePredictor<AnalysisConfig>."""
+    return AnalysisPredictor(config)
+
+
+def export_stablehlo(program: Program, feed_names, fetch_names, params,
+                     example_inputs, path=None) -> str:
+    """Standalone Program → StableHLO export."""
+    fn = program_as_function(program, feed_names, fetch_names)
+    lowered = jax.jit(fn).lower(params,
+                                *[jnp.asarray(a) for a in example_inputs])
+    text = lowered.as_text()
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -1,0 +1,185 @@
+"""Model persistence: save/load params, persistables, and inference models.
+
+ref ``python/paddle/fluid/io.py``: save_params:254, save_persistables:487,
+load_persistables:726, save_inference_model:933, load_inference_model:1113 —
+backed by the reference's ``save``/``load``/``save_combine``/``load_combine``
+ops (``operators/save_op.cc:25``, ``load_op.cc:22``) serializing LoDTensors.
+
+TPU-native format: one directory per model; tensors stored as ``.npy``
+(separate files, one per var — the reference's default) or a single
+``npz`` when ``filename`` is given (≈ save_combine); the program is the
+JSON ProgramDesc (``Program.serialize_to_string``) in ``__model__``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .framework.core import Program, Variable, default_main_program
+from .framework.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "get_program_persistable_vars",
+]
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable) and var.type not in ("raw", "step_scopes")
+
+
+def _is_parameter(var: Variable) -> bool:
+    return bool(var.is_parameter)
+
+
+def get_program_persistable_vars(program: Program) -> List[Variable]:
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def _scope_value(scope: Scope, name: str) -> np.ndarray:
+    v = scope.find_var(name)
+    if v is None:
+        raise ValueError(f"variable {name!r} has no value in scope — run the "
+                         f"startup program before saving")
+    return np.asarray(v)
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """ref io.py save_vars — writes each var (or a combined file)."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or _is_persistable)(v)]
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {v.name: _scope_value(scope, v.name) for v in vars}
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__")), arr)
+    meta = {name: {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+            for name, arr in arrays.items()}
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump({"filename": filename, "vars": meta}, f)
+
+
+def save_params(executor=None, dirname=None, main_program=None, filename=None,
+                scope=None):
+    """ref io.py:254 — trainable parameters only."""
+    save_vars(executor, dirname, main_program, None, _is_parameter,
+              filename, scope)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, scope=None):
+    """ref io.py:487 — params + optimizer accumulators + BN stats etc."""
+    save_vars(executor, dirname, main_program, None, _is_persistable,
+              filename, scope)
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """ref io.py load_vars."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or _is_persistable)(v)]
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not os.path.exists(path):
+            path = path + ".npz"
+        data = np.load(path)
+        missing = [v.name for v in vars if v.name not in data]
+        if missing:
+            raise ValueError(
+                f"combined checkpoint {path} is missing vars: {missing}")
+        for v in vars:
+            scope.set_var(v.name, data[v.name])
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if os.path.exists(path):
+                scope.set_var(v.name, np.load(path))
+            else:
+                raise ValueError(f"missing saved var file {path}")
+
+
+def load_params(executor=None, dirname=None, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program, None, _is_parameter,
+              filename, scope)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, scope=None):
+    """ref io.py:726."""
+    load_vars(executor, dirname, main_program, None, _is_persistable,
+              filename, scope)
+
+
+def save_inference_model(dirname, feeded_var_names: Sequence[str],
+                         target_vars: Sequence, executor=None,
+                         main_program: Optional[Program] = None,
+                         model_filename=None, params_filename=None,
+                         export_for_deployment=True, scope=None):
+    """ref io.py:933 — prune to fetch targets, switch to test mode, save
+    program + params.  Returns the feed names actually needed."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    target_names = [t.name if isinstance(t, Variable) else t
+                    for t in target_vars]
+    infer = program.clone(for_test=True)._prune(target_names)
+    os.makedirs(dirname, exist_ok=True)
+
+    # only persistables the pruned program still references
+    used = set()
+    for op in infer.global_block().ops:
+        used.update(op.input_arg_names())
+        used.update(op.output_arg_names())
+    pvars = [v for v in infer.list_vars() if _is_persistable(v)
+             and v.name in used]
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        payload = json.loads(infer.serialize_to_string())
+        payload["feed_names"] = list(feeded_var_names)
+        payload["fetch_names"] = list(target_names)
+        f.write(json.dumps(payload).encode("utf-8"))
+
+    save_vars(executor, dirname, infer, pvars, None,
+              params_filename, scope)
+    return list(feeded_var_names)
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None, scope=None):
+    """ref io.py:1113 → (program, feed_names, fetch_vars-as-names)."""
+    scope = scope or global_scope()
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        payload = json.loads(f.read().decode("utf-8"))
+    program = Program.parse_from_string(json.dumps(payload).encode("utf-8"))
+    feed_names = payload.get("feed_names", [])
+    fetch_names = payload.get("fetch_names", [])
+    # load exactly the vars that were saved (__meta__.json) — the pruned
+    # program's var table still lists training-only persistables (lr,
+    # optimizer accumulators) that save_inference_model intentionally omits
+    meta_path = os.path.join(dirname, "__meta__.json")
+    saved = None
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            saved = set(json.load(f)["vars"])
+    vars = [v for v in program.list_vars() if _is_persistable(v)
+            and (saved is None or v.name in saved)]
+    load_vars(executor, dirname, program, vars, None,
+              params_filename, scope)
+    return program, feed_names, fetch_names

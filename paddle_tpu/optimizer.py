@@ -167,17 +167,22 @@ class Optimizer:
         params_grads = [(p, p.grad) for p in params
                         if p.grad is not None and p.trainable]
         params_grads = eager_clip_grads(params_grads, self._grad_clip)
-        # regularization as grad += coeff * param (ref regularizer.py)
-        if self.regularization is not None:
-            coeff = getattr(self.regularization, "_coeff", 0.0)
-            is_l2 = type(self.regularization).__name__.startswith("L2")
-            new_pg = []
-            for p, g in params_grads:
-                if getattr(p, "regularizer", None) is None and coeff:
-                    g = g + (coeff * p.value if is_l2
-                             else coeff * np.sign(np.asarray(p.value)))
-                new_pg.append((p, g))
-            params_grads = new_pg
+        # regularization as grad += coeff * d(penalty)/d(param); per-param
+        # regularizer takes precedence over the global one, matching
+        # append_regularization_ops (regularizer.py:62)
+        from .regularizer import L2DecayRegularizer
+        new_pg = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None:
+                coeff = getattr(reg, "_coeff", 0.0)
+                if coeff:
+                    if isinstance(reg, L2DecayRegularizer):
+                        g = g + coeff * p.value
+                    else:
+                        g = g + coeff * np.sign(np.asarray(p.value))
+            new_pg.append((p, g))
+        params_grads = new_pg
         block = EagerBlock(self._dygraph_lr_value())
         self._eager_block = block
         self._create_accumulators(block, [p for p, _ in params_grads])

@@ -1,0 +1,143 @@
+"""Persistence + inference engine tests (ref io.py save/load +
+analysis_predictor_tester.cc patterns)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import core
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _build_mlp():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=8, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def _fresh():
+    main, startup = core.Program(), core.Program()
+    core.switch_main_program(main)
+    core.switch_startup_program(startup)
+    return main, startup
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup = _fresh()
+    x, y, pred, loss = _build_mlp()
+    opt = pt.optimizer.AdamOptimizer(0.01)
+    opt.minimize(loss)
+
+    scope = Scope()
+    exe = pt.Executor()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype("float32")
+    ys = rng.randn(8, 1).astype("float32")
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        pt.save_persistables(exe, str(tmp_path / "ckpt"), main, scope=scope)
+
+    # fresh scope: load and continue — params AND adam moments restored
+    scope2 = Scope()
+    with scope_guard(scope2):
+        pt.load_persistables(exe, str(tmp_path / "ckpt"), main, scope=scope2)
+        l2 = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                     scope=scope2)
+    with scope_guard(scope):
+        l1 = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                     scope=scope)
+    np.testing.assert_allclose(l1[0], l2[0], rtol=1e-5)
+
+
+def test_save_params_combined_file(tmp_path):
+    main, startup = _fresh()
+    _build_mlp()
+    scope = Scope()
+    exe = pt.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        pt.save_params(exe, str(tmp_path / "p"), main, filename="all_params",
+                       scope=scope)
+        scope2 = Scope()
+        pt.load_params(exe, str(tmp_path / "p"), main, filename="all_params",
+                       scope=scope2)
+        for v in main.all_parameters():
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(v.name)),
+                np.asarray(scope2.find_var(v.name)))
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = _fresh()
+    x, y, pred, loss = _build_mlp()
+    scope = Scope()
+    exe = pt.Executor()
+    xs = np.random.RandomState(1).randn(3, 4).astype("float32")
+    ys = np.zeros((3, 1), "float32")
+    with scope_guard(scope):
+        exe.run(startup)
+        ref_out = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+        pt.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                                main_program=main, scope=scope)
+
+    scope2 = Scope()
+    with scope_guard(scope2):
+        prog, feeds, fetches = pt.load_inference_model(str(tmp_path / "m"),
+                                                       exe, scope=scope2)
+        assert feeds == ["x"]
+        out = exe.run(prog, feed={"x": xs}, fetch_list=fetches, scope=scope2)
+    np.testing.assert_allclose(ref_out[0], out[0], rtol=1e-5)
+    # pruning dropped the label/loss/optimizer ops
+    types = [op.type for op in prog.global_block().ops]
+    assert "square_error_cost" not in types
+    assert not any(t.endswith("_grad") for t in types)
+
+
+def test_analysis_predictor(tmp_path):
+    from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                      create_paddle_predictor)
+    main, startup = _fresh()
+    x, y, pred, loss = _build_mlp()
+    scope = Scope()
+    exe = pt.Executor()
+    xs = np.random.RandomState(2).randn(5, 4).astype("float32")
+    ys = np.zeros((5, 1), "float32")
+    with scope_guard(scope):
+        exe.run(startup)
+        ref_out = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+        pt.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                                main_program=main, scope=scope)
+
+    config = AnalysisConfig(str(tmp_path / "m"))
+    predictor = create_paddle_predictor(config)
+    outs = predictor.run([PaddleTensor(xs, name="x")])
+    np.testing.assert_allclose(ref_out[0], outs[0].as_ndarray(), rtol=1e-5)
+
+    # zero-copy API
+    it = predictor.get_input_tensor("x")
+    it.copy_from_cpu(xs)
+    predictor.zero_copy_run()
+    ot = predictor.get_output_tensor(predictor.get_output_names()[0])
+    np.testing.assert_allclose(ref_out[0], ot.copy_to_cpu(), rtol=1e-5)
+
+
+def test_stablehlo_export(tmp_path):
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    main, startup = _fresh()
+    x, y, pred, loss = _build_mlp()
+    scope = Scope()
+    exe = pt.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        pt.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                                main_program=main, scope=scope)
+    predictor = create_paddle_predictor(AnalysisConfig(str(tmp_path / "m")))
+    xs = np.zeros((2, 4), "float32")
+    text = predictor.export_stablehlo([xs], str(tmp_path / "model.stablehlo"))
+    assert "module" in text and ("stablehlo" in text or "mhlo" in text)
+    assert (tmp_path / "model.stablehlo").exists()
