@@ -1,0 +1,210 @@
+// Host memory: stats-tracked aligned allocation + a best-fit pooled
+// allocator for staging buffers.
+//
+// Reference equivalents: memory/allocation/allocator_facade.h (strategy-
+// selected allocators), memory/allocation/best_fit_allocator.cc,
+// memory/detail/buddy_allocator.h, and the stats the GPU-memory gflags
+// exposed.  On TPU, device HBM is managed by the XLA runtime — what remains
+// native is HOST staging memory for the input pipeline (the role of
+// CUDAPinnedPlace), plus allocation accounting for observability.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <new>
+#include <set>
+#include <utility>
+
+#include "common.h"
+
+namespace ptn {
+namespace {
+
+struct Stats {
+  std::atomic<int64_t> in_use{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> total_allocs{0};
+  std::atomic<int64_t> total_frees{0};
+};
+
+Stats g_stats;
+std::mutex g_size_mu;
+std::map<void*, int64_t> g_sizes;
+
+void RecordAlloc(void* p, int64_t size) {
+  {
+    std::lock_guard<std::mutex> lk(g_size_mu);
+    g_sizes[p] = size;
+  }
+  int64_t cur = g_stats.in_use.fetch_add(size) + size;
+  g_stats.total_allocs.fetch_add(1);
+  int64_t peak = g_stats.peak.load();
+  while (cur > peak && !g_stats.peak.compare_exchange_weak(peak, cur)) {
+  }
+}
+
+int64_t RecordFree(void* p) {
+  int64_t size = 0;
+  {
+    std::lock_guard<std::mutex> lk(g_size_mu);
+    auto it = g_sizes.find(p);
+    if (it == g_sizes.end()) return 0;
+    size = it->second;
+    g_sizes.erase(it);
+  }
+  g_stats.in_use.fetch_sub(size);
+  g_stats.total_frees.fetch_add(1);
+  return size;
+}
+
+// ---------------------------------------------------------------------------
+// Best-fit pool over one contiguous chunk (ref best_fit_allocator.cc:
+// free-block map keyed by size; split on alloc, coalesce on free).
+// ---------------------------------------------------------------------------
+
+class BestFitPool {
+ public:
+  explicit BestFitPool(int64_t bytes) : size_(bytes) {
+    base_ = static_cast<char*>(std::malloc(bytes));
+    if (base_ == nullptr) throw std::bad_alloc();
+    free_by_offset_[0] = bytes;
+    free_by_size_.insert({bytes, 0});
+  }
+
+  ~BestFitPool() { std::free(base_); }
+
+  void* Alloc(int64_t want) {
+    constexpr int64_t kAlign = 64;
+    want = (want + kAlign - 1) / kAlign * kAlign;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = free_by_size_.lower_bound({want, 0});
+    if (it == free_by_size_.end()) return nullptr;  // caller falls back
+    int64_t blk_size = it->first, off = it->second;
+    free_by_size_.erase(it);
+    free_by_offset_.erase(off);
+    if (blk_size > want) {  // split
+      free_by_offset_[off + want] = blk_size - want;
+      free_by_size_.insert({blk_size - want, off + want});
+    }
+    allocated_[off] = want;
+    in_use_ += want;
+    peak_ = std::max(peak_, in_use_);
+    return base_ + off;
+  }
+
+  bool Free(void* p) {
+    auto* c = static_cast<char*>(p);
+    if (c < base_ || c >= base_ + size_) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t off = c - base_;
+    auto it = allocated_.find(off);
+    if (it == allocated_.end()) return false;
+    int64_t len = it->second;
+    allocated_.erase(it);
+    in_use_ -= len;
+    // coalesce with next
+    auto next = free_by_offset_.find(off + len);
+    if (next != free_by_offset_.end()) {
+      len += next->second;
+      free_by_size_.erase({next->second, next->first});
+      free_by_offset_.erase(next);
+    }
+    // coalesce with prev
+    auto prev = free_by_offset_.lower_bound(off);
+    if (prev != free_by_offset_.begin()) {
+      --prev;
+      if (prev->first + prev->second == off) {
+        off = prev->first;
+        len += prev->second;
+        free_by_size_.erase({prev->second, prev->first});
+        free_by_offset_.erase(prev);
+      }
+    }
+    free_by_offset_[off] = len;
+    free_by_size_.insert({len, off});
+    return true;
+  }
+
+  int64_t InUse() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return in_use_;
+  }
+
+  int64_t Peak() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return peak_;
+  }
+
+ private:
+  char* base_;
+  int64_t size_;
+  int64_t in_use_ = 0;
+  int64_t peak_ = 0;
+  std::mutex mu_;
+  std::map<int64_t, int64_t> free_by_offset_;          // offset -> size
+  std::set<std::pair<int64_t, int64_t>> free_by_size_;  // (size, offset)
+  std::map<int64_t, int64_t> allocated_;                // offset -> size
+};
+
+}  // namespace
+}  // namespace ptn
+
+using namespace ptn;
+
+PTN_EXPORT void* ptn_alloc(int64_t size) {
+  void* p = nullptr;
+  if (posix_memalign(&p, 64, size > 0 ? size : 1) != 0) return nullptr;
+  RecordAlloc(p, size);
+  return p;
+}
+
+PTN_EXPORT void ptn_free(void* p) {
+  if (p == nullptr) return;
+  RecordFree(p);
+  std::free(p);
+}
+
+PTN_EXPORT void ptn_memory_stats(int64_t* in_use, int64_t* peak,
+                                 int64_t* allocs, int64_t* frees) {
+  *in_use = g_stats.in_use.load();
+  *peak = g_stats.peak.load();
+  *allocs = g_stats.total_allocs.load();
+  *frees = g_stats.total_frees.load();
+}
+
+PTN_EXPORT void ptn_memory_stats_reset() {
+  g_stats.peak.store(g_stats.in_use.load());
+  g_stats.total_allocs.store(0);
+  g_stats.total_frees.store(0);
+}
+
+PTN_EXPORT void* ptn_pool_create(int64_t bytes) {
+  try {
+    return new BestFitPool(bytes);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+PTN_EXPORT void ptn_pool_destroy(void* pool) {
+  delete static_cast<BestFitPool*>(pool);
+}
+
+PTN_EXPORT void* ptn_pool_alloc(void* pool, int64_t size) {
+  return static_cast<BestFitPool*>(pool)->Alloc(size);
+}
+
+PTN_EXPORT int ptn_pool_free(void* pool, void* p) {
+  return static_cast<BestFitPool*>(pool)->Free(p) ? 0 : -1;
+}
+
+PTN_EXPORT int64_t ptn_pool_in_use(void* pool) {
+  return static_cast<BestFitPool*>(pool)->InUse();
+}
+
+PTN_EXPORT int64_t ptn_pool_peak(void* pool) {
+  return static_cast<BestFitPool*>(pool)->Peak();
+}

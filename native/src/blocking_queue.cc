@@ -1,0 +1,170 @@
+// Bounded blocking queue of opaque byte buffers.
+//
+// Reference equivalents: framework/blocking_queue.h (BlockingQueue<T>),
+// operators/reader/lod_tensor_blocking_queue (the PyReader feed channel),
+// framework/channel.h.  The Python DataLoader's background thread pushes
+// serialized batches here; the training loop pops — decoupling host data
+// prep from device step dispatch (the role buffered_reader.cc played).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+#include "common.h"
+
+namespace ptn {
+namespace {
+
+struct Buffer {
+  void* data;
+  int64_t size;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(int64_t capacity) : cap_(capacity) {}
+
+  ~BlockingQueue() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& b : q_) std::free(b.data);
+    q_.clear();
+  }
+
+  // RAII in-flight-operation guard so Destroy can drain before delete
+  struct OpGuard {
+    explicit OpGuard(BlockingQueue* q) : q_(q) { q_->in_flight_.fetch_add(1); }
+    ~OpGuard() { q_->in_flight_.fetch_sub(1); }
+    BlockingQueue* q_;
+  };
+
+  // returns 0 ok, -1 closed, -2 timeout
+  int Push(const void* data, int64_t size, int64_t timeout_ms) {
+    OpGuard g(this);
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || (int64_t)q_.size() < cap_; };
+    if (timeout_ms < 0) {
+      not_full_.wait(lk, pred);
+    } else if (!not_full_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+      return -2;
+    }
+    if (closed_) return -1;
+    Buffer b;
+    b.size = size;
+    b.data = std::malloc(size > 0 ? size : 1);
+    std::memcpy(b.data, data, size);
+    q_.push_back(b);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  // returns 0 ok, -1 closed-and-empty, -2 timeout; caller frees via
+  // ptn_buffer_free
+  int Pop(void** out, int64_t* out_size, int64_t timeout_ms) {
+    OpGuard g(this);
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || !q_.empty(); };
+    if (timeout_ms < 0) {
+      not_empty_.wait(lk, pred);
+    } else if (!not_empty_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+      return -2;
+    }
+    if (q_.empty()) return -1;  // closed and drained
+    Buffer b = q_.front();
+    q_.pop_front();
+    *out = b.data;
+    *out_size = b.size;
+    not_full_.notify_one();
+    return 0;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+  }
+
+  int64_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)q_.size();
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+  // Close + wait for every blocked Push/Pop to unwind, then it is safe to
+  // delete (a producer thread may still sit inside Push when the Python
+  // owner drops the queue).
+  void DrainForDestroy() {
+    Close();
+    while (in_flight_.load() != 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+ private:
+  int64_t cap_;
+  bool closed_ = false;
+  std::deque<Buffer> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::atomic<int> in_flight_{0};
+};
+
+}  // namespace
+}  // namespace ptn
+
+using ptn::BlockingQueue;
+
+PTN_EXPORT void* ptn_queue_create(int64_t capacity) {
+  return new BlockingQueue(capacity);
+}
+
+PTN_EXPORT void ptn_queue_destroy(void* q) {
+  auto* bq = static_cast<BlockingQueue*>(q);
+  bq->DrainForDestroy();
+  delete bq;
+}
+
+PTN_EXPORT int ptn_queue_push(void* q, const void* data, int64_t size,
+                              int64_t timeout_ms) {
+  return static_cast<BlockingQueue*>(q)->Push(data, size, timeout_ms);
+}
+
+PTN_EXPORT int ptn_queue_pop(void* q, void** out, int64_t* out_size,
+                             int64_t timeout_ms) {
+  return static_cast<BlockingQueue*>(q)->Pop(out, out_size, timeout_ms);
+}
+
+PTN_EXPORT void ptn_queue_close(void* q) {
+  static_cast<BlockingQueue*>(q)->Close();
+}
+
+PTN_EXPORT void ptn_queue_reopen(void* q) {
+  static_cast<BlockingQueue*>(q)->Reopen();
+}
+
+PTN_EXPORT int64_t ptn_queue_size(void* q) {
+  return static_cast<BlockingQueue*>(q)->Size();
+}
+
+PTN_EXPORT int ptn_queue_closed(void* q) {
+  return static_cast<BlockingQueue*>(q)->Closed() ? 1 : 0;
+}
+
+PTN_EXPORT void ptn_buffer_free(void* data) { std::free(data); }

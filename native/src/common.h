@@ -1,0 +1,35 @@
+// Shared helpers for the paddle_tpu native runtime library.
+//
+// TPU-native rebuild of the reference's C++ runtime substrate (SURVEY.md
+// §2.3/§2.4/§2.7): the compute path is XLA, but the host-side runtime —
+// data ingestion, queues, allocator accounting, profiling — stays native,
+// exported through a plain C ABI consumed via ctypes (the reference used
+// pybind11; ctypes keeps the boundary dependency-free).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#if defined(_WIN32)
+#define PTN_EXPORT extern "C" __declspec(dllexport)
+#else
+#define PTN_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace ptn {
+
+// Copy a std::string into a caller buffer; returns needed size (excluding
+// NUL) so callers can size-probe with buf == nullptr.
+inline int64_t CopyOut(const std::string& s, char* buf, int64_t cap) {
+  if (buf != nullptr && cap > 0) {
+    int64_t n = static_cast<int64_t>(s.size()) < cap - 1
+                    ? static_cast<int64_t>(s.size())
+                    : cap - 1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(s.size());
+}
+
+}  // namespace ptn

@@ -1,0 +1,345 @@
+// MultiSlot data feed: multi-threaded text-file → slot-tensor ingestion.
+//
+// Reference equivalents: framework/data_feed.h:532 (MultiSlotDataFeed),
+// framework/data_feed.h:222 (InMemoryDataFeed LoadIntoMemory + shuffle),
+// framework/data_set.h:132 (DatasetImpl multi-file orchestration).
+//
+// File format (identical to the reference's MultiSlot text format): each
+// line is one instance; for each declared slot, in order:
+//     <len> v_1 v_2 ... v_len
+// where values are floats (dtype "float") or int64 ids (dtype "uint64"/
+// "int64").  Parser threads consume a shared file list, batch instances,
+// and push ready batches into a bounded queue; the consumer drains batches
+// as flat value buffers + per-instance offsets (the dense stand-in for the
+// reference's LoD).
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace ptn {
+namespace {
+
+struct SlotDesc {
+  std::string name;
+  bool is_float;  // else int64
+};
+
+// One parsed instance: per-slot values.
+struct Instance {
+  std::vector<std::vector<float>> fvals;
+  std::vector<std::vector<int64_t>> ivals;
+};
+
+// A ready batch: flat buffers + offsets per slot.
+struct Batch {
+  // per slot: concatenated values and (batch_size+1) offsets
+  std::vector<std::vector<float>> fbuf;
+  std::vector<std::vector<int64_t>> ibuf;
+  std::vector<std::vector<int64_t>> offsets;
+  int64_t batch_size = 0;
+};
+
+class MultiSlotDataFeed {
+ public:
+  MultiSlotDataFeed(std::vector<SlotDesc> slots, int64_t batch_size,
+                    int64_t queue_cap)
+      : slots_(std::move(slots)),
+        batch_size_(batch_size),
+        queue_cap_(queue_cap) {}
+
+  ~MultiSlotDataFeed() { Join(); }
+
+  void SetFileList(std::vector<std::string> files) {
+    files_ = std::move(files);
+    next_file_.store(0);
+  }
+
+  void Start(int nthreads, uint64_t shuffle_seed) {
+    Join();
+    done_.store(false);
+    stop_.store(false);
+    shuffle_seed_ = shuffle_seed;
+    int n = std::max(1, nthreads);
+    active_workers_.store(n);
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this, i] { Worker(i); });
+    }
+  }
+
+  // Pop one batch; nullptr when all files are drained.
+  Batch* Next() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [this] { return !ready_.empty() || done_.load(); });
+    if (ready_.empty()) return nullptr;
+    Batch* b = ready_.front();
+    ready_.pop_front();
+    not_full_.notify_one();
+    return b;
+  }
+
+  int NumSlots() const { return (int)slots_.size(); }
+  const SlotDesc& Slot(int i) const { return slots_[i]; }
+
+ private:
+  void Worker(int idx) {
+    // worker body is exception-fenced: a malformed file must never
+    // std::terminate the process (uncaught exception in std::thread)
+    try {
+      std::vector<Instance> pending;
+      std::mt19937_64 rng(shuffle_seed_ + idx);
+      while (!stop_.load()) {
+        size_t fi = next_file_.fetch_add(1);
+        if (fi >= files_.size()) break;
+        ParseFile(files_[fi], &pending, &rng);
+      }
+      if (!pending.empty() && !stop_.load()) {
+        EmitBatch(&pending, pending.size());
+      }
+    } catch (...) {
+    }
+    if (active_workers_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(mu_);
+      done_.store(true);
+      not_empty_.notify_all();
+    }
+  }
+
+  void ParseFile(const std::string& path, std::vector<Instance>* pending,
+                 std::mt19937_64* rng) {
+    std::FILE* f = std::fopen(path.c_str(), "r");
+    if (f == nullptr) return;
+    std::string line;
+    char buf[1 << 16];
+    while (std::fgets(buf, sizeof(buf), f) != nullptr) {
+      line.assign(buf);
+      // lines longer than the buffer: keep reading
+      while (!line.empty() && line.back() != '\n' &&
+             std::fgets(buf, sizeof(buf), f) != nullptr) {
+        line += buf;
+      }
+      Instance inst;
+      if (ParseLine(line, &inst)) {
+        if (shuffle_seed_ != 0 && !pending->empty()) {
+          // reservoir-style local shuffle (InMemoryDataFeed's role)
+          size_t j = (*rng)() % (pending->size() + 1);
+          if (j < pending->size()) {
+            std::swap((*pending)[j], inst);
+          }
+        }
+        pending->push_back(std::move(inst));
+        if ((int64_t)pending->size() >= batch_size_) {
+          EmitBatch(pending, batch_size_);
+        }
+      }
+    }
+    std::fclose(f);
+  }
+
+  bool ParseLine(const std::string& line, Instance* inst) {
+    const char* p = line.c_str();
+    inst->fvals.resize(slots_.size());
+    inst->ivals.resize(slots_.size());
+    // cap per-slot length: a corrupt count token must not turn into a
+    // multi-GB reserve (bad_alloc) — the line is skipped instead
+    constexpr long kMaxSlotLen = 1 << 24;
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      char* end = nullptr;
+      long len = std::strtol(p, &end, 10);
+      if (end == p || len < 0 || len > kMaxSlotLen) return false;
+      p = end;
+      if (slots_[s].is_float) {
+        auto& v = inst->fvals[s];
+        v.reserve(len);
+        for (long i = 0; i < len; ++i) {
+          float x = std::strtof(p, &end);
+          if (end == p) return false;
+          v.push_back(x);
+          p = end;
+        }
+      } else {
+        auto& v = inst->ivals[s];
+        v.reserve(len);
+        for (long i = 0; i < len; ++i) {
+          long long x = std::strtoll(p, &end, 10);
+          if (end == p) return false;
+          v.push_back((int64_t)x);
+          p = end;
+        }
+      }
+    }
+    return true;
+  }
+
+  void EmitBatch(std::vector<Instance>* pending, int64_t take) {
+    auto* b = new Batch();
+    b->batch_size = take;
+    size_t ns = slots_.size();
+    b->fbuf.resize(ns);
+    b->ibuf.resize(ns);
+    b->offsets.assign(ns, std::vector<int64_t>(1, 0));
+    for (int64_t i = 0; i < take; ++i) {
+      Instance& inst = (*pending)[i];
+      for (size_t s = 0; s < ns; ++s) {
+        if (slots_[s].is_float) {
+          auto& src = inst.fvals[s];
+          b->fbuf[s].insert(b->fbuf[s].end(), src.begin(), src.end());
+          b->offsets[s].push_back((int64_t)b->fbuf[s].size());
+        } else {
+          auto& src = inst.ivals[s];
+          b->ibuf[s].insert(b->ibuf[s].end(), src.begin(), src.end());
+          b->offsets[s].push_back((int64_t)b->ibuf[s].size());
+        }
+      }
+    }
+    pending->erase(pending->begin(), pending->begin() + take);
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [this] {
+      return stop_.load() || (int64_t)ready_.size() < queue_cap_;
+    });
+    if (stop_.load()) {
+      delete b;
+      return;
+    }
+    ready_.push_back(b);
+    not_empty_.notify_one();
+  }
+
+  void Join() {
+    // wake any worker parked on a full queue (a consumer that abandoned
+    // iteration early) before joining — otherwise the destructor deadlocks
+    stop_.store(true);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      not_full_.notify_all();
+    }
+    for (auto& t : workers_) {
+      if (t.joinable()) t.join();
+    }
+    workers_.clear();
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto* b : ready_) delete b;
+    ready_.clear();
+  }
+
+  std::vector<SlotDesc> slots_;
+  int64_t batch_size_;
+  int64_t queue_cap_;
+  uint64_t shuffle_seed_ = 0;
+  std::vector<std::string> files_;
+  std::atomic<size_t> next_file_{0};
+  std::atomic<int> active_workers_{0};
+  std::atomic<bool> done_{false};
+  std::atomic<bool> stop_{false};
+  std::vector<std::thread> workers_;
+  std::deque<Batch*> ready_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+};
+
+}  // namespace
+}  // namespace ptn
+
+using namespace ptn;
+using ptn::MultiSlotDataFeed;
+
+// slots_spec: comma-separated "name:f" (float) / "name:i" (int64)
+PTN_EXPORT void* ptn_datafeed_create(const char* slots_spec,
+                                     int64_t batch_size, int64_t queue_cap) {
+  std::vector<SlotDesc> slots;
+  std::string spec(slots_spec);
+  size_t pos = 0;
+  while (pos < spec.size()) {
+    size_t comma = spec.find(',', pos);
+    if (comma == std::string::npos) comma = spec.size();
+    std::string item = spec.substr(pos, comma - pos);
+    size_t colon = item.find(':');
+    SlotDesc d;
+    d.name = colon == std::string::npos ? item : item.substr(0, colon);
+    d.is_float =
+        colon == std::string::npos || item.substr(colon + 1) != "i";
+    if (!d.name.empty()) slots.push_back(std::move(d));
+    pos = comma + 1;
+  }
+  return new MultiSlotDataFeed(std::move(slots), batch_size, queue_cap);
+}
+
+PTN_EXPORT void ptn_datafeed_destroy(void* h) {
+  delete static_cast<MultiSlotDataFeed*>(h);
+}
+
+// newline-separated file list
+PTN_EXPORT void ptn_datafeed_set_filelist(void* h, const char* files) {
+  std::vector<std::string> list;
+  std::string s(files);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t nl = s.find('\n', pos);
+    if (nl == std::string::npos) nl = s.size();
+    std::string f = s.substr(pos, nl - pos);
+    if (!f.empty()) list.push_back(std::move(f));
+    pos = nl + 1;
+  }
+  static_cast<MultiSlotDataFeed*>(h)->SetFileList(std::move(list));
+}
+
+PTN_EXPORT void ptn_datafeed_start(void* h, int nthreads,
+                                   uint64_t shuffle_seed) {
+  static_cast<MultiSlotDataFeed*>(h)->Start(nthreads, shuffle_seed);
+}
+
+// Returns a batch handle or nullptr at end of data.
+PTN_EXPORT void* ptn_datafeed_next(void* h) {
+  return static_cast<MultiSlotDataFeed*>(h)->Next();
+}
+
+PTN_EXPORT int64_t ptn_batch_size(void* batch) {
+  return static_cast<ptn::Batch*>(batch)->batch_size;
+}
+
+// Copy out slot values.  Returns number of values; float slots via fdst,
+// int slots via idst (pass nullptr to size-probe).
+PTN_EXPORT int64_t ptn_batch_slot_values(void* batch, int slot, float* fdst,
+                                         int64_t* idst) {
+  auto* b = static_cast<ptn::Batch*>(batch);
+  if (!b->fbuf[slot].empty() || b->ibuf[slot].empty()) {
+    if (fdst != nullptr) {
+      std::memcpy(fdst, b->fbuf[slot].data(),
+                  b->fbuf[slot].size() * sizeof(float));
+    }
+    return (int64_t)b->fbuf[slot].size();
+  }
+  if (idst != nullptr) {
+    std::memcpy(idst, b->ibuf[slot].data(),
+                b->ibuf[slot].size() * sizeof(int64_t));
+  }
+  return (int64_t)b->ibuf[slot].size();
+}
+
+PTN_EXPORT int64_t ptn_batch_slot_offsets(void* batch, int slot,
+                                          int64_t* dst) {
+  auto* b = static_cast<ptn::Batch*>(batch);
+  if (dst != nullptr) {
+    std::memcpy(dst, b->offsets[slot].data(),
+                b->offsets[slot].size() * sizeof(int64_t));
+  }
+  return (int64_t)b->offsets[slot].size();
+}
+
+PTN_EXPORT void ptn_batch_free(void* batch) {
+  delete static_cast<ptn::Batch*>(batch);
+}

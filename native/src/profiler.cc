@@ -1,0 +1,191 @@
+// Host profiler: RAII-style event recording with thread-local event lists,
+// aggregated reporting and chrome://tracing export.
+//
+// Reference equivalents: platform/profiler.h:81 (RecordEvent),
+// platform/profiler.h:131 (thread-local EventList), profiler.cc aggregate
+// printer, device_tracer.cc + tools/timeline.py (chrome trace).  Device-side
+// timing comes from XLA/jax.profiler; this records the host runtime around
+// it (executor dispatch, feed/fetch, data pipeline) exactly where the
+// reference placed its markers (framework/executor.cc:177).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace ptn {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Event {
+  std::string name;
+  uint64_t thread_id;
+  int64_t start_ns;
+  int64_t end_ns;
+};
+
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_epoch_ns{0};
+
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+// Per-thread open-event stack + completed list, registered globally so the
+// report can merge across threads (ref EventList + g_all_event_lists).
+struct ThreadEvents {
+  std::vector<Event> open;
+  std::vector<Event> done;
+  uint64_t tid;
+};
+
+std::mutex g_registry_mu;
+std::vector<ThreadEvents*> g_registry;
+
+ThreadEvents* Local() {
+  thread_local ThreadEvents* te = [] {
+    auto* t = new ThreadEvents();
+    t->tid = std::hash<std::thread::id>{}(std::this_thread::get_id()) &
+             0xffffff;
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    g_registry.push_back(t);
+    return t;
+  }();
+  return te;
+}
+
+}  // namespace
+}  // namespace ptn
+
+using namespace ptn;
+
+PTN_EXPORT void ptn_profiler_enable() {
+  g_epoch_ns.store(NowNs());
+  g_enabled.store(true);
+}
+
+PTN_EXPORT void ptn_profiler_disable() { g_enabled.store(false); }
+
+PTN_EXPORT int ptn_profiler_enabled() { return g_enabled.load() ? 1 : 0; }
+
+PTN_EXPORT void ptn_profiler_reset() {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  for (auto* t : g_registry) {
+    t->open.clear();
+    t->done.clear();
+  }
+}
+
+// Push an event (RecordEvent constructor).
+PTN_EXPORT void ptn_event_begin(const char* name) {
+  if (!g_enabled.load()) return;
+  auto* t = Local();
+  Event e;
+  e.name = name;
+  e.thread_id = t->tid;
+  e.start_ns = NowNs();
+  e.end_ns = -1;
+  t->open.push_back(std::move(e));
+}
+
+// Pop it (RecordEvent destructor).
+PTN_EXPORT void ptn_event_end() {
+  if (!g_enabled.load()) return;
+  auto* t = Local();
+  if (t->open.empty()) return;
+  Event e = std::move(t->open.back());
+  t->open.pop_back();
+  e.end_ns = NowNs();
+  t->done.push_back(std::move(e));
+}
+
+// One-shot complete event with explicit duration (used to splice device
+// step timing reported by jax back into the same trace).
+PTN_EXPORT void ptn_event_complete(const char* name, int64_t start_ns,
+                                   int64_t end_ns) {
+  auto* t = Local();
+  Event e;
+  e.name = name;
+  e.thread_id = t->tid;
+  e.start_ns = start_ns;
+  e.end_ns = end_ns;
+  t->done.push_back(std::move(e));
+}
+
+PTN_EXPORT int64_t ptn_now_ns() { return NowNs(); }
+
+// Aggregated report as JSON: {name: {calls, total_us, min_us, max_us}}
+// (ref profiler.cc PrintProfiler's table).
+PTN_EXPORT int64_t ptn_profiler_report_json(char* buf, int64_t cap) {
+  struct Agg {
+    int64_t calls = 0;
+    int64_t total_ns = 0;
+    int64_t min_ns = INT64_MAX;
+    int64_t max_ns = 0;
+  };
+  std::map<std::string, Agg> agg;
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    for (auto* t : g_registry) {
+      for (const auto& e : t->done) {
+        auto& a = agg[e.name];
+        int64_t d = e.end_ns - e.start_ns;
+        a.calls++;
+        a.total_ns += d;
+        if (d < a.min_ns) a.min_ns = d;
+        if (d > a.max_ns) a.max_ns = d;
+      }
+    }
+  }
+  std::ostringstream os;
+  os << "{";
+  bool first = true;
+  for (const auto& kv : agg) {
+    if (!first) os << ",";
+    first = false;
+    os << "\"" << kv.first << "\":{\"calls\":" << kv.second.calls
+       << ",\"total_us\":" << kv.second.total_ns / 1000.0
+       << ",\"min_us\":" << kv.second.min_ns / 1000.0
+       << ",\"max_us\":" << kv.second.max_ns / 1000.0 << "}";
+  }
+  os << "}";
+  return CopyOut(os.str(), buf, cap);
+}
+
+// chrome://tracing JSON (ref tools/timeline.py output format).
+PTN_EXPORT int ptn_profiler_chrome_trace(const char* path) {
+  std::FILE* f = std::fopen(path, "w");
+  if (f == nullptr) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  bool first = true;
+  int64_t epoch = g_epoch_ns.load();
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    for (auto* t : g_registry) {
+      for (const auto& e : t->done) {
+        if (!first) std::fputs(",", f);
+        first = false;
+        std::fprintf(f,
+                     "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+                     "\"ts\":%.3f,\"dur\":%.3f}",
+                     e.name.c_str(), (unsigned long long)e.thread_id,
+                     (e.start_ns - epoch) / 1000.0,
+                     (e.end_ns - e.start_ns) / 1000.0);
+      }
+    }
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return 0;
+}

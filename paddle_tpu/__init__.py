@@ -21,6 +21,11 @@ from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
+from . import native  # noqa
+from . import profiler  # noqa
+from . import data  # noqa
+from .data import DataFeeder, DataLoader, PyReader  # noqa
+from .data.slot_dataset import DatasetFactory  # noqa
 from .io import (load_inference_model, load_params, load_persistables,  # noqa
                  load_vars, save_inference_model, save_params,
                  save_persistables, save_vars)

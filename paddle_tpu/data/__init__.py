@@ -1,3 +1,6 @@
 from . import dataset, reader  # noqa
 from .dataloader import DataLoader  # noqa
 from .feeder import DataFeeder  # noqa
+from .py_reader import PyReader  # noqa
+from .slot_dataset import (DatasetBase, DatasetFactory,  # noqa
+                           InMemoryDataset, QueueDataset)

@@ -1,0 +1,125 @@
+"""PyReader: decorated-reader → blocking-queue → train-loop staging.
+
+ref ``python/paddle/fluid/reader.py:47`` (PyReader) + pybind
+``reader_py.cc``: a Python thread pushes numpy batches into the *native*
+C++ blocking queue (``native/src/blocking_queue.cc`` ≈
+LoDTensorBlockingQueue); the train loop pops and device-puts.  Falls back
+to queue.Queue when the native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import native
+from .feeder import DataFeeder
+
+
+class _PyQueueShim:
+    def __init__(self, capacity):
+        self._q = _pyqueue.Queue(maxsize=capacity)
+        self._closed = False
+
+    def push(self, obj, timeout_ms=-1):
+        self._q.put(obj)
+        return True
+
+    def pop(self, timeout_ms=-1):
+        item = self._q.get()
+        if item is StopIteration:
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._q.put(StopIteration)
+
+    def reopen(self):
+        self._closed = False
+
+
+class PyReader:
+    """ref reader.py PyReader(feed_list, capacity, iterable).
+
+    decorate_sample_list_generator / decorate_batch_generator mirror the
+    reference decorators; iteration yields feed dicts.
+    """
+
+    def __init__(self, feed_list: Optional[Sequence] = None,
+                 capacity: int = 8, use_double_buffer: bool = True,
+                 iterable: bool = True):
+        self.feed_list = list(feed_list or [])
+        self.capacity = capacity
+        self.iterable = iterable
+        self._gen: Optional[Callable] = None
+        self._thread: Optional[threading.Thread] = None
+        self._queue = None
+        self._err: List[BaseException] = []
+
+    # -- decoration (ref reader.py:453-620) ----------------------------------
+    def decorate_sample_list_generator(self, generator, places=None):
+        feeder = DataFeeder(self.feed_list)
+
+        def batches():
+            for samples in generator():
+                yield feeder.feed(samples)
+        self._gen = batches
+        return self
+
+    def decorate_batch_generator(self, generator, places=None):
+        self._gen = generator
+        return self
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._gen is None:
+            raise ValueError("decorate a generator first")
+        if native.available():
+            self._queue = native.BlockingQueue(self.capacity)
+        else:
+            self._queue = _PyQueueShim(self.capacity)
+        self._err = []
+
+        def producer():
+            try:
+                for batch in self._gen():
+                    self._queue.push(batch)
+            except BaseException as e:
+                self._err.append(e)
+            finally:
+                self._queue.close()
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._thread = None
+        self._queue = None
+
+    def __iter__(self):
+        if self._thread is None:
+            self.start()
+        try:
+            while True:
+                try:
+                    yield self._queue.pop()
+                except StopIteration:
+                    break
+            if self._err:
+                raise self._err[0]
+        finally:
+            # consumer may abandon iteration early: close the queue so a
+            # producer blocked in push() unwinds before the queue is dropped
+            if self._queue is not None:
+                self._queue.close()
+            self.reset()
+
+    def next(self):
+        if self._thread is None:
+            self.start()
+        return self._queue.pop()

@@ -1,0 +1,180 @@
+"""Slot-file Dataset API over the native MultiSlot data feed.
+
+ref ``python/paddle/fluid/dataset.py``: DatasetFactory:21,
+InMemoryDataset:269, QueueDataset:621 — configured with use_vars/filelist/
+thread-count, consumed by ``Executor.train_from_dataset``
+(ref ``framework/executor.cc:143`` RunFromDataset + MultiTrainer).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import native
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._use_vars = []          # Variables, in slot order
+        self._shuffle_seed = 0
+        self._pipe_command = None    # accepted for parity, unused
+
+    # -- configuration (ref dataset.py set_* methods) ------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = thread_num
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd: str):
+        self._pipe_command = cmd
+
+    def _slots(self):
+        out = []
+        for v in self._use_vars:
+            dtype = "int64" if "int" in str(v.dtype) else "float"
+            out.append((v.name, dtype))
+        return out
+
+    # -- iteration: yields {var_name: dense ndarray} feed dicts --------------
+    def _batches(self):
+        if not native.available():
+            yield from self._batches_python()
+            return
+        feed = native.MultiSlotDataFeed(self._slots(), self._batch_size)
+        feed.set_filelist(self._filelist)
+        feed.start(self._thread_num, self._shuffle_seed)
+        for raw in feed:
+            yield self._to_feed(raw)
+
+    def _batches_python(self):
+        """Pure-python fallback parser for the same MultiSlot text format.
+        Matches the native parser's behavior: malformed lines are skipped,
+        never fatal; local shuffle honors _shuffle_seed."""
+        slots = self._slots()
+        rng = (np.random.RandomState(self._shuffle_seed)
+               if self._shuffle_seed else None)
+        pending = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    inst = self._parse_line(line, slots)
+                    if inst is None:
+                        continue
+                    if rng is not None and pending:
+                        j = rng.randint(0, len(pending) + 1)
+                        if j < len(pending):
+                            pending[j], inst = inst, pending[j]
+                    pending.append(inst)
+                    if len(pending) == self._batch_size:
+                        yield self._pack(pending, slots)
+                        pending = []
+        if pending:
+            yield self._pack(pending, slots)
+
+    @staticmethod
+    def _parse_line(line, slots):
+        toks = line.split()
+        i = 0
+        inst = []
+        try:
+            for name, dtype in slots:
+                n = int(toks[i]); i += 1
+                if n < 0 or i + n > len(toks):
+                    return None
+                vals = toks[i:i + n]; i += n
+                inst.append(np.array(vals, np.int64 if dtype == "int64"
+                                     else np.float32))
+        except (ValueError, IndexError):
+            return None
+        return inst
+
+    def _pack(self, pending, slots):
+        raw = {}
+        for s, (name, dtype) in enumerate(slots):
+            vals = np.concatenate([inst[s] for inst in pending])
+            offs = np.cumsum([0] + [len(inst[s]) for inst in pending])
+            raw[name] = (vals, offs.astype(np.int64))
+        return self._to_feed(raw)
+
+    def _to_feed(self, raw):
+        feed = {}
+        for v in self._use_vars:
+            vals, offs = raw[v.name]
+            widths = np.diff(offs)
+            if len(widths) and (widths == widths[0]).all():
+                # fixed-width slot → dense (batch, w) (w==1 squeezes to the
+                # declared var shape)
+                w = int(widths[0])
+                arr = vals.reshape(-1, w)
+            else:
+                # ragged slot → dense padded + implicit zero pad (the LoD
+                # replacement; SURVEY §5.7).  Width is bucketed to the next
+                # power of two: the executor's jit cache is keyed on feed
+                # shapes, so per-batch max-widths would recompile XLA nearly
+                # every batch
+                w = int(widths.max()) if len(widths) else 1
+                w = 1 << (w - 1).bit_length() if w > 1 else 1
+                arr = np.zeros((len(widths), w), vals.dtype)
+                for i in range(len(widths)):
+                    arr[i, :widths[i]] = vals[offs[i]:offs[i + 1]]
+            feed[v.name] = arr
+        return feed
+
+    def __iter__(self):
+        return self._batches()
+
+
+class QueueDataset(DatasetBase):
+    """ref dataset.py:621 — streaming from files through the native queue."""
+
+
+class InMemoryDataset(DatasetBase):
+    """ref dataset.py:269 — load_into_memory + local/global shuffle."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: Optional[List[dict]] = None
+
+    def load_into_memory(self):
+        self._memory = list(self._batches())
+
+    def local_shuffle(self, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        if self._memory is None:
+            self._shuffle_seed = seed or 1
+        else:
+            rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, seed: int = 0):
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._memory = None
+
+    def __iter__(self):
+        if self._memory is not None:
+            return iter(self._memory)
+        return self._batches()
+
+
+class DatasetFactory:
+    """ref dataset.py:21 — create_dataset by class name."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

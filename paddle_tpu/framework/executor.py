@@ -266,6 +266,15 @@ class Executor:
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
                 self._cache[key] = cb
 
+        import contextlib
+        from .. import profiler as _prof
+        ctx = (_prof.RecordEvent("executor.run")
+               if _prof.is_profiler_enabled() else contextlib.nullcontext())
+        with ctx:
+            return self._finish_run(cb, key, feed, scope, program,
+                                    return_numpy, seed)
+
+    def _finish_run(self, cb, key, feed, scope, program, return_numpy, seed):
         feeds = [_to_device(feed[n]) for n in cb.feed_names]
         ro_vals = [_scope_fetch(scope, n) for n in cb.persist_ro]
         # read-write persistables that are READ must be initialized (optimizer
@@ -296,6 +305,33 @@ class Executor:
 
     def infer_from_program(self, *a, **k):
         return self.run(*a, **k)
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """ref ``framework/executor.cc:143`` RunFromDataset + MultiTrainer:
+        drain the dataset's slot batches through the training program.
+        Threaded file parsing happens in the native data feed; the device
+        step itself is one XLA computation, so the reference's
+        thread-per-device Hogwild loop maps to a single sequential feed
+        loop here."""
+        if dataset is None:
+            raise ValueError("dataset is required")
+        fetch_list = fetch_list or []
+        results = None
+        for i, feed in enumerate(dataset):
+            results = self.run(program, feed=feed, fetch_list=fetch_list,
+                               scope=scope)
+            if debug and fetch_list and i % print_period == 0:
+                info = fetch_info or [f.name if hasattr(f, "name") else str(f)
+                                      for f in fetch_list]
+                msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                for n, v in zip(info, results))
+                print(f"[train_from_dataset] batch {i}: {msg}")
+        return results
+
+    def infer_from_dataset(self, *a, **k):
+        return self.train_from_dataset(*a, **k)
 
 
 def _to_device(x):

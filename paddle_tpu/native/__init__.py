@@ -1,0 +1,356 @@
+"""Native C++ runtime bindings (profiler, blocking queue, allocator stats,
+MultiSlot data feed) — ctypes wrappers over ``native/libpaddle_tpu_native.so``.
+
+The reference exposes its C++ runtime through pybind11
+(``paddle/fluid/pybind/pybind.cc``); here the host runtime is a small C-ABI
+library built on demand with g++ (no pybind11 in the image) — see
+``native/src/*.cc`` for the component-by-component reference mapping.
+
+``available()`` gates every consumer: pure-Python fallbacks exist for each
+component so the framework degrades gracefully without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    for f in os.listdir(src_dir):
+        if os.path.getmtime(os.path.join(src_dir, f)) > so_mtime:
+            return True
+    return False
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if _needs_build():
+                subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                               capture_output=True, text=True)
+            lib = ctypes.CDLL(_SO_PATH)
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
+            _build_error = getattr(e, "stderr", None) or str(e)
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib):
+    c = ctypes
+    i64, p, cp = c.c_int64, c.c_void_p, c.c_char_p
+    sigs = {
+        # profiler
+        "ptn_profiler_enable": ([], None),
+        "ptn_profiler_disable": ([], None),
+        "ptn_profiler_enabled": ([], c.c_int),
+        "ptn_profiler_reset": ([], None),
+        "ptn_event_begin": ([cp], None),
+        "ptn_event_end": ([], None),
+        "ptn_event_complete": ([cp, i64, i64], None),
+        "ptn_now_ns": ([], i64),
+        "ptn_profiler_report_json": ([cp, i64], i64),
+        "ptn_profiler_chrome_trace": ([cp], c.c_int),
+        # queue
+        "ptn_queue_create": ([i64], p),
+        "ptn_queue_destroy": ([p], None),
+        "ptn_queue_push": ([p, p, i64, i64], c.c_int),
+        "ptn_queue_pop": ([p, c.POINTER(p), c.POINTER(i64), i64], c.c_int),
+        "ptn_queue_close": ([p], None),
+        "ptn_queue_reopen": ([p], None),
+        "ptn_queue_size": ([p], i64),
+        "ptn_queue_closed": ([p], c.c_int),
+        "ptn_buffer_free": ([p], None),
+        # allocator
+        "ptn_alloc": ([i64], p),
+        "ptn_free": ([p], None),
+        "ptn_memory_stats": ([c.POINTER(i64)] * 4, None),
+        "ptn_memory_stats_reset": ([], None),
+        "ptn_pool_create": ([i64], p),
+        "ptn_pool_destroy": ([p], None),
+        "ptn_pool_alloc": ([p, i64], p),
+        "ptn_pool_free": ([p, p], c.c_int),
+        "ptn_pool_in_use": ([p], i64),
+        "ptn_pool_peak": ([p], i64),
+        # data feed
+        "ptn_datafeed_create": ([cp, i64, i64], p),
+        "ptn_datafeed_destroy": ([p], None),
+        "ptn_datafeed_set_filelist": ([p, cp], None),
+        "ptn_datafeed_start": ([p, c.c_int, c.c_uint64], None),
+        "ptn_datafeed_next": ([p], p),
+        "ptn_batch_size": ([p], i64),
+        "ptn_batch_slot_values": ([p, c.c_int, p, p], i64),
+        "ptn_batch_slot_offsets": ([p, c.c_int, p], i64),
+        "ptn_batch_free": ([p], None),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+# ---------------------------------------------------------------------------
+# Profiler (ref platform/profiler.h)
+# ---------------------------------------------------------------------------
+
+class NativeProfiler:
+    @staticmethod
+    def enable():
+        _load().ptn_profiler_enable()
+
+    @staticmethod
+    def disable():
+        _load().ptn_profiler_disable()
+
+    @staticmethod
+    def reset():
+        _load().ptn_profiler_reset()
+
+    @staticmethod
+    def is_enabled() -> bool:
+        lib = _load()
+        return bool(lib and lib.ptn_profiler_enabled())
+
+    @staticmethod
+    def event_begin(name: str):
+        _load().ptn_event_begin(name.encode())
+
+    @staticmethod
+    def event_end():
+        _load().ptn_event_end()
+
+    @staticmethod
+    def event_complete(name: str, start_ns: int, end_ns: int):
+        _load().ptn_event_complete(name.encode(), start_ns, end_ns)
+
+    @staticmethod
+    def now_ns() -> int:
+        return _load().ptn_now_ns()
+
+    @staticmethod
+    def report() -> dict:
+        import json
+        lib = _load()
+        n = lib.ptn_profiler_report_json(None, 0)
+        buf = ctypes.create_string_buffer(int(n) + 2)
+        lib.ptn_profiler_report_json(buf, n + 2)
+        return json.loads(buf.value.decode())
+
+    @staticmethod
+    def chrome_trace(path: str) -> bool:
+        return _load().ptn_profiler_chrome_trace(path.encode()) == 0
+
+
+# ---------------------------------------------------------------------------
+# Blocking queue of numpy-batch payloads (ref LoDTensorBlockingQueue)
+# ---------------------------------------------------------------------------
+
+class BlockingQueue:
+    """Bounded queue moving pickled numpy batches between the reader thread
+    and the train loop through native memory."""
+
+    def __init__(self, capacity: int):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._h = self._lib.ptn_queue_create(capacity)
+
+    def push(self, obj, timeout_ms: int = -1) -> bool:
+        import pickle
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.ptn_queue_push(self._h, data, len(data), timeout_ms)
+        return rc == 0
+
+    def pop(self, timeout_ms: int = -1):
+        import pickle
+        out = ctypes.c_void_p()
+        size = ctypes.c_int64()
+        rc = self._lib.ptn_queue_pop(self._h, ctypes.byref(out),
+                                     ctypes.byref(size), timeout_ms)
+        if rc == -1:
+            raise StopIteration
+        if rc == -2:
+            raise TimeoutError("queue pop timed out")
+        try:
+            raw = ctypes.string_at(out.value, size.value)
+        finally:
+            self._lib.ptn_buffer_free(out)
+        return pickle.loads(raw)
+
+    def close(self):
+        self._lib.ptn_queue_close(self._h)
+
+    def reopen(self):
+        self._lib.ptn_queue_reopen(self._h)
+
+    def size(self) -> int:
+        return int(self._lib.ptn_queue_size(self._h))
+
+    def is_closed(self) -> bool:
+        return bool(self._lib.ptn_queue_closed(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptn_queue_destroy(self._h)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Allocator stats + best-fit staging pool (ref memory/allocation)
+# ---------------------------------------------------------------------------
+
+def memory_stats() -> dict:
+    lib = _load()
+    vals = [ctypes.c_int64() for _ in range(4)]
+    lib.ptn_memory_stats(*[ctypes.byref(v) for v in vals])
+    return {"in_use": vals[0].value, "peak": vals[1].value,
+            "allocs": vals[2].value, "frees": vals[3].value}
+
+
+class _PoolArray(np.ndarray):
+    """ndarray subclass so the pool address can ride along as an attribute."""
+    _ptn_ptr = None
+
+
+class BestFitPool:
+    """Best-fit arena for host staging buffers (ref best_fit_allocator.cc).
+    ``alloc`` returns a numpy view over pool memory; ``free`` recycles it."""
+
+    def __init__(self, nbytes: int):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._h = self._lib.ptn_pool_create(nbytes)
+        if not self._h:
+            raise MemoryError(f"cannot reserve {nbytes} bytes")
+
+    def alloc(self, shape, dtype) -> Optional[np.ndarray]:
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        ptr = self._lib.ptn_pool_alloc(self._h, nbytes)
+        if not ptr:
+            return None  # pool exhausted — caller falls back to np.empty
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dt).reshape(shape).view(_PoolArray)
+        arr._ptn_ptr = ptr  # keep address for free()
+        return arr
+
+    def free(self, arr: np.ndarray) -> bool:
+        ptr = getattr(arr, "_ptn_ptr", None)
+        if ptr is None:
+            return False
+        return self._lib.ptn_pool_free(self._h, ptr) == 0
+
+    def in_use(self) -> int:
+        return int(self._lib.ptn_pool_in_use(self._h))
+
+    def peak(self) -> int:
+        return int(self._lib.ptn_pool_peak(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptn_pool_destroy(self._h)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# MultiSlot data feed (ref framework/data_feed.h:532)
+# ---------------------------------------------------------------------------
+
+class MultiSlotDataFeed:
+    """Parallel text-slot file ingestion.
+
+    slots: [(name, "float"|"int64"), ...] in file order.
+    Yields per batch: {name: (values ndarray, offsets ndarray)} where
+    offsets[i]:offsets[i+1] delimits instance i (dense LoD replacement).
+    """
+
+    def __init__(self, slots: Sequence[Tuple[str, str]], batch_size: int,
+                 queue_capacity: int = 8):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._slots = list(slots)
+        spec = ",".join(f"{n}:{'i' if d in ('int64', 'uint64') else 'f'}"
+                        for n, d in self._slots)
+        self._h = self._lib.ptn_datafeed_create(spec.encode(), batch_size,
+                                                queue_capacity)
+
+    def set_filelist(self, files: Sequence[str]):
+        self._lib.ptn_datafeed_set_filelist(self._h,
+                                            "\n".join(files).encode())
+
+    def start(self, nthreads: int = 2, shuffle_seed: int = 0):
+        self._lib.ptn_datafeed_start(self._h, nthreads, shuffle_seed)
+
+    def __iter__(self):
+        while True:
+            bh = self._lib.ptn_datafeed_next(self._h)
+            if not bh:
+                return
+            try:
+                yield self._unpack(bh)
+            finally:
+                self._lib.ptn_batch_free(bh)
+
+    def _unpack(self, bh):
+        out = {}
+        bs = self._lib.ptn_batch_size(bh)
+        for i, (name, dtype) in enumerate(self._slots):
+            n = self._lib.ptn_batch_slot_values(bh, i, None, None)
+            offsets = np.empty(bs + 1, np.int64)
+            self._lib.ptn_batch_slot_offsets(
+                bh, i, offsets.ctypes.data_as(ctypes.c_void_p))
+            if dtype in ("int64", "uint64"):
+                vals = np.empty(int(n), np.int64)
+                self._lib.ptn_batch_slot_values(
+                    bh, i, None, vals.ctypes.data_as(ctypes.c_void_p))
+            else:
+                vals = np.empty(int(n), np.float32)
+                self._lib.ptn_batch_slot_values(
+                    bh, i, vals.ctypes.data_as(ctypes.c_void_p), None)
+            out[name] = (vals, offsets)
+        return out
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptn_datafeed_destroy(self._h)
+        except Exception:
+            pass

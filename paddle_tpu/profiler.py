@@ -1,0 +1,176 @@
+"""Profiler API (ref ``python/paddle/fluid/profiler.py`` +
+``platform/profiler.h:81,166`` RecordEvent/EnableProfiler).
+
+Host-side timing runs through the native C++ profiler
+(``native/src/profiler.cc`` — thread-local event lists, chrome-trace export,
+the reference's design) with a pure-Python fallback; device-side profiling
+delegates to ``jax.profiler`` (XLA's TraceMe ≈ the reference's CUPTI
+device tracer), matching SURVEY §5.1's TPU mapping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Optional
+
+from . import native
+
+_py_events = []          # fallback store: (name, tid, start_ns, end_ns)
+_py_open = threading.local()
+_py_enabled = False
+_use_native = None
+
+
+def _native_ok() -> bool:
+    global _use_native
+    if _use_native is None:
+        _use_native = native.available()
+    return _use_native
+
+
+def is_profiler_enabled() -> bool:
+    if _native_ok():
+        return native.NativeProfiler.is_enabled()
+    return _py_enabled
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """ref profiler.py start_profiler — state/tracer args accepted for
+    parity; host events always recorded, device via jax.profiler."""
+    global _py_enabled
+    if _native_ok():
+        native.NativeProfiler.enable()
+    else:
+        _py_enabled = True
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    """ref profiler.py stop_profiler — prints the aggregate table and
+    optionally writes a chrome trace."""
+    global _py_enabled
+    report = profiler_report()
+    if profile_path:
+        chrome_trace(profile_path)
+    if _native_ok():
+        native.NativeProfiler.disable()
+    else:
+        _py_enabled = False
+    _print_report(report, sorted_key)
+    return report
+
+
+def reset_profiler():
+    global _py_events
+    if _native_ok():
+        native.NativeProfiler.reset()
+    else:
+        _py_events = []
+
+
+def profiler_report() -> dict:
+    if _native_ok():
+        return native.NativeProfiler.report()
+    agg = {}
+    for name, tid, s, e in _py_events:
+        a = agg.setdefault(name, {"calls": 0, "total_us": 0.0,
+                                  "min_us": float("inf"), "max_us": 0.0})
+        d = (e - s) / 1000.0
+        a["calls"] += 1
+        a["total_us"] += d
+        a["min_us"] = min(a["min_us"], d)
+        a["max_us"] = max(a["max_us"], d)
+    return agg
+
+
+def chrome_trace(path: str) -> bool:
+    """Write chrome://tracing JSON (ref tools/timeline.py output)."""
+    if _native_ok():
+        return native.NativeProfiler.chrome_trace(path)
+    events = [{"name": n, "ph": "X", "pid": 0, "tid": t,
+               "ts": s / 1000.0, "dur": (e - s) / 1000.0}
+              for n, t, s, e in _py_events]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return True
+
+
+def _print_report(report: dict, sorted_key: Optional[str]):
+    if not report:
+        return
+    key = {"calls": lambda kv: -kv[1]["calls"],
+           "total": lambda kv: -kv[1]["total_us"],
+           "max": lambda kv: -kv[1]["max_us"],
+           "min": lambda kv: kv[1]["min_us"]}.get(
+               sorted_key or "total", lambda kv: -kv[1]["total_us"])
+    rows = sorted(report.items(), key=key)
+    print(f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}"
+          f"{'Min(us)':>12}{'Max(us)':>12}{'Ave(us)':>12}")
+    for name, a in rows:
+        print(f"{name:<40}{a['calls']:>8}{a['total_us']:>14.1f}"
+              f"{a['min_us']:>12.1f}{a['max_us']:>12.1f}"
+              f"{a['total_us'] / max(a['calls'], 1):>12.1f}")
+
+
+class RecordEvent:
+    """RAII/context event marker (ref platform/profiler.h:81)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        if _native_ok():
+            if native.NativeProfiler.is_enabled():
+                native.NativeProfiler.event_begin(self.name)
+                self._rec = True
+            else:
+                self._rec = False
+        elif _py_enabled:
+            stack = getattr(_py_open, "stack", None)
+            if stack is None:
+                stack = _py_open.stack = []
+            stack.append((self.name, time.monotonic_ns()))
+            self._rec = True
+        else:
+            self._rec = False
+        return self
+
+    def __exit__(self, *exc):
+        if not self._rec:
+            return False
+        if _native_ok():
+            native.NativeProfiler.event_end()
+        else:
+            name, start = _py_open.stack.pop()
+            _py_events.append((name, threading.get_ident() & 0xffffff,
+                               start, time.monotonic_ns()))
+        return False
+
+
+record_event = RecordEvent
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None):
+    """``with fluid.profiler.profiler(...):`` (ref profiler.py:profiler)."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def device_profiler(logdir: str):
+    """XLA/TPU device profile via jax.profiler (≈ CUPTI device tracer);
+    view with tensorboard or xprof."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
