@@ -1098,3 +1098,38 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
                      outputs={"Out": [out], "MidOut": [mid]},
                      attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
     return out
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    block_q=128, block_k=128, name=None):
+    """Fused online-softmax attention over [b, h, T, d] tensors.
+
+    TPU-native replacement for the matmul→softmax→matmul chain of the
+    reference Transformer recipe (ref dist_transformer.py:1034
+    scaled_dot_product_attention) — Pallas kernel on TPU, O(T) memory.
+    """
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("flash_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal, "sm_scale": sm_scale or 0.0,
+                            "block_q": block_q, "block_k": block_k})
+    return out
+
+
+def ring_attention(q, k, v, causal=False, sm_scale=None, axis_name="sp",
+                   name=None):
+    """Sequence-parallel attention: KV shards rotate over the mesh's
+    ``sp`` axis (paddle_tpu.pallas.ring_attention); degrades to
+    flash_attention when no sp axis is active.  The long-context
+    capability the reference lacks (SURVEY §5.7)."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op("ring_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal, "sm_scale": sm_scale or 0.0,
+                            "axis_name": axis_name})
+    return out

@@ -22,8 +22,15 @@ from ..param_attr import ParamAttr
 
 def multi_head_attention(queries, keys, values, d_model, n_head,
                          dropout_rate=0.0, attn_bias=None, is_test=False,
-                         param_prefix="attn"):
-    """ref dist_transformer.py:958 multi_head_attention."""
+                         param_prefix="attn", attn_impl="base",
+                         causal=False):
+    """ref dist_transformer.py:958 multi_head_attention.
+
+    attn_impl: "base" (matmul→softmax→matmul chain, ref recipe),
+    "flash" (fused Pallas kernel, O(T) memory), or "ring"
+    (sequence-parallel over the mesh's sp axis).  Fused paths skip
+    attention-weight dropout (standard for flash attention).
+    """
     d_head = d_model // n_head
 
     def _proj(x, size, name):
@@ -47,17 +54,25 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
         return layers.transpose(y, perm=[0, 2, 1, 3])
 
     q, k, v = _split_heads(q), _split_heads(k), _split_heads(v)
-    # scaled dot-product attention (ref dist_transformer.py:1034)
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=float(d_head) ** -0.5)
-    if attn_bias is not None:
-        scores = scores + attn_bias
-    weights = layers.softmax(scores)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate,
-                                 is_test=is_test,
-                                 dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(weights, v)                       # [b, h, t, dh]
+    if attn_impl == "flash":
+        ctx = layers.flash_attention(q, k, v, bias=attn_bias, causal=causal,
+                                     sm_scale=float(d_head) ** -0.5)
+    elif attn_impl == "ring":
+        assert attn_bias is None, "ring attention supports causal= only"
+        ctx = layers.ring_attention(q, k, v, causal=causal,
+                                    sm_scale=float(d_head) ** -0.5)
+    else:
+        # scaled dot-product attention (ref dist_transformer.py:1034)
+        scores = layers.matmul(q, k, transpose_y=True,
+                               alpha=float(d_head) ** -0.5)
+        if attn_bias is not None:
+            scores = scores + attn_bias
+        weights = layers.softmax(scores)
+        if dropout_rate:
+            weights = layers.dropout(
+                weights, dropout_prob=dropout_rate, is_test=is_test,
+                dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)                   # [b, h, t, dh]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(ctx, size=d_model, num_flatten_dims=2,
@@ -79,11 +94,12 @@ def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0, is_test=False,
 
 
 def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
-                  attn_bias=None, is_test=False, idx=0):
+                  attn_bias=None, is_test=False, idx=0, attn_impl="base"):
     """post-LN residual block (ref dist_transformer encoder_layer)."""
     attn = multi_head_attention(x, x, x, d_model, n_head, dropout_rate,
                                 attn_bias, is_test,
-                                param_prefix=f"enc_{idx}.attn")
+                                param_prefix=f"enc_{idx}.attn",
+                                attn_impl=attn_impl)
     if dropout_rate:
         attn = layers.dropout(attn, dropout_prob=dropout_rate,
                               is_test=is_test,
@@ -103,7 +119,7 @@ def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
 
 def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
             n_head, dropout_rate=0.0, attn_bias=None, is_test=False,
-            type_ids=None, n_types=2):
+            type_ids=None, n_types=2, attn_impl="base"):
     """BERT-style embedding + N encoder layers."""
     emb = layers.embedding(src_ids, size=[vocab_size, d_model],
                            param_attr=ParamAttr(name="word_embedding"))
@@ -121,7 +137,7 @@ def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
                            dropout_implementation="upscale_in_train")
     for i in range(n_layer):
         x = encoder_layer(x, d_model, d_inner, n_head, dropout_rate,
-                          attn_bias, is_test, idx=i)
+                          attn_bias, is_test, idx=i, attn_impl=attn_impl)
     return x
 
 
@@ -146,7 +162,7 @@ class BertConfig:
 
 
 def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
-                        dropout=None):
+                        dropout=None, attn_impl="base"):
     """Masked-LM pretraining net: ids+mask-labels → mean masked CE loss.
 
     Labels use 0 ([PAD], never a real MLM target) for unmasked positions;
@@ -158,7 +174,7 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
     lm_label = layers.data("lm_label", shape=[seq_len], dtype="int64")
     enc = encoder(src_ids, pos_ids, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
                   cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
-                  is_test=is_test)
+                  is_test=is_test, attn_impl=attn_impl)
     logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
                        param_attr=ParamAttr(name="mlm_out.w"),
                        bias_attr=ParamAttr(name="mlm_out.b"))

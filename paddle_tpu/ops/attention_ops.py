@@ -1,0 +1,62 @@
+"""Fused attention ops backed by the Pallas kernels.
+
+The reference builds attention from separate matmul/softmax/dropout ops
+(``tests/unittests/dist_transformer.py:1034``); these ops fuse the whole
+pattern so the [b, h, T, T] score matrix never reaches HBM.
+
+- ``flash_attention``: single-device fused attention (Pallas on TPU).
+- ``ring_attention``: the same contract, but when the active mesh has an
+  ``sp`` axis the sequence dimension is sharded and KV shards rotate over
+  the ring (``paddle_tpu.pallas.ring_attention``); without an sp axis it
+  degrades to flash attention, so programs are portable across meshes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import X
+
+
+@register_op("flash_attention")
+def _flash_attention(ctx, ins, attrs):
+    from ..pallas import flash_attention
+    q, k, v = X(ins, "Q"), X(ins, "K"), X(ins, "V")
+    bias = X(ins, "Bias")
+    out = flash_attention(
+        q, k, v, bias=bias, causal=bool(attrs.get("causal", False)),
+        sm_scale=attrs.get("sm_scale") or None,
+        block_q=int(attrs.get("block_q", 128) or 128),
+        block_k=int(attrs.get("block_k", 128) or 128))
+    return {"Out": [out]}
+
+
+@register_op("ring_attention")
+def _ring_attention(ctx, ins, attrs):
+    from ..parallel.mesh import current_mesh
+    q, k, v = X(ins, "Q"), X(ins, "K"), X(ins, "V")
+    causal = bool(attrs.get("causal", False))
+    sm_scale = attrs.get("sm_scale") or None
+    axis = attrs.get("axis_name", "sp") or "sp"
+
+    mesh = current_mesh()
+    if mesh is not None and axis in mesh.axis_names and \
+            mesh.shape[axis] > 1:
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map
+        from ..pallas import ring_attention as _ring
+        spec = P(None, None, axis, None)
+        fn = shard_map(
+            lambda q_, k_, v_: _ring(q_, k_, v_, axis, causal=causal,
+                                     sm_scale=sm_scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return {"Out": [fn(q, k, v)]}
+
+    from ..pallas import flash_attention
+    return {"Out": [flash_attention(q, k, v, causal=causal,
+                                    sm_scale=sm_scale)]}

@@ -1,0 +1,407 @@
+"""Flash attention: fused online-softmax attention with O(T) memory.
+
+Forward on TPU runs a Pallas kernel tiled for the MXU (grid over
+(batch*heads, q-blocks, k-blocks), f32 accumulators in VMEM scratch);
+elsewhere (CPU tests, interpret debugging) a blockwise ``lax.scan``
+computes the same math.  The backward pass is the standard flash
+recomputation: no O(T^2) attention matrix is ever materialized — only
+per-(q-block, k-block) tiles, rebuilt from the saved logsumexp.
+
+Capability anchor in the reference: attention assembled from separate
+matmul/softmax/dropout ops in its Transformer recipe
+(``python/paddle/fluid/tests/unittests/dist_transformer.py:1034``
+scaled_dot_product_attention), which materializes [b, h, T, T] scores in
+HBM.  This kernel is the TPU-native replacement.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+_LANE = 128      # TPU lane width: min last-dim tile
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+    """O(T^2) reference attention (the math the kernel must reproduce)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), k=tk - tq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (forward)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, sm_scale, causal, block_q, block_k,
+                tk_real, offset):
+    """One (bh, iq, ik) grid step of online-softmax attention.
+
+    Grid iterates ik innermost (sequentially on TPU), so the VMEM scratch
+    accumulators carry the running max/denominator across k-blocks.
+    """
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if b_ref is not None:
+            s = s + b_ref[0].astype(jnp.float32)
+        mask = k_pos < tk_real                       # kv padding
+        if causal:
+            mask = mask & (q_pos + offset >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                         # (bq, 1)
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    if causal:
+        # skip fully-masked blocks above the diagonal
+        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)          # fully-masked rows
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse = m_sc[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
+
+
+def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                      offset, interpret):
+    """Returns (o [bh,Tq,d], lse [bh,Tq]) on padded collapsed inputs."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    tk_real = tk
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    if bias is not None and (pad_q or pad_k):
+        bias = jnp.pad(bias, ((0, 0), (0, pad_q), (0, pad_k)))
+    tqp, tkp = tq + pad_q, tk + pad_k
+    nq, nk = tqp // block_q, tkp // block_k
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        nb = bias.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_q, block_k),
+            (lambda b, i, j: (b, i, j)) if nb > 1 else
+            (lambda b, i, j: (0, i, j))))
+        args.append(bias)
+
+    if bias is not None:
+        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, acc, m, l):
+            _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                        acc, m, l, sm_scale=sm_scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        tk_real=tk_real, offset=offset)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                        acc, m, l, sm_scale=sm_scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        tk_real=tk_real, offset=offset)
+
+    lane = min(_LANE, block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, lane), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tqp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tqp, lane), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, lane), jnp.float32),
+            pltpu.VMEM((block_q, lane), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return o[:, :tq], lse[:, :tq, 0]
+
+
+# ---------------------------------------------------------------------------
+# Blockwise JAX fallback (same math, lax.scan over k-blocks)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_jax(q, k, v, bias, causal, sm_scale, block_k, offset):
+    """(o, lse) via scan over k chunks — O(T*block_k) memory on any backend."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_k = min(block_k, tk)
+    pad_k = (-tk) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_k)),
+                           constant_values=NEG_INF)
+    nk = (tk + pad_k) // block_k
+    kc = k.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    vc = v.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    if bias is not None:
+        bc = bias.reshape(bias.shape[0], tq, nk, block_k
+                          ).transpose(2, 0, 1, 3)
+    q32 = q.astype(jnp.float32)
+    q_pos = offset + jnp.arange(tq)[:, None]
+
+    def step(carry, xs):
+        m_prev, l_prev, acc = carry
+        if bias is not None:
+            kj, vj, bj, j = xs
+        else:
+            kj, vj, j = xs
+        s = jnp.einsum("bqd,bkd->bqk", q32, kj.astype(jnp.float32)
+                       ) * sm_scale
+        if bias is not None:
+            s = s + bj.astype(jnp.float32)
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        mask = k_pos < tk
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask[None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bqk,bkd->bqd", p,
+                                       vj.astype(jnp.float32))
+        return (m_new, l_new, acc), None
+
+    # zero derived from the inputs so the carry inherits their device-
+    # varying type under shard_map (scan carries must type-match)
+    zero = (q32[0, 0, 0] + k[0, 0, 0].astype(jnp.float32)) * 0.0
+    init = (jnp.full((bh, tq, 1), NEG_INF, jnp.float32) + zero,
+            jnp.zeros((bh, tq, 1), jnp.float32) + zero,
+            jnp.zeros((bh, tq, d), jnp.float32) + zero)
+    xs = (kc, vc, bc, jnp.arange(nk)) if bias is not None else \
+         (kc, vc, jnp.arange(nk))
+    (m, l, acc), _ = jax.lax.scan(step, init, xs)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / l_safe).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[..., 0]
+    return o, lse
+
+
+def _flash_bwd_jax(q, k, v, bias, o, lse, do, causal, sm_scale, block_k,
+                   offset, delta=None):
+    """Flash backward: scan over k chunks rebuilding P from saved lse.
+
+    dq accumulates across chunks; dk/dv are emitted per chunk (stacked by
+    scan) — memory stays O(T*block_k).
+    """
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_k = min(block_k, tk)
+    pad_k = (-tk) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_k)),
+                           constant_values=NEG_INF)
+    nk = (tk + pad_k) // block_k
+    kc = k.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    vc = v.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    if bias is not None:
+        bc = bias.reshape(bias.shape[0], tq, nk, block_k
+                          ).transpose(2, 0, 1, 3)
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    if delta is None:
+        delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [bh, tq]
+    q_pos = offset + jnp.arange(tq)[:, None]
+
+    def step(dq_acc, xs):
+        if bias is not None:
+            kj, vj, bj, j = xs
+        else:
+            kj, vj, j = xs
+        kj32, vj32 = kj.astype(jnp.float32), vj.astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", q32, kj32) * sm_scale
+        if bias is not None:
+            s = s + bj.astype(jnp.float32)
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        mask = k_pos < tk
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask[None], s, NEG_INF)
+        # true softmax from saved lse; guard fully-masked rows (lse=-inf)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[..., None]))
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, do32)
+        dp = jnp.einsum("bqd,bkd->bqk", do32, vj32)
+        ds = p * (dp - delta[..., None])                   # dL/ds_ij
+        dq_acc = dq_acc + sm_scale * jnp.einsum("bqk,bkd->bqd", ds, kj32)
+        dk_j = sm_scale * jnp.einsum("bqk,bqd->bkd", ds, q32)
+        if bias is not None:
+            nb = bias.shape[0]
+            dbias_j = ds if nb == q.shape[0] else \
+                jnp.sum(ds, axis=0, keepdims=True)
+            return dq_acc, (dk_j, dv_j, dbias_j)
+        return dq_acc, (dk_j, dv_j)
+
+    xs = (kc, vc, bc, jnp.arange(nk)) if bias is not None else \
+         (kc, vc, jnp.arange(nk))
+    zero = (q32[0, 0, 0] + k[0, 0, 0].astype(jnp.float32)
+            + do32[0, 0, 0]) * 0.0
+    dq, outs = jax.lax.scan(
+        step, jnp.zeros((bh, tq, d), jnp.float32) + zero, xs)
+    if bias is not None:
+        dkc, dvc, dbc = outs
+    else:
+        dkc, dvc = outs
+        dbc = None
+    dk = dkc.transpose(1, 0, 2, 3).reshape(bh, tk + pad_k, d)[:, :tk]
+    dv = dvc.transpose(1, 0, 2, 3).reshape(bh, tk + pad_k, d)[:, :tk]
+    db = None
+    if dbc is not None:
+        db = dbc.transpose(1, 2, 0, 3).reshape(
+            bias.shape[0], tq, tk + pad_k)[:, :, :tk]
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), db)
+
+
+# ---------------------------------------------------------------------------
+# Public custom-vjp op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                      interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+    # end-aligned causal mask (matches jnp.tril(k=tk-tq)): the last query
+    # attends to every key — the KV-cache decode convention
+    offset = k.shape[1] - q.shape[1]
+    if _on_tpu() or interpret:
+        return _flash_fwd_pallas(q, k, v, bias, causal, sm_scale,
+                                 block_q, block_k, offset, interpret)
+    return _flash_fwd_jax(q, k, v, bias, causal, sm_scale, block_k, offset)
+
+
+def _flash_vjp_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                   interpret):
+    o, lse = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                        interpret)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, db = _flash_bwd_jax(q, k, v, bias, o, lse, do, causal,
+                                    sm_scale, block_k,
+                                    k.shape[1] - q.shape[1])
+    return dq, dk, dv, db
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Fused attention over [batch, heads, T, head_dim] tensors.
+
+    ``bias`` broadcasts over (batch, heads): accepted shapes are
+    [b, h, Tq, Tk], [1, 1, Tq, Tk] or [Tq, Tk].
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    qc = q.reshape(b * h, tq, d)
+    kc = k.reshape(b * h, tk, d)
+    vc = v.reshape(b * h, tk, d)
+    bc = None
+    if bias is not None:
+        if bias.ndim == 2:
+            bias = bias[None, None]
+        b0, h0 = bias.shape[:2]
+        if b0 == 1 and h0 == 1:
+            bc = bias.reshape(1, tq, tk)
+        else:  # [b,1], [1,h] or [b,h]: materialize full batch*heads
+            bc = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(
+                b * h, tq, tk)
+    o = _flash(qc, kc, vc, bc, causal, sm_scale, block_q, block_k,
+               interpret)
+    return o.reshape(b, h, tq, d)
