@@ -679,3 +679,12 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 DGCMomentum = DGCMomentumOptimizer
+
+
+def __getattr__(name):
+    # PipelineOptimizer lives in parallel.pipeline (lazy: avoids a circular
+    # import, since pipeline pulls in the executor machinery)
+    if name == "PipelineOptimizer":
+        from .parallel.pipeline import PipelineOptimizer
+        return PipelineOptimizer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -160,18 +160,14 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
             (lambda b, i, j: (0, i, j))))
         args.append(bias)
 
-    if bias is not None:
-        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, acc, m, l):
-            _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-                        acc, m, l, sm_scale=sm_scale, causal=causal,
-                        block_q=block_q, block_k=block_k,
-                        tk_real=tk_real, offset=offset)
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
-            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
-                        acc, m, l, sm_scale=sm_scale, causal=causal,
-                        block_q=block_q, block_k=block_k,
-                        tk_real=tk_real, offset=offset)
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        # rest = ([b_ref,] o_ref, lse_ref, acc, m, l) depending on bias
+        b_ref = rest[0] if bias is not None else None
+        o_ref, lse_ref, acc, m, l = rest[-5:]
+        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                    acc, m, l, sm_scale=sm_scale, causal=causal,
+                    block_q=block_q, block_k=block_k,
+                    tk_real=tk_real, offset=offset)
 
     lane = min(_LANE, block_k)
     o, lse = pl.pallas_call(
@@ -261,7 +257,7 @@ def _flash_fwd_jax(q, k, v, bias, causal, sm_scale, block_k, offset):
 
 
 def _flash_bwd_jax(q, k, v, bias, o, lse, do, causal, sm_scale, block_k,
-                   offset, delta=None):
+                   offset, delta=None, need_dbias=True):
     """Flash backward: scan over k chunks rebuilding P from saved lse.
 
     dq accumulates across chunks; dk/dv are emitted per chunk (stacked by
@@ -310,6 +306,8 @@ def _flash_bwd_jax(q, k, v, bias, o, lse, do, causal, sm_scale, block_k,
         ds = p * (dp - delta[..., None])                   # dL/ds_ij
         dq_acc = dq_acc + sm_scale * jnp.einsum("bqk,bkd->bqd", ds, kj32)
         dk_j = sm_scale * jnp.einsum("bqk,bqd->bkd", ds, q32)
+        if bias is not None and not need_dbias:
+            return dq_acc, (dk_j, dv_j)
         if bias is not None:
             nb = bias.shape[0]
             dbias_j = ds if nb == q.shape[0] else \
@@ -323,7 +321,7 @@ def _flash_bwd_jax(q, k, v, bias, o, lse, do, causal, sm_scale, block_k,
             + do32[0, 0, 0]) * 0.0
     dq, outs = jax.lax.scan(
         step, jnp.zeros((bh, tq, d), jnp.float32) + zero, xs)
-    if bias is not None:
+    if bias is not None and need_dbias:
         dkc, dvc, dbc = outs
     else:
         dkc, dvc = outs

@@ -114,7 +114,7 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, interpret, res, do):
         bias = _causal_bias(my, src, tq, tk) if causal else None
         dq_p, dk_p, dv_p, _ = _flash_bwd_jax(
             q, kc, vc, bias, o, lse, do, False, sm_scale, 128, 0,
-            delta=delta)
+            delta=delta, need_dbias=False)
         dq_acc = dq_acc + dq_p.astype(jnp.float32)
         dk_acc = dk_acc + dk_p.astype(jnp.float32)
         dv_acc = dv_acc + dv_p.astype(jnp.float32)

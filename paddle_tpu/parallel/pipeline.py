@@ -1,0 +1,355 @@
+"""Pipeline parallelism: program sections over devices, GPipe schedule.
+
+Reference: ``PipelineOptimizer`` (``python/paddle/fluid/optimizer.py:2687``)
+splits a program at ``cut_list`` vars into sections placed on heterogeneous
+devices, executed by ``PipelineTrainer``/``SectionWorker``
+(``framework/trainer.h:110``, ``framework/device_worker.h:262``) with
+scope queues between stages.
+
+TPU-native redesign:
+
+- the *split* stays program-level (ops between cut vars form a Section, a
+  standalone sub-Program), but
+- the *runtime* is functional: each section lowers to one jitted XLA
+  computation pinned to its pipeline device; activations move stage→stage
+  as committed device arrays (ICI transfers), and JAX's async dispatch
+  overlaps stage s of microbatch m with stage s+1 of microbatch m-1 — the
+  role the reference's scope queues + section worker threads play.
+- backward is recompute-based (each section's vjp re-runs its forward
+  inside one jitted computation) — the rematerialization trade the
+  reference approximates by dropping per-microbatch scopes.
+- optimizer apply reuses the *same* ``Optimizer._append_optimize_op``
+  kernels through the eager shim, so all optimizers work per-stage
+  unchanged (the reference shares optimize ops between modes the same
+  way, ``imperative/prepared_operator.h``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core
+from ..framework.core import Operator, Program, Variable
+
+
+class Section:
+    """One pipeline stage: a sub-program plus its boundary signature.
+
+    ≈ the reference's per-section program (SectionWorkerParameter,
+    ``framework/trainer_desc.proto:66-86``).
+    """
+
+    def __init__(self, idx: int, program: Program, in_names: List[str],
+                 feed_names: List[str], out_names: List[str],
+                 param_names: List[str]):
+        self.idx = idx
+        self.program = program
+        self.in_names = in_names        # activations from the previous stage
+        self.feed_names = feed_names    # raw feeds this stage consumes
+        self.out_names = out_names      # activations for later stages
+        self.param_names = param_names
+
+    def __repr__(self):
+        return (f"Section({self.idx}, ops={len(self.program.global_block().ops)}, "
+                f"in={self.in_names}, feed={self.feed_names}, "
+                f"out={self.out_names})")
+
+
+def _copy_section_program(src_block, ops: Sequence[Operator]) -> Program:
+    """Clone a slice of ops (with the vars they touch) into a fresh Program."""
+    prog = Program()
+    blk = prog.global_block()
+    for op in ops:
+        for name in op.input_arg_names() + op.output_arg_names():
+            if blk.has_var(name):
+                continue
+            if src_block.has_var(name):
+                v = src_block.var(name)
+                nv = Variable(blk, name, shape=v.shape, dtype=v.dtype,
+                              persistable=v.persistable,
+                              is_parameter=v.is_parameter,
+                              trainable=getattr(v, "trainable", False))
+                nv.stop_gradient = getattr(v, "stop_gradient", False)
+                blk.vars[name] = nv
+            else:
+                blk.create_var(name=name)
+    for op in ops:
+        # raw copy, no re-inference: var metadata came from the source block
+        new = Operator(blk, op.type, dict(op.inputs), dict(op.outputs),
+                       dict(op.attrs))
+        blk.ops.append(new)
+    return prog
+
+
+def split_program(program: Program, cut_vars: Sequence,
+                  loss_name: str) -> List[Section]:
+    """Split at cut vars: section s = ops after the producer of cut s-1 up
+    to (and including) the producer of cut s (ref PipelineOptimizer's
+    cut_list semantics, optimizer.py:2687)."""
+    block = program.global_block()
+    cut_names = [c.name if isinstance(c, Variable) else c for c in cut_vars]
+    ops = list(block.ops)
+
+    producer_idx = {}
+    for i, op in enumerate(ops):
+        for name in op.output_arg_names():
+            producer_idx[name] = i
+
+    bounds = []
+    for c in cut_names:
+        if c not in producer_idx:
+            raise ValueError(f"cut var {c!r} is not produced by any op")
+        bounds.append(producer_idx[c])
+    if bounds != sorted(bounds):
+        raise ValueError("cut_list must be topologically ordered")
+    bounds = bounds + [len(ops) - 1]
+
+    produced_by_stage: Dict[str, int] = {}
+    feed_candidates = set()
+    for op in ops:
+        for name in op.input_arg_names():
+            if name not in producer_idx and not block.var(name).persistable:
+                feed_candidates.add(name)
+
+    sections = []
+    start = 0
+    slices = []
+    for s, end in enumerate(bounds):
+        sec_ops = ops[start:end + 1]
+        slices.append(sec_ops)
+        for op in sec_ops:
+            for name in op.output_arg_names():
+                produced_by_stage[name] = s
+        start = end + 1
+
+    # consumers: which stages read each var
+    consumed_by: Dict[str, set] = {}
+    for s, sec_ops in enumerate(slices):
+        for op in sec_ops:
+            for name in op.input_arg_names():
+                consumed_by.setdefault(name, set()).add(s)
+
+    for s, sec_ops in enumerate(slices):
+        internal = set()
+        params, ins, feeds = [], [], []
+        for op in sec_ops:
+            for name in op.input_arg_names():
+                if name in internal:
+                    continue
+                v = block.var(name)
+                if v.persistable:
+                    if name not in params:
+                        params.append(name)
+                elif name in feed_candidates:
+                    if name not in feeds:
+                        feeds.append(name)
+                elif produced_by_stage.get(name, s) != s:
+                    if name not in ins:
+                        ins.append(name)
+            for name in op.output_arg_names():
+                internal.add(name)
+        outs = []
+        for op in sec_ops:
+            for name in op.output_arg_names():
+                later = any(t > s for t in consumed_by.get(name, ()))
+                if (later or name == loss_name) and name not in outs:
+                    outs.append(name)
+        sections.append(Section(s, _copy_section_program(block, sec_ops),
+                                ins, feeds, outs, params))
+    return sections
+
+
+class PipelineEngine:
+    """GPipe runtime over sections (≈ PipelineTrainer + SectionWorkers).
+
+    fwd: every microbatch flows through the jitted section functions, each
+    pinned to its device; boundary activations are stashed per microbatch.
+    bwd: reverse order, each section's vjp recomputes its forward; param
+    grads accumulate (mean over microbatches).  apply: inner optimizer's
+    eager kernels update each stage's params on its own device.
+    """
+
+    def __init__(self, sections: List[Section], loss_name: str,
+                 optimizer, num_microbatches: int,
+                 devices: Optional[List] = None, scope=None):
+        from ..framework.function import program_as_function
+        from ..framework.scope import global_scope
+        from ..dygraph.tracer import VarBase
+
+        self.sections = sections
+        self.loss_name = loss_name
+        self.optimizer = optimizer
+        self.num_microbatches = num_microbatches
+        all_devs = jax.devices()
+        if devices is None:
+            devices = [all_devs[s % len(all_devs)]
+                       for s in range(len(sections))]
+        self.devices = devices
+
+        scope = scope or global_scope()
+        self._vbs: List[Dict[str, VarBase]] = []
+        self._fwd, self._bwd = [], []
+        for s, sec in enumerate(sections):
+            vbs = {}
+            for name in sec.param_names:
+                val = scope.find_var(name)
+                if val is None:
+                    raise RuntimeError(
+                        f"parameter {name!r} not initialized — run the "
+                        f"startup program first")
+                vb = VarBase(jax.device_put(val, devices[s]), name=name,
+                             persistable=True, trainable=True)
+                vbs[name] = vb
+            self._vbs.append(vbs)
+
+            fn = program_as_function(sec.program,
+                                     sec.in_names + sec.feed_names,
+                                     sec.out_names)
+
+            def fwd(params, acts, feeds, _fn=fn):
+                return _fn(params, *(list(acts) + list(feeds)))
+
+            def bwd(params, acts, feeds, gouts, _fn=fn):
+                def f(p, a):
+                    return _fn(p, *(list(a) + list(feeds)))
+                _, vjp = jax.vjp(f, params, tuple(acts))
+                gp, ga = vjp(tuple(gouts))
+                return gp, ga
+
+            self._fwd.append(jax.jit(fwd))
+            self._bwd.append(jax.jit(bwd))
+        self._scope = scope
+
+    def _params(self, s):
+        return {n: vb.value for n, vb in self._vbs[s].items()}
+
+    def train_step(self, feed: Dict[str, np.ndarray]):
+        """One optimizer step over ``num_microbatches`` slices of ``feed``.
+        Returns the mean loss."""
+        M = self.num_microbatches
+        S = len(self.sections)
+        for k, v in feed.items():
+            if np.asarray(v).shape[0] % M:
+                raise ValueError(
+                    f"feed {k!r} batch {np.asarray(v).shape[0]} is not "
+                    f"divisible by num_microbatches={M}; unequal "
+                    f"microbatches would skew the 1/M gradient weighting")
+        micro = []
+        for m in range(M):
+            micro.append({k: np.array_split(np.asarray(v), M)[m]
+                          for k, v in feed.items()})
+
+        # forward wave: boundary activations stashed per (stage, microbatch)
+        stash_in: List[List] = [[None] * M for _ in range(S)]
+        stash_feed: List[List] = [[None] * M for _ in range(S)]
+        losses = [None] * M
+        acts_by_name = [dict() for _ in range(M)]
+        for m in range(M):
+            for s, sec in enumerate(self.sections):
+                acts = [jax.device_put(acts_by_name[m][n], self.devices[s])
+                        for n in sec.in_names]
+                feeds = [jax.device_put(jnp.asarray(micro[m][n]),
+                                        self.devices[s])
+                         for n in sec.feed_names]
+                stash_in[s][m], stash_feed[s][m] = acts, feeds
+                outs = self._fwd[s](self._params(s), acts, feeds)
+                for n, v in zip(sec.out_names, outs):
+                    acts_by_name[m][n] = v
+                    if n == self.loss_name:
+                        losses[m] = v
+
+        # backward wave (reverse), mean-of-microbatch-losses objective
+        gacc: List[Optional[Dict]] = [None] * S
+        for m in range(M):
+            gacts_by_name: Dict[str, jax.Array] = {}
+            for s in range(S - 1, -1, -1):
+                sec = self.sections[s]
+                gouts = []
+                for n in sec.out_names:
+                    if n == self.loss_name:
+                        g = jnp.full(np.shape(losses[m]), 1.0 / M,
+                                     jnp.float32)
+                    elif n in gacts_by_name:
+                        g = jax.device_put(gacts_by_name[n], self.devices[s])
+                    else:
+                        g = jnp.zeros_like(acts_by_name[m][n])
+                    gouts.append(g)
+                gp, ga = self._bwd[s](self._params(s), stash_in[s][m],
+                                      stash_feed[s][m], gouts)
+                for n, v in zip(sec.in_names, ga):
+                    # a boundary var can feed several later stages (skip
+                    # connections): cotangents sum across consumers
+                    if n in gacts_by_name:
+                        prev = gacts_by_name[n]
+                        dev = list(prev.devices())[0]
+                        gacts_by_name[n] = prev + jax.device_put(v, dev)
+                    else:
+                        gacts_by_name[n] = v
+                if gacc[s] is None:
+                    gacc[s] = dict(gp)
+                else:
+                    gacc[s] = {n: gacc[s][n] + v for n, v in gp.items()}
+
+        # optimizer apply per stage through the eager kernels
+        from ..dygraph import base as dy_base
+        with dy_base.guard():
+            for s in range(S):
+                vbs = self._vbs[s]
+                for n, vb in vbs.items():
+                    vb.grad = gacc[s][n]
+                self.optimizer._dygraph_minimize(
+                    None, parameter_list=list(vbs.values()))
+                for vb in vbs.values():
+                    vb.grad = None
+        return float(np.mean([np.asarray(l) for l in losses]))
+
+    def sync_to_scope(self):
+        """Write stage params back to the scope (for save_persistables)."""
+        for vbs in self._vbs:
+            for n, vb in vbs.items():
+                self._scope.set_var(n, jnp.asarray(vb.value))
+
+
+class PipelineOptimizer:
+    """ref ``python/paddle/fluid/optimizer.py:2687`` PipelineOptimizer.
+
+    ``cut_list`` marks stage boundaries.  The reference's scheduler knobs
+    (place_list/concurrency_list/queue_size/start_cpu_core_id) configure
+    its section-worker threads; here XLA async dispatch schedules, so they
+    are accepted for API parity and ignored.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=1):
+        self._inner = optimizer
+        self._cut_list = cut_list or []
+        self._num_microbatches = num_microbatches
+        self._sections: List[Section] = []
+        self._loss_name: Optional[str] = None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Split the (forward) main program at cut_list.  Backward/apply
+        happen functionally inside the engine — no grad ops are appended."""
+        program = loss.block.program if hasattr(loss, "block") else \
+            core.default_main_program()
+        self._loss_name = loss.name if hasattr(loss, "name") else str(loss)
+        self._sections = split_program(program, self._cut_list,
+                                       self._loss_name)
+        return [], []
+
+    @property
+    def sections(self):
+        return self._sections
+
+    def create_engine(self, devices=None, scope=None) -> PipelineEngine:
+        """Build the runtime (after the startup program has run)."""
+        if not self._sections:
+            raise RuntimeError("call minimize(loss) first")
+        return PipelineEngine(self._sections, self._loss_name, self._inner,
+                              self._num_microbatches, devices, scope)

@@ -1,0 +1,149 @@
+"""Pipeline parallelism: split correctness + loss parity with single-device
+training (the reference's ParallelExecutor consistency harness, SURVEY §4.5,
+applied to the PipelineOptimizer/SectionWorker analog §2.5)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer as opt
+from paddle_tpu.framework import Executor, Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.parallel.pipeline import PipelineOptimizer, split_program
+
+
+def _build(seed=0):
+    np.random.seed(seed)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h1 = layers.fc(x, size=16, act="relu")
+    h2 = layers.fc(h1, size=16, act="relu")
+    pred = layers.fc(h2, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    return h1, h2, loss
+
+
+def _feeds(steps=4, batch=16):
+    rng = np.random.RandomState(1)
+    return [{"x": rng.rand(batch, 8).astype("float32"),
+             "y": rng.randint(0, 4, (batch, 1)).astype("int64")}
+            for _ in range(steps)]
+
+
+def test_split_program_sections():
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        h1, h2, loss = _build()
+        secs = split_program(main, [h1, h2], loss.name)
+    assert len(secs) == 3
+    assert secs[0].feed_names == ["x"]
+    assert secs[1].in_names == [h1.name]
+    assert secs[2].in_names == [h2.name]
+    assert "y" in secs[2].feed_names
+    assert secs[2].out_names == [loss.name]
+    # every original op lands in exactly one section
+    total = sum(len(s.program.global_block().ops) for s in secs)
+    assert total == len(main.global_block().ops)
+
+
+def _run_single(optimizer_fn, steps=4):
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        _, _, loss = _build()
+        optimizer_fn().minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=42)
+        out = []
+        for feed in _feeds(steps):
+            lv, = exe.run(feed=feed, fetch_list=[loss.name])
+            out.append(float(np.asarray(lv)))
+        return out
+
+
+def _run_pipeline(optimizer_fn, num_microbatches, steps=4):
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        h1, h2, loss = _build()
+        pipe = PipelineOptimizer(optimizer_fn(), cut_list=[h1, h2],
+                                 num_microbatches=num_microbatches)
+        pipe.minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=42)
+        eng = pipe.create_engine()
+        out = [eng.train_step(feed) for feed in _feeds(steps)]
+        eng.sync_to_scope()
+        return out
+
+
+def test_pipeline_sgd_matches_single_device():
+    single = _run_single(lambda: opt.SGDOptimizer(0.1))
+    piped = _run_pipeline(lambda: opt.SGDOptimizer(0.1), num_microbatches=4)
+    np.testing.assert_allclose(single, piped, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_adam_matches_single_device():
+    """Adam exercises persistent per-stage accumulator state."""
+    single = _run_single(lambda: opt.AdamOptimizer(learning_rate=0.01))
+    piped = _run_pipeline(lambda: opt.AdamOptimizer(learning_rate=0.01),
+                          num_microbatches=2)
+    np.testing.assert_allclose(single, piped, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_single_microbatch():
+    single = _run_single(lambda: opt.SGDOptimizer(0.1))
+    piped = _run_pipeline(lambda: opt.SGDOptimizer(0.1), num_microbatches=1)
+    np.testing.assert_allclose(single, piped, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_skip_connection_parity():
+    """A boundary var consumed by two later stages (skip connection) must
+    sum its cotangents across consumers."""
+
+    def build():
+        np.random.seed(0)
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        h1 = layers.fc(x, size=16, act="relu")
+        h2 = layers.fc(h1, size=16, act="relu")
+        h3 = h1 + h2                      # h1 feeds stages 1 AND 2
+        pred = layers.fc(h3, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        return h1, h2, loss
+
+    def run(pipeline):
+        main, start = Program(), Program()
+        with program_guard(main, start), scope_guard(Scope()):
+            h1, h2, loss = build()
+            if pipeline:
+                pipe = PipelineOptimizer(opt.SGDOptimizer(0.1),
+                                         cut_list=[h1, h2],
+                                         num_microbatches=4)
+                pipe.minimize(loss)
+            else:
+                opt.SGDOptimizer(0.1).minimize(loss)
+            exe = Executor()
+            exe.run(pt.default_startup_program(), seed=42)
+            if pipeline:
+                eng = pipe.create_engine()
+                return [eng.train_step(f) for f in _feeds(3)]
+            return [float(np.asarray(exe.run(feed=f,
+                                             fetch_list=[loss.name])[0]))
+                    for f in _feeds(3)]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_rejects_indivisible_batch():
+    import pytest
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        h1, h2, loss = _build()
+        pipe = PipelineOptimizer(opt.SGDOptimizer(0.1), cut_list=[h1, h2],
+                                 num_microbatches=4)
+        pipe.minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=42)
+        eng = pipe.create_engine()
+        rng = np.random.RandomState(0)
+        with pytest.raises(ValueError, match="divisible"):
+            eng.train_step({"x": rng.rand(10, 8).astype("float32"),
+                            "y": rng.randint(0, 4, (10, 1)).astype("int64")})
