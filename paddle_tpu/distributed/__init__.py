@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed — multi-process/multi-host training surface.
+
+Reference layers replaced here (SURVEY §2.5, §3.3):
+
+- ``operators/collective/c_*`` NCCL-ring ops            → collective_ops
+  (XLA collectives on the mesh's ``dp`` axis under the executor's
+  collective shard_map mode)
+- ``transpiler/collective.py`` GradAllReduce/LocalSGD   → transpiler
+- ``incubate/fleet``  fleet.init/distributed_optimizer  → fleet
+- ``python/paddle/distributed/launch.py`` process spawn → launch
+- ``c_gen_nccl_id`` RPC bootstrap                       → init_parallel_env
+  (jax.distributed coordination service)
+"""
+
+from . import collective_ops  # noqa  (registers c_* lowerings)
+from .env import (Env, get_rank, get_world_size,  # noqa
+                  init_parallel_env)
+from .fleet import (CollectiveOptimizer, DistributedStrategy,  # noqa
+                    PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet)
+from .transpiler import GradAllReduce, LocalSGD  # noqa

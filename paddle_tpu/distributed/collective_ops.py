@@ -1,0 +1,119 @@
+"""``c_*`` collective ops — graph-level collectives on named mesh axes.
+
+Reference: ``operators/collective/`` (24 files): CUDA kernels calling
+``ncclAllReduce`` etc. on an ``NCCLCommContext`` ring selected by
+``ring_id`` (``c_allreduce_op.h:58,105``).  Here each op lowers to the
+matching XLA collective (``lax.psum/pmax/pmin/all_gather/psum_scatter``)
+over a mesh axis — XLA lays the collective onto ICI/DCN.  The ops are
+meaningful when the enclosing block executes under the executor's
+collective shard_map mode (``ctx.collective_axis`` set); outside it the
+world size is 1 and they are the identity, so the same program runs
+unchanged on one chip.
+
+Ring bootstrap ops (``c_gen_nccl_id``, ``c_comm_init``...) are no-op
+markers: the jax.distributed coordination service plays the role of the
+reference's RPC ncclUniqueId exchange (see ``env.init_parallel_env``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+from ..ops.common import X
+
+
+def _axis(ctx, attrs):
+    """Mesh axis for this ring: collective mode maps ring_id -> axis."""
+    ax = getattr(ctx, "collective_axis", None)
+    if isinstance(ax, dict):
+        return ax.get(int(attrs.get("ring_id", 0) or 0))
+    return ax
+
+
+def _allreduce(kind):
+    def lower(ctx, ins, attrs):
+        x = X(ins, "X")
+        ax = _axis(ctx, attrs)
+        if ax is None:
+            return {"Out": [x]}
+        fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+              "prod": _pprod}[kind]
+        return {"Out": [fn(x, ax)]}
+    return lower
+
+
+def _pprod(x, axis):
+    # XLA has no native pprod; all_gather + prod reduction
+    g = lax.all_gather(x, axis)
+    return jnp.prod(g, axis=0)
+
+
+for _kind in ("sum", "max", "min", "prod"):
+    register_op(f"c_allreduce_{_kind}", _allreduce(_kind))
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    root = int(attrs.get("root", 0) or 0)
+    return {"Out": [lax.all_gather(x, ax)[root]]}
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    g = lax.all_gather(x, ax)            # [nranks, ...]
+    return {"Out": [g.reshape((-1,) + x.shape[1:])]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    return {"Out": [lax.psum_scatter(x, ax, tiled=True)]}
+
+
+def _identity(ctx, ins, attrs):
+    x = X(ins, "X")
+    return {"Out": [x]} if x is not None else {}
+
+
+# stream sync is implicit in XLA's dataflow ordering
+register_op("c_sync_calc_stream", _identity)
+register_op("c_sync_comm_stream", _identity)
+
+
+def _noop(ctx, ins, attrs):
+    return {}
+
+
+# comm bootstrap: the jax.distributed coordination service replaces the
+# reference's RPC ncclUniqueId exchange (gen_nccl_id_op.cc)
+register_op("c_gen_nccl_id", _noop, no_grad=True)
+register_op("c_comm_init", _noop, no_grad=True)
+register_op("c_comm_init_all", _noop, no_grad=True)
+register_op("gen_nccl_id", _noop, no_grad=True)
+
+
+@register_op("c_split")
+def _c_split(ctx, ins, attrs):
+    """Each rank keeps its slice of dim 0 (inverse of c_allgather)."""
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    n = lax.psum(1, ax)
+    idx = lax.axis_index(ax)
+    return {"Out": [lax.dynamic_slice_in_dim(x, idx * (x.shape[0] // n),
+                                             x.shape[0] // n, 0)]}

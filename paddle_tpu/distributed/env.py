@@ -1,0 +1,74 @@
+"""Cluster environment + coordination bootstrap.
+
+Reference: trainer env vars set by ``paddle.distributed.launch``
+(``python/paddle/distributed/launch.py:147-281``:
+PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS) and the NCCL-id RPC exchange
+(``operators/collective/c_gen_nccl_id_op.cc``).
+
+TPU mapping: the same env contract, with the ncclUniqueId exchange
+replaced by ``jax.distributed.initialize`` — the coordination service at
+the rank-0 endpoint hands every process the global device topology.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class Env:
+    """Parsed trainer environment (≈ dygraph/parallel.py Env)."""
+
+    def __init__(self):
+        self.rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self.current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints: List[str] = eps.split(",") if eps else []
+
+    @property
+    def dev_id(self) -> int:
+        return int(os.getenv("FLAGS_selected_tpus",
+                             os.getenv("FLAGS_selected_gpus", "0")))
+
+
+def get_rank() -> int:
+    return Env().rank
+
+
+def get_world_size() -> int:
+    return Env().world_size
+
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> Env:
+    """Bring up the multi-process runtime (≈ c_gen_nccl_id + c_comm_init).
+
+    Rank 0's endpoint hosts the coordination service; every process learns
+    the global TPU topology from it.  After this, ``jax.devices()`` spans
+    all hosts and a Mesh over it scales collectives across DCN.
+    No-op in single-process runs.
+    """
+    global _initialized
+    env = Env()
+    if _initialized:
+        return env
+    num_processes = num_processes if num_processes is not None \
+        else env.world_size
+    if num_processes <= 1:
+        _initialized = True
+        return env
+    import jax
+    coordinator_address = coordinator_address or (
+        env.trainer_endpoints[0] if env.trainer_endpoints else None)
+    process_id = process_id if process_id is not None else env.rank
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return env

@@ -1,0 +1,260 @@
+"""Fleet: the unified distributed-training facade.
+
+Reference: ``python/paddle/fluid/incubate/fleet/base/fleet_base.py:38,222``
+(``fleet.init(role_maker)`` / ``fleet.distributed_optimizer(...)``
+``.minimize()`` / ``init_worker`` / ``init_server``) with the Collective
+backend (``incubate/fleet/collective/__init__.py:41,140``) and RoleMakers
+(``incubate/fleet/base/role_maker.py``).
+
+Collective mode here = GradAllReduce transpile + the executor's shard_map
+collective mode (XLA collectives over the dp mesh axis); multi-host
+bootstrap = jax.distributed via ``init_parallel_env``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .env import Env, init_parallel_env
+from .transpiler import GradAllReduce, LocalSGD
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var role maker (ref role_maker.py PaddleCloudRoleMaker): reads
+    the PADDLE_* contract that ``paddle_tpu.distributed.launch`` emits."""
+
+    def __init__(self, is_collective: bool = True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        env = Env()
+        training_role = os.getenv("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER" and not self._is_collective:
+            self._role = Role.SERVER
+            self._current_id = int(os.getenv("PADDLE_PSERVER_ID", "0"))
+            eps = os.getenv("PADDLE_PSERVER_ENDPOINTS", "")
+            self._server_endpoints = eps.split(",") if eps else []
+        else:
+            self._role = Role.WORKER
+            self._current_id = env.rank
+            self._worker_endpoints = env.trainer_endpoints or \
+                ["127.0.0.1:6174"]
+            eps = os.getenv("PADDLE_PSERVER_ENDPOINTS", "")
+            self._server_endpoints = eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """ref role_maker.py UserDefinedRoleMaker."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._server_endpoints = server_endpoints or []
+        self._worker_endpoints = worker_endpoints or \
+            [f"127.0.0.1:{6170 + i}" for i in range(worker_num)]
+
+
+class DistributedStrategy:
+    """ref ``incubate/fleet/collective/__init__.py:94`` DistributedStrategy.
+
+    TPU mapping notes: nccl_comm_num / hierarchical allreduce are XLA's
+    job (multi-stream + ICI/DCN hierarchy come from the compiler); the
+    knobs are kept for API parity and recorded on the program.
+    """
+
+    def __init__(self):
+        self.mode = "collective"          # or "local_sgd"
+        self.nccl_comm_num = 1
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.fuse_all_reduce_ops = True   # XLA fuses; parity knob
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+
+
+class Fleet:
+    """Singleton facade (ref fleet_base.py:38 Fleet)."""
+
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._is_initialized = False
+        self._strategy: Optional[DistributedStrategy] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        if self._role_maker.is_worker() and self._role_maker.worker_num() > 1:
+            # multi-host: bring up the coordination service (≈ gen_nccl_id)
+            init_parallel_env()
+        self._is_initialized = True
+
+    def _assert_init(self):
+        if not self._is_initialized:
+            raise RuntimeError("call fleet.init(role_maker) first "
+                               "(ref fleet_base.py:268)")
+
+    # -- role queries ---------------------------------------------------------
+    def is_worker(self):
+        self._assert_init()
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        self._assert_init()
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        self._assert_init()
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        self._assert_init()
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        self._assert_init()
+        return self._role_maker.worker_num()
+
+    def server_num(self):
+        self._assert_init()
+        return self._role_maker.server_num()
+
+    def worker_endpoints(self, to_string=False):
+        self._assert_init()
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        self._assert_init()
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- training surface ------------------------------------------------------
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None):
+        self._assert_init()
+        self._strategy = strategy or DistributedStrategy()
+        return CollectiveOptimizer(optimizer, self._strategy, self)
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise RuntimeError("collective fleet has no servers; use the "
+                           "parameter-server fleet for PS mode")
+
+    def stop_worker(self):
+        pass
+
+    def barrier_worker(self):
+        if self.worker_num() > 1:
+            import jax
+            # coordination-service barrier via a tiny collective
+            import jax.numpy as jnp
+            jax.block_until_ready(jnp.zeros(()))
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .. import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor,
+                                       main_program=main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+        return io.save_persistables(executor, dirname,
+                                    main_program=main_program)
+
+
+class CollectiveOptimizer:
+    """ref ``incubate/fleet/collective/__init__.py:140`` CollectiveOptimizer:
+    wraps a regular optimizer; minimize() then rewrites the program with
+    the collective transpiler for multi-process data parallelism."""
+
+    def __init__(self, optimizer, strategy, fleet_: Fleet):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._fleet = fleet_
+
+    def backward(self, *args, **kwargs):
+        return self._optimizer.backward(*args, **kwargs)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        optimize_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        f = self._fleet
+        nranks = f.worker_num()
+        if nranks > 1:
+            eps = f.worker_endpoints()
+            current = eps[f.worker_index()] if f.worker_index() < len(eps) \
+                else eps[0]
+            cls = LocalSGD if (self._strategy.use_local_sgd or
+                               self._strategy.mode == "local_sgd") \
+                else GradAllReduce
+            cls(self._strategy.nccl_comm_num).transpile(
+                startup_program=startup_program,
+                main_program=loss.block.program if hasattr(loss, "block")
+                else None,
+                rank=f.worker_index(), endpoints=",".join(eps),
+                current_endpoint=current)
+        return optimize_ops, params_grads
+
+
+fleet = Fleet()

@@ -1,0 +1,124 @@
+"""Process launcher: ``python -m paddle_tpu.distributed.launch train.py``.
+
+Reference: ``python/paddle/distributed/launch.py:147-281`` — parses the
+cluster env (node ips, per-node device count), spawns one trainer process
+per device with the PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS contract, streams logs,
+and tears the job down if any rank dies.
+
+TPU note: on TPU pods the natural unit is one process per *host* (each
+process owns all local chips; jax.distributed federates hosts), so
+``--nproc_per_node`` defaults to 1.  The rank-0 endpoint doubles as the
+jax.distributed coordinator address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="paddle_tpu distributed launcher "
+                    "(ref python/paddle/distributed/launch.py)")
+    p.add_argument("--cluster_node_ips", default="127.0.0.1",
+                   help="comma-separated node ips")
+    p.add_argument("--node_ip", default="127.0.0.1",
+                   help="this node's ip")
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (1 per TPU host)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_env(args):
+    """Build the per-rank env dicts (ref launch.py start_procs :147)."""
+    node_ips = args.cluster_node_ips.split(",")
+    nnodes = len(node_ips)
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    endpoints = [f"{ip}:{args.started_port + i}"
+                 for ip in node_ips for i in range(nproc)]
+    node_idx = node_ips.index(args.node_ip)
+    envs = []
+    for local in range(nproc):
+        rank = node_idx * nproc + local
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "FLAGS_selected_tpus": str(local),
+            "TRAINING_ROLE": "TRAINER",
+        }
+        envs.append(env)
+    return envs
+
+
+def start_procs(args, envs):
+    """Spawn one training process per local rank (ref launch.py:147)."""
+    procs, logs = [], []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local, env in enumerate(envs):
+        cmd = [sys.executable, "-u", args.training_script] + \
+            args.training_script_args
+        full_env = dict(os.environ, **env)
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    f"worker.{env['PADDLE_TRAINER_ID']}.log"),
+                       "w")
+            logs.append(out)
+        procs.append(subprocess.Popen(cmd, env=full_env, stdout=out,
+                                      stderr=subprocess.STDOUT if out
+                                      else None))
+    return procs, logs
+
+
+def wait_procs(procs):
+    """Wait for all ranks; kill the gang if any rank fails (ref :256)."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    raise SystemExit(
+                        f"rank process {p.pid} exited with {ret}")
+            if not alive:
+                return
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        raise
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    envs = get_cluster_env(args)
+    procs, logs = start_procs(args, envs)
+    try:
+        wait_procs(procs)
+    finally:
+        for f in logs:
+            f.close()
+
+
+if __name__ == "__main__":
+    launch()

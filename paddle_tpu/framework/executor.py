@@ -39,13 +39,17 @@ class LowerCtx:
 
     is_abstract = False
 
-    def __init__(self, seed, mesh=None, is_startup=False, amp=False):
+    def __init__(self, seed, mesh=None, is_startup=False, amp=False,
+                 collective_axis=None):
         self._seed = seed
         self._key = None  # derived lazily: most ops never need RNG
         self._counter = 0
         self.mesh = mesh
         self.is_startup = is_startup
         self.amp = amp
+        # set when the block runs under collective shard_map mode: the mesh
+        # axis (or ring_id->axis map) the c_* collective ops reduce over
+        self.collective_axis = collective_axis
 
     def rng(self):
         if self._key is None:
@@ -146,16 +150,21 @@ class _CompiledBlock:
     def __init__(self, program: Program, block_idx: int,
                  feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
                  persist_ro: Tuple[str, ...], persist_rw: Tuple[str, ...],
-                 mesh=None, in_shardings=None, donate=True):
+                 mesh=None, in_shardings=None, donate=True,
+                 collective=None, feed_ndims=None):
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.persist_ro = persist_ro
         self.persist_rw = persist_rw
+        self.collective_nranks = None
         block = program.blocks[block_idx]
         amp_on = bool(program._attrs.get("amp", False))
 
+        collective_axis = "dp" if collective else None
+
         def step(feeds, ro, rw, seed):
-            ctx = LowerCtx(seed, mesh=mesh, amp=amp_on)
+            ctx = LowerCtx(seed, mesh=mesh, amp=amp_on,
+                           collective_axis=collective_axis)
             values = {}
             values.update(dict(zip(persist_ro, ro)))
             values.update(dict(zip(persist_rw, rw)))
@@ -165,6 +174,74 @@ class _CompiledBlock:
             fetches = [state.values[n] for n in fetch_names]
             new_rw = [state.values[n] for n in persist_rw]
             return fetches, new_rw
+
+        if collective:
+            # Collective (multi-process DP) mode — ref §3.3: the whole block
+            # becomes one shard_map over the dp axis: per-device compute with
+            # explicit c_* collectives, batch feeds sharded on dim 0, params
+            # replicated.  Fetches come back stacked per-rank (the reference
+            # ParallelExecutor also returns per-device fetch values).
+            from jax import lax
+            from jax.sharding import Mesh, PartitionSpec as P
+            try:
+                from jax import shard_map
+            except ImportError:  # pragma: no cover
+                from jax.experimental.shard_map import shard_map
+            nranks = int(collective.get("nranks", 0)) or len(jax.devices())
+            devs = jax.devices()
+            if nranks > len(devs):
+                raise ValueError(
+                    f"collective mode needs {nranks} devices, have "
+                    f"{len(devs)}")
+            self.collective_nranks = nranks
+            cmesh = Mesh(np.array(devs[:nranks]), ("dp",))
+            # trainable params stay replicated by construction (psum'd
+            # grads); other persistables (BN running stats — non-trainable
+            # params, metric states) see per-rank batch shards and would
+            # diverge — average them across ranks (ints: pmax, they advance
+            # identically e.g. step counters)
+            def _synced_by_grads(n):
+                if not block.has_var(n):
+                    return False
+                v = block.var(n)
+                return getattr(v, "is_parameter", False) and \
+                    getattr(v, "trainable", True)
+            rw_is_param = [_synced_by_grads(n) for n in persist_rw]
+
+            def sharded_step(feeds, ro, rw, seed):
+                # per-rank RNG stream (reference multi-process trainers have
+                # independent seeds) — fold in the rank
+                seed = seed + lax.axis_index("dp").astype(
+                    jnp.uint32) * jnp.uint32(1000003)
+                fetches, new_rw = step(feeds, ro, rw, seed)
+                synced_rw = []
+                for v, is_p in zip(new_rw, rw_is_param):
+                    if is_p:
+                        synced_rw.append(v)
+                    elif jnp.issubdtype(v.dtype, jnp.floating):
+                        synced_rw.append(lax.pmean(v, "dp"))
+                    else:
+                        synced_rw.append(lax.pmax(v, "dp"))
+                return [f[None] for f in fetches], synced_rw
+
+            # scalar feeds replicate; batched feeds shard on dim 0
+            fspecs = [P("dp") if nd >= 1 else P()
+                      for nd in (feed_ndims or [1] * len(feed_names))]
+            sm_kwargs = dict(
+                mesh=cmesh,
+                in_specs=(fspecs, [P()] * len(persist_ro),
+                          [P()] * len(persist_rw), P()),
+                out_specs=([P("dp")] * len(fetch_names),
+                           [P()] * len(persist_rw)))
+            try:
+                inner = shard_map(sharded_step, check_vma=False, **sm_kwargs)
+            except TypeError:  # older jax: the kwarg is check_rep
+                inner = shard_map(sharded_step, check_rep=False, **sm_kwargs)
+            jkw = {}
+            if donate and persist_rw:
+                jkw["donate_argnums"] = (2,)
+            self.jitted = jax.jit(inner, **jkw)
+            return
 
         kwargs = {}
         if donate and persist_rw:
@@ -248,10 +325,12 @@ class Executor:
         feed_names = tuple(sorted(feed))
 
         block = program.global_block()
+        collective = program._attrs.get("collective")
         key = (program.fingerprint(), feed_names,
                tuple((np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
                      for n in feed_names),
-               fetch_names, id(scope), id(mesh))
+               fetch_names, id(scope), id(mesh),
+               tuple(sorted(collective.items())) if collective else None)
         with self._lock:
             cb = self._cache.get(key)
             if cb is None:
@@ -260,9 +339,12 @@ class Executor:
                 shardings = None
                 if in_shardings is not None:
                     shardings = in_shardings(feed_names, ro, rw)
-                cb = _CompiledBlock(program, 0, feed_names, fetch_names,
-                                    tuple(ro), tuple(rw), mesh=mesh,
-                                    in_shardings=shardings)
+                cb = _CompiledBlock(
+                    program, 0, feed_names, fetch_names,
+                    tuple(ro), tuple(rw), mesh=mesh,
+                    in_shardings=shardings, collective=collective,
+                    feed_ndims=tuple(np.asarray(feed[n]).ndim
+                                     for n in feed_names))
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
                 self._cache[key] = cb
 
