@@ -200,10 +200,8 @@ class Fleet:
 
     def barrier_worker(self):
         if self.worker_num() > 1:
-            import jax
-            # coordination-service barrier via a tiny collective
-            import jax.numpy as jnp
-            jax.block_until_ready(jnp.zeros(()))
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_barrier_worker")
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
                              target_vars, main_program=None,

@@ -3,20 +3,33 @@
 
 from . import control_flow, detection, io, learning_rate_scheduler  # noqa
 from . import math_ops, metric_op, nn, sequence, tensor  # noqa
-from .control_flow import (While, equal, greater_equal, greater_than,  # noqa
-                           increment, is_empty, less_equal, less_than,
-                           not_equal)
+from .control_flow import (Switch, While, array_length, array_read,  # noqa
+                           array_write, create_array, equal,
+                           greater_equal, greater_than, increment,
+                           is_empty, less_equal, less_than, not_equal)
+from .detection import (box_clip, box_coder, detection_output,  # noqa
+                        iou_similarity, multiclass_nms, prior_box,
+                        yolo_box)
 from .io import data  # noqa
+from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa
+                                      inverse_time_decay, linear_lr_warmup,
+                                      natural_exp_decay, noam_decay,
+                                      piecewise_decay, polynomial_decay)
 from .math_ops import scale  # noqa
 from .metric_op import accuracy, auc  # noqa
 from .nn import *  # noqa
-from .sequence import (sequence_concat, sequence_expand, sequence_first_step,  # noqa
-                       sequence_last_step, sequence_mask, sequence_pad,
-                       sequence_pool, sequence_reverse, sequence_softmax,
-                       sequence_unpad)
+from .sequence import (sequence_concat, sequence_enumerate,  # noqa
+                       sequence_expand, sequence_expand_as,
+                       sequence_first_step, sequence_last_step,
+                       sequence_mask, sequence_pad, sequence_pool,
+                       sequence_reshape, sequence_reverse,
+                       sequence_slice, sequence_softmax, sequence_unpad)
 from .tensor import (argmax, argmin, argsort, assign, cast, concat,  # noqa
                      create_global_var, create_parameter, create_tensor,
                      diag, eye, fill_constant,
                      fill_constant_batch_size_like, has_inf, has_nan,
                      isfinite, linspace, ones, ones_like, range, reverse,
-                     sums, zeros, zeros_like)
+                     sums, tensor_array_to_tensor, zeros, zeros_like)
+
+sum = sums  # fluid exports `sum` (ref layers/nn.py __all__)
+topk = nn.topk

@@ -1133,3 +1133,270 @@ def ring_attention(q, k, v, causal=False, sm_scale=None, axis_name="sp",
                      attrs={"causal": causal, "sm_scale": sm_scale or 0.0,
                             "axis_name": axis_name})
     return out
+
+
+# ---------------------------------------------------------------------------
+# similarity / losses / misc wrappers (ref layers/nn.py assorted exports)
+# ---------------------------------------------------------------------------
+
+def cos_sim(X, Y):
+    """ref layers/nn.py cos_sim → cos_sim op."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xnorm],
+                              "YNorm": [ynorm]})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss (ref bpr_loss_op.cc)."""
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("bpr_loss", inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """ref layers/nn.py center_loss → center_loss op w/ centers parameter."""
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    dtype = input.dtype
+    from ..param_attr import ParamAttr
+    if param_attr is None:
+        # centers are updated by the op itself, not by the optimizer
+        param_attr = ParamAttr(trainable=False)
+    centers = helper.create_parameter(param_attr,
+                                      shape=[num_classes, input.shape[1]],
+                                      dtype=dtype,
+                                      default_initializer=ConstantInitializer(0.0))
+    centers.stop_gradient = True
+    from .tensor import fill_constant
+    lr = fill_constant(shape=[1], dtype=dtype, value=float(alpha))
+    loss = helper.create_variable_for_type_inference(dtype)
+    sample_centers = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [lr]},
+        outputs={"Loss": [loss], "SampleCenterDiff": [sample_centers],
+                 "CentersOut": [centers]},
+        attrs={"cluster_num": num_classes, "need_update": update_center})
+    return loss
+
+
+def multiplex(inputs, index):
+    """Row-wise select across candidate tensors (ref multiplex_op.cc)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex", inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def where(condition):
+    """Indices of true elements, padded to static shape (ref where_op /
+    where_index)."""
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("where", inputs={"Condition": [condition]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (ref crop_op.cc); shape/offsets are python lists."""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("crop", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape or x.shape),
+                            "offsets": list(offsets or [0] * len(x.shape))})
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """ref crop_tensor_op.cc — static-shape variant under XLA."""
+    helper = LayerHelper("crop_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("crop_tensor", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape or []),
+                            "offsets": list(offsets or [0] * len(x.shape))})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """ref random_crop_op.cc — crop trailing dims to `shape` at random."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """ref mean_iou_op.cc: per-batch mean IoU + per-class wrong/correct."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32", True)
+    wrong = helper.create_variable_for_type_inference("int32", True)
+    correct = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def unique(x, dtype="int32"):
+    """ref unique_op.cc (padded to static size under XLA)."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    index = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    index = helper.create_variable_for_type_inference(dtype, True)
+    count = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count]})
+    return out, index, count
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """ref shard_index_op.cc — map global ids to shard-local ids."""
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("shard_index", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"index_num": index_num, "nshards": nshards,
+                            "shard_id": shard_id,
+                            "ignore_value": ignore_value})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op("pad_constant_like", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """ref layers/nn.py scatter_nd — scatter_nd_add onto zeros."""
+    helper = LayerHelper("scatter_nd", name=name)
+    out = helper.create_variable_for_type_inference(updates.dtype)
+    helper.append_op("scatter_nd",
+                     inputs={"Index": [index], "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={"shape": list(shape)})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """ref hash_op.cc — num_hash hashed id columns mod hash_size."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("hash", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """ref add_position_encoding_op.cc — sinusoidal position encoding."""
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (ref fsp_op.cc)."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": float(soft_max_up_bound),
+                            "soft_max_lower_bound": float(soft_max_lower_bound)})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution (ref tree_conv_op.cc)."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[2]
+    w = helper.create_parameter(param_attr,
+                                shape=[feature_size, 3, output_size,
+                                       num_filters],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("tree_conv",
+                     inputs={"NodesVector": [nodes_vector],
+                             "EdgeSet": [edge_set], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"max_depth": max_depth})
+    if bias_attr:
+        out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (ref row_conv_op.cc)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("row_conv", inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)

@@ -3,6 +3,6 @@ op's JAX lowering (the TPU stand-in for the reference's static
 REGISTER_OPERATOR initializers)."""
 
 from . import (attention_ops, control_flow_ops, math_ops, metrics_ops,  # noqa
-               nn_ops, optimizer_ops, reduce_ops, rnn_ops, sequence_ops,
-               tensor_ops)
+               misc_ops, nn_ops, optimizer_ops, reduce_ops, rnn_ops,
+               sequence_ops, tensor_ops)
 from ..framework.registry import registered_ops  # noqa

@@ -1,0 +1,161 @@
+"""Tests for the wrapper/misc layer surface added for parity with
+``fluid.layers`` (ref tests/unittests/test_layers.py style: build + run +
+numeric check vs numpy)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+
+
+def _run(fetch, feed):
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feed, fetch_list=list(fetch))
+
+
+def test_cos_sim():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[8], dtype="float32")
+    out = layers.cos_sim(x, y)
+    xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    yv = np.random.RandomState(1).rand(4, 8).astype(np.float32)
+    got, = _run([out], {"x": xv, "y": yv})
+    ref = (xv * yv).sum(-1) / (np.linalg.norm(xv, axis=-1)
+                               * np.linalg.norm(yv, axis=-1))
+    np.testing.assert_allclose(got.ravel(), ref, rtol=1e-5)
+
+
+def test_multiplex():
+    a = layers.data("a", shape=[3], dtype="float32")
+    b = layers.data("b", shape=[3], dtype="float32")
+    idx = layers.data("idx", shape=[1], dtype="int32")
+    out = layers.multiplex([a, b], idx)
+    av = np.zeros((4, 3), np.float32)
+    bv = np.ones((4, 3), np.float32)
+    iv = np.array([[0], [1], [1], [0]], np.int32)
+    got, = _run([out], {"a": av, "b": bv, "idx": iv})
+    np.testing.assert_allclose(got[:, 0], [0, 1, 1, 0])
+
+
+def test_scatter_nd_and_where():
+    idx = layers.data("idx", shape=[2], dtype="int32")
+    upd = layers.data("upd", shape=[], dtype="float32")
+    out = layers.scatter_nd(idx, upd, shape=[3, 4])
+    iv = np.array([[0, 1], [2, 3], [0, 1]], np.int32)
+    uv = np.array([1.0, 2.0, 3.0], np.float32)
+    got, = _run([out], {"idx": iv, "upd": uv})
+    assert got[0, 1] == 4.0 and got[2, 3] == 2.0
+
+
+def test_hash_deterministic_and_bounded():
+    x = layers.data("x", shape=[2], dtype="int64")
+    out = layers.hash(x, hash_size=100, num_hash=3)
+    xv = np.array([[1, 2], [3, 4], [1, 2]], np.int64)
+    got, = _run([out], {"x": xv})
+    assert got.shape == (3, 3, 1)
+    assert (got >= 0).all() and (got < 100).all()
+    np.testing.assert_array_equal(got[0], got[2])
+    assert not np.array_equal(got[0], got[1])
+
+
+def test_add_position_encoding():
+    x = layers.data("x", shape=[6, 8], dtype="float32")
+    out = layers.add_position_encoding(x, alpha=1.0, beta=1.0)
+    xv = np.zeros((2, 6, 8), np.float32)
+    got, = _run([out], {"x": xv})
+    # position 0: sin(0)=0, cos(0)=1
+    np.testing.assert_allclose(got[0, 0, :4], 0.0, atol=1e-6)
+    np.testing.assert_allclose(got[0, 0, 4:], 1.0, atol=1e-6)
+
+
+def test_fsp_matrix():
+    x = layers.data("x", shape=[2, 4, 5], dtype="float32")
+    y = layers.data("y", shape=[3, 4, 5], dtype="float32")
+    out = layers.fsp_matrix(x, y)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 2, 4, 5).astype(np.float32)
+    yv = rng.rand(2, 3, 4, 5).astype(np.float32)
+    got, = _run([out], {"x": xv, "y": yv})
+    ref = np.einsum("bik,bjk->bij", xv.reshape(2, 2, 20),
+                    yv.reshape(2, 3, 20)) / 20.0
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_unique_with_counts():
+    x = layers.data("x", shape=[], dtype="int64")
+    out, index, count = layers.unique_with_counts(x)
+    got = _run([out, index, count], {"x": np.array([2, 3, 3, 1, 5, 3],
+                                                   np.int64)})
+    u, idx, cnt = got
+    # padded to static size; first unique entries must match numpy
+    ref_u, ref_cnt = np.unique([2, 3, 3, 1, 5, 3], return_counts=True)
+    np.testing.assert_array_equal(np.sort(u[:4]), ref_u)
+
+
+def test_shard_index():
+    x = layers.data("x", shape=[1], dtype="int64")
+    out = layers.shard_index(x, index_num=20, nshards=2, shard_id=0)
+    xv = np.array([[1], [6], [12], [19]], np.int64)
+    got, = _run([out], {"x": xv})
+    np.testing.assert_array_equal(got.ravel(), [1, 6, -1, -1])
+
+
+def test_center_loss_trains():
+    x = layers.data("x", shape=[4], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    loss = layers.center_loss(x, label, num_classes=3, alpha=0.1)
+    avg = layers.mean(loss)
+    xv = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+    lv = np.random.RandomState(1).randint(0, 3, (6, 1)).astype(np.int64)
+    got, = _run([avg], {"x": xv, "label": lv})
+    assert np.isfinite(got).all()
+
+
+def test_row_conv():
+    x = layers.data("x", shape=[5, 6], dtype="float32")
+    out = layers.row_conv(x, future_context_size=2)
+    xv = np.random.RandomState(0).rand(3, 5, 6).astype(np.float32)
+    got, = _run([out], {"x": xv})
+    assert got.shape == (3, 5, 6)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = layers.data("x", shape=[1], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="float32")
+    out = layers.teacher_student_sigmoid_loss(x, label)
+    xv = np.array([[0.5], [-1.0]], np.float32)
+    lv = np.array([[1.0], [0.0]], np.float32)
+    got, = _run([out], {"x": xv, "label": lv})
+    assert np.isfinite(got).all() and (got >= 0).all()
+
+
+def test_tree_conv():
+    nodes = layers.data("nodes", shape=[5, 4], dtype="float32")
+    edges = layers.data("edges", shape=[4, 2], dtype="int32")
+    out = layers.tree_conv(nodes, edges, output_size=6, num_filters=2)
+    nv = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+    # tree: node1 -> children 2,3; node2 -> child 4 (1-based, 0 pad)
+    ev = np.tile(np.array([[1, 2], [1, 3], [2, 4], [0, 0]], np.int32),
+                 (2, 1, 1))
+    got, = _run([out], {"nodes": nv, "edges": ev})
+    assert got.shape == (2, 5, 6, 2)
+    assert np.isfinite(got).all()
+
+
+def test_lr_schedulers_exported():
+    for n in ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
+              "polynomial_decay", "piecewise_decay", "noam_decay",
+              "cosine_decay", "linear_lr_warmup"]:
+        assert hasattr(layers, n)
+
+
+def test_mean_iou():
+    pred = layers.data("pred", shape=[4], dtype="int32")
+    label = layers.data("label", shape=[4], dtype="int32")
+    miou, wrong, correct = layers.mean_iou(pred, label, num_classes=3)
+    pv = np.array([[0, 1, 2, 1]], np.int32)
+    lv = np.array([[0, 1, 1, 1]], np.int32)
+    got, = _run([miou], {"pred": pv, "label": lv})
+    assert 0.0 <= float(got.ravel()[0]) <= 1.0
