@@ -82,7 +82,7 @@ def registered_ops() -> List[str]:
 # build-time shape/dtype inference (ref framework/operator.cc:913 InferShape)
 # ---------------------------------------------------------------------------
 
-_NO_INFER = {"feed", "fetch", "while", "conditional_block", "py_func"}
+_NO_INFER = {"feed", "fetch", "while", "conditional_block"}
 
 
 class _AbstractCtx:

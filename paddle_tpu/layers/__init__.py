@@ -3,7 +3,8 @@
 
 from . import control_flow, detection, io, learning_rate_scheduler  # noqa
 from . import math_ops, metric_op, nn, sequence, tensor  # noqa
-from .control_flow import (Switch, While, array_length, array_read,  # noqa
+from .control_flow import (DynamicRNN, IfElse, Print, StaticRNN,  # noqa
+                           Switch, While, array_length, array_read,
                            array_write, create_array, equal,
                            greater_equal, greater_than, increment,
                            is_empty, less_equal, less_than, not_equal)

@@ -1211,8 +1211,13 @@ def crop(x, shape=None, offsets=None, name=None):
     """Static crop (ref crop_op.cc); shape/offsets are python lists."""
     helper = LayerHelper("crop", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
+    if shape is None:
+        # the build-time batch dim is -1; "crop to own shape" = identity crop
+        shape = [s for s in x.shape]
+    shape = [x.shape[i] if s == -1 and i > 0 else s
+             for i, s in enumerate(shape)]
     helper.append_op("crop", inputs={"X": [x]}, outputs={"Out": [out]},
-                     attrs={"shape": list(shape or x.shape),
+                     attrs={"shape": list(shape),
                             "offsets": list(offsets or [0] * len(x.shape))})
     return out
 
@@ -1369,7 +1374,7 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
                              "EdgeSet": [edge_set], "Filter": [w]},
                      outputs={"Out": [out]},
                      attrs={"max_depth": max_depth})
-    if bias_attr:
+    if bias_attr is not False:
         out = helper.append_bias_op(out, dim_start=2)
     return helper.append_activation(out)
 
@@ -1400,3 +1405,25 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     helper.append_op("row_conv", inputs={"X": [input], "Filter": [w]},
                      outputs={"Out": [out]})
     return helper.append_activation(out)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op via jax.pure_callback (ref py_func_op.cc).
+
+    ``out`` vars must be pre-created with concrete shapes
+    (``create_variable`` style); a leading -1 is bound to the batch size at
+    trace time.  ``backward_func(*x, *out, *out_grads) -> x_grads`` enables
+    reverse-mode through the callback.
+    """
+    from ..ops.control_flow_ops import PY_FUNC_TABLE
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fid = len(PY_FUNC_TABLE)
+    PY_FUNC_TABLE[fid] = {"forward": func, "backward": backward_func}
+    helper.append_op("py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)},
+                     attrs={"func_id": fid,
+                            "out_shapes": [list(o.shape) for o in outs],
+                            "out_dtypes": [o.dtype for o in outs]})
+    return out

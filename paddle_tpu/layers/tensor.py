@@ -98,11 +98,13 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
     helper = LayerHelper("fill_constant_batch_size_like")
     out = helper.create_variable_for_type_inference(convert_dtype(dtype))
     out.stop_gradient = True
-    shape = list(shape)
-    shape[output_dim_idx] = input.shape[input_dim_idx]
-    helper.append_op("fill_constant", outputs={"Out": [out]},
-                     attrs={"shape": shape, "dtype": convert_dtype(dtype),
-                            "value": float(value)})
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "dtype": convert_dtype(dtype),
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
     return out
 
 
@@ -245,7 +247,15 @@ def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
     return out
 
 
-def tensor_array_to_tensor(input, axis=1, name=None):
-    raise NotImplementedError(
-        "tensor_array_to_tensor: TensorArray lowers to lax.scan stacking; "
-        "use layers.stack on a Python list of Variables instead")
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """ref tensor_array_to_tensor_op.cc — stack/concat the dense array
+    buffer (rows past the written length are zero-padding; mask by
+    array_length as with any padded batch)."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_index = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("tensor_array_to_tensor",
+                     inputs={"Array": [input]},
+                     outputs={"Out": [out], "OutIndex": [out_index]},
+                     attrs={"axis": axis, "use_stack": use_stack})
+    return out, out_index

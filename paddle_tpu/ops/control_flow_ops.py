@@ -135,3 +135,240 @@ def _print(ctx, ins, attrs):
     x = X(ins, "In")
     jax.debug.print(attrs.get("message", "") + "{}", x)
     return {"Out": [x]}
+
+
+# ---------------------------------------------------------------------------
+# TensorArray ops — dense-buffer replacement for LoDTensorArray
+# (ref operators/controlflow/tensor_array_read_write.cc; under XLA the
+# array is a pre-sized [max_len, ...] buffer + a length scalar, functionally
+# updated — carried through while_loop/scan like any other var)
+# ---------------------------------------------------------------------------
+
+@register_op("array_write")
+def _array_write(ctx, ins, attrs):
+    from .common import X
+    x = X(ins, "X")
+    i = jnp.reshape(X(ins, "I"), ()).astype(jnp.int32)
+    arr = X(ins, "Array")
+    ln = X(ins, "ArrayLen")
+    if arr is None:
+        arr = jnp.zeros((attrs.get("max_len", 128),) + x.shape, x.dtype)
+        ln = jnp.zeros((), jnp.int32)
+    arr = jax.lax.dynamic_update_slice(arr, x[None].astype(arr.dtype),
+                                       (i,) + (0,) * x.ndim)
+    ln = jnp.maximum(ln.astype(jnp.int32), i + 1)
+    return {"Out": [arr], "OutLen": [ln]}
+
+
+@register_op("array_read")
+def _array_read(ctx, ins, attrs):
+    from .common import X
+    arr = X(ins, "Array")
+    i = jnp.reshape(X(ins, "I"), ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr, i, keepdims=False)]}
+
+
+@register_op("array_length", no_grad=True)
+def _array_length(ctx, ins, attrs):
+    from .common import X
+    return {"Out": [X(ins, "ArrayLen").astype(jnp.int64)]}
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """Stack/concat the first `len` rows of the buffer (static max_len; rows
+    past the length are zero — callers mask by length as with any padded
+    batch)."""
+    from .common import X
+    arr = X(ins, "Array")
+    axis = attrs.get("axis", 0)
+    if attrs.get("use_stack", True):
+        out = jnp.moveaxis(arr, 0, axis) if axis else arr
+    else:
+        out = jnp.concatenate([arr[i] for i in range(arr.shape[0])],
+                              axis=axis)
+    index = jnp.full((arr.shape[0],), arr.shape[1] if arr.ndim > 1 else 1,
+                     jnp.int32)
+    return {"Out": [out], "OutIndex": [index]}
+
+
+# ---------------------------------------------------------------------------
+# py_func — host-python escape hatch (ref operators/py_func_op.cc) via
+# jax.pure_callback; optional backward_func via custom_vjp
+# ---------------------------------------------------------------------------
+
+PY_FUNC_TABLE = {}
+
+
+@register_op("py_func")
+def _py_func(ctx, ins, attrs):
+    import numpy as np
+    from .common import XS
+    entry = PY_FUNC_TABLE[attrs["func_id"]]
+    fwd, bwd = entry["forward"], entry.get("backward")
+    xs = XS(ins, "X")
+    out_specs = []
+    for shape, dtype in zip(attrs["out_shapes"], attrs["out_dtypes"]):
+        shape = tuple(xs[0].shape[0] if s == -1 else s for s in shape)
+        out_specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)))
+
+    def host_fwd(*arrs):
+        outs = fwd(*[np.asarray(a) for a in arrs])
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return tuple(np.asarray(o, dtype=s.dtype).reshape(s.shape)
+                     for o, s in zip(outs, out_specs))
+
+    if bwd is None:
+        outs = jax.pure_callback(host_fwd, tuple(out_specs), *xs)
+    else:
+        @jax.custom_vjp
+        def f(*a):
+            return jax.pure_callback(host_fwd, tuple(out_specs), *a)
+
+        def f_fwd(*a):
+            o = jax.pure_callback(host_fwd, tuple(out_specs), *a)
+            return o, (a, o)
+
+        def f_bwd(res, g):
+            a, o = res
+            in_specs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                             for x in a)
+
+            def host_bwd(*args):
+                na = len(a)
+                xs_, outs_, gs_ = (args[:na], args[na:na + len(o)],
+                                   args[na + len(o):])
+                grads = bwd(*[np.asarray(v) for v in (*xs_, *outs_, *gs_)])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                return tuple(np.asarray(gr, dtype=s.dtype).reshape(s.shape)
+                             for gr, s in zip(grads, in_specs))
+
+            return jax.pure_callback(host_bwd, in_specs, *a, *o, *g)
+
+        f.defvjp(f_fwd, f_bwd)
+        outs = f(*xs)
+    return {"Out": list(outs)}
+
+
+@register_op("ifelse_merge")
+def _ifelse_merge(ctx, ins, attrs):
+    """Row-wise merge of IfElse branch outputs by bool cond [batch, 1]."""
+    from .common import X
+    cond, x, y = X(ins, "Cond"), X(ins, "X"), X(ins, "Y")
+    c = cond.reshape(cond.shape[0], *([1] * (x.ndim - 1))).astype(bool)
+    return {"Out": [jnp.where(c, x, y)]}
+
+
+@register_op("drnn_iota", no_grad=True)
+def _drnn_iota(ctx, ins, attrs):
+    """[batch, T] -> row-wise arange(T); scanned batch-major it yields the
+    per-step time index vector for DynamicRNN masking."""
+    from .common import X
+    x = X(ins, "X")
+    return {"Out": [jnp.broadcast_to(jnp.arange(x.shape[1], dtype=jnp.int32),
+                                     x.shape)]}
+
+
+@register_op("drnn_masked_update")
+def _drnn_masked_update(ctx, ins, attrs):
+    """new where t < seq_len else prev — freezes finished rows' state."""
+    from .common import X
+    t, sl = X(ins, "T"), X(ins, "SeqLen")
+    new, prev = X(ins, "New"), X(ins, "Prev")
+    mask = (t.astype(jnp.int32) < sl.astype(jnp.int32))
+    mask = mask.reshape(mask.shape[0], *([1] * (new.ndim - 1)))
+    return {"Out": [jnp.where(mask, new, prev)]}
+
+
+# ---------------------------------------------------------------------------
+# static_scan gradient: re-build the scan under jax.vjp w.r.t. the scanned
+# inputs, the initial states, and the captured Params (ref
+# operators/recurrent_op.cc RecurrentGradOp replaying step scopes in
+# reverse — here lax.scan's own transpose rule does the replay)
+# ---------------------------------------------------------------------------
+
+def _static_scan_grad_maker(op, block, no_grad_set):
+    from ..framework.core import grad_var_name
+
+    def outs_for(names):
+        res = []
+        for n in names:
+            v = block.var(n) if block.has_var(n) else None
+            if n in no_grad_set or (v is not None and v.stop_gradient):
+                res.append("")
+            else:
+                res.append(grad_var_name(n))
+        return res
+
+    g_inputs = {
+        "X": list(op.input("X")),
+        "Init": list(op.input("Init")),
+        "Params": list(op.input("Params")),
+        "OutGrad": [grad_var_name(n) for n in op.output("Out")],
+        "FinalGrad": [grad_var_name(n) for n in op.output("FinalStates")],
+    }
+    g_outputs = {
+        "XGrad": outs_for(op.input("X")),
+        "InitGrad": outs_for(op.input("Init")),
+        "ParamsGrad": outs_for(op.input("Params")),
+    }
+    return [{"type": "static_scan_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": dict(op.attrs)}]
+
+
+from ..framework.registry import _REGISTRY  # noqa: E402
+_REGISTRY["static_scan"].grad_maker = _static_scan_grad_maker
+
+
+@register_op("static_scan_grad", raw=True)
+def _static_scan_grad(ctx, block, op, state):
+    sub_block = op.attrs["sub_block"]
+    state_names = op.attrs["state_vars"]
+    xs_names = op.attrs["step_input_vars"]
+    out_step_names = op.attrs["step_output_vars"]
+    time_major = op.attrs.get("time_major", False)
+    reverse = op.attrs.get("reverse", False)
+    param_names = op.input("Params")
+    seq_vals = tuple(state.read(block, n) for n in op.input("X"))
+    init_vals = tuple(state.read(block, n) for n in op.input("Init"))
+    param_vals = tuple(state.read(block, n) for n in param_names)
+    consts = {n: v for n, v in state.values.items()
+              if n not in state_names and n not in xs_names}
+
+    def run(seqs, inits, params):
+        env_base = dict(consts)
+        env_base.update(zip(param_names, params))
+
+        def body(carry, xs):
+            env = dict(env_base)
+            env.update(zip(state_names, carry))
+            env.update(zip(xs_names, xs))
+            env = _trace_subblock(ctx, sub_block, env)
+            return (tuple(env[n] for n in state_names),
+                    tuple(env[n] for n in out_step_names))
+
+        xs = tuple(s if time_major else jnp.swapaxes(s, 0, 1) for s in seqs)
+        final, stacked = jax.lax.scan(body, inits, xs, reverse=reverse)
+        stacked = tuple(v if time_major else jnp.swapaxes(v, 0, 1)
+                        for v in stacked)
+        return final, stacked
+
+    (final, stacked), vjp = jax.vjp(run, seq_vals, init_vals, param_vals)
+
+    def cot(gname, primal):
+        g = state.values.get(gname)
+        if g is None:
+            return jnp.zeros(primal.shape, primal.dtype)
+        return g.astype(primal.dtype)
+
+    og_final = tuple(cot(n, v) for n, v in zip(op.input("FinalGrad"), final))
+    og_out = tuple(cot(n, v) for n, v in zip(op.input("OutGrad"), stacked))
+    gx, ginit, gparams = vjp((og_final, og_out))
+    for n, v in zip(op.output("XGrad"), gx):
+        state.write(n, v)
+    for n, v in zip(op.output("InitGrad"), ginit):
+        state.write(n, v)
+    for n, v in zip(op.output("ParamsGrad"), gparams):
+        state.write(n, v)

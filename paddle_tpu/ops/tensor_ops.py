@@ -372,7 +372,9 @@ def _crop(ctx, ins, attrs):
     x = X(ins, "X")
     offsets = attrs.get("offsets")
     shape = attrs.get("shape")
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 (symbolic batch at build time) = rest of the dim from the offset
+    idx = tuple(slice(o, xs if s == -1 else o + s)
+                for o, s, xs in zip(offsets, shape, x.shape))
     return {"Out": [x[idx]]}
 
 
@@ -530,3 +532,16 @@ def _shard_index(ctx, ins, attrs):
     shard_size = (index_num + nshards - 1) // nshards
     in_shard = (x // shard_size) == shard_id
     return {"Out": [jnp.where(in_shard, x % shard_size, ignore)]}
+
+
+@register_op("fill_constant_batch_size_like", no_grad=True)
+def _fill_constant_batch_size_like(ctx, ins, attrs):
+    """ref fill_constant_batch_size_like_op.cc — the batch dim is read off
+    the reference input AT TRACE TIME (the var's build-time shape is -1)."""
+    from .common import X
+    ref = X(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0),
+                             dtype=jnp.dtype(attrs["dtype"]))]}

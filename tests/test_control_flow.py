@@ -1,0 +1,174 @@
+"""Control-flow construct tests (ref tests/unittests/test_while_op.py,
+test_array_read_write.py, test_switch.py, test_ifelse.py,
+test_static_rnn / test_dynrnn_* families)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor, append_backward
+
+
+def _run(fetch, feed=None):
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=list(fetch))
+
+
+def test_array_write_read_length():
+    x = layers.data("x", shape=[3], dtype="float32")
+    arr = layers.create_array("float32", max_len=8)
+    i0 = layers.fill_constant([1], "int64", 0)
+    i1 = layers.fill_constant([1], "int64", 1)
+    layers.array_write(x, i0, arr)
+    two = layers.scale(x, scale=2.0)
+    layers.array_write(two, i1, arr)
+    r0 = layers.array_read(arr, i0)
+    r1 = layers.array_read(arr, i1)
+    ln = layers.array_length(arr)
+    xv = np.ones((2, 3), np.float32)
+    g0, g1, gl = _run([r0, r1, ln], {"x": xv})
+    np.testing.assert_allclose(g0, xv)
+    np.testing.assert_allclose(g1, 2 * xv)
+    assert int(gl) == 2
+
+
+def test_while_with_array_accumulation():
+    i = layers.fill_constant([1], "int64", 0)
+    limit = layers.fill_constant([1], "int64", 5)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        acc2 = layers.elementwise_add(
+            acc, layers.fill_constant([1], "float32", 1.0))
+        layers.assign(acc2, acc)
+        layers.increment(i, value=1, in_place=True)
+        layers.less_than(i, limit, cond=cond)
+    got, = _run([acc])
+    assert float(got.ravel()[0]) == 5.0
+
+
+def test_switch_lr_pattern():
+    step = layers.fill_constant([1], "float32", 7.0)
+    lr = layers.create_global_var(shape=[1], value=0.0, dtype="float32",
+                                  persistable=True, name="lr_sw")
+    b1 = layers.fill_constant([1], "float32", 5.0)
+    b2 = layers.fill_constant([1], "float32", 10.0)
+    sw = layers.Switch()
+    with sw.case(layers.less_than(step, b1)):
+        layers.assign(layers.fill_constant([1], "float32", 1.0), lr)
+    with sw.case(layers.less_than(step, b2)):
+        layers.assign(layers.fill_constant([1], "float32", 0.5), lr)
+    with sw.default():
+        layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+    got, = _run([lr])
+    assert float(got.ravel()[0]) == 0.5
+
+
+def test_ifelse_rowwise():
+    x = layers.data("x", shape=[1], dtype="float32")
+    zero = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.greater_than(x, zero)
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(ie.input(x), scale=2.0))
+    with ie.false_block():
+        ie.output(layers.scale(ie.input(x), scale=-1.0))
+    out = ie()
+    xv = np.array([[1.0], [-2.0], [3.0]], np.float32)
+    got, = _run([out], {"x": xv})
+    np.testing.assert_allclose(got.ravel(), [2.0, 2.0, 6.0])
+
+
+def test_static_rnn_sum():
+    # time-major input [T, B, D]; rnn accumulates sum over time
+    x = layers.data("x", shape=[3, 4, 2], dtype="float32",
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)                       # [4, 2]
+        mem = rnn.memory(shape=[4, 2], dtype="float32", value=0.0)
+        s = layers.elementwise_add(mem, xt)
+        rnn.update_memory(mem, s)
+        rnn.step_output(s)
+    out = rnn()
+    xv = np.ones((3, 4, 2), np.float32)
+    got, = _run([out], {"x": xv})
+    assert got.shape == (3, 4, 2)
+    np.testing.assert_allclose(got[-1], 3 * np.ones((4, 2)))
+
+
+def test_static_rnn_grad_flows():
+    x = layers.data("x", shape=[3, 2, 4], dtype="float32",
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        mem = rnn.memory(shape=[2, 4], dtype="float32", value=0.0)
+        h = layers.fc([xt, mem], size=4, bias_attr=False)
+        rnn.update_memory(mem, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = layers.mean(layers.square(out))
+    opt = pt.optimizer.SGD(0.1)
+    opt.minimize(loss)
+    xv = np.random.RandomState(0).rand(3, 2, 4).astype(np.float32)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    l0, = exe.run(feed={"x": xv}, fetch_list=[loss])
+    for _ in range(15):
+        l1, = exe.run(feed={"x": xv}, fetch_list=[loss])
+    # grads must flow to the fc weight captured inside the step block
+    assert float(l1) < float(l0)
+
+
+def test_dynamic_rnn_masks_state():
+    x = layers.data("x", shape=[4, 3], dtype="float32")   # [b, T=4, 3]
+    sl = layers.data("sl", shape=[], dtype="int32")       # [b]
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(x, seq_len=sl)
+        mem = drnn.memory(shape=[3], batch_ref=x, value=0.0)
+        s = layers.elementwise_add(mem, xt)
+        drnn.update_memory(mem, s)
+        drnn.output(s)
+    out = drnn()
+    last = layers.sequence_last_step(out, seq_len=sl)
+    xv = np.ones((2, 4, 3), np.float32)
+    slv = np.array([2, 4], np.int32)
+    got, glast = _run([out, last], {"x": xv, "sl": slv})
+    # memory freezes at each row's end: last VALID output is the true sum
+    np.testing.assert_allclose(glast[0], 2 * np.ones(3))
+    np.testing.assert_allclose(glast[1], 4 * np.ones(3))
+    # row 1 ran all 4 steps
+    np.testing.assert_allclose(got[1, -1], 4 * np.ones(3))
+
+
+def test_print_and_py_func():
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.Print(layers.scale(x, scale=1.0), message="dbg")
+
+    main = pt.default_main_program()
+    out_var = main.global_block().create_var(
+        name="pyfunc_out", shape=[-1, 2], dtype="float32")
+    layers.nn.py_func(lambda a: a * 3.0, x, out_var)
+    xv = np.ones((2, 2), np.float32)
+    gy, gout = _run([y, out_var], {"x": xv})
+    np.testing.assert_allclose(gout, 3 * xv)
+
+
+def test_py_func_backward():
+    x = layers.data("x", shape=[2], dtype="float32")
+    x.stop_gradient = False
+    main = pt.default_main_program()
+    out_var = main.global_block().create_var(
+        name="pyfunc_out2", shape=[-1, 2], dtype="float32")
+    layers.nn.py_func(lambda a: a * a,
+                      x, out_var,
+                      backward_func=lambda a, o, g: 2.0 * a * g)
+    loss = layers.mean(out_var)
+    grads = append_backward(loss)
+    xv = np.full((2, 2), 3.0, np.float32)
+    gx, = _run([x.name + "@GRAD"], {"x": xv})
+    np.testing.assert_allclose(gx, 2 * 3.0 / 4 * np.ones((2, 2)), rtol=1e-5)
