@@ -19,6 +19,11 @@ from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa
 from .math_ops import scale  # noqa
 from .metric_op import accuracy, auc  # noqa
 from .nn import *  # noqa
+from .structured import (beam_search, beam_search_decode,  # noqa
+                         crf_decoding, ctc_greedy_decoder, edit_distance,
+                         hsigmoid, linear_chain_crf, nce,
+                         sampled_softmax_with_cross_entropy, sampling_id,
+                         warpctc)
 from .sequence import (sequence_concat, sequence_enumerate,  # noqa
                        sequence_expand, sequence_expand_as,
                        sequence_first_step, sequence_last_step,

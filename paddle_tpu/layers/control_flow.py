@@ -108,11 +108,15 @@ class _WhileBlockGuard:
                 read.add(n)
             for n in op.output_arg_names():
                 written.add(n)
-        carried = sorted((read | written) & set(parent.vars) | {self.while_op.cond_var.name})
+        # membership must be recursive (has_var) — parent.vars is local-only,
+        # and the loop may sit inside another sub-block whose captures live
+        # further up the chain
+        carried = sorted({n for n in (read | written) if parent.has_var(n)}
+                         | {self.while_op.cond_var.name})
         parent.append_op(
             "while",
             inputs={"Condition": [self.while_op.cond_var.name],
-                    "X": sorted(read & set(parent.vars))},
+                    "X": sorted(n for n in read if parent.has_var(n))},
             outputs={"Out": list(carried)},
             attrs={"sub_block": inner, "carried_vars": list(carried)})
         return False
@@ -223,8 +227,8 @@ class _SwitchCaseGuard:
         program._rollback()
         parent = program.current_block()
         written = sorted({n for op in inner.ops
-                          for n in op.output_arg_names()}
-                         & set(parent.vars))
+                          for n in op.output_arg_names()
+                          if parent.has_var(n)})
         parent.append_op(
             "conditional_block",
             inputs={"Cond": [self.pred.name]},
@@ -268,9 +272,9 @@ class IfElse:
     def __init__(self, cond, name=None):
         self.cond = cond
         self.helper = LayerHelper("ifelse", name=name)
-        self._true_outs = None
-        self._false_outs = None
-        self._in_true = False
+        self._true_outs = []
+        self._false_outs = []
+        self._in_true = None        # None = outside any branch guard
 
     def input(self, x):
         """In the reference this slices the branch's rows; dense: identity."""
@@ -283,13 +287,17 @@ class IfElse:
         return _IfElseBranch(self, False)
 
     def output(self, *outs):
+        if self._in_true is None:
+            raise ValueError(
+                "IfElse.output() must be called inside true_block()/"
+                "false_block()")
         if self._in_true:
-            self._true_outs = list(outs)
+            self._true_outs.extend(outs)
         else:
-            self._false_outs = list(outs)
+            self._false_outs.extend(outs)
 
     def __call__(self):
-        if self._true_outs is None or self._false_outs is None:
+        if not self._true_outs or not self._false_outs:
             raise ValueError("IfElse: both branches must call output()")
         if len(self._true_outs) != len(self._false_outs):
             raise ValueError("IfElse: branch output arity mismatch")
@@ -316,6 +324,7 @@ class _IfElseBranch:
         return self
 
     def __exit__(self, *a):
+        self.ie._in_true = None
         return False
 
 
@@ -431,8 +440,9 @@ class _StaticRNNGuard:
                       {m[1].name for m in rnn.memories}
         read = {n for op_ in inner.ops for n in op_.input_arg_names()}
         written = {n for op_ in inner.ops for n in op_.output_arg_names()}
-        params = sorted((read - written - inner_names - seq_names -
-                         init_names) & set(parent.vars))
+        params = sorted(n for n in (read - written - inner_names -
+                                    seq_names - init_names)
+                        if parent.has_var(n))
         parent.append_op(
             "static_scan",
             inputs={"X": [x.name for x, _ in rnn.seq_inputs],
@@ -473,8 +483,8 @@ class DynamicRNN(StaticRNN):
         if seq_len is None:
             seq_len = getattr(x, "seq_len_var", None)
             if isinstance(seq_len, str):
-                pv = self.program.current_block().find_var_recursive(seq_len)
-                seq_len = pv
+                blk = self.program.current_block()
+                seq_len = blk.var(seq_len) if blk.has_var(seq_len) else None
         if seq_len is not None and self.seq_len is None:
             self.seq_len = seq_len
         # also scan a time-index input for masking: arange [T] -> t scalar

@@ -1,0 +1,255 @@
+"""Structured-prediction layers: CRF, CTC, beam search, candidate sampling.
+
+ref ``python/paddle/fluid/layers/nn.py`` (linear_chain_crf, crf_decoding,
+ctc_greedy_decoder, edit_distance, warpctc, nce, hsigmoid,
+sampled_softmax_with_cross_entropy, sampling_id, beam_search) — signatures
+follow the reference; sequence data is dense padded + explicit lengths
+instead of LoD.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """ref layers/nn.py linear_chain_crf → linear_chain_crf op.
+
+    Returns the per-sequence negative log-likelihood ``[batch, 1]`` (minimize
+    its mean).  ``input``: emissions ``[batch, time, n_tags]``; ``label``:
+    ``[batch, time]``; ``length``: ``[batch]`` valid lengths.
+    """
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    n_tags = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=[n_tags + 2, n_tags],
+                                         dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    em_exps = helper.create_variable_for_type_inference(input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("linear_chain_crf", inputs=ins,
+                     outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                              "EmissionExps": [em_exps],
+                              "TransitionExps": [tr_exps]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """ref layers/nn.py crf_decoding → crf_decoding op (Viterbi).
+
+    Pass the SAME ``param_attr`` (by name) as the ``linear_chain_crf`` layer
+    to decode with the learned transitions.
+    """
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    n_tags = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=[n_tags + 2, n_tags],
+                                         dtype=input.dtype)
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def ctc_greedy_decoder(input, blank, input_length=None):
+    """ref layers/nn.py ctc_greedy_decoder: argmax per step, merge repeats,
+    drop blanks.  Returns (decoded ``[batch, time]`` padded with 0,
+    out_length ``[batch, 1]``)."""
+    from .tensor import argmax
+    helper = LayerHelper("ctc_greedy_decoder")
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    ins = {"Input": [ids]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op("ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": blank, "merge_repeated": True,
+                            "padding_value": 0})
+    return out, out_len
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """ref layers/nn.py edit_distance → edit_distance op (Levenshtein)."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", inputs=ins,
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """ref layers/nn.py warpctc → warpctc op (CTC loss).
+
+    ``input``: logits ``[batch, time, num_classes]`` (pre-softmax);
+    ``label``: ``[batch, max_label_len]``.  Returns loss ``[batch, 1]``.
+    """
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op("warpctc", inputs=ins,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """ref layers/nn.py nce → nce op (noise-contrastive estimation)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    s_logits = helper.create_variable_for_type_inference(input.dtype)
+    s_labels = helper.create_variable_for_type_inference("int64")
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op("nce", inputs=ins,
+                     outputs={"Cost": [cost], "SampleLogits": [s_logits],
+                              "SampleLabels": [s_labels]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples or 10,
+                            "sampler": {"uniform": 0, "log_uniform": 1}.get(
+                                sampler, 0),
+                            "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """ref layers/nn.py hsigmoid → hierarchical_sigmoid op over the default
+    complete binary tree (ref operators/math/matrix_bit_code.h SimpleCode)."""
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_classes - 1, 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op("hierarchical_sigmoid", inputs=ins,
+                     outputs={"Out": [out], "PreOut": [pre_out]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """ref layers/nn.py sampled_softmax_with_cross_entropy → sample_logits +
+    softmax_with_cross_entropy over the sampled subset."""
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int64")
+    probs = helper.create_variable_for_type_inference(logits.dtype)
+    s_logits = helper.create_variable_for_type_inference(logits.dtype)
+    s_labels = helper.create_variable_for_type_inference("int64")
+    helper.append_op("sample_logits",
+                     inputs={"Logits": [logits], "Labels": [label]},
+                     outputs={"Samples": [samples], "Probabilities": [probs],
+                              "SampledLogits": [s_logits],
+                              "SampledLabels": [s_labels]},
+                     attrs={"num_samples": num_samples, "seed": seed})
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": [s_logits], "Label": [s_labels]},
+                     outputs={"Loss": [loss], "Softmax": [softmax]},
+                     attrs={"soft_label": False, "axis": -1})
+    return loss
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """ref layers/nn.py sampling_id → sampling_id op: sample one class index
+    per row of the probability matrix."""
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"seed": seed})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=True):
+    """ref layers/nn.py beam_search → beam_search op (one decode step).
+
+    Dense layout: rows are ``batch*beam_size`` hypothesis slots.  Seed step 0
+    with ``pre_scores`` 0 for beam 0 and a large negative for the rest.
+    Returns (selected_ids, selected_scores, parent_idx).
+    """
+    helper = LayerHelper("beam_search")
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype)
+    parent = helper.create_variable_for_type_inference("int64")
+    ins = {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+           "scores": [scores]}
+    if ids is not None:
+        ins["ids"] = [ids]
+    helper.append_op("beam_search", inputs=ins,
+                     outputs={"selected_ids": [sel_ids],
+                              "selected_scores": [sel_scores],
+                              "parent_idx": [parent]},
+                     attrs={"beam_size": beam_size, "end_id": end_id,
+                            "level": level, "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parents, beam_size, end_id, name=None):
+    """ref layers/nn.py beam_search_decode → beam_search_decode op.
+
+    ``ids``/``scores``/``parents`` are stacked step tensors ``[time,
+    batch*beam(,1)]`` (e.g. ``tensor_array_to_tensor`` of the per-step
+    outputs of :func:`beam_search`).  The reference recovers parent pointers
+    from LoD; the dense layout passes them explicitly.  Returns
+    (sentence_ids ``[batch, beam, time]``, sentence_scores).
+    """
+    helper = LayerHelper("beam_search_decode")
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": [ids], "Scores": [scores],
+                             "Parents": [parents]},
+                     outputs={"SentenceIds": [sent_ids],
+                              "SentenceScores": [sent_scores]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return sent_ids, sent_scores

@@ -4,5 +4,5 @@ REGISTER_OPERATOR initializers)."""
 
 from . import (attention_ops, control_flow_ops, math_ops, metrics_ops,  # noqa
                misc_ops, nn_ops, optimizer_ops, reduce_ops, rnn_ops,
-               sequence_ops, tensor_ops)
+               sequence_ops, structured_ops, tensor_ops)
 from ..framework.registry import registered_ops  # noqa

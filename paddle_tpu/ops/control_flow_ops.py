@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.registry import register_op
 
@@ -154,9 +155,16 @@ def _array_write(ctx, ins, attrs):
     if arr is None:
         arr = jnp.zeros((attrs.get("max_len", 128),) + x.shape, x.dtype)
         ln = jnp.zeros((), jnp.int32)
+    max_len = arr.shape[0]
+    if not isinstance(i, jax.core.Tracer) and int(np.asarray(i)) >= max_len:
+        raise IndexError(
+            f"array_write index {int(np.asarray(i))} >= buffer max_len "
+            f"{max_len}; pass a larger max_len to create_array")
     arr = jax.lax.dynamic_update_slice(arr, x[None].astype(arr.dtype),
                                        (i,) + (0,) * x.ndim)
-    ln = jnp.maximum(ln.astype(jnp.int32), i + 1)
+    # dynamic_update_slice clamps the start index, so cap the length counter
+    # too — array_length must never exceed the buffer
+    ln = jnp.minimum(jnp.maximum(ln.astype(jnp.int32), i + 1), max_len)
     return {"Out": [arr], "OutLen": [ln]}
 
 
@@ -184,11 +192,13 @@ def _tensor_array_to_tensor(ctx, ins, attrs):
     axis = attrs.get("axis", 0)
     if attrs.get("use_stack", True):
         out = jnp.moveaxis(arr, 0, axis) if axis else arr
+        per_elem = 1                        # each element contributes 1 slot
     else:
         out = jnp.concatenate([arr[i] for i in range(arr.shape[0])],
                               axis=axis)
-    index = jnp.full((arr.shape[0],), arr.shape[1] if arr.ndim > 1 else 1,
-                     jnp.int32)
+        # each element [arr.shape[1:]] contributes its extent on `axis`
+        per_elem = arr.shape[1 + axis] if arr.ndim > 1 + axis else 1
+    index = jnp.full((arr.shape[0],), per_elem, jnp.int32)
     return {"Out": [out], "OutIndex": [index]}
 
 

@@ -1,0 +1,439 @@
+"""Structured-prediction op lowerings: CRF, CTC, beam search, sampled losses.
+
+TPU-native equivalents of the reference's sequence-labeling / decoding /
+candidate-sampling kernels:
+
+- ``operators/linear_chain_crf_op.cc`` / ``crf_decoding_op.cc``
+- ``operators/ctc_align_op.cc`` / ``warpctc_op.cc`` / ``edit_distance_op.cc``
+- ``operators/nce_op.cc`` / ``hierarchical_sigmoid_op.cc``
+- ``operators/sample_logits_op.cc`` / ``sampling_id_op.cc``
+- ``operators/beam_search_op.cc`` / ``beam_search_decode_op.cc``
+
+Dense padded tensors plus length masks replace LoD; every recursion is a
+``lax.scan`` so the whole computation stays inside one XLA program and the
+generic vjp grad path differentiates the losses for free (the reference
+hand-writes each backward kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..framework.registry import register_op
+from .common import X, XS
+
+NEG_INF = -1e9
+
+
+def _lengths_or_full(x, lens, time_axis=1):
+    if lens is not None:
+        return lens.reshape(-1).astype(jnp.int32)
+    return jnp.full((x.shape[0],), x.shape[time_axis], jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF (ref operators/linear_chain_crf_op.{cc,h})
+# ---------------------------------------------------------------------------
+
+def _crf_unpack(transition):
+    """Transition is [n_tags+2, n_tags]: row 0 = start weights, row 1 = stop
+    weights, rows 2.. = pairwise weights (ref linear_chain_crf_op.h)."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    em = X(ins, "Emission")            # [b, t, n]
+    trans = X(ins, "Transition")       # [n+2, n]
+    label = X(ins, "Label")            # [b, t] or [b, t, 1]
+    lens = X(ins, "Length")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    start, stop, w = _crf_unpack(trans)
+    b, t, n = em.shape
+    lengths = _lengths_or_full(em, lens)
+
+    # forward (alpha) recursion in log space
+    def step(alpha, inp):
+        em_t, valid = inp                           # [b, n], [b]
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None, :, :], axis=1) + em_t
+        alpha = jnp.where(valid[:, None], nxt, alpha)
+        return alpha, None
+
+    alpha0 = em[:, 0] + start[None, :]
+    steps = jnp.arange(1, t)
+    valid = steps[None, :] < lengths[:, None]        # [b, t-1]
+    alpha, _ = jax.lax.scan(
+        step, alpha0, (jnp.moveaxis(em[:, 1:], 1, 0), jnp.moveaxis(valid, 1, 0)))
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=-1)
+
+    # gold-path score
+    tpos = jnp.arange(t)
+    tmask = (tpos[None, :] < lengths[:, None]).astype(em.dtype)
+    em_score = jnp.sum(
+        jnp.take_along_axis(em, label[..., None], axis=-1)[..., 0] * tmask,
+        axis=1)
+    pair = w[label[:, :-1], label[:, 1:]]            # [b, t-1]
+    pair_mask = (tpos[None, 1:] < lengths[:, None]).astype(em.dtype)
+    pair_score = jnp.sum(pair * pair_mask, axis=1)
+    last = jnp.take_along_axis(label, (lengths - 1)[:, None], axis=1)[:, 0]
+    gold = em_score + pair_score + start[label[:, 0]] + stop[last]
+
+    nll = (log_z - gold)[:, None]                    # [b, 1]
+    return {"LogLikelihood": [nll], "Alpha": [alpha],
+            "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(trans)]}
+
+
+@register_op("crf_decoding", no_grad=True)
+def _crf_decoding(ctx, ins, attrs):
+    em = X(ins, "Emission")            # [b, t, n]
+    trans = X(ins, "Transition")
+    label = X(ins, "Label")
+    lens = X(ins, "Length")
+    start, stop, w = _crf_unpack(trans)
+    b, t, n = em.shape
+    lengths = _lengths_or_full(em, lens)
+
+    def fwd(carry, inp):
+        alpha = carry
+        em_t, valid = inp
+        scores = alpha[:, :, None] + w[None, :, :]   # [b, n, n]
+        best = jnp.max(scores, axis=1) + em_t
+        bp = jnp.argmax(scores, axis=1)              # [b, n]
+        alpha = jnp.where(valid[:, None], best, alpha)
+        bp = jnp.where(valid[:, None], bp, jnp.arange(n)[None, :])
+        return alpha, bp
+
+    alpha0 = em[:, 0] + start[None, :]
+    steps = jnp.arange(1, t)
+    valid = steps[None, :] < lengths[:, None]
+    alpha, bps = jax.lax.scan(
+        fwd, alpha0, (jnp.moveaxis(em[:, 1:], 1, 0), jnp.moveaxis(valid, 1, 0)))
+    last_tag = jnp.argmax(alpha + stop[None, :], axis=-1)      # [b]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: outputs stack in forward order, carry ends at step 0
+    first, tags_rest = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([first[None, :], tags_rest], axis=0)  # [t, b]
+    path = jnp.moveaxis(path, 0, 1)                              # [b, t]
+    tmask = jnp.arange(t)[None, :] < lengths[:, None]
+    path = jnp.where(tmask, path, 0).astype(jnp.int64)
+    if label is not None:
+        lab = label[..., 0] if label.ndim == 3 else label
+        out = (path == lab.astype(path.dtype)).astype(jnp.int64)
+        out = jnp.where(tmask, out, 0)
+        return {"ViterbiPath": [out]}
+    return {"ViterbiPath": [path]}
+
+
+# ---------------------------------------------------------------------------
+# CTC (ref operators/ctc_align_op.cc, warpctc_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("ctc_align", no_grad=True)
+def _ctc_align(ctx, ins, attrs):
+    x = X(ins, "Input")                 # [b, t] token ids
+    lens = X(ins, "InputLength")
+    blank = attrs.get("blank", 0)
+    merge = attrs.get("merge_repeated", True)
+    pad_val = attrs.get("padding_value", 0)
+    x = x.astype(jnp.int32)
+    b, t = x.shape
+    lengths = _lengths_or_full(x, lens)
+    inb = jnp.arange(t)[None, :] < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = (x != blank) & inb
+    if merge:
+        keep = keep & (x != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(keep, pos, t)       # dump discarded tokens past the end
+    out = jnp.full((b, t + 1), pad_val, jnp.int32)
+    out = out.at[jnp.arange(b)[:, None], pos].set(x)[:, :t]
+    out_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Output": [out.astype(jnp.int64)],
+            "OutputLength": [out_len[:, None].astype(jnp.int64)]}
+
+
+@register_op("warpctc")
+def _warpctc(ctx, ins, attrs):
+    logits = X(ins, "Logits")           # [b, t, n_class]
+    label = X(ins, "Label")             # [b, l]
+    llen = X(ins, "LogitsLength")
+    lablen = X(ins, "LabelLength")
+    blank = attrs.get("blank", 0)
+    norm_by_times = attrs.get("norm_by_times", False)
+    b, t, _ = logits.shape
+    l = label.shape[1]
+    tl = _lengths_or_full(logits, llen)
+    ll = _lengths_or_full(label, lablen)
+    logit_pad = (jnp.arange(t)[None, :] >= tl[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(l)[None, :] >= ll[:, None]).astype(jnp.float32)
+    loss = optax.ctc_loss(logits.astype(jnp.float32), logit_pad,
+                          label.astype(jnp.int32), label_pad,
+                          blank_id=blank)
+    if norm_by_times:
+        loss = loss / tl.astype(loss.dtype)
+    return {"Loss": [loss[:, None].astype(logits.dtype)],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("edit_distance", no_grad=True)
+def _edit_distance(ctx, ins, attrs):
+    hyp = X(ins, "Hyps")                # [b, t1]
+    ref = X(ins, "Refs")                # [b, t2]
+    hlen = X(ins, "HypsLength")
+    rlen = X(ins, "RefsLength")
+    normalized = attrs.get("normalized", True)
+    hyp, ref = hyp.astype(jnp.int32), ref.astype(jnp.int32)
+    b, t1 = hyp.shape
+    t2 = ref.shape[1]
+    hl = _lengths_or_full(hyp, hlen)
+    rl = _lengths_or_full(ref, rlen)
+
+    def one(h, r, nh, nr):
+        row0 = jnp.arange(t2 + 1, dtype=jnp.float32)
+
+        def outer(prev_row, hi_i):
+            hi, i = hi_i
+
+            def inner(left, rj_prev_j):
+                rj, prev_j, prev_jm1 = rj_prev_j
+                cur = jnp.minimum(
+                    jnp.minimum(prev_j + 1.0, left + 1.0),
+                    prev_jm1 + jnp.where(hi == rj, 0.0, 1.0))
+                return cur, cur
+
+            first = i + 1.0
+            _, rest = jax.lax.scan(
+                inner, first, (r, prev_row[1:], prev_row[:-1]))
+            new_row = jnp.concatenate([jnp.array([first]), rest])
+            return new_row, new_row
+
+        _, rows = jax.lax.scan(
+            outer, row0, (h, jnp.arange(t1, dtype=jnp.float32)))
+        table = jnp.concatenate([row0[None, :], rows], axis=0)  # [t1+1, t2+1]
+        return table[nh, nr]
+
+    dist = jax.vmap(one)(hyp, ref, hl, rl)
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(dist.dtype), 1.0)
+    return {"Out": [dist[:, None]],
+            "SequenceNum": [jnp.array(b, jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# candidate sampling losses (ref nce_op.cc, hierarchical_sigmoid_op.cc,
+# sample_logits_op.cc, sampling_id_op.cc)
+# ---------------------------------------------------------------------------
+
+def _log_uniform_prob(ids, range_max):
+    ids = ids.astype(jnp.float32)
+    return (jnp.log1p(1.0 / (ids + 1.0))) / np.log(range_max + 1.0)
+
+
+def _sample_classes(key, n, num_classes, sampler):
+    """Shared negative samples + their proposal probabilities."""
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, (n,))
+        ids = (jnp.exp(u * np.log(num_classes + 1.0)) - 1.0).astype(jnp.int32)
+        ids = jnp.clip(ids, 0, num_classes - 1)
+        q = _log_uniform_prob(ids, num_classes)
+    else:
+        ids = jax.random.randint(key, (n,), 0, num_classes)
+        q = jnp.full((n,), 1.0 / num_classes)
+    return ids, q
+
+
+@register_op("nce", stateful_rng=True)
+def _nce(ctx, ins, attrs):
+    x = X(ins, "Input")                 # [b, d]
+    label = X(ins, "Label")             # [b, num_true]
+    w = X(ins, "Weight")                # [C, d]
+    bias = X(ins, "Bias")               # [C]
+    num_neg = attrs.get("num_neg_samples", 10)
+    num_classes = attrs.get("num_total_classes", w.shape[0])
+    sampler = {0: "uniform", 1: "log_uniform"}.get(
+        attrs.get("sampler", 0), "uniform")
+    if label.ndim == 1:
+        label = label[:, None]
+    label = label.astype(jnp.int32)
+    num_true = label.shape[1]
+    neg, q_neg = _sample_classes(ctx.rng(), num_neg, num_classes, sampler)
+    q_true = (_log_uniform_prob(label, num_classes) if sampler == "log_uniform"
+              else jnp.full(label.shape, 1.0 / num_classes))
+
+    w_true = w[label]                   # [b, nt, d]
+    s_true = jnp.einsum("bd,bnd->bn", x, w_true)
+    s_neg = x @ w[neg].T                # [b, S]
+    if bias is not None:
+        bvec = bias.reshape(-1)
+        s_true = s_true + bvec[label]
+        s_neg = s_neg + bvec[neg][None, :]
+    # NCE logistic loss with noise-distribution correction
+    # (ref nce_op.h: logit - log(num_neg * q))
+    lt = s_true - jnp.log(num_neg * q_true + 1e-20)
+    ln = s_neg - jnp.log(num_neg * q_neg + 1e-20)[None, :]
+    cost = jnp.sum(jax.nn.softplus(-lt), axis=1) + \
+        jnp.sum(jax.nn.softplus(ln), axis=1)
+    sample_logits = jnp.concatenate([s_true, s_neg], axis=1)
+    sample_labels = jnp.concatenate(
+        [label, jnp.broadcast_to(neg[None, :], (x.shape[0], num_neg))], axis=1)
+    return {"Cost": [cost[:, None]],
+            "SampleLogits": [sample_logits],
+            "SampleLabels": [sample_labels.astype(jnp.int64)]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    x = X(ins, "X")                     # [b, d]
+    w = X(ins, "W")                     # [C-1, d]
+    label = X(ins, "Label")             # [b] or [b,1]
+    bias = X(ins, "Bias")               # [C-1, 1] optional
+    num_classes = attrs.get("num_classes")
+    if label.ndim == 2:
+        label = label[:, 0]
+    label = label.astype(jnp.int32)
+    c = label + num_classes             # leaf code, complete binary tree
+    # path length = floor(log2(c)) (ref framework/.../matrix_bit_code.h)
+    length = jnp.floor(jnp.log2(c.astype(jnp.float32) + 0.5) + 1e-6)
+    length = length.astype(jnp.int32)
+    max_len = int(np.ceil(np.log2(num_classes))) if num_classes > 1 else 1
+
+    i = jnp.arange(max_len)             # bit position from the root
+    # ancestor internal node (1-indexed) at depth i+1: c >> (length - i)
+    shift = jnp.maximum(length[:, None] - i[None, :], 0)
+    idx = (c[:, None] >> shift) - 1     # [b, L] row into W
+    bit = (c[:, None] >> jnp.maximum(shift - 1, 0)) & 1   # branch taken
+    valid = i[None, :] < length[:, None]
+    idx = jnp.where(valid, jnp.clip(idx, 0, w.shape[0] - 1), 0)
+
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    t = bit.astype(pre.dtype)
+    # sigmoid cross-entropy against the branch bit
+    losses = jax.nn.softplus(pre) - t * pre
+    cost = jnp.sum(jnp.where(valid, losses, 0.0), axis=1)
+    return {"Out": [cost[:, None]], "PreOut": [pre]}
+
+
+@register_op("sample_logits", stateful_rng=True)
+def _sample_logits(ctx, ins, attrs):
+    logits = X(ins, "Logits")           # [b, C]
+    label = X(ins, "Labels")            # [b, nt]
+    num_samples = attrs.get("num_samples", 5)
+    b, c = logits.shape
+    label = label.astype(jnp.int32)
+    nt = label.shape[1]
+    neg, q_neg = _sample_classes(ctx.rng(), num_samples, c, "log_uniform")
+    samples = jnp.concatenate(
+        [label, jnp.broadcast_to(neg[None, :], (b, num_samples))], axis=1)
+    q_true = _log_uniform_prob(label, c)
+    probs = jnp.concatenate(
+        [q_true, jnp.broadcast_to(q_neg[None, :], (b, num_samples))], axis=1)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    # subtract log q (ref sample_logits_op.h ComputeRemoveLogQ)
+    sampled_logits = picked - jnp.log(probs * num_samples + 1e-20)
+    sampled_label = jnp.broadcast_to(jnp.arange(nt)[None, :], (b, nt))
+    return {"Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [probs],
+            "SampledLogits": [sampled_logits],
+            "SampledLabels": [sampled_label.astype(jnp.int64)]}
+
+
+@register_op("sampling_id", no_grad=True, stateful_rng=True)
+def _sampling_id(ctx, ins, attrs):
+    x = X(ins, "X")                     # [b, C] probabilities
+    ids = jax.random.categorical(ctx.rng(), jnp.log(x + 1e-20), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# beam search (ref beam_search_op.cc, beam_search_decode_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("beam_search", no_grad=True)
+def _beam_search(ctx, ins, attrs):
+    """One decoding step over dense [batch*beam, K] candidates.
+
+    The reference keeps variable-size beams in LoD; here every batch keeps
+    exactly ``beam_size`` live slots.  Seed step 0 by feeding ``pre_scores``
+    = 0 for beam 0 and a large negative for beams 1.. so duplicated initial
+    hypotheses don't crowd the beam (the LoD analog of an empty sub-beam).
+    Finished beams (pre_id == end_id) survive with frozen score.
+    """
+    pre_ids = X(ins, "pre_ids").reshape(-1)        # [bb]
+    pre_scores = X(ins, "pre_scores").reshape(-1)  # [bb]
+    ids = X(ins, "ids")
+    scores = X(ins, "scores")                      # [bb, K]
+    beam_size = attrs["beam_size"]
+    end_id = attrs["end_id"]
+    is_accum = attrs.get("is_accumulated", True)
+    bb, k = scores.shape
+    batch = bb // beam_size
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(k)[None, :], (bb, k))
+    acc = scores if is_accum else pre_scores[:, None] + jnp.log(scores + 1e-20)
+    finished = pre_ids == end_id
+    # frozen candidate occupies column 0 of a finished beam
+    first_col = jnp.arange(k) == 0
+    cand_score = jnp.where(finished[:, None],
+                           jnp.where(first_col[None, :],
+                                     pre_scores[:, None], NEG_INF),
+                           acc)
+    cand_id = jnp.where(finished[:, None], end_id, ids)
+    cand_score = cand_score.reshape(batch, beam_size * k)
+    cand_id = cand_id.reshape(batch, beam_size * k)
+    top_s, top_i = jax.lax.top_k(cand_score, beam_size)    # [batch, beam]
+    sel_id = jnp.take_along_axis(cand_id, top_i, axis=1)
+    parent_in_batch = top_i // k
+    parent = parent_in_batch + jnp.arange(batch)[:, None] * beam_size
+    return {"selected_ids": [sel_id.reshape(bb, 1).astype(jnp.int64)],
+            "selected_scores": [top_s.reshape(bb, 1)],
+            "parent_idx": [parent.reshape(bb).astype(jnp.int64)]}
+
+
+@register_op("beam_search_decode", no_grad=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stored steps into full sentences.
+
+    Inputs are stacked dense TensorArrays: Ids/Scores/Parents [T, bb(, 1)].
+    The reference recovers parents from per-step LoD
+    (``beam_search_decode_op.h``); dense slots carry them explicitly.
+    """
+    ids = X(ins, "Ids")
+    scores = X(ins, "Scores")
+    parents = X(ins, "Parents")
+    beam_size = attrs["beam_size"]
+    end_id = attrs["end_id"]
+    ids = ids.reshape(ids.shape[0], -1)            # [T, bb]
+    scores = scores.reshape(scores.shape[0], -1)
+    parents = parents.reshape(parents.shape[0], -1).astype(jnp.int32)
+    t, bb = ids.shape
+
+    def back(slot, step):
+        sid, sparent, ssc = step
+        tok = sid[slot]
+        sc = ssc[slot]
+        slot = sparent[slot]
+        return slot, (tok, sc)
+
+    slot0 = jnp.arange(bb)
+    _, (toks, scs) = jax.lax.scan(back, slot0, (ids, parents, scores),
+                                  reverse=True)
+    # toks [T, bb] in forward time order already (reverse scan stacks
+    # outputs in input order)
+    sent_ids = jnp.moveaxis(toks, 0, 1).reshape(bb // beam_size, beam_size, t)
+    sent_scores = jnp.moveaxis(scs, 0, 1).reshape(
+        bb // beam_size, beam_size, t)
+    return {"SentenceIds": [sent_ids.astype(jnp.int64)],
+            "SentenceScores": [sent_scores]}
