@@ -8,9 +8,19 @@ from .control_flow import (DynamicRNN, IfElse, Print, StaticRNN,  # noqa
                            array_write, create_array, equal,
                            greater_equal, greater_than, increment,
                            is_empty, less_equal, less_than, not_equal)
-from .detection import (box_clip, box_coder, detection_output,  # noqa
-                        iou_similarity, multiclass_nms, prior_box,
-                        yolo_box)
+from .detection import (anchor_generator, bipartite_match,  # noqa
+                        box_clip, box_coder, box_decoder_and_assign,
+                        collect_fpn_proposals, density_prior_box,
+                        detection_output, distribute_fpn_proposals,
+                        generate_mask_labels, generate_proposal_labels,
+                        generate_proposals, iou_similarity,
+                        multi_box_head, multiclass_nms, multiclass_nms2,
+                        polygon_box_transform, prior_box, prroi_pool,
+                        psroi_pool, retinanet_detection_output,
+                        retinanet_target_assign, roi_align,
+                        roi_perspective_transform, roi_pool,
+                        rpn_target_assign, sigmoid_focal_loss, ssd_loss,
+                        target_assign, yolo_box, yolov3_loss)
 from .io import data  # noqa
 from .learning_rate_scheduler import (cosine_decay, exponential_decay,  # noqa
                                       inverse_time_decay, linear_lr_warmup,

@@ -1,16 +1,37 @@
-"""Detection layers (ref ``python/paddle/fluid/layers/detection.py`` — 27
-exports).  Round 1 ships the box/anchor math subset; NMS-style ops that are
-host-side in every framework surface as NotImplemented with guidance."""
+"""Detection layers (ref ``python/paddle/fluid/layers/detection.py`` — the
+27-export surface).
+
+Dense fixed-shape semantics throughout: NMS-style layers return
+``[batch, K, ...]`` padded buffers + counts instead of LoD (see
+``ops/detection_ops.py``).  Ragged gt inputs are padded ``[batch, G, ...]``
+with zero-area rows ignored.
+"""
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "density_prior_box", "multi_box_head", "bipartite_match",
+    "target_assign", "detection_output", "ssd_loss", "rpn_target_assign",
+    "retinanet_target_assign", "sigmoid_focal_loss", "anchor_generator",
+    "roi_perspective_transform", "generate_proposal_labels",
+    "generate_proposals", "generate_mask_labels", "iou_similarity",
+    "box_coder", "polygon_box_transform", "yolov3_loss", "yolo_box",
+    "box_clip", "multiclass_nms", "multiclass_nms2",
+    "retinanet_detection_output", "distribute_fpn_proposals",
+    "box_decoder_and_assign", "collect_fpn_proposals",
+    "roi_pool", "roi_align", "psroi_pool", "prroi_pool",
+]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
               variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
               steps=[0.0, 0.0], offset=0.5, name=None,
               min_max_aspect_ratios_order=False):
+    """ref layers/detection.py prior_box → prior_box op."""
     helper = LayerHelper("prior_box", name=name)
     box = helper.create_variable_for_type_inference(input.dtype, True)
     var = helper.create_variable_for_type_inference(input.dtype, True)
@@ -28,39 +49,281 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
     return box, var
 
 
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """ref layers/detection.py density_prior_box → density_prior_box op."""
+    helper = LayerHelper("density_prior_box", name=name)
+    box = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("density_prior_box",
+                     inputs={"Input": [input], "Image": [image]},
+                     outputs={"Boxes": [box], "Variances": [var]},
+                     attrs={"densities": list(densities or []),
+                            "fixed_sizes": list(fixed_sizes or []),
+                            "fixed_ratios": list(fixed_ratios or []),
+                            "variances": list(variance), "clip": clip,
+                            "step_w": steps[0], "step_h": steps[1],
+                            "offset": offset,
+                            "flatten_to_2d": flatten_to_2d})
+    if flatten_to_2d:
+        from . import nn
+        box = nn.reshape(box, [-1, 4])
+        var = nn.reshape(var, [-1, 4])
+    return box, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    """ref layers/detection.py anchor_generator → anchor_generator op."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchor = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("anchor_generator", inputs={"Input": [input]},
+                     outputs={"Anchors": [anchor], "Variances": [var]},
+                     attrs={"anchor_sizes": list(anchor_sizes or
+                                                 [64., 128., 256., 512.]),
+                            "aspect_ratios": list(aspect_ratios or
+                                                  [0.5, 1.0, 2.0]),
+                            "variances": list(variance),
+                            "stride": list(stride or [16.0, 16.0]),
+                            "offset": offset})
+    return anchor, var
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
 def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True, name=None,
               axis=0):
     helper = LayerHelper("box_coder", name=name)
     out = helper.create_variable_for_type_inference(target_box.dtype)
     inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
     if prior_box_var is not None:
-        inputs["PriorBoxVar"] = [prior_box_var]
+        if isinstance(prior_box_var, (list, tuple)):
+            attrs["variance"] = [float(v) for v in prior_box_var]
+        else:
+            inputs["PriorBoxVar"] = [prior_box_var]
     helper.append_op("box_coder", inputs=inputs,
-                     outputs={"OutputBox": [out]},
-                     attrs={"code_type": code_type,
-                            "box_normalized": box_normalized, "axis": axis})
-    return out
-
-
-def iou_similarity(x, y, name=None):
-    helper = LayerHelper("iou_similarity", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype, True)
-    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
-                     outputs={"Out": [out]})
+                     outputs={"OutputBox": [out]}, attrs=attrs)
     return out
 
 
 def box_clip(input, im_info, name=None):
     helper = LayerHelper("box_clip", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("box_clip", inputs={"Input": [input], "ImInfo": [im_info]},
+    helper.append_op("box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
                      outputs={"Output": [out]})
     return out
 
 
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """ref layers/detection.py bipartite_match → bipartite_match op."""
+    helper = LayerHelper("bipartite_match", name=name)
+    match_indices = helper.create_variable_for_type_inference("int32", True)
+    match_dist = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, True)
+    helper.append_op("bipartite_match",
+                     inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [match_indices],
+                              "ColToRowMatchDist": [match_dist]},
+                     attrs={"match_type": "bipartite" if match_type is None
+                            else match_type,
+                            "dist_threshold": 0.5 if dist_threshold is None
+                            else dist_threshold})
+    return match_indices, match_dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """ref layers/detection.py target_assign → target_assign op."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    helper.append_op("target_assign",
+                     inputs={"X": [input],
+                             "MatchIndices": [matched_indices]},
+                     outputs={"Out": [out], "OutWeight": [out_weight]},
+                     attrs={"mismatch_value": mismatch_value or 0})
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """ref layers/detection.py multiclass_nms → dense Out [b, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2) padded with -1."""
+    return multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                           keep_top_k, nms_threshold, normalized, nms_eta,
+                           background_label, return_index=False, name=name)
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """ref multiclass_nms2: same as multiclass_nms, optionally also the
+    selected indices."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    index = helper.create_variable_for_type_inference("int64")
+    helper.append_op("multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "NmsRoisNum": [num],
+                              "Index": [index]},
+                     attrs={"background_label": background_label,
+                            "score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "nms_threshold": nms_threshold,
+                            "nms_eta": nms_eta, "keep_top_k": keep_top_k,
+                            "normalized": normalized})
+    if return_index:
+        return out, index
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """ref layers/detection.py detection_output → detection_output op
+    (decode + multiclass NMS)."""
+    helper = LayerHelper("detection_output", name=name)
+    out = helper.create_variable_for_type_inference(loc.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    index = helper.create_variable_for_type_inference("int64")
+    helper.append_op("detection_output",
+                     inputs={"Loc": [loc], "Scores": [scores],
+                             "PriorBox": [prior_box],
+                             "PriorBoxVar": [prior_box_var]},
+                     outputs={"Out": [out], "NmsRoisNum": [num],
+                              "Index": [index]},
+                     attrs={"background_label": background_label,
+                            "nms_threshold": nms_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "score_threshold": score_threshold,
+                            "nms_eta": nms_eta})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """ref layers/detection.py ssd_loss (match → target-assign → mined conf
+    CE + positive loc smooth_l1).  The reference composes ~10 ops; here the
+    whole pipeline is ONE fused differentiable lowering (XLA fuses it
+    anyway, and the matching/mining indices are non-differentiable
+    bookkeeping).  gt inputs are padded dense ``[b, G, ...]``; zero-area gt
+    rows are ignored by the matcher.  Returns per-prior weighted loss
+    ``[b, M, 1]``."""
+    helper = LayerHelper("ssd_loss")
+    out = helper.create_variable_for_type_inference(location.dtype)
+    ins = {"Location": [location], "Confidence": [confidence],
+           "GtBox": [gt_box], "GtLabel": [gt_label],
+           "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("ssd_loss", inputs=ins, outputs={"Out": [out]},
+                     attrs={"background_label": background_label,
+                            "overlap_threshold": overlap_threshold,
+                            "neg_pos_ratio": neg_pos_ratio,
+                            "neg_overlap": neg_overlap,
+                            "loc_loss_weight": loc_loss_weight,
+                            "conf_loss_weight": conf_loss_weight,
+                            "match_type": match_type,
+                            "mining_type": mining_type,
+                            "normalize": normalize})
+    return out
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """ref layers/detection.py rpn_target_assign → rpn_target_assign op.
+
+    Dense variant: returns (pred_scores, pred_loc, tgt_label, tgt_bbox,
+    bbox_inside_weight) as full per-anchor tensors; rows with label -1 are
+    ignore (mask them in the loss instead of gathering a dynamic subset).
+    """
+    helper = LayerHelper("rpn_target_assign")
+    from . import nn
+    anchor_flat = nn.reshape(anchor_box, [-1, 4])
+    labels = helper.create_variable_for_type_inference("int64")
+    match = helper.create_variable_for_type_inference("int32")
+    tgt = helper.create_variable_for_type_inference("float32")
+    score_idx = helper.create_variable_for_type_inference("int32")
+    inw = helper.create_variable_for_type_inference("float32")
+    helper.append_op("rpn_target_assign",
+                     inputs={"Anchor": [anchor_flat],
+                             "GtBoxes": [gt_boxes]},
+                     outputs={"TargetLabel": [labels],
+                              "LocationIndex": [match],
+                              "ScoreIndex": [score_idx],
+                              "TargetBBox": [tgt],
+                              "BBoxInsideWeight": [inw]},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap})
+    return cls_logits, bbox_pred, labels, tgt, inw
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """ref layers/detection.py retinanet_target_assign."""
+    helper = LayerHelper("retinanet_target_assign")
+    from . import nn
+    anchor_flat = nn.reshape(anchor_box, [-1, 4])
+    labels = helper.create_variable_for_type_inference("int64")
+    tgt = helper.create_variable_for_type_inference("float32")
+    fg_num = helper.create_variable_for_type_inference("int32")
+    inw = helper.create_variable_for_type_inference("float32")
+    helper.append_op("retinanet_target_assign",
+                     inputs={"Anchor": [anchor_flat],
+                             "GtBoxes": [gt_boxes],
+                             "GtLabels": [gt_labels]},
+                     outputs={"TargetLabel": [labels], "TargetBBox": [tgt],
+                              "ForegroundNumber": [fg_num],
+                              "BBoxInsideWeight": [inw]},
+                     attrs={"positive_overlap": positive_overlap,
+                            "negative_overlap": negative_overlap})
+    return cls_logits, bbox_pred, labels, tgt, inw, fg_num
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """ref layers/detection.py sigmoid_focal_loss → sigmoid_focal_loss op."""
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+                     outputs={"Out": [out]},
+                     attrs={"gamma": gamma, "alpha": alpha})
+    return out
+
+
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
-             name=None):
+             clip_bbox=True, name=None):
     helper = LayerHelper("yolo_box", name=name)
     boxes = helper.create_variable_for_type_inference(x.dtype, True)
     scores = helper.create_variable_for_type_inference(x.dtype, True)
@@ -68,15 +331,339 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
                      outputs={"Boxes": [boxes], "Scores": [scores]},
                      attrs={"anchors": list(anchors), "class_num": class_num,
                             "conf_thresh": conf_thresh,
-                            "downsample_ratio": downsample_ratio})
+                            "downsample_ratio": downsample_ratio,
+                            "clip_bbox": clip_bbox})
     return boxes, scores
 
 
-def multiclass_nms(*a, **k):
-    raise NotImplementedError(
-        "multiclass_nms: dynamic-output NMS is host-side; run it on fetched "
-        "numpy outputs via paddle_tpu.utils.nms.multiclass_nms_np")
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """ref layers/detection.py yolov3_loss → yolov3_loss op."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(x.dtype)
+    gt_match = helper.create_variable_for_type_inference("int32")
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    helper.append_op("yolov3_loss", inputs=ins,
+                     outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                              "GTMatchMask": [gt_match]},
+                     attrs={"anchors": list(anchors),
+                            "anchor_mask": list(anchor_mask),
+                            "class_num": class_num,
+                            "ignore_thresh": ignore_thresh,
+                            "downsample_ratio": downsample_ratio,
+                            "use_label_smooth": use_label_smooth})
+    return loss
 
 
-def detection_output(*a, **k):
-    raise NotImplementedError("detection_output: see multiclass_nms")
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """ref layers/detection.py multi_box_head: per-feature-map conv heads +
+    prior boxes, concatenated over maps (the SSD head)."""
+    from . import nn, tensor
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # ref: interpolate ratios between min_ratio and max_ratio
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0],
+                                            (list, tuple)) else aspect_ratios
+        st = steps[i] if steps else [
+            step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box(inp, image,
+                             [mins] if not isinstance(mins, list) else mins,
+                             [maxs] if maxs and not isinstance(maxs, list)
+                             else maxs,
+                             ar, variance, flip, clip, st, offset,
+                             min_max_aspect_ratios_order=
+                             min_max_aspect_ratios_order)
+        num_boxes = int(np.prod(box.shape[:-1]))
+        n_per_cell = box.shape[2]
+        boxes_l.append(nn.reshape(box, [-1, 4]))
+        vars_l.append(nn.reshape(var, [-1, 4]))
+        loc = nn.conv2d(inp, n_per_cell * 4, kernel_size, padding=pad,
+                        stride=stride)
+        # [b, p4, h, w] -> [b, h, w, p4] -> [b, -1, 4]
+        loc = nn.transpose(loc, [0, 2, 3, 1])
+        locs.append(nn.reshape(loc, [0, -1, 4]))
+        conf = nn.conv2d(inp, n_per_cell * num_classes, kernel_size,
+                         padding=pad, stride=stride)
+        conf = nn.transpose(conf, [0, 2, 3, 1])
+        confs.append(nn.reshape(conf, [0, -1, num_classes]))
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    box = tensor.concat(boxes_l, axis=0)
+    var = tensor.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, box, var
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """roi_pool op; ``rois`` dense [n, 4]; ``rois_num`` per-image ROI
+    counts [b] (the reference's RoisNum/LoD convention)."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int64")
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("roi_pool", inputs=ins,
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("roi_align", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("psroi_pool", inputs=ins, outputs={"Out": [out]},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, rois_num=None, name=None):
+    helper = LayerHelper("prroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("prroi_pool", inputs=ins, outputs={"Out": [out]},
+                     attrs={"spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    o2i = helper.create_variable_for_type_inference("int64")
+    o2w = helper.create_variable_for_type_inference("float32")
+    tm = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("roi_perspective_transform", inputs=ins,
+                     outputs={"Out": [out], "Out2InIdx": [o2i],
+                              "Out2InWeights": [o2w],
+                              "TransformMatrix": [tm]},
+                     attrs={"transformed_height": transformed_height,
+                            "transformed_width": transformed_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """ref layers/detection.py generate_proposals → generate_proposals op.
+    Dense: RpnRois [b, post_nms_top_n, 4] zero-padded + RpnRoisNum [b]."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("generate_proposals",
+                     inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                             "ImInfo": [im_info], "Anchors": [anchors],
+                             "Variances": [variances]},
+                     outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                              "RpnRoisNum": [num]},
+                     attrs={"pre_nms_topN": pre_nms_top_n,
+                            "post_nms_topN": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size,
+                            "eta": eta})
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """ref layers/detection.py generate_proposal_labels op."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference("float32")
+    labels = helper.create_variable_for_type_inference("int64")
+    tgt = helper.create_variable_for_type_inference("float32")
+    inw = helper.create_variable_for_type_inference("float32")
+    outw = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("generate_proposal_labels",
+                     inputs={"RpnRois": [rpn_rois],
+                             "GtClasses": [gt_classes],
+                             "GtBoxes": [gt_boxes]},
+                     outputs={"Rois": [rois], "LabelsInt32": [labels],
+                              "BboxTargets": [tgt],
+                              "BboxInsideWeights": [inw],
+                              "BboxOutsideWeights": [outw],
+                              "RoisNum": [num]},
+                     attrs={"batch_size_per_im": batch_size_per_im,
+                            "fg_fraction": fg_fraction,
+                            "fg_thresh": fg_thresh,
+                            "bg_thresh_hi": bg_thresh_hi,
+                            "bg_thresh_lo": bg_thresh_lo,
+                            "bbox_reg_weights": list(bbox_reg_weights),
+                            "class_nums": class_nums or 81})
+    return rois, labels, tgt, inw, outw
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         match_indices=None):
+    """ref layers/detection.py generate_mask_labels op (box-approx segms)."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference("float32")
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask_int32 = helper.create_variable_for_type_inference("int32")
+    ins = {"Rois": [rois], "LabelsInt32": [labels_int32],
+           "GtSegms": [gt_segms]}
+    if match_indices is not None:
+        ins["MatchIndices"] = [match_indices]
+    helper.append_op("generate_mask_labels", inputs=ins,
+                     outputs={"MaskRois": [mask_rois],
+                              "RoiHasMaskInt32": [has_mask],
+                              "MaskInt32": [mask_int32]},
+                     attrs={"num_classes": num_classes,
+                            "resolution": resolution})
+    return mask_rois, has_mask, mask_int32
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """ref layers/detection.py distribute_fpn_proposals op.  Dense: each
+    level's buffer is [n, 4] with non-member rows zeroed; masks say which."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_level = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference("float32")
+            for _ in range(n_level)]
+    masks = [helper.create_variable_for_type_inference("int32")
+             for _ in range(n_level)]
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op("distribute_fpn_proposals",
+                     inputs={"FpnRois": [fpn_rois]},
+                     outputs={"MultiFpnRois": outs,
+                              "MultiLevelMask": masks,
+                              "RestoreIndex": [restore]},
+                     attrs={"min_level": min_level, "max_level": max_level,
+                            "refer_level": refer_level,
+                            "refer_scale": refer_scale})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """ref layers/detection.py collect_fpn_proposals op."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("collect_fpn_proposals",
+                     inputs={"MultiLevelRois": list(multi_rois),
+                             "MultiLevelScores": list(multi_scores)},
+                     outputs={"FpnRois": [out], "RoisNum": [num]},
+                     attrs={"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_value=4.135, name=None):
+    """ref layers/detection.py box_decoder_and_assign op."""
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = helper.create_variable_for_type_inference(target_box.dtype)
+    assigned = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op("box_decoder_and_assign",
+                     inputs={"PriorBox": [prior_box],
+                             "PriorBoxVar": [prior_box_var],
+                             "TargetBox": [target_box],
+                             "BoxScore": [box_score]},
+                     outputs={"DecodeBox": [decoded],
+                              "OutputAssignBox": [assigned]},
+                     attrs={"box_clip": box_clip_value})
+    return decoded, assigned
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """ref layers/detection.py retinanet_detection_output op.
+
+    ``bboxes``: per-level delta tensors [b, Ai, 4]; ``scores``: per-level
+    sigmoid scores [b, Ai, C]; ``anchors``: per-level anchors [Ai, 4].
+    """
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    index = helper.create_variable_for_type_inference("int64")
+    helper.append_op("retinanet_detection_output",
+                     inputs={"BBoxes": list(anchors),
+                             "Deltas": list(bboxes),
+                             "Scores": list(scores),
+                             "ImInfo": [im_info]},
+                     outputs={"Out": [out], "NmsRoisNum": [num],
+                              "Index": [index]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "nms_eta": nms_eta})
+    return out

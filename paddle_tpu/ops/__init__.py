@@ -2,7 +2,8 @@
 op's JAX lowering (the TPU stand-in for the reference's static
 REGISTER_OPERATOR initializers)."""
 
-from . import (attention_ops, control_flow_ops, math_ops, metrics_ops,  # noqa
-               misc_ops, nn_ops, optimizer_ops, reduce_ops, rnn_ops,
-               sequence_ops, structured_ops, tensor_ops)
+from . import (attention_ops, control_flow_ops, detection_ops,  # noqa
+               math_ops, metrics_ops, misc_ops, nn_ops, optimizer_ops,
+               reduce_ops, rnn_ops, sequence_ops, structured_ops,
+               tensor_ops)
 from ..framework.registry import registered_ops  # noqa

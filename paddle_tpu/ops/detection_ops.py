@@ -1,0 +1,1368 @@
+"""Detection op lowerings (ref ``operators/detection/`` — 60 CUDA/C++
+kernels).
+
+TPU-native design: everything is dense and fixed-shape.  Where the
+reference emits variable-length LoD outputs (NMS, proposals, matched
+targets), we emit ``[batch, K, ...]`` buffers padded with -1 plus explicit
+count tensors — the XLA-compatible re-expression (dynamic shapes don't
+compile).  Sequential suppression loops are ``lax.fori_loop`` over a
+pairwise-IoU matrix, which XLA keeps on-chip.
+
+Boxes are ``[x1, y1, x2, y2]``; ``normalized=True`` means coordinates in
+[0, 1] (reference convention: pixel extents get a +1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import X, XS
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers
+# ---------------------------------------------------------------------------
+
+def box_area(b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    w = jnp.maximum(b[..., 2] - b[..., 0] + off, 0.0)
+    h = jnp.maximum(b[..., 3] - b[..., 1] + off, 0.0)
+    return w * h
+
+
+def pairwise_iou(a, b, normalized=True):
+    """[n,4] x [m,4] -> [n,m] (ref detection/iou_similarity_op.h)."""
+    off = 0.0 if normalized else 1.0
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(x2 - x1 + off, 0.0) * jnp.maximum(y2 - y1 + off, 0.0)
+    union = box_area(a, normalized)[:, None] + \
+        box_area(b, normalized)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity", no_grad=True)
+def _iou_similarity(ctx, ins, attrs):
+    x, y = X(ins, "X"), X(ins, "Y")
+    norm = attrs.get("box_normalized", True)
+    if x.ndim == 3:      # batched [b, n, 4]
+        out = jax.vmap(lambda a, c: pairwise_iou(a, c, norm))(x, y)
+    else:
+        out = pairwise_iou(x, y, norm)
+    return {"Out": [out]}
+
+
+@register_op("box_clip", no_grad=True)
+def _box_clip(ctx, ins, attrs):
+    box = X(ins, "Input")               # [b, n, 4] or [n, 4]
+    im_info = X(ins, "ImInfo")          # [b, 3] (h, w, scale)
+    def clip(b, info):
+        h, w = info[0] - 1.0, info[1] - 1.0
+        return jnp.stack([jnp.clip(b[..., 0], 0, w),
+                          jnp.clip(b[..., 1], 0, h),
+                          jnp.clip(b[..., 2], 0, w),
+                          jnp.clip(b[..., 3], 0, h)], axis=-1)
+    if box.ndim == 3:
+        out = jax.vmap(clip)(box, im_info)
+    else:
+        out = clip(box, im_info[0])
+    return {"Output": [out]}
+
+
+@register_op("box_coder", no_grad=True)
+def _box_coder(ctx, ins, attrs):
+    """ref detection/box_coder_op.h: encode/decode center-size deltas."""
+    prior = X(ins, "PriorBox")          # [m, 4]
+    pvar = X(ins, "PriorBoxVar")        # [m, 4] or None
+    target = X(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    axis = attrs.get("axis", 0)
+    var_attr = attrs.get("variance", None)
+    off = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if pvar is None and var_attr:
+        pvar = jnp.broadcast_to(jnp.asarray(var_attr, prior.dtype),
+                                prior.shape)
+
+    if code_type.lower() == "encode_center_size":
+        # target [n, 4] vs every prior -> [n, m, 4]
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:                                # decode_center_size
+        if target.ndim == 2:
+            # one-to-one: delta row i decodes against prior row i
+            d = target * pvar if pvar is not None else target
+            pw_, ph_, pcx_, pcy_ = pw, ph, pcx, pcy
+        else:
+            # [n, m, 4] deltas; axis picks which dim aligns with priors
+            if axis == 0:
+                pvar_b = pvar[None, :, :] if pvar is not None else None
+                pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                        pcx[None, :], pcy[None, :])
+            else:
+                pvar_b = pvar[:, None, :] if pvar is not None else None
+                pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                        pcx[:, None], pcy[:, None])
+            d = target * pvar_b if pvar_b is not None else target
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                         cx + 0.5 * w - off, cy + 0.5 * h - off], axis=-1)
+    return {"OutputBox": [out]}
+
+
+# ---------------------------------------------------------------------------
+# priors / anchors (ref detection/prior_box_op.h, density_prior_box_op.h,
+# anchor_generator_op.h)
+# ---------------------------------------------------------------------------
+
+def _prior_grid(h, w, step_w, step_h, offset):
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    return jnp.meshgrid(cx, cy)         # each [h, w]
+
+
+@register_op("prior_box", no_grad=True)
+def _prior_box(ctx, ins, attrs):
+    feat = X(ins, "Input")              # [b, c, h, w]
+    img = X(ins, "Image")               # [b, c, H, W]
+    h, w = feat.shape[-2], feat.shape[-1]
+    ih, iw = img.shape[-2], img.shape[-1]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+    mmorder = attrs.get("min_max_aspect_ratios_order", False)
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        if mmorder:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = np.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                big = np.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+    whs = np.asarray(whs, np.float32)   # [p, 2]
+    cxg, cyg = _prior_grid(h, w, step_w, step_h, offset)
+    cx = cxg[:, :, None]
+    cy = cyg[:, :, None]
+    bw = whs[None, None, :, 0] / 2.0
+    bh = whs[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cx - bw) / iw, (cy - bh) / ih,
+                       (cx + bw) / iw, (cy + bh) / ih], axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("density_prior_box", no_grad=True)
+def _density_prior_box(ctx, ins, attrs):
+    feat, img = X(ins, "Input"), X(ins, "Image")
+    h, w = feat.shape[-2], feat.shape[-1]
+    ih, iw = img.shape[-2], img.shape[-1]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [])]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+
+    # per-cell sub-grid shifted boxes (ref density_prior_box_op.h:71-115)
+    whs, shifts = [], []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step_avg_w = step_w / density
+            step_avg_h = step_h / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = (dj + 0.5) * step_avg_w - step_w / 2.0
+                    sy = (di + 0.5) * step_avg_h - step_h / 2.0
+                    whs.append((bw, bh))
+                    shifts.append((sx, sy))
+    whs = np.asarray(whs, np.float32)
+    shifts = np.asarray(shifts, np.float32)
+    cxg, cyg = _prior_grid(h, w, step_w, step_h, offset)
+    cx = cxg[:, :, None] + shifts[None, None, :, 0]
+    cy = cyg[:, :, None] + shifts[None, None, :, 1]
+    bw = whs[None, None, :, 0] / 2.0
+    bh = whs[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cx - bw) / iw, (cy - bh) / ih,
+                       (cx + bw) / iw, (cy + bh) / ih], axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("anchor_generator", no_grad=True)
+def _anchor_generator(ctx, ins, attrs):
+    feat = X(ins, "Input")              # [b, c, h, w]
+    h, w = feat.shape[-2], feat.shape[-1]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64., 128., 256.])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [.5, 1., 2.])]
+    stride = [float(s) for s in attrs["stride"]]
+    offset = attrs.get("offset", 0.5)
+    # ref anchor_generator_op.h: w = size*sqrt(1/r), h = size*sqrt(r)
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            whs.append((s * np.sqrt(1.0 / r), s * np.sqrt(r)))
+    whs = np.asarray(whs, np.float32)
+    cxg, cyg = _prior_grid(h, w, stride[0], stride[1], offset)
+    cx, cy = cxg[:, :, None], cyg[:, :, None]
+    bw = whs[None, None, :, 0] / 2.0
+    bh = whs[None, None, :, 1] / 2.0
+    anchors = jnp.stack([cx - bw, cy - bh, cx + bw, cy + bh], axis=-1)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# NMS family (ref detection/multiclass_nms_op.cc) — fixed-shape variant
+# ---------------------------------------------------------------------------
+
+def nms_keep(boxes, scores, iou_threshold, score_threshold=-1e9,
+             normalized=True):
+    """Greedy NMS over pre-sorted-by-caller candidates.
+
+    Returns a bool keep mask aligned with the input order.  Sequential
+    dependence is expressed as fori_loop over the IoU matrix.
+    """
+    m = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b_sorted = boxes[order]
+    s_sorted = scores[order]
+    iou = pairwise_iou(b_sorted, b_sorted, normalized)
+    idx = jnp.arange(m)
+
+    def body(i, keep):
+        sup = jnp.any((iou[i] > iou_threshold) & keep & (idx < i))
+        ok = (~sup) & (s_sorted[i] > score_threshold)
+        return keep.at[i].set(ok)
+
+    keep_sorted = jax.lax.fori_loop(0, m, body, jnp.zeros((m,), bool))
+    keep = jnp.zeros((m,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register_op("multiclass_nms", no_grad=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """Dense multiclass NMS: Out [b, keep_top_k, 6] (label, score, box),
+    padded with -1; NumDetections [b].  (The reference emits a LoD tensor of
+    exactly the kept rows — a dynamic shape XLA can't express.)"""
+    bboxes = X(ins, "BBoxes")           # [b, m, 4]
+    scores = X(ins, "Scores")           # [b, c, m]
+    bg = attrs.get("background_label", 0)
+    score_th = attrs.get("score_threshold", 0.0)
+    nms_th = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    keep_top_k = int(attrs.get("keep_top_k", 200))
+    normalized = attrs.get("normalized", True)
+    b, c, m = scores.shape
+    k_cls = min(nms_top_k, m) if nms_top_k > 0 else m
+    if keep_top_k < 0:
+        keep_top_k = c * k_cls
+    k_eff = min(keep_top_k, c * k_cls)    # keep_top_k is an upper bound
+
+    def per_image(boxes_i, scores_i):
+        def per_class(cls_scores):
+            top_s, top_i = jax.lax.top_k(cls_scores, k_cls)
+            keep = nms_keep(boxes_i[top_i], top_s, nms_th, score_th,
+                            normalized)
+            return top_s, top_i, keep
+        top_s, top_i, keep = jax.vmap(per_class)(scores_i)   # [c, k_cls]
+        cls_ids = jnp.broadcast_to(jnp.arange(c)[:, None], top_s.shape)
+        valid = keep & (cls_ids != bg)
+        flat_s = jnp.where(valid, top_s, -jnp.inf).reshape(-1)
+        sel_s, sel = jax.lax.top_k(flat_s, k_eff)
+        sel_cls = cls_ids.reshape(-1)[sel]
+        sel_idx = top_i.reshape(-1)[sel]                     # box row in m
+        sel_box = boxes_i[sel_idx]
+        ok = jnp.isfinite(sel_s)
+        out = jnp.concatenate(
+            [jnp.where(ok, sel_cls, -1)[:, None].astype(boxes_i.dtype),
+             jnp.where(ok, sel_s, -1.0)[:, None],
+             jnp.where(ok[:, None], sel_box, -1.0)], axis=1)
+        pad = keep_top_k - k_eff
+        if pad:
+            out = jnp.concatenate(
+                [out, jnp.full((pad, 6), -1.0, out.dtype)], axis=0)
+        index = jnp.where(ok, sel_idx, -1)
+        if pad:
+            index = jnp.concatenate(
+                [index, jnp.full((pad,), -1, index.dtype)])
+        return out, jnp.sum(ok.astype(jnp.int32)), index
+
+    out, num, index = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "NmsRoisNum": [num],
+            "Index": [index[..., None].astype(jnp.int64)]}
+
+
+@register_op("detection_output", no_grad=True)
+def _detection_output(ctx, ins, attrs):
+    """SSD post-process: decode loc deltas vs priors, then multiclass NMS
+    (ref layers/detection.py detection_output composition)."""
+    loc = X(ins, "Loc")                 # [b, m, 4] deltas
+    scores = X(ins, "Scores")           # [b, m, c] (softmax-ed)
+    prior = X(ins, "PriorBox")          # [m, 4]
+    pvar = X(ins, "PriorBoxVar")        # [m, 4]
+
+    def decode(d):
+        ob = _box_coder(ctx, {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                              "TargetBox": [d]},
+                        {"code_type": "decode_center_size", "axis": 0})
+        return ob["OutputBox"][0]
+
+    boxes = jax.vmap(decode)(loc)       # [b, m, 4]
+    nms_ins = {"BBoxes": [boxes],
+               "Scores": [jnp.swapaxes(scores, 1, 2)]}
+    return _multiclass_nms(ctx, nms_ins, attrs)
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment (ref detection/bipartite_match_op.cc,
+# target_assign_op.h, rpn_target_assign_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("bipartite_match", no_grad=True)
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy global bipartite match (ref bipartite_match_op.cc
+    BipartiteMatch): repeatedly take the largest remaining entry.
+    DistMat [b, n_gt, m_prior] → ColToRowMatchIndices [b, m] (-1 = none),
+    ColToRowMatchDist [b, m]."""
+    dist = X(ins, "DistMat")
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_th = attrs.get("dist_threshold", 0.5)
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, n, m = dist.shape
+
+    def per_image(d):
+        def body(_, state):
+            match_idx, match_dist, dd = state
+            flat = jnp.argmax(dd)
+            r, c = flat // m, flat % m
+            ok = dd[r, c] > 0
+            match_idx = jnp.where(ok, match_idx.at[c].set(r), match_idx)
+            match_dist = jnp.where(ok, match_dist.at[c].set(dd[r, c]),
+                                   match_dist)
+            dd = jnp.where(ok, dd.at[r, :].set(-1.0).at[:, c].set(-1.0), dd)
+            return match_idx, match_dist, dd
+
+        init = (jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), d.dtype), d)
+        match_idx, match_dist, _ = jax.lax.fori_loop(0, min(n, m), body, init)
+        if match_type == "per_prediction":
+            # extra matches: any unmatched col whose best row IoU > threshold
+            best_r = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_d = jnp.max(d, axis=0)
+            extra = (match_idx == -1) & (best_d > overlap_th)
+            match_idx = jnp.where(extra, best_r, match_idx)
+            match_dist = jnp.where(extra, best_d, match_dist)
+        return match_idx, match_dist
+
+    mi, md = jax.vmap(per_image)(dist)
+    return {"ColToRowMatchIndices": [mi], "ColToRowMatchDist": [md]}
+
+
+@register_op("target_assign", no_grad=True)
+def _target_assign(ctx, ins, attrs):
+    """Gather per-match targets (ref target_assign_op.h): out[i, j] =
+    X[i, match[i, j]] where matched, else mismatch_value; weight 1/0."""
+    x = X(ins, "X")                     # [b, n, k] or [n, k] per-image rows
+    match = X(ins, "MatchIndices")      # [b, m]
+    mismatch = attrs.get("mismatch_value", 0)
+    if x.ndim == 2:
+        x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    w = matched.astype(x.dtype)
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("rpn_target_assign", no_grad=True, stateful_rng=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """Anchor sampling for RPN (ref rpn_target_assign_op.cc): label anchors
+    by IoU vs gt (pos > pos_th or best-per-gt; neg < neg_th), subsample to
+    batch_size_per_im * fg_fraction positives.  Dense outputs: per-anchor
+    labels [b, A] in {-1 ignore, 0 neg, 1 pos}, matched gt index [b, A],
+    bbox targets [b, A, 4] (encoded deltas)."""
+    anchor = X(ins, "Anchor")           # [A, 4]
+    gt = X(ins, "GtBoxes")              # [b, G, 4] (padded with zeros)
+    is_crowd = X(ins, "IsCrowd")
+    pos_th = attrs.get("rpn_positive_overlap", 0.7)
+    neg_th = attrs.get("rpn_negative_overlap", 0.3)
+    batch_per_im = attrs.get("rpn_batch_size_per_im", 256)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    if gt.ndim == 2:
+        gt = gt[None]
+    b, g, _ = gt.shape
+    a = anchor.shape[0]
+    key = ctx.rng()
+
+    def per_image(gt_i, key_i):
+        valid_gt = box_area(gt_i) > 0
+        iou = pairwise_iou(gt_i, anchor, normalized=False)      # [G, A]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)                       # [A]
+        best_iou = jnp.max(iou, axis=0)
+        labels = jnp.full((a,), -1, jnp.int32)
+        labels = jnp.where(best_iou < neg_th, 0, labels)
+        labels = jnp.where(best_iou >= pos_th, 1, labels)
+        # every gt's best anchor is positive
+        best_a_per_gt = jnp.argmax(iou, axis=1)                 # [G]
+        labels = labels.at[best_a_per_gt].set(
+            jnp.where(valid_gt, 1, labels[best_a_per_gt]))
+        # subsample: keep at most fg positives / rest negatives by random
+        # priority (dense analog of the reference's random shuffle)
+        k1, k2 = jax.random.split(key_i)
+        fg_cap = int(batch_per_im * fg_frac)
+        pri_pos = jax.random.uniform(k1, (a,)) + (labels == 1)
+        pos_rank = jnp.argsort(jnp.argsort(-pri_pos))
+        labels = jnp.where((labels == 1) & (pos_rank >= fg_cap), -1, labels)
+        n_pos = jnp.sum((labels == 1).astype(jnp.int32))
+        neg_cap = batch_per_im - jnp.minimum(n_pos, fg_cap)
+        pri_neg = jax.random.uniform(k2, (a,)) + (labels == 0)
+        neg_rank = jnp.argsort(jnp.argsort(-pri_neg))
+        labels = jnp.where((labels == 0) & (neg_rank >= neg_cap), -1, labels)
+        # bbox targets: encode matched gt vs anchor
+        mgt = gt_i[best_gt]
+        aw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ah = anchor[:, 3] - anchor[:, 1] + 1.0
+        acx = anchor[:, 0] + aw * 0.5
+        acy = anchor[:, 1] + ah * 0.5
+        gw = mgt[:, 2] - mgt[:, 0] + 1.0
+        gh = mgt[:, 3] - mgt[:, 1] + 1.0
+        gcx = mgt[:, 0] + gw * 0.5
+        gcy = mgt[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                         jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
+        return labels, best_gt.astype(jnp.int32), tgt
+
+    keys = jax.random.split(key, b)
+    labels, match, tgt = jax.vmap(per_image)(gt, keys)
+    return {"ScoreIndex": [labels], "LocationIndex": [match],
+            "TargetLabel": [labels.astype(jnp.int64)],
+            "TargetBBox": [tgt],
+            "BBoxInsideWeight": [(labels == 1)[..., None].astype(tgt.dtype) *
+                                 jnp.ones_like(tgt)]}
+
+
+@register_op("retinanet_target_assign", no_grad=True)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """Like rpn_target_assign but no subsampling and class labels
+    (ref retinanet_target_assign in rpn_target_assign_op.cc)."""
+    anchor = X(ins, "Anchor")
+    gt = X(ins, "GtBoxes")
+    gt_labels = X(ins, "GtLabels")      # [b, G] (padded 0)
+    pos_th = attrs.get("positive_overlap", 0.5)
+    neg_th = attrs.get("negative_overlap", 0.4)
+    if gt.ndim == 2:
+        gt = gt[None]
+    b = gt.shape[0]
+    a = anchor.shape[0]
+
+    def per_image(gt_i, gl_i):
+        valid_gt = box_area(gt_i) > 0
+        iou = pairwise_iou(gt_i, anchor, normalized=False)
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)
+        best_iou = jnp.max(iou, axis=0)
+        labels = jnp.full((a,), -1, jnp.int32)                  # ignore
+        labels = jnp.where(best_iou < neg_th, 0, labels)        # background
+        pos = best_iou >= pos_th
+        cls = gl_i[best_gt].astype(jnp.int32)
+        labels = jnp.where(pos, cls, labels)
+        best_a_per_gt = jnp.argmax(iou, axis=1)
+        labels = labels.at[best_a_per_gt].set(
+            jnp.where(valid_gt, gl_i.astype(jnp.int32), labels[best_a_per_gt]))
+        mgt = gt_i[best_gt]
+        aw = anchor[:, 2] - anchor[:, 0] + 1.0
+        ah = anchor[:, 3] - anchor[:, 1] + 1.0
+        acx = anchor[:, 0] + aw * 0.5
+        acy = anchor[:, 1] + ah * 0.5
+        gw = mgt[:, 2] - mgt[:, 0] + 1.0
+        gh = mgt[:, 3] - mgt[:, 1] + 1.0
+        gcx = mgt[:, 0] + gw * 0.5
+        gcy = mgt[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                         jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
+        fg_num = jnp.sum(((labels > 0)).astype(jnp.int32)) + 1
+        return labels.astype(jnp.int64), tgt, fg_num
+
+    labels, tgt, fg = jax.vmap(per_image)(gt, gt_labels)
+    return {"TargetLabel": [labels], "TargetBBox": [tgt],
+            "ForegroundNumber": [fg[:, None]],
+            "BBoxInsideWeight": [(labels > 0)[..., None].astype(tgt.dtype) *
+                                 jnp.ones_like(tgt)]}
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """ref detection/sigmoid_focal_loss_op.cu: FL = -alpha_t (1-p_t)^gamma
+    log(p_t), label 0 = background, c in [1..C] one-vs-all."""
+    x = X(ins, "X")                     # [n, C] logits
+    label = X(ins, "Label")             # [n, 1] in [0..C]
+    fg_num = X(ins, "FgNum")            # [1]
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = x.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    t = (lab[:, None] == jnp.arange(1, c + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    pt = jnp.where(t > 0, p, 1.0 - p)
+    at = jnp.where(t > 0, alpha, 1.0 - alpha)
+    ce = -jnp.log(jnp.maximum(pt, 1e-10))
+    loss = at * jnp.power(1.0 - pt, gamma) * ce
+    # FgNum may be a scalar total or per-image [b, 1] counts
+    # (retinanet_target_assign emits the latter) — normalize by the total
+    denom = jnp.maximum(jnp.sum(fg_num).astype(x.dtype), 1.0)
+    return {"Out": [loss / denom]}
+
+
+# ---------------------------------------------------------------------------
+# YOLO (ref detection/yolo_box_op.h, yolov3_loss_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box", no_grad=True)
+def _yolo_box(ctx, ins, attrs):
+    x = X(ins, "X")                     # [b, an*(5+cls), h, w]
+    img_size = X(ins, "ImgSize")        # [b, 2] (h, w)
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_th = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    clip_bbox = attrs.get("clip_bbox", True)
+    an = len(anchors) // 2
+    b, _, h, w = x.shape
+    x = x.reshape(b, an, 5 + class_num, h, w)
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    in_h, in_w = float(h * downsample), float(w * downsample)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / w       # [b, an, h, w]
+    cy = (jax.nn.sigmoid(x[:, :, 1]) + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2.0) * imw
+    y1 = (cy - bh / 2.0) * imh
+    x2 = (cx + bw / 2.0) * imw
+    y2 = (cy + bh / 2.0) * imh
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, imw - 1.0)
+        y2 = jnp.minimum(y2, imh - 1.0)
+    keep = conf > conf_th
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    probs = jnp.where(keep[:, :, None], probs, 0.0)
+    boxes = boxes.reshape(b, an * h * w, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(b, an * h * w, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, ins, attrs):
+    """ref detection/yolov3_loss_op.h: per-gt responsible-anchor assignment
+    + coord/conf/class losses; objectness ignored where best IoU >
+    ignore_thresh."""
+    x = X(ins, "X")                     # [b, an_mask*(5+cls), h, w]
+    gt_box = X(ins, "GTBox")            # [b, G, 4] (cx, cy, w, h) relative
+    gt_label = X(ins, "GTLabel")        # [b, G]
+    anchors = [float(a) for a in attrs["anchors"]]
+    mask = [int(m) for m in attrs.get("anchor_mask", [])]
+    class_num = attrs["class_num"]
+    ignore_th = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    use_label_smooth = attrs.get("use_label_smooth", True)
+    an_all = len(anchors) // 2
+    an = len(mask) or an_all
+    mask = mask or list(range(an_all))
+    b, _, h, w = x.shape
+    g = gt_box.shape[1]
+    x = x.reshape(b, an, 5 + class_num, h, w)
+    in_w, in_h = w * downsample, h * downsample
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+    aw = aw_all[jnp.asarray(mask)]
+    ah = ah_all[jnp.asarray(mask)]
+
+    tx = x[:, :, 0]
+    ty = x[:, :, 1]
+    tw = x[:, :, 2]
+    th = x[:, :, 3]
+    tconf = x[:, :, 4]
+    tcls = x[:, :, 5:]                  # [b, an, cls, h, w]
+
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)       # [b, G]
+    # responsible anchor: best wh-IoU against ALL anchors at origin
+    gw = gt_box[..., 2] * in_w          # [b, G]
+    gh = gt_box[..., 3] * in_h
+    inter = jnp.minimum(gw[..., None], aw_all) * \
+        jnp.minimum(gh[..., None], ah_all)
+    union = gw[..., None] * gh[..., None] + aw_all * ah_all - inter
+    wh_iou = inter / jnp.maximum(union, 1e-10)                # [b, G, an_all]
+    best_anchor = jnp.argmax(wh_iou, axis=-1)                 # [b, G]
+    # only gts whose best anchor is in this layer's mask produce targets
+    mask_arr = jnp.asarray(mask)
+    in_mask = (best_anchor[..., None] == mask_arr).any(-1) & valid
+    local_a = jnp.argmax(
+        (best_anchor[..., None] == mask_arr).astype(jnp.int32), axis=-1)
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # scatter targets onto the grid
+    def scatter_img(loc_a, gj_i, gi_i, ok, gbox, glab):
+        obj = jnp.zeros((an, h, w), jnp.float32)
+        txy = jnp.zeros((an, h, w, 2), jnp.float32)
+        twh = jnp.zeros((an, h, w, 2), jnp.float32)
+        tcl = jnp.zeros((an, h, w), jnp.int32)
+        tscale = jnp.zeros((an, h, w), jnp.float32)
+        # invalid gts (padding rows / other-layer anchors) are routed to an
+        # out-of-range anchor slot and dropped — they must not clobber a
+        # real target at (0, 0, 0)
+        loc_a = jnp.where(ok, loc_a, an)
+        idx = (loc_a, gj_i, gi_i)
+        obj = obj.at[idx].add(1.0, mode="drop")
+        sx = gbox[:, 0] * w - gi_i
+        sy = gbox[:, 1] * h - gj_i
+        safe_a = jnp.minimum(loc_a, an - 1)
+        sw = jnp.log(jnp.maximum(gbox[:, 2] * in_w / aw[safe_a], 1e-9))
+        sh = jnp.log(jnp.maximum(gbox[:, 3] * in_h / ah[safe_a], 1e-9))
+        txy = txy.at[idx].set(jnp.stack([sx, sy], -1), mode="drop")
+        twh = twh.at[idx].set(jnp.stack([sw, sh], -1), mode="drop")
+        tcl = tcl.at[idx].set(glab.astype(jnp.int32), mode="drop")
+        tscale = tscale.at[idx].set(2.0 - gbox[:, 2] * gbox[:, 3],
+                                    mode="drop")
+        return jnp.minimum(obj, 1.0), txy, twh, tcl, tscale
+
+    obj, txy_t, twh_t, tcl_t, tscale = jax.vmap(scatter_img)(
+        local_a, gj, gi, in_mask, gt_box, gt_label)
+
+    # objectness-ignore: predicted boxes with IoU > thresh vs any gt
+    gx_ = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy_ = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    pcx = (jax.nn.sigmoid(tx) + gx_) / w
+    pcy = (jax.nn.sigmoid(ty) + gy_) / h
+    pw = jnp.exp(jnp.clip(tw, -10, 10)) * aw[None, :, None, None] / in_w
+    ph = jnp.exp(jnp.clip(th, -10, 10)) * ah[None, :, None, None] / in_h
+    pred = jnp.stack([pcx - pw / 2, pcy - ph / 2,
+                      pcx + pw / 2, pcy + ph / 2], axis=-1)   # [b,an,h,w,4]
+    gtb = jnp.stack([gt_box[..., 0] - gt_box[..., 2] / 2,
+                     gt_box[..., 1] - gt_box[..., 3] / 2,
+                     gt_box[..., 0] + gt_box[..., 2] / 2,
+                     gt_box[..., 1] + gt_box[..., 3] / 2], axis=-1)  # [b,G,4]
+
+    def best_iou_img(p, gt_i, ok):
+        ious = pairwise_iou(p.reshape(-1, 4), gt_i)           # [AHW, G]
+        ious = jnp.where(ok[None, :], ious, 0.0)
+        return jnp.max(ious, axis=-1).reshape(an, h, w)
+
+    biou = jax.vmap(best_iou_img)(pred, gtb, valid)
+    noobj_mask = (biou <= ignore_th).astype(x.dtype) * (1.0 - obj)
+
+    bce = lambda z, t_: jax.nn.softplus(z) - t_ * z            # noqa: E731
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_t = (tcl_t[:, :, None] == jnp.arange(class_num)[
+        None, None, :, None, None]).astype(x.dtype)
+    cls_t = cls_t * (1.0 - smooth) + smooth / class_num
+    loss_xy = tscale * (bce(tx, txy_t[..., 0]) + bce(ty, txy_t[..., 1]))
+    loss_wh = 0.5 * tscale * ((tw - twh_t[..., 0]) ** 2 +
+                              (th - twh_t[..., 1]) ** 2)
+    loss_obj = obj * bce(tconf, jnp.ones_like(tconf)) + \
+        noobj_mask * bce(tconf, jnp.zeros_like(tconf))
+    loss_cls = obj[:, :, None] * bce(tcls, cls_t)
+    loss = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
+            loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return {"Loss": [loss],
+            "ObjectnessMask": [obj], "GTMatchMask": [in_mask.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (ref detection/roi_align_op.*, roi_pool_op.*, psroi_pool_op.*,
+# prroi_pool_op.*)
+# ---------------------------------------------------------------------------
+
+def _rois_batch_index(rois_num, n, b):
+    """Per-image ROI counts [b] (the reference's RoisNum / LoD) → per-roi
+    image index [n]."""
+    if rois_num is None:
+        return jnp.zeros((n,), jnp.int32)
+    counts = rois_num.reshape(-1).astype(jnp.int32)
+    cum = jnp.cumsum(counts)
+    idx = jnp.searchsorted(cum, jnp.arange(n), side="right")
+    return jnp.minimum(idx, b - 1).astype(jnp.int32)
+
+
+def _roi_to_grid(roi, ph, pw, spatial_scale, sampling=2, align=False):
+    """Sample coordinates [ph, pw, s, s, 2] (y, x) for one roi [4]."""
+    off = 0.5 if align else 0.0
+    x1 = roi[0] * spatial_scale - off
+    y1 = roi[1] * spatial_scale - off
+    x2 = roi[2] * spatial_scale - off
+    y2 = roi[3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not align else 1e-3)
+    rh = jnp.maximum(y2 - y1, 1.0 if not align else 1e-3)
+    bw, bh = rw / pw, rh / ph
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    sx = (jnp.arange(sampling, dtype=jnp.float32) + 0.5) / sampling
+    xs = x1 + (ix[:, None] + sx[None, :]) * bw      # [pw, s]
+    ys = y1 + (iy[:, None] + sx[None, :]) * bh      # [ph, s]
+    return ys, xs
+
+
+def _bilinear(feat, y, x):
+    """feat [c, h, w]; y/x broadcastable index arrays → [c, ...]."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y = jnp.clip(y, 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly, lx = y - y0, x - x0
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+            v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+@register_op("roi_align")
+def _roi_align(ctx, ins, attrs):
+    x = X(ins, "X")                     # [b, c, h, w]
+    rois = X(ins, "ROIs")               # [n, 4]
+    roi_batch = X(ins, "RoisNum")     # [n] image index (dense LoD analog)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    sampling = attrs.get("sampling_ratio", -1)
+    s = sampling if sampling > 0 else 2
+    roi_batch = _rois_batch_index(roi_batch, rois.shape[0], x.shape[0])
+
+    def one(roi, bi):
+        feat = x[bi]
+        ys, xs = _roi_to_grid(roi, ph, pw, scale, s, align=True)
+        yy = ys[:, None, :, None]       # [ph, 1, s, 1]
+        xx = xs[None, :, None, :]       # [1, pw, 1, s]
+        vals = _bilinear(feat, jnp.broadcast_to(yy, (ph, pw, s, s)),
+                         jnp.broadcast_to(xx, (ph, pw, s, s)))
+        return vals.mean(axis=(-1, -2))             # [c, ph, pw]
+
+    out = jax.vmap(one)(rois, roi_batch)
+    return {"Out": [out]}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    x = X(ins, "X")
+    rois = X(ins, "ROIs")
+    roi_batch = X(ins, "RoisNum")
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    h, w = x.shape[-2], x.shape[-1]
+    roi_batch = _rois_batch_index(roi_batch, rois.shape[0], x.shape[0])
+
+    def one(roi, bi):
+        feat = x[bi]                    # [c, h, w]
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        # max over each bin via masked reduce on the full map (static shape)
+        yy = jnp.arange(h)[:, None]
+        xx = jnp.arange(w)[None, :]
+        out = []
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + (i * rh) // ph
+                ye = y1 + ((i + 1) * rh + ph - 1) // ph
+                xs_ = x1 + (j * rw) // pw
+                xe = x1 + ((j + 1) * rw + pw - 1) // pw
+                m = (yy >= ys) & (yy < jnp.maximum(ye, ys + 1)) & \
+                    (xx >= xs_) & (xx < jnp.maximum(xe, xs_ + 1))
+                out.append(jnp.max(jnp.where(m[None], feat, -jnp.inf),
+                                   axis=(-1, -2)))
+        return jnp.stack(out, -1).reshape(feat.shape[0], ph, pw)
+
+    out = jax.vmap(one)(rois, roi_batch)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int64)]}
+
+
+@register_op("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI pooling (ref psroi_pool_op.h): channel
+    c*ph*pw → output channel c picks its (i,j) group."""
+    x = X(ins, "X")                     # [b, C*ph*pw, h, w]
+    rois = X(ins, "ROIs")
+    roi_batch = X(ins, "RoisNum")
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    out_c = attrs.get("output_channels")
+    scale = attrs.get("spatial_scale", 1.0)
+    h, w = x.shape[-2], x.shape[-1]
+    roi_batch = _rois_batch_index(roi_batch, rois.shape[0], x.shape[0])
+
+    def one(roi, bi):
+        feat = x[bi].reshape(out_c, ph, pw, h, w)
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale) + 1.0
+        y2 = jnp.round(roi[3] * scale) + 1.0
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        yy = jnp.arange(h, dtype=jnp.float32)[:, None]
+        xx = jnp.arange(w, dtype=jnp.float32)[None, :]
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                ys = jnp.floor(y1 + i * bh)
+                ye = jnp.ceil(y1 + (i + 1) * bh)
+                xs_ = jnp.floor(x1 + j * bw)
+                xe = jnp.ceil(x1 + (j + 1) * bw)
+                m = (yy >= ys) & (yy < ye) & (xx >= xs_) & (xx < xe)
+                cnt = jnp.maximum(jnp.sum(m.astype(jnp.float32)), 1.0)
+                v = jnp.sum(jnp.where(m[None], feat[:, i, j], 0.0),
+                            axis=(-1, -2)) / cnt
+                outs.append(v)
+        return jnp.stack(outs, -1).reshape(out_c, ph, pw)
+
+    out = jax.vmap(one)(rois, roi_batch)
+    return {"Out": [out]}
+
+
+@register_op("prroi_pool")
+def _prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling ≈ roi_align with dense average (ref
+    prroi_pool_op.h); implemented as high-resolution average sampling."""
+    attrs = dict(attrs)
+    attrs.setdefault("sampling_ratio", 4)
+    return _roi_align(ctx, ins, attrs)
+
+
+@register_op("roi_perspective_transform", no_grad=True)
+def _roi_perspective_transform(ctx, ins, attrs):
+    """ref detection/roi_perspective_transform_op.cc: warp a quad ROI to a
+    rectangle by the perspective transform, bilinear-sampled."""
+    x = X(ins, "X")                     # [b, c, h, w]
+    rois = X(ins, "ROIs")               # [n, 8] quad corners
+    roi_batch = X(ins, "RoisNum")
+    th = attrs.get("transformed_height", 1)
+    tw = attrs.get("transformed_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    roi_batch = _rois_batch_index(roi_batch, rois.shape[0], x.shape[0])
+
+    def transform_matrix(quad):
+        # solve the 8-dof homography mapping output rect corners → quad
+        src = jnp.asarray([[0., 0.], [tw - 1.0, 0.],
+                           [tw - 1.0, th - 1.0], [0., th - 1.0]])
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.stack([sx, sy, jnp.asarray(1.0), jnp.asarray(0.0),
+                                   jnp.asarray(0.0), jnp.asarray(0.0),
+                                   -dx * sx, -dx * sy]))
+            rows.append(jnp.stack([jnp.asarray(0.0), jnp.asarray(0.0),
+                                   jnp.asarray(0.0), sx, sy, jnp.asarray(1.0),
+                                   -dy * sx, -dy * sy]))
+        a = jnp.stack(rows)
+        bvec = dst.reshape(-1)
+        sol = jnp.linalg.solve(a, bvec)
+        return jnp.concatenate([sol, jnp.ones((1,))]).reshape(3, 3)
+
+    def one(quad, bi):
+        m = transform_matrix(quad)
+        iy = jnp.arange(th, dtype=jnp.float32)
+        ix = jnp.arange(tw, dtype=jnp.float32)
+        gx, gy = jnp.meshgrid(ix, iy)
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], 0).reshape(3, -1)
+        warped = m @ pts
+        wx = warped[0] / jnp.maximum(warped[2], 1e-8)
+        wy = warped[1] / jnp.maximum(warped[2], 1e-8)
+        vals = _bilinear(x[bi], wy.reshape(th, tw), wx.reshape(th, tw))
+        return vals
+
+    out = jax.vmap(one)(rois, roi_batch)
+    return {"Out": [out], "Out2InIdx": [jnp.zeros((1,), jnp.int64)],
+            "Out2InWeights": [jnp.zeros((1,), jnp.float32)],
+            "TransformMatrix": [jnp.zeros((rois.shape[0], 9),
+                                          jnp.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# proposals (ref detection/generate_proposals_op.cc) + FPN routing
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposals", no_grad=True)
+def _generate_proposals(ctx, ins, attrs):
+    """Decode RPN deltas at top-scored anchors, clip, drop tiny boxes, NMS;
+    fixed post_nms_topN output per image, zero-padded + count."""
+    scores = X(ins, "Scores")           # [b, an, h, w]
+    deltas = X(ins, "BboxDeltas")       # [b, an*4, h, w]
+    im_info = X(ins, "ImInfo")          # [b, 3]
+    anchors = X(ins, "Anchors")         # [h, w, an, 4]
+    variances = X(ins, "Variances")
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_th = attrs.get("nms_thresh", 0.7)
+    min_size = attrs.get("min_size", 0.1)
+    b = scores.shape[0]
+    a4 = anchors.reshape(-1, 4)
+    v4 = variances.reshape(-1, 4) if variances is not None else None
+    total = a4.shape[0]
+    pre_n = min(pre_n, total)
+    post_n = min(post_n, pre_n)
+
+    # consistent [h, w, an] flattening to match anchors [h, w, an, 4]
+    an_n = scores.shape[1]
+    hh, ww = scores.shape[2], scores.shape[3]
+
+    def per_image(sc, dl, info):
+        sc = jnp.transpose(sc, (1, 2, 0)).reshape(-1)             # [hwA]
+        dl = dl.reshape(an_n, 4, hh, ww)
+        dl = jnp.transpose(dl, (2, 3, 0, 1)).reshape(-1, 4)       # [hwA, 4]
+        top_s, top_i = jax.lax.top_k(sc, pre_n)
+        anc = a4[top_i]
+        dvar = dl[top_i] * (v4[top_i] if v4 is not None else 1.0)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = dvar[:, 0] * aw + acx
+        cy = dvar[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(dvar[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(dvar[:, 3], 10.0)) * ah
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1.0, cy + bh / 2 - 1.0], -1)
+        imh, imw = info[0], info[1]
+        props = jnp.stack([jnp.clip(props[:, 0], 0, imw - 1),
+                           jnp.clip(props[:, 1], 0, imh - 1),
+                           jnp.clip(props[:, 2], 0, imw - 1),
+                           jnp.clip(props[:, 3], 0, imh - 1)], -1)
+        ms = min_size * info[2]
+        keep_size = ((props[:, 2] - props[:, 0] + 1.0) >= ms) & \
+                    ((props[:, 3] - props[:, 1] + 1.0) >= ms)
+        s_eff = jnp.where(keep_size, top_s, -jnp.inf)
+        keep = nms_keep(props, s_eff, nms_th, normalized=False) & keep_size
+        s_final = jnp.where(keep, top_s, -jnp.inf)
+        out_s, out_i = jax.lax.top_k(s_final, post_n)
+        ok = jnp.isfinite(out_s)
+        out_b = jnp.where(ok[:, None], props[out_i], 0.0)
+        return out_b, jnp.where(ok, out_s, 0.0), \
+            jnp.sum(ok.astype(jnp.int32))
+
+    boxes, probs, num = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [boxes], "RpnRoiProbs": [probs[..., None]],
+            "RpnRoisNum": [num]}
+
+
+@register_op("distribute_fpn_proposals", no_grad=True)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """Route each ROI to an FPN level by scale (ref
+    distribute_fpn_proposals_op.h): level = floor(log2(sqrt(area)/224) + 4).
+    Dense: per-level buffers [n, 4] zero-padded + per-level valid masks +
+    RestoreIndex."""
+    rois = X(ins, "FpnRois")            # [n, 4]
+    min_level = attrs.get("min_level", 2)
+    max_level = attrs.get("max_level", 5)
+    refer_level = attrs.get("refer_level", 4)
+    refer_scale = attrs.get("refer_scale", 224)
+    n = rois.shape[0]
+    scale = jnp.sqrt(jnp.maximum(box_area(rois, normalized=False), 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, masks = [], []
+    for l in range(min_level, max_level + 1):
+        m = (lvl == l)
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+        masks.append(m)
+    # restore index: stable order of (level, original position)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+    return {"MultiFpnRois": outs,
+            "MultiLevelMask": [m_.astype(jnp.int32) for m_ in masks],
+            "RestoreIndex": [restore[:, None]]}
+
+
+@register_op("collect_fpn_proposals", no_grad=True)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """Merge per-level proposals, keep global top post_nms_topN by score
+    (ref collect_fpn_proposals_op.h)."""
+    rois = XS(ins, "MultiLevelRois")    # list of [ni, 4]
+    scores = XS(ins, "MultiLevelScores")
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    allr = jnp.concatenate(rois, 0)
+    alls = jnp.concatenate([s.reshape(-1) for s in scores], 0)
+    k = min(post_n, allr.shape[0])
+    top_s, top_i = jax.lax.top_k(alls, k)
+    return {"FpnRois": [allr[top_i]], "RoisNum": [
+        jnp.asarray(k, jnp.int32)]}
+
+
+@register_op("box_decoder_and_assign", no_grad=True)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class deltas then pick each roi's best-scoring class box
+    (ref box_decoder_and_assign_op.cc)."""
+    prior = X(ins, "PriorBox")          # [n, 4]
+    pvar = X(ins, "PriorBoxVar")
+    target = X(ins, "TargetBox")        # [n, 4*c]
+    score = X(ins, "BoxScore")          # [n, c]
+    n, c4 = target.shape
+    c = c4 // 4
+    d = target.reshape(n, c, 4)
+    if pvar is not None:
+        d = d * pvar[:, None, :]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(d[..., 2]) * pw[:, None]
+    h = jnp.exp(d[..., 3]) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1.0, cy + h / 2 - 1.0], -1)  # [n, c, 4]
+    best = jnp.argmax(score[:, 1:], axis=-1) + 1    # skip background col 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(n, c4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("polygon_box_transform", no_grad=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """ref detection/polygon_box_transform_op.cc: for active cells, output
+    = 4*cell_coord - predicted offset; EAST-style geometry map."""
+    x = X(ins, "Input")                 # [b, geo(8), h, w]
+    b, g, h, w = x.shape
+    ix = jnp.arange(w, dtype=x.dtype)[None, :]
+    iy = jnp.arange(h, dtype=x.dtype)[:, None]
+    grid = jnp.zeros((g, h, w), x.dtype)
+    grid = grid.at[0::2].set(4.0 * ix[None])
+    grid = grid.at[1::2].set(4.0 * iy[None])
+    return {"Output": [grid[None] - x]}
+
+
+@register_op("generate_proposal_labels", no_grad=True, stateful_rng=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Sample RoIs for the second stage (ref
+    generate_proposal_labels_op.cc): label by IoU vs gt, subsample fg/bg to
+    batch_size_per_im, emit class labels + encoded bbox targets.  Dense:
+    fixed batch_size_per_im rows per image."""
+    rois = X(ins, "RpnRois")            # [b, R, 4]
+    gt_classes = X(ins, "GtClasses")    # [b, G]
+    gt_boxes = X(ins, "GtBoxes")        # [b, G, 4]
+    batch_per_im = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_th = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    class_num = int(attrs.get("class_nums", 81))
+    bbox_weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    if rois.ndim == 2:
+        rois = rois[None]
+    b, r, _ = rois.shape
+    key = ctx.rng()
+
+    def per_image(rois_i, gtc, gtb, k):
+        valid_gt = box_area(gtb, normalized=False) > 0
+        # gt boxes participate as candidate rois too (ref :~  concat)
+        cand = jnp.concatenate([rois_i, gtb], 0)
+        iou = pairwise_iou(gtb, cand, normalized=False)
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, 0)
+        best_iou = jnp.max(iou, 0)
+        is_fg = best_iou >= fg_th
+        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+        k1, k2 = jax.random.split(k)
+        fg_cap = int(batch_per_im * fg_frac)
+        nc = cand.shape[0]
+        pri_fg = jax.random.uniform(k1, (nc,)) + is_fg
+        fg_rank = jnp.argsort(jnp.argsort(-pri_fg))
+        take_fg = is_fg & (fg_rank < fg_cap)
+        n_fg = jnp.sum(take_fg.astype(jnp.int32))
+        bg_cap = batch_per_im - jnp.minimum(n_fg, fg_cap)
+        pri_bg = jax.random.uniform(k2, (nc,)) + is_bg
+        bg_rank = jnp.argsort(jnp.argsort(-pri_bg))
+        take_bg = is_bg & (bg_rank < bg_cap)
+        take = take_fg | take_bg
+        # order: fg first then bg, fixed batch_per_im slots
+        pri = take_fg * 2.0 + take_bg * 1.0 + \
+            jax.random.uniform(jax.random.fold_in(k, 7), (nc,)) * 0.1
+        sel = jnp.argsort(-pri)[:batch_per_im]
+        sel_rois = cand[sel]
+        sel_lab = jnp.where(take_fg[sel],
+                            gtc[best_gt[sel]].astype(jnp.int32), 0)
+        sel_lab = jnp.where(take[sel], sel_lab, -1)
+        # bbox targets (class-agnostic encode, expanded per class)
+        mgt = gtb[best_gt[sel]]
+        pw = sel_rois[:, 2] - sel_rois[:, 0] + 1.0
+        ph_ = sel_rois[:, 3] - sel_rois[:, 1] + 1.0
+        pcx = sel_rois[:, 0] + pw * 0.5
+        pcy = sel_rois[:, 1] + ph_ * 0.5
+        gw = mgt[:, 2] - mgt[:, 0] + 1.0
+        gh = mgt[:, 3] - mgt[:, 1] + 1.0
+        gcx = mgt[:, 0] + gw * 0.5
+        gcy = mgt[:, 1] + gh * 0.5
+        wts = jnp.asarray(bbox_weights, jnp.float32)
+        tgt = jnp.stack([(gcx - pcx) / pw / wts[0],
+                         (gcy - pcy) / ph_ / wts[1],
+                         jnp.log(jnp.maximum(gw / pw, 1e-10)) / wts[2],
+                         jnp.log(jnp.maximum(gh / ph_, 1e-10)) / wts[3]], -1)
+        expand = jnp.zeros((batch_per_im, 4 * class_num), jnp.float32)
+        cls_off = jnp.maximum(sel_lab, 0) * 4
+        cols = cls_off[:, None] + jnp.arange(4)[None, :]
+        rowi = jnp.arange(batch_per_im)[:, None]
+        fg_sel = take_fg[sel]
+        expand = expand.at[rowi, cols].set(
+            jnp.where(fg_sel[:, None], tgt, 0.0))
+        inside_w = jnp.zeros_like(expand).at[rowi, cols].set(
+            jnp.where(fg_sel[:, None], 1.0, 0.0))
+        return (sel_rois, sel_lab.astype(jnp.int64), expand, inside_w,
+                jnp.sum(take[sel].astype(jnp.int32)))
+
+    keys = jax.random.split(key, b)
+    rois_o, labels, tgt, in_w, cnt = jax.vmap(per_image)(
+        rois, gt_classes, gt_boxes, keys)
+    return {"Rois": [rois_o], "LabelsInt32": [labels],
+            "BboxTargets": [tgt], "BboxInsideWeights": [in_w],
+            "BboxOutsideWeights": [in_w], "RoisNum": [cnt]}
+
+
+@register_op("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD loss (ref layers/detection.py ssd_loss composition of
+    iou_similarity + bipartite_match + target_assign + mine_hard_examples +
+    softmax CE + smooth_l1).  Matching/mining indices are stop-gradient;
+    the loss is differentiable w.r.t. Location and Confidence."""
+    loc = X(ins, "Location")            # [b, M, 4]
+    conf = X(ins, "Confidence")         # [b, M, C]
+    gt_box = X(ins, "GtBox")            # [b, G, 4]
+    gt_label = X(ins, "GtLabel")        # [b, G]
+    prior = X(ins, "PriorBox")          # [M, 4]
+    pvar = X(ins, "PriorBoxVar")
+    bg = attrs.get("background_label", 0)
+    overlap_th = attrs.get("overlap_threshold", 0.5)
+    neg_ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_overlap = attrs.get("neg_overlap", 0.5)
+    loc_w = attrs.get("loc_loss_weight", 1.0)
+    conf_w = attrs.get("conf_loss_weight", 1.0)
+    normalize = attrs.get("normalize", True)
+    b, m, _ = loc.shape
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    var = pvar if pvar is not None else jnp.ones_like(prior)
+
+    def per_image(loc_i, conf_i, gtb, gtl):
+        valid_gt = box_area(gtb) > 0
+        iou = pairwise_iou(gtb, prior)
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        mi = _bipartite_match(
+            ctx, {"DistMat": [iou[None]]},
+            {"match_type": attrs.get("match_type", "per_prediction"),
+             "dist_threshold": overlap_th})
+        match = mi["ColToRowMatchIndices"][0][0]        # [M]
+        mdist = mi["ColToRowMatchDist"][0][0]
+        pos = match >= 0
+        tgt_cls = jnp.where(pos, gtl[jnp.maximum(match, 0)].astype(jnp.int32),
+                            bg)
+        # conf CE per prior
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=-1)[:, 0]
+        # hard negative mining on the stop-gradient CE
+        n_pos = jnp.sum(pos.astype(jnp.int32))
+        n_neg = (n_pos.astype(jnp.float32) * neg_ratio).astype(jnp.int32)
+        cand = (~pos) & (mdist < neg_overlap)
+        mine_score = jnp.where(cand, jax.lax.stop_gradient(ce), -jnp.inf)
+        rank = jnp.argsort(jnp.argsort(-mine_score))
+        mined = cand & (rank < n_neg)
+        conf_mask = (pos | mined).astype(loc_i.dtype)
+        # loc targets: encode matched gt vs own prior
+        mgt = gtb[jnp.maximum(match, 0)]
+        gw = mgt[:, 2] - mgt[:, 0]
+        gh = mgt[:, 3] - mgt[:, 1]
+        gcx = mgt[:, 0] + 0.5 * gw
+        gcy = mgt[:, 1] + 0.5 * gh
+        tgt = jnp.stack([(gcx - pcx) / jnp.maximum(pw, 1e-10),
+                         (gcy - pcy) / jnp.maximum(ph, 1e-10),
+                         jnp.log(jnp.maximum(gw / jnp.maximum(pw, 1e-10),
+                                             1e-10)),
+                         jnp.log(jnp.maximum(gh / jnp.maximum(ph, 1e-10),
+                                             1e-10))], -1) / var
+        diff = loc_i - tgt
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+        loss = conf_w * ce * conf_mask + \
+            loc_w * sl1 * pos.astype(loc_i.dtype)
+        if normalize:
+            loss = loss / jnp.maximum(n_pos.astype(loc_i.dtype), 1.0)
+        return loss
+
+    out = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    return {"Out": [out[..., None]]}
+
+
+@register_op("mine_hard_examples", no_grad=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """OHEM negative mining (ref mine_hard_examples_op.cc): keep the
+    highest-loss negatives up to neg_pos_ratio * num_pos.  Dense: returns an
+    updated match-indices tensor where un-mined negatives stay -1 and mined
+    ones get -2 (selected-negative marker) — plus the mask itself."""
+    cls_loss = X(ins, "ClsLoss")        # [b, m]
+    match = X(ins, "MatchIndices")      # [b, m]
+    neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_overlap = attrs.get("neg_dist_threshold", 0.5)
+    dist = X(ins, "MatchDist")
+    b, m = match.shape
+    is_pos = match >= 0
+    n_pos = jnp.sum(is_pos.astype(jnp.int32), axis=1, keepdims=True)
+    n_neg = (n_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32)
+    cand = (~is_pos) & ((dist < neg_overlap) if dist is not None else True)
+    loss_eff = jnp.where(cand, cls_loss, -jnp.inf)
+    rank = jnp.argsort(jnp.argsort(-loss_eff, axis=1), axis=1)
+    mined = cand & (rank < n_neg)
+    upd = jnp.where(mined, -2, match)
+    return {"UpdatedMatchIndices": [upd.astype(jnp.int32)],
+            "NegIndices": [mined.astype(jnp.int32)]}
+
+
+@register_op("generate_mask_labels", no_grad=True)
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask targets for Mask-RCNN (ref generate_mask_labels_op.cc),
+    simplified to box-driven rasterization: the gt 'segm' here is the gt
+    box rasterized into resolution² — sufficient for pipeline plumbing and
+    shape-compatible with the reference's polygon path."""
+    rois = X(ins, "Rois")               # [b, R, 4]
+    labels = X(ins, "LabelsInt32")      # [b, R]
+    gt_boxes = X(ins, "GtSegms")        # [b, G, 4] (box-approx segms)
+    match = X(ins, "MatchIndices")      # [b, R] roi→gt
+    res = int(attrs.get("resolution", 14))
+    num_classes = int(attrs.get("num_classes", 81))
+
+    def per_image(rois_i, lab, gtb, mi):
+        mgt = gtb[jnp.maximum(mi, 0)]
+        iy = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+        ix = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+        rw = jnp.maximum(rois_i[:, 2] - rois_i[:, 0], 1e-3)
+        rh = jnp.maximum(rois_i[:, 3] - rois_i[:, 1], 1e-3)
+        ys = rois_i[:, 1:2] + iy[None, :] * rh[:, None]     # [R, res]
+        xs = rois_i[:, 0:1] + ix[None, :] * rw[:, None]
+        iny = (ys[:, :, None] >= mgt[:, None, None, 1]) & \
+              (ys[:, :, None] <= mgt[:, None, None, 3])     # [R, res, 1]
+        inx = (xs[:, None, :] >= mgt[:, None, None, 0]) & \
+              (xs[:, None, :] <= mgt[:, None, None, 2])
+        mask = (iny & inx).astype(jnp.int32)                # [R, res, res]
+        fg = (lab > 0) & (mi >= 0)
+        mask = jnp.where(fg[:, None, None], mask, -1)
+        return mask.reshape(mask.shape[0], -1)
+
+    masks = jax.vmap(per_image)(rois, labels, gt_boxes, match)
+    return {"MaskRois": [rois], "RoiHasMaskInt32": [
+        (labels > 0).astype(jnp.int32)], "MaskInt32": [masks]}
+
+
+@register_op("retinanet_detection_output", no_grad=True)
+def _retinanet_detection_output(ctx, ins, attrs):
+    """Multi-level decode + NMS (ref retinanet_detection_output_op.cc)."""
+    bboxes = XS(ins, "BBoxes")          # per level [b, Ai, 4] anchors
+    scores = XS(ins, "Scores")          # per level [b, Ai, C] sigmoid scores
+    deltas = XS(ins, "Deltas")          # per level [b, Ai, 4]
+    im_info = X(ins, "ImInfo")
+    score_th = attrs.get("score_threshold", 0.05)
+    nms_th = attrs.get("nms_threshold", 0.5)
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+
+    def decode(anc, d):
+        aw = anc[..., 2] - anc[..., 0] + 1.0
+        ah = anc[..., 3] - anc[..., 1] + 1.0
+        acx = anc[..., 0] + aw * 0.5
+        acy = anc[..., 1] + ah * 0.5
+        cx = d[..., 0] * aw + acx
+        cy = d[..., 1] * ah + acy
+        w = jnp.exp(jnp.minimum(d[..., 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(d[..., 3], 10.0)) * ah
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1.0, cy + h / 2 - 1.0], -1)
+
+    all_boxes = jnp.concatenate(
+        [decode(a, d) for a, d in zip(bboxes, deltas)], axis=1)
+    all_scores = jnp.concatenate(scores, axis=1)    # [b, A, C]
+    nms_ins = {"BBoxes": [all_boxes],
+               "Scores": [jnp.swapaxes(all_scores, 1, 2)]}
+    return _multiclass_nms(ctx, nms_ins,
+                           {"background_label": -1,
+                            "score_threshold": score_th,
+                            "nms_threshold": nms_th,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "normalized": False})

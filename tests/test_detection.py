@@ -1,0 +1,329 @@
+"""Detection op tests vs numpy references (≈ ref
+tests/unittests/test_prior_box_op.py, test_iou_similarity_op.py,
+test_box_coder_op.py, test_multiclass_nms_op.py, test_bipartite_match_op.py,
+test_yolo_box_op.py, test_roi_align_op.py, test_sigmoid_focal_loss.py,
+test_generate_proposals.py, test_ssd_loss.py)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+from paddle_tpu import optimizer as opt
+
+
+def _run(fetch, feed):
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feed, fetch_list=list(fetch))
+
+
+def _np_iou(a, b):
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    u = area_a[:, None] + area_b[None, :] - inter
+    return np.where(u > 0, inter / np.maximum(u, 1e-10), 0)
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 4).astype(np.float32), -1)[:, [0, 1, 2, 3]]
+    a = np.stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 2] * 0.5 + 0.01,
+                  a[:, 1] + a[:, 3] * 0.5 + 0.01], -1).astype(np.float32)
+    b = np.stack([a[:, 0] * 0.9, a[:, 1] * 0.9, a[:, 2] * 1.1,
+                  a[:, 3] * 1.1], -1)[:3]
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[4], dtype="float32")
+    out = layers.iou_similarity(x, y)
+    got, = _run([out], {"x": a, "y": b})
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    m = 6
+    prior = np.stack([rng.rand(m), rng.rand(m),
+                      1.0 + rng.rand(m), 1.0 + rng.rand(m)],
+                     -1).astype(np.float32)
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (m, 1))
+    target = prior + 0.1 * rng.randn(m, 4).astype(np.float32)
+
+    pb = layers.data("pb", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    pv = layers.data("pv", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    tb = layers.data("tb", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    enc = layers.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = layers.box_coder(pb, pv, enc, code_type="decode_center_size",
+                           axis=1)
+    enc_v, dec_v = _run([enc, dec],
+                        {"pb": prior, "pv": pvar, "tb": target})
+    # decoding row i's encoding against prior i must return target i
+    diag = np.stack([dec_v[i, i] for i in range(m)])
+    np.testing.assert_allclose(diag, target, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_count_and_values():
+    img = layers.data("img", shape=[3, 32, 32], dtype="float32")
+    feat = layers.data("feat", shape=[8, 4, 4], dtype="float32")
+    box, var = layers.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                                aspect_ratios=[2.0], flip=True)
+    b, v = _run([box, var], {"img": np.zeros((1, 3, 32, 32), np.float32),
+                             "feat": np.zeros((1, 8, 4, 4), np.float32)})
+    # priors per cell: ars {1, 2, 0.5} + max_size big square = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # first cell center = (0.5*8, 0.5*8) = (4, 4); min box half-size 4/32
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_shape():
+    feat = layers.data("feat", shape=[8, 4, 4], dtype="float32")
+    anc, var = layers.anchor_generator(feat, anchor_sizes=[32., 64.],
+                                       aspect_ratios=[1.0],
+                                       stride=[16.0, 16.0])
+    a, = _run([anc], {"feat": np.zeros((1, 8, 4, 4), np.float32)})
+    assert a.shape == (4, 4, 2, 4)
+    # center of cell (0,0) is (8, 8); size-32 square → [-8, -8, 24, 24]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-4)
+
+
+def test_bipartite_match():
+    # dist [2 gt, 4 priors]
+    d = np.array([[[0.9, 0.1, 0.2, 0.3],
+                   [0.8, 0.7, 0.1, 0.0]]], np.float32)
+    dm = layers.data("dm", shape=[2, 4], dtype="float32")
+    mi, md = layers.bipartite_match(dm)
+    i, v = _run([mi, md], {"dm": d})
+    # greedy: (0,0)=0.9 first, then row1's best remaining col = col1 (0.7)
+    assert list(i[0]) == [0, 1, -1, -1]
+    np.testing.assert_allclose(v[0][:2], [0.9, 0.7], rtol=1e-6)
+
+
+def test_multiclass_nms_dense():
+    # 1 image, 4 boxes, 2 classes (class 0 = background)
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30], [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]    # class 1 scores
+    bb = layers.data("bb", shape=[4, 4], dtype="float32")
+    sc = layers.data("sc", shape=[2, 4], dtype="float32")
+    out = layers.multiclass_nms(bb, sc, score_threshold=0.1, nms_top_k=4,
+                                keep_top_k=4, nms_threshold=0.5,
+                                normalized=False)
+    got, = _run([out], {"bb": boxes, "sc": scores})
+    kept = got[0][got[0][:, 0] >= 0]
+    # box 1 suppressed by box 0 (IoU ~0.82); box 3 under score threshold
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(kept[0][:2], [1, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(kept[1][:2], [1, 0.7], rtol=1e-5)
+    np.testing.assert_allclose(kept[0][2:], [0, 0, 10, 10], atol=1e-5)
+
+
+def test_yolo_box_decode():
+    an = [10, 14]                       # one anchor
+    b, h, w, cls = 1, 2, 2, 3
+    x = np.zeros((b, 1 * (5 + cls), h, w), np.float32)
+    x[0, 4] = 10.0                      # conf ≈ 1
+    x[0, 5] = 10.0                      # class 0 prob ≈ 1
+    x[0, 6] = -10.0                     # class 1 prob ≈ 0
+    x[0, 7] = -10.0                     # class 2 prob ≈ 0
+    xv = layers.data("x", shape=[8, 2, 2], dtype="float32")
+    imgsz = layers.data("imgsz", shape=[2], dtype="int32")
+    boxes, scores = layers.yolo_box(xv, imgsz, an, cls, 0.01, 32)
+    bo, so = _run([boxes, scores],
+                  {"x": x, "imgsz": np.array([[64, 64]], np.int32)})
+    assert bo.shape == (1, 4, 4) and so.shape == (1, 4, 3)
+    # cell (0,0): cx = sigmoid(0)+0 = 0.5 over grid 2 → 0.25 * 64 = 16
+    cx = (bo[0, 0, 0] + bo[0, 0, 2]) / 2
+    cy = (bo[0, 0, 1] + bo[0, 0, 3]) / 2
+    np.testing.assert_allclose([cx, cy], [16, 16], atol=0.5)
+    assert so[0, 0, 0] > 0.9 and so[0, 0, 1] < 0.01
+
+
+def test_roi_align_exact_bins():
+    # 1x1x4x4 feature; roi covering the full map, pooled 2x2 equals the
+    # average of each quadrant when sampled densely
+    feat = np.arange(16).astype(np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+    x = layers.data("x", shape=[1, 4, 4], dtype="float32")
+    r = layers.data("r", shape=[4], dtype="float32")
+    out = layers.roi_align(x, r, pooled_height=2, pooled_width=2,
+                           spatial_scale=1.0, sampling_ratio=2)
+    got, = _run([out], {"x": feat, "r": rois})
+    assert got.shape == (1, 1, 2, 2)
+    # quadrant means of the 4x4 ramp (bilinear at interior points is exact)
+    ref = np.array([[2.5, 4.5], [10.5, 12.5]])
+    np.testing.assert_allclose(got[0, 0], ref, atol=0.6)
+
+
+def test_sigmoid_focal_loss_formula():
+    rng = np.random.RandomState(3)
+    n, c = 6, 4
+    xv = rng.randn(n, c).astype(np.float32)
+    lv = rng.randint(0, c + 1, (n, 1)).astype(np.int64)
+    fg = np.array([3], np.int32)
+    x = layers.data("x", shape=[c], dtype="float32")
+    lab = layers.data("lab", shape=[1], dtype="int64")
+    fgv = layers.data("fg", shape=[1], dtype="int32",
+                      append_batch_size=False)
+    out = layers.sigmoid_focal_loss(x, lab, fgv, gamma=2.0, alpha=0.25)
+    got, = _run([out], {"x": xv, "lab": lv, "fg": fg})
+    p = 1 / (1 + np.exp(-xv))
+    t = (lv == np.arange(1, c + 1)[None, :]).astype(np.float32)
+    pt = np.where(t > 0, p, 1 - p)
+    at = np.where(t > 0, 0.25, 0.75)
+    ref = at * (1 - pt) ** 2 * -np.log(np.maximum(pt, 1e-10)) / 3.0
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ssd_loss_trains():
+    """SSD head loss decreases when predictions move toward a fixed gt."""
+    rng = np.random.RandomState(4)
+    b, m, g, c = 2, 16, 3, 4
+    prior_v = np.stack([
+        np.linspace(0.05, 0.8, m), np.linspace(0.05, 0.8, m),
+        np.linspace(0.15, 0.9, m), np.linspace(0.15, 0.9, m)],
+        -1).astype(np.float32)
+    gt_v = np.tile(np.array([[0.1, 0.1, 0.3, 0.3],
+                             [0.4, 0.4, 0.6, 0.6],
+                             [0.7, 0.7, 0.9, 0.9]], np.float32), (b, 1, 1))
+    gl_v = np.tile(np.array([[1], [2], [3]], np.int64), (b, 1, 1))
+
+    feats = layers.data("f", shape=[8], dtype="float32")
+    gtb = layers.data("gtb", shape=[g, 4], dtype="float32")
+    gtl = layers.data("gtl", shape=[g, 1], dtype="int64")
+    pb = layers.data("pb", shape=[4], dtype="float32",
+                     append_batch_size=False)
+    hidden = layers.fc(feats, size=64, act="relu")
+    loc = layers.reshape(layers.fc(hidden, size=m * 4), [-1, m, 4])
+    conf = layers.reshape(layers.fc(hidden, size=m * c), [-1, m, c])
+    loss = layers.mean(layers.ssd_loss(loc, conf, gtb, gtl, pb))
+    opt.Adam(learning_rate=0.05).minimize(loss)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    fv = rng.randn(b, 8).astype(np.float32)
+    first = last = None
+    for i in range(40):
+        lv, = exe.run(feed={"f": fv, "gtb": gt_v, "gtl": gl_v,
+                            "pb": prior_v}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert np.isfinite(last) and last < first * 0.5
+
+
+def test_generate_proposals_smoke():
+    rng = np.random.RandomState(5)
+    b, an, h, w = 1, 3, 4, 4
+    scores_v = rng.rand(b, an, h, w).astype(np.float32)
+    deltas_v = 0.1 * rng.randn(b, an * 4, h, w).astype(np.float32)
+    anchors_v = np.zeros((h, w, an, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            for k in range(an):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                s = 8 * (k + 1)
+                anchors_v[i, j, k] = [cx - s, cy - s, cx + s, cy + s]
+    var_v = np.ones((h, w, an, 4), np.float32)
+    im_v = np.array([[64, 64, 1.0]], np.float32)
+
+    sc = layers.data("sc", shape=[an, h, w], dtype="float32")
+    dl = layers.data("dl", shape=[an * 4, h, w], dtype="float32")
+    im = layers.data("im", shape=[3], dtype="float32")
+    ac = layers.data("ac", shape=[w, an, 4], dtype="float32",
+                     append_batch_size=False)
+    vr = layers.data("vr", shape=[w, an, 4], dtype="float32",
+                     append_batch_size=False)
+    rois, probs, num = layers.generate_proposals(
+        sc, dl, im, ac, vr, pre_nms_top_n=48, post_nms_top_n=10,
+        return_rois_num=True)
+    r, p, n = _run([rois, probs, num],
+                   {"sc": scores_v, "dl": deltas_v, "im": im_v,
+                    "ac": anchors_v.reshape(h, w, an, 4),
+                    "vr": var_v.reshape(h, w, an, 4)})
+    assert r.shape == (1, 10, 4) and int(n[0]) > 0
+    kept = r[0][:int(n[0])]
+    assert (kept[:, 2] >= kept[:, 0]).all() and \
+        (kept[:, 3] >= kept[:, 1]).all()
+    assert kept.max() <= 64.0
+
+
+def test_distribute_and_collect_fpn():
+    rois_v = np.array([[0, 0, 50, 50],       # small → level 2
+                       [0, 0, 230, 230],     # ~refer → level 4
+                       [0, 0, 600, 600]], np.float32)  # big → level 5
+    r = layers.data("r", shape=[4], dtype="float32")
+    outs, restore = layers.distribute_fpn_proposals(
+        r, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    fetched = _run(list(outs) + [restore], {"r": rois_v})
+    lv2, lv3, lv4, lv5, rest = fetched
+    assert lv2[0, 2] == 50 and lv4[1, 2] == 230 and lv5[2, 2] == 600
+    assert lv3.sum() == 0
+
+
+def test_yolov3_loss_trains():
+    rng = np.random.RandomState(6)
+    b, h, w, cls = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    an = 3
+    gt_v = np.tile(np.array([[[0.3, 0.3, 0.2, 0.2],
+                              [0.7, 0.6, 0.3, 0.4]]], np.float32), (b, 1, 1))
+    gl_v = np.tile(np.array([[0, 2]], np.int64), (b, 1))
+    x = layers.data("x", shape=[an * (5 + cls), h, w], dtype="float32")
+    gtb = layers.data("gtb", shape=[2, 4], dtype="float32")
+    gtl = layers.data("gtl", shape=[2], dtype="int64")
+    net = layers.fc(layers.reshape(x, [0, -1]), size=an * (5 + cls) * h * w)
+    net = layers.reshape(net, [0, an * (5 + cls), h, w])
+    loss = layers.mean(layers.yolov3_loss(net, gtb, gtl, anchors, mask, cls,
+                                          ignore_thresh=0.7,
+                                          downsample_ratio=32))
+    opt.Adam(learning_rate=0.02).minimize(loss)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    xv = 0.1 * rng.randn(b, an * (5 + cls), h, w).astype(np.float32)
+    first = last = None
+    for i in range(30):
+        lv, = exe.run(feed={"x": xv, "gtb": gt_v, "gtl": gl_v},
+                      fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert np.isfinite(last) and last < first * 0.8
+
+
+def test_roi_align_rois_num_counts():
+    """rois_num carries per-image COUNTS (reference RoisNum semantics)."""
+    feat = np.zeros((2, 1, 2, 2), np.float32)
+    feat[0] = 1.0
+    feat[1] = 5.0
+    rois_v = np.array([[0, 0, 2, 2], [0, 0, 2, 2], [0, 0, 2, 2]], np.float32)
+    num_v = np.array([1, 2], np.int32)       # roi 0 → img 0, rois 1-2 → img 1
+    x = layers.data("x", shape=[1, 2, 2], dtype="float32")
+    r = layers.data("r", shape=[4], dtype="float32")
+    n = layers.data("n", shape=[2], dtype="int32", append_batch_size=False)
+    out = layers.roi_align(x, r, pooled_height=1, pooled_width=1,
+                           rois_num=n)
+    got, = _run([out], {"x": feat, "r": rois_v, "n": num_v})
+    np.testing.assert_allclose(got.ravel(), [1.0, 5.0, 5.0], atol=1e-5)
+
+
+def test_multiclass_nms2_index():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.5, 0.9, 0.7]
+    bb = layers.data("bb", shape=[3, 4], dtype="float32")
+    sc = layers.data("sc", shape=[2, 3], dtype="float32")
+    out, idx = layers.multiclass_nms2(bb, sc, score_threshold=0.1,
+                                      nms_top_k=3, keep_top_k=3,
+                                      nms_threshold=0.5, normalized=False,
+                                      return_index=True)
+    o, i = _run([out, idx], {"bb": boxes, "sc": scores})
+    # kept in score order: box 1 (0.9), box 2 (0.7), box 0 (0.5)
+    assert list(i[0].ravel()) == [1, 2, 0]
