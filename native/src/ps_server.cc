@@ -1,0 +1,573 @@
+// Parameter-server runtime: TCP KV store with server-side optimizers.
+//
+// TPU-native stand-in for the reference's RPC parameter-server plane
+// (operators/distributed/: grpc_server.cc async service, request_handler_impl.cc
+// server-side optimize blocks, parameter_send/recv.cc, brpc/*), collapsed to
+// the essential architecture: a threaded socket server owning named dense
+// and sparse (row-sharded, SelectedRows-analog) float32 tables, applying
+// SGD/momentum/adagrad/adam updates in native code, with sync-mode
+// accumulate-until-all-trainers semantics (ref listen_and_serv_op.cc
+// RunSyncLoop barriers) and async apply-on-push (RunAsyncLoop).
+//
+// Wire protocol (all little-endian):
+//   request : u8 op | u16 name_len | name | u32 rows | u64 payload_len |
+//             [rows * u32 row ids] | [payload bytes]
+//   response: u64 payload_len | payload
+// ops: 0 PUT  1 GET  2 PUSH_DENSE  3 BARRIER  4 PUSH_SPARSE  5 GET_ROWS
+//      6 STOP 7 GET_NOBARRIER
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  kPut = 0,
+  kGet = 1,
+  kPushDense = 2,
+  kBarrier = 3,
+  kPushSparse = 4,
+  kGetRows = 5,
+  kStop = 6,
+  kGetNoBarrier = 7,
+};
+
+enum Optim : int32_t { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
+
+struct Param {
+  std::vector<float> value;
+  std::vector<float> grad_acc;    // sync-mode accumulator
+  std::vector<float> m0, m1;      // optimizer slots
+  int64_t rows = 0;               // >0: sparse table [rows, width]
+  int64_t width = 0;
+  int optim = kSGD;
+  float lr = 0.01f, mom = 0.9f, beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  int push_count = 0;             // pushes since last apply
+  int64_t version = 0;
+  int64_t adam_t = 0;
+};
+
+struct Server {
+  int port = 0;
+  int num_trainers = 1;
+  bool sync_mode = true;
+  int listen_fd = -1;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, Param> table;
+  std::vector<int> conn_fds;      // live connections, for shutdown
+  int barrier_count = 0;
+  int64_t barrier_gen = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_payload(int fd, const float* data, size_t n_floats) {
+  uint64_t len = n_floats * sizeof(float);
+  if (!write_full(fd, &len, sizeof(len))) return false;
+  return n_floats == 0 || write_full(fd, data, len);
+}
+
+// Error response: payload_len sentinel of all-ones (a real payload is
+// bounded at 2^34 by the request validator, so this is unambiguous).
+bool send_error(int fd) {
+  uint64_t len = ~0ull;
+  return write_full(fd, &len, sizeof(len));
+}
+
+// Apply one optimizer step to `n` contiguous floats at offset `off`.
+// Dense: off=0, n=value.size(); sparse: one row at a time.
+void apply_update(Param& p, const float* grad, size_t off, size_t n) {
+  float* v = p.value.data() + off;
+  switch (p.optim) {
+    case kSGD:
+      for (size_t i = 0; i < n; i++) v[i] -= p.lr * grad[i];
+      break;
+    case kMomentum: {
+      if (p.m0.size() != p.value.size()) p.m0.assign(p.value.size(), 0.f);
+      float* m = p.m0.data() + off;
+      for (size_t i = 0; i < n; i++) {
+        m[i] = p.mom * m[i] + grad[i];
+        v[i] -= p.lr * m[i];
+      }
+      break;
+    }
+    case kAdagrad: {
+      if (p.m0.size() != p.value.size()) p.m0.assign(p.value.size(), 0.f);
+      float* m = p.m0.data() + off;
+      for (size_t i = 0; i < n; i++) {
+        m[i] += grad[i] * grad[i];
+        v[i] -= p.lr * grad[i] / (std::sqrt(m[i]) + p.eps);
+      }
+      break;
+    }
+    case kAdam: {
+      if (p.m0.size() != p.value.size()) {
+        p.m0.assign(p.value.size(), 0.f);
+        p.m1.assign(p.value.size(), 0.f);
+      }
+      // adam_t is bumped by the caller once per logical step
+      float* m = p.m0.data() + off;
+      float* u = p.m1.data() + off;
+      double bc1 = 1.0 - std::pow(p.beta1, static_cast<double>(p.adam_t));
+      double bc2 = 1.0 - std::pow(p.beta2, static_cast<double>(p.adam_t));
+      for (size_t i = 0; i < n; i++) {
+        m[i] = p.beta1 * m[i] + (1 - p.beta1) * grad[i];
+        u[i] = p.beta2 * u[i] + (1 - p.beta2) * grad[i] * grad[i];
+        float mh = static_cast<float>(m[i] / bc1);
+        float uh = static_cast<float>(u[i] / bc2);
+        v[i] -= p.lr * mh / (std::sqrt(uh) + p.eps);
+      }
+      break;
+    }
+  }
+  p.version++;
+}
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->conn_fds.push_back(fd);
+  }
+  // sync-mode round tracking: param -> version seen at this connection's
+  // last push.  A GET waits until the version advances PAST that push's
+  // round — not until push_count==0, which deadlocks when a fast trainer
+  // pushes round k+1 before a slow trainer's round-k GET (the reference
+  // orders rounds with explicit send/get barriers; this per-connection
+  // version watermark is the equivalent).
+  std::map<std::string, int64_t> pending;
+  while (s->running.load()) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint16_t name_len;
+    if (!read_full(fd, &name_len, sizeof(name_len))) break;
+    std::string name(name_len, '\0');
+    if (name_len && !read_full(fd, &name[0], name_len)) break;
+    uint32_t n_rows;
+    if (!read_full(fd, &n_rows, sizeof(n_rows))) break;
+    uint64_t payload_len;
+    if (!read_full(fd, &payload_len, sizeof(payload_len))) break;
+    if (payload_len % sizeof(float) != 0 ||
+        payload_len > (1ull << 34)) break;  // malformed request
+    std::vector<uint32_t> rows(n_rows);
+    if (n_rows && !read_full(fd, rows.data(), n_rows * 4)) break;
+    std::vector<float> payload(payload_len / sizeof(float));
+    if (payload_len && !read_full(fd, payload.data(), payload_len)) break;
+
+    if (op == kStop) {
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->running.store(false);
+      s->cv.notify_all();
+      send_payload(fd, nullptr, 0);
+      // unblock accept() and every worker blocked on a client read
+      for (int cfd : s->conn_fds)
+        if (cfd != fd) ::shutdown(cfd, SHUT_RDWR);
+      ::shutdown(s->listen_fd, SHUT_RDWR);
+      break;
+    }
+
+    std::unique_lock<std::mutex> lk(s->mu);
+    Param* pp = nullptr;
+    if (op == kPut) {
+      pp = &s->table[name];  // PUT registers the table
+    } else if (op != kBarrier) {
+      // never default-insert on reads/pushes: a misrouted or typo'd name
+      // must fail loudly, not silently train a ghost default-SGD entry
+      auto it = s->table.find(name);
+      if (it == s->table.end()) {
+        send_error(fd);
+        continue;
+      }
+      pp = &it->second;
+    }
+    static Param dummy;  // kBarrier never touches the table
+    Param& p = pp ? *pp : dummy;
+    switch (op) {
+      case kPut: {
+        p.value = payload;
+        if (p.width == 0) p.width = static_cast<int64_t>(payload.size());
+        send_payload(fd, nullptr, 0);
+        break;
+      }
+      case kGet: {
+        // sync mode: wait until the round this connection pushed into has
+        // been applied (ref RunSyncLoop's Send-barrier before Get)
+        auto it = pending.find(name);
+        if (s->sync_mode && it != pending.end()) {
+          int64_t watermark = it->second;
+          s->cv.wait(lk, [&] {
+            return !s->running.load() || p.version > watermark;
+          });
+          pending.erase(name);
+        }
+        send_payload(fd, p.value.data(), p.value.size());
+        break;
+      }
+      case kGetNoBarrier: {
+        send_payload(fd, p.value.data(), p.value.size());
+        break;
+      }
+      case kPushDense: {
+        if (p.value.empty()) p.value.assign(payload.size(), 0.f);
+        pending[name] = p.version;      // this round's watermark
+        if (s->sync_mode && s->num_trainers > 1) {
+          if (p.grad_acc.size() != payload.size())
+            p.grad_acc.assign(payload.size(), 0.f);
+          for (size_t i = 0; i < payload.size(); i++)
+            p.grad_acc[i] += payload[i];
+          p.push_count++;
+          if (p.push_count >= s->num_trainers) {
+            for (size_t i = 0; i < p.grad_acc.size(); i++)
+              p.grad_acc[i] /= static_cast<float>(s->num_trainers);
+            if (p.optim == kAdam) p.adam_t++;
+            apply_update(p, p.grad_acc.data(), 0, p.grad_acc.size());
+            p.grad_acc.assign(p.grad_acc.size(), 0.f);
+            p.push_count = 0;
+            s->cv.notify_all();
+          }
+        } else {
+          if (p.optim == kAdam) p.adam_t++;
+          apply_update(p, payload.data(), 0, payload.size());
+        }
+        send_payload(fd, nullptr, 0);
+        break;
+      }
+      case kPushSparse: {
+        // payload is [n_rows, width]; apply per-row (async semantics —
+        // ref async_sparse_param_update_recorder.h / SelectedRows merge)
+        int64_t w = p.width;
+        if (w == 0 && n_rows) {
+          w = static_cast<int64_t>(payload.size() / n_rows);
+          p.width = w;
+        }
+        if (p.optim == kAdam) p.adam_t++;
+        for (uint32_t r = 0; r < n_rows; r++) {
+          size_t off = static_cast<size_t>(rows[r]) * w;
+          if (off + w <= p.value.size())
+            apply_update(p, payload.data() + r * w, off, w);
+        }
+        send_payload(fd, nullptr, 0);
+        break;
+      }
+      case kGetRows: {
+        int64_t w = p.width;
+        std::vector<float> out(static_cast<size_t>(n_rows) * w);
+        for (uint32_t r = 0; r < n_rows; r++) {
+          size_t off = static_cast<size_t>(rows[r]) * w;
+          if (off + w <= p.value.size())
+            std::memcpy(out.data() + r * w, p.value.data() + off,
+                        w * sizeof(float));
+        }
+        send_payload(fd, out.data(), out.size());
+        break;
+      }
+      case kBarrier: {
+        int64_t gen = s->barrier_gen;
+        if (++s->barrier_count >= s->num_trainers) {
+          s->barrier_count = 0;
+          s->barrier_gen++;
+          s->cv.notify_all();
+        } else {
+          s->cv.wait(lk, [&] {
+            return !s->running.load() || s->barrier_gen != gen;
+          });
+        }
+        send_payload(fd, nullptr, 0);
+        break;
+      }
+      default:
+        send_payload(fd, nullptr, 0);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it)
+      if (*it == fd) { s->conn_fds.erase(it); break; }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  while (s->running.load()) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!s->running.load()) break;
+      continue;
+    }
+    s->workers.emplace_back(handle_conn, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ps_server_create(int port, int num_trainers, int sync_mode) {
+  Server* s = new Server();
+  s->port = port;
+  s->num_trainers = num_trainers;
+  s->sync_mode = sync_mode != 0;
+  return s;
+}
+
+// Register a table before start.  rows=0 → dense of size `size`;
+// rows>0 → sparse table [rows, size/rows] (size = rows*width).
+int ps_server_add_param(void* h, const char* name, int64_t size,
+                        const float* init, int optim, float lr, float hp1,
+                        float hp2, int64_t rows) {
+  Server* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  Param& p = s->table[name];
+  p.value.assign(init, init + size);
+  p.optim = optim;
+  p.lr = lr;
+  if (optim == kMomentum) p.mom = hp1;
+  if (optim == kAdam) { p.beta1 = hp1; p.beta2 = hp2; }
+  p.rows = rows;
+  p.width = rows > 0 ? size / rows : size;
+  return 0;
+}
+
+int ps_server_start(void* h) {
+  Server* s = static_cast<Server*>(h);
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  // ANY, not LOOPBACK: pserver endpoints may be reached from other hosts
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(s->port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0)
+    return -2;
+  if (::listen(s->listen_fd, 64) != 0) return -3;
+  if (s->port == 0) {
+    socklen_t len = sizeof(addr);
+    getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    s->port = ntohs(addr.sin_port);
+  }
+  s->running.store(true);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s->port;
+}
+
+void ps_server_wait(void* h) {
+  Server* s = static_cast<Server*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait(lk, [&] { return !s->running.load(); });
+}
+
+void ps_server_stop(void* h) {
+  Server* s = static_cast<Server*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->running.store(false);
+    s->cv.notify_all();
+    // unblock workers stuck reading from clients that never disconnect
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (s->listen_fd >= 0) ::shutdown(s->listen_fd, SHUT_RDWR);
+}
+
+int ps_server_get(void* h, const char* name, float* out, int64_t size) {
+  Server* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->table.find(name);
+  if (it == s->table.end()) return -1;
+  int64_t n = std::min<int64_t>(size,
+                                static_cast<int64_t>(it->second.value.size()));
+  std::memcpy(out, it->second.value.data(), n * sizeof(float));
+  return static_cast<int>(n);
+}
+
+void ps_server_destroy(void* h) {
+  Server* s = static_cast<Server*>(h);
+  ps_server_stop(s);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  if (s->listen_fd >= 0) ::close(s->listen_fd);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// client (ref operators/distributed/grpc/grpc_client.cc AsyncSendVar /
+// AsyncGetVar — synchronous here; the Python Communicator supplies the
+// async batching on top)
+// ---------------------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+};
+
+void* ps_client_connect(const char* host, int port) {
+  Client* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // not dotted-quad: resolve the hostname (PaddleCloud-style endpoints
+    // are usually names, not IPs)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(c->fd);
+      delete c;
+      return nullptr;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  for (int attempt = 0; attempt < 200; attempt++) {
+    if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return c;
+    }
+    // server may not be up yet (ref WaitServerReady in grpc_client)
+    ::close(c->fd);
+    c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  ::close(c->fd);
+  delete c;
+  return nullptr;
+}
+
+namespace {
+int64_t request(Client* c, uint8_t op, const char* name,
+                const uint32_t* rows, uint32_t n_rows, const float* payload,
+                uint64_t n_floats, float* out, int64_t out_cap) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint16_t name_len = static_cast<uint16_t>(std::strlen(name));
+  uint64_t payload_len = n_floats * sizeof(float);
+  if (!write_full(c->fd, &op, 1)) return -1;
+  if (!write_full(c->fd, &name_len, sizeof(name_len))) return -1;
+  if (name_len && !write_full(c->fd, name, name_len)) return -1;
+  if (!write_full(c->fd, &n_rows, sizeof(n_rows))) return -1;
+  if (!write_full(c->fd, &payload_len, sizeof(payload_len))) return -1;
+  if (n_rows && !write_full(c->fd, rows, n_rows * 4)) return -1;
+  if (payload_len && !write_full(c->fd, payload, payload_len)) return -1;
+  uint64_t resp_len;
+  if (!read_full(c->fd, &resp_len, sizeof(resp_len))) return -1;
+  if (resp_len == ~0ull) return -2;  // server error: unknown table
+  int64_t n = static_cast<int64_t>(resp_len / sizeof(float));
+  // read straight into the caller's buffer (no temp copy on the hot
+  // recv path); drain any excess to keep the stream in sync
+  uint64_t remaining = resp_len;
+  if (out && out_cap > 0 && remaining > 0) {
+    uint64_t take =
+        std::min<uint64_t>(remaining, static_cast<uint64_t>(out_cap) * 4);
+    if (!read_full(c->fd, out, take)) return -1;
+    remaining -= take;
+  }
+  char scratch[4096];
+  while (remaining > 0) {
+    size_t chunk = static_cast<size_t>(
+        std::min<uint64_t>(remaining, sizeof(scratch)));
+    if (!read_full(c->fd, scratch, chunk)) return -1;
+    remaining -= chunk;
+  }
+  return n;
+}
+}  // namespace
+
+int ps_client_put(void* h, const char* name, const float* data, int64_t n) {
+  return request(static_cast<Client*>(h), kPut, name, nullptr, 0, data,
+                 static_cast<uint64_t>(n), nullptr, 0) >= 0 ? 0 : -1;
+}
+
+int64_t ps_client_get(void* h, const char* name, float* out, int64_t cap) {
+  return request(static_cast<Client*>(h), kGet, name, nullptr, 0, nullptr, 0,
+                 out, cap);
+}
+
+int64_t ps_client_get_nobarrier(void* h, const char* name, float* out,
+                                int64_t cap) {
+  return request(static_cast<Client*>(h), kGetNoBarrier, name, nullptr, 0,
+                 nullptr, 0, out, cap);
+}
+
+int ps_client_push_dense(void* h, const char* name, const float* grad,
+                         int64_t n) {
+  return request(static_cast<Client*>(h), kPushDense, name, nullptr, 0, grad,
+                 static_cast<uint64_t>(n), nullptr, 0) >= 0 ? 0 : -1;
+}
+
+int ps_client_push_sparse(void* h, const char* name, const uint32_t* rows,
+                          uint32_t n_rows, const float* grad, int64_t n) {
+  return request(static_cast<Client*>(h), kPushSparse, name, rows, n_rows,
+                 grad, static_cast<uint64_t>(n), nullptr, 0) >= 0 ? 0 : -1;
+}
+
+int64_t ps_client_get_rows(void* h, const char* name, const uint32_t* rows,
+                           uint32_t n_rows, float* out, int64_t cap) {
+  return request(static_cast<Client*>(h), kGetRows, name, rows, n_rows,
+                 nullptr, 0, out, cap);
+}
+
+int ps_client_barrier(void* h) {
+  return request(static_cast<Client*>(h), kBarrier, "", nullptr, 0, nullptr,
+                 0, nullptr, 0) >= 0 ? 0 : -1;
+}
+
+int ps_client_stop_server(void* h) {
+  return request(static_cast<Client*>(h), kStop, "", nullptr, 0, nullptr, 0,
+                 nullptr, 0) >= 0 ? 0 : -1;
+}
+
+void ps_client_destroy(void* h) {
+  Client* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -13,8 +13,12 @@ Reference layers replaced here (SURVEY §2.5, §3.3):
 """
 
 from . import collective_ops  # noqa  (registers c_* lowerings)
+from . import ps  # noqa  (registers send/recv/listen_and_serv lowerings)
+from .ps import (Communicator, DistributeTranspiler,  # noqa
+                 DistributeTranspilerConfig, GeoCommunicator)
 from .env import (Env, get_rank, get_world_size,  # noqa
                   init_parallel_env)
 from .fleet import (CollectiveOptimizer, DistributedStrategy,  # noqa
-                    PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet)
+                    PaddleCloudRoleMaker, PSFleet, TranspilerOptimizer,
+                    UserDefinedRoleMaker, fleet, ps_fleet)
 from .transpiler import GradAllReduce, LocalSGD  # noqa

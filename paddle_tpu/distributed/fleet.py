@@ -59,6 +59,16 @@ class RoleMakerBase:
     def get_pserver_endpoints(self) -> List[str]:
         return self._server_endpoints
 
+    def get_current_endpoint(self) -> str:
+        """This process's own endpoint (ref role_maker get_current_endpoint):
+        a server serves its slot of the pserver list; a worker reports its
+        trainer endpoint."""
+        eps = self._server_endpoints if self.is_server() \
+            else self._worker_endpoints
+        if not eps:
+            return ""
+        return eps[min(self._current_id, len(eps) - 1)]
+
     def generate_role(self):
         pass
 
@@ -67,7 +77,7 @@ class PaddleCloudRoleMaker(RoleMakerBase):
     """Env-var role maker (ref role_maker.py PaddleCloudRoleMaker): reads
     the PADDLE_* contract that ``paddle_tpu.distributed.launch`` emits."""
 
-    def __init__(self, is_collective: bool = True):
+    def __init__(self, is_collective: bool = False):
         super().__init__()
         self._is_collective = is_collective
 
@@ -79,6 +89,10 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             self._current_id = int(os.getenv("PADDLE_PSERVER_ID", "0"))
             eps = os.getenv("PADDLE_PSERVER_ENDPOINTS", "")
             self._server_endpoints = eps.split(",") if eps else []
+            # servers must still know the trainer count (sync Fanin)
+            teps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = teps.split(",") if teps else \
+                [""] * int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
         else:
             self._role = Role.WORKER
             self._current_id = env.rank
@@ -131,7 +145,8 @@ class Fleet:
 
     # -- lifecycle -----------------------------------------------------------
     def init(self, role_maker: Optional[RoleMakerBase] = None):
-        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker = role_maker or \
+            PaddleCloudRoleMaker(is_collective=True)
         self._role_maker.generate_role()
         if self._role_maker.is_worker() and self._role_maker.worker_num() > 1:
             # multi-host: bring up the coordination service (≈ gen_nccl_id)
@@ -256,3 +271,94 @@ class CollectiveOptimizer:
 
 
 fleet = Fleet()
+
+
+# ---------------------------------------------------------------------------
+# parameter-server fleet (ref incubate/fleet/parameter_server/
+# distribute_transpiler/__init__.py DistributedTranspiler fleet)
+# ---------------------------------------------------------------------------
+
+class TranspilerOptimizer:
+    """ref parameter_server/distribute_transpiler __init__.py
+    TranspilerOptimizer: minimize() then transpile for PS."""
+
+    def __init__(self, optimizer, strategy, fleet):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._fleet = fleet
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        from .ps import DistributeTranspiler, DistributeTranspilerConfig
+        cfg = self._strategy if isinstance(
+            self._strategy, DistributeTranspilerConfig) else None
+        t = DistributeTranspiler(cfg)
+        f = self._fleet
+        t.transpile(trainer_id=max(f.worker_index(), 0),
+                    pservers=f.server_endpoints(to_string=True),
+                    trainers=max(f.worker_num(), 1))
+        f._transpiler = t
+        if f.is_server():
+            ep = f._role_maker.get_current_endpoint()
+            f._main_program, f._startup_program = t.get_pserver_programs(ep)
+        else:
+            f._main_program = t.get_trainer_program()
+            from ..framework import core
+            f._startup_program = core.default_startup_program()
+        return result
+
+
+class PSFleet(Fleet):
+    """PS-mode fleet facade: workers train with send/recv programs, servers
+    block in run_server() (ref fleet_base + PS fleet impls)."""
+
+    def __init__(self):
+        super().__init__()
+        self._transpiler = None
+        self._main_program = None
+        self._startup_program = None
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        # PS mode: trainers are independent processes wired by the RPC
+        # plane, not a jax.distributed SPMD group — skip the coordination
+        # service (ref: PS fleet never runs gen_nccl_id; that bootstrap
+        # belongs to collective mode)
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        self._is_initialized = True
+
+    @property
+    def main_program(self):
+        return self._main_program
+
+    @property
+    def startup_program(self):
+        return self._startup_program
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._assert_init()
+        self._strategy = strategy
+        return TranspilerOptimizer(optimizer, strategy, self)
+
+    def init_server(self, *args, **kwargs):
+        from ..framework import Executor
+        Executor().run(self._startup_program)
+
+    def run_server(self):
+        from ..framework import Executor
+        Executor().run(self._main_program)     # blocks until STOP
+
+    def stop_worker(self):
+        from . import ps as ps_mod
+        if self._transpiler is not None:
+            for ep in self._transpiler.eps:
+                try:
+                    ps_mod.get_client(ep).barrier()
+                except Exception:
+                    pass
+        ps_mod.reset_clients()
+
+
+ps_fleet = PSFleet()

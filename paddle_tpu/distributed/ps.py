@@ -1,0 +1,589 @@
+"""Parameter-server training (ref SURVEY §2.5 PS path).
+
+Maps the reference's PS stack onto the native KV runtime
+(``native/src/ps_server.cc``):
+
+- ``DistributeTranspiler`` (ref ``transpiler/distribute_transpiler.py:212,
+  476``): rewrites a trained program into a trainer program (optimizer ops
+  removed; ``send`` grad / ``recv`` param host ops appended) and per-endpoint
+  pserver programs (one ``listen_and_serv`` op carrying the param table +
+  server-side optimizer config — ref ``listen_and_serv_op.cc`` runs optimize
+  blocks; here the native server applies them in C++).
+- ``send`` / ``recv`` ops (ref ``operators/distributed_ops/send_op.cc``,
+  ``recv_op.cc``): lowered as ordered ``jax.experimental.io_callback``s so
+  the host RPC happens inside the jitted step at the right point.
+- ``Communicator`` (ref ``operators/distributed/communicator.h:162``):
+  background async grad push / param pull; ``GeoCommunicator`` implements
+  geo-SGD (ref ``DistributeTranspilerConfig geo_sgd_mode``): local steps,
+  periodic param-delta push.
+- sync semantics: the server accumulates each grad until every trainer
+  pushed, applies the update once, and ``recv`` blocks until applied —
+  the RunSyncLoop barrier structure (``listen_and_serv_op.cc:109-183``).
+
+Params are placed round-robin by size (ref ``ps_dispatcher.py`` RoundRobin);
+whole-param granularity (the reference's sub-block splitting exists to
+balance very large embeddings — sparse tables here shard by ROW via
+``split_ids``-style row routing instead).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import native
+from ..framework import core
+from ..framework.core import Program
+from ..framework.registry import register_op
+from ..framework.scope import global_scope
+from ..ops.common import X, XS
+
+OPTIM_IDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
+
+
+# ---------------------------------------------------------------------------
+# client registry
+# ---------------------------------------------------------------------------
+
+_clients: Dict[str, "PSClient"] = {}
+_clients_lock = threading.Lock()
+
+
+class PSClient:
+    """ctypes wrapper over the native client (ref grpc_client.h RPCClient)."""
+
+    def __init__(self, endpoint: str):
+        lib = native._load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable: %s"
+                               % native.build_error())
+        host, port = endpoint.rsplit(":", 1)
+        if host in ("localhost", ""):
+            host = "127.0.0.1"
+        self._lib = lib
+        self._h = lib.ps_client_connect(host.encode(), int(port))
+        if not self._h:
+            raise ConnectionError(f"cannot connect to pserver {endpoint}")
+
+    def _buf(self, arr):
+        import ctypes
+        a = np.ascontiguousarray(arr, np.float32)
+        return a, a.ctypes.data_as(ctypes.c_void_p)
+
+    def put(self, name: str, value) -> None:
+        a, p = self._buf(value)
+        rc = self._lib.ps_client_put(self._h, name.encode(), p, a.size)
+        if rc != 0:
+            raise RuntimeError(f"ps put({name}) failed (server down?)")
+
+    def get(self, name: str, size: int, barrier: bool = True):
+        import ctypes
+        out = np.empty(size, np.float32)
+        fn = self._lib.ps_client_get if barrier else \
+            self._lib.ps_client_get_nobarrier
+        n = fn(self._h, name.encode(),
+               out.ctypes.data_as(ctypes.c_void_p), size)
+        if n != size:
+            raise RuntimeError(
+                f"ps get({name}): expected {size} floats, got {n} "
+                "(unknown table)" if n == -2 else
+                f"ps get({name}): expected {size} floats, got {n} "
+                "(mis-sized table or connection lost?)")
+        return out
+
+    def push_dense(self, name: str, grad) -> None:
+        a, p = self._buf(grad)
+        rc = self._lib.ps_client_push_dense(self._h, name.encode(), p,
+                                            a.size)
+        if rc != 0:
+            raise RuntimeError(
+                f"ps push_dense({name}) failed — gradient would be "
+                "silently dropped (unknown table or server down)")
+
+    def push_sparse(self, name: str, rows, grad) -> None:
+        import ctypes
+        r = np.ascontiguousarray(rows, np.uint32)
+        a, p = self._buf(grad)
+        rc = self._lib.ps_client_push_sparse(
+            self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
+            len(r), p, a.size)
+        if rc != 0:
+            raise RuntimeError(
+                f"ps push_sparse({name}) failed — gradient would be "
+                "silently dropped (unknown table or server down)")
+
+    def get_rows(self, name: str, rows, width: int):
+        import ctypes
+        r = np.ascontiguousarray(rows, np.uint32)
+        out = np.empty(len(r) * width, np.float32)
+        n = self._lib.ps_client_get_rows(
+            self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
+            len(r), out.ctypes.data_as(ctypes.c_void_p), out.size)
+        if n != out.size:
+            raise RuntimeError(
+                f"ps get_rows({name}): expected {out.size} floats, got {n} "
+                "(unknown table or wrong width?)")
+        return out.reshape(len(r), width)
+
+    def barrier(self) -> None:
+        self._lib.ps_client_barrier(self._h)
+
+    def stop_server(self) -> None:
+        self._lib.ps_client_stop_server(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ps_client_destroy(self._h)
+            self._h = None
+
+
+def get_client(endpoint: str) -> PSClient:
+    with _clients_lock:
+        c = _clients.get(endpoint)
+        if c is None:
+            c = PSClient(endpoint)
+            _clients[endpoint] = c
+        return c
+
+
+def reset_clients() -> None:
+    with _clients_lock:
+        for c in _clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        _clients.clear()
+
+
+# ---------------------------------------------------------------------------
+# server (ref listen_and_serv_op.cc + grpc_server.cc)
+# ---------------------------------------------------------------------------
+
+class PSServer:
+    """Owns one native server process-wide; built from a pserver program's
+    listen_and_serv op attrs + the initialized scope values."""
+
+    def __init__(self, port: int, num_trainers: int, sync_mode: bool,
+                 param_specs: List[dict], scope=None):
+        lib = native._load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable: %s"
+                               % native.build_error())
+        self._lib = lib
+        self._h = lib.ps_server_create(int(port), int(num_trainers),
+                                       1 if sync_mode else 0)
+        scope = scope or global_scope()
+        import ctypes
+        for spec in param_specs:
+            init = scope.find_var(spec["name"])
+            val = np.ascontiguousarray(
+                np.asarray(init).ravel() if init is not None
+                else np.zeros(spec["size"]), np.float32)
+            lib.ps_server_add_param(
+                self._h, spec["name"].encode(), val.size,
+                val.ctypes.data_as(ctypes.c_void_p),
+                OPTIM_IDS.get(spec.get("optimizer", "sgd"), 0),
+                float(spec.get("lr", 0.01)), float(spec.get("hp1", 0.9)),
+                float(spec.get("hp2", 0.999)),
+                int(spec.get("rows", 0)))
+        self.port = None
+
+    def start(self) -> int:
+        port = self._lib.ps_server_start(self._h)
+        if port < 0:
+            raise RuntimeError(f"pserver bind failed: {port}")
+        self.port = port
+        return port
+
+    def wait(self) -> None:
+        self._lib.ps_server_wait(self._h)
+
+    def stop(self) -> None:
+        self._lib.ps_server_stop(self._h)
+
+    def get_param(self, name: str, size: int):
+        import ctypes
+        out = np.empty(size, np.float32)
+        n = self._lib.ps_server_get(self._h, name.encode(),
+                                    out.ctypes.data_as(ctypes.c_void_p), size)
+        return out[:max(n, 0)]
+
+    def destroy(self) -> None:
+        self._lib.ps_server_destroy(self._h)
+        self._h = None
+
+
+def run_pserver(op, scope, wait: bool = True) -> PSServer:
+    """Execute a listen_and_serv op host-side (called by Executor.run when a
+    program contains one — the blocking server loop can't live under jit)."""
+    attrs = op.attrs
+    endpoint = attrs["endpoint"]
+    port = int(endpoint.rsplit(":", 1)[1])
+    server = PSServer(port, attrs.get("Fanin", 1),
+                      attrs.get("sync_mode", True),
+                      attrs.get("param_specs", []), scope)
+    server.start()
+    if wait:
+        server.wait()
+        server.destroy()
+        return None
+    return server
+
+
+# ---------------------------------------------------------------------------
+# trainer-side ops (ref operators/distributed_ops/send_op.cc, recv_op.cc,
+# distributed_ops/distributed_lookup_table_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("send", no_grad=True)
+def _send(ctx, ins, attrs):
+    import jax
+    from jax.experimental import io_callback
+    eps = attrs["epmap"]
+    names = attrs["send_varnames"]
+    is_sparse = attrs.get("is_sparse", [0] * len(names))
+    xs = XS(ins, "X")
+    rows_in = ins.get("Rows", [None] * len(xs))
+    for x, ep, nm, sp, rows in zip(xs, eps, names, is_sparse, rows_in):
+        if sp and rows is not None:
+            def cb_sp(r, v, ep=ep, nm=nm):
+                get_client(ep).push_sparse(nm, np.asarray(r),
+                                           np.asarray(v, np.float32))
+                return np.zeros((), np.float32)
+            io_callback(cb_sp, jax.ShapeDtypeStruct((), np.float32),
+                        rows, x, ordered=True)
+        else:
+            def cb(v, ep=ep, nm=nm):
+                get_client(ep).push_dense(nm, np.asarray(v, np.float32))
+                return np.zeros((), np.float32)
+            io_callback(cb, jax.ShapeDtypeStruct((), np.float32), x,
+                        ordered=True)
+    return {}
+
+
+@register_op("recv", no_grad=True)
+def _recv(ctx, ins, attrs):
+    import jax
+    from jax.experimental import io_callback
+    eps = attrs["epmap"]
+    names = attrs["recv_varnames"]
+    shapes = attrs["shapes"]
+    barrier = attrs.get("with_barrier", True)
+    outs = []
+    for ep, nm, shape in zip(eps, names, shapes):
+        size = int(np.prod(shape)) if shape else 1
+
+        def cb(ep=ep, nm=nm, size=size, shape=tuple(shape)):
+            v = get_client(ep).get(nm, size, barrier=barrier)
+            return v.reshape(shape).astype(np.float32)
+
+        outs.append(io_callback(
+            cb, jax.ShapeDtypeStruct(tuple(shape), np.float32),
+            ordered=True))
+    return {"Out": outs}
+
+
+@register_op("distributed_lookup_table", no_grad=True)
+def _distributed_lookup_table(ctx, ins, attrs):
+    """Sparse embedding pull (ref operators/distributed_ops/
+    distributed_lookup_table_op.cc + parameter_prefetch.cc): fetch only the
+    queried rows from the owning pserver."""
+    import jax
+    from jax.experimental import io_callback
+    ids = X(ins, "Ids")
+    ep = attrs["endpoint"]
+    table = attrs["table_name"]
+    width = attrs["emb_dim"]
+    flat = ids.reshape(-1)
+
+    def cb(rows, ep=ep, table=table, width=width):
+        return get_client(ep).get_rows(
+            table, np.asarray(rows, np.uint32), width).astype(np.float32)
+
+    out = io_callback(
+        cb, jax.ShapeDtypeStruct((flat.shape[0], width), np.float32),
+        flat, ordered=True)
+    return {"Outputs": [out.reshape(tuple(ids.shape) + (width,))]}
+
+
+@register_op("fetch_barrier", no_grad=True)
+def _fetch_barrier(ctx, ins, attrs):
+    import jax
+    from jax.experimental import io_callback
+    eps = attrs.get("endpoints", [])
+
+    def cb():
+        for ep in eps:
+            get_client(ep).barrier()
+        return np.zeros((), np.float32)
+
+    io_callback(cb, jax.ShapeDtypeStruct((), np.float32), ordered=True)
+    return {}
+
+
+register_op("send_barrier", lambda ctx, ins, attrs: {}, no_grad=True)
+
+
+@register_op("listen_and_serv", no_grad=True)
+def _listen_and_serv(ctx, ins, attrs):
+    raise RuntimeError(
+        "listen_and_serv is a host-side blocking op; Executor.run handles "
+        "it before jit — reaching this lowering means the pserver program "
+        "was embedded in a larger traced block")
+
+
+# ---------------------------------------------------------------------------
+# transpiler (ref transpiler/distribute_transpiler.py)
+# ---------------------------------------------------------------------------
+
+class DistributeTranspilerConfig:
+    """ref distribute_transpiler.py:131."""
+
+    slice_var_up = True
+    split_method = "RoundRobin"
+    min_block_size = 8192
+    sync_mode = True
+    runtime_split_send_recv = False
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+#: optimizer op types the transpiler moves to the pserver
+PS_OPTIMIZER_OPS = {"sgd", "momentum", "adagrad", "adam"}
+
+
+class DistributeTranspiler:
+    """ref transpiler/distribute_transpiler.py DistributeTranspiler.
+
+    ``transpile`` → ``get_trainer_program`` / ``get_pserver_program`` /
+    ``get_startup_program``, same call protocol as the reference.
+    """
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._param_eps: Dict[str, str] = {}     # param -> endpoint
+        self._param_specs: Dict[str, dict] = {}
+        self._grad_of: Dict[str, str] = {}       # param -> grad var
+        self._origin_program: Optional[Program] = None
+
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: Optional[bool] = None, startup_program=None,
+                  current_endpoint: str = ""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.eps = pservers.split(",")
+        if sync_mode is not None:
+            self.config.sync_mode = sync_mode
+        program = program or core.default_main_program()
+        self._origin_program = program
+        self._startup = startup_program or core.default_startup_program()
+        block = program.global_block()
+
+        # collect (param, grad, optimizer) triples from the optimize ops
+        lr_value = self._find_lr_value()
+        for op in block.ops:
+            if op.type not in PS_OPTIMIZER_OPS:
+                continue
+            pname = op.input("Param")[0]
+            gname = op.input("Grad")[0]
+            pvar = block.var(pname)
+            size = int(np.prod([d for d in pvar.shape if d > 0]))
+            spec = {"name": pname, "size": size, "optimizer": op.type,
+                    "lr": lr_value, "shape": [d for d in pvar.shape],
+                    "rows": 0}
+            if op.type == "momentum":
+                spec["hp1"] = op.attrs.get("mu", 0.9)
+            if op.type == "adam":
+                spec["hp1"] = op.attrs.get("beta1", 0.9)
+                spec["hp2"] = op.attrs.get("beta2", 0.999)
+            self._param_specs[pname] = spec
+            self._grad_of[pname] = gname
+        # round-robin placement (ref ps_dispatcher.py RoundRobinDispatcher)
+        for i, pname in enumerate(sorted(self._param_specs)):
+            self._param_eps[pname] = self.eps[i % len(self.eps)]
+
+    def _find_lr_value(self) -> float:
+        for op in self._startup.global_block().ops \
+                if self._startup is not None else []:
+            if op.type == "fill_constant":
+                out = op.output("Out")
+                if out and "learning_rate" in out[0]:
+                    return float(op.attrs.get("value", 0.01))
+        return 0.01
+
+    # -- trainer side --------------------------------------------------------
+    def get_trainer_program(self, wait_port: bool = True) -> Program:
+        """ref :814 — strip optimizer ops; append send(grad) + recv(param).
+
+        geo-SGD mode keeps local optimizer ops (the GeoCommunicator pushes
+        deltas outside the step)."""
+        prog = self._origin_program.clone()
+        block = prog.global_block()
+        if not self.config.geo_sgd_mode:
+            block.ops = [op for op in block.ops
+                         if op.type not in PS_OPTIMIZER_OPS]
+            by_ep: Dict[str, List[str]] = {}
+            for pname, ep in self._param_eps.items():
+                by_ep.setdefault(ep, []).append(pname)
+            for ep, pnames in sorted(by_ep.items()):
+                block.append_op(
+                    "send",
+                    inputs={"X": [self._grad_of[p] for p in pnames]},
+                    outputs={},
+                    attrs={"epmap": [ep] * len(pnames),
+                           "send_varnames": pnames})
+                block.append_op(
+                    "recv", inputs={},
+                    outputs={"Out": pnames},
+                    attrs={"epmap": [ep] * len(pnames),
+                           "recv_varnames": pnames,
+                           "shapes": [self._param_specs[p]["shape"]
+                                      for p in pnames],
+                           "with_barrier": self.config.sync_mode})
+        prog._attrs["is_distributed"] = True
+        return prog
+
+    # -- pserver side --------------------------------------------------------
+    def get_pserver_program(self, endpoint: str) -> Program:
+        """ref :948 — one listen_and_serv op with this endpoint's shard."""
+        prog = Program()
+        specs = [self._param_specs[p]
+                 for p, ep in sorted(self._param_eps.items())
+                 if ep == endpoint]
+        if self.config.geo_sgd_mode:
+            # geo: trainers push param DELTAS; the server just adds them
+            # (SGD with lr=1 on grad=-delta → value += delta)
+            specs = [dict(s, optimizer="sgd", lr=1.0) for s in specs]
+        prog.global_block().append_op(
+            "listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "Fanin": self.trainer_num,
+                   "sync_mode": self.config.sync_mode and
+                   not self.config.geo_sgd_mode,
+                   "param_specs": specs})
+        return prog
+
+    def get_pserver_programs(self, endpoint: str):
+        p = self.get_pserver_program(endpoint)
+        return p, self.get_startup_program(endpoint, p)
+
+    def get_startup_program(self, endpoint: str,
+                            pserver_program: Optional[Program] = None
+                            ) -> Program:
+        """Startup for one pserver: create + init only its params."""
+        prog = Program()
+        blk = prog.global_block()
+        src = self._startup.global_block()
+        mine = {p for p, ep in self._param_eps.items() if ep == endpoint}
+        for name in mine:
+            v = src.var(name) if src.has_var(name) else None
+            blk.create_var(name=name,
+                           shape=v.shape if v else
+                           self._param_specs[name]["shape"],
+                           dtype=v.dtype if v else "float32",
+                           persistable=True)
+        for op in src.ops:
+            outs = op.output_arg_names()
+            if outs and all(o in mine for o in outs):
+                blk.append_op(op.type, inputs=dict(op.inputs),
+                              outputs=dict(op.outputs), attrs=dict(op.attrs))
+        return prog
+
+
+# ---------------------------------------------------------------------------
+# async / geo communicators (ref operators/distributed/communicator.h,
+# python/paddle/fluid/communicator.py)
+# ---------------------------------------------------------------------------
+
+class Communicator:
+    """Async-mode background param PULLER (the RecvThread half of ref
+    ``communicator.h``; the push half lives in the in-graph ``send`` op,
+    which applies immediately in async mode).
+
+    Use with a trainer program transpiled WITHOUT recv ops (async mode may
+    drop them: pulls are decoupled from steps) — with in-graph recv, the
+    step's own write-back would race these background scope writes."""
+
+    def __init__(self, transpiler: DistributeTranspiler, scope=None,
+                 send_interval_s: float = 0.01):
+        self.t = transpiler
+        self.scope = scope or global_scope()
+        self.interval = send_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[Exception] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        # a dead puller must not be silent: record the failure so check()/
+        # stop() surface it instead of the trainer reading stale params
+        # forever (ref communicator.h RecvThread glog-fatals on RPC error)
+        try:
+            while not self._stop.is_set():
+                for pname, ep in self.t._param_eps.items():
+                    spec = self.t._param_specs[pname]
+                    fresh = get_client(ep).get(pname, spec["size"],
+                                               barrier=False)
+                    self.scope.set_var(pname, fresh.reshape(spec["shape"]))
+                self._stop.wait(self.interval)
+        except Exception as e:   # noqa: BLE001 — any RPC failure
+            self.error = e
+
+    def check(self):
+        """Raise if the background puller died."""
+        if self.error is not None:
+            raise RuntimeError(
+                "Communicator recv thread died; trainer was reading stale "
+                "params") from self.error
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+        self.check()
+
+
+class GeoCommunicator:
+    """geo-SGD (ref distribute_transpiler geo_sgd_mode + communicator_py):
+    train locally; every ``push_nums`` steps push param deltas (server adds
+    them: SGD with lr=-1 on -delta ≡ +=delta) and pull the merged params."""
+
+    def __init__(self, transpiler: DistributeTranspiler, scope=None):
+        self.t = transpiler
+        self.scope = scope or global_scope()
+        self.push_nums = transpiler.config.geo_sgd_need_push_nums
+        self._step = 0
+        self._snapshots: Dict[str, np.ndarray] = {}
+
+    def init_snapshots(self):
+        for pname, spec in self.t._param_specs.items():
+            v = np.asarray(self.scope.find_var(pname), np.float32)
+            self._snapshots[pname] = v.copy()
+            # seed the server with the initial value
+            get_client(self.t._param_eps[pname]).put(pname, v.ravel())
+
+    def step(self):
+        self._step += 1
+        if self._step % self.push_nums:
+            return
+        for pname, ep in self.t._param_eps.items():
+            spec = self.t._param_specs[pname]
+            cur = np.asarray(self.scope.find_var(pname), np.float32)
+            delta = (cur - self._snapshots[pname]) / self.t.trainer_num
+            cli = get_client(ep)
+            cli.push_dense(pname, -delta.ravel())   # server lr=1 → +=delta
+            fresh = cli.get(pname, spec["size"], barrier=False)
+            fresh = fresh.reshape(spec["shape"]).astype(np.float32)
+            self.scope.set_var(pname, fresh)
+            self._snapshots[pname] = fresh.copy()

@@ -251,6 +251,19 @@ class _CompiledBlock:
             # updated state must come back in its declared layout, or the
             # next call's arg shardings mismatch the jit signature
             kwargs["out_shardings"] = (None, list(in_shardings[2]))
+        if program._attrs.get("is_distributed") and \
+                jax.default_backend() != "cpu":
+            # PS trainer programs embed host-RPC send/recv io_callbacks,
+            # which the tunneled TPU backend can't service — PS mode is the
+            # reference's CPU sparse-workload path (ref §3.4), so pin the
+            # step to the host CPU backend
+            cpu = jax.devices("cpu")[0]
+            # jit rejects device= combined with donation or shardings
+            kwargs.pop("donate_argnums", None)
+            kwargs.pop("in_shardings", None)
+            kwargs.pop("out_shardings", None)
+            self.jitted = jax.jit(step, device=cpu, **kwargs)
+            return
         self.jitted = jax.jit(step, **kwargs)
 
     def __call__(self, feeds, ro, rw, seed):
@@ -320,6 +333,14 @@ class Executor:
             program = default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
+
+        # a pserver program is a blocking host loop, not a jittable block
+        # (ref listen_and_serv_op.cc RunImpl blocking in Executor::Run)
+        lsv = next((op for op in program.global_block().ops
+                    if op.type == "listen_and_serv"), None)
+        if lsv is not None:
+            from ..distributed import ps as _ps
+            return _ps.run_pserver(lsv, scope)
         fetch_names = tuple(
             f.name if isinstance(f, Variable) else f for f in (fetch_list or []))
         feed_names = tuple(sorted(feed))

@@ -105,6 +105,25 @@ def _declare(lib):
         "ptn_batch_slot_values": ([p, c.c_int, p, p], i64),
         "ptn_batch_slot_offsets": ([p, c.c_int, p], i64),
         "ptn_batch_free": ([p], None),
+        # parameter server (ref operators/distributed/)
+        "ps_server_create": ([c.c_int, c.c_int, c.c_int], p),
+        "ps_server_add_param": ([p, cp, i64, p, c.c_int, c.c_float,
+                                 c.c_float, c.c_float, i64], c.c_int),
+        "ps_server_start": ([p], c.c_int),
+        "ps_server_wait": ([p], None),
+        "ps_server_stop": ([p], None),
+        "ps_server_get": ([p, cp, p, i64], c.c_int),
+        "ps_server_destroy": ([p], None),
+        "ps_client_connect": ([cp, c.c_int], p),
+        "ps_client_put": ([p, cp, p, i64], c.c_int),
+        "ps_client_get": ([p, cp, p, i64], i64),
+        "ps_client_get_nobarrier": ([p, cp, p, i64], i64),
+        "ps_client_push_dense": ([p, cp, p, i64], c.c_int),
+        "ps_client_push_sparse": ([p, cp, p, c.c_uint32, p, i64], c.c_int),
+        "ps_client_get_rows": ([p, cp, p, c.c_uint32, p, i64], i64),
+        "ps_client_barrier": ([p], c.c_int),
+        "ps_client_stop_server": ([p], c.c_int),
+        "ps_client_destroy": ([p], None),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

@@ -1,0 +1,81 @@
+"""Subprocess entry for PS distributed tests (≈ ref
+tests/unittests/test_dist_base.py model scripts: run as
+``python ps_dist_runner.py pserver|trainer <trainer_id> <port>
+<n_trainers>``).  Trains the same tiny regression on fixed data; trainers
+print their final loss + a param checksum so the parent can assert sync
+parity."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax may be pre-imported by sitecustomize with the (single-client) TPU
+# backend — multiple PS processes must not fight over the chip, and env
+# vars are too late; the config API works until a backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu import optimizer as opt  # noqa: E402
+from paddle_tpu.framework import Executor  # noqa: E402
+from paddle_tpu.distributed import DistributeTranspiler  # noqa: E402
+from paddle_tpu.distributed import ps as ps_mod  # noqa: E402
+
+
+def build():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.ParamAttr(
+                         name="w",
+                         initializer=pt.initializer.ConstantInitializer(0.0)),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def main():
+    role, trainer_id, port, n_trainers = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    eps = f"127.0.0.1:{port}"
+    loss = build()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id, pservers=eps, trainers=n_trainers)
+    exe = Executor()
+    if role == "pserver":
+        prog, startup = t.get_pserver_programs(eps)
+        exe.run(startup)
+        exe.run(prog)          # blocks until a trainer sends STOP
+        return
+    # trainer
+    trainer_prog = t.get_trainer_program()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)     # same data on every trainer
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    last = None
+    debug = os.environ.get("PS_DEBUG")
+    for i in range(30):
+        xv = rng.rand(16, 4).astype(np.float32)
+        yv = xv @ w_true
+        lv, = exe.run(trainer_prog, feed={"x": xv, "y": yv},
+                      fetch_list=[loss])
+        last = float(lv)
+        if debug:
+            print(f"step {i} loss {last}", file=sys.stderr, flush=True)
+    w = np.asarray(pt.global_scope().find_var("w")).ravel()
+    print(f"RESULT {trainer_id} {last:.6f} {w.sum():.6f}", flush=True)
+    # all trainers must be done before anyone stops the server
+    # (ref SendComplete / send_barrier graceful-shutdown protocol)
+    ps_mod.get_client(eps).barrier()
+    if trainer_id == 0:
+        ps_mod.get_client(eps).stop_server()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,317 @@
+"""Parameter-server tests (≈ ref tests/unittests/test_dist_base.py
+subprocess-localhost pattern + test_dist_transpiler.py + communicator
+tests).  The native KV server is exercised in-process (client/server
+roundtrip, sync parity vs local SGD, sparse rows, geo-SGD) and across
+real processes (2 trainers + 1 pserver)."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu import optimizer as opt
+from paddle_tpu.framework import Executor
+from paddle_tpu import native
+from paddle_tpu.distributed import (DistributeTranspiler,
+                                    DistributeTranspilerConfig,
+                                    GeoCommunicator)
+from paddle_tpu.distributed import ps as ps_mod
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _fresh_clients():
+    yield
+    ps_mod.reset_clients()
+
+
+def test_kv_roundtrip_and_server_sgd():
+    server = ps_mod.PSServer(0, 1, True, [
+        {"name": "w", "size": 4, "optimizer": "sgd", "lr": 0.5}])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        cli.put("w", np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        got = cli.get("w", 4)
+        np.testing.assert_allclose(got, [1, 2, 3, 4])
+        cli.push_dense("w", np.array([1.0, 1.0, 1.0, 1.0], np.float32))
+        got = cli.get("w", 4)
+        np.testing.assert_allclose(got, [0.5, 1.5, 2.5, 3.5])   # -= 0.5*g
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_sparse_rows():
+    server = ps_mod.PSServer(0, 1, False, [
+        {"name": "emb", "size": 12, "rows": 4, "optimizer": "sgd",
+         "lr": 1.0}])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        table = np.arange(12, dtype=np.float32)
+        cli.put("emb", table)
+        rows = cli.get_rows("emb", [2, 0], width=3)
+        np.testing.assert_allclose(rows, [[6, 7, 8], [0, 1, 2]])
+        # sparse push on row 1 only
+        cli.push_sparse("emb", [1], np.array([[1.0, 1.0, 1.0]], np.float32))
+        rows = cli.get_rows("emb", [1], width=3)
+        np.testing.assert_allclose(rows, [[2, 3, 4]])           # -= 1*g
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def _train_local(steps=25):
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.ParamAttr(
+                         name="w_local",
+                         initializer=pt.initializer.ConstantInitializer(0.0)),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    losses = []
+    for i in range(steps):
+        xv = rng.rand(16, 4).astype(np.float32)
+        yv = xv @ w_true
+        lv, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    return losses, np.asarray(pt.global_scope().find_var("w_local")).copy()
+
+
+def test_ps_sync_matches_local():
+    """1-trainer PS-SGD must track local SGD step for step (ref
+    TestDistBase sync parity assertion)."""
+    local_losses, local_w = _train_local()
+
+    # fresh program state for the PS run
+    from paddle_tpu.framework import core, unique_name
+    main, startup = core.Program(), core.Program()
+    core.switch_main_program(main)
+    core.switch_startup_program(startup)
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.ParamAttr(
+                         name="w",
+                         initializer=pt.initializer.ConstantInitializer(0.0)),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+
+    port = _free_port()
+    t = DistributeTranspiler()
+    t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+    pserver_prog, pserver_startup = t.get_pserver_programs(
+        f"127.0.0.1:{port}")
+    trainer_prog = t.get_trainer_program()
+
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv_thread = threading.Thread(target=exe.run, args=(pserver_prog,),
+                                  daemon=True)
+    srv_thread.start()
+    time.sleep(0.2)
+
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    ps_losses = []
+    for i in range(25):
+        xv = rng.rand(16, 4).astype(np.float32)
+        yv = xv @ w_true
+        lv, = exe.run(trainer_prog, feed={"x": xv, "y": yv},
+                      fetch_list=[loss])
+        ps_losses.append(float(lv))
+    w_ps = np.asarray(pt.global_scope().find_var("w")).copy()
+    ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+    srv_thread.join(timeout=5)
+
+    np.testing.assert_allclose(ps_losses, local_losses, rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(w_ps.ravel(), local_w.ravel(), rtol=1e-3)
+
+
+def test_geo_sgd_pushes_deltas():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.ParamAttr(
+                         name="wg",
+                         initializer=pt.initializer.ConstantInitializer(0.0)),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+
+    port = _free_port()
+    cfg = DistributeTranspilerConfig(geo_sgd_mode=True,
+                                     geo_sgd_need_push_nums=5,
+                                     sync_mode=False)
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+    pserver_prog, pserver_startup = t.get_pserver_programs(
+        f"127.0.0.1:{port}")
+    trainer_prog = t.get_trainer_program()   # keeps local optimizer
+
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv_thread = threading.Thread(target=exe.run, args=(pserver_prog,),
+                                  daemon=True)
+    srv_thread.start()
+    time.sleep(0.2)
+
+    exe.run(pt.default_startup_program())
+    geo = GeoCommunicator(t)
+    geo.init_snapshots()
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    for i in range(10):
+        xv = rng.rand(16, 4).astype(np.float32)
+        yv = xv @ w_true
+        exe.run(trainer_prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        geo.step()
+    # after 10 steps / push interval 5, server holds the merged params ≠ 0
+    srv_w = ps_mod.get_client(f"127.0.0.1:{port}").get("wg", 4,
+                                                       barrier=False)
+    local_w = np.asarray(pt.global_scope().find_var("wg")).ravel()
+    np.testing.assert_allclose(srv_w, local_w, rtol=1e-5)
+    assert np.abs(srv_w).sum() > 0.1
+    ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+    srv_thread.join(timeout=5)
+
+
+def test_distributed_lookup_table_op():
+    port = _free_port()
+    server = ps_mod.PSServer(port, 1, False, [
+        {"name": "embtab", "size": 20, "rows": 5, "optimizer": "sgd",
+         "lr": 1.0}])
+    server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        cli.put("embtab", np.arange(20, dtype=np.float32))
+
+        from paddle_tpu.layer_helper import LayerHelper
+        ids = layers.data("ids", shape=[3], dtype="int64")
+        helper = LayerHelper("distributed_lookup_table")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("distributed_lookup_table",
+                         inputs={"Ids": [ids]},
+                         outputs={"Outputs": [out]},
+                         attrs={"endpoint": f"127.0.0.1:{port}",
+                                "table_name": "embtab", "emb_dim": 4})
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        got, = exe.run(feed={"ids": np.array([[0, 2, 4]], np.int64)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(got[0, 0], [0, 1, 2, 3])
+        np.testing.assert_allclose(got[0, 1], [8, 9, 10, 11])
+        np.testing.assert_allclose(got[0, 2], [16, 17, 18, 19])
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_two_trainers_subprocess():
+    """2 trainer procs + 1 pserver proc on localhost (ref
+    test_dist_base._run_cluster): sync grads average, so both trainers see
+    identical params and the shared model converges."""
+    port = _free_port()
+    runner = os.path.join(os.path.dirname(__file__), "ps_dist_runner.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    env.pop("PYTEST_CURRENT_TEST", None)
+
+    def launch(role, tid):
+        return subprocess.Popen(
+            [sys.executable, runner, role, str(tid), str(port), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+
+    ps_proc = launch("pserver", 0)
+    time.sleep(1.0)
+    t0 = launch("trainer", 0)
+    t1 = launch("trainer", 1)
+    out0, err0 = t0.communicate(timeout=240)
+    out1, err1 = t1.communicate(timeout=240)
+    ps_proc.wait(timeout=60)
+    assert t0.returncode == 0, f"trainer0 failed:\n{err0}"
+    assert t1.returncode == 0, f"trainer1 failed:\n{err1}"
+    r0 = [l for l in out0.splitlines() if l.startswith("RESULT")][0].split()
+    r1 = [l for l in out1.splitlines() if l.startswith("RESULT")][0].split()
+    loss0, wsum0 = float(r0[2]), float(r0[3])
+    loss1, wsum1 = float(r1[2]), float(r1[3])
+    # identical data + sync averaging → identical params on both trainers
+    np.testing.assert_allclose(wsum0, wsum1, rtol=1e-5)
+    assert loss0 < 1.0 and loss1 < 1.0      # converging
+
+
+def test_ps_fleet_end_to_end():
+    """fleet-facade PS flow (ref incubate fleet PS usage): worker trains
+    through fleet.main_program against an in-thread server."""
+    from paddle_tpu.distributed import PSFleet, UserDefinedRoleMaker
+    from paddle_tpu.distributed.fleet import Role
+
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1,
+                     param_attr=pt.ParamAttr(
+                         name="wf",
+                         initializer=pt.initializer.ConstantInitializer(0.0)),
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+
+    f = PSFleet()
+    f.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                worker_num=1, server_endpoints=[ep]))
+    opt_d = f.distributed_optimizer(opt.SGD(learning_rate=0.1))
+    opt_d.minimize(loss)
+
+    # bring up the server from the same transpiler (server role reuses it)
+    t = f._transpiler
+    pserver_prog, pserver_startup = t.get_pserver_programs(ep)
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv = threading.Thread(target=exe.run, args=(pserver_prog,), daemon=True)
+    srv.start()
+    time.sleep(0.2)
+
+    exe.run(f.startup_program)
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    last = None
+    for i in range(20):
+        xv = rng.rand(16, 4).astype(np.float32)
+        yv = xv @ w_true
+        lv, = exe.run(f.main_program, feed={"x": xv, "y": yv},
+                      fetch_list=[loss])
+        last = float(lv)
+    assert last < 1.0
+    ps_mod.get_client(ep).stop_server()
+    srv.join(timeout=5)
