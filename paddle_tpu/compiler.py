@@ -71,6 +71,30 @@ class CompiledProgram:
         self._share_vars_from = None
         self._is_data_parallel = False
 
+    def _optimized(self, fetch_names=()) -> Program:
+        """Apply the BuildStrategy's graph passes (ref BuildStrategy::Apply,
+        details/build_strategy.cc:299 — there the pass list builds the whole
+        multi-device graph; here only the program-level canonicalizations
+        remain meaningful, XLA owns fusion/memory).  Keyed by program
+        version + fetch set: fetched intermediates must survive fusion, and
+        a mutated program must re-optimize."""
+        key = (self._program.fingerprint(), frozenset(fetch_names))
+        cache = getattr(self, "_optimized_cache", None)
+        if cache is None:
+            cache = self._optimized_cache = {}
+        prog = cache.get(key)
+        if prog is None:
+            prog = self._program
+            if self._build_strategy.fuse_elewise_add_act_ops:
+                from .framework import ir
+                g = ir.Graph(prog)
+                g = ir.get_pass("fuse_elewise_add_act_pass",
+                                protected=frozenset(fetch_names)).apply(g)
+                if g.attrs.get("fuse_elewise_add_act_count"):
+                    prog = g.to_program()
+            cache[key] = prog
+        return prog
+
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):

@@ -5,4 +5,5 @@ from .core import (Block, Operator, Parameter, Program, Variable,  # noqa
                    default_startup_program, grad_var_name, program_guard,
                    switch_main_program, switch_startup_program)
 from .executor import Executor  # noqa
+from . import ir  # noqa  (Graph/Pass/PassBuilder + fusion & analysis passes)
 from .scope import Scope, global_scope, scope_guard  # noqa

@@ -326,7 +326,9 @@ class Executor:
         in_shardings = None
         if isinstance(program, CompiledProgram):
             compiled = program
-            program = compiled._program
+            program = compiled._optimized(
+                tuple(f.name if isinstance(f, Variable) else f
+                      for f in (fetch_list or [])))
             mesh = compiled._mesh
             in_shardings = compiled._build_in_shardings
         if program is None:

@@ -1,0 +1,711 @@
+"""Graph IR + pass infrastructure (ref SURVEY §2.2, ``paddle/fluid/framework/ir/``).
+
+TPU-native role: in the reference, graph passes are the *primary* optimizer —
+fusion passes stitch kernels together because the runtime dispatches one CUDA
+kernel per op.  Under XLA the whole block compiles as one computation and the
+compiler does the fusing, so these passes are (a) program-level canonicalizers
+that produce better-shaped traces (e.g. folding conv+BN at inference time
+eliminates the BN params entirely), (b) the analysis substrate (liveness,
+inplace pairing) that informs buffer donation, and (c) the user-extensible
+rewrite framework (``Pass``/``PassRegistry``/``PassBuilder``) the reference
+exposes via ``ir::Pass`` (``ir/pass.h``) and ``BuildStrategy``.
+
+Components mirrored (reference file:line cited per class):
+- ``Graph``/``Node``       ← ``ir/graph.{h,cc}``, ``ir/node.{h,cc}``
+- ``topology_sort``        ← ``ir/graph_helper.cc TopologySortOperations``
+- ``Pass``/``PassRegistry``← ``ir/pass.{h,cc}``
+- ``PassBuilder``          ← ``ir/pass_builder.{h,cc}``
+- ``PDNode``/``PDPattern``/``GraphPatternDetector``
+                           ← ``ir/graph_pattern_detector.{h,cc}``
+- fusion passes            ← ``ir/fc_fuse_pass.cc``,
+                             ``ir/conv_bn_fuse_pass.cc``,
+                             ``ir/fuse_elewise_add_act_pass.cc``
+- ``reference_count_pass`` / ``buffer_shared_inplace_pass`` analogs
+                           ← ``ir/memory_optimize_pass/``
+- ``graph_viz_pass`` (DOT) ← ``ir/graph_viz_pass.cc``
+- ``graph_to_program``     ← ``ir/graph_to_program_pass.cc``
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .core import Operator, Program, Variable
+
+# ---------------------------------------------------------------------------
+# Graph / Node
+# ---------------------------------------------------------------------------
+
+_node_ids = itertools.count()
+
+
+class Node:
+    """Op or var node (ref ``ir/node.h`` Node::Type::kOperation/kVariable).
+
+    Var nodes are SSA: every write to a name creates a fresh var node, so a
+    pattern match never confuses a value with its later overwrite (the
+    reference gets this from per-definition ``VarHandle`` versions).
+    """
+
+    def __init__(self, kind: str, name: str, op: Optional[Operator] = None,
+                 var: Optional[Variable] = None):
+        self.id = next(_node_ids)
+        self.kind = kind                    # "op" | "var"
+        self.name = name                    # op type, or var name
+        self.op = op                        # Operator (op nodes)
+        self.var = var                      # Variable metadata (var nodes)
+        self.inputs: List[Node] = []
+        self.outputs: List[Node] = []
+
+    def is_op(self, type: Optional[str] = None) -> bool:
+        return self.kind == "op" and (type is None or self.name == type)
+
+    def is_var(self) -> bool:
+        return self.kind == "var"
+
+    @property
+    def persistable(self) -> bool:
+        return bool(self.var is not None and self.var.persistable)
+
+    def __repr__(self):
+        return f"Node#{self.id}({self.kind}:{self.name})"
+
+
+class Graph:
+    """Dependency graph of one block (ref ``ir/graph.h`` ir::Graph).
+
+    Built from block 0 of a Program; ops in other blocks (control-flow
+    sub-blocks) ride along opaquely through their Block-valued attrs, exactly
+    as the reference keeps sub-graphs inside the op's attribute.
+    """
+
+    def __init__(self, program: Program, block_idx: int = 0):
+        self.program = program
+        self.block_idx = block_idx
+        self.attrs: Dict[str, object] = {}
+        self.op_nodes: List[Node] = []      # in original program order
+        self.var_nodes: List[Node] = []
+        block = program.blocks[block_idx]
+        latest: Dict[str, Node] = {}        # name -> current SSA def
+
+        def var_meta(name):
+            return block.vars.get(name) or (
+                block.var(name) if block.has_var(name) else None)
+
+        for op in block.ops:
+            op_node = Node("op", op.type, op=op)
+            self.op_nodes.append(op_node)
+            for name in op.input_arg_names():
+                if not name:
+                    continue
+                v = latest.get(name)
+                if v is None:
+                    v = Node("var", name, var=var_meta(name))
+                    latest[name] = v
+                    self.var_nodes.append(v)
+                op_node.inputs.append(v)
+                v.outputs.append(op_node)
+            for name in op.output_arg_names():
+                if not name:
+                    continue
+                v = Node("var", name, var=var_meta(name))
+                latest[name] = v
+                self.var_nodes.append(v)
+                op_node.outputs.append(v)
+                v.inputs.append(op_node)
+
+    # -- queries -------------------------------------------------------------
+    def all_op_nodes(self) -> List[Node]:
+        return list(self.op_nodes)
+
+    def all_var_nodes(self) -> List[Node]:
+        return list(self.var_nodes)
+
+    def ops_of_type(self, type: str) -> List[Node]:
+        return [n for n in self.op_nodes if n.name == type]
+
+    def num_nodes(self) -> int:
+        return len(self.op_nodes) + len(self.var_nodes)
+
+    def topology_sort(self) -> List[Node]:
+        """Op nodes in dependency order (ref graph_helper.cc
+        TopologySortOperations).  Program order is already topological for a
+        straight-line block, but passes may have appended nodes out of order."""
+        indeg: Dict[int, int] = {}
+        succ: Dict[int, List[Node]] = {}
+        for op in self.op_nodes:
+            indeg.setdefault(op.id, 0)
+            for v in op.outputs:
+                for consumer in v.outputs:
+                    succ.setdefault(op.id, []).append(consumer)
+                    indeg[consumer.id] = indeg.get(consumer.id, 0) + 1
+        from collections import deque
+        ready = deque(op for op in self.op_nodes if indeg[op.id] == 0)
+        order: List[Node] = []
+        while ready:
+            op = ready.popleft()
+            order.append(op)
+            for consumer in succ.get(op.id, []):
+                indeg[consumer.id] -= 1
+                if indeg[consumer.id] == 0:
+                    ready.append(consumer)
+        if len(order) != len(self.op_nodes):
+            raise RuntimeError("graph has a cycle; pass produced invalid IR")
+        return order
+
+    # -- mutation (ref graph.h CreateOpNode/CreateVarNode/RemoveNode) --------
+    def create_op_node(self, type: str, inputs: Dict[str, List[Node]],
+                       outputs: Dict[str, List[Node]],
+                       attrs: Optional[dict] = None) -> Node:
+        block = self.program.blocks[self.block_idx]
+        op = Operator(block, type, attrs=attrs or {})
+        op.inputs = {slot: [v.name for v in vs] for slot, vs in inputs.items()}
+        op.outputs = {slot: [v.name for v in vs]
+                      for slot, vs in outputs.items()}
+        node = Node("op", type, op=op)
+        for vs in inputs.values():
+            for v in vs:
+                node.inputs.append(v)
+                v.outputs.append(node)
+        for vs in outputs.values():
+            for v in vs:
+                node.outputs.append(v)
+                v.inputs.append(node)
+        self.op_nodes.append(node)
+        return node
+
+    def create_var_node(self, name: str, shape=None, dtype=None,
+                        persistable: bool = False) -> Node:
+        block = self.program.blocks[self.block_idx]
+        var = block.create_var(name=name, shape=shape, dtype=dtype,
+                               persistable=persistable)
+        node = Node("var", var.name, var=var)
+        self.var_nodes.append(node)
+        return node
+
+    def safe_remove_nodes(self, nodes: Sequence[Node]) -> None:
+        doomed = {n.id for n in nodes}
+        for n in nodes:
+            if n.kind == "op":
+                self.op_nodes = [o for o in self.op_nodes if o.id != n.id]
+            else:
+                self.var_nodes = [v for v in self.var_nodes if v.id != n.id]
+        for n in itertools.chain(self.op_nodes, self.var_nodes):
+            n.inputs = [i for i in n.inputs if i.id not in doomed]
+            n.outputs = [o for o in n.outputs if o.id not in doomed]
+
+    # -- export (ref ir/graph_to_program_pass.cc) ----------------------------
+    def to_program(self) -> Program:
+        """Rebuild a Program: block 0 from this graph (topo order), other
+        blocks copied from the source so Block-valued attrs stay valid."""
+        src = self.program
+        out = src.clone()
+        blk = out.global_block()
+        # vars already cloned; add any pass-created vars
+        for v in self.var_nodes:
+            if v.var is not None and v.name not in blk.vars:
+                blk.create_var(name=v.name, shape=v.var.shape,
+                               dtype=v.var.dtype,
+                               persistable=v.var.persistable)
+        blk.ops = []
+        for op_node in self.topology_sort():
+            op = op_node.op
+            attrs = {}
+            for k, val in op.attrs.items():
+                # remap sub-block refs into the cloned program
+                from .core import Block
+                attrs[k] = out.blocks[val.idx] if isinstance(val, Block) \
+                    else val
+            nop = Operator(blk, op.type, None, None, attrs)
+            nop.inputs = {k: list(v) for k, v in op.inputs.items()}
+            nop.outputs = {k: list(v) for k, v in op.outputs.items()}
+            blk.ops.append(nop)
+        out._bump_version()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pass framework (ref ir/pass.h, ir/pass_builder.h)
+# ---------------------------------------------------------------------------
+
+class Pass:
+    """Base pass: override ``apply_impl(graph) -> graph``.
+
+    The ``protected`` attr (set of var names) marks values an enclosing
+    executor will fetch: rewrites must not remove their defining ops (the
+    reference marks fetched vars in the graph before applying passes —
+    parallel_executor.cc keeps FetchOpHandles as graph roots)."""
+
+    name = "pass"
+
+    def __init__(self, **attrs):
+        self.attrs = attrs
+
+    def protected_vars(self) -> frozenset:
+        return frozenset(self.get("protected") or ())
+
+    def set(self, key, value):
+        self.attrs[key] = value
+        return self
+
+    def get(self, key, default=None):
+        return self.attrs.get(key, default)
+
+    def apply(self, graph: Graph) -> Graph:
+        out = self.apply_impl(graph)
+        return graph if out is None else out
+
+    def apply_impl(self, graph: Graph) -> Optional[Graph]:
+        raise NotImplementedError
+
+
+_PASS_REGISTRY: Dict[str, Callable[..., Pass]] = {}
+
+
+def register_pass(name: str):
+    """``REGISTER_PASS`` (ref ir/pass.h:195)."""
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_pass(name: str, **attrs) -> Pass:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"no pass registered under {name!r}; "
+                       f"have {sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name](**attrs)
+
+
+def registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+class PassBuilder:
+    """Ordered pass pipeline (ref ir/pass_builder.h PassBuilder)."""
+
+    def __init__(self, names: Optional[Sequence[str]] = None):
+        self._passes: List[Pass] = [get_pass(n) for n in (names or [])]
+
+    def append_pass(self, name: str, **attrs) -> Pass:
+        p = get_pass(name, **attrs)
+        self._passes.append(p)
+        return p
+
+    def insert_pass(self, idx: int, name: str, **attrs) -> Pass:
+        p = get_pass(name, **attrs)
+        self._passes.insert(idx, p)
+        return p
+
+    def remove_pass(self, idx: int) -> None:
+        del self._passes[idx]
+
+    def all_passes(self) -> List[Pass]:
+        return list(self._passes)
+
+    def apply(self, graph: Graph) -> Graph:
+        for p in self._passes:
+            graph = p.apply(graph)
+        return graph
+
+
+def apply_passes(program: Program, names: Sequence[str],
+                 **attrs) -> Program:
+    """Convenience: Program → Graph → passes → Program."""
+    graph = Graph(program)
+    for n in names:
+        graph = get_pass(n, **attrs).apply(graph)
+    return graph.to_program()
+
+
+# ---------------------------------------------------------------------------
+# Pattern detector (ref ir/graph_pattern_detector.{h,cc})
+# ---------------------------------------------------------------------------
+
+class PDNode:
+    """One slot of a pattern: predicate + role flags (ref PDNode)."""
+
+    def __init__(self, pattern: "PDPattern", name: str, kind: str,
+                 op_type: Optional[str] = None,
+                 predicate: Optional[Callable[[Node], bool]] = None,
+                 persistable: Optional[bool] = None):
+        self.pattern = pattern
+        self.pd_name = name
+        self.kind = kind
+        self.op_type = op_type
+        self.predicate = predicate
+        self.persistable = persistable
+        self.intermediate = False
+
+    def as_intermediate(self) -> "PDNode":
+        """Matched nodes are consumed by the rewrite (removed)."""
+        self.intermediate = True
+        return self
+
+    def matches(self, node: Node) -> bool:
+        if node.kind != self.kind:
+            return False
+        if self.op_type is not None and node.name != self.op_type:
+            return False
+        if self.persistable is not None and node.kind == "var" and \
+                node.persistable != self.persistable:
+            return False
+        return self.predicate is None or self.predicate(node)
+
+
+class PDPattern:
+    """A small graph of PDNodes with edges (ref PDPattern)."""
+
+    def __init__(self):
+        self.nodes: List[PDNode] = []
+        self.edges: List[tuple] = []        # (from PDNode, to PDNode)
+
+    def new_op(self, op_type: str, name: Optional[str] = None,
+               predicate=None) -> PDNode:
+        n = PDNode(self, name or op_type, "op", op_type=op_type,
+                   predicate=predicate)
+        self.nodes.append(n)
+        return n
+
+    def new_var(self, name: str, persistable: Optional[bool] = None,
+                predicate=None) -> PDNode:
+        n = PDNode(self, name, "var", predicate=predicate,
+                   persistable=persistable)
+        self.nodes.append(n)
+        return n
+
+    def link(self, frm: PDNode, to: PDNode) -> None:
+        self.edges.append((frm, to))
+
+
+class GraphPatternDetector:
+    """Backtracking subgraph matcher.  The reference builds candidate sets
+    per PDNode then prunes by edge consistency
+    (graph_pattern_detector.cc MarkPDNodesInGraph/DetectPatterns); pattern
+    sizes are tiny (<10 nodes) so plain DFS with injectivity is equivalent
+    and simpler."""
+
+    def __init__(self, pattern: PDPattern):
+        self.pattern = pattern
+
+    def __call__(self, graph: Graph) -> List[Dict[PDNode, Node]]:
+        pat = self.pattern
+        all_nodes = graph.all_op_nodes() + graph.all_var_nodes()
+        candidates = {pd: [n for n in all_nodes if pd.matches(n)]
+                      for pd in pat.nodes}
+        order = sorted(pat.nodes, key=lambda pd: len(candidates[pd]))
+        matches: List[Dict[PDNode, Node]] = []
+        used_ids = set()                    # no overlapping rewrites
+
+        def edges_ok(assign: Dict[PDNode, Node]) -> bool:
+            for frm, to in pat.edges:
+                if frm in assign and to in assign:
+                    if assign[to] not in assign[frm].outputs:
+                        return False
+            return True
+
+        def dfs(i: int, assign: Dict[PDNode, Node]):
+            if i == len(order):
+                if not any(n.id in used_ids for n in assign.values()):
+                    matches.append(dict(assign))
+                    used_ids.update(
+                        n.id for pd, n in assign.items()
+                        if pd.intermediate or pd.kind == "op")
+                return
+            pd = order[i]
+            taken = {n.id for n in assign.values()}
+            for cand in candidates[pd]:
+                if cand.id in taken:
+                    continue
+                assign[pd] = cand
+                if edges_ok(assign):
+                    dfs(i + 1, assign)
+                del assign[pd]
+
+        dfs(0, {})
+        return matches
+
+
+# ---------------------------------------------------------------------------
+# Fusion passes
+# ---------------------------------------------------------------------------
+
+@register_pass("fc_fuse_pass")
+class FCFusePass(Pass):
+    """mul(X,W) + elementwise_add(·,b) [+ act] → one ``fc`` op
+    (ref ir/fc_fuse_pass.cc).  Under XLA the fusion itself is free; the win
+    is a canonical single node for later passes (quant, viz, stats)."""
+
+    ACTS = ("relu", "tanh", "sigmoid", "gelu")
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        pat = PDPattern()
+        mul = pat.new_op("mul")
+        mul_out = pat.new_var("mul_out").as_intermediate()
+        add = pat.new_op("elementwise_add")
+        bias = pat.new_var("bias", persistable=True)
+        add_out = pat.new_var("add_out")
+        pat.link(mul, mul_out)
+        pat.link(mul_out, add)
+        pat.link(bias, add)
+        pat.link(add, add_out)
+        protected = self.protected_vars()
+        count = 0
+        for m in GraphPatternDetector(pat)(graph):
+            # mul_out must feed ONLY the add (no other consumer may lose
+            # it), and must not be a fetch target
+            if len(m[mul_out].outputs) != 1 or \
+                    m[mul_out].name in protected:
+                continue
+            mul_op, add_op = m[mul], m[add]
+            # bind operands by SLOT, not by persistability: fc is X@W, so
+            # Input must be mul's X and W its Y (which must be a weight)
+            by_name = {v.name: v for v in mul_op.inputs}
+            x_name = mul_op.op.input("X")[0]
+            w_name = mul_op.op.input("Y")[0]
+            x_node, w_node = by_name.get(x_name), by_name.get(w_name)
+            if x_node is None or w_node is None or not w_node.persistable:
+                continue
+            out_node = m[add_out]
+            act_type = ""
+            doomed = [mul_op, add_op, m[mul_out]]
+            # optional activation directly consuming add_out
+            consumers = out_node.outputs
+            if len(consumers) == 1 and consumers[0].is_op() and \
+                    consumers[0].name in self.ACTS and \
+                    out_node.name not in protected:
+                act_op = consumers[0]
+                act_type = act_op.name
+                doomed += [act_op, out_node]
+                out_node = act_op.outputs[0]
+            graph.create_op_node(
+                "fc",
+                inputs={"Input": [x_node], "W": [w_node],
+                        "Bias": [m[bias]]},
+                outputs={"Out": [out_node]},
+                attrs={"in_num_col_dims":
+                       mul_op.op.attrs.get("x_num_col_dims", 1),
+                       "activation_type": act_type})
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs["fc_fuse_count"] = count
+        return graph
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add + activation → fused_elemwise_activation
+    (ref ir/fuse_elewise_add_act_pass.cc)."""
+
+    ACTS = ("relu", "scale", "tanh", "sigmoid", "gelu")
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for add in list(graph.ops_of_type("elementwise_add")):
+            if add not in graph.op_nodes:
+                continue
+            out = add.outputs[0] if add.outputs else None
+            if out is None or len(out.outputs) != 1 or \
+                    out.name in protected:
+                continue
+            act = out.outputs[0]
+            if not act.is_op() or act.name not in self.ACTS:
+                continue
+            # bind by slot: elementwise broadcast is X-major
+            by_name = {v.name: v for v in add.inputs}
+            try:
+                xs = [by_name[add.op.input("X")[0]],
+                      by_name[add.op.input("Y")[0]]]
+            except (KeyError, IndexError):
+                continue
+            extra = {}
+            if act.name == "scale":
+                extra = {"scale": act.op.attrs.get("scale", 1.0),
+                         "bias": act.op.attrs.get("bias", 0.0),
+                         "bias_after_scale":
+                         act.op.attrs.get("bias_after_scale", True)}
+            graph.create_op_node(
+                "fused_elemwise_activation",
+                inputs={"X": [xs[0]], "Y": [xs[1]]},
+                outputs={"Out": [act.outputs[0]]},
+                attrs={"functor_list": ["elementwise_add", act.name],
+                       "axis": add.op.attrs.get("axis", -1), **extra})
+            graph.safe_remove_nodes([add, act, out])
+            count += 1
+        graph.attrs["fuse_elewise_add_act_count"] = count
+        return graph
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    """conv2d + batch_norm(is_test) → conv2d + folded weights
+    (ref ir/conv_bn_fuse_pass.cc).  Numeric folding needs the param values:
+    pass ``scope=`` when constructing.  W' = W·(γ/σ) per out-channel,
+    b' = β − μ·γ/σ, emitted as an elementwise_add on the conv output (the
+    reference does exactly this when conv has no bias)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        import numpy as np
+        scope = self.get("scope")
+        if scope is None:
+            raise ValueError("conv_bn_fuse_pass needs scope= with param "
+                             "values to fold numerically")
+        count = 0
+        for bn in list(graph.ops_of_type("batch_norm")):
+            if bn not in graph.op_nodes:
+                continue
+            if not bn.op.attrs.get("is_test") and \
+                    not bn.op.attrs.get("use_global_stats"):
+                continue
+            conv_out = next((v for v in bn.inputs
+                             if v.inputs and v.inputs[0].is_op("conv2d")),
+                            None)
+            if conv_out is None or len(conv_out.outputs) != 1:
+                continue
+            conv = conv_out.inputs[0]
+            w_shared = next(v for v in conv.inputs if v.persistable)
+            if any(c is not conv for c in w_shared.outputs):
+                # folding mutates the filter values in the scope — a shared
+                # filter would silently corrupt its other consumers
+                continue
+            by_name = {v.name: v for v in bn.inputs}
+            op = bn.op
+            scale_n = op.input("Scale")[0]
+            bias_n = op.input("Bias")[0]
+            mean_n = op.input("Mean")[0]
+            var_n = op.input("Variance")[0]
+            w_node = next(v for v in conv.inputs if v.persistable)
+            eps = op.attrs.get("epsilon", 1e-5)
+            gamma = np.asarray(scope.find_var(scale_n), np.float64)
+            beta = np.asarray(scope.find_var(bias_n), np.float64)
+            mu = np.asarray(scope.find_var(mean_n), np.float64)
+            var = np.asarray(scope.find_var(var_n), np.float64)
+            w = np.asarray(scope.find_var(w_node.name), np.float64)
+            factor = gamma / np.sqrt(var + eps)       # [out_c]
+            scope.set_var(w_node.name,
+                          (w * factor.reshape(-1, 1, 1, 1)).astype(
+                              np.float32))
+            fused_bias_name = bn.op.output("Y")[0] + ".conv_bn_bias"
+            bias_node = graph.create_var_node(
+                fused_bias_name, shape=(len(factor),), dtype="float32",
+                persistable=True)
+            scope.set_var(fused_bias_name,
+                          (beta - mu * factor).astype(np.float32))
+            y_node = next(v for v in bn.outputs
+                          if v.name in op.output("Y"))
+            graph.create_op_node(
+                "elementwise_add",
+                inputs={"X": [conv_out], "Y": [bias_node]},
+                outputs={"Out": [y_node]},
+                attrs={"axis": 1})
+            # stat outputs (MeanOut etc.) die with the bn node
+            doomed = [bn] + [v for v in bn.outputs if v is not y_node]
+            doomed += [by_name[n] for n in
+                       (scale_n, bias_n, mean_n, var_n)
+                       if n in by_name and
+                       all(c is bn for c in by_name[n].outputs)]
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs["conv_bn_fuse_count"] = count
+        return graph
+
+
+# ---------------------------------------------------------------------------
+# Memory-analysis passes (ref ir/memory_optimize_pass/)
+# ---------------------------------------------------------------------------
+
+@register_pass("reference_count_pass")
+class ReferenceCountPass(Pass):
+    """Liveness: last-use op index per non-persistable var
+    (ref reference_count_pass.cc).  Under the block-compiler XLA frees
+    temporaries itself; this analysis feeds donation and debugging
+    (``graph.attrs['last_use']``)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        order = {op.id: i for i, op in enumerate(graph.topology_sort())}
+        last_use: Dict[str, int] = {}
+        for v in graph.all_var_nodes():
+            if v.persistable:
+                continue
+            uses = [order[c.id] for c in v.outputs if c.id in order]
+            if uses:
+                last_use[v.name] = max(uses)
+        graph.attrs["last_use"] = last_use
+        return graph
+
+
+@register_pass("buffer_shared_inplace_pass")
+class BufferSharedInplacePass(Pass):
+    """Pairs (in, out) an op could compute in place because the input dies
+    there (ref buffer_shared_inplace_op_pass.cc).  XLA's buffer assigner
+    performs the actual aliasing; the pairs inform ``donate_argnums`` for
+    feed buffers (``graph.attrs['inplace_pairs']``)."""
+
+    INPLACE_OPS = ("relu", "scale", "reshape", "reshape2", "squeeze",
+                   "squeeze2", "unsqueeze", "unsqueeze2", "flatten",
+                   "flatten2", "elementwise_add", "softmax", "dropout")
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        graph = get_pass("reference_count_pass").apply(graph)
+        last_use = graph.attrs["last_use"]
+        order = {op.id: i for i, op in enumerate(graph.topology_sort())}
+        pairs = []
+        for op in graph.all_op_nodes():
+            if op.name not in self.INPLACE_OPS:
+                continue
+            for vin in op.inputs:
+                if vin.persistable or vin.name not in last_use:
+                    continue
+                if last_use[vin.name] == order[op.id] and op.outputs:
+                    pairs.append((vin.name, op.outputs[0].name))
+                    break
+        graph.attrs["inplace_pairs"] = pairs
+        return graph
+
+
+# ---------------------------------------------------------------------------
+# Graph viz / round-trip passes
+# ---------------------------------------------------------------------------
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """DOT dump (ref ir/graph_viz_pass.cc).  ``graph_viz_path`` attr writes
+    to a file; the DOT text is also returned in
+    ``graph.attrs['graph_viz_dot']``."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        lines = ["digraph G {", "  rankdir=TB;"]
+        for op in graph.all_op_nodes():
+            lines.append(
+                f'  n{op.id} [label="{op.name}" shape=box '
+                f'style=filled fillcolor="#ffd39b"];')
+        for v in graph.all_var_nodes():
+            shape = "ellipse"
+            fill = "#c0d9ee" if not v.persistable else "#b5e7b5"
+            lines.append(
+                f'  n{v.id} [label="{v.name}" shape={shape} '
+                f'style=filled fillcolor="{fill}"];')
+        for n in graph.all_op_nodes() + graph.all_var_nodes():
+            for o in n.outputs:
+                lines.append(f"  n{n.id} -> n{o.id};")
+        lines.append("}")
+        dot = "\n".join(lines)
+        graph.attrs["graph_viz_dot"] = dot
+        path = self.get("graph_viz_path")
+        if path:
+            with open(path, "w") as f:
+                f.write(dot)
+        return graph
+
+
+@register_pass("graph_to_program_pass")
+class GraphToProgramPass(Pass):
+    """Round-trip Graph → ProgramDesc (ref ir/graph_to_program_pass.cc);
+    result in ``graph.attrs['program']``."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        graph.attrs["program"] = graph.to_program()
+        return graph

@@ -105,6 +105,18 @@ class AnalysisPredictor:
             _io.load_inference_model(
                 config.model_dir, model_filename=config.prog_file,
                 params_filename=config.params_file, scope=self.scope)
+        if config._ir_optim:
+            # analysis pass pipeline (ref inference/analysis/ir_pass_manager
+            # .cc): canonicalizing fusions before the XLA trace.  conv+BN
+            # folds numerically into the conv weights (needs the scope).
+            from ..framework import ir
+            keep = frozenset(self.fetch_names)
+            g = ir.Graph(self.program)
+            g = ir.get_pass("conv_bn_fuse_pass", scope=self.scope).apply(g)
+            g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
+            g = ir.get_pass("fuse_elewise_add_act_pass",
+                            protected=keep).apply(g)
+            self.program = g.to_program()
         self._params = {name: jnp.asarray(np.asarray(val))
                         for name, val in self.scope.items() if val is not None}
         self._fn = program_as_function(self.program, self.feed_names,

@@ -792,3 +792,41 @@ def _im2sequence(ctx, ins, attrs):
     nc, oh, ow = patches.shape[1], patches.shape[2], patches.shape[3]
     out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, nc)
     return {"Out": [out]}
+
+
+@register_op("fc")
+def _fc(ctx, ins, attrs):
+    """Fused fc produced by fc_fuse_pass (ref operators/fc_op.cc): flatten
+    Input at in_num_col_dims, matmul W, add Bias, optional activation."""
+    from .math_ops import _ACTIVATIONS
+    x, w, b = X(ins, "Input"), X(ins, "W"), X(ins, "Bias")
+    ncd = attrs.get("in_num_col_dims", 1)
+    x2 = x.reshape(int(np.prod(x.shape[:ncd])), -1)
+    out = x2 @ w
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    act = attrs.get("activation_type", "")
+    if act:
+        out = (jax.nn.gelu if act == "gelu" else _ACTIVATIONS[act])(out)
+    return {"Out": [out.reshape(x.shape[:ncd] + (w.shape[1],))]}
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """ref operators/fused/fused_elemwise_activation_op.cc: functor_list is
+    [binary, unary] applied as unary(binary(x, y))."""
+    from .math_ops import _ACTIVATIONS
+    x, y = X(ins, "X"), X(ins, "Y")
+    binary, unary = attrs["functor_list"]
+    if binary != "elementwise_add":
+        raise NotImplementedError(f"fused functor {binary}")
+    out = x + broadcast_to_x(x, y, attrs.get("axis", -1))
+    if unary == "scale":
+        s, b = attrs.get("scale", 1.0), attrs.get("bias", 0.0)
+        out = out * s + b if attrs.get("bias_after_scale", True) \
+            else (out + b) * s
+    elif unary == "gelu":
+        out = jax.nn.gelu(out, approximate=False)
+    else:
+        out = _ACTIVATIONS[unary](out)
+    return {"Out": [out]}
