@@ -70,6 +70,24 @@ class Collective:
     def _transpile_main(self, main):
         raise NotImplementedError
 
+    def _append_dense_allreduce(self, block, at, grads):
+        """scale 1/nranks + c_allreduce_sum per grad (ref collective.py
+        :189,:208); shared by GradAllReduce and the DGC transpiler's
+        non-compressed tail."""
+        ring = 0
+        for g in grads:
+            block.insert_op(at, "scale",
+                            inputs={"X": [g]}, outputs={"Out": [g]},
+                            attrs={"scale": 1.0 / self.nranks, "bias": 0.0,
+                                   "bias_after_scale": False})
+            block.insert_op(at + 1, "c_allreduce_sum",
+                            inputs={"X": [g]}, outputs={"Out": [g]},
+                            attrs={"ring_id": ring % self.nrings,
+                                   "use_calc_stream": True})
+            at += 2
+            ring += 1
+        return at
+
 
 class GradAllReduce(Collective):
     """Sync multi-process data parallel (ref collective.py:178).
@@ -93,20 +111,7 @@ class GradAllReduce(Collective):
                         grads.append(g)
         if first_opt is None or not grads:
             return
-        ring = 0
-        at = first_opt
-        for g in grads:
-            # scale 1/nranks (ref :189) + allreduce (ref :208)
-            block.insert_op(at, "scale",
-                            inputs={"X": [g]}, outputs={"Out": [g]},
-                            attrs={"scale": 1.0 / self.nranks, "bias": 0.0,
-                                   "bias_after_scale": False})
-            block.insert_op(at + 1, "c_allreduce_sum",
-                            inputs={"X": [g]}, outputs={"Out": [g]},
-                            attrs={"ring_id": ring % self.nrings,
-                                   "use_calc_stream": True})
-            at += 2
-            ring += 1
+        self._append_dense_allreduce(block, first_opt, grads)
 
 
 class LocalSGD(Collective):

@@ -514,15 +514,32 @@ class LambOptimizer(AdamOptimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """ref optimizer.py:809 — deep gradient compression.  Single-chip
-    semantics equal Momentum; the sparse-allreduce path lives in
-    ``paddle_tpu.parallel.dgc`` and activates under data-parallel meshes."""
+    """ref optimizer.py:809 — deep gradient compression.  Single-process
+    semantics equal Momentum; under ``parallel.dgc.DGCGradAllReduce`` the
+    tagged momentum ops are rewritten into dgc_allreduce (top-k sparse
+    sync with momentum correction) + dgc_momentum."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
-                 rampup_step=1, sparsity=(0.999,), **kw):
+                 rampup_step=1, sparsity=(0.999,),
+                 local_grad_clip_norm=None, **kw):
         super().__init__(learning_rate, momentum, **kw)
         self._rampup_begin_step = rampup_begin_step
         self._sparsity = sparsity
+        self._local_grad_clip_norm = local_grad_clip_norm
+
+    def _append_optimize_op(self, block, param_and_grad):
+        super()._append_optimize_op(block, param_and_grad)
+        if not hasattr(block, "ops"):
+            return  # dygraph EagerBlock: eager DGC degrades to momentum
+        op = block.ops[-1]
+        op.attrs["dgc"] = True
+        op.attrs["rampup_begin_step"] = self._rampup_begin_step
+        op.attrs["sparsity"] = float(self._sparsity[-1]) \
+            if isinstance(self._sparsity, (list, tuple)) else \
+            float(self._sparsity)
+        if self._local_grad_clip_norm is not None:
+            op.attrs["local_grad_clip_norm"] = \
+                float(self._local_grad_clip_norm)
 
 
 class ExponentialMovingAverage:

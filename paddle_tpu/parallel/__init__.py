@@ -2,3 +2,4 @@ from .mesh import (current_mesh, data_parallel_mesh, make_mesh, set_mesh,  # noq
                    sharding_for)
 from .pipeline import (PipelineEngine, PipelineOptimizer,  # noqa
                        Section, split_program)
+from .dgc import DGCGradAllReduce  # noqa  (registers dgc_* op lowerings)
