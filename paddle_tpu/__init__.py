@@ -19,6 +19,7 @@ from .framework import (Program, Variable, append_backward,  # noqa
                         Scope)
 from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
+from . import evaluator, metrics, nets  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
 from . import native  # noqa

@@ -1,0 +1,81 @@
+"""Evaluator API (ref ``python/paddle/fluid/evaluator.py``).
+
+Deprecated in the reference in favor of ``fluid.metrics`` — kept for API
+parity.  Each evaluator owns host-side accumulator state and exposes the
+reference protocol: construct with graph outputs, call ``update`` with the
+fetched per-batch values, ``eval()`` for the aggregate, ``reset()`` between
+passes (the reference stores state in scope variables and appends update
+ops; under the block-compiler the per-batch stats are just fetched and
+reduced host-side, same numbers)."""
+
+from __future__ import annotations
+
+from . import metrics as _metrics
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    """ref evaluator.py Evaluator: named metric states + reset/eval."""
+
+    def __init__(self, name, **kwargs):
+        self.metric = None
+        self.states = []
+        self.helper_name = name
+
+    def reset(self, executor=None, reset_program=None):
+        if self.metric is not None:
+            self.metric.reset()
+
+    def eval(self, executor=None, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """ref evaluator.py ChunkEvaluator: F1 over chunk counts; pass the
+    ``chunk_eval`` op's count outputs to ``update``."""
+
+    def __init__(self, input=None, label=None, chunk_scheme=None,
+                 num_chunk_types=None, excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        self.metric = _metrics.ChunkEvaluator()
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.metric.update(num_infer_chunks, num_label_chunks,
+                           num_correct_chunks)
+
+    def eval(self, executor=None, eval_program=None):
+        return self.metric.eval()
+
+
+class EditDistance(Evaluator):
+    """ref evaluator.py EditDistance."""
+
+    def __init__(self, input=None, label=None, ignored_tokens=None):
+        super().__init__("edit_distance")
+        self.metric = _metrics.EditDistance()
+
+    def update(self, distances, seq_num):
+        self.metric.update(distances, seq_num)
+
+    def eval(self, executor=None, eval_program=None):
+        return self.metric.eval()
+
+
+class DetectionMAP(Evaluator):
+    """ref evaluator.py DetectionMAP."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("map_eval")
+        self.metric = _metrics.DetectionMAP(
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+
+    def update(self, pred, gt):
+        self.metric.update(pred, gt)
+
+    def eval(self, executor=None, eval_program=None):
+        return self.metric.eval()

@@ -1,0 +1,146 @@
+"""Probability distributions (ref ``python/paddle/fluid/layers/
+distributions.py``): Uniform, Normal, Categorical, MultivariateNormalDiag
+built from the layer DSL, so every method returns graph Variables."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.core import Variable
+from . import nn, tensor
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_variable(x, name="dist_const"):
+    if isinstance(x, Variable):
+        return x
+    arr = np.asarray(x, np.float32)
+    return tensor.assign(arr if arr.ndim else arr.reshape(1))
+
+
+class Distribution:
+    """ref distributions.py Distribution base."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (ref distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _to_variable(low)
+        self.high = _to_variable(high)
+
+    def sample(self, shape, seed=0):
+        u = nn.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        return self.low + u * (self.high - self.low)
+
+    def entropy(self):
+        return nn.log(self.high - self.low)
+
+    def log_prob(self, value):
+        # in-support density 1/(high-low); the reference likewise does not
+        # mask out-of-support values
+        return 0.0 - nn.log(self.high - self.low) + \
+            tensor.zeros_like(value)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (ref distributions.py Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_variable(loc)
+        self.scale = _to_variable(scale)
+
+    def sample(self, shape, seed=0):
+        z = nn.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return self.loc + z * self.scale
+
+    def entropy(self):
+        half_log_2pi_e = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return half_log_2pi_e + nn.log(self.scale)
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        log_scale = nn.log(self.scale)
+        diff = value - self.loc
+        return 0.0 - (diff * diff) / (2.0 * var) - log_scale \
+            - 0.5 * math.log(2.0 * math.pi)
+
+    def kl_divergence(self, other):
+        """KL(self ‖ other) for two Normals (ref :kl_divergence)."""
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - nn.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (ref distributions.py
+    Categorical: entropy + kl_divergence)."""
+
+    def __init__(self, logits):
+        self.logits = _to_variable(logits)
+
+    def _probs_and_logp(self, logits):
+        z = logits - nn.reduce_max(logits, dim=[-1], keep_dim=True)
+        e = nn.exp(z)
+        denom = nn.reduce_sum(e, dim=[-1], keep_dim=True)
+        prob = e / denom
+        logp = z - nn.log(denom)
+        return prob, logp
+
+    def entropy(self):
+        prob, logp = self._probs_and_logp(self.logits)
+        return 0.0 - nn.reduce_sum(prob * logp, dim=[-1])
+
+    def kl_divergence(self, other):
+        prob, logp = self._probs_and_logp(self.logits)
+        _, logq = self._probs_and_logp(other.logits)
+        return nn.reduce_sum(prob * (logp - logq), dim=[-1])
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) — diagonal-covariance multivariate normal
+    (ref distributions.py MultivariateNormalDiag: entropy + kl)."""
+
+    def __init__(self, loc, scale):
+        # scale is the DIAGONAL MATRIX [k,k] in the reference's API
+        self.loc = _to_variable(loc)
+        self.scale = _to_variable(scale)
+
+    def _diag(self):
+        k = self.scale.shape[-1]
+        eye = tensor.assign(np.eye(k, dtype=np.float32))
+        return nn.reduce_sum(self.scale * eye, dim=[-1])
+
+    def entropy(self):
+        d = self._diag()
+        k = float(d.shape[-1])
+        log_det = nn.reduce_sum(nn.log(d), dim=[-1])
+        return 0.5 * k * (1.0 + math.log(2.0 * math.pi)) + log_det
+
+    def kl_divergence(self, other):
+        d1, d2 = self._diag(), other._diag()
+        var1, var2 = d1 * d1, d2 * d2
+        diff = self.loc - other.loc
+        k = float(d1.shape[-1])
+        tr = nn.reduce_sum(var1 / var2, dim=[-1])
+        quad = nn.reduce_sum(diff * diff / var2, dim=[-1])
+        log_det = nn.reduce_sum(nn.log(var2) - nn.log(var1),
+                                dim=[-1])
+        return 0.5 * (tr + quad - k + log_det)

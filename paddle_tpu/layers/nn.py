@@ -921,6 +921,15 @@ def size(input):
     return out
 
 
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype, "min": min,
+                            "max": max, "seed": seed})
+    return out
+
+
 def uniform_random_batch_size_like(input, shape, dtype="float32",
                                    input_dim_idx=0, output_dim_idx=0,
                                    min=-1.0, max=1.0, seed=0):

@@ -1,0 +1,321 @@
+"""Stateful Python metric aggregators (ref ``python/paddle/fluid/metrics.py``).
+
+These accumulate across minibatches host-side; the in-graph metric ops
+(``accuracy``, ``auc`` — ``operators/metrics/``) produce the per-batch
+statistics fed into ``update``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "ChunkEvaluator", "EditDistance", "Auc",
+           "DetectionMAP"]
+
+
+def _to_np(x):
+    return np.asarray(x)
+
+
+class MetricBase:
+    """ref metrics.py MetricBase: name + reset/update/eval protocol."""
+
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def get_config(self):
+        states = {k: v for k, v in self.__dict__.items()
+                  if not k.startswith("_")}
+        return {"name": self._name, "states": states}
+
+    def reset(self):
+        for k in list(self.__dict__):
+            if not k.startswith("_"):
+                v = self.__dict__[k]
+                self.__dict__[k] = 0.0 if np.isscalar(v) else \
+                    type(v)() if isinstance(v, (list, dict)) else v * 0
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """ref metrics.py CompositeMetric: fan one update into many metrics."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise TypeError("add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision = tp / (tp + fp) (ref metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).astype(np.int64).ravel()
+        labels = _to_np(labels).astype(np.int64).ravel()
+        pos = preds == 1
+        self.tp += float(np.sum(pos & (labels == 1)))
+        self.fp += float(np.sum(pos & (labels != 1)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall = tp / (tp + fn) (ref metrics.py Recall)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).astype(np.int64).ravel()
+        labels = _to_np(labels).astype(np.int64).ravel()
+        true = labels == 1
+        self.tp += float(np.sum(true & (preds == 1)))
+        self.fn += float(np.sum(true & (preds != 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running accuracy: feed the per-batch accuracy from the
+    in-graph ``accuracy`` op plus the batch size (ref metrics.py Accuracy)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        value = float(np.asarray(value).ravel()[0])
+        weight = float(weight)
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += value * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy.eval before any update")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunking F1 from (num_infer, num_label, num_correct) counts produced
+    by the ``chunk_eval`` op (ref metrics.py ChunkEvaluator)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0.0
+        self.num_label_chunks = 0.0
+        self.num_correct_chunks = 0.0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += float(np.asarray(num_infer_chunks).ravel()[0])
+        self.num_label_chunks += float(np.asarray(num_label_chunks).ravel()[0])
+        self.num_correct_chunks += float(
+            np.asarray(num_correct_chunks).ravel()[0])
+
+    def eval(self):
+        precision = self.num_correct_chunks / self.num_infer_chunks \
+            if self.num_infer_chunks else 0.0
+        recall = self.num_correct_chunks / self.num_label_chunks \
+            if self.num_label_chunks else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.0
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate from the
+    ``edit_distance`` op's (distances, seq_num) pair (ref metrics.py)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = _to_np(distances).astype(np.float64).ravel()
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(d > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance.eval before any update")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """ROC AUC via threshold-bucketed tp/fp histograms, trapezoid rule
+    (ref metrics.py Auc — same bucket algorithm as the ``auc`` op)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        if curve not in ("ROC", "PR"):
+            raise ValueError(f"curve must be ROC or PR, got {curve!r}")
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(num_thresholds + 1, np.int64)
+
+    def reset(self):
+        self._stat_pos[:] = 0
+        self._stat_neg[:] = 0
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).astype(np.int64).ravel()
+        # preds: [N, 2] probability rows (ref expects softmax output)
+        p1 = preds[:, -1] if preds.ndim == 2 else preds.ravel()
+        idx = np.minimum((p1 * self._num_thresholds).astype(np.int64),
+                         self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels != 1], 1)
+
+    def eval(self):
+        # cumulate from the highest threshold down: (tp, fp) at each cut
+        tp = np.cumsum(self._stat_pos[::-1]).astype(np.float64)
+        fp = np.cumsum(self._stat_neg[::-1]).astype(np.float64)
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        if self._curve == "ROC":
+            tpr = np.concatenate([[0.0], tp / tot_pos])
+            fpr = np.concatenate([[0.0], fp / tot_neg])
+            return float(np.trapezoid(tpr, fpr))
+        rec = np.concatenate([[0.0], tp / tot_pos])
+        prec = np.concatenate([[1.0], tp / np.maximum(tp + fp, 1e-12)])
+        return float(np.trapezoid(prec, rec))
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection, 11-point interpolated or
+    integral (ref metrics.py DetectionMAP / operators/detection_map_op).
+
+    ``update(pred, gt)`` takes per-image lists:
+      pred: [label, score, xmin, ymin, xmax, ymax] rows
+      gt:   [label, xmin, ymin, xmax, ymax] or
+            [label, xmin, ymin, xmax, ymax, difficult] rows
+    With ``evaluate_difficult=False``, difficult gt boxes are excluded from
+    the recall denominator and detections matching them count neither as
+    true nor false positives (VOC convention, ref detection_map_op).
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be integral|11point")
+        self._iou = overlap_threshold
+        self._evaluate_difficult = evaluate_difficult
+        self._ap_version = ap_version
+        self._preds = []      # (label, score, matched, ignored)
+        self._gt_count = {}
+
+    def reset(self):
+        self._preds = []
+        self._gt_count = {}
+
+    @staticmethod
+    def _iou_xyxy(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, pred, gt):
+        pred = _to_np(pred).reshape(-1, 6)
+        gt = _to_np(gt)
+        gt = gt.reshape(-1, gt.shape[-1] if gt.ndim > 1 else 5)
+        difficult = gt[:, 5].astype(bool) if gt.shape[1] > 5 else \
+            np.zeros(len(gt), bool)
+        count_mask = self._evaluate_difficult | ~difficult
+        for lbl in set(gt[:, 0].astype(int)):
+            self._gt_count[lbl] = self._gt_count.get(lbl, 0) + \
+                int(np.sum((gt[:, 0].astype(int) == lbl) & count_mask))
+        taken = set()
+        for row in pred[np.argsort(-pred[:, 1])]:
+            lbl, score = int(row[0]), float(row[1])
+            best, best_j = 0.0, -1
+            for j, g in enumerate(gt):
+                if int(g[0]) != lbl or j in taken:
+                    continue
+                iou = self._iou_xyxy(row[2:], g[1:5])
+                if iou > best:
+                    best, best_j = iou, j
+            matched = best >= self._iou and best_j >= 0
+            ignored = matched and not count_mask[best_j]
+            if matched:
+                taken.add(best_j)
+            self._preds.append((lbl, score, matched and not ignored,
+                                ignored))
+
+    def _ap(self, rec, prec):
+        if self._ap_version == "11point":
+            return float(np.mean([
+                max([p for r, p in zip(rec, prec) if r >= t], default=0.0)
+                for t in np.linspace(0, 1, 11)]))
+        # VOC integral: interpolate precision with the running max over
+        # LATER points (each recall gain is credited the best precision
+        # still achievable at that recall or beyond)
+        prec = np.maximum.accumulate(prec[::-1])[::-1]
+        ap = 0.0
+        prev_r = 0.0
+        for r, p in zip(rec, prec):
+            ap += (r - prev_r) * p
+            prev_r = r
+        return ap
+
+    def eval(self):
+        if not self._gt_count:
+            raise ValueError("DetectionMAP.eval before any update")
+        aps = []
+        for lbl, n_gt in self._gt_count.items():
+            rows = sorted((p for p in self._preds
+                           if p[0] == lbl and not p[3]),
+                          key=lambda t: -t[1])
+            tp = np.cumsum([1 if m else 0 for _, _, m, _ in rows])
+            fp = np.cumsum([0 if m else 1 for _, _, m, _ in rows])
+            if len(rows) == 0:
+                aps.append(0.0)
+                continue
+            rec = tp / max(n_gt, 1)
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            aps.append(self._ap(rec, prec))
+        return float(np.mean(aps))

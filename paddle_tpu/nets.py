@@ -1,0 +1,114 @@
+"""Composite network helpers (ref ``python/paddle/fluid/nets.py``):
+prebuilt layer stacks over the fluid-style DSL."""
+
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """ref nets.py simple_img_conv_pool — conv2d + pool2d."""
+    conv_out = layers.conv2d(input, num_filters=num_filters,
+                             filter_size=filter_size, stride=conv_stride,
+                             padding=conv_padding, dilation=conv_dilation,
+                             groups=conv_groups, param_attr=param_attr,
+                             bias_attr=bias_attr, act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """ref nets.py img_conv_group — VGG-style conv[-bn][-dropout]* + pool."""
+    tmp = input
+    if not isinstance(conv_num_filter, (list, tuple)):
+        conv_num_filter = [conv_num_filter]
+
+    def _expand(v):
+        return v if isinstance(v, (list, tuple)) else \
+            [v] * len(conv_num_filter)
+
+    conv_padding = _expand(conv_padding)
+    conv_filter_size = _expand(conv_filter_size)
+    param_attr = _expand(param_attr)
+    conv_with_batchnorm = _expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _expand(conv_batchnorm_drop_rate)
+
+    for i, nf in enumerate(conv_num_filter):
+        local_act = conv_act if not conv_with_batchnorm[i] else None
+        tmp = layers.conv2d(tmp, num_filters=nf,
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i],
+                            param_attr=param_attr[i], act=local_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            rate = conv_batchnorm_drop_rate[i]
+            if abs(rate) > 1e-5:
+                tmp = layers.dropout(tmp, dropout_prob=rate)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """ref nets.py sequence_conv_pool — sequence_conv + sequence_pool."""
+    conv_out = layers.sequence_conv(input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act,
+                                    bias_attr=bias_attr)
+    return layers.sequence_pool(conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """ref nets.py glu — gated linear unit: a ⊙ σ(b) over a split."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """ref nets.py scaled_dot_product_attention — multi-head attention from
+    primitive layers (the Pallas flash path lives in
+    ``paddle_tpu.pallas.flash_attention``; this is the composable DSL form).
+
+    queries [B, Lq, D], keys/values [B, Lk, D] → [B, Lq, D]
+    """
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys must share the hidden size")
+    if keys.shape[-1] % num_heads != 0:
+        raise ValueError("num_heads must divide the hidden size")
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        b, l, d = x.shape
+        x = layers.reshape(x, shape=[0, 0, num_heads, d // num_heads])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    def _merge_heads(x):
+        if num_heads == 1:
+            return x
+        x = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(x, shape=[0, 0, int(x.shape[2] * x.shape[3])])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    head_dim = int(q.shape[-1])
+    scaled_q = layers.scale(q, scale=head_dim ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    return _merge_heads(ctx)

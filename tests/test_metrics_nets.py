@@ -1,0 +1,223 @@
+"""Metrics aggregators, Evaluator, distributions, nets (ref
+python/paddle/fluid/{metrics,evaluator,nets}.py, layers/distributions.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, metrics, nets
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.layers import distributions
+
+
+def _fresh():
+    return program_guard(Program(), Program())
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_precision_recall_accuracy():
+    p, r = metrics.Precision(), metrics.Recall()
+    preds = np.array([1, 1, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+    a = metrics.Accuracy()
+    a.update(0.5, 10)
+    a.update(1.0, 10)
+    assert a.eval() == pytest.approx(0.75)
+    a.reset()
+    with pytest.raises(ValueError):
+        a.eval()
+
+
+def test_auc_against_sklearn_free_reference():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(500)
+    labels = (scores + rng.rand(500) * 0.7 > 0.8).astype(np.int64)
+    m = metrics.Auc()
+    m.update(np.stack([1 - scores, scores], 1), labels)
+    # exact rank-statistic AUC
+    pos, neg = scores[labels == 1], scores[labels == 0]
+    exact = np.mean([(pos_i > neg).mean() + 0.5 * (pos_i == neg).mean()
+                     for pos_i in pos])
+    assert m.eval() == pytest.approx(exact, abs=2e-3)
+
+
+def test_chunk_edit_composite():
+    c = metrics.ChunkEvaluator()
+    c.update(10, 8, 6)
+    prec, rec, f1 = c.eval()
+    assert prec == pytest.approx(0.6)
+    assert rec == pytest.approx(0.75)
+    assert f1 == pytest.approx(2 * 0.6 * 0.75 / 1.35)
+    e = metrics.EditDistance()
+    e.update(np.array([0.0, 2.0, 1.0]), 3)
+    avg, err = e.eval()
+    assert avg == pytest.approx(1.0)
+    assert err == pytest.approx(2 / 3)
+    comp = metrics.CompositeMetric()
+    comp.add_metric(metrics.Precision())
+    comp.add_metric(metrics.Recall())
+    comp.update(np.array([1, 0]), np.array([1, 1]))
+    assert comp.eval() == [1.0, 0.5]
+
+
+def test_detection_map():
+    m = metrics.DetectionMAP(overlap_threshold=0.5)
+    gt = np.array([[0, 0, 0, 10, 10], [1, 20, 20, 30, 30]], np.float32)
+    pred = np.array([[0, 0.9, 0, 0, 10, 10],       # perfect match
+                     [1, 0.8, 21, 21, 30, 30],     # good match
+                     [1, 0.7, 50, 50, 60, 60]],    # false positive
+                    np.float32)
+    m.update(pred, gt)
+    val = m.eval()
+    assert 0.9 <= val <= 1.0   # both classes found, one fp after the tp
+
+
+# -- evaluator --------------------------------------------------------------
+
+def test_evaluator_wrappers():
+    from paddle_tpu.evaluator import ChunkEvaluator, EditDistance
+    c = ChunkEvaluator()
+    c.update(4, 4, 4)
+    assert c.eval() == (1.0, 1.0, 1.0)
+    c.reset()
+    e = EditDistance()
+    e.update([1.0], 1)
+    assert e.eval()[0] == 1.0
+
+
+# -- distributions ----------------------------------------------------------
+
+def test_normal_uniform_distributions():
+    with _fresh():
+        n = distributions.Normal(0.0, 2.0)
+        u = distributions.Uniform(1.0, 3.0)
+        x = layers.data("x", shape=[1], dtype="float32")
+        ent_n = n.entropy()
+        lp = n.log_prob(x)
+        s = n.sample([1000], seed=5)
+        ent_u = u.entropy()
+        su = u.sample([1000], seed=7)
+        kl = n.kl_divergence(distributions.Normal(1.0, 1.0))
+        exe = Executor()
+        xv = np.array([[1.0]], np.float32)
+        en, lpv, sv, eu, suv, klv = exe.run(
+            feed={"x": xv}, fetch_list=[ent_n, lp, s, ent_u, su, kl])
+        assert float(en[0]) == pytest.approx(
+            0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0), rel=1e-5)
+        assert float(lpv[0, 0]) == pytest.approx(
+            -0.125 - math.log(2.0) - 0.5 * math.log(2 * math.pi), rel=1e-5)
+        assert abs(np.mean(sv)) < 0.3 and 1.5 < np.std(sv) < 2.5
+        assert float(eu[0]) == pytest.approx(math.log(2.0), rel=1e-5)
+        assert suv.min() >= 1.0 and suv.max() <= 3.0
+        # KL(N(0,2) || N(1,1)) = 0.5*(4 + 1 - 1 - ln 4)
+        assert float(klv[0]) == pytest.approx(
+            0.5 * (4 + 1 - 1 - math.log(4.0)), rel=1e-5)
+
+
+def test_categorical_and_mvn():
+    with _fresh():
+        logits = layers.assign(np.array([1.0, 2.0, 3.0], np.float32))
+        c = distributions.Categorical(logits)
+        c2 = distributions.Categorical(
+            layers.assign(np.array([3.0, 2.0, 1.0], np.float32)))
+        ent = c.entropy()
+        kl = c.kl_divergence(c2)
+        m1 = distributions.MultivariateNormalDiag(
+            layers.assign(np.zeros(2, np.float32)),
+            layers.assign(np.eye(2, dtype=np.float32) * 2.0))
+        m2 = distributions.MultivariateNormalDiag(
+            layers.assign(np.ones(2, np.float32)),
+            layers.assign(np.eye(2, dtype=np.float32)))
+        em = m1.entropy()
+        klm = m1.kl_divergence(m2)
+        exe = Executor()
+        e, k, emv, klmv = exe.run(fetch_list=[ent, kl, em, klm])
+        p = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+        q = p[::-1]
+        assert float(e) == pytest.approx(-np.sum(p * np.log(p)), rel=1e-5)
+        assert float(k) == pytest.approx(np.sum(p * np.log(p / q)), rel=1e-5)
+        # H = 0.5*k*(1+ln 2π) + Σ ln σ
+        assert float(emv) == pytest.approx(
+            (1 + math.log(2 * math.pi)) + 2 * math.log(2.0), rel=1e-5)
+        # KL = .5*(Σ σ1²/σ2² + Σ diff²/σ2² - k + Σ ln σ2²/σ1²)
+        assert float(klmv) == pytest.approx(
+            0.5 * (8 + 2 - 2 + 2 * math.log(1 / 4.0)), rel=1e-5)
+
+
+# -- nets -------------------------------------------------------------------
+
+def test_simple_img_conv_pool_and_group():
+    with _fresh():
+        img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+        out = nets.simple_img_conv_pool(img, num_filters=4, filter_size=5,
+                                        pool_size=2, pool_stride=2,
+                                        act="relu")
+        grp = nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                                  pool_stride=2, conv_with_batchnorm=True,
+                                  conv_act="relu")
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        xv = np.random.RandomState(0).rand(2, 1, 28, 28).astype(np.float32)
+        a, b = exe.run(feed={"img": xv}, fetch_list=[out, grp])
+        assert a.shape == (2, 4, 12, 12)
+        assert b.shape == (2, 4, 14, 14)
+
+
+def test_glu_and_sdpa():
+    with _fresh():
+        x = layers.data("x", shape=[8], dtype="float32")
+        g = nets.glu(x, dim=-1)
+        q = layers.data("q", shape=[5, 16], dtype="float32")
+        kv = layers.data("kv", shape=[7, 16], dtype="float32")
+        att = nets.scaled_dot_product_attention(q, kv, kv, num_heads=4)
+        exe = Executor()
+        xv = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+        qv = np.random.RandomState(2).randn(2, 5, 16).astype(np.float32)
+        kvv = np.random.RandomState(3).randn(2, 7, 16).astype(np.float32)
+        gv, av = exe.run(feed={"x": xv, "q": qv, "kv": kvv},
+                         fetch_list=[g, att])
+        ref = xv[:, :4] * (1 / (1 + np.exp(-xv[:, 4:])))
+        np.testing.assert_allclose(gv, ref, rtol=1e-5)
+        assert av.shape == (2, 5, 16)
+
+
+def test_detection_map_integral_counts_fp():
+    """Review repro: TP(0.9), FP(0.8), TP(0.7) over 2 gt -> AP 0.833."""
+    m = metrics.DetectionMAP()
+    gt = np.array([[0, 0, 0, 10, 10], [0, 20, 20, 30, 30]], np.float32)
+    pred = np.array([[0, 0.9, 0, 0, 10, 10],
+                     [0, 0.8, 50, 50, 60, 60],
+                     [0, 0.7, 20, 20, 30, 30]], np.float32)
+    m.update(pred, gt)
+    assert m.eval() == pytest.approx(0.5 * 1.0 + 0.5 * (2 / 3), abs=1e-6)
+
+
+def test_detection_map_difficult_excluded():
+    m = metrics.DetectionMAP(evaluate_difficult=False)
+    gt = np.array([[0, 0, 0, 10, 10, 0],        # normal
+                   [0, 20, 20, 30, 30, 1]],     # difficult
+                  np.float32)
+    pred = np.array([[0, 0.9, 0, 0, 10, 10],    # tp on normal
+                     [0, 0.8, 20, 20, 30, 30]], # match difficult: ignored
+                    np.float32)
+    m.update(pred, gt)
+    assert m.eval() == pytest.approx(1.0)
+
+
+def test_auc_pr_curve():
+    m = metrics.Auc(curve="PR")
+    scores = np.array([0.9, 0.8, 0.7, 0.3, 0.2])
+    labels = np.array([1, 1, 0, 1, 0])
+    m.update(np.stack([1 - scores, scores], 1), labels)
+    v = m.eval()
+    assert 0.5 < v <= 1.0
+    with pytest.raises(ValueError):
+        metrics.Auc(curve="XYZ")
