@@ -20,6 +20,7 @@ from .framework import (Program, Variable, append_backward,  # noqa
 from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
 from . import evaluator, metrics, nets  # noqa
+from . import contrib  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
 from . import native  # noqa

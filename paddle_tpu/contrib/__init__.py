@@ -1,0 +1,3 @@
+"""Contrib subpackages (ref ``python/paddle/fluid/contrib/``)."""
+
+from . import slim  # noqa

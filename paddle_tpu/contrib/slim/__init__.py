@@ -1,0 +1,5 @@
+"""Model-compression toolkit (ref ``python/paddle/fluid/contrib/slim/``)."""
+
+from . import quantization  # noqa
+from .quantization import (QuantizationFreezePass,  # noqa
+                           QuantizationTransformPass)

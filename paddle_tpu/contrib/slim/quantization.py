@@ -1,0 +1,214 @@
+"""Quantization-aware training passes (ref ``python/paddle/fluid/contrib/
+slim/quantization/quantization_pass.py``: QuantizationTransformPass rewrites
+the IrGraph inserting fake_quant/dequant pairs; QuantizationFreezePass bakes
+trained scales in for inference).
+
+TPU-native shape: the transform operates on the Program *before*
+``append_backward`` and inserts the fused ``fake_quantize_dequantize_*`` ops
+(straight-through-estimator gradient built in), so autodiff simply flows
+through — no separate grad-graph surgery as in the reference's IrGraph
+rewrite.  XLA then folds the round/clip arithmetic into neighbouring
+kernels; the simulated-int8 training cost is a few elementwise ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...framework import core
+from ...framework.core import Program
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+
+#: ops whose inputs get quantized (ref quantization_pass.py
+#: _quantizable_op_type)
+QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+_QDQ_OPS = ("fake_quantize_dequantize_abs_max",
+            "fake_channel_wise_quantize_dequantize_abs_max",
+            "fake_quantize_dequantize_moving_average_abs_max")
+
+
+class QuantizationTransformPass:
+    """Insert weight + activation fake-quant-dequant before quantizable ops
+    (ref QuantizationTransformPass.apply).
+
+    weight_quantize_type: 'abs_max' | 'channel_wise_abs_max'
+    activation_quantize_type: 'moving_average_abs_max' | 'abs_max'
+    """
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9,
+                 skip_pattern: str = "skip_quant"):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(f"bad weight_quantize_type "
+                             f"{weight_quantize_type!r}")
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError(f"bad activation_quantize_type "
+                             f"{activation_quantize_type!r}")
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._moving_rate = moving_rate
+        self._skip_pattern = skip_pattern
+
+    # -- helpers -------------------------------------------------------------
+    def _make_state(self, block, sblock, name, value):
+        block.create_var(name=name, shape=(1,), dtype="float32",
+                         persistable=True)
+        if sblock is not None:
+            sblock.create_var(name=name, shape=(1,), dtype="float32",
+                              persistable=True)
+            sblock.append_op("fill_constant", outputs={"Out": [name]},
+                             attrs={"shape": [1], "dtype": "float32",
+                                    "value": float(value)})
+
+    def _insert_qdq(self, block, sblock, idx, var_name, is_weight,
+                    quant_axis=0):
+        """Insert one QDQ op before ops[idx]; returns (new_idx, out_name)."""
+        v = block.var(var_name)
+        out = block.create_var(name=var_name + ".quantized",
+                               shape=v.shape, dtype=v.dtype)
+        scale_name = var_name + ".quant_scale"
+        if is_weight:
+            if self._w_type == "channel_wise_abs_max":
+                op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+                block.create_var(name=scale_name,
+                                 shape=(v.shape[quant_axis],),
+                                 dtype="float32")
+            else:
+                op_type = "fake_quantize_dequantize_abs_max"
+                block.create_var(name=scale_name, shape=(1,),
+                                 dtype="float32")
+            block.insert_op(
+                idx, op_type,
+                inputs={"X": [var_name]},
+                outputs={"Out": [out.name], "OutScale": [scale_name]},
+                attrs={"bit_length": self._wbits,
+                       "quant_axis": quant_axis})
+            return idx + 1, out.name
+        if self._act_type == "abs_max":
+            block.create_var(name=scale_name, shape=(1,), dtype="float32")
+            block.insert_op(
+                idx, "fake_quantize_dequantize_abs_max",
+                inputs={"X": [var_name]},
+                outputs={"Out": [out.name], "OutScale": [scale_name]},
+                attrs={"bit_length": self._abits})
+            return idx + 1, out.name
+        # moving-average: persistable scale/state/accum trackers
+        self._make_state(block, sblock, scale_name, 0.001)
+        self._make_state(block, sblock, var_name + ".quant_state", 0.0)
+        self._make_state(block, sblock, var_name + ".quant_accum", 0.0)
+        block.insert_op(
+            idx, "fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [var_name], "InScale": [scale_name],
+                    "InState": [var_name + ".quant_state"],
+                    "InAccum": [var_name + ".quant_accum"]},
+            outputs={"Out": [out.name], "OutScale": [scale_name],
+                     "OutState": [var_name + ".quant_state"],
+                     "OutAccum": [var_name + ".quant_accum"]},
+            attrs={"bit_length": self._abits, "is_test": False,
+                   "moving_rate": self._moving_rate})
+        return idx + 1, out.name
+
+    # -- entry ---------------------------------------------------------------
+    def apply(self, program: Optional[Program] = None,
+              startup_program: Optional[Program] = None) -> Program:
+        """Rewrite IN PLACE (the reference mutates the IrGraph likewise);
+        returns the program for chaining.  Call BEFORE minimize()."""
+        program = program or core.default_main_program()
+        startup = startup_program or core.default_startup_program()
+        block = program.global_block()
+        sblock = startup.global_block() if startup is not None else None
+        quantized: Dict[str, str] = {}     # var -> quantized var (per program)
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in QUANTIZABLE_OPS or \
+                    op.attrs.get(self._skip_pattern):
+                i += 1
+                continue
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for name in names:
+                    if not name or not block.has_var(name):
+                        new_names.append(name)
+                        continue
+                    v = block.var(name)
+                    if name in quantized:
+                        new_names.append(quantized[name])
+                        continue
+                    is_weight = v.persistable
+                    if is_weight and op.type in ("conv2d",
+                                                 "depthwise_conv2d") \
+                            and slot != "Filter":
+                        new_names.append(name)   # conv bias etc.
+                        continue
+                    # per-OUTPUT-channel scales: conv filters [O,I,H,W] →
+                    # axis 0; mul/matmul weights [in,out] → axis 1 (ref
+                    # quantization_pass.py quant_axis selection)
+                    axis = 1 if op.type in ("mul", "matmul") else 0
+                    i, qname = self._insert_qdq(block, sblock, i, name,
+                                                is_weight, quant_axis=axis)
+                    quantized[name] = qname
+                    new_names.append(qname)
+                op.inputs[slot] = new_names
+            i += 1
+        program._bump_version()
+        return program
+
+
+class QuantizationFreezePass:
+    """Bake trained quantization in for inference (ref
+    QuantizationFreezePass): weight QDQ ops are folded numerically into the
+    weight values (needs the scope), then stripped; activation QDQ ops flip
+    to ``is_test`` so they quantize with the frozen moving-average scale."""
+
+    def __init__(self, scope, weight_bits: int = 8,
+                 weight_quantize_type: str = "abs_max"):
+        self._scope = scope
+        self._wbits = weight_bits
+        self._w_type = weight_quantize_type
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        keep = []
+        renames: Dict[str, str] = {}
+        for op in block.ops:
+            if op.type in _QDQ_OPS:
+                src = op.input("X")[0]
+                dst = op.output("Out")[0]
+                v = block.var(src)
+                if v.persistable:        # weight: bake and strip
+                    # the op's own bit_length, not the ctor default — the
+                    # bake must match what training simulated
+                    bnt = float(
+                        (1 << (int(op.attrs.get("bit_length", 8)) - 1)) - 1)
+                    w = np.asarray(self._scope.find_var(src), np.float64)
+                    if op.type.startswith("fake_channel"):
+                        axis = int(op.attrs.get("quant_axis", 0))
+                        red = tuple(i for i in range(w.ndim) if i != axis)
+                        s = np.maximum(np.abs(w).max(axis=red), 1e-8)
+                        bshape = [1] * w.ndim
+                        bshape[axis] = -1
+                        s = s.reshape(bshape)
+                    else:
+                        s = max(np.abs(w).max(), 1e-8)
+                    qdq = np.round(np.clip(w / s, -1, 1) * bnt) * s / bnt
+                    self._scope.set_var(src, qdq.astype(np.float32))
+                    renames[dst] = src
+                    continue
+                op.attrs["is_test"] = True   # activation: frozen scale
+            keep.append(op)
+        for op in keep:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [renames.get(n, n) for n in names]
+        block.ops = keep
+        program._bump_version()
+        return program

@@ -566,7 +566,11 @@ class ConvBNFusePass(Pass):
             if conv_out is None or len(conv_out.outputs) != 1:
                 continue
             conv = conv_out.inputs[0]
-            w_shared = next(v for v in conv.inputs if v.persistable)
+            w_shared = next((v for v in conv.inputs if v.persistable), None)
+            if w_shared is None:
+                # filter is not a plain persistable weight (e.g. a QAT
+                # .quantized intermediate) — nothing to fold numerically
+                continue
             if any(c is not conv for c in w_shared.outputs):
                 # folding mutates the filter values in the scope — a shared
                 # filter would silently corrupt its other consumers

@@ -1436,3 +1436,26 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                             "out_shapes": [list(o.shape) for o in outs],
                             "out_dtypes": [o.dtype for o in outs]})
     return out
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """ref operators/fake_quantize_op.cc (QAT building block)."""
+    helper = LayerHelper("fake_quantize_abs_max")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    scale = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fake_quantize_abs_max", inputs={"X": [x]},
+                     outputs={"Out": [out], "OutScale": [scale]},
+                     attrs={"bit_length": bit_length})
+    return out
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    """Fused quant-dequant with STE grad (QAT workhorse)."""
+    helper = LayerHelper("fake_quantize_dequantize_abs_max")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    scale = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fake_quantize_dequantize_abs_max",
+                     inputs={"X": [x]},
+                     outputs={"Out": [out], "OutScale": [scale]},
+                     attrs={"bit_length": bit_length})
+    return out

@@ -1,0 +1,262 @@
+"""Fake-quantization ops for quantization-aware training (ref
+``operators/fake_quantize_op.cc``, ``fake_dequantize_op.cc``; the QAT graph
+rewriter lives in ``paddle_tpu.contrib.slim.quantization``).
+
+Quantization model (matching the reference):
+    bnt       = 2^(bit_length-1) - 1
+    quant(x)  = round(x / scale * bnt)       (stored as float)
+    dequant(q)= q * scale / max_range        (max_range = bnt)
+
+``fake_quantize_*`` outputs the integer-valued float tensor + its scale;
+``fake_dequantize_*`` maps it back.  The fused
+``fake_quantize_dequantize_*`` ops do both and carry a straight-through
+estimator gradient (identity inside [-scale, scale], zero outside) so QAT
+trains through them — the reference added the fused forms for exactly this
+(``fake_quantize_dequantize_moving_average_abs_max``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import grad_var_name
+from ..framework.registry import register_op
+from .common import X
+
+
+def _bnt(attrs):
+    return float((1 << (int(attrs.get("bit_length", 8)) - 1)) - 1)
+
+
+def _abs_max(x):
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return jnp.maximum(s, 1e-8)
+
+
+def _channel_abs_max(x, quant_axis=0):
+    """Per-channel abs max over every dim except ``quant_axis`` (conv
+    filters: axis 0 = out channel; mul/matmul weights: axis 1 = out col)."""
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    return jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes),
+                       1e-8)
+
+
+def _channel_bshape(ndim, quant_axis):
+    shape = [1] * ndim
+    shape[quant_axis] = -1
+    return tuple(shape)
+
+
+def _ma_update(state, accum, cur, rate):
+    """Shared EMA tracker: state counts decayed updates, accum decayed
+    abs-max mass; scale = accum/state (ref fake_quantize_op.cc
+    FindMovingAverageAbsMax)."""
+    new_state = (rate * state.reshape(()) + 1.0) if state is not None else 1.0
+    new_accum = (rate * accum.reshape(()) + cur) if accum is not None else cur
+    return new_state, new_accum, new_accum / new_state
+
+
+def _quant(x, scale, bnt):
+    xf = x.astype(jnp.float32)
+    return jnp.round(jnp.clip(xf / scale, -1.0, 1.0) * bnt)
+
+
+# -- plain quantize ops ------------------------------------------------------
+
+@register_op("fake_quantize_abs_max", no_grad=True)
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = X(ins, "X")
+    bnt = _bnt(attrs)
+    scale = _abs_max(x)
+    return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", no_grad=True)
+def _fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    x = X(ins, "X")
+    bnt = _bnt(attrs)
+    axis = int(attrs.get("quant_axis", 0))
+    scales = _channel_abs_max(x, axis)
+    out = _quant(x, scales.reshape(_channel_bshape(x.ndim, axis)), bnt)
+    return {"Out": [out.astype(x.dtype)], "OutScale": [scales]}
+
+
+@register_op("fake_quantize_range_abs_max", no_grad=True)
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Scale = windowed max of batch abs-max (ref fake_quantize_op.cc
+    FakeQuantizeRangeAbsMaxOp).  With an ``Iter`` counter input the max
+    restarts every ``window_size`` steps (a one-slot approximation of the
+    reference's scale history window — it recovers from transient spikes
+    within one window); without it, the plain running max."""
+    x = X(ins, "X")
+    in_scale = X(ins, "InScale")
+    it = X(ins, "Iter")
+    bnt = _bnt(attrs)
+    if attrs.get("is_test"):
+        scale = in_scale.reshape(())
+        return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+                "OutScale": [in_scale.reshape(1)]}
+    cur = _abs_max(x)
+    if it is not None:
+        window = int(attrs.get("window_size", 10000))
+        restart = (it.reshape(()).astype(jnp.int32) % window) == 0
+        scale = jnp.where(restart, cur,
+                          jnp.maximum(cur, in_scale.reshape(())))
+        return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+                "OutScale": [scale.reshape(1)],
+                "OutIter": [(it + 1).astype(it.dtype)]}
+    scale = jnp.maximum(cur, in_scale.reshape(()))
+    return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+            "OutScale": [scale.reshape(1)]}
+
+
+def _ma_outs(state, accum, new_state, new_accum):
+    outs = {}
+    if state is not None:
+        outs["OutState"] = [jnp.reshape(new_state, (1,))]
+    if accum is not None:
+        outs["OutAccum"] = [jnp.reshape(new_accum, (1,))]
+    return outs
+
+
+@register_op("fake_quantize_moving_average_abs_max", no_grad=True)
+def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    x = X(ins, "X")
+    in_scale = X(ins, "InScale")
+    state = X(ins, "InState")
+    accum = X(ins, "InAccum")
+    bnt = _bnt(attrs)
+    if attrs.get("is_test"):
+        scale = in_scale.reshape(())
+        return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+                "OutScale": [in_scale.reshape(1)]}
+    new_state, new_accum, scale = _ma_update(
+        state, accum, _abs_max(x), attrs.get("moving_rate", 0.9))
+    return {"Out": [_quant(x, scale, bnt).astype(x.dtype)],
+            "OutScale": [scale.reshape(1)],
+            **_ma_outs(state, accum, new_state, new_accum)}
+
+
+@register_op("moving_average_abs_max_scale", no_grad=True)
+def _moving_average_abs_max_scale(ctx, ins, attrs):
+    """Track the scale only; Out passes X through (ref
+    moving_average_abs_max_scale op used for output-scale collection)."""
+    x = X(ins, "X")
+    state = X(ins, "InState")
+    accum = X(ins, "InAccum")
+    if attrs.get("is_test"):
+        # frozen: report the trained scale without touching the trackers
+        if accum is not None and state is not None:
+            scale = accum.reshape(()) / jnp.maximum(state.reshape(()), 1e-8)
+        else:
+            scale = _abs_max(x)
+        return {"Out": [x], "OutScale": [scale.reshape(1)]}
+    new_state, new_accum, scale = _ma_update(
+        state, accum, _abs_max(x), attrs.get("moving_rate", 0.9))
+    return {"Out": [x], "OutScale": [scale.reshape(1)],
+            **_ma_outs(state, accum, new_state, new_accum)}
+
+
+# -- dequantize --------------------------------------------------------------
+
+@register_op("fake_dequantize_max_abs", no_grad=True)
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x, scale = X(ins, "X"), X(ins, "Scale")
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [(x.astype(jnp.float32) * scale.reshape(()) /
+                     max_range).astype(x.dtype)]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", no_grad=True)
+def _fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    scales = ins.get("Scales", [])
+    x = xs[0]
+    bits = attrs.get("quant_bits", [8])
+    bnt0 = float((1 << (int(bits[0]) - 1)) - 1)
+    s0 = scales[0]
+    bshape = (-1,) + (1,) * (x.ndim - 1)
+    out = x.astype(jnp.float32) * s0.reshape(bshape) / bnt0
+    if len(scales) > 1 and scales[1] is not None and len(bits) > 1:
+        bnt1 = float((1 << (int(bits[1]) - 1)) - 1)
+        out = out * scales[1].reshape(()) / bnt1
+    return {"Out": [out.astype(x.dtype)]}
+
+
+# -- fused quant-dequant with STE gradient (the QAT workhorses) --------------
+
+def _qdq(x, scale, bnt):
+    return _quant(x, scale, bnt) * scale / bnt
+
+
+def _qdq_grad_maker(op, block, no_grad_set):
+    g_inputs = {"X": op.input("X"),
+                "OutScale": op.output("OutScale"),
+                "OutGrad": [grad_var_name(n) for n in op.output("Out")]}
+    g_outputs = {"XGrad": [grad_var_name(n) for n in op.input("X")]}
+    return [{"type": "fake_quantize_dequantize_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": dict(op.attrs)}]
+
+
+@register_op("fake_quantize_dequantize_grad")
+def _fake_quantize_dequantize_grad(ctx, ins, attrs):
+    """Straight-through estimator: identity inside [-scale, scale], zero
+    outside (values beyond the clip range got a flat output)."""
+    x, gout = X(ins, "X"), X(ins, "OutGrad")
+    raw = X(ins, "OutScale")
+    if raw.size > 1:
+        axis = int(attrs.get("quant_axis", 0))
+        scale = raw.reshape(_channel_bshape(x.ndim, axis))
+    else:
+        scale = raw.reshape(())
+    inside = (jnp.abs(x.astype(jnp.float32)) <= scale).astype(gout.dtype)
+    return {"XGrad": [gout * inside]}
+
+
+def _register_qdq(name, scale_fn, channel=False):
+    def lower(ctx, ins, attrs):
+        x = X(ins, "X")
+        bnt = _bnt(attrs)
+        outs = scale_fn(ctx, ins, attrs, x)
+        scale = outs.pop("__scale__")
+        if channel:
+            axis = int(attrs.get("quant_axis", 0))
+            out = _qdq(x.astype(jnp.float32),
+                       scale.reshape(_channel_bshape(x.ndim, axis)), bnt)
+        else:
+            out = _qdq(x.astype(jnp.float32), scale, bnt)
+        outs["Out"] = [out.astype(x.dtype)]
+        return outs
+    register_op(name, lower, grad_maker=_qdq_grad_maker)
+
+
+def _scale_abs_max(ctx, ins, attrs, x):
+    s = _abs_max(x)
+    return {"__scale__": s, "OutScale": [s.reshape(1)]}
+
+
+def _scale_channel(ctx, ins, attrs, x):
+    s = _channel_abs_max(x, int(attrs.get("quant_axis", 0)))
+    return {"__scale__": s, "OutScale": [s]}
+
+
+def _scale_moving_average(ctx, ins, attrs, x):
+    in_scale = X(ins, "InScale")
+    state = X(ins, "InState")
+    accum = X(ins, "InAccum")
+    if attrs.get("is_test"):
+        s = in_scale.reshape(())
+        return {"__scale__": s, "OutScale": [in_scale.reshape(1)]}
+    new_state, new_accum, s = _ma_update(
+        state, accum, _abs_max(x), attrs.get("moving_rate", 0.9))
+    return {"__scale__": s, "OutScale": [s.reshape(1)],
+            **_ma_outs(state, accum, new_state, new_accum)}
+
+
+_register_qdq("fake_quantize_dequantize_abs_max", _scale_abs_max)
+_register_qdq("fake_channel_wise_quantize_dequantize_abs_max",
+              _scale_channel, channel=True)
+_register_qdq("fake_quantize_dequantize_moving_average_abs_max",
+              _scale_moving_average)

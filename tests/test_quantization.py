@@ -1,0 +1,159 @@
+"""Fake-quant ops + QAT passes (ref operators/fake_quantize_op.cc,
+contrib/slim/quantization/quantization_pass.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim import (QuantizationFreezePass,
+                                     QuantizationTransformPass)
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _fresh():
+    return program_guard(Program(), Program())
+
+
+def test_fake_quant_dequant_roundtrip_numeric():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        q = layers.fake_quantize_dequantize_abs_max(x)
+        exe = Executor()
+        xv = np.linspace(-2, 2, 16, dtype=np.float32).reshape(2, 8)
+        out, = exe.run(feed={"x": xv}, fetch_list=[q])
+        scale = np.abs(xv).max()
+        ref = np.round(np.clip(xv / scale, -1, 1) * 127) * scale / 127
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+        # quantization error bounded by scale/127 half-step
+        assert np.abs(out - xv).max() <= scale / 127
+
+
+def test_fake_quant_ste_gradient():
+    """d(qdq)/dx must be identity inside the clip range (STE)."""
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        q = layers.fake_quantize_dequantize_abs_max(x)
+        loss = layers.reduce_sum(q)
+        g, = fluid.framework.calc_gradient(loss, [x])
+        exe = Executor()
+        xv = np.array([[0.5, -1.0, 0.25, 2.0]], np.float32)
+        gv, = exe.run(feed={"x": xv}, fetch_list=[g])
+        np.testing.assert_allclose(gv, np.ones_like(xv), atol=1e-6)
+
+
+def test_transform_pass_inserts_qdq():
+    with _fresh(), scope_guard(Scope()):
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=2, filter_size=3)
+        f = layers.fc(layers.flatten(c), size=4)
+        prog = fluid.default_main_program()
+        QuantizationTransformPass(
+            weight_quantize_type="channel_wise_abs_max").apply()
+        types = [op.type for op in prog.global_block().ops]
+        assert types.count(
+            "fake_quantize_dequantize_moving_average_abs_max") == 2  # acts
+        assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+        # conv + mul weights and activations rewired
+        conv_op = next(op for op in prog.global_block().ops
+                       if op.type == "conv2d")
+        assert conv_op.input("Filter")[0].endswith(".quantized")
+        assert conv_op.input("Input")[0].endswith(".quantized")
+
+
+def test_qat_end_to_end_and_freeze():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        QuantizationTransformPass().apply()
+        fluid.optimizer.Adam(0.01).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        first = last = None
+        for i in range(30):
+            xv = rng.rand(32, 8).astype(np.float32)
+            yv = xv[:, :4].argmax(1).reshape(-1, 1).astype(np.int64)
+            last, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+            if first is None:
+                first = last
+        assert float(last) < float(first) - 0.2, \
+            f"QAT did not train: {float(first)} -> {float(last)}"
+        # freeze for inference: weight QDQ baked, program still runs and
+        # matches the QAT-simulated forward
+        test_prog = fluid.default_main_program().clone(
+            for_test=True)._prune([pred])
+        xv = rng.rand(8, 8).astype(np.float32)
+        ref, = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred.name])
+        frozen = QuantizationFreezePass(fluid.global_scope()).apply(
+            test_prog.clone())
+        types = [op.type for op in frozen.global_block().ops]
+        assert "fake_quantize_dequantize_abs_max" not in types  # weights baked
+        out, = exe.run(frozen, feed={"x": xv}, fetch_list=[pred.name])
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_quant_op_variants():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        q1 = layers.fake_quantize_abs_max(x)
+        exe = Executor()
+        xv = np.array([[1.0, -2.0, 0.5, 4.0]], np.float32)
+        out, = exe.run(feed={"x": xv}, fetch_list=[q1])
+        np.testing.assert_allclose(
+            out, np.round(xv / 4.0 * 127), atol=1e-5)
+
+
+def test_channel_wise_mul_axis_and_bits_roundtrip():
+    """mul weights quantize per OUTPUT column (axis 1); freeze honors the
+    op's bit_length (4-bit here), matching the QAT forward exactly."""
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        pred = layers.fc(x, size=4, act="softmax")
+        QuantizationTransformPass(
+            weight_bits=4,
+            weight_quantize_type="channel_wise_abs_max").apply()
+        prog = fluid.default_main_program()
+        qop = next(op for op in prog.global_block().ops
+                   if op.type ==
+                   "fake_channel_wise_quantize_dequantize_abs_max")
+        assert qop.attrs["quant_axis"] == 1
+        assert qop.attrs["bit_length"] == 4
+        scale_var = prog.global_block().var(qop.output("OutScale")[0])
+        assert scale_var.shape == (4,)   # out columns, not in rows
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        ref, = exe.run(feed={"x": xv}, fetch_list=[pred.name])
+        test_prog = prog.clone(for_test=True)._prune([pred])
+        frozen = QuantizationFreezePass(fluid.global_scope()).apply(
+            test_prog)
+        out, = exe.run(frozen, feed={"x": xv}, fetch_list=[pred.name])
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_range_abs_max_window_restart():
+    from paddle_tpu.framework import registry
+    import jax.numpy as jnp
+
+    class Ctx:
+        pass
+
+    info = registry.get_op_info("fake_quantize_range_abs_max")
+    spike = jnp.full((4,), 100.0)
+    normal = jnp.full((4,), 1.0)
+    scale = jnp.array([0.001])
+    it = jnp.array([0.0])
+    o = info.lower(Ctx(), {"X": [spike], "InScale": [scale], "Iter": [it]},
+                   {"window_size": 2})
+    assert float(o["OutScale"][0][0]) == 100.0
+    # next window restarts: scale recovers to the normal level
+    o2 = info.lower(Ctx(), {"X": [normal], "InScale": o["OutScale"],
+                            "Iter": [jnp.array([2.0])]}, {"window_size": 2})
+    assert float(o2["OutScale"][0][0]) == 1.0
