@@ -83,7 +83,7 @@ class uci_housing:
     def _make(n, seed):
         rng = np.random.RandomState(seed)
         x = rng.randn(n, 13).astype("float32")
-        w = rng.RandomState(0).randn(13).astype("float32")
+        w = np.random.RandomState(0).randn(13).astype("float32")
         y = (x @ w + 0.1 * rng.randn(n)).astype("float32")[:, None]
         return x, y
 
@@ -190,7 +190,7 @@ class ctr_synthetic:
     def train(n=4096, sparse_dim=1000, seed=13):
         def reader():
             rng = np.random.RandomState(seed)
-            w_dense = rng.RandomState(0).randn(13) * 0.3
+            w_dense = np.random.RandomState(0).randn(13) * 0.3
             for _ in range(n):
                 dense = rng.randn(13).astype("float32")
                 sparse = rng.randint(0, sparse_dim, size=26).astype("int64")
@@ -198,3 +198,273 @@ class ctr_synthetic:
                 click = int(rng.rand() < 1 / (1 + np.exp(-logit)))
                 yield dense, sparse, click
         return reader
+
+
+class cifar:
+    """ref dataset/cifar.py — 32×32×3 images flattened to 3072 floats in
+    [0,1]; cifar10 and cifar100 label spaces."""
+
+    @staticmethod
+    def _reader(n, seed, num_classes):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                label = int(rng.randint(0, num_classes))
+                img = rng.rand(3, 32, 32).astype("float32") * 0.4
+                # class-dependent color bias so models can learn
+                img[label % 3] += 0.3 + 0.3 * ((label // 3) % 2)
+                yield np.clip(img, 0, 1).ravel(), label
+        return reader
+
+    @staticmethod
+    def train10():
+        return cifar._reader(2048, 21, 10)
+
+    @staticmethod
+    def test10():
+        return cifar._reader(512, 22, 10)
+
+    @staticmethod
+    def train100():
+        return cifar._reader(2048, 23, 100)
+
+    @staticmethod
+    def test100():
+        return cifar._reader(512, 24, 100)
+
+
+class imikolov:
+    """ref dataset/imikolov.py — PTB-style n-gram LM tuples.
+
+    Synthetic text follows a deterministic first-order chain (next word =
+    f(prev)) + noise, so an n-gram model is learnable."""
+
+    DICT_SIZE = 2073
+
+    @staticmethod
+    def build_dict(min_word_freq=50):
+        return {f"w{i}": i for i in range(imikolov.DICT_SIZE)}
+
+    @staticmethod
+    def _reader(n, seed, word_idx, ngram):
+        V = len(word_idx) if word_idx else imikolov.DICT_SIZE
+
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                w = [int(rng.randint(0, V))]
+                for _ in range(ngram - 1):
+                    nxt = (w[-1] * 7 + 3) % V if rng.rand() < 0.8 \
+                        else int(rng.randint(0, V))
+                    w.append(nxt)
+                yield tuple(w)
+        return reader
+
+    @staticmethod
+    def train(word_idx=None, n=5):
+        return imikolov._reader(4096, 31, word_idx, n)
+
+    @staticmethod
+    def test(word_idx=None, n=5):
+        return imikolov._reader(512, 32, word_idx, n)
+
+
+class movielens:
+    """ref dataset/movielens.py — (user features, movie features, rating)."""
+
+    MAX_USER_ID = 6040
+    MAX_MOVIE_ID = 3952
+    MAX_JOB_ID = 20
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+    CATEGORIES = 18
+    TITLE_DICT_LEN = 5175
+
+    @staticmethod
+    def max_user_id():
+        return movielens.MAX_USER_ID
+
+    @staticmethod
+    def max_movie_id():
+        return movielens.MAX_MOVIE_ID
+
+    @staticmethod
+    def max_job_id():
+        return movielens.MAX_JOB_ID
+
+    @staticmethod
+    def age_table():
+        return list(movielens.AGES)
+
+    @staticmethod
+    def movie_categories():
+        return {f"cat{i}": i for i in range(movielens.CATEGORIES)}
+
+    @staticmethod
+    def get_movie_title_dict():
+        return {f"title{i}": i for i in range(movielens.TITLE_DICT_LEN)}
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                user = int(rng.randint(1, movielens.MAX_USER_ID + 1))
+                gender = int(rng.randint(0, 2))
+                age = int(rng.randint(0, len(movielens.AGES)))
+                job = int(rng.randint(0, movielens.MAX_JOB_ID + 1))
+                movie = int(rng.randint(1, movielens.MAX_MOVIE_ID + 1))
+                cats = rng.randint(0, movielens.CATEGORIES,
+                                   size=rng.randint(1, 4)).tolist()
+                title = rng.randint(0, movielens.TITLE_DICT_LEN,
+                                    size=rng.randint(1, 6)).tolist()
+                # learnable rating: affinity between user and movie hashes
+                score = 1 + (user * 31 + movie * 17) % 5
+                yield user, gender, age, job, movie, cats, title, \
+                    float(score)
+        return reader
+
+    @staticmethod
+    def train():
+        return movielens._reader(4096, 41)
+
+    @staticmethod
+    def test():
+        return movielens._reader(512, 42)
+
+
+class conll05:
+    """ref dataset/conll05.py — SRL tuples: (words, predicate, ctx windows,
+    marks, labels) as index lists."""
+
+    WORD_DICT_LEN = 44068
+    LABEL_DICT_LEN = 59
+    PRED_DICT_LEN = 3162
+
+    @staticmethod
+    def get_dict():
+        word_dict = {f"w{i}": i for i in range(conll05.WORD_DICT_LEN)}
+        verb_dict = {f"v{i}": i for i in range(conll05.PRED_DICT_LEN)}
+        label_dict = {f"l{i}": i for i in range(conll05.LABEL_DICT_LEN)}
+        return word_dict, verb_dict, label_dict
+
+    @staticmethod
+    def get_embedding():
+        rng = np.random.RandomState(55)
+        return rng.randn(conll05.WORD_DICT_LEN, 32).astype("float32")
+
+    @staticmethod
+    def test():
+        """Reference slot order (conll05.py reader): (words, ctx_n2,
+        ctx_n1, ctx_0, ctx_p1, ctx_p2, verb, mark, labels) — the five
+        context windows and the verb are per-token sequences (the sentence
+        -level value repeated for every token)."""
+        def reader():
+            rng = np.random.RandomState(51)
+            for _ in range(256):
+                length = int(rng.randint(5, 30))
+                words = rng.randint(0, conll05.WORD_DICT_LEN,
+                                    size=length).astype("int64")
+                pred_pos = int(rng.randint(0, length))
+                predicate = int(words[pred_pos] % conll05.PRED_DICT_LEN)
+                mark = np.zeros(length, "int64")
+                mark[pred_pos] = 1
+                # labels depend on distance to predicate: learnable
+                labels = np.minimum(np.abs(np.arange(length) - pred_pos),
+                                    conll05.LABEL_DICT_LEN - 1
+                                    ).astype("int64")
+                ctx = [[int(words[max(0, min(length - 1, pred_pos + d))])]
+                       * length for d in (-2, -1, 0, 1, 2)]
+                yield (words.tolist(), ctx[0], ctx[1], ctx[2], ctx[3],
+                       ctx[4], [predicate] * length, mark.tolist(),
+                       labels.tolist())
+        return reader
+
+
+class sentiment:
+    """ref dataset/sentiment.py — NLTK movie-review polarity; shares the
+    imdb vocabulary since its readers delegate to imdb._reader."""
+
+    @staticmethod
+    def get_word_dict():
+        return {f"w{i}": i for i in range(imdb.WORD_DICT_SIZE)}
+
+    @staticmethod
+    def train():
+        return imdb._reader(1024, 61)
+
+    @staticmethod
+    def test():
+        return imdb._reader(256, 62)
+
+
+class wmt16:
+    """ref dataset/wmt16.py — like wmt14 with explicit dict sizes + BPE."""
+
+    @staticmethod
+    def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+        return wmt14._reader(1024, 71, min(src_dict_size, trg_dict_size))
+
+    @staticmethod
+    def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+        return wmt14._reader(128, 72, min(src_dict_size, trg_dict_size))
+
+    @staticmethod
+    def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+        return wmt14._reader(128, 73, min(src_dict_size, trg_dict_size))
+
+    @staticmethod
+    def get_dict(lang, dict_size, reverse=False):
+        d = {f"{lang}{i}": i for i in range(dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class flowers:
+    """ref dataset/flowers.py — 102-class 3×224×224 images."""
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                label = int(rng.randint(0, 102))
+                img = rng.rand(3, 224, 224).astype("float32")
+                yield img, label
+        return reader
+
+    @staticmethod
+    def train(mapper=None, buffered_size=1024, use_xmap=True):
+        return flowers._reader(512, 81)
+
+    @staticmethod
+    def test(mapper=None, buffered_size=1024, use_xmap=True):
+        return flowers._reader(128, 82)
+
+    @staticmethod
+    def valid(mapper=None, buffered_size=1024, use_xmap=True):
+        return flowers._reader(128, 83)
+
+
+class voc2012:
+    """ref dataset/voc2012.py — segmentation pairs (image, label mask)."""
+
+    @staticmethod
+    def _reader(n, seed, hw=64):
+        def reader():
+            rng = np.random.RandomState(seed)
+            for _ in range(n):
+                img = rng.rand(3, hw, hw).astype("float32")
+                mask = (img.sum(0) > 1.5).astype("int32")  # learnable seg
+                yield img, mask
+        return reader
+
+    @staticmethod
+    def train():
+        return voc2012._reader(256, 91)
+
+    @staticmethod
+    def test():
+        return voc2012._reader(64, 92)
+
+    @staticmethod
+    def val():
+        return voc2012._reader(64, 93)

@@ -51,6 +51,12 @@ def append_backward(loss: Variable,
     for v in block.vars.values():
         if v.stop_gradient and not v.is_parameter:
             no_grad.add(v.name)
+        elif v.dtype is not None and v.dtype not in (
+                "float16", "bfloat16", "float32", "float64"):
+            # integer/bool vars carry no gradient (the reference's
+            # OpKernelType dispatch never registers grad kernels for them;
+            # under jax they'd surface as float0 tangents)
+            no_grad.add(v.name)
 
     relevant, needed = _relevant_ops(block, loss, no_grad)
 

@@ -1,1 +1,1 @@
-from . import mnist, resnet, transformer  # noqa
+from . import ctr, mnist, resnet, transformer, word2vec  # noqa

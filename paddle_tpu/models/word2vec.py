@@ -1,0 +1,28 @@
+"""N-gram word2vec model (ref book test
+``python/paddle/fluid/tests/book/test_word2vec.py``: 4 context embeddings →
+concat → hidden fc → softmax over the vocabulary)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def build_word2vec_train(dict_size, embed_size=32, hidden_size=256,
+                         is_sparse=False):
+    """Returns (loss, feeds): feeds are the 4 context words + target."""
+    words = [layers.data(f"word_{i}", shape=[1], dtype="int64")
+             for i in range(4)]
+    target = layers.data("target", shape=[1], dtype="int64")
+
+    embeds = [layers.embedding(
+        w, size=[dict_size, embed_size], is_sparse=is_sparse,
+        param_attr=ParamAttr(name="shared_w"))
+        for w in words]
+    concat = layers.concat(
+        [layers.reshape(e, shape=[-1, embed_size]) for e in embeds], axis=1)
+    hidden = layers.fc(concat, size=hidden_size, act="sigmoid")
+    predict = layers.fc(hidden, size=dict_size, act="softmax")
+    cost = layers.cross_entropy(predict, target)
+    avg_cost = layers.mean(cost)
+    return avg_cost, words + [target]

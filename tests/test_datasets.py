@@ -1,0 +1,69 @@
+"""Dataset loaders + reader decorators (ref python/paddle/dataset/,
+python/paddle/reader/decorator.py)."""
+
+import numpy as np
+
+from paddle_tpu.data import dataset, reader
+
+
+def test_cifar_schema():
+    for rd, ncls in ((dataset.cifar.train10(), 10),
+                     (dataset.cifar.train100(), 100)):
+        img, label = next(rd())
+        assert img.shape == (3072,) and 0 <= label < ncls
+        assert img.min() >= 0 and img.max() <= 1
+
+
+def test_imikolov_ngrams():
+    word_idx = dataset.imikolov.build_dict()
+    rows = list(dataset.imikolov.train(word_idx, n=5)())
+    assert all(len(r) == 5 for r in rows[:50])
+    V = len(word_idx)
+    assert all(0 <= w < V for r in rows[:50] for w in r)
+    # the chain structure is learnable: majority of transitions follow f
+    hits = sum(1 for r in rows for a, b in zip(r, r[1:])
+               if b == (a * 7 + 3) % V)
+    total = sum(len(r) - 1 for r in rows)
+    assert hits / total > 0.6
+
+
+def test_movielens_conll_sentiment_schema():
+    u, g, a, j, m, cats, title, score = next(dataset.movielens.train()())
+    assert 1 <= u <= dataset.movielens.max_user_id()
+    assert 1 <= m <= dataset.movielens.max_movie_id()
+    assert 1.0 <= score <= 5.0
+    row = next(dataset.conll05.test()())
+    words, c_n2, c_n1, c_0, c_p1, c_p2, verb, mark, labels = row
+    assert len(words) == len(mark) == len(labels) == len(verb) == len(c_n2)
+    assert sum(mark) == 1
+    wd, vd, ld = dataset.conll05.get_dict()
+    assert len(ld) == dataset.conll05.LABEL_DICT_LEN
+    assert dataset.conll05.get_embedding().shape[0] == len(wd)
+    words2, label = next(dataset.sentiment.train()())
+    assert label in (0, 1)
+
+
+def test_wmt16_flowers_voc_schema():
+    src, tin, tout = next(dataset.wmt16.train(1000, 1000)())
+    assert tin[0] == 1 and tout[-1] == 2 and len(tin) == len(tout)
+    img, lab = next(dataset.flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= lab < 102
+    img, mask = next(dataset.voc2012.train()())
+    assert img.shape[1:] == mask.shape
+
+
+def test_reader_decorators_compose():
+    base = dataset.uci_housing.train()
+    batched = reader.batch(reader.shuffle(base, buf_size=64), 16)
+    b = next(batched())
+    assert len(b) == 16
+    first_n = list(reader.firstn(base, 5)())
+    assert len(first_n) == 5
+    chained = list(reader.chain(reader.firstn(base, 3),
+                                reader.firstn(base, 2))())
+    assert len(chained) == 5
+    mapped = list(reader.map_readers(lambda x: x[0][0],
+                                     reader.firstn(base, 3))())
+    assert len(mapped) == 3
+    cached = reader.cache(reader.firstn(base, 4))
+    assert len(list(cached())) == 4 and len(list(cached())) == 4
