@@ -1,3 +1,3 @@
 """Contrib subpackages (ref ``python/paddle/fluid/contrib/``)."""
 
-from . import slim  # noqa
+from . import model_stat, op_frequence, slim  # noqa

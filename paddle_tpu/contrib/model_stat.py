@@ -1,0 +1,79 @@
+"""Model summary: per-layer params + FLOPs table (ref
+``python/paddle/fluid/contrib/model_stat.py`` summary())."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import core
+
+__all__ = ["summary"]
+
+def _numel(shape):
+    return int(np.prod([d for d in (shape or []) if d and d > 0])) if shape \
+        else 0
+
+
+def _op_stats(op, block, batch_size):
+    """(params, flops) for one op; conv/fc/matmul carry the MXU work.
+    A dynamic (-1) batch dim counts as ``batch_size`` samples."""
+    def shape(name):
+        return block.var(name).shape if block.has_var(name) else None
+
+    def batched_numel(s):
+        if not s:
+            return 0
+        n = _numel(s)
+        return n * batch_size if s[0] in (-1, None) else n
+
+    if op.type in ("conv2d", "depthwise_conv2d"):
+        w = shape(op.input("Filter")[0])
+        out = shape(op.output("Output")[0])
+        if w and out:
+            params = _numel(w)
+            flops = 2 * params // max(w[0], 1) * batched_numel(out)
+            return params, flops
+    elif op.type == "mul":
+        w = shape(op.input("Y")[0])
+        x = shape(op.input("X")[0])
+        if w and x:
+            params = _numel(w)
+            batch = batch_size if x[0] in (-1, None) else abs(x[0])
+            return params, 2 * params * batch
+    elif op.type == "matmul":
+        x, y = shape(op.input("X")[0]), shape(op.input("Y")[0])
+        if x and y:
+            m = batched_numel(x[:-1])
+            k = abs(x[-1])
+            return 0, 2 * m * k * abs(y[-1])
+    elif op.type in ("elementwise_add", "relu", "batch_norm", "softmax"):
+        outs = op.output_arg_names()
+        if outs:
+            o = shape(outs[0])
+            return (0, batched_numel(o)) if o else (0, 0)
+    return 0, 0
+
+
+def summary(program: core.Program, batch_size: int = 1) -> str:
+    """Printable table + returns the text; also usable as
+    ``summary(main_program)`` right after building (ref model_stat usage).
+    ``batch_size`` scales FLOPs of dynamic (-1) batch dims."""
+    block = program.global_block()
+    rows = []
+    total_p = total_f = 0
+    for op in block.ops:
+        if op.type.endswith("_grad"):
+            continue
+        p, f = _op_stats(op, block, batch_size)
+        total_p += p
+        total_f += f
+        if p or f:
+            rows.append((op.type, p, f))
+    width = max([len(r[0]) for r in rows], default=8) + 2
+    lines = [f"{'op':<{width}}{'params':>14}{'FLOPs':>16}", "-" * (width + 30)]
+    for t, p, f in rows:
+        lines.append(f"{t:<{width}}{p:>14,}{f:>16,}")
+    lines.append("-" * (width + 30))
+    lines.append(f"{'total':<{width}}{total_p:>14,}{total_f:>16,}")
+    text = "\n".join(lines)
+    return text

@@ -1,0 +1,26 @@
+"""Op-frequency statistics over programs (ref
+``python/paddle/fluid/contrib/op_frequence.py`` op_freq_statistic)."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ..framework import core
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program: core.Program):
+    """Returns (uni_op_freq, adj_op_freq): single-op counts and adjacent
+    op-pair counts over the whole program (the reference uses these to
+    prioritize fusion-pass work)."""
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    return uni, adj

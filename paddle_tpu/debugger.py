@@ -1,0 +1,44 @@
+"""Program debugging helpers (ref ``python/paddle/fluid/debugger.py``:
+``pprint_program_codes`` text dump + ``draw_block_graphviz``)."""
+
+from __future__ import annotations
+
+from .framework import ir
+from .framework.core import Program
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def pprint_block_codes(block, show_backward: bool = False) -> str:
+    """Readable listing of one block's vars + ops (ref
+    debugger.py pprint_block_codes)."""
+    lines = [f"# block {block.idx} (parent {block.parent_idx})"]
+    for name, v in sorted(block.vars.items()):
+        if not show_backward and name.endswith("@GRAD"):
+            continue
+        tag = "param" if v.is_parameter else \
+            ("persist" if v.persistable else "var")
+        lines.append(f"  {tag} {name}: {v.dtype}{list(v.shape or [])}")
+    for op in block.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
+        outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
+        lines.append(f"  {outs} = {op.type}({ins})")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program,
+                         show_backward: bool = False) -> str:
+    return "\n".join(pprint_block_codes(b, show_backward)
+                     for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights=None, path: str = "block.dot"):
+    """DOT dump of one block via graph_viz_pass; ``highlights`` names vars
+    to tint red (ref debugger.py draw_block_graphviz)."""
+    g = ir.Graph(block.program, block.idx)
+    ir.get_pass("graph_viz_pass", graph_viz_path=path,
+                highlights=frozenset(highlights or ())).apply(g)
+    return path

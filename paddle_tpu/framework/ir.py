@@ -686,9 +686,11 @@ class GraphVizPass(Pass):
             lines.append(
                 f'  n{op.id} [label="{op.name}" shape=box '
                 f'style=filled fillcolor="#ffd39b"];')
+        highlights = frozenset(self.get("highlights") or ())
         for v in graph.all_var_nodes():
             shape = "ellipse"
-            fill = "#c0d9ee" if not v.persistable else "#b5e7b5"
+            fill = "#f4adad" if v.name in highlights else \
+                "#c0d9ee" if not v.persistable else "#b5e7b5"
             lines.append(
                 f'  n{v.id} [label="{v.name}" shape={shape} '
                 f'style=filled fillcolor="{fill}"];')

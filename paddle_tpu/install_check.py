@@ -1,0 +1,35 @@
+"""Installation self-check (ref ``python/paddle/fluid/install_check.py``
+run_check): trains a tiny linear model end-to-end on the active backend and
+reports success."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("inp", shape=[2], dtype="float32")
+        y = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(
+            y, layers.assign(np.zeros((1, 1), np.float32))))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        out = None
+        for _ in range(3):
+            out, = exe.run(feed={"inp": np.ones((4, 2), np.float32)},
+                           fetch_list=[loss])
+        import jax
+        print(f"Your paddle_tpu works well on {jax.default_backend()} "
+              f"({len(jax.devices())} device(s)).")
+        print("Your paddle_tpu is installed successfully!")
+        return float(np.asarray(out))

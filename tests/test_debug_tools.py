@@ -1,0 +1,68 @@
+"""Debug/observability tools (ref debugger.py, contrib/model_stat.py,
+contrib/op_frequence.py, install_check.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework.core import Program, program_guard
+
+
+def test_debugger_pprint_and_dot(tmp_path):
+    with program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=3, act="relu")
+        prog = fluid.default_main_program()
+        txt = fluid.debugger.pprint_program_codes(prog)
+        assert "mul" in txt and "param" in txt
+        path = str(tmp_path / "b.dot")
+        fluid.debugger.draw_block_graphviz(prog.global_block(), path=path)
+        assert "digraph" in open(path).read()
+
+
+def test_model_stat_and_op_freq():
+    from paddle_tpu.contrib.model_stat import summary
+    from paddle_tpu.contrib.op_frequence import op_freq_statistic
+    with program_guard(Program(), Program()):
+        img = layers.data("img", shape=[3, 16, 16], dtype="float32")
+        c = layers.conv2d(img, num_filters=4, filter_size=3)
+        out = layers.fc(layers.flatten(c), size=10)
+        prog = fluid.default_main_program()
+        text = summary(prog)
+        assert "conv2d" in text and "total" in text
+        # conv params = 4*3*3*3 (+bias handled separately) appear in table
+        assert "108" in text.replace(",", "")
+        uni, adj = op_freq_statistic(prog)
+        assert uni["conv2d"] == 1 and uni["mul"] == 1
+        assert any(k.startswith("mul->") for k in adj)
+
+
+def test_install_check_runs():
+    loss = fluid.install_check.run_check()
+    assert np.isfinite(loss)
+
+
+def test_model_stat_matmul_k_and_batch():
+    from paddle_tpu.contrib.model_stat import summary
+    with program_guard(Program(), Program()):
+        a = layers.data("a", shape=[8, 64], dtype="float32")
+        b = layers.data("b", shape=[64, 32], dtype="float32")
+        layers.matmul(a, b)
+        prog = fluid.default_main_program()
+        t1 = summary(prog, batch_size=1)
+        # 2*M*K*N with batch 1 = 2*8*64*32 = 32768
+        assert "32768" in t1.replace(",", "")
+        t4 = summary(prog, batch_size=4)
+        assert "131072" in t4.replace(",", "")
+
+
+def test_graphviz_highlights(tmp_path):
+    with program_guard(Program(), Program()):
+        x = layers.data("hx", shape=[4], dtype="float32")
+        layers.fc(x, size=3)
+        path = str(tmp_path / "h.dot")
+        fluid.debugger.draw_block_graphviz(
+            fluid.default_main_program().global_block(),
+            highlights=["hx"], path=path)
+        dot = open(path).read()
+        assert '#f4adad' in dot
