@@ -350,8 +350,7 @@ class Executor:
         block = program.global_block()
         collective = program._attrs.get("collective")
         key = (program.fingerprint(), feed_names,
-               tuple((np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
-                     for n in feed_names),
+               tuple(_feed_sig(feed[n]) for n in feed_names),
                fetch_names, id(scope), id(mesh),
                tuple(sorted(collective.items())) if collective else None)
         with self._lock:
@@ -366,7 +365,7 @@ class Executor:
                     program, 0, feed_names, fetch_names,
                     tuple(ro), tuple(rw), mesh=mesh,
                     in_shardings=shardings, collective=collective,
-                    feed_ndims=tuple(np.asarray(feed[n]).ndim
+                    feed_ndims=tuple(len(_feed_sig(feed[n])[0])
                                      for n in feed_names))
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
                 self._cache[key] = cb
@@ -437,6 +436,15 @@ class Executor:
 
     def infer_from_dataset(self, *a, **k):
         return self.train_from_dataset(*a, **k)
+
+
+def _feed_sig(x):
+    """(shape, dtype) of a feed WITHOUT np.asarray — materializing a device
+    array per run would force a device→host sync in the hot path."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return (tuple(x.shape), str(x.dtype))
+    a = np.asarray(x)
+    return (a.shape, str(a.dtype))
 
 
 def _to_device(x):

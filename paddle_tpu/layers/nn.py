@@ -1459,3 +1459,26 @@ def fake_quantize_dequantize_abs_max(x, bit_length=8):
                      outputs={"Out": [out], "OutScale": [scale]},
                      attrs={"bit_length": bit_length})
     return out
+
+
+def fused_lm_head_ce(x, size, label, param_attr=None, bias_attr=None,
+                     ignore_index=-100, chunk_size=1024):
+    """Chunked LM-head + cross-entropy: O(chunk × vocab) memory instead of
+    materializing [tokens, vocab] logits (TPU-native; no fluid analog).
+    Owns its projection parameters like ``fc`` (same weight orientation
+    [d_in, size])."""
+    helper = LayerHelper("fused_lm_head_ce", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    d_in = int(x.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[d_in, size],
+                                dtype=x.dtype)
+    inputs = {"X": [x], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[size], dtype=x.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "fused_lm_head_ce", inputs=inputs, outputs={"Loss": [loss]},
+        attrs={"ignore_index": ignore_index, "chunk_size": chunk_size})
+    return loss

@@ -162,12 +162,17 @@ class BertConfig:
 
 
 def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
-                        dropout=None, attn_impl="base"):
+                        dropout=None, attn_impl="base", fused_head=False):
     """Masked-LM pretraining net: ids+mask-labels → mean masked CE loss.
 
     Labels use 0 ([PAD], never a real MLM target) for unmasked positions;
     positions with label 0 are excluded from loss and denominator — the
-    masked-LM objective of the LARK recipe."""
+    masked-LM objective of the LARK recipe.
+
+    ``fused_head=True`` computes the head projection + CE with the chunked
+    ``fused_lm_head_ce`` op: the [tokens, vocab] logits (GBs in f32 at
+    vocab 30k) are never materialized, cutting the dominant HBM cost of the
+    step; ``logits`` is returned as None in that mode."""
     dropout = cfg.dropout if dropout is None else dropout
     src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
     pos_ids = layers.data("pos_ids", shape=[seq_len], dtype="int64")
@@ -175,12 +180,19 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
     enc = encoder(src_ids, pos_ids, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
                   cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
                   is_test=is_test, attn_impl=attn_impl)
-    logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
-                       param_attr=ParamAttr(name="mlm_out.w"),
-                       bias_attr=ParamAttr(name="mlm_out.b"))
-    # masked positions only: label 0 ([PAD]) is ignored
-    loss = layers.softmax_with_cross_entropy(
-        logits, layers.unsqueeze(lm_label, [2]), ignore_index=0)
+    if fused_head:
+        loss = layers.fused_lm_head_ce(
+            enc, cfg.vocab_size, lm_label,
+            param_attr=ParamAttr(name="mlm_out.w"),
+            bias_attr=ParamAttr(name="mlm_out.b"), ignore_index=0)
+        logits = None
+    else:
+        logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
+                           param_attr=ParamAttr(name="mlm_out.w"),
+                           bias_attr=ParamAttr(name="mlm_out.b"))
+        # masked positions only: label 0 ([PAD]) is ignored
+        loss = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lm_label, [2]), ignore_index=0)
     mask = layers.cast(lm_label > 0, "float32")
     masked = layers.reduce_sum(loss * layers.unsqueeze(mask, [2]))
     denom = layers.reduce_sum(mask) + 1e-6

@@ -830,3 +830,50 @@ def _fused_elemwise_activation(ctx, ins, attrs):
     else:
         out = _ACTIVATIONS[unary](out)
     return {"Out": [out]}
+
+
+@register_op("fused_lm_head_ce")
+def _fused_lm_head_ce(ctx, ins, attrs):
+    """LM head projection + softmax cross-entropy, scanned over token
+    chunks so the [tokens, vocab] logits are NEVER materialized in HBM
+    (with vocab 30k+, full f32 logits are gigabytes — the dominant memory
+    AND bandwidth cost of an MLM/LM step; the reference computes them
+    dense, operators/softmax_with_cross_entropy_op.cc).  jax.checkpoint on
+    the chunk body makes the backward recompute each chunk's logits, so
+    training memory stays O(chunk * vocab).  No reference counterpart —
+    TPU-native capability."""
+    x, w = X(ins, "X"), X(ins, "W")
+    b = X(ins, "Bias")
+    label = X(ins, "Label")
+    ignore = attrs.get("ignore_index", -100)
+    chunk = int(attrs.get("chunk_size", 1024))
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    n = int(np.prod(lead))
+    x2 = x.reshape(n, d)
+    l1 = label.reshape(n)
+    pad = (-n) % chunk
+    if pad:
+        x2 = jnp.concatenate([x2, jnp.zeros((pad, d), x2.dtype)])
+        l1 = jnp.concatenate(
+            [l1, jnp.full((pad,), ignore, l1.dtype)])
+    n_chunks = (n + pad) // chunk
+    xc = x2.reshape(n_chunks, chunk, d)
+    lc = l1.reshape(n_chunks, chunk)
+
+    def body(carry, inp):
+        xi, li = inp
+        logits = (xi.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)
+                  ).astype(jnp.float32)
+        if b is not None:
+            logits = logits + b.astype(jnp.float32)
+        m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+        lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[:, 0]
+        safe = jnp.where(li == ignore, 0, li)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        loss = jnp.where(li == ignore, 0.0, lse - picked)
+        return carry, loss
+
+    _, losses = jax.lax.scan(jax.checkpoint(body), 0.0, (xc, lc))
+    out = losses.reshape(-1)[:n].reshape(lead + (1,))
+    return {"Loss": [out]}

@@ -59,3 +59,40 @@ def test_ring_attention_no_mesh_falls_back():
     base = _train_bert("base")
     ring = _train_bert("ring", mesh=None)
     np.testing.assert_allclose(base, ring, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_lm_head_ce_matches_unfused():
+    """Chunked LM-head CE (never materializes [tokens, vocab] logits) must
+    match the fc + softmax_with_cross_entropy path."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.framework import Executor
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.models import transformer as T
+
+    def run(fused):
+        with program_guard(Program(), Program()), scope_guard(Scope()):
+            cfg = T.BertConfig(vocab_size=517, d_model=64, n_layer=2,
+                               n_head=4, d_inner=128, max_pos=32)
+            feeds, logits, loss = T.build_bert_pretrain(
+                cfg, 16, dropout=0.0, fused_head=fused)
+            opt.SGDOptimizer(0.1).minimize(loss)
+            exe = Executor()
+            exe.run(pt.default_startup_program(), seed=99)
+            rng = np.random.RandomState(0)
+            feed = {"src_ids": rng.randint(1, 517, (4, 16)).astype(np.int64),
+                    "pos_ids": np.tile(np.arange(16),
+                                       (4, 1)).astype(np.int64),
+                    "lm_label": rng.randint(0, 517,
+                                            (4, 16)).astype(np.int64)}
+            out = []
+            for _ in range(5):
+                lv, = exe.run(feed=feed, fetch_list=[loss.name])
+                out.append(float(np.asarray(lv)))
+            return out
+
+    a, b = run(False), run(True)
+    # fused path computes the projection in bf16 (MXU dtype): small drift
+    np.testing.assert_allclose(a, b, atol=5e-3)
