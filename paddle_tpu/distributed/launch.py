@@ -74,9 +74,9 @@ def start_procs(args, envs):
         full_env = dict(os.environ, **env)
         out = None
         if args.log_dir:
-            out = open(os.path.join(args.log_dir,
-                                    f"worker.{env['PADDLE_TRAINER_ID']}.log"),
-                       "w")
+            log_name = env.get("PADDLE_LOG_NAME",
+                               f"worker.{env['PADDLE_TRAINER_ID']}")
+            out = open(os.path.join(args.log_dir, f"{log_name}.log"), "w")
             logs.append(out)
         procs.append(subprocess.Popen(cmd, env=full_env, stdout=out,
                                       stderr=subprocess.STDOUT if out

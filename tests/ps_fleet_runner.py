@@ -1,0 +1,54 @@
+"""Subprocess entry for the launch_ps e2e test: picks its role from the
+PS env contract (what paddle_tpu.distributed.launch_ps emits)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu import optimizer as opt  # noqa: E402
+from paddle_tpu.framework import Executor  # noqa: E402
+from paddle_tpu.distributed import PaddleCloudRoleMaker, ps_fleet as fleet  # noqa: E402
+from paddle_tpu.distributed import ps as ps_mod  # noqa: E402
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker())
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False,
+                     param_attr=pt.ParamAttr(name="w"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer = fleet.distributed_optimizer(opt.SGD(learning_rate=0.1))
+    optimizer.minimize(loss)
+    exe = Executor()
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()
+        return
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    last = None
+    for _ in range(20):
+        xv = rng.rand(16, 4).astype(np.float32)
+        lv, = exe.run(fleet.main_program, feed={"x": xv, "y": xv @ w_true},
+                      fetch_list=[loss])
+        last = float(lv)
+    print(f"RESULT {fleet.worker_index()} {last:.6f}", flush=True)
+    fleet.stop_worker()
+    if fleet.worker_index() == 0:
+        for ep in os.environ["PADDLE_PSERVER_ENDPOINTS"].split(","):
+            ps_mod.get_client(ep).stop_server()
+
+
+if __name__ == "__main__":
+    main()

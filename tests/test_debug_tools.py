@@ -66,3 +66,25 @@ def test_graphviz_highlights(tmp_path):
             highlights=["hx"], path=path)
         dot = open(path).read()
         assert '#f4adad' in dot
+
+
+def test_timeline_merge(tmp_path):
+    import json
+    import subprocess
+    import sys
+    for r in (0, 1):
+        (tmp_path / f"r{r}.json").write_text(json.dumps({
+            "traceEvents": [{"name": f"op{r}", "ph": "X", "ts": r * 10,
+                             "dur": 5, "pid": 0, "tid": 0}]}))
+    out = tmp_path / "merged.json"
+    proc = subprocess.run(
+        [sys.executable, "tools/timeline.py", "--profile_path",
+         f"0={tmp_path}/r0.json,1={tmp_path}/r1.json",
+         "--timeline_path", str(out)],
+        capture_output=True, text=True,
+        cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    merged = json.loads(out.read_text())
+    assert len(merged["traceEvents"]) == 2
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {"rank0:0", "rank1:0"}

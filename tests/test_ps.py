@@ -315,3 +315,51 @@ def test_ps_fleet_end_to_end():
     assert last < 1.0
     ps_mod.get_client(ep).stop_server()
     srv.join(timeout=5)
+
+
+def test_launch_ps_end_to_end(tmp_path):
+    """paddle_tpu.distributed.launch_ps spawns servers + workers with the
+    PS env contract and the gang trains to completion (ref launch_ps.py)."""
+    import subprocess
+    import sys
+
+    # launch_ps binds started_port..+1 (servers) and +1000..+1001
+    # (worker endpoints): probe the whole range, not just one port
+    import random
+    for _ in range(20):
+        base = random.randint(20000, 40000)
+        try:
+            socks = []
+            for off in (0, 1, 1000, 1001):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            for s in socks:
+                s.close()
+            port = base
+            break
+        except OSError:
+            for s in socks:
+                s.close()
+    else:
+        pytest.skip("no free port range found")
+    script = os.path.join(os.path.dirname(__file__), "ps_fleet_runner.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch_ps",
+         "--server_num", "2", "--worker_num", "2",
+         "--started_port", str(port),
+         "--log_dir", str(tmp_path), script],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    results = {}
+    for i in range(2):
+        log = (tmp_path / f"worker.{i}.log").read_text()
+        for line in log.splitlines():
+            if line.startswith("RESULT"):
+                _, rank, lv = line.split()
+                results[int(rank)] = float(lv)
+    assert set(results) == {0, 1}, f"missing worker results: {results}"
+    # sync PS: both workers see the same final loss
+    assert abs(results[0] - results[1]) < 1e-4
+    assert results[0] < 1.0

@@ -1,0 +1,48 @@
+"""Merge per-rank profiler dumps into one chrome://tracing file (ref
+``tools/timeline.py``: profile-proto → chrome trace; here the profiler
+already emits chrome JSON, so this tool merges multiple ranks' files and
+prefixes their pid/tid so they stack in one timeline).
+
+Usage:
+    python tools/timeline.py --profile_path 0=r0.json,1=r1.json \
+        --timeline_path out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def merge(profile_paths, out_path):
+    events = []
+    for spec in profile_paths.split(","):
+        if "=" in spec:
+            rank, path = spec.split("=", 1)
+        else:
+            rank, path = "0", spec
+        with open(path) as f:
+            data = json.load(f)
+        # both valid chrome-trace forms: {"traceEvents": [...]} or bare list
+        evs = data if isinstance(data, list) else data.get("traceEvents", [])
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
+            events.append(ev)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile_path", required=True,
+                   help="comma-separated [rank=]file.json entries")
+    p.add_argument("--timeline_path", default="timeline.json")
+    args = p.parse_args(argv)
+    n = merge(args.profile_path, args.timeline_path)
+    print(f"wrote {n} events to {args.timeline_path}")
+
+
+if __name__ == "__main__":
+    main()
