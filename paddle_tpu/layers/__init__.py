@@ -35,6 +35,7 @@ from .structured import (beam_search, beam_search_decode,  # noqa
                          hsigmoid, linear_chain_crf, nce,
                          sampled_softmax_with_cross_entropy, sampling_id,
                          warpctc)
+from .sequence import sequence_conv  # noqa
 from .sequence import (sequence_concat, sequence_enumerate,  # noqa
                        sequence_expand, sequence_expand_as,
                        sequence_first_step, sequence_last_step,

@@ -148,3 +148,25 @@ def sequence_reshape(input, new_dim):
     helper.append_op("sequence_reshape", inputs={"X": [input]},
                      outputs={"Out": [out]}, attrs={"new_dim": new_dim})
     return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    """ref layers/nn.py sequence_conv → sequence_conv op (dense [b,t,d])."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * d, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"contextLength": filter_size,
+                            "contextStride": filter_stride,
+                            "contextStart": -(filter_size // 2)})
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)

@@ -1,0 +1,1224 @@
+"""Long-tail op-surface parity (SURVEY Appendix A stragglers).
+
+Each lowering cites its reference kernel.  Ops whose reference semantics
+depend on dynamic shapes (LoD splits, id sharding) are realized in the
+dense-masked form the rest of this framework uses for ragged data (SURVEY
+§5.7): same information, static shapes, documented per op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import registry
+from ..framework.registry import register_op
+from .common import X, XS
+
+
+def alias_op(new: str, old: str) -> None:
+    """Register ``new`` as an exact alias of an existing lowering."""
+    info = registry.get_op_info(old)
+    register_op(new, info.lower, infer=info.infer,
+                grad_maker=info.grad_maker, no_grad=info.no_grad,
+                stateful_rng=info.stateful_rng, raw=info.raw)
+
+
+# -- straight aliases (same kernel, alternate registered name) ---------------
+# ref: write_to_array/read_from_array (operators/tensor_array_read_write_op
+# .cc), lod_array_length, conditional_block_infer (controlflow/
+# conditional_block_infer_op.cc), multiclass_nms2 (adds RoisNum — identical
+# math), split_byref (split without copy; XLA is SSA anyway)
+alias_op("write_to_array", "array_write")
+alias_op("read_from_array", "array_read")
+alias_op("lod_array_length", "array_length")
+alias_op("conditional_block_infer", "conditional_block")
+alias_op("multiclass_nms2", "multiclass_nms")
+alias_op("split_byref", "split")
+alias_op("fill_zeros_like2", "fill_zeros_like")
+
+
+@register_op("fill", no_grad=True)
+def _fill(ctx, ins, attrs):
+    """ref operators/fill_op.cc: constant tensor from a value list attr."""
+    shape = attrs["shape"]
+    value = np.asarray(attrs["value"], np.float64).reshape(shape)
+    return {"Out": [jnp.asarray(value, jnp.dtype(
+        attrs.get("dtype", "float32")))]}
+
+
+def _batch_size_like_shape(ins, attrs):
+    ref = X(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    return shape
+
+
+@register_op("uniform_random_batch_size_like", no_grad=True,
+             stateful_rng=True)
+def _uniform_random_batch_size_like(ctx, ins, attrs):
+    """ref operators/uniform_random_batch_size_like_op.cc."""
+    shape = _batch_size_like_shape(ins, attrs)
+    u = jax.random.uniform(ctx.rng(), tuple(shape),
+                           minval=attrs.get("min", -1.0),
+                           maxval=attrs.get("max", 1.0))
+    return {"Out": [u.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("gaussian_random_batch_size_like", no_grad=True,
+             stateful_rng=True)
+def _gaussian_random_batch_size_like(ctx, ins, attrs):
+    shape = _batch_size_like_shape(ins, attrs)
+    g = jax.random.normal(ctx.rng(), tuple(shape)) * \
+        attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": [g.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+# -- losses / simple math ----------------------------------------------------
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """ref operators/modified_huber_loss_op.cc: y∈{0,1} mapped to ±1;
+    quadratic inside margin, linear outside."""
+    x, y = X(ins, "X"), X(ins, "Y")
+    target = 2.0 * y.astype(jnp.float32) - 1.0
+    z = x * target
+    inter = jnp.square(jnp.maximum(1.0 - z, 0.0))
+    loss = jnp.where(z < -1.0, -4.0 * z, inter)
+    return {"IntermediateVal": [z], "Out": [loss]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    """ref operators/squared_l2_distance_op.cc: row-wise ||x-y||²."""
+    x, y = X(ins, "X"), X(ins, "Y")
+    sub = x - y
+    return {"sub_result": [sub],
+            "Out": [jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                            keepdims=sub.ndim > 1)]}
+
+
+@register_op("positive_negative_pair", no_grad=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """ref operators/positive_negative_pair_op.cc: within each query id,
+    count score pairs ordered agreeing/disagreeing with the labels."""
+    score = X(ins, "Score").reshape(-1)
+    label = X(ins, "Label").reshape(-1)
+    qid = X(ins, "QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    valid = same_q & (upper > 0)
+    ds = score[:, None] - score[None, :]
+    dl = label[:, None] - label[None, :]
+    informative = valid & (dl != 0)
+    pos = jnp.sum(informative & (ds * dl > 0)).astype(jnp.float32)
+    neg = jnp.sum(informative & (ds * dl < 0)).astype(jnp.float32)
+    neu = jnp.sum(informative & (ds == 0)).astype(jnp.float32)
+    acc_pos = X(ins, "AccumulatePositivePair")
+    acc_neg = X(ins, "AccumulateNegativePair")
+    acc_neu = X(ins, "AccumulateNeutralPair")
+    if acc_pos is not None:
+        pos = pos + acc_pos.reshape(())
+        neg = neg + acc_neg.reshape(())
+        neu = neu + acc_neu.reshape(())
+    return {"PositivePair": [pos.reshape(1)],
+            "NegativePair": [neg.reshape(1)],
+            "NeutralPair": [neu.reshape(1)]}
+
+
+@register_op("cvm")
+def _cvm(ctx, ins, attrs):
+    """ref operators/cvm_op.cc: first two cols are (show, click) counters;
+    use_cvm keeps them log-transformed, else strips them."""
+    x = X(ins, "X")
+    show = jnp.log(x[:, 0:1] + 1.0)
+    ctr = jnp.log(x[:, 1:2] + 1.0) - show
+    if attrs.get("use_cvm", True):
+        return {"Y": [jnp.concatenate([show, ctr, x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """ref operators/conv_shift_op.cc: per-row circular correlation,
+    y width M (odd) centred on each position."""
+    x, y = X(ins, "X"), X(ins, "Y")
+    m = y.shape[1]
+    half = m // 2
+    out = jnp.zeros_like(x)
+    for j in range(m):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return {"Out": [out]}
+
+
+# -- int8 scale ops (ref operators/mkldnn quantize/dequantize/requantize) ----
+
+@register_op("quantize", no_grad=True)
+def _quantize(ctx, ins, attrs):
+    x = X(ins, "Input")
+    s = attrs.get("Scale", 1.0)
+    out = jnp.clip(jnp.round(x.astype(jnp.float32) * s), -128, 127)
+    return {"Output": [out.astype(jnp.int8)]}
+
+
+@register_op("dequantize", no_grad=True)
+def _dequantize(ctx, ins, attrs):
+    x = X(ins, "Input")
+    s = attrs.get("Scale", 1.0)
+    return {"Output": [x.astype(jnp.float32) / s]}
+
+
+@register_op("requantize", no_grad=True)
+def _requantize(ctx, ins, attrs):
+    x = X(ins, "Input")
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    out = jnp.clip(jnp.round(x.astype(jnp.float32) / s_in * s_out),
+                   -128, 127)
+    return {"Output": [out.astype(jnp.int8)]}
+
+
+# -- pooling with argmax index, unpool, spp ----------------------------------
+
+def _windows(x, kh, kw, sh, sw, ph, pw):
+    """Stack the kh·kw shifted strided views: [n, c, oh, ow, kh*kw]."""
+    n, c, h, w = x.shape
+    xpad = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                   constant_values=-jnp.inf)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    views = []
+    for i in range(kh):
+        for j in range(kw):
+            views.append(jax.lax.slice(
+                xpad, (0, 0, i, j),
+                (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    return jnp.stack(views, axis=-1), oh, ow
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """ref operators/pool_with_index_op.cc: max pool + flat h*w argmax."""
+    x = X(ins, "X")
+    kh, kw = attrs["ksize"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])
+    n, c, h, w = x.shape
+    win, oh, ow = _windows(x, kh, kw, sh, sw, ph, pw)
+    out = jnp.max(win, axis=-1)
+    arg = jnp.argmax(win, axis=-1)                    # in-window index
+    ki, kj = arg // kw, arg % kw
+    rows = (jnp.arange(oh) * sh)[None, None, :, None] + ki - ph
+    cols = (jnp.arange(ow) * sw)[None, None, None, :] + kj - pw
+    mask = jnp.clip(rows, 0, h - 1) * w + jnp.clip(cols, 0, w - 1)
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """3-D variant via one depth loop over the 2-D kernel."""
+    x = X(ins, "X")
+    kd, kh, kw = attrs["ksize"]
+    sd, sh, sw = attrs.get("strides", [1, 1, 1])
+    pd, ph, pw = attrs.get("paddings", [0, 0, 0])
+    n, c, d, h, w = x.shape
+    outs, masks = [], []
+    od = (d + 2 * pd - kd) // sd + 1
+    xpad = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (0, 0), (0, 0)),
+                   constant_values=-jnp.inf)
+    for oz in range(od):
+        slabs, slab_masks = [], []
+        for dz in range(kd):
+            z = oz * sd + dz
+            win, oh, ow = _windows(xpad[:, :, z], kh, kw, sh, sw, ph, pw)
+            m = jnp.max(win, axis=-1)
+            a = jnp.argmax(win, axis=-1)
+            ki, kj = a // kw, a % kw
+            rows = (jnp.arange(oh) * sh)[None, None, :, None] + ki - ph
+            cols = (jnp.arange(ow) * sw)[None, None, None, :] + kj - pw
+            flat = ((z - pd) * h * w + jnp.clip(rows, 0, h - 1) * w +
+                    jnp.clip(cols, 0, w - 1))
+            slabs.append(m)
+            slab_masks.append(flat)
+        stack = jnp.stack(slabs, axis=-1)
+        best = jnp.argmax(stack, axis=-1)
+        outs.append(jnp.max(stack, axis=-1))
+        masks.append(jnp.take_along_axis(
+            jnp.stack(slab_masks, axis=-1), best[..., None], -1)[..., 0])
+    return {"Out": [jnp.stack(outs, axis=2)],
+            "Mask": [jnp.stack(masks, axis=2).astype(jnp.int32)]}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs):
+    """ref operators/unpool_op.cc: scatter pooled values to their argmax
+    positions in the unpooled [h, w] plane."""
+    x, idx = X(ins, "X"), X(ins, "Indices")
+    oh, ow = attrs["unpooled_height"], attrs["unpooled_width"]
+    n, c = x.shape[:2]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """ref operators/spp_op.cc: pyramid of adaptive pools, flattened."""
+    x = X(ins, "X")
+    n, c, h, w = x.shape
+    levels = attrs.get("pyramid_height", 3)
+    ptype = attrs.get("pooling_type", "max")
+    red = jnp.max if ptype == "max" else jnp.mean
+    feats = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        # pad to a multiple then reshape-reduce (adaptive pooling)
+        hh = -(-h // bins) * bins
+        ww = -(-w // bins) * bins
+        pad_val = -jnp.inf if ptype == "max" else 0.0
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, hh - h), (0, ww - w)),
+                     constant_values=pad_val)
+        r = red(xp.reshape(n, c, bins, hh // bins, bins, ww // bins),
+                axis=(3, 5))
+        if ptype == "avg":
+            # renormalize for the zero padding
+            ones = jnp.pad(jnp.ones((1, 1, h, w)),
+                           ((0, 0), (0, 0), (0, hh - h), (0, ww - w)))
+            cnt = jnp.mean(ones.reshape(1, 1, bins, hh // bins, bins,
+                                        ww // bins), axis=(3, 5))
+            r = r / jnp.maximum(cnt, 1e-8)
+        feats.append(r.reshape(n, -1))
+    return {"Out": [jnp.concatenate(feats, axis=1)]}
+
+
+# -- dense LoD-machinery equivalents (SURVEY §5.7: lengths replace LoD) ------
+
+@register_op("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    """ref operators/lod_reset_op.cc — LoD is metadata-only here (dense
+    batches + length companions), so the values pass through."""
+    return {"Out": [X(ins, "X")]}
+
+
+@register_op("lod_rank_table", no_grad=True)
+def _lod_rank_table(ctx, ins, attrs):
+    """ref lod_rank_table_op.cc: (index, length) sorted by length desc.
+    Dense form: input is the LENGTHS vector (the LoD companion)."""
+    lengths = X(ins, "X").reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(-lengths, stable=True)
+    return {"Out": [jnp.stack([order.astype(jnp.int32), lengths[order]],
+                              axis=1)]}
+
+
+@register_op("max_sequence_len", no_grad=True)
+def _max_sequence_len(ctx, ins, attrs):
+    """ref max_sequence_len_op.cc: longest length in a rank table."""
+    table = X(ins, "RankTable")
+    return {"Out": [jnp.max(table[:, 1]).astype(jnp.int64).reshape(())]}
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """ref reorder_lod_tensor_by_rank_op.cc: permute batch rows into rank
+    -table order (dense: gather on dim 0)."""
+    x = X(ins, "X")
+    table = X(ins, "RankTable")
+    order = table[:, 0].astype(jnp.int32)
+    return {"Out": [x[order]]}
+
+
+@register_op("shrink_rnn_memory")
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """ref shrink_rnn_memory_op.cc: keep the first k rows (sequences still
+    alive at this step).  Dense scans mask instead of shrinking, so k rows
+    are kept in place and the rest zeroed (static shape)."""
+    x = X(ins, "X")
+    i = X(ins, "I").reshape(()).astype(jnp.int32)
+    table = X(ins, "RankTable")
+    alive = jnp.sum((table[:, 1] > i)).astype(jnp.int32)
+    mask = (jnp.arange(x.shape[0]) < alive).astype(x.dtype)
+    return {"Out": [x * mask.reshape((-1,) + (1,) * (x.ndim - 1))]}
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    return {"Out": [X(ins, "X")]}
+
+
+@register_op("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs):
+    """ref split_lod_tensor_op.cc (IfElse input router).  Dense-masked:
+    both outputs keep the full batch with non-selected rows zeroed; the
+    mask travels with them (static shapes — the reference physically
+    splits, which is a dynamic shape)."""
+    x, mask = X(ins, "X"), X(ins, "Mask")
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return {"OutTrue": [x * m], "OutFalse": [x * (1 - m)]}
+
+
+@register_op("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs):
+    """ref merge_lod_tensor_op.cc: row-wise select by mask."""
+    mask = X(ins, "Mask")
+    t, f = X(ins, "InTrue"), X(ins, "InFalse")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+    return {"Out": [jnp.where(m, t, f)]}
+
+
+alias_op("merge_lod_tensor_infer", "merge_lod_tensor")
+alias_op("lod_tensor_to_array", "lod_reset")    # dense: values unchanged
+alias_op("array_to_lod_tensor", "lod_reset")
+
+
+# -- sequence stragglers -----------------------------------------------------
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """ref operators/sequence_conv_op.cc: sliding window of
+    ``context_length`` rows, linearly projected.  Dense [b, t, d] input."""
+    x, w = X(ins, "X"), X(ins, "Filter")
+    # both attr spellings exist in the reference (op proto snake_case,
+    # Python layer camelCase)
+    clen = attrs.get("contextLength", attrs.get("context_length", 3))
+    cstart = attrs.get("contextStart",
+                       attrs.get("context_start", -(clen // 2)))
+    b, t, d = x.shape
+    cols = []
+    for o in range(clen):
+        shift = cstart + o
+        cols.append(jnp.roll(x, -shift, axis=1) *
+                    ((jnp.arange(t) + shift >= 0) &
+                     (jnp.arange(t) + shift < t)).astype(x.dtype)[None, :,
+                                                                  None])
+    ctx_mat = jnp.concatenate(cols, axis=-1)          # [b, t, clen*d]
+    return {"Out": [ctx_mat @ w]}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    """ref sequence_scatter_op.cc: per-sequence scatter-add of updates at
+    ids (dense: ids/updates [b, k], X [b, d])."""
+    x, ids, upd = X(ins, "X"), X(ins, "Ids"), X(ins, "Updates")
+    b = x.shape[0]
+    return {"Out": [x.at[jnp.arange(b)[:, None], ids].add(upd)]}
+
+
+@register_op("sequence_topk_avg_pooling")
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """ref sequence_topk_avg_pooling_op.cc: per row+channel, average of the
+    top-k values (dense [b, c, t] input), one output column per k."""
+    x = X(ins, "X")
+    topks = attrs.get("topks", [1])
+    sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+    outs = []
+    for k in topks:
+        outs.append(jnp.mean(sorted_x[..., :k], axis=-1))
+    return {"Out": [jnp.stack(outs, axis=-1).reshape(x.shape[0], -1)],
+            "pos": [jnp.argsort(-x, axis=-1)[..., :max(topks)]
+                    .astype(jnp.int32)]}
+
+
+@register_op("match_matrix_tensor")
+def _match_matrix_tensor(ctx, ins, attrs):
+    """ref match_matrix_tensor_op.cc: bilinear match x·W·yᵀ per channel.
+    Dense x [b, tx, d], y [b, ty, d], W [d, c, d] → [b, c, tx, ty]."""
+    x, y, w = X(ins, "X"), X(ins, "Y"), X(ins, "W")
+    out = jnp.einsum("bxd,dce,bye->bcxy", x, w, y)
+    return {"Out": [out], "Tmp": [jnp.einsum("bxd,dce->bcxe", x, w)]}
+
+
+@register_op("var_conv_2d")
+def _var_conv_2d(ctx, ins, attrs):
+    """ref var_conv_2d_op.cc: conv over per-sequence 2-D feature maps;
+    dense equivalent is a grouped conv2d on [b, c, h, w]."""
+    from .nn_ops import _conv2d
+    return {"Out": _conv2d(ctx, {"Input": ins.get("X"),
+                                 "Filter": ins.get("W")}, attrs)["Output"]}
+
+
+@register_op("filter_by_instag")
+def _filter_by_instag(ctx, ins, attrs):
+    """ref filter_by_instag_op.cc: keep rows whose tag set intersects the
+    filter tags.  Dense-masked: rows stay, non-matching ones are zeroed and
+    LossWeight marks survivors (the reference compacts rows — dynamic
+    shape)."""
+    x = X(ins, "Ins")
+    tags = X(ins, "Ins_tag")           # [b] one tag per row (dense form)
+    filt = X(ins, "Filter_tag")        # [k]
+    keep = jnp.isin(tags.reshape(-1), filt.reshape(-1))
+    w = keep.astype(jnp.float32)
+    return {"Out": [x * w.reshape((-1,) + (1,) * (x.ndim - 1))],
+            "LossWeight": [w.reshape(-1, 1)],
+            "IndexMap": [jnp.stack([jnp.arange(x.shape[0]),
+                                    jnp.arange(x.shape[0])],
+                                   axis=1).astype(jnp.int64)]}
+
+
+# -- PS id sharding (dense-masked; the native PS plane routes rows itself) ---
+
+@register_op("split_ids", no_grad=True, raw=True)
+def _split_ids(ctx, block, op, state):
+    """ref split_ids_op.cc: shard ids round-robin by id % n (n = number of
+    Out vars, as in the reference).  Dense form: every shard output keeps
+    the input shape with foreign ids as -1."""
+    ids = state.read(block, op.input("Ids")[0])
+    out_names = op.output("Out")
+    n = max(len(out_names), 1)
+    for i, name in enumerate(out_names):
+        state.write(name, jnp.where(ids % n == i, ids, -1))
+
+
+@register_op("merge_ids", no_grad=True)
+def _merge_ids(ctx, ins, attrs):
+    """ref merge_ids_op.cc: row lookups return to original positions.
+    Dense form: shard rows carry zeros for foreign ids, so merge = sum."""
+    rows = XS(ins, "X")
+    out = rows[0]
+    for r in rows[1:]:
+        out = out + r
+    return {"Out": [out]}
+
+
+@register_op("split_selected_rows", no_grad=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """ref split_selected_rows_op.cc: slice rows into height sections."""
+    x = X(ins, "X")
+    sections = attrs.get("height_sections", [x.shape[0]])
+    outs, start = [], 0
+    for s in sections:
+        outs.append(jax.lax.slice_in_dim(x, start, start + s, axis=0))
+        start += s
+    return {"Out": outs}
+
+
+@register_op("coalesce_tensor", no_grad=True)
+def _coalesce_tensor(ctx, ins, attrs):
+    """ref coalesce_tensor_op.cc: pack tensors into one contiguous buffer
+    (fused-allreduce staging).  XLA owns real buffer placement; the fused
+    view is the concat of flattened inputs, and the per-tensor outputs
+    pass through."""
+    xs = XS(ins, "Input")
+    fused = jnp.concatenate([a.reshape(-1) for a in xs])
+    return {"FusedOutput": [fused], "Output": list(xs)}
+
+
+# -- dygraph collectives (ref operators/distributed_ops/allreduce_op.cc) -----
+
+@register_op("allreduce")
+def _allreduce(ctx, ins, attrs):
+    from ..distributed.collective_ops import _axis
+    from jax import lax
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    return {"Out": [lax.psum(x, ax) if ax is not None else x]}
+
+
+@register_op("broadcast")
+def _broadcast(ctx, ins, attrs):
+    from ..distributed.collective_ops import _axis
+    from jax import lax
+    x = X(ins, "X")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": [x]}
+    root = int(attrs.get("root", 0) or 0)
+    return {"Out": [lax.all_gather(x, ax)[root]]}
+
+
+@register_op("sync_batch_norm")
+def _sync_batch_norm(ctx, ins, attrs):
+    """ref operators/sync_batch_norm_op.cu: BN statistics reduced across
+    the data-parallel group (psum over the mesh axis) so every replica
+    normalizes with GLOBAL batch moments."""
+    from ..distributed.collective_ops import _axis
+    from jax import lax
+    from .nn_ops import _bn_axes
+    x = X(ins, "X")
+    scale, bias = X(ins, "Scale"), X(ins, "Bias")
+    mean, var = X(ins, "Mean"), X(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or attrs.get("use_global_stats",
+                                                       False)
+    axes, bshape = _bn_axes(layout, x.ndim)
+    xf = x.astype(jnp.float32)
+    if is_test:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        ax = _axis(ctx, attrs)
+        cnt = float(np.prod([x.shape[a] for a in axes]))
+        s1 = jnp.sum(xf, axis=axes)
+        s2 = jnp.sum(jnp.square(xf), axis=axes)
+        if ax is not None:
+            s1 = lax.psum(s1, ax)
+            s2 = lax.psum(s2, ax)
+            cnt = cnt * lax.psum(1, ax)
+        m = s1 / cnt
+        v = s2 / cnt - jnp.square(m)
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+    y = (xf - m.reshape(bshape)) * inv * scale.reshape(bshape) + \
+        bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)], "MeanOut": [mean_out],
+            "VarianceOut": [var_out], "SavedMean": [m],
+            "SavedVariance": [jax.lax.rsqrt(v + eps)]}
+
+
+@register_op("dgc", no_grad=True)
+def _dgc(ctx, ins, attrs):
+    """ref operators/dgc_op.cc: the compression half of DGC (the sync half
+    is dgc_allreduce).  u/v updates + top-k selection; EncodeGrad carries
+    (idx, val) pairs as a dense [2k] vector."""
+    u, v, g = X(ins, "U"), X(ins, "V"), X(ins, "Grad")
+    m = attrs.get("m", 0.9)
+    ratio = 1.0 - attrs.get("sparsity", [0.999])[-1] \
+        if isinstance(attrs.get("sparsity"), (list, tuple)) \
+        else 1.0 - attrs.get("sparsity", 0.999)
+    gf = g.reshape(-1).astype(jnp.float32)
+    k = max(1, int(round(gf.shape[0] * ratio)))
+    u_new = m * u.reshape(-1) + gf
+    v_new = v.reshape(-1) + u_new
+    _, idx = jax.lax.top_k(jnp.abs(v_new), k)
+    vals = v_new[idx]
+    keep = jnp.ones_like(gf).at[idx].set(0.0)
+    grad_out = jnp.zeros_like(gf).at[idx].set(vals)
+    encode = jnp.concatenate([idx.astype(jnp.float32), vals])
+    return {"U_out": [u_new * keep], "V_out": [v_new * keep],
+            "EncodeGrad": [encode], "Grad_out": [grad_out.reshape(g.shape)],
+            "GatherBuff": [encode]}
+
+
+# -- fused / fusion op family (ref operators/fused/) -------------------------
+# These exist in the reference as hand-fused CPU kernels; here they are
+# COMPOSITIONS of the already-registered lowerings — XLA fuses the pieces,
+# so the fused registration is an API/graph-compat surface, not a perf
+# feature (fusion is the compiler's job on TPU).
+
+def _call(op_type, ctx, ins, attrs):
+    return registry.get_op_info(op_type).lower(ctx, ins, attrs)
+
+
+@register_op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ctx, ins, attrs):
+    """ref fused/fusion_squared_mat_sub_op.cc:
+    out = scalar · ((XY)² − X²Y²)."""
+    x, y = X(ins, "X"), X(ins, "Y")
+    xy = x @ y
+    x2y2 = jnp.square(x) @ jnp.square(y)
+    out = attrs.get("scalar", 1.0) * (jnp.square(xy) - x2y2)
+    return {"SquaredXY": [jnp.square(xy)], "SquaredX": [jnp.square(x)],
+            "SquaredY": [jnp.square(y)], "Out": [out]}
+
+
+@register_op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ctx, ins, attrs):
+    """ref fused/fusion_repeated_fc_relu_op.cc: chain of fc+relu."""
+    x = X(ins, "X")
+    ws = XS(ins, "W")
+    bs = XS(ins, "Bias")
+    outs = []
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        x = x.reshape(x.shape[0], -1) @ w + b.reshape(1, -1)
+        if i < len(ws) - 1:
+            x = jax.nn.relu(x)
+        outs.append(x)
+    return {"ReluOut": outs[:-1], "Out": [jax.nn.relu(outs[-1])]}
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def _fused_fc_elementwise_layernorm(ctx, ins, attrs):
+    """ref fused/fused_fc_elementwise_layernorm_op.cc:
+    layer_norm(fc(x) + y)."""
+    x, w = X(ins, "X"), X(ins, "W")
+    b = X(ins, "Bias0")
+    y = X(ins, "Y")
+    scale, bias1 = X(ins, "Scale"), X(ins, "Bias1")
+    h = x.reshape(x.shape[0], -1) @ w
+    if b is not None:
+        h = h + b.reshape(1, -1)
+    h = h + y
+    eps = attrs.get("epsilon", 1e-5)
+    m = jnp.mean(h, axis=-1, keepdims=True)
+    v = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - m) * jax.lax.rsqrt(v + eps)
+    if scale is not None:
+        out = out * scale.reshape(1, -1)
+    if bias1 is not None:
+        out = out + bias1.reshape(1, -1)
+    return {"Out": [out], "Mean": [m.reshape(-1)],
+            "Variance": [v.reshape(-1)]}
+
+
+@register_op("fused_embedding_seq_pool")
+def _fused_embedding_seq_pool(ctx, ins, attrs):
+    """ref fused/fused_embedding_seq_pool_op.cc: lookup + sum-pool over the
+    time dim (dense ids [b, t])."""
+    w, ids = X(ins, "W"), X(ins, "Ids")
+    emb = w[ids.reshape(ids.shape[0], -1)]
+    return {"Out": [jnp.sum(emb, axis=1)]}
+
+
+@register_op("fusion_seqpool_concat")
+def _fusion_seqpool_concat(ctx, ins, attrs):
+    """ref fused/fusion_seqpool_concat_op.cc: pool each [b,t,d] input over
+    t, concat on features."""
+    xs = XS(ins, "X")
+    ptype = attrs.get("pooltype", "SUM").upper()
+    red = {"SUM": jnp.sum, "AVERAGE": jnp.mean, "SQRT": jnp.sum,
+           "MAX": jnp.max, "LAST": None, "FIRST": None}[ptype]
+    pooled = []
+    for x in xs:
+        if ptype == "LAST":
+            pooled.append(x[:, -1])
+        elif ptype == "FIRST":
+            pooled.append(x[:, 0])
+        else:
+            p = red(x, axis=1)
+            if ptype == "SQRT":
+                p = p / jnp.sqrt(float(x.shape[1]))
+            pooled.append(p)
+    return {"Out": [jnp.concatenate(pooled, axis=-1)]}
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def _fusion_seqpool_cvm_concat(ctx, ins, attrs):
+    """ref fused/fusion_seqpool_cvm_concat_op.cc: seqpool → cvm → concat."""
+    pooled = _call("fusion_seqpool_concat", ctx, ins, attrs)["Out"][0]
+    return {"Out": [_cvm(ctx, {"X": [pooled]}, attrs)["Y"][0]]}
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, ins, attrs):
+    """ref fused/fusion_transpose_flatten_concat_op.cc."""
+    xs = XS(ins, "X")
+    perm = attrs.get("trans_axis", [0, 2, 3, 1])
+    axis = attrs.get("concat_axis", 1)
+    flat = [jnp.transpose(x, perm).reshape(x.shape[0], -1) for x in xs]
+    return {"Out": [jnp.concatenate(flat, axis=axis if axis < 2 else 1)]}
+
+
+@register_op("fusion_gru")
+def _fusion_gru(ctx, ins, attrs):
+    """ref fused/fusion_gru_op.cc: x·Wx projection fused in front of the
+    standard GRU recurrence; delegates to the gru lowering."""
+    x = X(ins, "X")
+    wx, wh = X(ins, "WeightX"), X(ins, "WeightH")
+    proj = x @ wx                          # [b, t, 3d]
+    ins2 = {"Input": [proj], "Weight": [wh], "Bias": ins.get("Bias"),
+            "H0": ins.get("H0"), "SeqLen": ins.get("SeqLen")}
+    out = _call("gru", ctx, ins2, attrs)
+    return {"Hidden": out["Hidden"], "XX": [proj],
+            "BatchedInput": [proj], "BatchedOut": out["Hidden"]}
+
+
+@register_op("fusion_lstm")
+def _fusion_lstm(ctx, ins, attrs):
+    """ref fused/fusion_lstm_op.cc: fused x·Wx + LSTM recurrence."""
+    x = X(ins, "X")
+    wx, wh = X(ins, "WeightX"), X(ins, "WeightH")
+    proj = x @ wx                          # [b, t, 4d]
+    ins2 = {"Input": [proj], "Weight": [wh], "Bias": ins.get("Bias"),
+            "H0": ins.get("H0"), "C0": ins.get("C0"),
+            "SeqLen": ins.get("SeqLen")}
+    out = _call("lstm", ctx, ins2, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [proj]}
+
+
+@register_op("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """ref fused/fused_embedding_fc_lstm_op.cc: ids → embedding rows used
+    directly as the 4d gate projection, then LSTM."""
+    ids = X(ins, "Ids")
+    emb = X(ins, "Embeddings")             # [V, 4d] pre-multiplied table
+    proj = emb[ids.reshape(ids.shape[0], -1)]
+    ins2 = {"Input": [proj], "Weight": ins.get("WeightH"),
+            "Bias": ins.get("Bias"), "H0": ins.get("H0"),
+            "C0": ins.get("C0"), "SeqLen": ins.get("SeqLen")}
+    out = _call("lstm", ctx, ins2, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [proj]}
+
+
+@register_op("attention_lstm")
+def _attention_lstm(ctx, ins, attrs):
+    """ref fused/attention_lstm_op.cc: per step, softmax attention over the
+    encoder states conditioned on the previous cell, then one LSTM step."""
+    x = X(ins, "X")                        # [b, t, d]
+    c0 = X(ins, "C0")
+    h0 = X(ins, "H0")
+    att_w = X(ins, "AttentionWeight")      # [d + d, 1]
+    lstm_w = X(ins, "LSTMWeight")          # [d + d, 4d]
+    lstm_b = X(ins, "LSTMBias")            # [1, 4d]
+    b, t, d = x.shape
+    dh = lstm_w.shape[1] // 4
+    if h0 is None:
+        h0 = jnp.zeros((b, dh), x.dtype)
+
+    def step(carry, _):
+        h, c = carry
+        # attention scores from [x_t ; c] per time step
+        cexp = jnp.broadcast_to(c[:, None, :], (b, t, c.shape[-1]))
+        feat = jnp.concatenate([x, cexp], axis=-1)
+        scores = jax.nn.softmax(
+            (feat @ att_w).squeeze(-1), axis=-1)       # [b, t]
+        ctx_vec = jnp.einsum("bt,btd->bd", scores, x)
+        gates = jnp.concatenate([ctx_vec, h], axis=-1) @ lstm_w + \
+            lstm_b.reshape(-1)
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(gf) * c + \
+            jax.nn.sigmoid(gi) * jnp.tanh(gc)
+        h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h_f, c_f), hs = jax.lax.scan(step, (h0, c0), None, length=t)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "Cell": [c_f],
+            "AttentionedX": [x], "AttentionFCOut": [h_f],
+            "LSTMX": [x], "LSTMOUT": [h_f]}
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    """ref fused/fusion_seqconv_eltadd_relu_op.cc:
+    relu(sequence_conv(x) + b)."""
+    conv = _call("sequence_conv", ctx,
+                 {"X": ins.get("X"), "Filter": ins.get("Filter")},
+                 {"context_length": attrs.get("contextLength", 3),
+                  "context_start": attrs.get("contextStart", 0)})["Out"][0]
+    b = X(ins, "Bias")
+    return {"Out": [jax.nn.relu(conv + b.reshape(1, 1, -1))],
+            "ColMat": [conv]}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """ref fused/fusion_seqexpand_concat_fc_op.cc: broadcast the second
+    (per-sequence) inputs over time, concat features, one fc + act."""
+    xs = XS(ins, "X")
+    w = X(ins, "FCWeight")
+    bias = X(ins, "FCBias")
+    base = xs[0]                           # [b, t, d0]
+    b_, t = base.shape[0], base.shape[1]
+    feats = [base]
+    for extra in xs[1:]:                   # [b, d] broadcast over t
+        feats.append(jnp.broadcast_to(extra[:, None, :],
+                                      (b_, t, extra.shape[-1])))
+    cat = jnp.concatenate(feats, axis=-1)
+    out = cat @ w
+    if bias is not None:
+        out = out + bias.reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    if act not in ("identity", ""):
+        from .math_ops import _ACTIVATIONS
+        out = _ACTIVATIONS[act](out)
+    return {"Out": [out], "FCOut": [out]}
+
+
+# -- conv stragglers ---------------------------------------------------------
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """ref operators/conv_transpose_op.cc (3-D)."""
+    x, w = X(ins, "Input"), X(ins, "Filter")
+    strides = attrs.get("strides", [1, 1, 1])
+    pads = attrs.get("paddings", [0, 0, 0])
+    dils = attrs.get("dilations", [1, 1, 1])
+    out = jax.lax.conv_transpose(
+        x, w, strides=tuple(strides),
+        padding=[(p, p) for p in pads], rhs_dilation=tuple(dils),
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    a = dict(attrs)
+    a["groups"] = X(ins, "Input").shape[1]
+    return _call("conv2d_transpose", ctx, ins, a)
+
+
+@register_op("conv2d_fusion")
+def _conv2d_fusion(ctx, ins, attrs):
+    """ref fused/conv2d_fusion_op.cc: conv + bias + (residual) + act."""
+    out = _call("conv2d", ctx, ins, attrs)["Output"][0]
+    b = X(ins, "Bias")
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    res = X(ins, "ResidualData")
+    if res is not None:
+        out = out + res
+    act = attrs.get("activation", "relu")
+    if act and act != "identity":
+        from .math_ops import _ACTIVATIONS
+        out = _ACTIVATIONS[act](out)
+    return {"Output": [out]}
+
+
+@register_op("spectral_norm")
+def _spectral_norm(ctx, ins, attrs):
+    """ref operators/spectral_norm_op.cc: weight / σ_max via power
+    iteration on the stored u/v vectors."""
+    w, u, v = X(ins, "Weight"), X(ins, "U"), X(ins, "V")
+    dim = attrs.get("dim", 0)
+    iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 0)):
+        v = mat.T @ u.reshape(-1)
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = u.reshape(-1)
+    v = v.reshape(-1)
+    sigma = u @ mat @ v
+    return {"Out": [w / sigma]}
+
+
+@register_op("detection_map", no_grad=True)
+def _detection_map(ctx, ins, attrs):
+    """ref operators/detection_map_op.cc — host-side mAP via the metrics
+    implementation (pure_callback; metric ops are not on the training hot
+    path)."""
+    det = X(ins, "DetectRes")      # [n, 6] label,score,x1,y1,x2,y2
+    label = X(ins, "Label")        # [m, 5] or [m, 6]
+    overlap = attrs.get("overlap_threshold", 0.5)
+    ap_version = attrs.get("ap_type", attrs.get("ap_version", "integral"))
+
+    evaluate_difficult = attrs.get("evaluate_difficult", True)
+
+    def host(det_v, label_v):
+        from ..metrics import DetectionMAP
+        m = DetectionMAP(overlap_threshold=overlap,
+                         evaluate_difficult=evaluate_difficult,
+                         ap_version=ap_version)
+        lab = np.asarray(label_v, np.float64)
+        if lab.shape[-1] == 6:     # [label, difficult, x1, y1, x2, y2] →
+            # metrics order [label, x1, y1, x2, y2, difficult]
+            lab = lab[:, [0, 2, 3, 4, 5, 1]]
+        m.update(np.asarray(det_v, np.float64), lab)
+        try:
+            return np.float32(m.eval())
+        except ValueError:
+            return np.float32(0.0)
+
+    out = jax.pure_callback(
+        host, jax.ShapeDtypeStruct((), jnp.float32), det, label)
+    return {"MAP": [out.reshape(1)],
+            "AccumPosCount": [jnp.zeros((1,), jnp.int32)],
+            "AccumTruePos": [jnp.zeros((1, 2), jnp.float32)],
+            "AccumFalsePos": [jnp.zeros((1, 2), jnp.float32)]}
+
+
+# -- final stragglers --------------------------------------------------------
+
+@register_op("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """ref operators/affine_grid_op.cc: theta [N,2,3] → normalized sampling
+    grid [N,H,W,2] over [-1,1]² (pairs with grid_sampler)."""
+    theta = X(ins, "Theta")
+    shape = attrs.get("output_shape") or None
+    if not shape:
+        hw = X(ins, "OutputShape")
+        if isinstance(hw, jax.core.Tracer):
+            raise TypeError(
+                "affine_grid OutputShape must be a compile-time constant "
+                "under XLA; pass the output_shape attr instead")
+        shape = [int(v) for v in np.asarray(hw)]
+    n, _, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                  # [h, w]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)      # [h, w, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)
+    return {"Output": [grid]}
+
+
+@register_op("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """ref operators/lstmp_op.cc: LSTM with a recurrent projection layer
+    (h = (o ⊙ tanh(c)) · P), the LARK/ASR recipe."""
+    x = X(ins, "Input")                # [b, t, 4d] pre-projected
+    w = X(ins, "Weight")               # [p, 4d] recurrent on the PROJECTION
+    proj_w = X(ins, "ProjWeight")      # [d, p]
+    bias = X(ins, "Bias")
+    h0, c0 = X(ins, "H0"), X(ins, "C0")
+    gate_act = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh}[
+        attrs.get("gate_activation", "sigmoid")]
+    act = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh}[
+        attrs.get("cell_activation", "tanh")]
+    proj_act = attrs.get("proj_activation", "tanh")
+    b, t, d4 = x.shape
+    d = d4 // 4
+    p = proj_w.shape[1]
+    if bias is not None:
+        x = x + bias.reshape(-1)[:4 * d]
+    if h0 is None:
+        h0 = jnp.zeros((b, p), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        c_new = gate_act(gf) * c + gate_act(gi) * act(gc)
+        raw_h = gate_act(go) * act(c_new)
+        h_new = raw_h @ proj_w
+        if proj_act == "tanh":
+            h_new = jnp.tanh(h_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0), jnp.swapaxes(x, 0, 1),
+        reverse=attrs.get("is_reverse", False))
+    return {"Projection": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "BatchGate": [x], "BatchCellPreAct": [cs[-1]],
+            "BatchHidden": [hs[-1]]}
+
+
+@register_op("cudnn_lstm")
+def _cudnn_lstm(ctx, ins, attrs):
+    """ref operators/cudnn_lstm_op.cu (single-layer unidirectional subset):
+    flat weight blob unpacked to Wx/Wh/biases per the cudnn layout."""
+    x = X(ins, "Input")                # [t, b, in] time-major (cudnn)
+    w = X(ins, "W").reshape(-1)
+    init_h, init_c = X(ins, "InitH"), X(ins, "InitC")
+    hidden = int(attrs.get("hidden_size"))
+    if attrs.get("num_layers", 1) != 1 or attrs.get("is_bidirec", False):
+        raise NotImplementedError(
+            "cudnn_lstm lowering covers num_layers=1 unidirectional; stack "
+            "the lstm op for deeper/bidirectional nets")
+    t, b, d_in = x.shape
+    o = 0
+    wx = w[o:o + 4 * hidden * d_in].reshape(4, hidden, d_in); o += 4 * hidden * d_in
+    wh = w[o:o + 4 * hidden * hidden].reshape(4, hidden, hidden); o += 4 * hidden * hidden
+    bx = w[o:o + 4 * hidden].reshape(4, hidden); o += 4 * hidden
+    bh = w[o:o + 4 * hidden].reshape(4, hidden)
+    # cudnn gate order i,f,c,o matches the lstm op's
+    wx2 = jnp.concatenate([wx[g].T for g in range(4)], axis=1)  # [d_in, 4h]
+    wh2 = jnp.concatenate([wh[g].T for g in range(4)], axis=1)  # [h, 4h]
+    bias = (bx + bh).reshape(1, -1)
+    xb = jnp.swapaxes(x, 0, 1)          # [b, t, d_in]
+    proj = xb @ wx2
+    h0 = init_h.reshape(b, hidden) if init_h is not None else None
+    c0 = init_c.reshape(b, hidden) if init_c is not None else None
+    out = _call("lstm", ctx,
+                {"Input": [proj], "Weight": [wh2], "Bias": [bias],
+                 "H0": [h0] if h0 is not None else [],
+                 "C0": [c0] if c0 is not None else []},
+                {"gate_activation": "sigmoid", "cell_activation": "tanh",
+                 "candidate_activation": "tanh"})
+    hs = jnp.swapaxes(out["Hidden"][0], 0, 1)       # back to [t, b, h]
+    return {"Out": [hs], "last_h": [out["LastH"][0][None]],
+            "last_c": [out["LastC"][0][None]],
+            "Reserve": [jnp.zeros((1,), jnp.float32)],
+            "StateOut": [jnp.zeros((1,), jnp.float32)]}
+
+
+@register_op("recurrent", no_grad=True, raw=True)
+def _recurrent(ctx, block, op, state):
+    """ref operators/recurrent_op.cc: run the step block once per time
+    step, threading `states` → `ex_states`, stacking `outputs`.  Sequence
+    inputs are time-major (sliced on dim 0), exactly the reference's step
+    slicing; the whole loop compiles to one lax.scan."""
+    from .control_flow_ops import _trace_subblock
+    sub = op.attrs["sub_block"]
+    states = op.attrs.get("states", [])
+    ex_states = op.attrs.get("ex_states", [])
+    seq_names = op.input("inputs")
+    init_names = op.input("initial_states")
+    param_names = op.input("parameters")
+    out_names = op.output("outputs")
+    consts = {n: state.read(block, n) for n in param_names}
+    xs = tuple(state.read(block, n) for n in seq_names)
+    carry0 = tuple(state.read(block, n) for n in init_names)
+
+    def step(carry, xt):
+        env = dict(consts)
+        env.update(zip(ex_states, carry))
+        env.update(zip(seq_names, xt))
+        env = _trace_subblock(ctx, sub, env)
+        return (tuple(env[n] for n in states),
+                tuple(env[n] for n in out_names))
+
+    _, outs = jax.lax.scan(step, carry0, xs,
+                           reverse=op.attrs.get("reverse", False))
+    for n, v in zip(out_names, outs):
+        state.write(n, v)
+
+
+def _bilinear_sample(feat, py, px):
+    """feat [C, H, W]; py/px arbitrary-shape float coords → [C, *coords]."""
+    c, h, w = feat.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inside = ((yy >= 0) & (yy <= h - 1) &
+                      (xx >= 0) & (xx <= w - 1))
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            out = out + feat[:, yi, xi] * (sy * sx * inside)[None]
+    return out
+
+
+@register_op("deformable_conv")
+def _deformable_conv(ctx, ins, attrs):
+    """ref operators/deformable_conv_op.cc (v2): each kernel tap samples at
+    a learned offset, optionally modulated by Mask; realized as bilinear
+    gathers + one matmul (the deformable im2col, MXU-shaped)."""
+    x = X(ins, "Input")              # [N, C, H, W]
+    offset = X(ins, "Offset")        # [N, 2*kh*kw, Ho, Wo] (y, x pairs)
+    mask = X(ins, "Mask")            # [N, kh*kw, Ho, Wo] or None (v1)
+    w = X(ins, "Filter")             # [Co, C/g, kh, kw]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])
+    dh, dw = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    n, c, h, wd = x.shape
+    co, cpg, kh, kw = w.shape
+    ho = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    base_y = (jnp.arange(ho) * sh - ph)[:, None]          # [Ho, 1]
+    base_x = (jnp.arange(wo) * sw - pw)[None, :]          # [1, Wo]
+
+    def one_image(xi, offi, mi):
+        cols = []
+        for t in range(kh * kw):
+            ky, kx = t // kw, t % kw
+            py = base_y + ky * dh + offi[2 * t]           # [Ho, Wo]
+            px = base_x + kx * dw + offi[2 * t + 1]
+            s = _bilinear_sample(xi, py, px)              # [C, Ho, Wo]
+            if mi is not None:
+                s = s * mi[t][None]
+            cols.append(s)
+        return jnp.stack(cols, axis=1)                    # [C, kh*kw, Ho, Wo]
+
+    if mask is not None:
+        cols = jax.vmap(one_image)(x, offset, mask)
+    else:
+        cols = jax.vmap(lambda xi, offi: one_image(xi, offi, None))(
+            x, offset)
+    # cols: [N, C, kh*kw, Ho, Wo] → grouped matmul with the filter
+    cols_g = cols.reshape(n, groups, cpg * kh * kw, ho * wo)
+    w_g = w.reshape(groups, co // groups, cpg * kh * kw)
+    out = jnp.einsum("ngkp,gok->ngop", cols_g, w_g)
+    return {"Output": [out.reshape(n, co, ho, wo)]}
+
+
+@register_op("deformable_conv_v1")
+def _deformable_conv_v1(ctx, ins, attrs):
+    ins2 = dict(ins)
+    ins2["Mask"] = []
+    return {"Output": _deformable_conv(ctx, ins2, attrs)["Output"]}
+
+
+@register_op("deformable_psroi_pooling")
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """ref operators/deformable_psroi_pooling_op.cc: position-sensitive ROI
+    pooling with per-part learned offsets (deformable R-FCN head)."""
+    x = X(ins, "Input")              # [N, C, H, W], C = out_c * ph * pw
+    rois = X(ins, "ROIs")            # [R, 4] x1,y1,x2,y2
+    trans = X(ins, "Trans")          # [R, 2, ph, pw] offsets or None
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    out_dim = attrs.get("output_dim")
+    group = attrs.get("group_size", [1, 1])[0]
+    pooled = attrs.get("pooled_height", attrs.get("pooled_size", 7))
+    part = attrs.get("part_size", [pooled, pooled])[0]
+    tstd = attrs.get("trans_std", 0.1)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    ph_ = pooled
+    from .detection_ops import _rois_batch_index
+    roi_imgs = _rois_batch_index(X(ins, "RoisNum"), r, n)
+
+    def one_roi(roi, tr, bi):
+        img = x[bi]
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        bin_w, bin_h = rw / ph_, rh / ph_
+        outs = []
+        for i in range(ph_):
+            for j in range(ph_):
+                off_y = tr[0, min(i * part // ph_, part - 1),
+                           min(j * part // ph_, part - 1)] * tstd * rh \
+                    if tr is not None else 0.0
+                off_x = tr[1, min(i * part // ph_, part - 1),
+                           min(j * part // ph_, part - 1)] * tstd * rw \
+                    if tr is not None else 0.0
+                cy = y1 + (i + 0.5) * bin_h + off_y
+                cx = x1 + (j + 0.5) * bin_w + off_x
+                gi = min(i * group // ph_, group - 1)
+                gj = min(j * group // ph_, group - 1)
+                # output-channel-major layout, matching _psroi_pool
+                # (detection_ops.py) and the reference kernel: channel for
+                # output ctop at part (gi, gj) is ctop·group² + gi·group+gj
+                feat = img[gi * group + gj::group * group][:out_dim]
+                outs.append(_bilinear_sample(feat, cy[None, None],
+                                             cx[None, None])[:, 0, 0])
+        return jnp.stack(outs, -1).reshape(out_dim, ph_, ph_)
+
+    if trans is not None:
+        outs = jax.vmap(one_roi)(rois, trans, roi_imgs)
+    else:
+        outs = jax.vmap(lambda roi, bi: one_roi(roi, None, bi))(
+            rois, roi_imgs)
+    return {"Output": [outs], "TopCount": [jnp.ones_like(outs)]}
+
+
+@register_op("conv2d_inception_fusion")
+def _conv2d_inception_fusion(ctx, ins, attrs):
+    """ref fused/fusion_conv_inception_op.cu: 4-branch inception cell —
+    (avgpool→1×1), (1×1 direct channels), (grouped double-3×3 chain) —
+    concatenated along channels with per-branch bias+relu."""
+    x = X(ins, "Input")
+    f = XS(ins, "Filter")
+    bs = XS(ins, "Bias")
+
+    def conv(inp, w, b, groups=1, k3=False):
+        pad = 1 if k3 else 0
+        out = jax.lax.conv_general_dilated(
+            inp, w, window_strides=(1, 1),
+            padding=[(pad, pad), (pad, pad)],
+            feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return jax.nn.relu(out)
+
+    # branch 0: 3x3 avg pool (same) → 1x1 conv
+    pooled = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (1, 1), (1, 1)]) / 9.0
+    b0 = conv(pooled, f[0], bs[0] if bs else None)
+    # branch 1+2 stem: 1x1 conv; first oc1 channels pass through, the rest
+    # feed the grouped double-3x3 chain
+    u = conv(x, f[1], bs[1] if len(bs) > 1 else None)
+    f2_in = f[2].shape[1] * 2                 # grouped (2) conv input
+    oc1 = f[1].shape[0] - f2_in
+    b1 = u[:, :oc1]
+    v = u[:, oc1:]
+    w2 = conv(v, f[2], bs[2] if len(bs) > 2 else None, groups=2, k3=True)
+    f3_ic = f[3].shape[1]
+    b2 = w2[:, :w2.shape[1] - f3_ic]
+    b3 = conv(w2[:, w2.shape[1] - f3_ic:], f[3],
+              bs[3] if len(bs) > 3 else None, k3=True)
+    return {"Output": [jnp.concatenate([b0, b1, b2, b3], axis=1)],
+            "TempOutput": [u]}
