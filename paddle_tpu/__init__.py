@@ -21,7 +21,7 @@ from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
 from . import evaluator, metrics, nets  # noqa
 from . import contrib  # noqa
-from . import debugger, install_check  # noqa
+from . import checkpoint, debugger, install_check  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
 from . import native  # noqa

@@ -1,0 +1,120 @@
+"""Preemption-aware training checkpoints (SURVEY §5.3/§5.4).
+
+The reference's recovery story is op-level save/load plus PS
+``checkpoint_notify`` snapshots (``operators/save_op.cc``,
+``distributed_ops/checkpoint_notify_op.cc``); on TPU the failure model is
+preemption, so the first-class tool is a step-indexed, atomic, keep-last-k
+checkpoint manager (orbax-backed — the jax-ecosystem standard writer) over
+the program's persistable state.
+
+    ckpt = CheckpointManager("/tmp/run1", max_to_keep=3)
+    start = ckpt.latest_step() or 0          # resume after preemption
+    if start:
+        ckpt.restore(start, scope=fluid.global_scope())
+    for step in range(start, total):
+        exe.run(...)
+        ckpt.save(step, program=main_program)
+
+Train-loop integration mirroring the reference's ``fluid.io`` family; the
+PS plane snapshots itself through the same manager via ``save_server``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from .framework import core
+from .framework.scope import Scope, global_scope
+from .io import get_program_persistable_vars
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Atomic, step-indexed, keep-last-k checkpoints of scope state."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._interval = max(int(save_interval_steps), 1)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    # -- state gathering -----------------------------------------------------
+    def _gather(self, program, scope) -> Dict[str, np.ndarray]:
+        scope = scope or global_scope()
+        program = program or core.default_main_program()
+        state = {}
+        for v in get_program_persistable_vars(program):
+            val = scope.find_var(v.name)
+            if val is None:
+                # a partial checkpoint would restore into a broken run —
+                # fail at save time (same contract as io.save_persistables)
+                raise RuntimeError(
+                    f"persistable var {v.name!r} has no value in the "
+                    "scope; did you run the startup program before "
+                    "checkpointing?")
+            state[v.name] = np.asarray(val)
+        return state
+
+    def _write(self, step: int, state: Dict[str, np.ndarray],
+               force: bool) -> bool:
+        if not force and step % self._interval != 0:
+            return False
+        import orbax.checkpoint as ocp
+        # async write: orbax serializes with the previous save itself, so
+        # training overlaps checkpoint I/O; the rename is atomic, a
+        # preemption mid-save never corrupts the latest complete ckpt
+        return bool(self._mgr.save(step, args=ocp.args.StandardSave(state)))
+
+    # -- API (shape of orbax, semantics of fluid.io.save_persistables) ------
+    def save(self, step: int, program=None, scope: Optional[Scope] = None,
+             force: bool = False) -> bool:
+        """Write persistables at ``step``; returns True iff orbax accepted
+        the write (False when off-interval or step ≤ latest saved).
+        Respects ``save_interval_steps`` unless ``force``."""
+        if not force and step % self._interval != 0:
+            return False
+        return self._write(step, self._gather(program, scope), force=True)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(self, step: Optional[int] = None, program=None,
+                scope: Optional[Scope] = None) -> int:
+        """Load persistables from ``step`` (default: latest) into the
+        scope; returns the restored step."""
+        import orbax.checkpoint as ocp
+        self._mgr.wait_until_finished()    # drain any in-flight save
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        scope = scope or global_scope()
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore())
+        for name, val in restored.items():
+            scope.set_var(name, np.asarray(val))
+        return int(step)
+
+    # -- PS snapshot (ref checkpoint_notify → pserver-side save) -------------
+    def save_server(self, step: int, server, param_specs,
+                    force: bool = False) -> bool:
+        """Snapshot a PSServer's tables (ref CheckpointNotify RPC: each
+        pserver persists its own shard)."""
+        state = {spec["name"]: np.asarray(
+            server.get_param(spec["name"], spec["size"]))
+            for spec in param_specs}
+        return self._write(step, state, force)
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,95 @@
+"""Preemption-aware checkpointing (SURVEY §5.3/§5.4; orbax-backed)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _build_and_step(exe, loss, rng, steps):
+    out = None
+    for _ in range(steps):
+        xv = rng.rand(8, 4).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        out, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    return float(out)
+
+
+def test_save_restore_resume(tmp_path):
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="ck_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+
+        _build_and_step(exe, loss, rng, 5)
+        assert ckpt.save(5)
+        w5 = np.asarray(fluid.global_scope().find_var("ck_w")).copy()
+        m5 = np.asarray(
+            fluid.global_scope().find_var("ck_w_moment1_0")).copy() \
+            if fluid.global_scope().find_var("ck_w_moment1_0") is not None \
+            else None
+        _build_and_step(exe, loss, rng, 5)
+        assert ckpt.save(10)
+        # keep-last-2: step 5 and 10 retained
+        assert ckpt.all_steps() == [5, 10]
+        assert ckpt.latest_step() == 10
+
+        # "preemption": wipe the scope and resume from step 5
+        restored = ckpt.restore(5)
+        assert restored == 5
+        np.testing.assert_allclose(
+            np.asarray(fluid.global_scope().find_var("ck_w")), w5)
+        if m5 is not None:
+            # optimizer slots (Adam moments) resume too — true training
+            # resume, not params-only
+            np.testing.assert_allclose(
+                np.asarray(fluid.global_scope().find_var("ck_w_moment1_0")),
+                m5)
+        # training continues after restore
+        out = _build_and_step(exe, loss, rng, 3)
+        assert np.isfinite(out)
+        ckpt.close()
+
+
+def test_interval_and_missing(tmp_path):
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[2], dtype="float32")
+        layers.fc(x, size=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        ckpt = CheckpointManager(str(tmp_path / "r2"),
+                                 save_interval_steps=5)
+        assert not ckpt.save(3)          # off-interval: skipped
+        assert ckpt.save(3, force=True)
+        assert ckpt.latest_step() == 3
+        import pytest
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager(str(tmp_path / "empty")).restore()
+        ckpt.close()
+
+
+def test_save_below_latest_reports_false(tmp_path):
+    """After restoring an older step, saves below the latest retained step
+    are refused by orbax — save() must report that honestly."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[2], dtype="float32")
+        layers.fc(x, size=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        ckpt = CheckpointManager(str(tmp_path / "r3"))
+        assert ckpt.save(5)
+        assert ckpt.save(10)
+        ckpt.restore(5)
+        assert not ckpt.save(8), \
+            "orbax skipped the write; save() must not claim success"
+        ckpt.close()
