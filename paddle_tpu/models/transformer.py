@@ -119,8 +119,10 @@ def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
 
 def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
             n_head, dropout_rate=0.0, attn_bias=None, is_test=False,
-            type_ids=None, n_types=2, attn_impl="base"):
-    """BERT-style embedding + N encoder layers."""
+            type_ids=None, n_types=2, attn_impl="base", checkpoints=None):
+    """BERT-style embedding + N encoder layers.  Pass ``checkpoints=[]`` to
+    collect each layer's output for RecomputeOptimizer (remat at layer
+    boundaries — the standard transformer memory/compute trade)."""
     emb = layers.embedding(src_ids, size=[vocab_size, d_model],
                            param_attr=ParamAttr(name="word_embedding"))
     pos = layers.embedding(pos_ids, size=[max_pos, d_model],
@@ -138,6 +140,8 @@ def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
     for i in range(n_layer):
         x = encoder_layer(x, d_model, d_inner, n_head, dropout_rate,
                           attn_bias, is_test, idx=i, attn_impl=attn_impl)
+        if checkpoints is not None:
+            checkpoints.append(x)
     return x
 
 
@@ -162,7 +166,8 @@ class BertConfig:
 
 
 def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
-                        dropout=None, attn_impl="base", fused_head=False):
+                        dropout=None, attn_impl="base", fused_head=False,
+                        checkpoints=None):
     """Masked-LM pretraining net: ids+mask-labels → mean masked CE loss.
 
     Labels use 0 ([PAD], never a real MLM target) for unmasked positions;
@@ -179,7 +184,8 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
     lm_label = layers.data("lm_label", shape=[seq_len], dtype="int64")
     enc = encoder(src_ids, pos_ids, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
                   cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
-                  is_test=is_test, attn_impl=attn_impl)
+                  is_test=is_test, attn_impl=attn_impl,
+                  checkpoints=checkpoints)
     if fused_head:
         loss = layers.fused_lm_head_ce(
             enc, cfg.vocab_size, lm_label,

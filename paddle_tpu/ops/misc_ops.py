@@ -208,3 +208,12 @@ def _merge_selected_rows(ctx, ins, attrs):
 def _get_tensor_from_selected_rows(ctx, ins, attrs):
     """ref get_tensor_from_selected_rows_op.cc — dense carrier passthrough."""
     return {"Out": [X(ins, "X")]}
+
+
+@register_op("optimization_barrier", no_grad=True)
+def _optimization_barrier(ctx, ins, attrs):
+    """XLA CSE fence: recomputed-segment inputs pass through this so the
+    compiler cannot merge the recomputation with the original forward
+    values (jax.checkpoint uses the same primitive for the same reason).
+    No reference counterpart — remat support is TPU-native."""
+    return {"Out": [jax.lax.optimization_barrier(X(ins, "X"))]}

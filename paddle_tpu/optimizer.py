@@ -705,3 +705,48 @@ def __getattr__(name):
         from .parallel.pipeline import PipelineOptimizer
         return PipelineOptimizer
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+class RecomputeOptimizer:
+    """Activation-rematerialization wrapper (TPU-native; the 2019 reference
+    has no recompute — see framework/recompute.py).  Usage mirrors the
+    modern fluid API:
+
+        opt = optimizer.RecomputeOptimizer(Adam(1e-4))
+        opt._set_checkpoints([layer_out_1, layer_out_2, ...])
+        opt.minimize(loss)
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = []
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [
+            c.name if hasattr(c, "name") else c for c in checkpoints]
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def _apply(self, program):
+        # idempotent: minimize() delegates to the inner optimizer whose
+        # backward() may already have routed through this wrapper
+        if self._checkpoints and not program._attrs.get("__recompute__"):
+            from .framework.recompute import apply_recompute
+            apply_recompute(program, self._checkpoints)
+            program._attrs["__recompute__"] = True
+
+    def backward(self, loss, **kw):
+        """fluid's documented recompute entry point: backward() builds the
+        grad ops, then the program is rewritten for rematerialization."""
+        result = self._optimizer.backward(loss, **kw)
+        self._apply(loss.block.program)
+        return result
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set,
+                                          grad_clip=grad_clip)
+        self._apply(loss.block.program)
+        return result

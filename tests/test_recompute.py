@@ -1,0 +1,113 @@
+"""Activation rematerialization (framework/recompute.py; no reference
+counterpart — SURVEY §5.7 notes the 2019 codebase has no recompute)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _build(n_layers=3):
+    x = layers.data("x", shape=[16], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = x
+    ckpts = []
+    for i in range(n_layers):
+        h = layers.fc(h, size=16, act="tanh")
+        ckpts.append(h)
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss, ckpts
+
+
+def _train(recompute, steps=10):
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        loss, ckpts = _build()
+        opt = fluid.optimizer.Adam(0.01)
+        if recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(opt)
+            opt._set_checkpoints(ckpts)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=11)
+        rng = np.random.RandomState(0)
+        out = []
+        for _ in range(steps):
+            xv = rng.rand(8, 16).astype(np.float32)
+            yv = xv.sum(1, keepdims=True).astype(np.float32)
+            lv, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+            out.append(float(lv))
+        return out, prog
+
+
+def test_recompute_exact_parity():
+    """Recompute must not change a single gradient: loss trajectories are
+    bit-identical to the stored-activation run."""
+    base, _ = _train(False)
+    rc, prog = _train(True)
+    np.testing.assert_allclose(base, rc, rtol=0, atol=0)
+    types = [op.type for op in prog.global_block().ops]
+    assert "optimization_barrier" in types
+    assert any("@RECOMPUTE" in n for op in prog.global_block().ops
+               for n in op.output_arg_names())
+
+
+def test_recompute_with_dropout_keeps_mask():
+    """RNG ops are excluded: dropout masks stay stored, so grads stay
+    consistent (re-drawing the mask in backward would corrupt them)."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="tanh")
+        c1 = h
+        h = layers.dropout(h, dropout_prob=0.5)
+        h = layers.fc(h, size=16, act="tanh")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.RecomputeOptimizer(fluid.optimizer.SGD(0.1))
+        opt._set_checkpoints([c1])
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        # no dropout op in the recompute chain
+        for op in prog.global_block().ops:
+            if op.type == "dropout":
+                assert not any("@RECOMPUTE" in n
+                               for n in op.output_arg_names())
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=3)
+        rng = np.random.RandomState(1)
+        last = None
+        for _ in range(8):
+            xv = rng.rand(8, 16).astype(np.float32)
+            yv = xv.sum(1, keepdims=True).astype(np.float32)
+            last, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        assert np.isfinite(float(last))
+
+
+def test_backward_entry_point_applies_recompute():
+    """The fluid-style backward()/apply_gradients flow must also remat."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        loss, ckpts = _build()
+        opt = fluid.optimizer.RecomputeOptimizer(fluid.optimizer.SGD(0.1))
+        opt._set_checkpoints(ckpts)
+        pg = opt.backward(loss)
+        opt.apply_gradients(pg)
+        prog = fluid.default_main_program()
+        types = [op.type for op in prog.global_block().ops]
+        assert "optimization_barrier" in types
+        # weights are NOT fenced (barriers only on stored activations)
+        for op in prog.global_block().ops:
+            if op.type == "optimization_barrier":
+                src = op.input("X")[0]
+                v = prog.global_block().vars.get(src)
+                assert v is None or not v.persistable
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        xv = np.random.rand(4, 16).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        lv, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        assert np.isfinite(float(lv))
