@@ -3,6 +3,7 @@
 
 from . import control_flow, detection, io, learning_rate_scheduler  # noqa
 from . import distributions  # noqa
+from .compat import *  # noqa
 from . import math_ops, metric_op, nn, sequence, tensor  # noqa
 from .control_flow import (DynamicRNN, IfElse, Print, StaticRNN,  # noqa
                            Switch, While, array_length, array_read,

@@ -1,0 +1,535 @@
+"""Remaining Appendix-B layer wrappers (ref ``python/paddle/fluid/layers``
+``__all__`` lists — SURVEY Appendix B).  Thin LayerHelper shims over
+already-registered lowerings; recurrent layers create their parameters
+exactly as the reference layers do."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework.core import Variable
+
+__all__ = [
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
+    "lstm", "chunk_eval", "conv3d", "pool3d", "adaptive_pool3d",
+    "conv3d_transpose", "lod_reset", "lod_append", "image_resize_short",
+    "sequence_scatter", "affine_grid", "sequence_topk_avg_pooling",
+    "continuous_value_model", "deformable_conv", "deformable_roi_pooling",
+    "match_matrix_tensor", "filter_by_instag", "var_conv_2d",
+    "reorder_lod_tensor_by_rank", "read_file", "double_buffer", "load",
+    "py_reader", "create_py_reader_by_data",
+    "atan", "tanh_shrink", "acos", "asin", "softshrink", "hard_shrink",
+    "cumsum",
+]
+
+
+def _tuple_n(v, n):
+    """int-or-sequence attr → list of n ints (the 3-D _pair)."""
+    return [v] * n if isinstance(v, int) else list(v)
+
+
+def _channel_bias(helper, out, num_filters, bias_attr):
+    """Per-output-channel conv bias, broadcast on axis 1 (the conv2d layer
+    convention)."""
+    if bias_attr is False:
+        return out
+    b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                dtype=out.dtype, is_bias=True)
+    pre = helper.create_variable_for_type_inference(out.dtype)
+    helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                     outputs={"Out": [pre]}, attrs={"axis": 1})
+    return pre
+
+
+def _simple(op_type, ins, outs=("Out",), attrs=None, dtype=None):
+    helper = LayerHelper(op_type)
+    first = next(v[0] for v in ins.values() if v)
+    out_vars = [helper.create_variable_for_type_inference(
+        dtype or getattr(first, "dtype", "float32")) for _ in outs]
+    helper.append_op(op_type, inputs=ins,
+                     outputs={o: [v] for o, v in zip(outs, out_vars)},
+                     attrs=attrs or {})
+    return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+
+# -- recurrent layers (ref layers/nn.py dynamic_lstm:*, dynamic_gru:*) -------
+
+def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
+                 use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """ref layers/nn.py dynamic_lstm: input is the 4d pre-projection
+    [b, t, 4d]; creates the recurrent weight + bias."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(param_attr, shape=[d, 4 * d], dtype=dtype)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(bias_attr, shape=[1, bias_size],
+                                dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lstm", inputs={"Input": [input], "Weight": [w], "Bias": [b]},
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """ref layers/nn.py dynamic_lstmp — LSTM with projection."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, 4 * d],
+                                dtype=dtype)
+    pw = helper.create_parameter(param_attr, shape=[d, proj_size],
+                                 dtype=dtype)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    b = helper.create_parameter(bias_attr, shape=[1, bias_size],
+                                dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lstmp", inputs={"Input": [input], "Weight": [w],
+                         "ProjWeight": [pw], "Bias": [b]},
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                dtype="float32", name=None):
+    """ref layers/nn.py dynamic_gru: input [b, t, 3d] pre-projection."""
+    helper = LayerHelper("dynamic_gru", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size
+    w = helper.create_parameter(param_attr, shape=[d, 3 * d], dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * d], dtype=dtype,
+                                is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    helper.append_op(
+        "gru", inputs=ins, outputs={"Hidden": [hidden]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation,
+               "origin_mode": origin_mode})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """ref layers/nn.py gru_unit — one GRU step."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    d = size // 3
+    w = helper.create_parameter(param_attr, shape=[d, 3 * d],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * d],
+                                dtype=input.dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    updated = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Gate": [gate], "ResetHiddenPrev": [reset_h],
+                 "Hidden": [updated]},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation,
+               "origin_mode": origin_mode})
+    return updated, reset_h, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """ref layers/nn.py lstm_unit: fc([x, h]) then one LSTM cell step."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    d = int(hidden_t_prev.shape[-1])
+    cat = _tensor.concat([x_t, hidden_t_prev], axis=1)
+    gates = _nn.fc(cat, size=4 * d, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", name=name)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         param_attr=None, bias_attr=None, dtype="float32", seed=-1):
+    """ref layers/nn.py lstm (the cudnn_lstm wrapper)."""
+    helper = LayerHelper("lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d_in = int(input.shape[-1])
+    weight_size = 4 * hidden_size * (d_in + hidden_size + 2)
+    w = helper.create_parameter(param_attr, shape=[weight_size],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "cudnn_lstm",
+        inputs={"Input": [input], "W": [w], "InitH": [init_h],
+                "InitC": [init_c]},
+        outputs={"Out": [out], "last_h": [last_h], "last_c": [last_c]},
+        attrs={"hidden_size": hidden_size, "num_layers": num_layers,
+               "is_bidirec": is_bidirec, "dropout_prob": dropout_prob,
+               "is_test": is_test})
+    return out, last_h, last_c
+
+
+# -- misc nn -----------------------------------------------------------------
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """ref layers/nn.py chunk_eval → chunk_eval op."""
+    helper = LayerHelper("chunk_eval")
+    outs = ["Precision", "Recall", "F1-Score", "NumInferChunks",
+            "NumLabelChunks", "NumCorrectChunks"]
+    out_vars = [helper.create_variable_for_type_inference("float32")
+                for _ in outs]
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        "chunk_eval", inputs=ins,
+        outputs={o: [v] for o, v in zip(outs, out_vars)},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return tuple(out_vars)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    k = _tuple_n(filter_size, 3)
+    c = int(input.shape[1])
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // groups] + list(k),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _tuple_n(stride, 3),
+               "paddings": _tuple_n(padding, 3),
+               "dilations": _tuple_n(dilation, 3), "groups": groups})
+    pre = _channel_bias(helper, out, num_filters, bias_attr)
+    return helper.append_activation(pre)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None):
+    return _simple("pool3d", {"X": [input]},
+                   attrs={"ksize": _tuple_n(pool_size, 3),
+                          "pooling_type": pool_type,
+                          "strides": _tuple_n(pool_stride, 3),
+                          "paddings": _tuple_n(pool_padding, 3),
+                          "global_pooling": global_pooling,
+                          "ceil_mode": ceil_mode})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    return _simple("pool3d", {"X": [input]},
+                   attrs={"ksize": [pool_size] * 3
+                          if isinstance(pool_size, int) else list(pool_size),
+                          "pooling_type": pool_type, "strides": [1, 1, 1],
+                          "paddings": [0, 0, 0], "adaptive": True,
+                          "global_pooling": False})
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    st = _tuple_n(stride, 3)
+    pd = _tuple_n(padding, 3)
+    dl = _tuple_n(dilation, 3)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose needs filter_size or output_size")
+        out_sz = _tuple_n(output_size, 3)
+        # k from out = (in-1)*s - 2p + d*(k-1) + 1 (ref conv2d_transpose
+        # filter inference)
+        k = [(out_sz[i] - (int(input.shape[2 + i]) - 1) * st[i] +
+              2 * pd[i] - 1) // dl[i] + 1 for i in range(3)]
+    else:
+        k = _tuple_n(filter_size, 3)
+    c = int(input.shape[1])
+    w = helper.create_parameter(
+        param_attr, shape=[c, num_filters // groups] + list(k),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups})
+    pre = _channel_bias(helper, out, num_filters, bias_attr)
+    return helper.append_activation(pre)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    ins = {"X": [x]}
+    if y is not None:
+        ins["Y"] = [y]
+    return _simple("lod_reset", ins,
+                   attrs={"target_lod": target_lod or []})
+
+
+def lod_append(x, level):
+    """Dense sequences carry lengths separately — values pass through."""
+    return lod_reset(x)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """ref layers/nn.py image_resize_short: scale so the short side hits
+    ``out_short_len``."""
+    from . import nn as _nn
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    scale = out_short_len / float(short)
+    return _nn.image_resize(input,
+                            out_shape=[int(round(h * scale)),
+                                       int(round(w * scale))],
+                            resample=resample)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    return _simple("sequence_scatter",
+                   {"X": [input], "Ids": [index], "Updates": [updates]})
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    if isinstance(out_shape, Variable):
+        return _simple("affine_grid", {"Theta": [theta],
+                                       "OutputShape": [out_shape]})
+    return _simple("affine_grid", {"Theta": [theta]},
+                   attrs={"output_shape": list(out_shape)})
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pos = helper.create_variable_for_type_inference("int32")
+    helper.append_op("sequence_topk_avg_pooling", inputs={"X": [input]},
+                     outputs={"Out": [out], "pos": [pos]},
+                     attrs={"topks": list(topks),
+                            "channel_num": channel_num})
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _simple("cvm", {"X": [input], "CVM": [cvm]},
+                   outs=("Y",), attrs={"use_cvm": use_cvm})
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """ref layers/nn.py deformable_conv (v2 modulated / v1)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    k = _tuple_n(filter_size, 2)
+    c = int(input.shape[1])
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // groups] + list(k),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = [mask]
+    helper.append_op(
+        op_type, inputs=ins, outputs={"Output": [out]},
+        attrs={"strides": _tuple_n(stride, 2),
+               "paddings": _tuple_n(padding, 2),
+               "dilations": _tuple_n(dilation, 2), "groups": groups,
+               "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    helper = LayerHelper("deformable_roi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    top = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input], "ROIs": [rois]}
+    if not no_trans:
+        ins["Trans"] = [trans]
+    helper.append_op(
+        "deformable_psroi_pooling", inputs=ins,
+        outputs={"Output": [out], "TopCount": [top]},
+        attrs={"spatial_scale": spatial_scale,
+               "output_dim": int(input.shape[1]) //
+               (group_size[0] * group_size[1])
+               if position_sensitive else int(input.shape[1]),
+               "group_size": list(group_size),
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "part_size": list(part_size) if part_size
+               else [pooled_height, pooled_width],
+               "trans_std": trans_std})
+    return out
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    helper = LayerHelper("match_matrix_tensor", param_attr=param_attr,
+                         act=act, name=name)
+    d = int(x.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[d, channel_num, d],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("match_matrix_tensor",
+                     inputs={"X": [x], "Y": [y], "W": [w]},
+                     outputs={"Out": [out], "Tmp": [tmp]},
+                     attrs={"dim_t": channel_num})
+    return helper.append_activation(out), tmp
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference("float32")
+    index_map = helper.create_variable_for_type_inference("int64")
+    helper.append_op("filter_by_instag",
+                     inputs={"Ins": [ins], "Ins_tag": [ins_tag],
+                             "Filter_tag": [filter_tag]},
+                     outputs={"Out": [out], "LossWeight": [loss_weight],
+                              "IndexMap": [index_map]},
+                     attrs={"is_lod": is_lod})
+    return out, loss_weight
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    helper = LayerHelper("var_conv_2d", param_attr=param_attr, act=act,
+                         name=name)
+    k = _tuple_n(filter_size, 2)
+    w = helper.create_parameter(
+        param_attr, shape=[output_channel, input_channel] + list(k),
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("var_conv_2d", inputs={"X": [input], "W": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"strides": _tuple_n(stride, 2),
+                            "paddings": [k[0] // 2, k[1] // 2]})
+    return helper.append_activation(out)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return _simple("reorder_lod_tensor_by_rank",
+                   {"X": [x], "RankTable": [rank_table]})
+
+
+# -- io shims (ref layers/io.py) ---------------------------------------------
+
+def read_file(reader):
+    """Dense pipelines read through DataLoader/PyReader; pass-through."""
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is the DataLoader's job under XLA; pass-through."""
+    return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref layers/io.py py_reader: creates data vars for the given
+    shapes/dtypes and binds a PyReader to them."""
+    from ..data.py_reader import PyReader
+    from . import io as _io
+    feed_list = [
+        _io.data(f"{name or 'py_reader'}_in_{i}",
+                 shape=list(shape)[1:], dtype=dtype)
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes))]
+    return PyReader(feed_list=feed_list, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..data.py_reader import PyReader
+    return PyReader(feed_list=feed_list, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """ref layers/io.py load → host-side value load into the scope var."""
+    from ..framework.scope import global_scope
+    arr = np.load(file_path) if file_path.endswith(".npy") else \
+        np.fromfile(file_path, dtype="float32")
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    global_scope().set_var(out.name if hasattr(out, "name") else out, arr)
+    return out
+
+
+# -- autogen-style unary activations (ref layers/ops.py) ---------------------
+
+def _unary(op_type):
+    def f(x, name=None):
+        return _simple(op_type, {"X": [x]})
+    f.__name__ = op_type
+    f.__doc__ = f"ref layers/ops.py {op_type} (autogen from OpProto)."
+    return f
+
+
+atan = _unary("atan")
+tanh_shrink = _unary("tanh_shrink")
+acos = _unary("acos")
+asin = _unary("asin")
+
+
+def softshrink(x, alpha=0.5, name=None):
+    return _simple("softshrink", {"X": [x]}, attrs={"lambda": alpha})
+
+
+def hard_shrink(x, threshold=0.5, name=None):
+    return _simple("hard_shrink", {"X": [x]}, attrs={"threshold": threshold})
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    return _simple("cumsum", {"X": [x]},
+                   attrs={"axis": -1 if axis is None else axis,
+                          "flatten": axis is None, "exclusive": exclusive,
+                          "reverse": reverse})

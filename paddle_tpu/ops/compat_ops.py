@@ -826,15 +826,13 @@ def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
 @register_op("conv3d_transpose")
 def _conv3d_transpose(ctx, ins, attrs):
     """ref operators/conv_transpose_op.cc (3-D)."""
+    from .nn_ops import _conv_transpose_nd
     x, w = X(ins, "Input"), X(ins, "Filter")
-    strides = attrs.get("strides", [1, 1, 1])
-    pads = attrs.get("paddings", [0, 0, 0])
-    dils = attrs.get("dilations", [1, 1, 1])
-    out = jax.lax.conv_transpose(
-        x, w, strides=tuple(strides),
-        padding=[(p, p) for p in pads], rhs_dilation=tuple(dils),
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
-        transpose_kernel=True)
+    out = _conv_transpose_nd(
+        x, w, list(attrs.get("strides", [1, 1, 1])),
+        list(attrs.get("paddings", [0, 0, 0])),
+        list(attrs.get("dilations", [1, 1, 1])),
+        attrs.get("groups", 1) or 1, 3)
     return {"Output": [out]}
 
 
@@ -957,11 +955,17 @@ def _lstmp(ctx, ins, attrs):
     act = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh}[
         attrs.get("cell_activation", "tanh")]
     proj_act = attrs.get("proj_activation", "tanh")
+    use_peepholes = attrs.get("use_peepholes", False)
     b, t, d4 = x.shape
     d = d4 // 4
     p = proj_w.shape[1]
+    w_ic = w_fc = w_oc = None
     if bias is not None:
-        x = x + bias.reshape(-1)[:4 * d]
+        flat_b = bias.reshape(-1)
+        x = x + flat_b[:4 * d]
+        if use_peepholes:
+            peep = flat_b[4 * d:]
+            w_ic, w_fc, w_oc = peep[:d], peep[d:2 * d], peep[2 * d:3 * d]
     if h0 is None:
         h0 = jnp.zeros((b, p), x.dtype)
     if c0 is None:
@@ -971,7 +975,12 @@ def _lstmp(ctx, ins, attrs):
         h, c = carry
         gates = xt + h @ w
         gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
         c_new = gate_act(gf) * c + gate_act(gi) * act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
         raw_h = gate_act(go) * act(c_new)
         h_new = raw_h @ proj_w
         if proj_act == "tanh":

@@ -73,6 +73,28 @@ def _conv3d(ctx, ins, attrs):
     return {"Output": [out]}
 
 
+def _conv_transpose_nd(x, w, strides, pads, dils, groups, nd):
+    """Exact transposed conv (== vjp of the forward conv wrt its input):
+    input-dilate by stride, convolve with the spatially-flipped, IO-swapped
+    kernel.  w: [in, out/groups, k...] (the fluid filter layout)."""
+    ci = w.shape[0]
+    og = w.shape[1]
+    k = w.shape[2:]
+    spatial = tuple(range(2, 2 + nd))
+    wf = jnp.flip(w, axis=spatial)
+    # [Ci, Co/g, ...] → grouped IO swap → [Co, Ci/g, ...]
+    wf = wf.reshape((groups, ci // groups, og) + k)
+    wf = jnp.swapaxes(wf, 1, 2).reshape((groups * og, ci // groups) + k)
+    pad_cfg = [(dils[i] * (k[i] - 1) - pads[i],
+                dils[i] * (k[i] - 1) - pads[i]) for i in range(nd)]
+    dn = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    return jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1,) * nd, padding=pad_cfg,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dils),
+        feature_group_count=groups, dimension_numbers=dn)
+
+
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
     x, w = X(ins, "Input"), X(ins, "Filter")  # w: [in, out/groups, kh, kw]
@@ -80,12 +102,7 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dils = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dils,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
+    out = _conv_transpose_nd(x, w, strides, pads, dils, groups, 2)
     return {"Output": [out]}
 
 

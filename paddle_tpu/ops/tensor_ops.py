@@ -434,6 +434,8 @@ def _increment(ctx, ins, attrs):
 @register_op("cumsum")
 def _cumsum(ctx, ins, attrs):
     x = X(ins, "X")
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
     axis = attrs.get("axis", -1)
     if attrs.get("reverse", False):
         x = jnp.flip(x, axis)

@@ -1,0 +1,193 @@
+"""Appendix-B layer API surface lock + smoke tests for the compat layers
+(ref SURVEY Appendix B __all__ lists)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+APPENDIX_B = {
+    "nn": "fc center_loss embedding dynamic_lstm dynamic_lstmp dynamic_gru "
+          "gru_unit linear_chain_crf crf_decoding cos_sim cross_entropy "
+          "bpr_loss square_error_cost chunk_eval sequence_conv conv2d conv3d "
+          "sequence_pool sequence_softmax softmax pool2d pool3d "
+          "adaptive_pool2d adaptive_pool3d batch_norm data_norm "
+          "beam_search_decode conv2d_transpose conv3d_transpose "
+          "sequence_expand sequence_expand_as sequence_pad sequence_unpad "
+          "lstm_unit reduce_sum reduce_mean reduce_max reduce_min "
+          "reduce_prod reduce_all reduce_any sequence_first_step "
+          "sequence_last_step sequence_slice dropout split "
+          "ctc_greedy_decoder edit_distance l2_normalize matmul topk "
+          "warpctc sequence_reshape transpose im2sequence nce "
+          "sampled_softmax_with_cross_entropy hsigmoid beam_search row_conv "
+          "multiplex layer_norm group_norm spectral_norm "
+          "softmax_with_cross_entropy smooth_l1 one_hot "
+          "autoincreased_step_counter reshape squeeze unsqueeze lod_reset "
+          "lod_append lrn pad pad_constant_like label_smooth roi_pool "
+          "roi_align dice_loss image_resize image_resize_short "
+          "resize_bilinear resize_trilinear resize_nearest gather gather_nd "
+          "scatter scatter_nd_add scatter_nd sequence_scatter random_crop "
+          "mean_iou relu selu log crop crop_tensor rank_loss "
+          "margin_rank_loss elu relu6 pow stanh hard_sigmoid swish prelu "
+          "brelu leaky_relu soft_relu flatten sequence_mask stack pad2d "
+          "unstack sequence_enumerate unique unique_with_counts expand "
+          "sequence_concat scale elementwise_add elementwise_div "
+          "elementwise_sub elementwise_mul elementwise_max elementwise_min "
+          "elementwise_pow elementwise_mod elementwise_floordiv "
+          "uniform_random_batch_size_like gaussian_random sampling_id "
+          "gaussian_random_batch_size_like sum slice strided_slice shape "
+          "rank size logical_and logical_or logical_xor logical_not clip "
+          "clip_by_norm mean mul sigmoid_cross_entropy_with_logits maxout "
+          "space_to_depth affine_grid sequence_reverse "
+          "sequence_topk_avg_pooling affine_channel similarity_focus hash "
+          "grid_sampler log_loss add_position_encoding "
+          "bilinear_tensor_product merge_selected_rows "
+          "get_tensor_from_selected_rows lstm shuffle_channel "
+          "temporal_shift py_func psroi_pool prroi_pool "
+          "teacher_student_sigmoid_loss huber_loss kldiv_loss tree_conv "
+          "npair_loss pixel_shuffle fsp_matrix continuous_value_model where "
+          "sign deformable_conv unfold deformable_roi_pooling "
+          "match_matrix_tensor filter_by_instag var_conv_2d shard_index "
+          "hard_swish",
+    "tensor": "create_tensor create_parameter create_global_var cast "
+              "tensor_array_to_tensor concat sums assign "
+              "fill_constant_batch_size_like fill_constant argmin argmax "
+              "argsort ones zeros reverse has_inf has_nan isfinite range "
+              "linspace zeros_like ones_like diag eye",
+    "control_flow": "While Switch increment array_write create_array "
+                    "less_than less_equal greater_than greater_equal equal "
+                    "not_equal array_read array_length IfElse DynamicRNN "
+                    "StaticRNN reorder_lod_tensor_by_rank Print is_empty",
+    "io": "data read_file double_buffer py_reader create_py_reader_by_data "
+          "load",
+    "ops": "sigmoid logsigmoid exp tanh atan tanh_shrink sqrt rsqrt abs "
+           "ceil floor cos acos asin sin round reciprocal square softplus "
+           "softsign softshrink hard_shrink cumsum thresholded_relu",
+    "detection": "prior_box density_prior_box multi_box_head "
+                 "bipartite_match target_assign detection_output ssd_loss "
+                 "rpn_target_assign retinanet_target_assign "
+                 "sigmoid_focal_loss anchor_generator "
+                 "roi_perspective_transform generate_proposal_labels "
+                 "generate_proposals generate_mask_labels iou_similarity "
+                 "box_coder polygon_box_transform yolov3_loss yolo_box "
+                 "box_clip multiclass_nms multiclass_nms2 "
+                 "retinanet_detection_output distribute_fpn_proposals "
+                 "box_decoder_and_assign collect_fpn_proposals",
+    "lr": "exponential_decay natural_exp_decay inverse_time_decay "
+          "polynomial_decay piecewise_decay noam_decay cosine_decay "
+          "linear_lr_warmup",
+    "metric": "accuracy auc",
+}
+
+
+def test_appendix_b_surface_complete():
+    missing = [f"{m}.{n}" for m, names in APPENDIX_B.items()
+               for n in names.split() if not hasattr(layers, n)]
+    assert not missing, f"Appendix B layers missing: {missing}"
+    from paddle_tpu.layers import distributions as D
+    for n in ("Uniform", "Normal", "Categorical", "MultivariateNormalDiag"):
+        assert hasattr(D, n)
+
+
+def test_dynamic_rnn_layers_execute():
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x4 = layers.data("x4", shape=[5, 32], dtype="float32")  # [b,t,4d]
+        h, c = layers.dynamic_lstm(x4, size=32)
+        x3 = layers.data("x3", shape=[5, 24], dtype="float32")  # [b,t,3d]
+        g = layers.dynamic_gru(x3, size=8)
+        p, pc = layers.dynamic_lstmp(x4, size=32, proj_size=6)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        hv, gv, pv = exe.run(
+            feed={"x4": rng.rand(2, 5, 32).astype(np.float32),
+                  "x3": rng.rand(2, 5, 24).astype(np.float32)},
+            fetch_list=[h, g, p])
+        assert hv.shape == (2, 5, 8)
+        assert gv.shape == (2, 5, 8)
+        assert pv.shape == (2, 5, 6)
+        assert np.isfinite(hv).all()
+
+
+def test_conv3d_and_pool3d_execute():
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        vol = layers.data("vol", shape=[2, 8, 8, 8], dtype="float32")
+        c = layers.conv3d(vol, num_filters=4, filter_size=3, padding=1)
+        p = layers.pool3d(c, pool_size=2, pool_stride=2)
+        t = layers.conv3d_transpose(p, num_filters=2, filter_size=2,
+                                    stride=2)
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        tv, = exe.run(feed={"vol": np.random.rand(1, 2, 8, 8, 8)
+                            .astype(np.float32)}, fetch_list=[t])
+        assert tv.shape == (1, 2, 8, 8, 8)
+
+
+def test_unary_compat_ops_numeric():
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        outs = [layers.atan(x), layers.cumsum(x, axis=1),
+                layers.softshrink(x, alpha=0.5),
+                layers.hard_shrink(x, threshold=0.5)]
+        exe = Executor()
+        xv = np.array([[0.2, -0.7, 1.0, 0.4]], np.float32)
+        a, cs, ss, hs = exe.run(feed={"x": xv}, fetch_list=outs)
+        np.testing.assert_allclose(a, np.arctan(xv), rtol=1e-6)
+        np.testing.assert_allclose(cs, np.cumsum(xv, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            ss, np.sign(xv) * np.maximum(np.abs(xv) - 0.5, 0), rtol=1e-6)
+        np.testing.assert_allclose(hs, np.where(np.abs(xv) > 0.5, xv, 0),
+                                   rtol=1e-6)
+
+
+def test_conv2d_transpose_matches_vjp_reference():
+    """Transposed conv == vjp of the forward conv wrt its input."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.framework import registry
+
+    class Ctx:
+        amp = False
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 4, 8, 8), jnp.float32)
+    W = jnp.asarray(rng.rand(4, 3, 3, 3), jnp.float32)
+    s, p = 2, 1
+    out = registry.get_op_info("conv2d_transpose").lower(
+        Ctx(), {"Input": [x], "Filter": [W]},
+        {"strides": [s, s], "paddings": [p, p]})["Output"][0]
+
+    def fwd(y):
+        return jax.lax.conv_general_dilated(
+            y, W, (s, s), [(p, p), (p, p)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    y0 = jnp.zeros((2, 3) + out.shape[2:])
+    assert fwd(y0).shape == x.shape
+    _, vjp = jax.vjp(fwd, y0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(vjp(x)[0]),
+                               atol=1e-4)
+
+
+def test_py_reader_and_conv3dt_output_size_and_cumsum_flatten():
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        r = layers.py_reader(capacity=4, shapes=[[-1, 2, 3]],
+                             dtypes=["float32"])
+        assert r is not None
+        vol = layers.data("v2", shape=[2, 4, 4, 4], dtype="float32")
+        t = layers.conv3d_transpose(vol, num_filters=3,
+                                    output_size=[8, 8, 8], stride=2)
+        x = layers.data("cx", shape=[3], dtype="float32")
+        flat = layers.cumsum(x)          # axis None → flattened
+        exe = Executor()
+        exe.run(fluid.default_startup_program())
+        tv, fv = exe.run(
+            feed={"v2": np.random.rand(1, 2, 4, 4, 4).astype(np.float32),
+                  "cx": np.array([[1, 2, 3], [4, 5, 6]], np.float32)},
+            fetch_list=[t, flat])
+        assert tv.shape == (1, 3, 8, 8, 8)
+        np.testing.assert_allclose(fv, [1, 3, 6, 10, 15, 21], rtol=1e-6)
