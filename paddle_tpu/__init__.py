@@ -22,6 +22,7 @@ from . import optimizer  # noqa
 from . import evaluator, metrics, nets  # noqa
 from . import contrib  # noqa
 from . import checkpoint, debugger, install_check  # noqa
+from . import device_worker, trainer_desc, trainer_factory  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
 from . import native  # noqa

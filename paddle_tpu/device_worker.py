@@ -1,0 +1,54 @@
+"""Device workers (ref ``python/paddle/fluid/device_worker.py:71,96,189``
+DeviceWorker/Hogwild/DownpourSGD/Section; C++ counterparts
+``framework/device_worker.h:103,175,262``).
+
+On TPU the per-thread Hogwild loop collapses into the jitted block the
+executor runs (XLA owns intra-step parallelism), so these classes carry
+configuration, not threads: Hogwild configures the plain dataset loop,
+DownpourSGD the PS push/pull plane, Section the pipeline engine."""
+
+from __future__ import annotations
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "Section"]
+
+
+class DeviceWorker:
+    """ref device_worker.py DeviceWorker base."""
+
+    def __init__(self):
+        self._program = None
+        self._infer = False
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _set_infer(self, infer):
+        self._infer = bool(infer)
+
+
+class Hogwild(DeviceWorker):
+    """ref device_worker.py Hogwild — the default dataset-loop worker."""
+
+
+class DownpourSGD(DeviceWorker):
+    """ref device_worker.py DownpourSGD — PS sparse/dense push-pull worker;
+    the transpiled send/recv/distributed_lookup_table ops carry the actual
+    communication (paddle_tpu.distributed.ps)."""
+
+    def __init__(self):
+        super().__init__()
+        self.sparse_tables = []
+        self.dense_tables = []
+
+
+class Section(DeviceWorker):
+    """ref device_worker.py Section — pipeline-stage worker; maps to
+    paddle_tpu.parallel.pipeline's stage executors."""
+
+    def __init__(self, program_list=None, queue_size=30,
+                 sync_steps=1, start_cpu_core_id=0):
+        super().__init__()
+        self.program_list = program_list or []
+        self.queue_size = queue_size
+        self.sync_steps = sync_steps
+        self.start_cpu_core_id = start_cpu_core_id

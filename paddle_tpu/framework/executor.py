@@ -136,6 +136,9 @@ def _run_generic_grad(ctx, block: Block, op: Operator, state: _ExecState):
             ins[slot] = [state.values.get(n) for n in names]
         else:
             ins[slot] = [state.read(block, n) for n in names]
+    # NO amp cast here: generic_grad_lower casts INSIDE its vjp closure,
+    # which keeps master-weight grads f32 (a pre-cast would differentiate
+    # wrt the bf16 copy and round every weight grad)
     outs = registry.generic_grad_lower(ctx, ins, op.attrs)
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
@@ -412,15 +415,21 @@ class Executor:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           trainer_desc=None):
         """ref ``framework/executor.cc:143`` RunFromDataset + MultiTrainer:
         drain the dataset's slot batches through the training program.
         Threaded file parsing happens in the native data feed; the device
         step itself is one XLA computation, so the reference's
         thread-per-device Hogwild loop maps to a single sequential feed
-        loop here."""
+        loop here.  A ``TrainerDesc`` (trainer_factory API) supplies
+        fetch/print config when passed."""
         if dataset is None:
             raise ValueError("dataset is required")
+        if trainer_desc is not None:
+            fetch_list = fetch_list or trainer_desc._fetch_vars
+            fetch_info = fetch_info or trainer_desc._fetch_info
+            print_period = trainer_desc._print_period
         fetch_list = fetch_list or []
         results = None
         for i, feed in enumerate(dataset):

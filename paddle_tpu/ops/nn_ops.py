@@ -42,12 +42,14 @@ def _conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dils = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    # no preferred_element_type=f32: this jax version's conv transpose
+    # (vjp) rule emits a mixed-dtype conv for the f32-out/bf16-in form,
+    # and on TPU the MXU accumulates bf16 convs in f32 internally anyway
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dils, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return {"Output": [out.astype(x.dtype)]}
 
 

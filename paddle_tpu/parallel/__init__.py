@@ -1,3 +1,4 @@
+from .mesh import hierarchical_allreduce, make_hierarchical_mesh  # noqa
 from .mesh import (current_mesh, data_parallel_mesh, make_mesh, set_mesh,  # noqa
                    sharding_for)
 from .pipeline import (PipelineEngine, PipelineOptimizer,  # noqa

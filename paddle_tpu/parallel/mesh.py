@@ -77,3 +77,27 @@ def _axis_in(mesh: Mesh, axis) -> bool:
     if isinstance(axis, (tuple, list)):
         return all(a in mesh.axis_names for a in axis)
     return axis in mesh.axis_names
+
+
+def make_hierarchical_mesh(inter: int, intra: int, devices=None) -> Mesh:
+    """2-level data-parallel mesh (ref SURVEY §2.5 hierarchical allreduce:
+    ``NCCLCommunicator::InitHierarchicalCtxs`` inter/intra-node rings).
+
+    On TPU the two levels are DCN (between slices/hosts) and ICI (inside a
+    slice): build a ``("dcn", "ici")`` mesh and shard the batch over BOTH
+    axes; XLA lowers the gradient psum into an ICI-local reduce followed by
+    a DCN exchange — the exact hierarchical-allreduce structure the
+    reference hand-builds, chosen automatically from the mesh topology.
+    ``hierarchical_allreduce`` exposes the explicit two-stage form for
+    shard_map code."""
+    return make_mesh({"dcn": inter, "ici": intra}, devices)
+
+
+def hierarchical_allreduce(x, inter_axis: str = "dcn",
+                           intra_axis: str = "ici"):
+    """Explicit two-stage allreduce over a hierarchical mesh (inside
+    shard_map): reduce over the fast intra axis first, then the slow inter
+    axis — same result as one psum over both, with the collective order
+    pinned (ref nccl_helper.h:246 hierarchical inter/exter comms)."""
+    from jax import lax
+    return lax.psum(lax.psum(x, intra_axis), inter_axis)

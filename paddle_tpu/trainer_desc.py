@@ -1,0 +1,70 @@
+"""Trainer descriptors (ref ``python/paddle/fluid/trainer_desc.py:20,118,
+139,158`` TrainerDesc/MultiTrainer/DistMultiTrainer/PipelineTrainer and
+``framework/trainer_desc.proto``).
+
+The reference serializes these to protobuf consumed by the C++ trainer
+runtime; here the descriptor carries the same knobs as plain attributes.
+``Executor.train_from_dataset(..., trainer_desc=...)`` consumes the
+fetch/print configuration; thread_num/device_worker are accepted for API
+parity (the XLA block-compiler owns intra-step parallelism, so there is no
+thread-per-device loop to configure)."""
+
+from __future__ import annotations
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer"]
+
+
+class TrainerDesc:
+    """ref trainer_desc.py:20 — thread count, fetch config, device worker."""
+
+    def __init__(self):
+        self._thread_num = 1
+        self._device_worker = None
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+        self._program = None
+        self._infer = False
+        self.proto_desc = self          # parity: .proto_desc attr exists
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_device_worker(self, device_worker):
+        self._device_worker = device_worker
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = list(fetch_vars or [])
+        self._fetch_info = list(fetch_info or [])
+        self._print_period = int(print_period)
+
+    def set_program(self, program):
+        self._program = program
+
+    def set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _desc(self):
+        return {
+            "class": type(self).__name__,
+            "thread_num": self._thread_num,
+            "device_worker": type(self._device_worker).__name__
+            if self._device_worker else None,
+            "fetch_vars": [getattr(v, "name", v) for v in self._fetch_vars],
+            "fetch_info": list(self._fetch_info),
+            "print_period": self._print_period,
+            "infer": self._infer,
+        }
+
+
+class MultiTrainer(TrainerDesc):
+    """ref trainer_desc.py:118 — thread × HogwildWorker trainer."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """ref trainer_desc.py:139 — PS trainer with background dense pull."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """ref trainer_desc.py:158 — section-pipeline trainer."""

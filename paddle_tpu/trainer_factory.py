@@ -1,0 +1,37 @@
+"""TrainerFactory (ref ``python/paddle/fluid/trainer_factory.py:21``):
+build a trainer descriptor + device worker pair from an optimizer's
+attributes, exactly the reference's string-dispatch protocol."""
+
+from __future__ import annotations
+
+from .device_worker import DeviceWorker, DownpourSGD, Hogwild, Section
+from .trainer_desc import (DistMultiTrainer, MultiTrainer, PipelineTrainer,
+                           TrainerDesc)
+
+__all__ = ["TrainerFactory"]
+
+_TRAINERS = {c.__name__: c for c in
+             (TrainerDesc, MultiTrainer, DistMultiTrainer, PipelineTrainer)}
+_WORKERS = {c.__name__: c for c in
+            (DeviceWorker, Hogwild, DownpourSGD, Section)}
+
+
+class TrainerFactory:
+    def _create_trainer(self, opt_info=None):
+        trainer_name = "MultiTrainer"
+        worker_name = "Hogwild"
+        if opt_info:
+            trainer_name = opt_info.get("trainer", trainer_name)
+            worker_name = opt_info.get("device_worker", worker_name)
+        trainer = _TRAINERS[trainer_name]()
+        worker = _WORKERS[worker_name]()
+        trainer.set_device_worker(worker)
+        if opt_info:
+            if "thread_num" in opt_info:
+                trainer.set_thread(opt_info["thread_num"])
+            if "fetch_var_names" in opt_info:
+                trainer.set_fetch_var_and_info(
+                    opt_info.get("fetch_var_names"),
+                    opt_info.get("fetch_info"),
+                    opt_info.get("print_period", 100))
+        return trainer

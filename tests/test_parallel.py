@@ -120,3 +120,40 @@ def test_dp_actually_shards_batch():
         assert out.shape == (16, 2)
         # the fc ran under the mesh: its output sharding spans 8 devices
         assert len(out.sharding.device_set) == 8
+
+
+def test_hierarchical_mesh_and_allreduce():
+    """2-level dcn×ici mesh: hierarchical psum == flat psum (ref
+    NCCLCommunicator hierarchical allreduce semantics)."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel import (hierarchical_allreduce,
+                                     make_hierarchical_mesh)
+
+    mesh = make_hierarchical_mesh(2, 4)
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return hierarchical_allreduce(v)
+
+    out = shard_map(f, mesh=mesh, in_specs=P(("dcn", "ici")),
+                    out_specs=P())(x)
+    assert float(out[0]) == float(x.sum())
+
+
+def test_trainer_factory_api():
+    from paddle_tpu.trainer_factory import TrainerFactory
+    from paddle_tpu.trainer_desc import DistMultiTrainer
+    from paddle_tpu.device_worker import DownpourSGD
+    t = TrainerFactory()._create_trainer(
+        {"trainer": "DistMultiTrainer", "device_worker": "DownpourSGD",
+         "thread_num": 4, "fetch_var_names": ["loss"], "fetch_info": ["l"]})
+    assert isinstance(t, DistMultiTrainer)
+    assert isinstance(t._device_worker, DownpourSGD)
+    assert t._thread_num == 4
+    assert t._desc()["fetch_vars"] == ["loss"]
