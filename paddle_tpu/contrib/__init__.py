@@ -1,3 +1,3 @@
 """Contrib subpackages (ref ``python/paddle/fluid/contrib/``)."""
 
-from . import model_stat, op_frequence, slim  # noqa
+from . import memory_usage_calc, model_stat, op_frequence, slim  # noqa

@@ -1,0 +1,36 @@
+"""Estimate training memory (ref ``python/paddle/fluid/contrib/
+memory_usage_calc.py`` memory_usage): sums var sizes in a program for a
+given batch size.  Under the block compiler, actual peak memory is XLA's
+buffer assignment; this is the same build-time estimate the reference
+gives."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+DTYPE_SIZES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+               "float16": 2, "bfloat16": 2, "int16": 2, "uint8": 1,
+               "int8": 1, "bool": 1}
+
+
+def memory_usage(program, batch_size=1, unit="MB"):
+    """Returns (lower_bound, upper_bound, unit_str) like the reference
+    (upper adds a 1.5x slack for temporaries)."""
+    total = 0.0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        numel = 1
+        for d in var.shape:
+            numel *= batch_size if d in (-1, None) else d
+        total += numel * DTYPE_SIZES.get(var.dtype, 4)
+    units = {"B": 1, "KB": 2 ** 10, "MB": 2 ** 20, "GB": 2 ** 30}
+    key = str(unit).upper()
+    if key not in units:
+        raise ValueError(f"unit must be one of {sorted(units)}, got "
+                         f"{unit!r}")
+    div = units[key]
+    low = total / div
+    return low, low * 1.5, unit

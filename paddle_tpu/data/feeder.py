@@ -27,3 +27,12 @@ class DataFeeder:
                 batch = batch[..., None]
             out[name] = batch
         return out
+
+    def feed_parallel(self, iterable_list, num_places=None):
+        """ref data_feeder.py feed_parallel: one feed dict per device; the
+        GSPMD executor shards one global batch instead, so the per-device
+        dicts are concatenated into it."""
+        dicts = [self.feed(it) for it in iterable_list]
+        if len(dicts) == 1:
+            return dicts[0]
+        return {k: np.concatenate([d[k] for d in dicts]) for k in dicts[0]}

@@ -15,3 +15,4 @@ from .nn import (FC, NCE, BatchNorm, BilinearTensorProduct, Conv2D,  # noqa
                  SequenceConv, SpectralNorm, TreeConv)
 from .parallel import DataParallel, Env, ParallelEnv, prepare_context  # noqa
 from .tracer import Tracer, VarBase, default_tracer  # noqa
+from .base import BackwardStrategy  # noqa

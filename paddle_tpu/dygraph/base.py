@@ -68,3 +68,13 @@ class no_grad:
                     return func(*args, **kwargs)
             return wrapper
         return super().__new__(cls)
+
+
+class BackwardStrategy:
+    """ref dygraph/backward_strategy.py BackwardStrategy: sort_sum_gradient
+    toggles deterministic gradient accumulation order.  The vjp tape here
+    accumulates in fixed reverse-topological order already (deterministic),
+    so the knob is accepted and recorded for API parity."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False

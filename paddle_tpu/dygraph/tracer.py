@@ -84,9 +84,16 @@ class VarBase:
         return _trace_unary("cast", self, {"out_dtype": convert_dtype(dtype)})
 
     # -- autograd ------------------------------------------------------------
-    def backward(self, retain_graph: bool = False):
+    def backward(self, backward_strategy=None, retain_graph: bool = False):
         """Reverse sweep of the global tape from this var
-        (ref ``imperative/engine.cc`` Engine::Execute)."""
+        (ref ``imperative/engine.cc`` Engine::Execute).  Accepts fluid's
+        ``loss.backward(BackwardStrategy())`` call form — the strategy is
+        parity-only (accumulation order is already deterministic here) and
+        must not bind to retain_graph."""
+        from .base import BackwardStrategy
+        if backward_strategy is not None and \
+                not isinstance(backward_strategy, BackwardStrategy):
+            retain_graph = bool(backward_strategy)
         default_tracer().backward(self, retain_graph=retain_graph)
 
     def gradient(self) -> Optional[np.ndarray]:

@@ -9,6 +9,7 @@ the full graph), so the predictor is a thin shape-specializing cache around
 standing in for the reference's saved TensorRT engines.
 """
 
+from .api import NativePaddlePredictor  # noqa
 from .api import (AnalysisConfig, AnalysisPredictor, PaddlePredictor,  # noqa
                   PaddleTensor, ZeroCopyTensor, create_paddle_predictor,
                   export_stablehlo)

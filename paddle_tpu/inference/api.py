@@ -193,3 +193,14 @@ def export_stablehlo(program: Program, feed_names, fetch_names, params,
         with open(path, "w") as f:
             f.write(text)
     return text
+
+
+# ref inference/api/api_impl.h — the pass-free predictor; under the block
+# compiler both predictors share one engine, so Native aliases Analysis
+# with ir optimization off
+class NativePaddlePredictor(AnalysisPredictor):
+    def __init__(self, config: AnalysisConfig):
+        import copy
+        cfg = copy.copy(config)       # never mutate the caller's config
+        cfg.switch_ir_optim(False)
+        super().__init__(cfg)
