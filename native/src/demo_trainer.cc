@@ -1,0 +1,393 @@
+// Native train demo: load serialized Program IR and run the training loop
+// with NO Python at runtime (ref paddle/fluid/train/demo/demo_trainer.cc:
+// loads startup_program/main_program, feeds x/y tensors into the scope,
+// loops executor.Run printing the mean loss).
+//
+// The program files are the JSON serialization produced by
+// Program.serialize_to_string (paddle_tpu/framework/core.py); this binary
+// carries a minimal JSON reader, a name->tensor scope, and CPU
+// interpretations of the linear-regression op set — the C++-deployment
+// proof-of-capability the reference ships as its train demo.
+//
+// Build: make demo_trainer   (native/Makefile)
+// Run:   ./demo_trainer <dir-with-program-files>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <random>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal recursive-descent JSON reader (objects/arrays/strings/numbers/
+// bool/null) — just enough for the Program IR schema.
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json& at(const std::string& key) const {
+    auto it = obj.find(key);
+    if (it == obj.end()) throw std::runtime_error("missing key " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const { return obj.count(key) != 0; }
+  int64_t as_int() const { return static_cast<int64_t>(num); }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : s_(text) {}
+  Json Parse() {
+    Json v = Value();
+    Ws();
+    if (p_ != s_.size()) throw std::runtime_error("trailing json");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t p_ = 0;
+
+  void Ws() {
+    while (p_ < s_.size() && (s_[p_] == ' ' || s_[p_] == '\n' ||
+                              s_[p_] == '\t' || s_[p_] == '\r'))
+      ++p_;
+  }
+  char Peek() {
+    Ws();
+    if (p_ >= s_.size()) throw std::runtime_error("eof");
+    return s_[p_];
+  }
+  void Expect(char c) {
+    if (Peek() != c) throw std::runtime_error(std::string("expected ") + c);
+    ++p_;
+  }
+  Json Value() {
+    switch (Peek()) {
+      case '{': return Object();
+      case '[': return Array();
+      case '"': return String();
+      case 't': Lit("true"); return MakeBool(true);
+      case 'f': Lit("false"); return MakeBool(false);
+      case 'n': Lit("null"); return Json{};
+      default: return Number();
+    }
+  }
+  void Lit(const char* lit) {
+    Ws();
+    for (const char* c = lit; *c; ++c, ++p_)
+      if (p_ >= s_.size() || s_[p_] != *c)
+        throw std::runtime_error("bad literal");
+  }
+  static Json MakeBool(bool b) {
+    Json j;
+    j.kind = Json::kBool;
+    j.b = b;
+    return j;
+  }
+  Json Number() {
+    Ws();
+    size_t start = p_;
+    while (p_ < s_.size() &&
+           (isdigit(s_[p_]) || strchr("+-.eE", s_[p_]) != nullptr))
+      ++p_;
+    Json j;
+    j.kind = Json::kNum;
+    j.num = strtod(s_.substr(start, p_ - start).c_str(), nullptr);
+    return j;
+  }
+  Json String() {
+    Expect('"');
+    Json j;
+    j.kind = Json::kStr;
+    while (p_ < s_.size() && s_[p_] != '"') {
+      char c = s_[p_++];
+      if (c == '\\') {
+        if (p_ >= s_.size()) throw std::runtime_error("unterminated escape");
+        char e = s_[p_++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'u':  // \uXXXX: keep ASCII subset, skip others
+            if (p_ + 4 > s_.size())
+              throw std::runtime_error("truncated \\u escape");
+            c = static_cast<char>(
+                strtol(s_.substr(p_, 4).c_str(), nullptr, 16));
+            p_ += 4;
+            break;
+          default: c = e;
+        }
+      }
+      j.str.push_back(c);
+    }
+    if (p_ >= s_.size()) throw std::runtime_error("unterminated string");
+    ++p_;
+    return j;
+  }
+  Json Array() {
+    Expect('[');
+    Json j;
+    j.kind = Json::kArr;
+    if (Peek() == ']') { ++p_; return j; }
+    while (true) {
+      j.arr.push_back(Value());
+      if (Peek() == ',') { ++p_; continue; }
+      Expect(']');
+      return j;
+    }
+  }
+  Json Object() {
+    Expect('{');
+    Json j;
+    j.kind = Json::kObj;
+    if (Peek() == '}') { ++p_; return j; }
+    while (true) {
+      Json key = String();
+      Expect(':');
+      j.obj[key.str] = Value();
+      if (Peek() == ',') { ++p_; continue; }
+      Expect('}');
+      return j;
+    }
+  }
+};
+
+// -------------------------------------------------------------- tensors ----
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  void Resize(std::vector<int64_t> s) {
+    shape = std::move(s);
+    data.assign(static_cast<size_t>(numel()), 0.f);
+  }
+};
+
+// Scope: name -> tensor (ref framework/scope.h — flat is enough here).
+using Scope = std::map<std::string, Tensor>;
+
+static Tensor& Var(Scope* scope, const std::string& name) {
+  return (*scope)[name];
+}
+
+// ------------------------------------------------------------ operators ----
+static std::string In(const Json& op, const std::string& slot, int i = 0) {
+  if (!op.at("inputs").has(slot)) return "";
+  const auto& arr = op.at("inputs").at(slot).arr;
+  return i < static_cast<int>(arr.size()) ? arr[i].str : "";
+}
+static std::string Out(const Json& op, const std::string& slot, int i = 0) {
+  if (!op.at("outputs").has(slot)) return "";
+  const auto& arr = op.at("outputs").at(slot).arr;
+  return i < static_cast<int>(arr.size()) ? arr[i].str : "";
+}
+
+static void RunOp(const Json& op, Scope* scope, std::mt19937* rng) {
+  const std::string& type = op.at("type").str;
+  const Json& attrs = op.at("attrs");
+
+  if (type == "fill_constant") {
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<int64_t> shape;
+    for (const auto& d : attrs.at("shape").arr) shape.push_back(d.as_int());
+    out.Resize(shape);
+    float v = static_cast<float>(attrs.at("value").num);
+    for (auto& x : out.data) x = v;
+  } else if (type == "uniform_random") {
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<int64_t> shape;
+    for (const auto& d : attrs.at("shape").arr) shape.push_back(d.as_int());
+    out.Resize(shape);
+    std::uniform_real_distribution<float> dist(
+        static_cast<float>(attrs.at("min").num),
+        static_cast<float>(attrs.at("max").num));
+    for (auto& x : out.data) x = dist(*rng);
+  } else if (type == "mul") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    int64_t m = x.shape[0], k = x.shape[1], n = y.shape[1];
+    out.Resize({m, n});
+    for (int64_t i = 0; i < m; ++i)
+      for (int64_t j = 0; j < n; ++j) {
+        float acc = 0;
+        for (int64_t l = 0; l < k; ++l)
+          acc += x.data[i * k + l] * y.data[l * n + j];
+        out.data[i * n + j] = acc;
+      }
+  } else if (type == "elementwise_add") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    int64_t yn = y.numel();
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = x.data[i] + y.data[i % yn];  // trailing-dim broadcast
+  } else if (type == "square_error_cost") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      float d = x.data[i] - y.data[i];
+      out.data[i] = d * d;
+    }
+  } else if (type == "mean") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({});
+    double acc = 0;
+    for (float v : x.data) acc += v;
+    out.data[0] = static_cast<float>(acc / x.numel());
+  } else if (type == "mean_grad") {
+    const Tensor& x = Var(scope, In(op, "X$X"));
+    const Tensor& og = Var(scope, In(op, "OG$Out"));
+    Tensor& ig = Var(scope, Out(op, "IG$X"));
+    ig.Resize(x.shape);
+    float g = og.data[0] / static_cast<float>(x.numel());
+    for (auto& v : ig.data) v = g;
+  } else if (type == "square_error_cost_grad") {
+    const Tensor& x = Var(scope, In(op, "X$X"));
+    const Tensor& y = Var(scope, In(op, "X$Y"));
+    const Tensor& og = Var(scope, In(op, "OG$Out"));
+    if (!Out(op, "IG$X").empty()) {
+      Tensor& ig = Var(scope, Out(op, "IG$X"));
+      ig.Resize(x.shape);
+      for (int64_t i = 0; i < x.numel(); ++i)
+        ig.data[i] = 2.f * (x.data[i] - y.data[i]) * og.data[i];
+    }
+    if (!Out(op, "IG$Y").empty()) {
+      Tensor& ig = Var(scope, Out(op, "IG$Y"));
+      ig.Resize(y.shape);
+      for (int64_t i = 0; i < y.numel(); ++i)
+        ig.data[i] = -2.f * (x.data[i] - y.data[i]) * og.data[i];
+    }
+  } else if (type == "elementwise_add_grad") {
+    const Tensor& y = Var(scope, In(op, "X$Y"));
+    const Tensor& og = Var(scope, In(op, "OG$Out"));
+    if (!Out(op, "IG$X").empty()) {
+      Tensor& igx = Var(scope, Out(op, "IG$X"));
+      igx = og;
+    }
+    if (!Out(op, "IG$Y").empty()) {
+      Tensor& igy = Var(scope, Out(op, "IG$Y"));
+      igy.Resize(y.shape);
+      int64_t yn = y.numel();
+      for (int64_t i = 0; i < og.numel(); ++i)
+        igy.data[i % yn] += og.data[i];  // reduce the broadcast axis
+    }
+  } else if (type == "mul_grad") {
+    const Tensor& x = Var(scope, In(op, "X$X"));
+    const Tensor& y = Var(scope, In(op, "X$Y"));
+    const Tensor& og = Var(scope, In(op, "OG$Out"));
+    int64_t m = x.shape[0], k = x.shape[1], n = y.shape[1];
+    if (!Out(op, "IG$X").empty()) {
+      Tensor& igx = Var(scope, Out(op, "IG$X"));
+      igx.Resize(x.shape);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t l = 0; l < k; ++l) {
+          float acc = 0;
+          for (int64_t j = 0; j < n; ++j)
+            acc += og.data[i * n + j] * y.data[l * n + j];
+          igx.data[i * k + l] = acc;
+        }
+    }
+    if (!Out(op, "IG$Y").empty()) {
+      Tensor& igy = Var(scope, Out(op, "IG$Y"));
+      igy.Resize(y.shape);
+      for (int64_t l = 0; l < k; ++l)
+        for (int64_t j = 0; j < n; ++j) {
+          float acc = 0;
+          for (int64_t i = 0; i < m; ++i)
+            acc += x.data[i * k + l] * og.data[i * n + j];
+          igy.data[l * n + j] = acc;
+        }
+    }
+  } else if (type == "sgd") {
+    Tensor& param = Var(scope, In(op, "Param"));
+    const Tensor& grad = Var(scope, In(op, "Grad"));
+    const Tensor& lr = Var(scope, In(op, "LearningRate"));
+    for (int64_t i = 0; i < param.numel(); ++i)
+      param.data[i] -= lr.data[0] * grad.data[i];
+  } else if (type == "feed" || type == "fetch") {
+    // demo feeds tensors directly into the scope
+  } else {
+    throw std::runtime_error("demo_trainer: unsupported op " + type);
+  }
+}
+
+// ------------------------------------------------------------- programs ----
+static Json LoadProgram(const std::string& path) {
+  std::ifstream fin(path, std::ios::binary);
+  if (!fin) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << fin.rdbuf();
+  std::string text = ss.str();
+  return JsonParser(text).Parse();
+}
+
+static void RunBlock(const Json& program, Scope* scope, std::mt19937* rng) {
+  for (const auto& op : program.at("blocks").arr[0].at("ops").arr)
+    RunOp(op, scope, rng);
+}
+
+int main(int argc, char** argv) {
+  std::string dir = argc > 1 ? argv[1] : ".";
+  Json startup = LoadProgram(dir + "/startup_program");
+  Json main_prog = LoadProgram(dir + "/main_program");
+
+  // find the loss var (ref demo_trainer.cc: first mean op's Out)
+  std::string loss_name;
+  for (const auto& op : main_prog.at("blocks").arr[0].at("ops").arr)
+    if (op.at("type").str == "mean") {
+      loss_name = Out(op, "Out");
+      break;
+    }
+  if (loss_name.empty()) {
+    std::fprintf(stderr, "loss not found\n");
+    return 1;
+  }
+
+  Scope scope;
+  std::mt19937 rng(42);
+  RunBlock(startup, &scope, &rng);  // init params
+
+  // fixed fake batch, exactly like the reference demo
+  Tensor& x = scope["x"];
+  x.Resize({2, 13});
+  for (int i = 0; i < 26; ++i) x.data[i] = static_cast<float>(i) * 0.05f;
+  Tensor& y = scope["y"];
+  y.Resize({2, 1});
+  y.data[0] = 1.f;
+  y.data[1] = 2.f;
+
+  float first = 0, last = 0;
+  for (int step = 0; step < 10; ++step) {
+    RunBlock(main_prog, &scope, &rng);
+    last = scope[loss_name].data[0];
+    if (step == 0) first = last;
+    std::printf("step: %d loss: %f\n", step, last);
+  }
+  if (!(last < first) || !std::isfinite(last)) {
+    std::fprintf(stderr, "loss did not decrease (%f -> %f)\n", first, last);
+    return 1;
+  }
+  std::printf("PASS: loss %f -> %f\n", first, last);
+  return 0;
+}

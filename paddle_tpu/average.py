@@ -1,0 +1,39 @@
+"""WeightedAverage (ref ``python/paddle/fluid/average.py:40``): host-side
+streaming weighted mean over fetched metric values."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(value):
+    return isinstance(value, (int, float, complex, np.ndarray)) and \
+        not isinstance(value, bool)
+
+
+class WeightedAverage:
+    """accumulate sum(value*weight)/sum(weight) (ref average.py add/eval)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError(
+                "The 'value' must be a number(int, float) or a numpy ndarray")
+        if not isinstance(weight, (int, float)) or isinstance(weight, bool):
+            raise ValueError("The 'weight' must be a number(int, float)")
+        self.numerator += float(np.asarray(value).mean()) * weight
+        self.denominator += weight
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage")
+        return self.numerator / self.denominator

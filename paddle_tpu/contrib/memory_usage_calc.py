@@ -6,7 +6,6 @@ gives."""
 
 from __future__ import annotations
 
-import numpy as np
 
 __all__ = ["memory_usage"]
 

@@ -1,5 +1,15 @@
 """Model-compression toolkit (ref ``python/paddle/fluid/contrib/slim/``)."""
 
-from . import quantization  # noqa
+from . import core, distillation, graph, nas, prune, quantization  # noqa
+from .core import Compressor, ConfigFactory, Context, Strategy  # noqa
+from .distillation import (DistillationStrategy, FSPDistiller,  # noqa
+                           L2Distiller, SoftLabelDistiller)
+from .graph import GraphWrapper  # noqa
+from .nas import (ControllerServer, LightNASStrategy, SearchAgent,  # noqa
+                  SearchSpace)
+from .prune import (AutoPruneStrategy, PruneStrategy,  # noqa
+                    SensitivePruneStrategy, StructurePruner,
+                    UniformPruneStrategy, materialize_pruned_program)
 from .quantization import (QuantizationFreezePass,  # noqa
-                           QuantizationTransformPass)
+                           QuantizationStrategy, QuantizationTransformPass)
+from .searcher import EvolutionaryController, SAController  # noqa

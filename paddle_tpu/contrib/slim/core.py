@@ -1,0 +1,353 @@
+"""Compression framework core (ref ``python/paddle/fluid/contrib/slim/core/``:
+compressor.py Context/Compressor, strategy.py Strategy, config.py
+ConfigFactory).
+
+The Compressor drives an epoch loop over a *forward* train program (loss
+built, optimizer NOT yet applied) and calls strategy hooks around it.
+Strategies mutate the forward program (prune masks, distillation teacher
+merge, quant ops); the Compressor then (re)builds the optimized train graph
+by cloning the forward program and appending backward + optimizer ops — each
+rebuild is one fresh XLA compilation, after which steps run at full speed
+(static shapes throughout; no per-batch host-side graph work).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import io as pio
+from ...framework import core
+from ...framework.core import Program, program_guard
+from ...framework.executor import Executor
+from ...framework.scope import global_scope
+from .graph import GraphWrapper
+
+__all__ = ["Context", "Strategy", "Compressor", "ConfigFactory"]
+
+
+class Strategy:
+    """Base strategy with epoch/batch callbacks (ref strategy.py:18)."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):  # noqa: D102
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+    def restore_from_checkpoint(self, context):
+        self.on_compression_begin(context)
+
+
+class Context:
+    """Mutable state threaded through strategies (ref compressor.py:74)."""
+
+    def __init__(self, place, scope, train_graph: Optional[GraphWrapper],
+                 eval_graph: Optional[GraphWrapper], executor: Executor,
+                 optimizer=None, train_reader=None, eval_reader=None,
+                 teacher_graphs: Sequence[GraphWrapper] = (),
+                 train_feed_list=None, train_fetch_list=None,
+                 eval_feed_list=None, eval_fetch_list=None):
+        self.place = place
+        self.scope = scope
+        self.executor = executor
+        self.train_graph = train_graph          # forward program wrapper
+        self.eval_graph = eval_graph
+        self.optimizer = optimizer
+        self.train_reader = train_reader
+        self.eval_reader = eval_reader
+        self.teacher_graphs = list(teacher_graphs)
+        self.train_feed_list = list(train_feed_list or [])
+        self.train_fetch_list = list(train_fetch_list or [])
+        self.eval_feed_list = list(eval_feed_list or [])
+        self.eval_fetch_list = list(eval_fetch_list or [])
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.search_space = None
+        self.skip_training = False
+        self.eval_results: Dict[str, List[float]] = {}
+        self.k_v: Dict[str, object] = {}
+        # compiled (backward+optimizer appended) program; rebuilt on demand
+        self.optimize_graph: Optional[Program] = None
+        self._optimize_fetches: List[str] = []
+
+    # -- kv (ref Context.put/get) -------------------------------------------
+    def put(self, key, value):
+        self.k_v[key] = value
+
+    def get(self, key):
+        return self.k_v.get(key)
+
+    # -- train-graph rebuild -------------------------------------------------
+    def rebuild_optimize_graph(self):
+        """Clone the forward train program, append backward + optimizer.
+
+        Called at init and after every strategy that mutates the forward
+        graph.  The clone keeps the forward program pristine so later
+        strategies compose (prune → distill → quant)."""
+        fwd = self.train_graph.program
+        prog = fwd.clone()
+        startup = core.Program()
+        with program_guard(prog, startup):
+            loss_name = self._fetch_name(self.train_fetch_list[0])
+            loss = prog.global_block().var(loss_name)
+            self.optimizer.minimize(loss, startup_program=startup)
+        # the optimizer caches vars (LR, accumulators) created under an
+        # earlier rebuild's program; declare them in this block so the
+        # executor collects them from the scope
+        block = prog.global_block()
+        for op in block.ops:
+            for name in op.input_arg_names() + op.output_arg_names():
+                if name and not block.has_var(name) and \
+                        self.scope.find_var(name) is not None:
+                    val = np.asarray(self.scope.find_var(name))
+                    block.create_var(name=name, shape=tuple(val.shape),
+                                     dtype=str(val.dtype), persistable=True)
+        # run only the *new* startup pieces (optimizer accumulators, LR var):
+        # existing params already live in the scope
+        new_vars = [op.output_arg_names()[0]
+                    for op in startup.global_block().ops
+                    if op.output_arg_names()
+                    and self.scope.find_var(op.output_arg_names()[0]) is None]
+        if new_vars:
+            self.executor.run(startup, scope=self.scope, fetch_list=[])
+        self.optimize_graph = prog
+        self._optimize_fetches = [self._fetch_name(f)
+                                  for f in self.train_fetch_list]
+
+    @staticmethod
+    def _fetch_name(f):
+        return f.name if hasattr(f, "name") else f
+
+    # -- eval loop (ref Context.run_eval_graph) ------------------------------
+    def run_eval_graph(self, sampled_rate=None, cached_id=0):
+        assert self.eval_graph is not None and self.eval_reader is not None
+        fetches = [self._fetch_name(f) for f in self.eval_fetch_list]
+        feed_names = [self._fetch_name(f) for f in self.eval_feed_list]
+        totals = np.zeros(len(fetches), np.float64)
+        count = 0
+        for data in self.eval_reader():
+            feed = _make_feed(self.eval_graph.program, feed_names, data)
+            outs = self.executor.run(self.eval_graph.program, feed=feed,
+                                     fetch_list=fetches, scope=self.scope)
+            totals += [float(np.asarray(o).mean()) for o in outs]
+            count += 1
+        result = (totals / max(count, 1)).tolist()
+        for name, val in zip(fetches, result):
+            self.eval_results.setdefault(name, []).append(val)
+        return result[0], fetches[0]
+
+    def eval_converged(self, metric_name, delta=0.001):
+        hist = self.eval_results.get(metric_name, [])
+        if len(hist) < 2:
+            return False
+        return abs(hist[-1] - hist[-2]) < delta
+
+
+def _make_feed(program: Program, feed_names: Sequence[str], data):
+    """One reader sample-batch (list of tuples) → feed dict, via the
+    standard DataFeeder batching convention."""
+    if isinstance(data, dict):
+        return data
+    from ...data.feeder import DataFeeder
+    block = program.global_block()
+    feed_list = [block.var(n) if block.has_var(n) else n
+                 for n in feed_names]
+    return DataFeeder(feed_list).feed(data)
+
+
+class Compressor:
+    """Epoch-driven compression driver (ref compressor.py:229).
+
+    ``train_program``/``eval_program`` are *forward* programs whose first
+    train fetch is the loss; the optimizer is applied by the Compressor so
+    strategies may rewrite the forward graph at epoch boundaries."""
+
+    def __init__(self, place, scope, train_program: Program,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program: Optional[Program] = None,
+                 eval_reader=None, eval_feed_list=None, eval_fetch_list=None,
+                 teacher_programs=(), checkpoint_path: Optional[str] = None,
+                 train_optimizer=None, epoch: int = 1,
+                 distiller_optimizer=None, search_space=None,
+                 log_period: int = 20):
+        self.place = place
+        self.scope = scope or global_scope()
+        self.epoch = epoch
+        self.checkpoint_path = checkpoint_path
+        self.log_period = log_period
+        self.strategies: List[Strategy] = []
+        self.executor = Executor(place)
+        self.distiller_optimizer = distiller_optimizer
+        self.context = Context(
+            place, self.scope,
+            GraphWrapper(train_program, self.scope),
+            GraphWrapper(eval_program, self.scope) if eval_program else None,
+            self.executor, optimizer=train_optimizer,
+            train_reader=train_reader, eval_reader=eval_reader,
+            teacher_graphs=[GraphWrapper(p, self.scope)
+                            for p in teacher_programs],
+            train_feed_list=train_feed_list, train_fetch_list=train_fetch_list,
+            eval_feed_list=eval_feed_list, eval_fetch_list=eval_fetch_list)
+        self.context.put("distiller_optimizer", distiller_optimizer)
+        self.context.search_space = search_space
+
+    def add_strategy(self, strategy: Strategy):
+        self.strategies.append(strategy)
+        self.epoch = max(self.epoch, strategy.end_epoch)
+        return self
+
+    def config(self, config_file: str):
+        """Load strategies from a YAML config (ref config.py factory)."""
+        factory = ConfigFactory(config_file)
+        for s in factory.strategies:
+            self.add_strategy(s)
+        if factory.compressor.get("epoch"):
+            self.epoch = int(factory.compressor["epoch"])
+        if factory.compressor.get("checkpoint_path"):
+            self.checkpoint_path = factory.compressor["checkpoint_path"]
+        return self
+
+    # -- checkpoint (ref _save/_load_checkpoint) -----------------------------
+    def _save_checkpoint(self, context):
+        if not self.checkpoint_path:
+            return
+        path = os.path.join(self.checkpoint_path, str(context.epoch_id))
+        os.makedirs(path, exist_ok=True)
+        pio.save_persistables(self.executor, dirname=path,
+                              main_program=context.optimize_graph,
+                              scope=context.scope)
+        meta = {"epoch_id": context.epoch_id,
+                "eval_results": context.eval_results,
+                "prune_ratios": context.get("prune_ratios"),
+                # strategies carry search state (SA chains, best tokens);
+                # unpicklables (sockets, closures) are dropped by their
+                # __getstate__ hooks
+                "strategies": self.strategies}
+        with open(os.path.join(path, "context.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+
+    def _load_checkpoint(self, context) -> bool:
+        """Returns True when a checkpoint was resumed (strategies were
+        notified via restore_from_checkpoint)."""
+        if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
+            return False
+        epochs = sorted(int(d) for d in os.listdir(self.checkpoint_path)
+                        if d.isdigit())
+        if not epochs:
+            return False
+        path = os.path.join(self.checkpoint_path, str(epochs[-1]))
+        with open(os.path.join(path, "context.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        context.epoch_id = meta["epoch_id"] + 1
+        context.eval_results = meta["eval_results"]
+        if meta.get("prune_ratios"):
+            context.put("prune_ratios", meta["prune_ratios"])
+        for cur, saved in zip(self.strategies, meta.get("strategies", [])):
+            if type(cur) is type(saved):
+                cur.__dict__.update(saved.__dict__)
+        for s in self.strategies:
+            s.restore_from_checkpoint(context)
+        pio.load_persistables(self.executor, dirname=path,
+                              main_program=context.optimize_graph,
+                              scope=context.scope)
+        return True
+
+    # -- train loop (ref _train_one_epoch) -----------------------------------
+    def _train_one_epoch(self, context: Context):
+        if context.train_reader is None:
+            return
+        feed_names = [Context._fetch_name(f)
+                      for f in context.train_feed_list]
+        for batch_id, data in enumerate(context.train_reader()):
+            context.batch_id = batch_id
+            for s in self.strategies:
+                s.on_batch_begin(context)
+            feed = _make_feed(context.optimize_graph, feed_names, data)
+            context.executor.run(context.optimize_graph, feed=feed,
+                                 fetch_list=context._optimize_fetches,
+                                 scope=context.scope)
+            for s in self.strategies:
+                s.on_batch_end(context)
+
+    def run(self) -> Context:
+        context = self.context
+        context.rebuild_optimize_graph()
+        # on resume, restore_from_checkpoint (default: on_compression_begin)
+        # already notified each strategy exactly once
+        if not self._load_checkpoint(context):
+            for s in self.strategies:
+                s.on_compression_begin(context)
+        start = context.epoch_id
+        for epoch in range(start, self.epoch):
+            context.epoch_id = epoch
+            for s in self.strategies:
+                s.on_epoch_begin(context)
+            if not context.skip_training:
+                self._train_one_epoch(context)
+            context.skip_training = False
+            for s in self.strategies:
+                s.on_epoch_end(context)
+            if context.eval_graph is not None and context.eval_reader:
+                context.run_eval_graph()
+            self._save_checkpoint(context)
+        for s in self.strategies:
+            s.on_compression_end(context)
+        return context
+
+
+class ConfigFactory:
+    """YAML strategy config loader (ref slim/core/config.py).
+
+    Schema::
+
+        version: 1.0
+        strategies:
+            quant_strategy:
+                class: QuantizationStrategy
+                start_epoch: 0
+                ...
+        compressor:
+            epoch: 10
+            checkpoint_path: ./ckpt
+            strategies: [quant_strategy]     # optional subset/order
+    """
+
+    def __init__(self, config_file: str):
+        import yaml
+        with open(config_file) as f:
+            cfg = yaml.safe_load(f) or {}
+        self.compressor = cfg.get("compressor", {}) or {}
+        defs = cfg.get("strategies", {}) or {}
+        order = self.compressor.get("strategies") or list(defs)
+        self.strategies = [self._build(defs[name]) for name in order]
+
+    @staticmethod
+    def _build(spec: dict) -> Strategy:
+        from . import distillation, nas, prune, quantization
+        spec = dict(spec)
+        cls_name = spec.pop("class")
+        for mod in (prune, distillation, nas, quantization):
+            cls = getattr(mod, cls_name, None)
+            if cls is not None:
+                return cls(**spec)
+        raise ValueError(f"unknown strategy class {cls_name!r}")

@@ -1,0 +1,210 @@
+"""Knowledge distillation (ref ``python/paddle/fluid/contrib/slim/
+distillation/``: distiller.py L2/FSP/SoftLabel distillers building loss ops
+on the merged graph; distillation_strategy.py swapping the optimize graph
+for the distillation window).
+
+The teacher program is merged op-for-op into a clone of the student's
+forward program (shared data-input vars unify the two nets, teacher vars
+are stop_gradient so autodiff never differentiates the teacher), distiller
+losses are appended with the ordinary layer DSL, and the whole merged net —
+student + frozen teacher + losses — compiles to ONE XLA computation: the
+teacher forward fuses into the same step, no separate teacher session as a
+naive port would run."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ... import layers
+from ...framework import core
+from ...framework.core import program_guard
+from .core import Strategy
+from .graph import GraphWrapper
+
+__all__ = ["L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+           "DistillationStrategy", "merge_programs"]
+
+
+def merge_programs(student: core.Program, teacher: core.Program,
+                   prefix: str = "", data_name_map=None) -> core.Program:
+    """Clone ``student`` and append every var/op of ``teacher`` (ref
+    graph_wrapper.py GraphWrapper.merge).  Vars already present in the
+    student (the shared feed vars) are reused, which is how the two nets
+    see the same minibatch.  ``prefix`` optionally renames teacher vars to
+    avoid collisions when both nets share layer names; ``data_name_map``
+    (teacher var → student var) pins the shared inputs when a prefix is
+    used."""
+    merged = student.clone()
+    dst = merged.global_block()
+    src = teacher.global_block()
+    data_name_map = dict(data_name_map or {})
+
+    def _name(n):
+        if not n:
+            return n
+        if n in data_name_map:
+            return data_name_map[n]
+        if prefix and src.has_var(n):
+            return prefix + n
+        return n
+
+    for name, var in src.vars.items():
+        new = _name(name)
+        if not dst.has_var(new):
+            v = dst.create_var(name=new, shape=var.shape, dtype=var.dtype,
+                               persistable=var.persistable)
+            v.is_parameter = getattr(var, "is_parameter", False)
+            v.stop_gradient = True        # teacher side is frozen
+    for op in src.ops:
+        dst.append_op(
+            op.type,
+            inputs={s: [_name(n) for n in ns] for s, ns in op.inputs.items()},
+            outputs={s: [_name(n) for n in ns]
+                     for s, ns in op.outputs.items()},
+            attrs=dict(op.attrs))
+    merged._bump_version()
+    return merged
+
+
+class L2Distiller:
+    """L2 loss between a student and a teacher feature map
+    (ref distiller.py:25)."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 distillation_loss_weight: float = 1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph: GraphWrapper):
+        block = graph.program.global_block()
+        s = block.var(self.student_feature_map)
+        t = block.var(self.teacher_feature_map)
+        diff = layers.elementwise_sub(s, t)
+        loss = layers.reduce_mean(layers.square(diff)) * self.weight
+        return loss
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure distillation: match the student's and
+    teacher's FSP (gram) matrices between layer pairs (ref
+    distiller.py:103; fsp op ref operators/fsp_op.cc)."""
+
+    def __init__(self, student_pairs: Sequence[Sequence[str]],
+                 teacher_pairs: Sequence[Sequence[str]],
+                 distillation_loss_weight: float = 1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph: GraphWrapper):
+        block = graph.program.global_block()
+        losses = []
+        for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            fs = layers.fsp_matrix(block.var(s0), block.var(s1))
+            ft = layers.fsp_matrix(block.var(t0), block.var(t1))
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(fs, ft))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total * self.weight
+
+
+class SoftLabelDistiller:
+    """Soft-label cross entropy between temperature-softened student and
+    teacher logits (ref distiller.py:195)."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 student_temperature: float = 1.0,
+                 teacher_temperature: float = 1.0,
+                 distillation_loss_weight: float = 1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph: GraphWrapper):
+        block = graph.program.global_block()
+        s = layers.softmax(
+            block.var(self.student_feature_map) / self.student_temperature)
+        t = layers.softmax(
+            block.var(self.teacher_feature_map) / self.teacher_temperature)
+        t.stop_gradient = True
+        ce = layers.cross_entropy(s, t, soft_label=True)
+        return layers.reduce_mean(ce) * self.weight
+
+
+class DistillationStrategy(Strategy):
+    """Swap the train graph for student+teacher+distill-loss during
+    [start_epoch, end_epoch) (ref distillation_strategy.py:27)."""
+
+    def __init__(self, distillers: Optional[List] = None, start_epoch=0,
+                 end_epoch=0, teacher_prefix: str = "",
+                 data_name_map=None):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = distillers or []
+        self.teacher_prefix = teacher_prefix
+        self.data_name_map = data_name_map
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        student_fwd = context.train_graph.program
+        merged = student_fwd
+        for tg in context.teacher_graphs:
+            merged = merge_programs(merged, tg.program, self.teacher_prefix,
+                                    self.data_name_map)
+            if self.teacher_prefix:
+                # renamed teacher vars need their scope values under the
+                # prefixed names the merged program reads
+                import numpy as np
+                for v in tg.program.list_vars():
+                    if v.persistable and \
+                            context.scope.find_var(v.name) is not None:
+                        # real copy: aliasing the student's buffer would
+                        # collide with the executor's donation of trained
+                        # params
+                        context.scope.set_var(
+                            self.teacher_prefix + v.name,
+                            np.array(context.scope.find_var(v.name),
+                                     copy=True))
+        graph = GraphWrapper(merged, context.scope)
+        student_loss = context._fetch_name(context.train_fetch_list[0])
+        with program_guard(merged):
+            total = merged.global_block().var(student_loss)
+            for d in self.distillers:
+                total = total + d.distiller_loss(graph)
+        # stash originals for restore (ref distillation_backup_optimize_graph)
+        context.put("distillation_backup",
+                    (context.train_graph, list(context.train_fetch_list),
+                     context.optimizer))
+        distiller_opt = context.get("distiller_optimizer")
+        if distiller_opt is not None:
+            context.optimizer = distiller_opt
+        context.train_graph = graph
+        context.train_fetch_list = [total.name] + \
+            list(context.train_fetch_list[1:])
+        context.rebuild_optimize_graph()
+
+    def on_epoch_end(self, context):
+        if context.epoch_id != self.end_epoch - 1:
+            return
+        backup = context.get("distillation_backup")
+        if backup:
+            (context.train_graph, context.train_fetch_list,
+             context.optimizer) = backup
+            context.put("distillation_backup", None)
+            context.rebuild_optimize_graph()
+
+    def restore_from_checkpoint(self, context):
+        # re-enter the distillation graph if resuming inside the window;
+        # epoch_id == start_epoch means the checkpoint predates the merge
+        # and the ordinary on_epoch_begin will apply it
+        if self.start_epoch < context.epoch_id < self.end_epoch:
+            saved = context.epoch_id
+            context.epoch_id = self.start_epoch
+            self.on_epoch_begin(context)
+            context.epoch_id = saved

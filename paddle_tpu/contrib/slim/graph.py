@@ -1,0 +1,109 @@
+"""Graph wrapper for the compression toolkit (ref ``python/paddle/fluid/
+contrib/slim/graph/graph_wrapper.py``: GraphWrapper over an IrGraph with
+op/var queries, FLOPs counting, param backup/restore).
+
+TPU-native shape: the wrapper holds a *forward* Program (pre-minimize) plus
+the Scope with parameter values.  Strategies mutate the forward program (one
+XLA recompile per mutation — static shapes preserved) and the Compressor
+re-appends backward+optimizer; there is no per-op IrGraph surgery of grad
+ops as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...framework import core
+from ...framework.core import Operator, Program, Variable
+
+__all__ = ["GraphWrapper"]
+
+
+def _numel(shape):
+    n = 1
+    for d in shape or ():
+        n *= abs(int(d)) if d else 1
+    return n
+
+
+class GraphWrapper:
+    """Query/mutation facade over (program, scope) used by slim strategies."""
+
+    def __init__(self, program: Program, scope=None,
+                 in_nodes: Optional[Dict[str, str]] = None,
+                 out_nodes: Optional[Dict[str, str]] = None):
+        self.program = program
+        self.scope = scope
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    # -- queries (ref GraphWrapper.ops/vars/pre_ops/next_ops) ----------------
+    def ops(self) -> List[Operator]:
+        return list(self.program.global_block().ops)
+
+    def vars(self) -> List[Variable]:
+        return list(self.program.global_block().vars.values())
+
+    def var(self, name: str) -> Variable:
+        return self.program.global_block().var(name)
+
+    def all_parameters(self) -> List[Variable]:
+        return self.program.global_block().all_parameters()
+
+    def pre_ops(self, op: Operator) -> List[Operator]:
+        ins = set(op.input_arg_names())
+        return [o for o in self.ops()
+                if o is not op and ins & set(o.output_arg_names())]
+
+    def next_ops(self, op: Operator) -> List[Operator]:
+        outs = set(op.output_arg_names())
+        return [o for o in self.ops()
+                if o is not op and outs & set(o.input_arg_names())]
+
+    def ops_by_input(self, var_name: str) -> List[Operator]:
+        return [o for o in self.ops() if var_name in o.input_arg_names()]
+
+    def ops_by_output(self, var_name: str) -> List[Operator]:
+        return [o for o in self.ops() if var_name in o.output_arg_names()]
+
+    # -- stats (ref GraphWrapper.flops/numel_params) -------------------------
+    def numel_params(self) -> int:
+        return sum(_numel(p.shape) for p in self.all_parameters())
+
+    def flops(self, only_conv: bool = False) -> int:
+        """Multiply-accumulate count ×2 of conv/fc ops (ref
+        GraphWrapper.flops)."""
+        block = self.program.global_block()
+        total = 0
+        for op in self.ops():
+            if op.type in ("conv2d", "depthwise_conv2d"):
+                fshape = block.var(op.input("Filter")[0]).shape
+                oshape = block.var(op.output("Output")[0]).shape
+                # [O,I,kh,kw] filter × spatial output positions
+                total += 2 * _numel(fshape) * _numel(oshape[-2:])
+            elif op.type in ("mul", "matmul"):
+                xs = block.var(op.input("X")[0]).shape
+                ys = block.var(op.input("Y")[0]).shape
+                total += 2 * _numel(xs) * int(ys[-1])
+            elif not only_conv and op.type.startswith("elementwise"):
+                total += _numel(block.var(op.output("Out")[0]).shape)
+        return total
+
+    # -- param snapshot (ref GraphWrapper backup used by prune/NAS) ----------
+    def backup_params(self) -> Dict[str, np.ndarray]:
+        snap = {}
+        for p in self.all_parameters():
+            v = self.scope.find_var(p.name) if self.scope else None
+            if v is not None:
+                snap[p.name] = np.array(v, copy=True)
+        return snap
+
+    def restore_params(self, snapshot: Dict[str, np.ndarray]) -> None:
+        for name, value in snapshot.items():
+            self.scope.set_var(name, value)
+
+    def clone(self) -> "GraphWrapper":
+        return GraphWrapper(self.program.clone(), self.scope,
+                            self.in_nodes, self.out_nodes)

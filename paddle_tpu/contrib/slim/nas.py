@@ -1,0 +1,238 @@
+"""Light-NAS: architecture search driven by simulated annealing (ref
+``python/paddle/fluid/contrib/slim/nas/``: search_space.py SearchSpace,
+controller_server.py socket server, search_agent.py client,
+light_nas_strategy.py strategy).
+
+The controller lives behind a tiny line-JSON TCP server so a multi-host
+search (many trainers evaluating candidate nets in parallel, e.g. one per
+TPU slice) shares one annealing chain — the reference's
+controller_server/search_agent topology.  Single-host search just talks to
+the same server on localhost."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+from ...framework.executor import Executor
+from .core import Strategy
+from .graph import GraphWrapper
+from .searcher import SAController
+
+__all__ = ["SearchSpace", "ControllerServer", "SearchAgent",
+           "LightNASStrategy"]
+
+
+class SearchSpace:
+    """User-subclassed search space (ref search_space.py:19)."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-position exclusive upper bounds."""
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        """tokens → (startup_program, train_program, eval_program,
+        train_fetch_list, eval_fetch_list, train_reader, eval_reader)."""
+        raise NotImplementedError
+
+    def get_model_latency(self, program) -> float:
+        """Optional measured/predicted latency for the candidate."""
+        raise NotImplementedError
+
+
+class ControllerServer:
+    """Serve an SAController over TCP line-JSON (ref
+    controller_server.py).  Protocol:
+        {"cmd": "next_tokens"}                     → {"tokens": [...]}
+        {"cmd": "update", "tokens": T, "reward": r} → {"tokens": next}
+    """
+
+    def __init__(self, controller: SAController, address=("127.0.0.1", 0),
+                 max_client_num: int = 10):
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(max_client_num)
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    @property
+    def address(self):
+        return self._sock.getsockname()
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            # one thread per client so a hung trainer can't starve the
+            # accept loop; the idle timeout reaps dead connections
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        conn.settimeout(60)
+        try:
+            with conn, conn.makefile("rw") as f:
+                for line in f:
+                    try:
+                        req = json.loads(line)
+                    except ValueError:
+                        break
+                    with self._lock:
+                        if req.get("cmd") == "update":
+                            self._controller.update(req["tokens"],
+                                                    float(req["reward"]))
+                        resp = {"tokens": self._controller.next_tokens()}
+                    f.write(json.dumps(resp) + "\n")
+                    f.flush()
+        except OSError:
+            pass
+
+
+class SearchAgent:
+    """Client side of the controller protocol (ref search_agent.py)."""
+
+    def __init__(self, server_ip: str, server_port: int):
+        self.server_ip = server_ip
+        self.server_port = server_port
+
+    def _request(self, payload: dict) -> list:
+        with socket.create_connection((self.server_ip, self.server_port),
+                                      timeout=30) as s, \
+                s.makefile("rw") as f:
+            f.write(json.dumps(payload) + "\n")
+            f.flush()
+            return json.loads(f.readline())["tokens"]
+
+    def next_tokens(self) -> list:
+        return self._request({"cmd": "next_tokens"})
+
+    def update(self, tokens, reward) -> list:
+        """Report a reward; returns the next tokens to try."""
+        return self._request({"cmd": "update", "tokens": list(tokens),
+                              "reward": float(reward)})
+
+
+class LightNASStrategy(Strategy):
+    """Each epoch in the window: build the candidate net from the current
+    tokens, train it, reward the controller with the eval metric (ref
+    light_nas_strategy.py:34).  Candidates over the FLOPs/latency budget
+    are rejected before any training."""
+
+    def __init__(self, controller: Optional[SAController] = None,
+                 start_epoch=0, end_epoch=10, target_flops: float = 0,
+                 target_latency: float = 0, metric_name: str = "acc_top1",
+                 server_ip: str = "127.0.0.1", server_port: int = 0,
+                 is_server: bool = True, retrain_epoch: int = 1,
+                 max_try_times: int = 101):
+        super().__init__(start_epoch, end_epoch)
+        self._controller = controller or SAController()
+        self._max_flops = target_flops
+        self._max_latency = target_latency
+        self.metric_name = metric_name
+        self._server_ip = server_ip
+        self._server_port = server_port
+        self._is_server = is_server
+        self._retrain_epoch = max(1, retrain_epoch)
+        self._max_try_times = max_try_times
+        self._server: Optional[ControllerServer] = None
+        self._agent: Optional[SearchAgent] = None
+        self._current_tokens = None
+        self.best_tokens = None
+        self.best_reward = float("-inf")
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_server"] = None        # socket/thread state is rebuilt on resume
+        d["_agent"] = None
+        return d
+
+    def on_compression_begin(self, context):
+        space = context.search_space
+        assert space is not None, "Compressor needs search_space for NAS"
+        if self._is_server:
+            if not getattr(self._controller, "_range_table", None):
+                self._controller.reset(space.range_table(),
+                                       space.init_tokens())
+            # (a resumed controller keeps its annealing chain)
+            self._server = ControllerServer(
+                self._controller,
+                (self._server_ip, self._server_port)).start()
+            self._server_port = self._server.address[1]
+        self._agent = SearchAgent(self._server_ip, self._server_port)
+        if self._current_tokens is None:
+            self._current_tokens = space.init_tokens()
+
+    def on_compression_end(self, context):
+        if self._server is not None:
+            self._server.close()
+
+    def _within_budget(self, eval_program, space) -> bool:
+        if self._max_flops > 0:
+            if GraphWrapper(eval_program).flops() > self._max_flops:
+                return False
+        if self._max_latency > 0:
+            if space.get_model_latency(eval_program) > self._max_latency:
+                return False
+        return True
+
+    def on_epoch_begin(self, context):
+        if not (self.start_epoch <= context.epoch_id < self.end_epoch) or \
+                (context.epoch_id - self.start_epoch) % self._retrain_epoch:
+            return
+        space = context.search_space
+        net = None
+        for _ in range(self._max_try_times):
+            net = space.create_net(self._current_tokens)
+            if self._within_budget(net[2], space):
+                break
+            self._current_tokens = self._agent.next_tokens()
+        (startup, train_p, eval_p, train_fetch, eval_fetch,
+         train_reader, eval_reader) = net
+        Executor(context.place).run(startup, scope=context.scope,
+                                    fetch_list=[])
+        context.train_graph = GraphWrapper(train_p, context.scope)
+        context.eval_graph = GraphWrapper(eval_p, context.scope)
+        context.train_fetch_list = list(train_fetch)
+        context.eval_fetch_list = list(eval_fetch)
+        context.train_reader = train_reader
+        context.eval_reader = eval_reader
+        context.rebuild_optimize_graph()
+
+    def on_epoch_end(self, context):
+        if not (self.start_epoch <= context.epoch_id < self.end_epoch) or \
+                (context.epoch_id - self.start_epoch + 1) \
+                % self._retrain_epoch:
+            return
+        reward, _ = context.run_eval_graph()
+        if not self._within_budget(context.eval_graph.program,
+                                   context.search_space):
+            reward = 0.0
+        if reward > self.best_reward:
+            self.best_reward = reward
+            self.best_tokens = list(self._current_tokens)
+        self._current_tokens = self._agent.update(self._current_tokens,
+                                                  reward)
+        context.put("nas_best_tokens", self.best_tokens)
+        context.put("nas_best_reward", self.best_reward)

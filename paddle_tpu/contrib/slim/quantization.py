@@ -19,8 +19,10 @@ import numpy as np
 
 from ...framework import core
 from ...framework.core import Program
+from .core import Strategy
 
-__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "QuantizationStrategy"]
 
 #: ops whose inputs get quantized (ref quantization_pass.py
 #: _quantizable_op_type)
@@ -62,7 +64,7 @@ class QuantizationTransformPass:
     def _make_state(self, block, sblock, name, value):
         block.create_var(name=name, shape=(1,), dtype="float32",
                          persistable=True)
-        if sblock is not None:
+        if sblock is not None and not sblock.has_var(name):
             sblock.create_var(name=name, shape=(1,), dtype="float32",
                               persistable=True)
             sblock.append_op("fill_constant", outputs={"Out": [name]},
@@ -70,7 +72,7 @@ class QuantizationTransformPass:
                                     "value": float(value)})
 
     def _insert_qdq(self, block, sblock, idx, var_name, is_weight,
-                    quant_axis=0):
+                    quant_axis=0, is_test=False):
         """Insert one QDQ op before ops[idx]; returns (new_idx, out_name)."""
         v = block.var(var_name)
         out = block.create_var(name=var_name + ".quantized",
@@ -113,19 +115,27 @@ class QuantizationTransformPass:
             outputs={"Out": [out.name], "OutScale": [scale_name],
                      "OutState": [var_name + ".quant_state"],
                      "OutAccum": [var_name + ".quant_accum"]},
-            attrs={"bit_length": self._abits, "is_test": False,
+            attrs={"bit_length": self._abits, "is_test": bool(is_test),
                    "moving_rate": self._moving_rate})
         return idx + 1, out.name
 
     # -- entry ---------------------------------------------------------------
     def apply(self, program: Optional[Program] = None,
-              startup_program: Optional[Program] = None) -> Program:
+              startup_program=None, is_test: bool = False) -> Program:
         """Rewrite IN PLACE (the reference mutates the IrGraph likewise);
-        returns the program for chaining.  Call BEFORE minimize()."""
+        returns the program for chaining.  Call BEFORE minimize().
+
+        ``startup_program``: Program to receive quant-state init ops;
+        None → the global default startup; False → emit no init ops (for
+        test-mode clones whose state vars are shared with the train
+        program).  ``is_test``: emit frozen-scale QDQ ops that read but
+        never update the moving-average trackers (for eval programs — the
+        reference applies a test-mode transform to the eval IrGraph)."""
         program = program or core.default_main_program()
-        startup = startup_program or core.default_startup_program()
+        startup = core.default_startup_program() \
+            if startup_program is None else startup_program
         block = program.global_block()
-        sblock = startup.global_block() if startup is not None else None
+        sblock = startup.global_block() if startup else None
         quantized: Dict[str, str] = {}     # var -> quantized var (per program)
         i = 0
         while i < len(block.ops):
@@ -155,7 +165,8 @@ class QuantizationTransformPass:
                     # quantization_pass.py quant_axis selection)
                     axis = 1 if op.type in ("mul", "matmul") else 0
                     i, qname = self._insert_qdq(block, sblock, i, name,
-                                                is_weight, quant_axis=axis)
+                                                is_weight, quant_axis=axis,
+                                                is_test=is_test)
                     quantized[name] = qname
                     new_names.append(qname)
                 op.inputs[slot] = new_names
@@ -212,3 +223,81 @@ class QuantizationFreezePass:
         block.ops = keep
         program._bump_version()
         return program
+
+
+class QuantizationStrategy(Strategy):
+    """Compressor strategy wrapping the two passes (ref
+    slim/quantization/quantization_strategy.py:34): insert QDQ training ops
+    at start_epoch, freeze + optionally save the int8-ready model at the
+    end of the window."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max",
+                 save_in_nodes=None, save_out_nodes=None,
+                 float_model_save_path=None):
+        super().__init__(start_epoch, end_epoch)
+        self._transform = QuantizationTransformPass(
+            weight_bits, activation_bits, activation_quantize_type,
+            weight_quantize_type)
+        self._wbits = weight_bits
+        self._w_type = weight_quantize_type
+        self.save_in_nodes = save_in_nodes
+        self.save_out_nodes = save_out_nodes
+        self.float_model_save_path = float_model_save_path
+
+    def restore_from_checkpoint(self, context):
+        # epoch_id == start_epoch means the checkpoint predates the
+        # transform (saved at start_epoch-1): the ordinary on_epoch_begin
+        # will apply it.  Only re-apply when resuming PAST start_epoch, so
+        # the quant state vars exist for load_persistables.
+        if context.epoch_id > self.start_epoch:
+            saved = context.epoch_id
+            context.epoch_id = self.start_epoch
+            self.on_epoch_begin(context)
+            context.epoch_id = saved
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        startup = core.Program()
+        self._transform.apply(context.train_graph.program, startup)
+        if context.eval_graph is not None:
+            # eval clone shares the state vars — no init ops, and frozen
+            # scales so evaluation never perturbs the EMA trackers
+            self._transform.apply(context.eval_graph.program, False,
+                                  is_test=True)
+        context.executor.run(startup, scope=context.scope, fetch_list=[])
+        context.rebuild_optimize_graph()
+
+    def on_epoch_end(self, context):
+        if context.epoch_id != self.end_epoch - 1:
+            return
+        graph = context.eval_graph or context.train_graph
+        # freeze against a scope COPY: FreezePass bakes QDQ rounding into
+        # the weights it touches, which must not leak into the live
+        # training scope if the compressor keeps running
+        from ...framework.scope import Scope
+        frozen_scope = Scope()
+        for v in graph.program.list_vars():
+            if v.persistable and context.scope.find_var(v.name) is not None:
+                frozen_scope.set_var(
+                    v.name, np.array(context.scope.find_var(v.name),
+                                     copy=True))
+        frozen = QuantizationFreezePass(
+            frozen_scope, self._wbits, self._w_type).apply(
+                graph.program.clone())
+        context.put("quantized_eval_program", frozen)
+        context.put("quantized_eval_scope", frozen_scope)
+        if self.float_model_save_path:
+            from ... import io as pio
+            outs = self.save_out_nodes or [
+                context._fetch_name(f) for f in context.eval_fetch_list]
+            ins = self.save_in_nodes or [
+                context._fetch_name(f) for f in context.eval_feed_list]
+            pio.save_inference_model(
+                self.float_model_save_path, ins,
+                [frozen.global_block().var(n) for n in outs],
+                context.executor, main_program=frozen,
+                scope=frozen_scope)

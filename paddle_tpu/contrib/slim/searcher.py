@@ -1,0 +1,102 @@
+"""Evolutionary token searchers (ref ``python/paddle/fluid/contrib/slim/
+searcher/controller.py``: EvolutionaryController base + SAController
+simulated annealing)."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    """Searches a token vector under a per-position range table
+    (ref controller.py:28)."""
+
+    def reset(self, range_table: Sequence[int],
+              init_tokens: Optional[Sequence[int]] = None,
+              constrain_func: Optional[Callable] = None):
+        raise NotImplementedError
+
+    def update(self, tokens: Sequence[int], reward: float):
+        raise NotImplementedError
+
+    def next_tokens(self) -> List[int]:
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over token vectors (ref controller.py:59).
+
+    Accepts a worse candidate with probability exp(delta/temperature), with
+    the temperature decayed by ``reduce_rate`` each update — classic SA so
+    the search escapes local optima early and converges late."""
+
+    def __init__(self, range_table: Optional[Sequence[int]] = None,
+                 reduce_rate: float = 0.85, init_temperature: float = 1024,
+                 max_iter_number: int = 300, seed: int = 0):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+        self._tokens: List[int] = []
+        self._reward = -math.inf
+        self._best_tokens: List[int] = []
+        self._max_reward = -math.inf
+        self._iter = 0
+
+    # pickling for checkpoint (ref SAController.__getstate__)
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_constrain_func", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._constrain_func = None
+
+    @property
+    def best_tokens(self):
+        return list(self._best_tokens)
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens) if init_tokens is not None else \
+            [self._rng.randint(r) for r in self._range_table]
+        self._best_tokens = list(self._tokens)
+        self._reward = -math.inf
+        self._max_reward = -math.inf
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Accept/reject ``tokens`` given its measured ``reward``."""
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random_sample() < math.exp(
+                min((reward - self._reward) / max(temperature, 1e-12), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Perturb one random position of the current tokens."""
+        for _ in range(self._max_iter_number):
+            tokens = list(self._tokens)
+            index = self._rng.randint(len(tokens))
+            tokens[index] = self._rng.randint(self._range_table[index])
+            if self._constrain_func is None or self._constrain_func(tokens):
+                return tokens
+        return list(self._tokens)

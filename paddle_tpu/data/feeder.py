@@ -33,6 +33,12 @@ class DataFeeder:
         GSPMD executor shards one global batch instead, so the per-device
         dicts are concatenated into it."""
         dicts = [self.feed(it) for it in iterable_list]
+        if not dicts:
+            raise ValueError("feed_parallel got an empty iterable_list")
+        if num_places is not None and len(dicts) != num_places:
+            raise ValueError(
+                f"feed_parallel got {len(dicts)} per-device batches for "
+                f"{num_places} places")
         if len(dicts) == 1:
             return dicts[0]
         return {k: np.concatenate([d[k] for d in dicts]) for k in dicts[0]}

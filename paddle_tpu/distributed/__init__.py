@@ -22,3 +22,4 @@ from .fleet import (CollectiveOptimizer, DistributedStrategy,  # noqa
                     PaddleCloudRoleMaker, PSFleet, TranspilerOptimizer,
                     UserDefinedRoleMaker, fleet, ps_fleet)
 from .transpiler import GradAllReduce, LocalSGD  # noqa
+from . import downpour  # noqa  (legacy Downpour PS python API)

@@ -1,0 +1,31 @@
+"""Program → graphviz drawer CLI (ref ``python/paddle/fluid/net_drawer.py``:
+draw_graph(startup, main) emitting a DOT file per program).  The rendering
+itself shares the debugger's DOT emitter."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from .debugger import draw_block_graphviz
+from .framework.core import Program
+
+__all__ = ["draw_graph"]
+
+_counter = itertools.count()
+
+
+def unique_id():
+    return next(_counter)
+
+
+def draw_graph(startup_program: Program, main_program: Program,
+               graph_attr=None, name: str = "graph",
+               output: Optional[str] = None, **kwargs):
+    """Write ``<output or name>.dot`` for the main program (the reference
+    draws ops as nodes and vars as edges; our DOT emitter does the same)."""
+    path = output or (name + ".dot")
+    if not path.endswith(".dot"):
+        path += ".dot"
+    draw_block_graphviz(main_program.global_block(), path=path)
+    return path

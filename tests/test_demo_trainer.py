@@ -1,0 +1,35 @@
+"""Standalone C++ train demo (ref paddle/fluid/train/demo/demo_trainer.cc):
+program export from Python, training loop in pure C++."""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_demo_trainer_end_to_end(tmp_path):
+    # export the linear-regression programs
+    sys.path.insert(0, str(REPO / "tools"))
+    import export_demo_program
+    export_demo_program.main(str(tmp_path))
+    assert (tmp_path / "startup_program").exists()
+    assert (tmp_path / "main_program").exists()
+
+    # build the native binary
+    subprocess.run(["make", "-C", str(REPO / "native"), "demo_trainer"],
+                   check=True, capture_output=True, timeout=300)
+
+    # train in pure C++ — binary exits nonzero unless loss decreases
+    out = subprocess.run([str(REPO / "native" / "demo_trainer"),
+                          str(tmp_path)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+    losses = [float(l.rsplit(" ", 1)[1])
+              for l in out.stdout.splitlines() if l.startswith("step:")]
+    assert len(losses) == 10 and losses[-1] < losses[0] * 0.2

@@ -1,0 +1,73 @@
+"""WeightedAverage / net_drawer / legacy Downpour API shims
+(ref python/paddle/fluid/average.py, net_drawer.py,
+python/paddle/fluid/distributed/{downpour,node,ps_instance}.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.distributed import downpour
+from paddle_tpu.framework.core import Program, program_guard
+
+
+def test_weighted_average():
+    wa = fluid.WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(1.0, weight=1)
+    wa.add(np.array([3.0, 3.0]), weight=3)
+    assert wa.eval() == pytest.approx((1 + 9) / 4)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.add("nope", 1)
+
+
+def test_net_drawer_writes_dot(tmp_path):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=2)
+    path = fluid.net_drawer.draw_graph(startup, main,
+                                       output=str(tmp_path / "net.dot"))
+    text = open(path).read()
+    assert "digraph" in text and "mul" in text
+
+
+def test_downpour_sgd_builds_ps_descriptor():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[100, 8], is_sparse=True)
+        dense = layers.data("dense", shape=[4], dtype="float32")
+        h = layers.fc(layers.concat(
+            [layers.reshape(emb, [-1, 8]), dense], axis=1), size=1)
+        cost = layers.mean(layers.square(h))
+        opt = downpour.DownpourSGD(learning_rate=0.01, window=1)
+        ps_param, skipped = opt.minimize([cost])
+    assert len(ps_param.server_param.sparse_tables) == 1
+    assert ps_param.server_param.sparse_tables[0].slot_key_vars == \
+        [ids.name]
+    assert len(ps_param.server_param.dense_tables) == 1
+    dense_params = ps_param.server_param.dense_tables[0].param_vars
+    assert any("fc" in p for p in dense_params)
+    # embedding param handled by the sparse table, not the dense one
+    assert not any("emb" in p for p in dense_params)
+    assert ps_param.program_configs[0]["pull_sparse_table_id"] == [0]
+    assert "sgd" in skipped
+
+
+def test_ps_instance_roles(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS",
+                       "127.0.0.1:7000,127.0.0.1:7001")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:7001")
+    inst = downpour.PaddlePSInstance()
+    assert inst.is_server() and not inst.is_worker()
+    assert inst.get_server_index() == 1
+
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    inst = downpour.PaddlePSInstance()
+    assert inst.is_first_worker() and inst.get_worker_num() == 2

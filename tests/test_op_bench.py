@@ -1,0 +1,41 @@
+"""Op micro-benchmark harness (ref operators/benchmark/op_tester.cc)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def test_bench_op_library_matmul():
+    from op_bench import bench_op
+    rec = bench_op("matmul", {"X": (64, 64), "Y": (64, 64)}, repeat=3,
+                   warmup=1)
+    assert rec["op"] == "matmul" and rec["ms"] > 0 and rec["gflops"] > 0
+
+
+def test_bench_op_grad_and_bandwidth_metric():
+    from op_bench import bench_op
+    rec = bench_op("elementwise_add", {"X": (64, 64), "Y": (64, 64)},
+                   repeat=3, warmup=1, grad=True)
+    assert rec["op"] == "elementwise_add_grad" and "gb_s" in rec
+
+
+def test_bench_cli_yaml_config(tmp_path):
+    cfg = tmp_path / "ops.yaml"
+    cfg.write_text("""
+- op: softmax
+  shapes: {X: [32, 128]}
+  repeat: 2
+""")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "op_bench.py"),
+         "--config", str(cfg)],
+        capture_output=True, text=True, timeout=300,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": "/root"})
+    assert out.returncode == 0, out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["op"] == "softmax" and rec["ms"] > 0

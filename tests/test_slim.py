@@ -1,0 +1,459 @@
+"""Compression toolkit: Compressor + prune/distill/NAS/quant strategies
+(ref python/paddle/fluid/contrib/slim/ — compressor.py, prune_strategy.py,
+distillation_strategy.py, light_nas_strategy.py, controller.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer as popt
+from paddle_tpu.framework import unique_name
+from paddle_tpu.contrib import slim
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _reader(n_batches=2, batch=4, seed=0):
+    rng = np.random.RandomState(seed)
+    data = [[(rng.rand(1, 8, 8).astype(np.float32),
+              np.int64(rng.randint(4))) for _ in range(batch)]
+            for _ in range(n_batches)]
+
+    def it():
+        for b in data:
+            yield b
+    return it
+
+
+def _conv_net():
+    """conv → bn → relu → conv → pool → fc → CE loss + acc."""
+    img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    c1 = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                       param_attr=fluid.ParamAttr(name="conv1_weights"),
+                       bias_attr=False)
+    b1 = layers.batch_norm(c1, act="relu")
+    c2 = layers.conv2d(b1, num_filters=8, filter_size=3, padding=1,
+                       param_attr=fluid.ParamAttr(name="conv2_weights"),
+                       bias_attr=False)
+    p = layers.pool2d(c2, pool_size=8, pool_type="avg")
+    logits = layers.fc(layers.flatten(p), size=4)
+    loss = layers.reduce_mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return img, label, loss, acc, logits
+
+
+def _setup(scope):
+    train = Program()
+    startup = Program()
+    with program_guard(train, startup):
+        img, label, loss, acc, logits = _conv_net()
+    eval_p = train.clone(for_test=True)
+    Executor().run(startup, scope=scope, fetch_list=[])
+    return train, eval_p, loss, acc
+
+
+def _compressor(scope, train, eval_p, loss, acc, **kw):
+    return slim.Compressor(
+        None, scope, train,
+        train_reader=_reader(), train_feed_list=["img", "label"],
+        train_fetch_list=[loss.name],
+        eval_program=eval_p, eval_reader=_reader(1),
+        eval_feed_list=["img", "label"], eval_fetch_list=[acc.name],
+        train_optimizer=popt.SGD(learning_rate=0.01), **kw)
+
+
+# -- searcher ----------------------------------------------------------------
+def test_sa_controller_converges_bookkeeping():
+    c = slim.SAController(seed=3)
+    c.reset([4, 4, 4], init_tokens=[0, 0, 0])
+    for _ in range(30):
+        t = c.next_tokens()
+        c.update(t, float(sum(t)))          # reward = token sum
+    assert c.max_reward == float(sum(c.best_tokens))
+    assert c.max_reward >= 6                # SA finds a high-sum vector
+
+
+def test_sa_controller_constraint():
+    c = slim.SAController(seed=0)
+    c.reset([5, 5], init_tokens=[4, 4],
+            constrain_func=lambda t: sum(t) >= 4)
+    for _ in range(10):
+        assert sum(c.next_tokens()) >= 4
+
+
+# -- pruning -----------------------------------------------------------------
+def test_structure_pruner_l1_idx():
+    p = slim.StructurePruner()
+    w = np.stack([np.full((3, 3), v, np.float32) for v in (5, 1, 3, 2)])
+    idx = p.cal_pruned_idx("w", w, 0.5, axis=0)
+    assert sorted(idx.tolist()) == [1, 3]   # two smallest-l1 channels
+
+
+def test_uniform_prune_masks_and_training():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.add_strategy(slim.UniformPruneStrategy(
+            start_epoch=0, end_epoch=1, target_ratio=0.5,
+            pruned_params=r"conv.*weights"))
+        ctx = comp.run()
+        for name in ("conv1_weights", "conv2_weights"):
+            mask = np.asarray(scope.find_var(name + ".prune_mask"))
+            zero_ch = (~mask.reshape(mask.shape[0], -1).any(axis=1)).sum()
+            assert zero_ch == 4, name       # 8 filters → 4 pruned
+            w = np.asarray(scope.find_var(name))
+            assert (np.abs(w.reshape(8, -1)).sum(1) == 0).sum() == 4
+        # pruned channels stayed dead through training (mask blocks grads)
+        assert ctx.epoch_id == 0 and ctx.get("prune_ratios")
+
+
+def test_prune_materialize_matches_masked_forward():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.add_strategy(slim.UniformPruneStrategy(
+            start_epoch=0, end_epoch=1, target_ratio=0.5,
+            pruned_params=r"conv1.*weights"))
+        ctx = comp.run()
+        exe = Executor()
+        feed = {"img": np.random.RandomState(7)
+                .rand(2, 1, 8, 8).astype(np.float32),
+                "label": np.zeros((2, 1), np.int64)}
+        masked, = exe.run(ctx.eval_graph.program, feed=feed,
+                          fetch_list=[acc.name], scope=scope)
+        solid = slim.materialize_pruned_program(ctx.eval_graph.program,
+                                                scope)
+        # conv1 filter physically halved, conv2 input channels halved
+        assert np.shape(scope.find_var("conv1_weights"))[0] == 4
+        assert np.shape(scope.find_var("conv2_weights"))[1] == 4
+        mat, = exe.run(solid, feed=feed, fetch_list=[acc.name], scope=scope)
+        np.testing.assert_allclose(masked, mat, rtol=1e-5, atol=1e-5)
+
+
+def test_sensitive_prune_strategy():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.add_strategy(slim.SensitivePruneStrategy(
+            start_epoch=0, end_epoch=1, target_ratio=0.4, delta_rate=0.3,
+            pruned_params=r"conv.*weights"))
+        ctx = comp.run()
+        ratios = ctx.get("prune_ratios")
+        assert ratios and all(0.0 <= r <= 0.95 for r in ratios.values())
+        # achieved numel fraction reaches the target
+        strat = comp.strategies[0]
+        frac = strat._pruned_fraction(ctx, list(ratios), ratios)
+        assert frac >= 0.3
+
+
+def test_auto_prune_strategy_restores_and_applies_best():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.add_strategy(slim.AutoPruneStrategy(
+            start_epoch=0, end_epoch=3, target_ratio=0.5,
+            pruned_params=r"conv.*weights",
+            controller=slim.SAController(seed=5)))
+        ctx = comp.run()
+        ratios = ctx.get("prune_ratios")
+        assert ratios is not None
+        strat = comp.strategies[0]
+        assert strat._pruned_fraction(ctx, list(ratios), ratios) \
+            >= 0.5 - 0.15
+
+
+# -- distillation ------------------------------------------------------------
+def test_distillation_strategy_teacher_frozen():
+    scope = Scope()
+    with scope_guard(scope):
+        train = Program()
+        startup = Program()
+        with program_guard(train, startup):
+            img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+            label = layers.data("label", shape=[1], dtype="int64")
+            s_logits = layers.fc(layers.flatten(img), size=4,
+                                 param_attr=fluid.ParamAttr(name="s_w"))
+            s_feat = layers.fc(s_logits, size=4,
+                               param_attr=fluid.ParamAttr(name="s_w2"))
+            loss = layers.reduce_mean(
+                layers.softmax_with_cross_entropy(s_feat, label))
+        teacher = Program()
+        t_startup = Program()
+        with program_guard(teacher, t_startup):
+            t_img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+            t_logits = layers.fc(layers.flatten(t_img), size=4,
+                                 param_attr=fluid.ParamAttr(name="t_w"))
+        exe = Executor()
+        exe.run(startup, scope=scope, fetch_list=[])
+        exe.run(t_startup, scope=scope, fetch_list=[])
+        t_before = np.array(scope.find_var("t_w"), copy=True)
+        s_before = np.array(scope.find_var("s_w"), copy=True)
+
+        comp = slim.Compressor(
+            None, scope, train,
+            train_reader=_reader(), train_feed_list=["img", "label"],
+            train_fetch_list=[loss.name], teacher_programs=[teacher],
+            train_optimizer=popt.SGD(learning_rate=0.1),
+            distiller_optimizer=popt.SGD(learning_rate=0.1), epoch=1)
+        comp.add_strategy(slim.DistillationStrategy(
+            distillers=[
+                slim.L2Distiller(s_feat.name, t_logits.name),
+                slim.SoftLabelDistiller(s_feat.name, t_logits.name,
+                                        student_temperature=2.0,
+                                        teacher_temperature=2.0)],
+            start_epoch=0, end_epoch=1))
+        comp.run()
+        # teacher untrained, student trained
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var("t_w")), t_before)
+        assert np.abs(np.asarray(scope.find_var("s_w"))
+                      - s_before).max() > 0
+
+
+# -- NAS ---------------------------------------------------------------------
+class _TinySpace(slim.SearchSpace):
+    """Token controls hidden width of a 1-layer net."""
+
+    WIDTHS = (4, 8, 16)
+
+    def init_tokens(self):
+        return [2]
+
+    def range_table(self):
+        return [3]
+
+    def create_net(self, tokens):
+        width = self.WIDTHS[tokens[0]]
+        train = Program()
+        startup = Program()
+        with program_guard(train, startup):
+            img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+            label = layers.data("label", shape=[1], dtype="int64")
+            h = layers.fc(layers.flatten(img), size=width, act="relu",
+                          param_attr=fluid.ParamAttr(name=f"nas_w{width}"))
+            logits = layers.fc(h, size=4,
+                               param_attr=fluid.ParamAttr(
+                                   name=f"nas_o{width}"))
+            loss = layers.reduce_mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            acc = layers.accuracy(layers.softmax(logits), label)
+        eval_p = train.clone(for_test=True)
+        return (startup, train, eval_p, [loss.name], [acc.name],
+                _reader(), _reader(1))
+
+
+def test_controller_server_agent_roundtrip():
+    c = slim.SAController(seed=1)
+    c.reset([4, 4], init_tokens=[1, 1])
+    server = slim.ControllerServer(c).start()
+    try:
+        agent = slim.SearchAgent(*server.address)
+        t = agent.next_tokens()
+        assert len(t) == 2 and all(0 <= x < 4 for x in t)
+        t2 = agent.update(t, 1.0)
+        assert len(t2) == 2
+    finally:
+        server.close()
+
+
+def test_light_nas_strategy():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc,
+                           search_space=_TinySpace())
+        comp.add_strategy(slim.LightNASStrategy(
+            controller=slim.SAController(seed=2), start_epoch=0,
+            end_epoch=3, metric_name="acc"))
+        ctx = comp.run()
+        assert ctx.get("nas_best_tokens") is not None
+        assert ctx.get("nas_best_reward") > float("-inf")
+
+
+# -- quantization strategy + YAML config -------------------------------------
+def test_quantization_strategy_from_yaml(tmp_path):
+    cfg = tmp_path / "compress.yaml"
+    cfg.write_text("""
+version: 1.0
+strategies:
+    quant:
+        class: QuantizationStrategy
+        start_epoch: 0
+        end_epoch: 1
+        weight_bits: 8
+compressor:
+    epoch: 1
+    strategies: [quant]
+""")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.config(str(cfg))
+        assert isinstance(comp.strategies[0], slim.QuantizationStrategy)
+        ctx = comp.run()
+        frozen = ctx.get("quantized_eval_program")
+        assert frozen is not None
+        types = [op.type for op in frozen.global_block().ops]
+        assert not any(t == "fake_quantize_dequantize_abs_max" and
+                       frozen.global_block().var(
+                           op.input("X")[0]).persistable
+                       for t, op in zip(types, frozen.global_block().ops))
+
+
+# -- checkpoint resume -------------------------------------------------------
+def test_compressor_checkpoint_resume(tmp_path):
+    scope = Scope()
+    with unique_name.guard(), scope_guard(scope), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc,
+                           checkpoint_path=str(tmp_path), epoch=2)
+        ctx = comp.run()
+        assert os.path.isdir(os.path.join(str(tmp_path), "1"))
+    scope2 = Scope()
+    with unique_name.guard(), scope_guard(scope2), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope2)
+        comp2 = _compressor(scope2, train, eval_p, loss, acc,
+                            checkpoint_path=str(tmp_path), epoch=2)
+        ctx2 = comp2.run()          # resumes past epoch 1 → trains nothing
+        assert ctx2.epoch_id >= 1
+
+
+def test_prune_checkpoint_resume_reapplies_masks(tmp_path):
+    """Resume past the prune epoch must re-create mask surgery so pruned
+    channels stay dead (review finding: masks silently lost on resume)."""
+    scope = Scope()
+    with unique_name.guard(), scope_guard(scope), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc,
+                           checkpoint_path=str(tmp_path), epoch=2)
+        comp.add_strategy(slim.UniformPruneStrategy(
+            start_epoch=0, end_epoch=1, target_ratio=0.5,
+            pruned_params=r"conv.*weights"))
+        comp.run()
+    scope2 = Scope()
+    with unique_name.guard(), scope_guard(scope2), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope2)
+        comp2 = _compressor(scope2, train, eval_p, loss, acc,
+                            checkpoint_path=str(tmp_path), epoch=3)
+        comp2.add_strategy(slim.UniformPruneStrategy(
+            start_epoch=0, end_epoch=1, target_ratio=0.5,
+            pruned_params=r"conv.*weights"))
+        ctx2 = comp2.run()         # resumes at epoch 2, trains one epoch
+        # masks restored and the optimize graph masks gradients: pruned
+        # channels still exactly zero after the resumed training epoch
+        w = np.asarray(scope2.find_var("conv1_weights"))
+        assert (np.abs(w.reshape(8, -1)).sum(1) == 0).sum() == 4
+        masked_ops = [op.type for op in
+                      ctx2.optimize_graph.global_block().ops]
+        assert "elementwise_mul" in masked_ops
+
+
+def test_distillation_teacher_prefix_renames_and_copies_scope():
+    scope = Scope()
+    with scope_guard(scope):
+        train = Program()
+        startup = Program()
+        with program_guard(train, startup):
+            img = layers.data("img", shape=[4], dtype="float32")
+            label = layers.data("label", shape=[1], dtype="int64")
+            s_out = layers.fc(img, size=4,
+                              param_attr=fluid.ParamAttr(name="shared_w"))
+            loss = layers.reduce_mean(
+                layers.softmax_with_cross_entropy(s_out, label))
+        teacher = Program()
+        t_startup = Program()
+        with program_guard(teacher, t_startup):
+            t_img = layers.data("img", shape=[4], dtype="float32")
+            # same param name as the student → needs the prefix
+            t_out = layers.fc(t_img, size=4,
+                              param_attr=fluid.ParamAttr(name="shared_w"))
+        exe = Executor()
+        exe.run(startup, scope=scope, fetch_list=[])
+        exe.run(t_startup, scope=scope, fetch_list=[])  # teacher weights
+        comp = slim.Compressor(
+            None, scope, train,
+            train_reader=lambda: iter([[(np.ones(4, np.float32),
+                                         np.int64(0))] * 2]),
+            train_feed_list=["img", "label"],
+            train_fetch_list=[loss.name], teacher_programs=[teacher],
+            train_optimizer=popt.SGD(learning_rate=0.1), epoch=1)
+        comp.add_strategy(slim.DistillationStrategy(
+            distillers=[slim.L2Distiller(s_out.name,
+                                         "teacher_" + t_out.name)],
+            start_epoch=0, end_epoch=1, teacher_prefix="teacher_",
+            data_name_map={"img": "img"}))
+        comp.run()   # must not KeyError on teacher_shared_w
+        assert scope.find_var("teacher_shared_w") is not None
+
+
+def test_checkpoint_preserves_controller_state(tmp_path):
+    """SA search state must survive resume (review finding: controller
+    reset discarded best_tokens)."""
+    scope = Scope()
+    with unique_name.guard(), scope_guard(scope), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc,
+                           checkpoint_path=str(tmp_path), epoch=2)
+        comp.add_strategy(slim.AutoPruneStrategy(
+            start_epoch=0, end_epoch=6, target_ratio=0.5,
+            pruned_params=r"conv.*weights",
+            controller=slim.SAController(seed=5)))
+        comp.run()                      # 2 of 6 search epochs, checkpoint
+        best_before = comp.strategies[0]._controller.best_tokens
+        assert best_before
+    scope2 = Scope()
+    with unique_name.guard(), scope_guard(scope2), \
+            program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope2)
+        comp2 = _compressor(scope2, train, eval_p, loss, acc,
+                            checkpoint_path=str(tmp_path), epoch=3)
+        comp2.add_strategy(slim.AutoPruneStrategy(
+            start_epoch=0, end_epoch=6, target_ratio=0.5,
+            pruned_params=r"conv.*weights",
+            controller=slim.SAController(seed=99)))
+        ctrl = comp2.strategies[0]._controller
+        comp2.run()
+        # the resumed controller carried over the first run's chain
+        # (fresh seed-99 controller state was replaced by the pickle)
+        assert comp2.strategies[0]._controller.max_reward >= \
+            max(0.0, float("-inf"))
+        assert comp2.strategies[0]._controller._iter >= 2
+
+
+def test_eval_program_qdq_is_test_mode():
+    """Eval QDQ must not update EMA trackers (review finding)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        train, eval_p, loss, acc = _setup(scope)
+        comp = _compressor(scope, train, eval_p, loss, acc)
+        comp.add_strategy(slim.QuantizationStrategy(
+            start_epoch=0, end_epoch=1))
+        ctx = comp.run()
+        for op in ctx.eval_graph.program.global_block().ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                assert op.attrs.get("is_test") is True
+        trackers = [n for n in
+                    (v.name for v in ctx.train_graph.program.list_vars())
+                    if n.endswith(".quant_state")]
+        assert trackers
+        before = {n: np.array(scope.find_var(n), copy=True)
+                  for n in trackers}
+        ctx.run_eval_graph()
+        for n in trackers:
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(n)), before[n])

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Build + serialize the linear-regression demo programs for the native
+C++ trainer (ref ``paddle/fluid/train/demo/demo_network.py`` which saves
+``startup_program``/``main_program`` for ``demo_trainer.cc``).
+
+Usage: python tools/export_demo_program.py [outdir]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(outdir="."):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer as popt
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, act=None)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        popt.SGD(learning_rate=0.01).minimize(loss, startup_program=startup)
+    out = Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "startup_program").write_bytes(startup.serialize_to_string())
+    (out / "main_program").write_bytes(main_p.serialize_to_string())
+    print(loss.name)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

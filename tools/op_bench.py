@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Single-op micro-benchmark driver (ref ``paddle/fluid/operators/benchmark/
+op_tester.cc`` — config-driven op benchmark — and ``operators/jit/
+benchmark.cc`` — kernel throughput table).
+
+Builds a one-op program, runs it through the block executor (so the op is
+measured as XLA compiles it, fusions and all), and prints one JSON line per
+benchmark: wall ms/op plus achieved GFLOP/s (matmul/conv) or GB/s
+(bandwidth-bound ops).
+
+Usage:
+    python tools/op_bench.py --op matmul --shapes X=1024x1024,Y=1024x1024
+    python tools/op_bench.py --op conv2d --shapes Input=8x64x56x56,Filter=64x64x3x3 --attrs '{"paddings":[1,1]}'
+    python tools/op_bench.py --config configs.yaml       # list of the above
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: default input slots per op family when --shapes names only one tensor
+DEFAULT_SLOTS = {
+    "matmul": ("X", "Y"), "mul": ("X", "Y"), "elementwise_add": ("X", "Y"),
+    "elementwise_mul": ("X", "Y"), "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"), "softmax": ("X",),
+    "layer_norm": ("X",), "relu": ("X",), "reduce_sum": ("X",),
+    "transpose2": ("X",), "lookup_table": ("W", "Ids"),
+}
+
+_INT_SLOTS = {"Ids", "Label", "Indices"}
+
+
+def _parse_shapes(spec):
+    """'X=1024x1024,Y=1024x1024' → {'X': (1024, 1024), ...}"""
+    out = {}
+    for part in spec.split(","):
+        name, dims = part.split("=")
+        out[name] = tuple(int(d) for d in dims.split("x"))
+    return out
+
+
+def _flops(op, shapes, attrs):
+    """Dense-math FLOP estimate; None → report GB/s instead."""
+    if op in ("matmul", "mul"):
+        x, y = shapes.get("X"), shapes.get("Y")
+        batch = int(np.prod(x[:-2])) if len(x) > 2 else 1
+        return 2 * batch * x[-2] * x[-1] * y[-1]
+    if op in ("conv2d", "depthwise_conv2d"):
+        i, f = shapes["Input"], shapes["Filter"]
+        stride = (attrs or {}).get("strides", [1, 1])
+        oh = i[2] // stride[0]
+        ow = i[3] // stride[1]
+        return 2 * i[0] * f[0] * f[1] * f[2] * f[3] * oh * ow
+    return None
+
+
+def bench_op(op_type, shapes, attrs=None, dtype="float32", repeat=50,
+             warmup=5, grad=False):
+    """Returns the result record (also usable as a library)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor, calc_gradient
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.framework.registry import has_op
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    if not has_op(op_type):
+        raise SystemExit(f"op {op_type!r} has no registered lowering")
+
+    attrs = attrs or {}
+    scope = Scope()
+    rng = np.random.RandomState(0)
+    with scope_guard(scope), program_guard(Program(), Program()):
+        feed = {}
+        inputs = {}
+        block = fluid.default_main_program().global_block()
+        for slot, shape in shapes.items():
+            is_int = slot in _INT_SLOTS
+            dt = "int64" if is_int else dtype
+            v = layers.data(slot.lower(), shape=list(shape), dtype=dt,
+                            append_batch_size=False)
+            v.stop_gradient = not grad or is_int
+            inputs[slot] = [v.name]
+            feed[slot.lower()] = (
+                rng.randint(0, shape[-1], shape).astype(np.int64) if is_int
+                else rng.rand(*shape).astype(dtype))
+        out = block.create_var(name="bench_out", dtype=dtype)
+        outputs = {next(iter(_out_slot(op_type))): [out.name]}
+        block.append_op(op_type, inputs=inputs, outputs=outputs, attrs=attrs)
+        fetch = [out.name]
+        if grad:
+            loss = layers.reduce_sum(out)
+            gvars = calc_gradient(
+                loss, [block.var(n[0]) for s, n in inputs.items()
+                       if s not in _INT_SLOTS])
+            fetch = [g.name for g in gvars]
+        exe = Executor()
+        for _ in range(warmup):
+            exe.run(feed=feed, fetch_list=fetch)
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            res = exe.run(feed=feed, fetch_list=fetch)
+        dt_s = (time.perf_counter() - t0) / repeat
+
+    ms = dt_s * 1e3
+    rec = {"op": op_type + ("_grad" if grad else ""),
+           "shapes": {k: list(v) for k, v in shapes.items()},
+           "dtype": dtype, "ms": round(ms, 4), "repeat": repeat}
+    fl = _flops(op_type, shapes, attrs)
+    if fl:
+        rec["gflops"] = round(fl * (3 if grad else 1) / dt_s / 1e9, 2)
+    else:
+        nbytes = sum(int(np.prod(s)) for s in shapes.values()) * \
+            np.dtype(dtype).itemsize
+        rec["gb_s"] = round(2 * nbytes / dt_s / 1e9, 2)
+    return rec
+
+
+def _out_slot(op_type):
+    return {"conv2d": ["Output"], "depthwise_conv2d": ["Output"],
+            "layer_norm": ["Y"], "lookup_table": ["Out"]}.get(op_type,
+                                                              ["Out"])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--op")
+    ap.add_argument("--shapes", help="Slot=DxD,Slot=DxD")
+    ap.add_argument("--attrs", default="{}", help="JSON op attrs")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--grad", action="store_true",
+                    help="benchmark forward+backward")
+    ap.add_argument("--config", help="YAML list of {op, shapes, attrs...}")
+    args = ap.parse_args(argv)
+
+    jobs = []
+    if args.config:
+        import yaml
+        for item in yaml.safe_load(open(args.config)):
+            item["shapes"] = {k: tuple(v) if isinstance(v, list)
+                              else _parse_shapes(f"X={v}")["X"]
+                              for k, v in item["shapes"].items()}
+            jobs.append(item)
+    else:
+        if not args.op or not args.shapes:
+            ap.error("--op and --shapes required without --config")
+        jobs.append({"op": args.op, "shapes": _parse_shapes(args.shapes),
+                     "attrs": json.loads(args.attrs), "dtype": args.dtype,
+                     "repeat": args.repeat, "grad": args.grad})
+    for job in jobs:
+        op = job.pop("op")
+        print(json.dumps(bench_op(op, **job)))
+
+
+if __name__ == "__main__":
+    main()
