@@ -1,3 +1,12 @@
 """Contrib subpackages (ref ``python/paddle/fluid/contrib/``)."""
 
-from . import memory_usage_calc, model_stat, op_frequence, slim  # noqa
+from . import (extend_optimizer, layers, memory_usage_calc,  # noqa
+               model_stat, op_frequence, quantize, reader, slim, utils)
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa
+from .float16_transpiler import Float16Transpiler  # noqa
+from .inferencer import Inferencer  # noqa
+from .quantize import QuantizeTranspiler  # noqa
+from .reader import distributed_batch_reader  # noqa
+from .trainer import (BeginEpochEvent, BeginStepEvent,  # noqa
+                      CheckpointConfig, EndEpochEvent, EndStepEvent,
+                      Trainer)

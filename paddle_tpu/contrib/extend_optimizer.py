@@ -1,0 +1,79 @@
+"""Decoupled weight decay optimizer extension (ref ``python/paddle/fluid/
+contrib/extend_optimizer/extend_optimizer_with_weight_decay.py``):
+``extend_with_decoupled_weight_decay(Adam)`` returns an AdamW-style class
+whose minimize subtracts ``coeff * param`` from each parameter *outside*
+the gradient-based update (Loshchilov & Hutter decoupling)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..framework.core import Variable
+from ..optimizer import Optimizer
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin applied in front of a concrete Optimizer class
+    (ref extend_optimizer_with_weight_decay.py:20)."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, (float, Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = coeff
+        super().__init__(**kwargs)
+
+    def _scale_parameters(self, params_and_grads):
+        """(param, grad, param*coeff) triples for params that decay."""
+        if isinstance(self._coeff, float) and self._coeff == 0.0:
+            return []
+        scaled = []
+        for param, grad in params_and_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(param.name):
+                continue
+            scaled.append((param, grad, param * self._coeff))
+            self._params_name.add(param.name)
+        return scaled
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        # decay BEFORE the update, decoupled from the gradient path
+        for param, grad, scaled in self._scale_parameters(params_grads):
+            updated = layers.elementwise_sub(x=param, y=scaled)
+            layers.assign(input=updated, output=param)
+        optimize_ops = self.apply_optimize(loss, startup_program,
+                                           params_grads)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(self._params_name)])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Build an AdamW-style class from any Optimizer subclass (ref
+    extend_optimizer_with_weight_decay.py:102).
+
+    >>> AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+    >>> optimizer = AdamW(learning_rate=1e-3, coeff=0.01)
+    """
+    if not issubclass(base_optimizer, Optimizer):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay=None, coeff=None, **kwargs):
+            if coeff is None:
+                coeff = 0.0 if weight_decay is None else weight_decay
+            super().__init__(coeff=coeff, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

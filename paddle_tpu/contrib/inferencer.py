@@ -1,0 +1,45 @@
+"""High-level Inferencer API (ref ``python/paddle/fluid/contrib/
+inferencer.py``: Inferencer(infer_func, param_path).infer(inputs))."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import io as pio
+from ..framework import unique_name
+from ..framework.core import Program, Variable, program_guard
+from ..framework.executor import Executor
+from ..framework.scope import Scope
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    """Builds the inference program from ``infer_func`` and loads trained
+    params from ``param_path`` (ref inferencer.py:27)."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel: bool = False):
+        self.place = place
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup), \
+                unique_name.guard():
+            out = infer_func()
+            self.predict_var = out if isinstance(out, Variable) else out[0]
+        self.inference_program = \
+            self.inference_program.clone(for_test=True)
+        self.exe = Executor(place)
+        pio.load_params(self.exe, dirname=param_path,
+                        main_program=self.inference_program,
+                        scope=self.scope)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        """inputs: feed-var name → numpy array (ref inferencer.py:85)."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        return self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=[self.predict_var.name],
+                            scope=self.scope, return_numpy=return_numpy)

@@ -1,0 +1,6 @@
+"""Contrib layer collection (ref ``python/paddle/fluid/contrib/layers/``)."""
+
+from .metric_op import ctr_metric_bundle  # noqa
+from .nn import fused_elemwise_activation  # noqa
+from .rnn_impl import (BasicGRUUnit, BasicLSTMUnit, basic_gru,  # noqa
+                       basic_lstm)

@@ -1,0 +1,20 @@
+"""Contrib metric layers (ref ``python/paddle/fluid/contrib/layers/
+metric_op.py``)."""
+
+from __future__ import annotations
+
+from ... import layers
+
+__all__ = ["ctr_metric_bundle"]
+
+
+def ctr_metric_bundle(input, label):
+    """CTR metric bundle (ref metric_op.py:30): returns
+    (local_sqrerr, local_abserr, local_prob, local_q) accumulator-style
+    sums a CTR trainer aggregates across batches/workers."""
+    sub = layers.elementwise_sub(input, label)
+    sqrerr = layers.reduce_sum(layers.square(sub))
+    abserr = layers.reduce_sum(layers.abs(sub))
+    prob = layers.reduce_sum(input)
+    q = layers.reduce_sum(layers.elementwise_mul(input, label))
+    return sqrerr, abserr, prob, q

@@ -1,0 +1,28 @@
+"""Contrib NN layers (ref ``python/paddle/fluid/contrib/layers/nn.py``)."""
+
+from __future__ import annotations
+
+from ... import layers
+from ...layer_helper import LayerHelper
+
+__all__ = ["fused_elemwise_activation"]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """ref contrib/layers/nn.py fused_elemwise_activation → the
+    fused_elemwise_activation op (XLA fuses the chain anyway; the op keeps
+    the exact fluid semantics incl. the intermediate output)."""
+    if isinstance(functor_list, str):
+        functor_list = [functor_list]
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    intermediate = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out, "IntermediateOut": intermediate},
+        attrs={"axis": axis, "scale": scale,
+               "functor_list": list(functor_list),
+               "save_intermediate_out": bool(save_intermediate_out)})
+    return out

@@ -1,0 +1,50 @@
+"""Int8 quantization transpiler (ref ``python/paddle/fluid/contrib/
+quantize/quantize_transpiler.py`` QuantizeTranspiler: training_transpile
+inserts fake quant/dequant before minimize, freeze_program bakes trained
+scales for int8 inference).
+
+This is the pre-slim program-level API; the heavy lifting is shared with
+``contrib.slim.quantization`` — the same QDQ op rewrite and freeze pass,
+exposed under the transpiler names the reference ships."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework import core
+from ..framework.core import Program
+from .slim.quantization import (QuantizationFreezePass,
+                                QuantizationTransformPass)
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    """ref quantize_transpiler.py:80."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 window_size: int = 10000, moving_rate: float = 0.9):
+        if activation_quantize_type == "range_abs_max":
+            # the windowed tracker trains the same EMA-style scale; map to
+            # the moving-average QDQ op family
+            activation_quantize_type = "moving_average_abs_max"
+        self._transform = QuantizationTransformPass(
+            weight_bits, activation_bits, activation_quantize_type,
+            weight_quantize_type, moving_rate)
+        self._wbits = weight_bits
+        self._w_type = weight_quantize_type
+
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        """Insert QDQ training ops; call BEFORE optimizer.minimize (ref
+        quantize_transpiler.py:146)."""
+        self._transform.apply(program, startup_program)
+
+    def freeze_program(self, program: Program, place=None, scope=None):
+        """Bake trained scales for inference (ref
+        quantize_transpiler.py:223)."""
+        from ..framework.scope import global_scope
+        return QuantizationFreezePass(
+            scope or global_scope(), self._wbits, self._w_type).apply(program)

@@ -1,0 +1,24 @@
+"""Distributed reader decorator (ref ``python/paddle/fluid/contrib/reader/
+distributed_reader.py``): shard a batch reader across trainers by stride so
+each process sees a disjoint slice of the stream."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer keeps every num_trainers-th batch, offset by its id
+    (env contract PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, same as the
+    launcher's)."""
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def decorated():
+        for idx, batch in enumerate(batch_reader()):
+            if idx % trainers_num == trainer_id:
+                yield batch
+
+    return decorated

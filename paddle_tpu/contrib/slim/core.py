@@ -13,11 +13,14 @@ rebuild is one fresh XLA compilation, after which steps run at full speed
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 from ... import io as pio
 from ...framework import core
@@ -139,7 +142,9 @@ class Context:
         return f.name if hasattr(f, "name") else f
 
     # -- eval loop (ref Context.run_eval_graph) ------------------------------
-    def run_eval_graph(self, sampled_rate=None, cached_id=0):
+    def run_eval_graph(self, sampled_rate=None, cached_id=0, record=True):
+        """``record=False`` keeps probe evals (e.g. sensitivity sweeps)
+        out of the per-epoch metric history."""
         assert self.eval_graph is not None and self.eval_reader is not None
         fetches = [self._fetch_name(f) for f in self.eval_fetch_list]
         feed_names = [self._fetch_name(f) for f in self.eval_feed_list]
@@ -152,8 +157,10 @@ class Context:
             totals += [float(np.asarray(o).mean()) for o in outs]
             count += 1
         result = (totals / max(count, 1)).tolist()
-        for name, val in zip(fetches, result):
-            self.eval_results.setdefault(name, []).append(val)
+        if record:
+            for name, val in zip(fetches, result):
+                self.eval_results.setdefault(name, []).append(val)
+            self.k_v["_evaled_epoch"] = self.epoch_id
         return result[0], fetches[0]
 
     def eval_converged(self, metric_name, delta=0.001):
@@ -283,9 +290,19 @@ class Compressor:
             for s in self.strategies:
                 s.on_batch_begin(context)
             feed = _make_feed(context.optimize_graph, feed_names, data)
-            context.executor.run(context.optimize_graph, feed=feed,
-                                 fetch_list=context._optimize_fetches,
-                                 scope=context.scope)
+            # metrics leave the device only on log steps (ref compressor.py
+            # log_period; saves the per-step D2H transfer otherwise)
+            log_step = batch_id % self.log_period == 0
+            outs = context.executor.run(
+                context.optimize_graph, feed=feed,
+                fetch_list=context._optimize_fetches if log_step else [],
+                scope=context.scope)
+            if log_step:
+                vals = ", ".join(
+                    f"{n}={float(np.asarray(v).mean()):.6g}"
+                    for n, v in zip(context._optimize_fetches, outs))
+                _logger.info("epoch %d batch %d: %s",
+                             context.epoch_id, batch_id, vals)
             for s in self.strategies:
                 s.on_batch_end(context)
 
@@ -307,7 +324,11 @@ class Compressor:
             context.skip_training = False
             for s in self.strategies:
                 s.on_epoch_end(context)
-            if context.eval_graph is not None and context.eval_reader:
+            if context.eval_graph is not None and context.eval_reader and \
+                    context.k_v.get("_evaled_epoch") != epoch:
+                # skip when a strategy already scored this epoch (AutoPrune/
+                # LightNAS) — their eval reflects the candidate, ours would
+                # measure the restored weights
                 context.run_eval_graph()
             self._save_checkpoint(context)
         for s in self.strategies:

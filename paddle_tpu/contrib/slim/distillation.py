@@ -50,6 +50,12 @@ def merge_programs(student: core.Program, teacher: core.Program,
 
     for name, var in src.vars.items():
         new = _name(name)
+        if var.persistable and not prefix and name not in data_name_map \
+                and dst.has_var(new):
+            raise ValueError(
+                f"teacher parameter {name!r} collides with a student var; "
+                "pass teacher_prefix= (and data_name_map= for the shared "
+                "inputs) so the teacher keeps its own weights")
         if not dst.has_var(new):
             v = dst.create_var(name=new, shape=var.shape, dtype=var.dtype,
                                persistable=var.persistable)

@@ -203,9 +203,12 @@ class LightNASStrategy(Strategy):
             return
         space = context.search_space
         net = None
-        for _ in range(self._max_try_times):
+        for attempt in range(self._max_try_times):
             net = space.create_net(self._current_tokens)
-            if self._within_budget(net[2], space):
+            if self._within_budget(net[2], space) or \
+                    attempt == self._max_try_times - 1:
+                # keep net/_current_tokens consistent even when the budget
+                # was never met (the reward is zeroed at epoch end)
                 break
             self._current_tokens = self._agent.next_tokens()
         (startup, train_p, eval_p, train_fetch, eval_fetch,

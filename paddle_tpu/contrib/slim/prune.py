@@ -264,7 +264,7 @@ class SensitivePruneStrategy(PruneStrategy):
         if self.sensitivities_file and os.path.exists(self.sensitivities_file):
             with open(self.sensitivities_file, "rb") as f:
                 return pickle.load(f)
-        baseline, _ = context.run_eval_graph()
+        baseline, _ = context.run_eval_graph(record=False)
         sens: Dict[str, Dict[float, float]] = {}
         for name in self._candidate_params(context.train_graph):
             backup = np.array(context.scope.find_var(name), copy=True)
@@ -276,7 +276,7 @@ class SensitivePruneStrategy(PruneStrategy):
                                       self.pruner.axis_of(name), idx)
                 context.scope.set_var(name,
                                       (backup * mask).astype(backup.dtype))
-                metric, _ = context.run_eval_graph()
+                metric, _ = context.run_eval_graph(record=False)
                 sens[name][round(ratio, 4)] = \
                     (baseline - metric) / (abs(baseline) + 1e-12)
                 ratio += self.delta_rate
@@ -458,12 +458,23 @@ def materialize_pruned_program(program, scope):
                 scope.set_var(bname, np.ascontiguousarray(bv[keep]))
                 block.var(bname).shape = (len(keep),)
                 _drop_mask(block, graph, bname)
-        # slice consumer input channels
+        # slice consumer input channels (incl. any still-attached mask of
+        # the consumer's own pruning, which must track the new shape)
         for nxt in frontier:
             fname = _strip(nxt.input("Filter")[0])
             fv = np.asarray(scope.find_var(fname))
             scope.set_var(fname, np.ascontiguousarray(fv[:, keep]))
-            block.var(fname).shape = tuple(np.shape(scope.find_var(fname)))
+            new_shape = tuple(np.shape(scope.find_var(fname)))
+            block.var(fname).shape = new_shape
+            fmask = scope.find_var(fname + PruneStrategy.MASK_SUFFIX)
+            if fmask is not None:
+                scope.set_var(fname + PruneStrategy.MASK_SUFFIX,
+                              np.ascontiguousarray(
+                                  np.asarray(fmask)[:, keep]))
+                for aux in (fname + PruneStrategy.MASK_SUFFIX,
+                            fname + PruneStrategy.PRUNED_SUFFIX):
+                    if block.has_var(aux):
+                        block.var(aux).shape = new_shape
         # conv output var channel dim
         for out_name in op.output("Output"):
             v = block.var(out_name)

@@ -1,0 +1,242 @@
+"""High-level Trainer API (ref ``python/paddle/fluid/contrib/trainer.py``:
+Trainer(train_func, optimizer_func) with epoch/step events, checkpointing,
+test(), save_params/save_inference_model; the book-chapter fluent API).
+
+The train loop compiles to the same single jitted block as the raw
+Executor path — the event callbacks run host-side between steps and only
+the metrics the handler asked for are fetched (BeginStepEvent.fetch_metrics
+gates the device→host transfer, same as the reference)."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+from .. import io as pio
+from ..data.feeder import DataFeeder
+from ..framework import core, unique_name
+from ..framework.core import Program, Variable, program_guard
+from ..framework.executor import Executor
+from ..framework.scope import Scope, scope_guard
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    """ref trainer.py:40."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    """ref trainer.py:52."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    """ref trainer.py:64; set ``fetch_metrics=False`` to skip the
+    device→host metric transfer for this step."""
+
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    """ref trainer.py:83."""
+
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """ref trainer.py:100."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_num_checkpoints: int = 3, epoch_interval: int = 1,
+                 step_interval: int = 10):
+        self.checkpoint_dir = checkpoint_dir or \
+            os.path.join(os.getcwd(), "checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, epoch_interval)
+        self.step_interval = max(1, step_interval)
+        self.epoch_id = 0
+        self.step_id = 0
+
+
+class Trainer:
+    """ref trainer.py:169.
+
+    train_func: () → loss Variable or [loss, *metrics]
+    optimizer_func: () → Optimizer
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path: Optional[str] = None, place=None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.place = place
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.__stop = False
+
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            outs = train_func()
+            if isinstance(outs, Variable):
+                outs = [outs]
+            self.train_func_outputs: List[Variable] = list(outs)
+            loss = outs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(loss, startup_program=self.startup_program)
+        self.test_program = self.train_program.clone(for_test=True)
+
+        self.exe = Executor(place)
+        with self._prog_and_scope_guard():
+            self.exe.run(self.startup_program, scope=self.scope,
+                         fetch_list=[])
+        if param_path and os.path.isdir(param_path):
+            pio.load_persistables(self.exe, dirname=param_path,
+                                  main_program=self.startup_program,
+                                  scope=self.scope)
+        if self.checkpoint_cfg:
+            self._load_checkpoint()
+
+    def _prog_and_scope_guard(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            with program_guard(self.train_program, self.startup_program), \
+                    scope_guard(self.scope):
+                yield
+        return guard()
+
+    def stop(self):
+        """ref trainer.py:373 — stop training at the next step."""
+        self.__stop = True
+
+    # -- train/test ----------------------------------------------------------
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader=None, feed_order: Optional[Sequence[str]] = None):
+        """ref trainer.py:379."""
+        feed_vars = _feed_var_list(self.train_program, feed_order)
+        feeder = DataFeeder(feed_vars, self.place)
+        fetch = [v.name for v in self.train_func_outputs]
+        start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg \
+            else 0
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self.__stop:
+                    self._save_checkpoint(epoch_id, step_id)
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                metrics = self.exe.run(
+                    self.train_program, feed=feeder.feed(data),
+                    fetch_list=fetch if begin.fetch_metrics else [],
+                    scope=self.scope)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                if self.checkpoint_cfg and \
+                        step_id % self.checkpoint_cfg.step_interval == 0:
+                    self._save_checkpoint(epoch_id, step_id)
+            event_handler(EndEpochEvent(epoch_id))
+            if self.checkpoint_cfg and \
+                    epoch_id % self.checkpoint_cfg.epoch_interval == 0:
+                self._save_checkpoint(epoch_id, 0)
+
+    def test(self, reader, feed_order: Optional[Sequence[str]] = None):
+        """Mean of the train_func metrics over the reader (ref
+        trainer.py:407)."""
+        import numpy as np
+        feed_vars = _feed_var_list(self.test_program, feed_order)
+        feeder = DataFeeder(feed_vars, self.place)
+        fetch = [v.name for v in self.train_func_outputs]
+        totals = np.zeros(len(fetch), np.float64)
+        count = 0
+        for data in reader():
+            outs = self.exe.run(self.test_program, feed=feeder.feed(data),
+                                fetch_list=fetch, scope=self.scope)
+            totals += [float(np.asarray(o).mean()) for o in outs]
+            count += 1
+        return (totals / max(count, 1)).tolist()
+
+    # -- persistence ---------------------------------------------------------
+    def save_params(self, param_path: str):
+        """ref trainer.py:420."""
+        with self._prog_and_scope_guard():
+            pio.save_persistables(self.exe, dirname=param_path,
+                                  scope=self.scope)
+
+    def save_inference_model(self, param_path: str,
+                             feeded_var_names: Sequence[str],
+                             target_var_indexes: Sequence[int]):
+        """ref trainer.py:434 — targets picked from train_func outputs by
+        index."""
+        with self._prog_and_scope_guard():
+            pio.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, main_program=self.train_program,
+                scope=self.scope)
+
+    # -- checkpoints ---------------------------------------------------------
+    def _ckpt_dir(self, serial):
+        return os.path.join(self.checkpoint_cfg.checkpoint_dir, str(serial))
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        path = self._ckpt_dir(epoch_id)
+        pio.save_persistables(self.exe, dirname=path,
+                              main_program=self.train_program,
+                              scope=self.scope)
+        with open(os.path.join(path, "__meta__"), "w") as f:
+            f.write(f"{epoch_id} {step_id}")
+        serials = sorted(int(d) for d in os.listdir(cfg.checkpoint_dir)
+                         if d.isdigit())
+        for old in serials[:-cfg.max_num_checkpoints]:
+            import shutil
+            shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
+
+    def _load_checkpoint(self):
+        cfg = self.checkpoint_cfg
+        if not os.path.isdir(cfg.checkpoint_dir):
+            return
+        serials = sorted(int(d) for d in os.listdir(cfg.checkpoint_dir)
+                         if d.isdigit())
+        if not serials:
+            return
+        path = self._ckpt_dir(serials[-1])
+        pio.load_persistables(self.exe, dirname=path,
+                              main_program=self.train_program,
+                              scope=self.scope)
+        with open(os.path.join(path, "__meta__")) as f:
+            epoch_id, step_id = map(int, f.read().split())
+        cfg.epoch_id = epoch_id
+        cfg.step_id = step_id
+
+
+def _feed_var_list(program: Program, feed_order) -> List[Variable]:
+    """ref trainer.py:630 build_feed_var_list."""
+    block = program.global_block()
+    if feed_order is None:
+        feed_order = [v.name for v in block.vars.values()
+                      if getattr(v, "is_data", False)]
+        if not feed_order:
+            raise ValueError("pass feed_order: the program declares no "
+                             "data vars to infer it from")
+    if isinstance(feed_order, dict):
+        feed_order = [n for n, _ in
+                      sorted(feed_order.items(), key=lambda kv: kv[1])]
+    return [block.var(n) for n in feed_order]

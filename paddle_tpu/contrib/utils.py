@@ -1,0 +1,145 @@
+"""Contrib utilities (ref ``python/paddle/fluid/contrib/utils/``:
+hdfs_utils.py HDFSClient + multi_download/multi_upload shell wrappers,
+lookup_table_utils.py PS lookup-table checkpoint surgery).
+
+HDFSClient drives the ``hadoop fs`` CLI exactly as the reference does (the
+native runtime's fs layer shells out the same way, ref framework/io/
+shell.h); without a hadoop binary every call raises a clear error, so the
+API is importable/configurable on any box and functional where hadoop
+exists."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "convert_dist_to_sparse_program"]
+
+
+class HDFSClient:
+    """ref hdfs_utils.py HDFSClient — thin ``hadoop fs`` process wrapper."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[dict] = None):
+        self.pre_commands: List[str] = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.pre_commands.append(hadoop_bin)
+        self.pre_commands.append("fs")
+        for k, v in (configs or {}).items():
+            self.pre_commands += ["-D", f"{k}={v}"]
+        self._available = os.path.exists(hadoop_bin) or \
+            shutil.which(hadoop_bin) is not None
+
+    def _run(self, commands: List[str], retry_times: int = 5):
+        if not self._available:
+            raise RuntimeError(
+                f"hadoop binary {self.pre_commands[0]!r} not found; "
+                "HDFSClient needs a hadoop installation")
+        whole = self.pre_commands + commands
+        last = None
+        for _ in range(max(1, retry_times)):
+            proc = subprocess.run(whole, capture_output=True, text=True)
+            if proc.returncode == 0:
+                return True, proc.stdout
+            last = proc.stderr
+        return False, last
+
+    def is_exist(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-test", "-e", hdfs_path], retry_times=1)
+        return ok
+
+    def is_dir(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-test", "-d", hdfs_path], retry_times=1)
+        return ok
+
+    def delete(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-rm", "-r", "-skipTrash", hdfs_path])
+        return ok
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False) -> bool:
+        if overwrite and self.is_exist(hdfs_dst_path):
+            self.delete(hdfs_dst_path)
+        ok, _ = self._run(["-mv", hdfs_src_path, hdfs_dst_path])
+        return ok
+
+    def makedirs(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-mkdir", "-p", hdfs_path])
+        return ok
+
+    def ls(self, hdfs_path) -> List[str]:
+        ok, out = self._run(["-ls", hdfs_path])
+        if not ok:
+            return []
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def lsr(self, hdfs_path) -> List[str]:
+        ok, out = self._run(["-ls", "-R", hdfs_path])
+        if not ok:
+            return []
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5) -> bool:
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        ok, _ = self._run(["-put", local_path, hdfs_path], retry_times)
+        return ok
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False) -> bool:
+        if overwrite and os.path.exists(local_path):
+            shutil.rmtree(local_path, ignore_errors=True)
+        ok, _ = self._run(["-get", hdfs_path, local_path])
+        return ok
+
+
+def multi_download(client: HDFSClient, hdfs_path: str, local_path: str,
+                   trainer_id: int, trainers: int, multi_processes: int = 5):
+    """Shard-aware download: trainer i pulls every trainers-th file (ref
+    hdfs_utils.py multi_download)."""
+    files = sorted(client.lsr(hdfs_path))
+    mine = files[trainer_id::max(trainers, 1)]
+    out = []
+    os.makedirs(local_path, exist_ok=True)
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        if client.download(f, dst):
+            out.append(dst)
+    return out
+
+
+def multi_upload(client: HDFSClient, hdfs_path: str, local_path: str,
+                 multi_processes: int = 5, overwrite: bool = False):
+    """Upload every file under local_path (ref hdfs_utils.py
+    multi_upload)."""
+    uploaded = []
+    for root, _, names in os.walk(local_path):
+        for name in names:
+            src = os.path.join(root, name)
+            rel = os.path.relpath(src, local_path)
+            dst = os.path.join(hdfs_path, rel)
+            client.makedirs(os.path.dirname(dst))
+            if client.upload(dst, src, overwrite=overwrite):
+                uploaded.append(dst)
+    return uploaded
+
+
+def convert_dist_to_sparse_program(program):
+    """ref lookup_table_utils.py convert_dist_to_sparse_program: turn the
+    PS-transpiled trainer program's distributed_lookup_table pulls back
+    into local sparse lookup_table ops (for single-box inference over a
+    model trained on a PS cluster)."""
+    block = program.global_block()
+    for op in block.ops:
+        if op.type == "distributed_lookup_table":
+            op.type = "lookup_table"
+            op.attrs.pop("table_names", None)
+            op.attrs.pop("endpoints", None)
+            op.attrs["is_distributed"] = False
+            op.attrs["is_sparse"] = True
+    program._bump_version()
+    return program

@@ -1,0 +1,277 @@
+"""Contrib surface: extend_optimizer, QuantizeTranspiler, contrib.layers
+(basic_gru/basic_lstm/fused_elemwise_activation/ctr_metric_bundle),
+distributed reader, utils, Float16Transpiler, Trainer/Inferencer
+(ref python/paddle/fluid/contrib/ + paddle/contrib/float16/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import contrib
+from paddle_tpu.framework import Executor, unique_name
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _fresh():
+    return program_guard(Program(), Program())
+
+
+# -- extend_optimizer --------------------------------------------------------
+def test_decoupled_weight_decay_shrinks_params():
+    AdamW = contrib.extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=1, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name="w"))
+        loss = layers.mean(layers.square(y))
+        opt = AdamW(learning_rate=0.0, coeff=0.1)   # lr 0 isolates decay
+        opt.minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        w0 = np.array(scope.find_var("w"), copy=True)
+        exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        w1 = np.asarray(scope.find_var("w"))
+        np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-5)
+
+
+def test_decoupled_weight_decay_type_check():
+    AdamW = contrib.extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+    with pytest.raises(TypeError):
+        AdamW(learning_rate=0.1, coeff="bad")
+    with pytest.raises(TypeError):
+        contrib.extend_with_decoupled_weight_decay(object)
+
+
+# -- QuantizeTranspiler ------------------------------------------------------
+def test_quantize_transpiler_roundtrip():
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=2, filter_size=3)
+        out = layers.fc(layers.flatten(c), size=4)
+        main = fluid.default_main_program()
+        t = contrib.QuantizeTranspiler(
+            activation_quantize_type="range_abs_max")
+        t.training_transpile(main, fluid.default_startup_program())
+        types = [op.type for op in main.global_block().ops]
+        assert any("fake_quantize_dequantize" in t_ for t_ in types)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        exe.run(feed={"img": np.ones((2, 1, 8, 8), np.float32)},
+                fetch_list=[out], scope=scope)
+        frozen = t.freeze_program(main.clone(for_test=True), scope=scope)
+        # weight QDQ stripped, baked into the weight value
+        for op in frozen.global_block().ops:
+            if op.type.startswith("fake_quantize_dequantize_abs_max"):
+                assert not frozen.global_block().var(
+                    op.input("X")[0]).persistable
+
+
+# -- contrib layers ----------------------------------------------------------
+def test_fused_elemwise_activation_numeric():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[4], dtype="float32")
+        out = contrib.layers.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])
+        xv = np.array([[-2, -1, 1, 2]], np.float32)
+        yv = np.array([[1, 0, 0, -3]], np.float32)
+        r, = Executor().run(feed={"x": xv, "y": yv}, fetch_list=[out])
+        np.testing.assert_allclose(r, np.maximum(xv + yv, 0))
+
+
+def test_ctr_metric_bundle():
+    with _fresh(), scope_guard(Scope()):
+        p = layers.data("p", shape=[1], dtype="float32")
+        l = layers.data("l", shape=[1], dtype="float32")
+        sqrerr, abserr, prob, q = contrib.layers.ctr_metric_bundle(p, l)
+        pv = np.array([[0.3], [0.8]], np.float32)
+        lv = np.array([[0.0], [1.0]], np.float32)
+        res = Executor().run(feed={"p": pv, "l": lv},
+                             fetch_list=[sqrerr, abserr, prob, q])
+        np.testing.assert_allclose(res[0], ((pv - lv) ** 2).sum(), rtol=1e-6)
+        np.testing.assert_allclose(res[1], np.abs(pv - lv).sum(), rtol=1e-6)
+        np.testing.assert_allclose(res[2], pv.sum(), rtol=1e-6)
+        np.testing.assert_allclose(res[3], (pv * lv).sum(), rtol=1e-6)
+
+
+def test_basic_gru_shapes_and_masking():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[5, 6], dtype="float32")  # [B,T=5,in=6]
+        seq_len = layers.data("sl", shape=[1], dtype="int64")
+        out, last_h = contrib.layers.basic_gru(
+            x, None, hidden_size=8, num_layers=2,
+            sequence_length=layers.squeeze(seq_len, axes=[1]),
+            batch_first=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), fetch_list=[])
+        rng = np.random.RandomState(0)
+        xv = rng.randn(3, 5, 6).astype(np.float32)
+        sl = np.array([[5], [3], [1]], np.int64)
+        o, h = exe.run(feed={"x": xv, "sl": sl},
+                       fetch_list=[out, last_h])
+        assert o.shape == (3, 5, 8)
+        assert h.shape == (2, 3, 8)
+        # masking: short sequence's final state equals state at its length
+        xv2 = xv.copy()
+        xv2[1, 3:] = 99.0          # garbage beyond length 3
+        o2, h2 = exe.run(feed={"x": xv2, "sl": sl},
+                         fetch_list=[out, last_h])
+        np.testing.assert_allclose(h[:, 1], h2[:, 1], atol=1e-6)
+
+
+def test_basic_lstm_bidirectional_trains():
+    with _fresh(), scope_guard(Scope()):
+        x = layers.data("x", shape=[4, 6], dtype="float32")
+        out, last_h, last_c = contrib.layers.basic_lstm(
+            x, None, None, hidden_size=8, num_layers=1,
+            bidirectional=True, batch_first=True)
+        loss = layers.reduce_mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), fetch_list=[])
+        xv = np.random.RandomState(1).randn(2, 4, 6).astype(np.float32)
+        l1, = exe.run(feed={"x": xv}, fetch_list=[loss])
+        o, h, c = exe.run(feed={"x": xv}, fetch_list=[out, last_h, last_c])
+        assert o.shape == (2, 4, 16)        # 2 directions concat
+        assert h.shape == (2, 2, 8) and c.shape == (2, 2, 8)
+
+
+# -- reader / utils ----------------------------------------------------------
+def test_distributed_batch_reader(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    base = lambda: iter(range(10))
+    got = list(contrib.distributed_batch_reader(base)())
+    assert got == [1, 3, 5, 7, 9]
+
+
+def test_hdfs_client_without_hadoop(tmp_path):
+    from paddle_tpu.contrib.utils import HDFSClient
+    client = HDFSClient(str(tmp_path))       # no bin/hadoop here
+    with pytest.raises(RuntimeError, match="hadoop"):
+        client.ls("/foo")
+
+
+def test_convert_dist_to_sparse_program():
+    from paddle_tpu.contrib.utils import convert_dist_to_sparse_program
+    with _fresh(), scope_guard(Scope()):
+        prog = fluid.default_main_program()
+        block = prog.global_block()
+        block.create_var(name="W", shape=[10, 4], dtype="float32",
+                         persistable=True)
+        block.create_var(name="ids", shape=[-1, 1], dtype="int64")
+        block.create_var(name="emb", shape=[-1, 4], dtype="float32")
+        block.append_op("distributed_lookup_table",
+                        inputs={"W": ["W"], "Ids": ["ids"]},
+                        outputs={"Outputs": ["emb"]},
+                        attrs={"endpoints": ["127.0.0.1:1"],
+                               "table_names": ["W"]})
+        convert_dist_to_sparse_program(prog)
+        op = prog.global_block().ops[0]
+        assert op.type == "lookup_table"
+        assert op.attrs["is_sparse"] and not op.attrs["is_distributed"]
+
+
+# -- float16 transpiler ------------------------------------------------------
+@pytest.mark.parametrize("target", ["bfloat16", "float16"])
+def test_float16_transpiler_matches_fp32(target):
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        out = layers.fc(layers.flatten(c), size=3, act="softmax")
+        main = fluid.default_main_program()
+        infer = main.clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        xv = np.random.RandomState(3).rand(2, 1, 8, 8).astype(np.float32)
+        ref, = exe.run(infer, feed={"img": xv}, fetch_list=[out.name],
+                       scope=scope)
+        contrib.Float16Transpiler().transpile(infer, scope=scope,
+                                              target_dtype=target)
+        conv_w = [v for v in infer.global_block().vars.values()
+                  if v.persistable and "conv" in v.name and
+                  v.name.endswith(".w_0")]
+        assert conv_w and all(v.dtype == target for v in conv_w)
+        half, = exe.run(infer, feed={"img": xv}, fetch_list=[out.name],
+                        scope=scope)
+        np.testing.assert_allclose(np.asarray(half, np.float32), ref,
+                                   atol=2e-2)
+
+
+# -- Trainer / Inferencer ----------------------------------------------------
+def test_trainer_inferencer_end_to_end(tmp_path):
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    fixed = []
+    for _ in range(8):
+        x = rng.rand(8, 4).astype(np.float32)
+        fixed.append(list(zip(x, x @ w_true)))
+
+    def reader():
+        return iter(fixed)
+
+    def train_func():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="fc_w"))
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    losses = []
+    trainer = contrib.Trainer(
+        train_func, lambda: fluid.optimizer.SGD(0.1),
+        checkpoint_config=contrib.CheckpointConfig(
+            str(tmp_path / "ckpt"), step_interval=4))
+    trainer.train(20, lambda ev: losses.append(ev.metrics[0])
+                  if isinstance(ev, contrib.EndStepEvent) else None,
+                  reader=reader, feed_order=["x", "y"])
+    assert float(losses[-1]) < float(losses[0])
+    test_loss = trainer.test(reader, feed_order=["x", "y"])[0]
+    assert test_loss < float(losses[0])
+    trainer.save_params(str(tmp_path / "params"))
+    trainer.save_inference_model(str(tmp_path / "infer"), ["x"], [0])
+
+    def infer_func():
+        x = layers.data("x", shape=[4], dtype="float32")
+        return layers.fc(x, size=1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="fc_w"))
+
+    inferencer = contrib.Inferencer(infer_func, str(tmp_path / "params"))
+    xv = rng.rand(4, 4).astype(np.float32)
+    pred, = inferencer.infer({"x": xv})
+    np.testing.assert_allclose(pred, xv @ w_true, atol=0.5)
+
+
+def test_trainer_stop_and_checkpoint_resume(tmp_path):
+    def train_func():
+        x = layers.data("x", shape=[2], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    def reader():
+        for _ in range(4):
+            yield [(np.ones(2, np.float32), np.zeros(1, np.float32))] * 2
+
+    cfg = contrib.CheckpointConfig(str(tmp_path), step_interval=1)
+    trainer = contrib.Trainer(train_func,
+                              lambda: fluid.optimizer.SGD(0.01),
+                              checkpoint_config=cfg)
+
+    def handler(ev):
+        if isinstance(ev, contrib.EndStepEvent) and ev.step == 1:
+            trainer.stop()
+    trainer.train(2, handler, reader=reader, feed_order=["x", "y"])
+    # a new trainer resumes from the checkpoint without error
+    trainer2 = contrib.Trainer(
+        train_func, lambda: fluid.optimizer.SGD(0.01),
+        checkpoint_config=contrib.CheckpointConfig(str(tmp_path),
+                                                   step_interval=1))
+    trainer2.train(1, lambda ev: None, reader=reader,
+                   feed_order=["x", "y"])

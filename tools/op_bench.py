@@ -24,7 +24,7 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: default input slots per op family when --shapes names only one tensor
+#: input slots per op family, used to name positional --shapes entries
 DEFAULT_SLOTS = {
     "matmul": ("X", "Y"), "mul": ("X", "Y"), "elementwise_add": ("X", "Y"),
     "elementwise_mul": ("X", "Y"), "conv2d": ("Input", "Filter"),
@@ -36,11 +36,22 @@ DEFAULT_SLOTS = {
 _INT_SLOTS = {"Ids", "Label", "Indices"}
 
 
-def _parse_shapes(spec):
-    """'X=1024x1024,Y=1024x1024' → {'X': (1024, 1024), ...}"""
+def _parse_shapes(spec, op_type=None):
+    """'X=1024x1024,Y=1024x1024' → {'X': (1024, 1024), ...}; unnamed
+    entries ('1024x1024,1024x1024') take the op's DEFAULT_SLOTS names."""
     out = {}
+    slots = iter(DEFAULT_SLOTS.get(op_type, ()))
     for part in spec.split(","):
-        name, dims = part.split("=")
+        if "=" in part:
+            name, dims = part.split("=")
+        else:
+            try:
+                name = next(slots)
+            except StopIteration:
+                raise SystemExit(
+                    f"unnamed shape {part!r}: op {op_type!r} has no "
+                    "default slot for it — use Slot=DIMS")
+            dims = part
         out[name] = tuple(int(d) for d in dims.split("x"))
     return out
 
@@ -87,9 +98,14 @@ def bench_op(op_type, shapes, attrs=None, dtype="float32", repeat=50,
                             append_batch_size=False)
             v.stop_gradient = not grad or is_int
             inputs[slot] = [v.name]
-            feed[slot.lower()] = (
-                rng.randint(0, shape[-1], shape).astype(np.int64) if is_int
-                else rng.rand(*shape).astype(dtype))
+            if is_int:
+                # ids index into the table's vocab (W's first dim), not
+                # their own last dim
+                vocab = shapes.get("W", shapes.get("X", shape))[0]
+                feed[slot.lower()] = rng.randint(
+                    0, max(int(vocab), 2), shape).astype(np.int64)
+            else:
+                feed[slot.lower()] = rng.rand(*shape).astype(dtype)
         out = block.create_var(name="bench_out", dtype=dtype)
         outputs = {next(iter(_out_slot(op_type))): [out.name]}
         block.append_op(op_type, inputs=inputs, outputs=outputs, attrs=attrs)
@@ -151,7 +167,8 @@ def main(argv=None):
     else:
         if not args.op or not args.shapes:
             ap.error("--op and --shapes required without --config")
-        jobs.append({"op": args.op, "shapes": _parse_shapes(args.shapes),
+        jobs.append({"op": args.op,
+                     "shapes": _parse_shapes(args.shapes, args.op),
                      "attrs": json.loads(args.attrs), "dtype": args.dtype,
                      "repeat": args.repeat, "grad": args.grad})
     for job in jobs:
