@@ -38,7 +38,9 @@ def main():
         batch, seq_len, steps = 4, 64, 2
         peak = 1e12
 
-    feeds, logits, loss = T.build_bert_pretrain(cfg, seq_len)
+    # fused chunked head: the [tokens, vocab] logits never hit HBM
+    feeds, logits, loss = T.build_bert_pretrain(cfg, seq_len,
+                                                fused_head=True)
     optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
     optimizer.minimize(loss)
 

@@ -44,10 +44,12 @@ class DecoupledWeightDecay:
                  no_grad_set=None):
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
-        # decay BEFORE the update, decoupled from the gradient path
-        for param, grad, scaled in self._scale_parameters(params_grads):
-            updated = layers.elementwise_sub(x=param, y=scaled)
-            layers.assign(input=updated, output=param)
+        # decay BEFORE the update, decoupled from the gradient path; tagged
+        # optimize so clone(for_test=True) prunes it with the rest
+        with loss.block.program._op_role_guard("optimize"):
+            for param, grad, scaled in self._scale_parameters(params_grads):
+                updated = layers.elementwise_sub(x=param, y=scaled)
+                layers.assign(input=updated, output=param)
         optimize_ops = self.apply_optimize(loss, startup_program,
                                            params_grads)
         return optimize_ops, params_grads

@@ -111,6 +111,29 @@ class Float16Transpiler:
                         for n in names]
             new_ops.append(op)
         block.ops = new_ops
+
+        # fetch contract: graph sinks go back to fp32 under their ORIGINAL
+        # names (ref _modify_feed_fetch keeps feed/fetch fp32) — the
+        # producer is renamed to <n>.half and a final cast restores <n>
+        consumed = set()
+        for op in block.ops:
+            consumed.update(op.input_arg_names())
+        for n in sorted(half_out):
+            if n in consumed or not block.has_var(n) or \
+                    block.var(n).dtype != target_dtype:
+                continue
+            v = block.var(n)
+            half_name = n + ".half"
+            block.create_var(name=half_name, shape=v.shape,
+                             dtype=target_dtype)
+            for op in block.ops:
+                for slot, names in op.outputs.items():
+                    op.outputs[slot] = [half_name if m == n else m
+                                        for m in names]
+            v.dtype = "float32"
+            block.ops.append(Operator(
+                block, "cast", {"X": [half_name]}, {"Out": [n]},
+                {"in_dtype": target_dtype, "out_dtype": "float32"}))
         program._bump_version()
         return program
 

@@ -139,7 +139,8 @@ class Trainer:
             event_handler(BeginEpochEvent(epoch_id))
             for step_id, data in enumerate(reader()):
                 if self.__stop:
-                    self._save_checkpoint(epoch_id, step_id)
+                    if self.checkpoint_cfg:
+                        self._save_checkpoint(epoch_id, step_id)
                     return
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)

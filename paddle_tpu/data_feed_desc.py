@@ -1,0 +1,124 @@
+"""DataFeedDesc (ref ``python/paddle/fluid/data_feed_desc.py``): textual
+descriptor of the MultiSlot input format, parsed from the reference's
+protobuf-text files (``framework/data_feed.proto`` schema) without
+requiring protobuf — the same ``name/batch_size/multi_slot_desc{slots{...}}``
+grammar handled by a small recursive reader."""
+
+from __future__ import annotations
+
+import re
+from types import SimpleNamespace
+
+__all__ = ["DataFeedDesc"]
+
+
+def _parse_prototxt(text: str):
+    """Tiny text-format protobuf reader: k: v scalars and k { ... } blocks
+    (repeated keys accumulate into lists)."""
+    tokens = re.findall(r'[{}]|[\w.]+\s*:\s*(?:"[^"]*"|[^\s{}]+)|\w+(?=\s*{)',
+                        text)
+    pos = 0
+
+    def parse_block():
+        nonlocal pos
+        out = {}
+        while pos < len(tokens):
+            tok = tokens[pos]
+            if tok == "}":
+                pos += 1
+                return out
+            if pos + 1 < len(tokens) and tokens[pos + 1] == "{":
+                key = tok
+                pos += 2
+                val = parse_block()
+            else:
+                key, _, raw = tok.partition(":")
+                raw = raw.strip()
+                if raw.startswith('"'):
+                    val = raw.strip('"')
+                elif raw in ("true", "false"):
+                    val = raw == "true"
+                else:
+                    try:
+                        val = int(raw)
+                    except ValueError:
+                        try:
+                            val = float(raw)
+                        except ValueError:
+                            val = raw
+                pos += 1
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(val)
+            else:
+                out[key] = val
+        return out
+
+    return parse_block()
+
+
+def _emit(d, indent=0):
+    pad = "  " * indent
+    lines = []
+    for key, val in d.items():
+        vals = val if isinstance(val, list) else [val]
+        for v in vals:
+            if isinstance(v, dict):
+                lines.append(f"{pad}{key} {{")
+                lines.append(_emit(v, indent + 1))
+                lines.append(pad + "}")
+            elif isinstance(v, bool):
+                lines.append(f"{pad}{key}: {str(v).lower()}")
+            elif isinstance(v, str):
+                lines.append(f'{pad}{key}: "{v}"')
+            else:
+                lines.append(f"{pad}{key}: {v}")
+    return "\n".join(lines)
+
+
+class DataFeedDesc:
+    """ref data_feed_desc.py:21."""
+
+    def __init__(self, proto_file: str):
+        with open(proto_file) as f:
+            self._d = _parse_prototxt(f.read())
+        self._d.setdefault("pipe_command", "cat")
+        self.__name_to_index = {}
+        slots = self._slots()
+        self.__name_to_index = {s["name"]: i for i, s in enumerate(slots)}
+        self.proto_desc = SimpleNamespace(
+            name=self._d.get("name", ""),
+            batch_size=self._d.get("batch_size", 1))
+
+    def _slots(self):
+        msd = self._d.get("multi_slot_desc") or {}
+        slots = msd.get("slots", [])
+        return slots if isinstance(slots, list) else [slots]
+
+    def set_batch_size(self, batch_size: int):
+        """ref data_feed_desc.py:93."""
+        self._d["batch_size"] = int(batch_size)
+        self.proto_desc.batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        """ref data_feed_desc.py:128 — named slots become dense."""
+        slots = self._slots()
+        for name in dense_slots_name:
+            if name not in self.__name_to_index:
+                raise ValueError(f"slot {name!r} not in the descriptor")
+            slots[self.__name_to_index[name]]["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name):
+        """ref data_feed_desc.py:173 — only named slots are used."""
+        slots = self._slots()
+        for s in slots:
+            s["is_used"] = False
+        for name in use_slots_name:
+            if name not in self.__name_to_index:
+                raise ValueError(f"slot {name!r} not in the descriptor")
+            slots[self.__name_to_index[name]]["is_used"] = True
+
+    def desc(self) -> str:
+        """Text-format descriptor (ref data_feed_desc.py:218)."""
+        return _emit(self._d) + "\n"

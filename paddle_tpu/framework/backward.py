@@ -60,6 +60,15 @@ def append_backward(loss: Variable,
 
     relevant, needed = _relevant_ops(block, loss, no_grad)
 
+    # every op appended below is training-only: tag it so
+    # clone(for_test=True) prunes the backward tail (ref OpRole::kBackward)
+    with program._op_role_guard("backward"):
+        return _append_backward_tagged(block, program, loss, no_grad,
+                                       relevant, needed, parameter_list)
+
+
+def _append_backward_tagged(block, program, loss, no_grad, relevant, needed,
+                            parameter_list):
     # seed: d loss / d loss = 1  (ref backward.py _append_loss_ops /
     # ScaleLossGradOpHandle with coeff 1 on a single device)
     loss_g_name = grad_var_name(loss.name)

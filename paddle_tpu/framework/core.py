@@ -182,6 +182,12 @@ class Operator:
         for slot, vs in (outputs or {}).items():
             self.outputs[slot] = [v.name if isinstance(v, Variable) else v
                                   for v in _as_list(vs)]
+        # role tagging (ref op_proto_maker.h OpRole + framework.py _op_role):
+        # append_backward/optimizers set the program's current role so
+        # clone(for_test=True) can prune the training-only tail
+        role = getattr(block.program, "_current_role", None) if block else None
+        if role is not None and "op_role" not in self.attrs:
+            self.attrs["op_role"] = role
 
     def input(self, slot) -> List[str]:
         return self.inputs.get(slot, [])
@@ -340,6 +346,22 @@ class Program:
         self.random_seed = 0
         # name -> attr dict for program-level metadata (e.g. dist info)
         self._attrs: Dict[str, Any] = {}
+        self._current_role: Optional[str] = None
+
+    def _op_role_guard(self, role: str):
+        """Ops created inside carry attrs['op_role']=role (ref
+        framework.py _op_role / _optimized_guard)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            prev = self._current_role
+            self._current_role = role
+            try:
+                yield
+            finally:
+                self._current_role = prev
+        return guard()
 
     # -- blocks --------------------------------------------------------------
     def global_block(self) -> Block:
@@ -390,6 +412,7 @@ class Program:
         p.random_seed = self.random_seed
         p._attrs = copy.deepcopy(self._attrs)
         p._current_block_idx = 0
+        p._current_role = None
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
@@ -407,6 +430,12 @@ class Program:
                 nv.seq_len_var = v.seq_len_var
                 nb.vars[name] = nv
             for op in b.ops:
+                if for_test and op.attrs.get("op_role") in (
+                        "backward", "optimize", "lrsched"):
+                    # ref framework.py clone docstring: "We will prune the
+                    # backward and optimize part of the program when you
+                    # use clone after Optimizer.minimize"
+                    continue
                 attrs = {}
                 for k, val in op.attrs.items():
                     if isinstance(val, Block):
@@ -459,6 +488,7 @@ class Program:
         p.random_seed = d.get("random_seed", 0)
         p._attrs = {}
         p._current_block_idx = 0
+        p._current_role = None
         p.blocks = []
         for bd in d["blocks"]:
             b = Block(p, bd["idx"], bd["parent_idx"])

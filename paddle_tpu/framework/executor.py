@@ -426,21 +426,47 @@ class Executor:
         fetch/print config when passed."""
         if dataset is None:
             raise ValueError("dataset is required")
+        dump_fields, dump_file = [], None
         if trainer_desc is not None:
             fetch_list = fetch_list or trainer_desc._fetch_vars
             fetch_info = fetch_info or trainer_desc._fetch_info
             print_period = trainer_desc._print_period
+            dump_fields = getattr(trainer_desc, "_dump_fields", [])
+            if dump_fields and trainer_desc._dump_fields_path:
+                # per-worker dump file (ref DistMultiTrainer dump workers,
+                # framework/trainer.h:92: each worker streams tab-separated
+                # field values for offline analysis)
+                import os
+                os.makedirs(trainer_desc._dump_fields_path, exist_ok=True)
+                wid = os.environ.get("PADDLE_TRAINER_ID", "0")
+                dump_file = open(os.path.join(
+                    trainer_desc._dump_fields_path, f"worker_{wid}"), "w")
         fetch_list = fetch_list or []
         results = None
-        for i, feed in enumerate(dataset):
-            results = self.run(program, feed=feed, fetch_list=fetch_list,
-                               scope=scope)
-            if debug and fetch_list and i % print_period == 0:
-                info = fetch_info or [f.name if hasattr(f, "name") else str(f)
-                                      for f in fetch_list]
-                msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
-                                for n, v in zip(info, results))
-                print(f"[train_from_dataset] batch {i}: {msg}")
+        try:
+            for i, feed in enumerate(dataset):
+                results = self.run(
+                    program, feed=feed,
+                    fetch_list=list(fetch_list) +
+                    (list(dump_fields) if dump_file else []),
+                    scope=scope)
+                if dump_file:
+                    results, dumped = (results[:len(fetch_list)],
+                                       results[len(fetch_list):])
+                    for name, val in zip(dump_fields, dumped):
+                        flat = " ".join(
+                            str(x) for x in np.asarray(val).ravel())
+                        dump_file.write(f"{i}\t{name}\t{flat}\n")
+                if debug and fetch_list and i % print_period == 0:
+                    info = fetch_info or [
+                        f.name if hasattr(f, "name") else str(f)
+                        for f in fetch_list]
+                    msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                    for n, v in zip(info, results))
+                    print(f"[train_from_dataset] batch {i}: {msg}")
+        finally:
+            if dump_file is not None:
+                dump_file.close()
         return results
 
     def infer_from_dataset(self, *a, **k):

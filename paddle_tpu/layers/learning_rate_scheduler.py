@@ -124,3 +124,26 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     if not hasattr(learning_rate, "block"):
         learning_rate = step * 0.0 + float(learning_rate)
     return mask * warm + (1.0 - mask) * learning_rate
+
+
+def _lr_sched(fn):
+    """Tag scheduler-emitted ops 'lrsched' so clone(for_test=True) prunes
+    them (ref framework.py _lr_schedule_guard / OpRole::kLRSched)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from ..framework.core import default_main_program
+        with default_main_program()._op_role_guard("lrsched"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+noam_decay = _lr_sched(noam_decay)
+exponential_decay = _lr_sched(exponential_decay)
+natural_exp_decay = _lr_sched(natural_exp_decay)
+inverse_time_decay = _lr_sched(inverse_time_decay)
+polynomial_decay = _lr_sched(polynomial_decay)
+piecewise_decay = _lr_sched(piecewise_decay)
+cosine_decay = _lr_sched(cosine_decay)
+linear_lr_warmup = _lr_sched(linear_lr_warmup)

@@ -114,14 +114,19 @@ class Optimizer:
 
     def apply_gradients(self, params_grads):
         block = default_main_program().global_block()
-        params_grads = append_gradient_clip_ops(params_grads, self._grad_clip)
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
-        self._create_global_learning_rate()
-        self._create_accumulators(block, [p for p, _ in params_grads])
-        for pg in params_grads:
-            self._append_optimize_op(block, pg)
-        self._finish_update(block, params_grads)
+        # clip/regularization/LR-decay/update ops are all training-only:
+        # tag them so clone(for_test=True) prunes the optimize tail
+        # (ref OpRole::kOptimize / _optimized_guard)
+        with block.program._op_role_guard("optimize"):
+            params_grads = append_gradient_clip_ops(params_grads,
+                                                    self._grad_clip)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+            self._create_global_learning_rate()
+            self._create_accumulators(block, [p for p, _ in params_grads])
+            for pg in params_grads:
+                self._append_optimize_op(block, pg)
+            self._finish_update(block, params_grads)
         return []
 
     def apply_optimize(self, loss, startup_program, params_grads):

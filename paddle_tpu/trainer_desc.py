@@ -26,6 +26,9 @@ class TrainerDesc:
         self._print_period = 100
         self._program = None
         self._infer = False
+        self._dump_fields = []
+        self._dump_fields_path = ""
+        self._dump_converter = ""
         self.proto_desc = self          # parity: .proto_desc attr exists
 
     def set_thread(self, thread_num):
@@ -44,6 +47,17 @@ class TrainerDesc:
 
     def set_infer(self, infer):
         self._infer = bool(infer)
+
+    # field-dump pipeline (ref trainer_desc.py:87-92 _set_dump_fields;
+    # DistMultiTrainer dump workers, framework/trainer.h:92)
+    def _set_dump_fields(self, dump_fields):
+        self._dump_fields = [getattr(f, "name", f) for f in dump_fields]
+
+    def _set_dump_fields_path(self, path):
+        self._dump_fields_path = str(path)
+
+    def _set_dump_converter(self, converter):
+        self._dump_converter = str(converter)
 
     def _desc(self):
         return {

@@ -200,8 +200,9 @@ def test_float16_transpiler_matches_fp32(target):
         assert conv_w and all(v.dtype == target for v in conv_w)
         half, = exe.run(infer, feed={"img": xv}, fetch_list=[out.name],
                         scope=scope)
-        np.testing.assert_allclose(np.asarray(half, np.float32), ref,
-                                   atol=2e-2)
+        # fetch contract: outputs come back fp32 under the original name
+        assert np.asarray(half).dtype == np.float32
+        np.testing.assert_allclose(half, ref, atol=2e-2)
 
 
 # -- Trainer / Inferencer ----------------------------------------------------

@@ -97,3 +97,47 @@ def test_prune():
             for n in op.output_arg_names()}
     assert y1.name in used
     assert y2.name not in used
+
+
+def test_clone_for_test_prunes_training_tail():
+    """ref framework.py Program.clone: after minimize, clone(for_test=True)
+    drops backward + optimize + lr-sched ops, so running the eval clone
+    never mutates parameters."""
+    import numpy as np
+    from paddle_tpu import layers, optimizer as popt
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Executor
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        h = layers.dropout(layers.fc(x, size=8), dropout_prob=0.5)
+        loss = layers.mean(layers.square(h))
+        lr = layers.exponential_decay(0.1, 10, 0.9)
+        popt.SGD(lr).minimize(loss)
+        main = fluid.default_main_program()
+        infer = main.clone(for_test=True)
+        types = [op.type for op in infer.global_block().ops]
+        assert "sgd" not in types and "increment" not in types
+        assert not any(t.endswith("_grad") for t in types)
+        # dropout flipped to test mode
+        dp = next(op for op in infer.global_block().ops
+                  if op.type == "dropout")
+        assert dp.attrs["is_test"] is True
+        # running the clone twice: identical outputs, params untouched
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        feed = {"x": np.ones((2, 4), np.float32)}
+        w_before = np.array(scope.find_var(
+            main.global_block().all_parameters()[0].name), copy=True)
+        o1, = exe.run(infer, feed=feed, fetch_list=[loss.name], scope=scope)
+        o2, = exe.run(infer, feed=feed, fetch_list=[loss.name], scope=scope)
+        np.testing.assert_array_equal(o1, o2)
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(
+                main.global_block().all_parameters()[0].name)), w_before)
+        # the train program still trains
+        l1, = exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+        l2, = exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
+        assert float(l2) != float(l1)

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI driver (ref paddle/scripts/paddle_build.sh, scoped to this repo):
+# native build, full test suite on the virtual 8-device CPU mesh, the
+# standalone C++ train demo, a bench smoke run, and the API-spec dump.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native runtime build =="
+make -C native
+make -C native demo_trainer
+
+echo "== test suite (8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== C++ train demo =="
+tmp=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/export_demo_program.py "$tmp"
+./native/demo_trainer "$tmp"
+rm -rf "$tmp"
+
+echo "== bench smoke (CPU fallback) =="
+JAX_PLATFORMS=cpu python bench.py
+
+echo "== API surface =="
+JAX_PLATFORMS=cpu python tools/print_signatures.py --md5
+
+echo "CI OK"

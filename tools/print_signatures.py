@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Dump the public API surface as stable one-line signatures (ref
+``tools/print_signatures.py`` + the ``API.spec`` diff-check the reference
+CI runs: any PR changing a public signature shows up as a spec diff).
+
+Usage:
+    python tools/print_signatures.py > API.spec
+    python tools/print_signatures.py --diff API.spec   # exit 1 on changes
+"""
+
+import argparse
+import hashlib
+import inspect
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.io",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.distributed",
+    "paddle_tpu.contrib",
+    "paddle_tpu.contrib.slim",
+    "paddle_tpu.contrib.layers",
+    "paddle_tpu.data",
+]
+
+
+def _signature(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+    lines = []
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        names = getattr(mod, "__all__", None) or \
+            [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{mod_name}.{name} "
+                             f"__init__{_signature(obj.__init__)}")
+                for m_name, m in sorted(vars(obj).items()):
+                    if m_name.startswith("_") or not callable(m):
+                        continue
+                    lines.append(f"{mod_name}.{name}.{m_name} "
+                                 f"{_signature(m)}")
+            elif callable(obj):
+                lines.append(f"{mod_name}.{name} {_signature(obj)}")
+    return sorted(set(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--diff", metavar="SPEC",
+                    help="compare against a saved spec; exit 1 on changes")
+    ap.add_argument("--md5", action="store_true",
+                    help="print one line: md5 of the whole surface")
+    args = ap.parse_args()
+    lines = collect()
+    if args.md5:
+        print(hashlib.md5("\n".join(lines).encode()).hexdigest())
+        return
+    if args.diff:
+        old = Path(args.diff).read_text().splitlines()
+        removed = sorted(set(old) - set(lines))
+        added = sorted(set(lines) - set(old))
+        for line in removed:
+            print("- " + line)
+        for line in added:
+            print("+ " + line)
+        sys.exit(1 if (removed or added) else 0)
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
