@@ -21,6 +21,7 @@ from .framework.executor import Executor  # noqa
 from . import optimizer  # noqa
 from . import evaluator, metrics, nets  # noqa
 from . import contrib  # noqa
+from . import incubate  # noqa
 from . import average, checkpoint, debugger, install_check, net_drawer  # noqa
 from .average import WeightedAverage  # noqa
 from . import device_worker, trainer_desc, trainer_factory  # noqa

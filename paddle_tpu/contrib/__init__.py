@@ -1,7 +1,8 @@
 """Contrib subpackages (ref ``python/paddle/fluid/contrib/``)."""
 
-from . import (extend_optimizer, layers, memory_usage_calc,  # noqa
-               model_stat, op_frequence, quantize, reader, slim, utils)
+from . import (decoder, extend_optimizer, layers,  # noqa
+               memory_usage_calc, model_stat, op_frequence, quantize,
+               reader, slim, utils)
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa
 from .float16_transpiler import Float16Transpiler  # noqa
 from .inferencer import Inferencer  # noqa

@@ -1,0 +1,4 @@
+"""Seq2seq decoder toolkit (ref ``python/paddle/fluid/contrib/decoder/``)."""
+
+from .beam_search_decoder import (BeamSearchDecoder, InitState,  # noqa
+                                  StateCell, TrainingDecoder)

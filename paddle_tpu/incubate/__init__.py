@@ -1,0 +1,6 @@
+"""Incubating APIs (ref ``python/paddle/fluid/incubate/``): the fleet
+facade lives in :mod:`paddle_tpu.distributed.fleet`; re-exported here for
+import-path parity, alongside the dataset DataGenerator toolkit."""
+
+from . import data_generator  # noqa
+from ..distributed import fleet  # noqa

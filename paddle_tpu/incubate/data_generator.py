@@ -1,0 +1,144 @@
+"""Dataset line generators (ref ``python/paddle/fluid/incubate/
+data_generator/__init__.py``): user subclasses override generate_sample /
+generate_batch; run_from_stdin turns the class into the ``pipe_command``
+stage of the Dataset ingestion pipeline, emitting the MultiSlot text format
+the native data feed parses (native/src/data_feed.cc: per slot
+"count v1 v2 ...")."""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """ref data_generator/__init__.py:21."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit: int):
+        if not isinstance(line_limit, int) or line_limit < 1:
+            raise ValueError("line_limit must be a positive int")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    # -- drivers -------------------------------------------------------------
+    def run_from_memory(self):
+        """Generate from self.generate_sample(None) and write to stdout."""
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for user_parsed_line in line_iter():
+            if user_parsed_line is None:
+                continue
+            batch_samples.append(user_parsed_line)
+            if len(batch_samples) == self.batch_size_:
+                self._flush(batch_samples)
+                batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples)
+
+    def run_from_stdin(self):
+        """One stdin line → samples → MultiSlot text lines on stdout (the
+        Dataset pipe_command contract)."""
+        batch_samples = []
+        for count, line in enumerate(sys.stdin, 1):
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    self._flush(batch_samples)
+                    batch_samples = []
+            if self._line_limit and count >= self._line_limit:
+                break
+        if batch_samples:
+            self._flush(batch_samples)
+
+    def _flush(self, batch_samples):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            sys.stdout.write(self._gen_str(sample))
+
+    # -- user hooks ----------------------------------------------------------
+    def generate_sample(self, line):
+        """→ callable yielding [(name, [feasign, ...]), ...]"""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String feasigns, no type tracking (ref :241)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        out = []
+        for name, elements in line:
+            out.append(str(len(elements)))
+            out.extend(str(e) for e in elements)
+        return " ".join(out) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """int/float feasigns with per-slot type inference recorded in
+    _proto_info (ref :282)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type. "
+                "Example: [('words', [1926, 8, 17]), ('label', [1])]")
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two given line are "
+                    f"inconsistent: {len(line)} vs {len(self._proto_info)}")
+        out = []
+        for i, (name, elements) in enumerate(line):
+            if not isinstance(name, str):
+                raise ValueError(f"name {name!r} must be in str type")
+            if not isinstance(elements, list):
+                raise ValueError(f"elements {elements!r} must be a list")
+            if not elements:
+                raise ValueError(
+                    "the elements of each field can not be empty; pad it "
+                    "in process()")
+            if first:
+                self._proto_info.append((name, "uint64"))
+            elif self._proto_info[i][0] != name:
+                raise ValueError(
+                    f"the field name of two given line are not match: "
+                    f"require {self._proto_info[i][0]}, get {name}")
+            out.append(str(len(elements)))
+            for elem in elements:
+                if isinstance(elem, float):
+                    self._proto_info[i] = (name, "float")
+                elif not isinstance(elem, int):
+                    raise ValueError(
+                        f"the type of element {elem!r} must be int or float")
+                out.append(str(elem))
+        return " ".join(out) + "\n"

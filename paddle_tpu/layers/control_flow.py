@@ -84,6 +84,22 @@ class While:
         return _WhileBlockGuard(self)
 
 
+def _collect_io(block):
+    """(reads, writes) over a block INCLUDING nested sub-blocks — a Switch
+    inside a While reads/writes through a conditional_block whose body the
+    outer capture analysis must see."""
+    reads, writes = set(), set()
+    for op in block.ops:
+        reads.update(op.input_arg_names())
+        writes.update(op.output_arg_names())
+        for val in op.attrs.values():
+            if hasattr(val, "ops") and hasattr(val, "vars"):   # a Block
+                r, w = _collect_io(val)
+                reads.update(r)
+                writes.update(w)
+    return reads, writes
+
+
 class _WhileBlockGuard:
     def __init__(self, while_op: While):
         self.while_op = while_op
@@ -100,14 +116,9 @@ class _WhileBlockGuard:
         program._rollback()
         parent = program.current_block()
         # loop-carried vars: every var read in the sub-block that lives in the
-        # parent and is written in the sub-block, plus the condition var.
-        written = set()
-        read = set()
-        for op in inner.ops:
-            for n in op.input_arg_names():
-                read.add(n)
-            for n in op.output_arg_names():
-                written.add(n)
+        # parent and is written in the sub-block, plus the condition var;
+        # collection recurses into nested conditional sub-blocks
+        read, written = _collect_io(inner)
         # membership must be recursive (has_var) — parent.vars is local-only,
         # and the loop may sit inside another sub-block whose captures live
         # further up the chain
@@ -226,12 +237,14 @@ class _SwitchCaseGuard:
         inner = program.current_block()
         program._rollback()
         parent = program.current_block()
-        written = sorted({n for op in inner.ops
-                          for n in op.output_arg_names()
-                          if parent.has_var(n)})
+        reads, writes = _collect_io(inner)
+        written = sorted(n for n in writes if parent.has_var(n))
         parent.append_op(
             "conditional_block",
-            inputs={"Cond": [self.pred.name]},
+            # reads declared so an enclosing While's capture analysis sees
+            # through this case body
+            inputs={"Cond": [self.pred.name],
+                    "X": sorted(n for n in reads if parent.has_var(n))},
             outputs={"Out": written},
             attrs={"sub_block": inner})
         return False

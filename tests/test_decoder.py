@@ -1,0 +1,187 @@
+"""contrib.decoder: StateCell/TrainingDecoder/BeamSearchDecoder
+(ref python/paddle/fluid/contrib/decoder/beam_search_decoder.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import decoder as D
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _gru_like_updater(state_cell, hidden_size, name):
+    """Simple recurrent update: h' = tanh(fc([x, h]))."""
+    x = state_cell.get_input("x")
+    h = state_cell.get_state("h")
+    new_h = layers.fc(layers.concat([x, h], axis=1), size=hidden_size,
+                      act="tanh",
+                      param_attr=fluid.ParamAttr(name=f"{name}_w"),
+                      bias_attr=fluid.ParamAttr(name=f"{name}_b"))
+    state_cell.set_state("h", new_h)
+
+
+def test_training_decoder_teacher_forced():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        batch, seq, word_dim, hidden = 3, 5, 4, 6
+        trg = layers.data("trg", shape=[seq, word_dim], dtype="float32")
+        boot = layers.data("boot", shape=[hidden], dtype="float32")
+
+        cell = D.StateCell(inputs={"x": None},
+                           states={"h": D.InitState(init=boot)},
+                           out_state="h")
+
+        @cell.state_updater
+        def updater(state_cell):
+            _gru_like_updater(state_cell, hidden, "train_dec")
+
+        dec = D.TrainingDecoder(cell)
+        with dec.block():
+            current = dec.step_input(trg)
+            cell.compute_state(inputs={"x": current})
+            cell.update_states()
+            dec.output(cell.get_state("h"))
+        out = dec()
+        loss = layers.reduce_mean(layers.square(out))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        feed = {"trg": np.random.RandomState(0)
+                .rand(batch, seq, word_dim).astype(np.float32),
+                "boot": np.zeros((batch, hidden), np.float32)}
+        o, l1 = exe.run(feed=feed, fetch_list=[out, loss], scope=scope)
+        assert o.shape == (batch, seq, hidden)
+        l2, = exe.run(feed=feed, fetch_list=[loss], scope=scope)
+        assert float(l2) < float(l1)        # trains
+
+
+def test_beam_search_decoder_decodes():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        beam, vocab, word_dim, hidden, max_len = 2, 7, 4, 6, 4
+        batch = 1
+        bb = batch * beam
+        init_ids = layers.data("init_ids", shape=[1], dtype="int64")
+        init_scores = layers.data("init_scores", shape=[1],
+                                  dtype="float32")
+        boot = layers.data("boot", shape=[hidden], dtype="float32")
+
+        cell = D.StateCell(inputs={"x": None},
+                           states={"h": D.InitState(init=boot,
+                                                    need_reorder=True)},
+                           out_state="h")
+
+        @cell.state_updater
+        def updater(state_cell):
+            _gru_like_updater(state_cell, hidden, "beam_dec")
+
+        dec = D.BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=vocab,
+            word_dim=word_dim, topk_size=vocab, max_len=max_len,
+            beam_size=beam, end_id=1)
+        dec.decode()
+        trans_ids, trans_scores = dec()
+
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        feed = {
+            "init_ids": np.zeros((bb, 1), np.int64),
+            # beam 0 live, beam 1 seeded dead (dense step-0 convention)
+            "init_scores": np.array([[0.0], [-1e9]] * batch, np.float32),
+            "boot": np.zeros((bb, hidden), np.float32),
+        }
+        ids, scores = exe.run(feed=feed,
+                              fetch_list=[trans_ids, trans_scores],
+                              scope=scope)
+        ids = np.asarray(ids)
+        scores = np.asarray(scores)
+        # [batch, beam, time]: exact static buffer (max_len+1 steps),
+        # valid token ids, finite scores on live entries
+        assert ids.shape == (batch, beam, max_len + 1)
+        assert ids.min() >= 0 and ids.max() < vocab
+        assert np.all(np.isfinite(scores[scores > -1e8]))
+        # a finished hypothesis keeps emitting end_id to the fixed length
+        end_rows = np.where((ids == 1).any(axis=2))
+        for b, k in zip(*end_rows):
+            row = ids[b, k]
+            first_end = int(np.argmax(row == 1))
+            assert np.all(row[first_end:] == 1)
+
+
+def test_beam_decoder_greedy_matches_numpy():
+    """beam_size=1 decode vs a hand-rolled numpy simulation with pinned
+    weights — locks state evolution through the loop (a stale-state bug
+    would keep h at (a permutation of) boot and diverge immediately)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        vocab, word_dim, hidden, max_len = 5, 3, 4, 4
+        rng = np.random.RandomState(7)
+        E = rng.randn(vocab, word_dim).astype(np.float32) * 0.5
+        W1 = rng.randn(word_dim + hidden, hidden).astype(np.float32) * 0.5
+        b1 = rng.randn(hidden).astype(np.float32) * 0.1
+        W2 = rng.randn(hidden, vocab).astype(np.float32) * 0.5
+        b2 = rng.randn(vocab).astype(np.float32) * 0.1
+
+        init_ids = layers.data("init_ids", shape=[1], dtype="int64")
+        init_scores = layers.data("init_scores", shape=[1],
+                                  dtype="float32")
+        boot = layers.data("boot", shape=[hidden], dtype="float32")
+        cell = D.StateCell(inputs={"x": None},
+                           states={"h": D.InitState(init=boot,
+                                                    need_reorder=True)},
+                           out_state="h")
+
+        @cell.state_updater
+        def updater(sc):
+            x, h = sc.get_input("x"), sc.get_state("h")
+            sc.set_state("h", layers.fc(
+                layers.concat([x, h], axis=1), size=hidden, act="tanh",
+                param_attr=fluid.ParamAttr(name="np_w1"),
+                bias_attr=fluid.ParamAttr(name="np_b1")))
+
+        dec = D.BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=vocab,
+            word_dim=word_dim, topk_size=vocab, max_len=max_len,
+            beam_size=1, end_id=vocab + 7)     # end id unreachable
+        # pin the decoder's internal embedding/fc params after startup
+        dec.decode()
+        trans_ids, _ = dec()
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+        # identify the embedding + output fc params by shape
+        blk = fluid.default_main_program().global_block()
+        for p in blk.all_parameters():
+            shape = tuple(np.shape(scope.find_var(p.name)))
+            if shape == (vocab, word_dim):
+                scope.set_var(p.name, E)
+            elif shape == (word_dim + hidden, hidden):
+                scope.set_var(p.name, W1)
+            elif shape == (hidden,) and p.name.endswith("b1"):
+                scope.set_var(p.name, b1)
+            elif shape == (hidden, vocab):
+                scope.set_var(p.name, W2)
+            elif shape == (vocab,):
+                scope.set_var(p.name, b2)
+
+        feed = {"init_ids": np.zeros((1, 1), np.int64),
+                "init_scores": np.zeros((1, 1), np.float32),
+                "boot": np.zeros((1, hidden), np.float32)}
+        got, = exe.run(feed=feed, fetch_list=[trans_ids], scope=scope)
+        got = np.asarray(got)[0, 0]
+
+        # numpy greedy simulation
+        def softmax(z):
+            e = np.exp(z - z.max())
+            return e / e.sum()
+        h = np.zeros(hidden, np.float32)
+        prev = 0
+        want = [0]
+        for _ in range(max_len):
+            x = E[prev]
+            h = np.tanh(np.concatenate([x, h]) @ W1 + b1)
+            p = softmax(h @ W2 + b2)
+            prev = int(np.argmax(p))
+            want.append(prev)
+        np.testing.assert_array_equal(got[:max_len + 1], want)

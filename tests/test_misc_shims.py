@@ -71,3 +71,38 @@ def test_ps_instance_roles(monkeypatch):
     monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
     inst = downpour.PaddlePSInstance()
     assert inst.is_first_worker() and inst.get_worker_num() == 2
+
+
+def test_multi_slot_data_generator(capsys):
+    from paddle_tpu.incubate.data_generator import (
+        MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                ints = [int(t) for t in line.split()]
+                yield [("words", ints[:-1]), ("label", [ints[-1]])]
+            return local_iter
+
+    import io, sys
+    gen = Gen()
+    gen.set_batch(2)
+    sys.stdin = io.StringIO("1 2 3 0\n4 5 6 1\n")
+    try:
+        gen.run_from_stdin()
+    finally:
+        sys.stdin = sys.__stdin__
+    out = capsys.readouterr().out.splitlines()
+    # MultiSlot text format: "count v..." per slot (native data_feed.cc)
+    assert out[0] == "3 1 2 3 1 0"
+    assert out[1] == "3 4 5 6 1 1"
+    assert gen._proto_info == [("words", "uint64"), ("label", "uint64")]
+
+    sgen = MultiSlotStringDataGenerator()
+    assert sgen._gen_str([("a", ["x", "y"])]) == "2 x y\n"
+
+    import pytest
+    with pytest.raises(ValueError):
+        Gen()._gen_str("not a list")
+    with pytest.raises(ValueError):
+        Gen()._gen_str([("a", [])])

@@ -21,7 +21,11 @@ rm -rf "$tmp"
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 
-echo "== API surface =="
-JAX_PLATFORMS=cpu python tools/print_signatures.py --md5
+echo "== API surface vs committed spec =="
+if ! JAX_PLATFORMS=cpu python tools/print_signatures.py --diff API.spec; then
+    echo "public API changed; review the diff above and regenerate with:"
+    echo "    python tools/print_signatures.py > API.spec"
+    exit 1
+fi
 
 echo "CI OK"

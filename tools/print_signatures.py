@@ -56,8 +56,11 @@ def collect():
             if inspect.isclass(obj):
                 lines.append(f"{mod_name}.{name} "
                              f"__init__{_signature(obj.__init__)}")
-                for m_name, m in sorted(vars(obj).items()):
-                    if m_name.startswith("_") or not callable(m):
+                # getmembers (not vars): inherited public methods and
+                # classmethods are part of the surface too
+                for m_name, m in inspect.getmembers(obj):
+                    if m_name.startswith("_") or not (
+                            inspect.isfunction(m) or inspect.ismethod(m)):
                         continue
                     lines.append(f"{mod_name}.{name}.{m_name} "
                                  f"{_signature(m)}")
