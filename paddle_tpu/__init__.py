@@ -23,6 +23,8 @@ from . import evaluator, metrics, nets  # noqa
 from . import contrib  # noqa
 from . import incubate  # noqa
 from . import average, checkpoint, debugger, install_check, net_drawer  # noqa
+from . import flags  # noqa  (FLAGS_* env bootstrap runs at import)
+from .flags import get_flags, set_flags  # noqa
 from .average import WeightedAverage  # noqa
 from . import device_worker, trainer_desc, trainer_factory  # noqa
 from . import dygraph  # noqa

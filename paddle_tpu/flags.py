@@ -1,0 +1,135 @@
+"""Runtime flag system (ref ``platform/flags.cc`` ~40 gflags,
+``python/paddle/fluid/__init__.py`` ``__bootstrap__`` reading ``FLAGS_*``
+env vars, ``core.globals()`` pybind dict).
+
+TPU mapping: knobs that steer CUDA allocators/cudnn autotune have no
+hardware meaning here and are accepted as inert parity flags; the ones
+with a real XLA-side effect are wired:
+
+- ``check_nan_inf``   → ``jax.config jax_debug_nans/jax_debug_infs`` (the
+  per-kernel output validation of ``FLAGS_check_nan_inf``)
+- ``benchmark``       → per-step host sync in the executor (the reference
+  adds per-op sync timing)
+- ``allocator_strategy`` / ``eager_delete_tensor_gb`` → recorded; XLA owns
+  device memory, the native host allocator reads the strategy
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["get_flags", "set_flags", "globals"]
+
+#: name → default (ref platform/flags.cc:33-391; GPU-only knobs kept for
+#: API parity, marked inert)
+_DEFAULTS: Dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fast_eager_deletion_mode": True,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,     # inert on TPU
+    "FLAGS_initial_gpu_memory_in_mb": 0,             # inert
+    "FLAGS_reallocate_gpu_memory_in_mb": 0,          # inert
+    "FLAGS_gpu_allocator_retry_time": 0,             # inert
+    "FLAGS_cudnn_deterministic": False,              # inert
+    "FLAGS_cudnn_exhaustive_search": False,          # inert
+    "FLAGS_conv_workspace_size_limit": 512,          # inert
+    "FLAGS_enable_parallel_graph": False,
+    "FLAGS_sync_nccl_allreduce": True,               # inert (XLA collectives)
+    "FLAGS_fuse_parameter_memory_size": -1,
+    "FLAGS_fuse_parameter_groups_size": 3,
+    "FLAGS_inner_op_parallelism": 0,
+    "FLAGS_max_inmem_feed_queue_size": 64,
+    "FLAGS_reader_queue_speed_test_mode": False,
+    "FLAGS_pe_profile_fname": "",
+    "FLAGS_print_sub_graph_dir": "",
+    "FLAGS_selected_gpus": "",                       # inert
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_dist_threadpool_size": 0,
+    "FLAGS_rpc_deadline": 180000,
+    "FLAGS_rpc_retry_times": 3,
+    "FLAGS_tracer_profile_fname": "",
+}
+
+_values: Dict[str, Any] = dict(_DEFAULTS)
+
+
+def _coerce(name: str, raw):
+    default = _DEFAULTS[name]
+    if isinstance(default, bool):
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    if isinstance(default, int) and not isinstance(default, bool):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return str(raw)
+
+
+def _apply_side_effects(name: str, value):
+    if name == "FLAGS_check_nan_inf":
+        import jax
+        jax.config.update("jax_debug_nans", bool(value))
+        jax.config.update("jax_debug_infs", bool(value))
+
+
+def set_flags(flags: Dict[str, Any]):
+    """ref paddle.set_flags / core.globals()[k] = v."""
+    for name, value in flags.items():
+        if name not in _DEFAULTS:
+            raise ValueError(f"unknown flag {name!r}")
+        _values[name] = _coerce(name, value)
+        _apply_side_effects(name, _values[name])
+
+
+def get_flags(flags):
+    """ref paddle.get_flags: name or list of names → dict."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for name in names:
+        if name not in _values:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = _values[name]
+    return out
+
+
+class _Globals:
+    """Mapping facade (ref pybind ``core.globals()``)."""
+
+    def __getitem__(self, name):
+        return get_flags(name)[name]
+
+    def __setitem__(self, name, value):
+        set_flags({name: value})
+
+    def __contains__(self, name):
+        return name in _DEFAULTS
+
+    def keys(self):
+        return _DEFAULTS.keys()
+
+
+def globals():  # noqa: A001  (parity with core.globals())
+    return _Globals()
+
+
+def _bootstrap_from_env():
+    """ref __init__.py __bootstrap__: FLAGS_* env vars seed the registry.
+    Malformed values warn and are ignored (gflags behavior) — a typo'd env
+    var must not brick ``import paddle_tpu``."""
+    import warnings
+    for name in _DEFAULTS:
+        raw = os.environ.get(name)
+        if raw is None:
+            continue
+        try:
+            set_flags({name: raw})
+        except (ValueError, TypeError) as e:
+            warnings.warn(f"ignoring malformed env var {name}={raw!r}: {e}")
+
+
+_bootstrap_from_env()

@@ -406,6 +406,13 @@ class Executor:
             raise
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
+        from .. import flags as _flags
+        if _flags._values["FLAGS_benchmark"]:
+            # ref FLAGS_benchmark: per-step device sync so wall timing is
+            # attributable (normally steps pipeline asynchronously)
+            for v in list(new_rw) + list(fetches):
+                if hasattr(v, "block_until_ready"):
+                    v.block_until_ready()
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
