@@ -78,12 +78,18 @@ def _apply_side_effects(name: str, value):
 
 
 def set_flags(flags: Dict[str, Any]):
-    """ref paddle.set_flags / core.globals()[k] = v."""
+    """ref paddle.set_flags / core.globals()[k] = v.
+
+    All names and values validate before ANY is applied, so a bad entry
+    cannot leave half-applied state."""
+    coerced = {}
     for name, value in flags.items():
         if name not in _DEFAULTS:
             raise ValueError(f"unknown flag {name!r}")
-        _values[name] = _coerce(name, value)
-        _apply_side_effects(name, _values[name])
+        coerced[name] = _coerce(name, value)
+    for name, value in coerced.items():
+        _values[name] = value
+        _apply_side_effects(name, value)
 
 
 def get_flags(flags):

@@ -406,8 +406,8 @@ class Executor:
             raise
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
-        from .. import flags as _flags
-        if _flags._values["FLAGS_benchmark"]:
+        from ..flags import get_flags
+        if get_flags("FLAGS_benchmark")["FLAGS_benchmark"]:
             # ref FLAGS_benchmark: per-step device sync so wall timing is
             # attributable (normally steps pipeline asynchronously)
             for v in list(new_rw) + list(fetches):

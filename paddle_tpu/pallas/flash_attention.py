@@ -193,6 +193,195 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
 
 
 # ---------------------------------------------------------------------------
+# Pallas TPU kernels (backward): dq pass + dk/dv pass, FlashAttention-2
+# recomputation from the saved logsumexp.  No O(T^2) tensor touches HBM.
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_sc, *, sm_scale, causal, block_q, block_k,
+                   tq_real, tk_real, offset):
+    """Grid (bh, iq, ik): accumulate dq over k-blocks in VMEM scratch."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                             # (bq, 1)
+        delta = delta_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mask = (k_pos < tk_real) & (q_pos < tq_real)
+        if causal:
+            mask = mask & (q_pos + offset >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[...] = dq_sc[...] + sm_scale * lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, sm_scale, causal,
+                    block_q, block_k, tq_real, tk_real, offset):
+    """Grid (bh, ik, iq): accumulate dk/dv over q-blocks in VMEM scratch
+    (transposed tiles: everything is (bk, ·) so the MXU contractions stay
+    tall)."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                             # (1, bq)
+        delta = delta_ref[0]
+        s_t = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32) * sm_scale
+        mask = (k_pos < tk_real) & (q_pos < tq_real)
+        if causal:
+            mask = mask & (q_pos + offset >= k_pos)
+        s_t = jnp.where(mask, s_t, NEG_INF)
+        p_t = jnp.where(s_t <= NEG_INF / 2, 0.0, jnp.exp(s_t - lse))
+        dv_sc[...] = dv_sc[...] + lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta)
+        dk_sc[...] = dk_sc[...] + sm_scale * lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                      block_k, offset, interpret):
+    """(dq, dk, dv) via the two kernels above (no-bias path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    tq_real, tk_real = tq, tk
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                    # [bh, tq]
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, pad_q), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, pad_q)))
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    tqp, tkp = tq + pad_q, tk + pad_k
+    nq, nk = tqp // block_q, tkp // block_k
+
+    # lse/delta ride as [bh, tq, 1]: block (1, block_q, 1) keeps the last
+    # dim equal to the array's (mosaic tiling constraint)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    k_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q,
+                  row_spec_q, row_spec_q],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tqp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    # dk/dv pass: grid iterates q innermost per k-block; lse/delta ride
+    # TRANSPOSED [bh, 1, tq] so the kernel reads (1, bq) rows directly
+    lse_t = lse[:, None, :]
+    delta_t = delta[:, None, :]
+    q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    k_spec_k = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec_k = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k,
+                  row_spec_k, row_spec_k],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, tkp, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tkp, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_t, delta_t)
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
+# ---------------------------------------------------------------------------
 # Blockwise JAX fallback (same math, lax.scan over k-blocks)
 # ---------------------------------------------------------------------------
 
@@ -365,9 +554,14 @@ def _flash_vjp_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     q, k, v, bias, o, lse = res
+    offset = k.shape[1] - q.shape[1]
+    if bias is None and (_on_tpu() or interpret):
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, causal,
+                                       sm_scale, block_q, block_k, offset,
+                                       interpret)
+        return dq, dk, dv, None
     dq, dk, dv, db = _flash_bwd_jax(q, k, v, bias, o, lse, do, causal,
-                                    sm_scale, block_k,
-                                    k.shape[1] - q.shape[1])
+                                    sm_scale, block_k, offset)
     return dq, dk, dv, db
 
 

@@ -110,32 +110,41 @@ def test_multi_slot_data_generator(capsys):
 
 def test_flags_system(monkeypatch):
     """ref platform/flags.cc + __bootstrap__ FLAGS_* env passthrough."""
-    import importlib
     import jax
     import paddle_tpu.flags as F
-    assert fluid.get_flags("FLAGS_allocator_strategy") == \
-        {"FLAGS_allocator_strategy": "auto_growth"}
-    fluid.set_flags({"FLAGS_eager_delete_tensor_gb": "2.5"})
-    assert F.globals()["FLAGS_eager_delete_tensor_gb"] == 2.5
-    F.globals()["FLAGS_benchmark"] = True
-    assert fluid.get_flags(["FLAGS_benchmark"])["FLAGS_benchmark"] is True
-    fluid.set_flags({"FLAGS_benchmark": False})
-    import pytest
-    with pytest.raises(ValueError):
-        fluid.set_flags({"FLAGS_not_a_flag": 1})
-    # check_nan_inf wires through to jax debug-nans
-    fluid.set_flags({"FLAGS_check_nan_inf": True})
-    assert jax.config.jax_debug_nans
-    fluid.set_flags({"FLAGS_check_nan_inf": False})
-    assert not jax.config.jax_debug_nans
-    # env bootstrap — malformed values warn and are ignored
-    monkeypatch.setenv("FLAGS_paddle_num_threads", "4")
-    monkeypatch.setenv("FLAGS_rpc_retry_times", "not_an_int")
-    import warnings
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        F._bootstrap_from_env()
-    assert any("FLAGS_rpc_retry_times" in str(x.message) for x in w)
-    assert F.globals()["FLAGS_paddle_num_threads"] == 4
-    # restore process-global defaults so later tests see pristine flags
-    F._values.update(F._DEFAULTS)
+    try:
+        assert fluid.get_flags("FLAGS_allocator_strategy") == \
+            {"FLAGS_allocator_strategy": "auto_growth"}
+        fluid.set_flags({"FLAGS_eager_delete_tensor_gb": "2.5"})
+        assert F.globals()["FLAGS_eager_delete_tensor_gb"] == 2.5
+        F.globals()["FLAGS_benchmark"] = True
+        assert fluid.get_flags(["FLAGS_benchmark"])["FLAGS_benchmark"] \
+            is True
+        fluid.set_flags({"FLAGS_benchmark": False})
+        import pytest
+        with pytest.raises(ValueError):
+            fluid.set_flags({"FLAGS_not_a_flag": 1})
+        # a bad entry must not half-apply the good ones
+        with pytest.raises(ValueError):
+            fluid.set_flags({"FLAGS_check_nan_inf": True,
+                             "FLAGS_typo": 1})
+        assert not jax.config.jax_debug_nans
+        # check_nan_inf wires through to jax debug-nans
+        fluid.set_flags({"FLAGS_check_nan_inf": True})
+        assert jax.config.jax_debug_nans
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+        assert not jax.config.jax_debug_nans
+        # env bootstrap — malformed values warn and are ignored
+        monkeypatch.setenv("FLAGS_paddle_num_threads", "4")
+        monkeypatch.setenv("FLAGS_rpc_retry_times", "not_an_int")
+        import warnings
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            F._bootstrap_from_env()
+        assert any("FLAGS_rpc_retry_times" in str(x.message) for x in w)
+        assert F.globals()["FLAGS_paddle_num_threads"] == 4
+    finally:
+        # process-global state: always restore defaults for later tests
+        F._values.update(F._DEFAULTS)
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_debug_infs", False)
