@@ -1,0 +1,158 @@
+"""Remaining book-chapter acceptance tests (ref
+python/paddle/fluid/tests/book/: test_fit_a_line.py,
+test_image_classification.py, notest_understand_sentiment.py,
+test_rnn_encoder_decoder.py) — build the chapter's model with the layer
+DSL, train until the loss clearly drops, round-trip where the chapter
+does."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.contrib import decoder as D
+from paddle_tpu.data import dataset, reader
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _train(loss, feeder_vars, batches, lr=0.01, opt=None, steps=None,
+           scope=None):
+    opt = opt or fluid.optimizer.SGD(lr)
+    opt.minimize(loss)
+    exe = Executor()
+    exe.run(fluid.default_startup_program(), scope=scope, fetch_list=[])
+    feeder = DataFeeder(feeder_vars)
+    losses = []
+    for i, b in enumerate(batches):
+        lv, = exe.run(feed=feeder.feed(b), fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv)))
+        if steps and i + 1 >= steps:
+            break
+    return losses
+
+
+def test_fit_a_line_converges():
+    """ch.1 linear regression on uci_housing (ref test_fit_a_line.py)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        batches = list(reader.batch(dataset.uci_housing.train(), 32)()) * 8
+        losses = _train(loss, [x, y], batches, lr=0.02, scope=scope)
+        assert losses[-1] < losses[0] * 0.5
+
+
+def test_image_classification_vgg_converges():
+    """ch.3 image classification: VGG-style conv groups on cifar10
+    (ref test_image_classification.py vgg16_bn_drop, shrunk)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        img = layers.data("img", shape=[3, 32, 32], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        g1 = nets.img_conv_group(img, conv_num_filter=[16, 16],
+                                 pool_size=2, conv_act="relu",
+                                 conv_with_batchnorm=True)
+        g2 = nets.img_conv_group(g1, conv_num_filter=[32, 32],
+                                 pool_size=2, conv_act="relu",
+                                 conv_with_batchnorm=True)
+        fc = layers.fc(layers.flatten(g2), size=64, act="relu")
+        logits = layers.fc(fc, size=10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        batches = list(reader.batch(dataset.cifar.train10(), 16)())[:6] * 5
+        losses = _train(loss, [img, label], batches,
+                        opt=fluid.optimizer.Adam(2e-3), scope=scope)
+        assert losses[-1] < losses[0] * 0.8
+
+
+def test_understand_sentiment_conv_converges():
+    """ch.5 sentiment: sequence-conv-pool text classifier on imdb
+    (ref notest_understand_sentiment.py convolution_net), dense padded
+    ids replacing LoD."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        seq_len, dict_dim = 40, 500
+        words = layers.data("words", shape=[seq_len], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[dict_dim, 32])
+        conv3 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=3,
+                                        act="tanh", pool_type="sqrt")
+        conv4 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=4,
+                                        act="tanh", pool_type="sqrt")
+        logits = layers.fc(layers.concat([conv3, conv4], axis=1), size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+
+        rng = np.random.RandomState(0)
+        def synth():
+            # class 0 draws low ids, class 1 high ids — separable
+            for _ in range(10):
+                batch = []
+                for _ in range(16):
+                    y = rng.randint(2)
+                    lo, hi = (2, dict_dim // 2) if y == 0 else \
+                        (dict_dim // 2, dict_dim - 1)
+                    batch.append((rng.randint(lo, hi, seq_len),
+                                  np.int64(y)))
+                yield batch
+        losses = _train(loss, [words, label], list(synth()) * 3,
+                        opt=fluid.optimizer.Adam(2e-3), scope=scope)
+        assert losses[-1] < losses[0] * 0.6
+
+
+def test_rnn_encoder_decoder_converges():
+    """ch.8-adjacent seq2seq (ref test_rnn_encoder_decoder.py): GRU-ish
+    encoder, TrainingDecoder over the StateCell, CE loss on a copy task."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        seq, vocab, word_dim, hidden = 6, 20, 16, 32
+        src = layers.data("src", shape=[seq], dtype="int64")
+        trg_in = layers.data("trg_in", shape=[seq], dtype="int64")
+        trg_out = layers.data("trg_out", shape=[seq], dtype="int64")
+
+        src_emb = layers.embedding(src, size=[vocab, word_dim])
+        from paddle_tpu.contrib.layers import basic_gru
+        _, enc_last = basic_gru(src_emb, None, hidden_size=hidden,
+                                batch_first=True, name="enc")
+        enc_state = layers.squeeze(enc_last, axes=[0])    # [batch, hidden]
+
+        cell = D.StateCell(inputs={"x": None},
+                           states={"h": D.InitState(init=enc_state)},
+                           out_state="h")
+
+        @cell.state_updater
+        def updater(sc):
+            x, h = sc.get_input("x"), sc.get_state("h")
+            sc.set_state("h", layers.fc(
+                layers.concat([x, h], axis=1), size=hidden, act="tanh",
+                param_attr=fluid.ParamAttr(name="dec_w"),
+                bias_attr=fluid.ParamAttr(name="dec_b")))
+
+        trg_emb = layers.embedding(trg_in, size=[vocab, word_dim])
+        dec = D.TrainingDecoder(cell)
+        with dec.block():
+            cur = dec.step_input(trg_emb)
+            cell.compute_state(inputs={"x": cur})
+            cell.update_states()
+            dec.output(cell.get_state("h"))
+        dec_out = dec()                                   # [b, seq, hidden]
+        logits = layers.fc(dec_out, size=vocab, num_flatten_dims=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(trg_out, [2])))
+
+        rng = np.random.RandomState(1)
+        def copy_task():
+            for _ in range(12):
+                batch = []
+                for _ in range(16):
+                    s = rng.randint(2, vocab, seq)
+                    batch.append((s, np.concatenate([[0], s[:-1]]), s))
+                yield batch
+        losses = _train(loss, [src, trg_in, trg_out], list(copy_task()) * 4,
+                        opt=fluid.optimizer.Adam(5e-3), scope=scope)
+        assert losses[-1] < losses[0] * 0.5
