@@ -11,14 +11,37 @@ class DataFeeder:
         self.feed_vars = feed_list
         self.place = place
 
+    @staticmethod
+    def _sample_shape(shape):
+        """Per-sample target shape from a declared var shape: drop the
+        leading batch dim (fluid's ``data`` prepends -1); None if any
+        remaining dim is symbolic."""
+        if shape is None:
+            return None
+        dims = [int(d) for d in shape]
+        if dims and dims[0] == -1:
+            dims = dims[1:]
+        if any(d <= 0 for d in dims):
+            return None
+        return tuple(dims)
+
     def feed(self, iterable):
-        """iterable: list of tuples, one element per feed var."""
+        """iterable: list of tuples, one element per feed var.
+
+        Each sample is reshaped to the var's declared per-sample shape
+        (ref ``python/paddle/fluid/data_feeder.py`` DataToLoDTensorConverter
+        — cifar-style flat float rows reach conv2d as [N,C,H,W])."""
         cols = list(zip(*iterable))
         out = {}
         for var, col in zip(self.feed_vars, cols):
             name = var.name if hasattr(var, "name") else var
             dtype = var.dtype if hasattr(var, "dtype") else "float32"
             arrs = [np.asarray(c, dtype=dtype) for c in col]
+            target = self._sample_shape(getattr(var, "shape", None))
+            if target is not None:
+                size = int(np.prod(target)) if target else 1
+                arrs = [a.reshape(target) if a.size == size else a
+                        for a in arrs]
             batch = np.stack(arrs, axis=0)
             # fluid convention: int labels declared [.., 1] keep trailing dim
             shape = getattr(var, "shape", None)

@@ -99,11 +99,15 @@ class _AbstractCtx:
 
 def infer_op(op: Operator, block: Block) -> None:
     """Populate output Variable shape/dtype by abstractly running the lowering."""
-    if op.type in _NO_INFER or op.type not in _REGISTRY:
+    if op.type not in _REGISTRY:
         return
     info = _REGISTRY[op.type]
     if info.infer is not None:
         info.infer(op, block)
+        return
+    if op.type in _NO_INFER or info.raw:
+        # raw (sub-block) ops can't go through eval_shape; they either carry
+        # an explicit infer above or are skipped
         return
     # symbolic batch dim: -1 is replaced by a sentinel for abstract eval and
     # mapped back afterwards (the reference's InferShape threads -1 natively).

@@ -377,18 +377,56 @@ class StaticRNN:
         self.seq_inputs.append((x, v))
         return v
 
+    def _resolve_batch_ref(self, batch_ref, ref_batch_dim_idx):
+        """Map a batch_ref var to one usable from the PARENT block.
+
+        The boot memory is built in the parent block, but callers naturally
+        pass in-block vars (the step_input result, per the reference's own
+        example, control_flow.py:408).  Step vars map back to their parent
+        sequence (batch axis 1 time-major, 0 otherwise); other in-block vars
+        fall back to any parent sequence (step inputs share the batch dim);
+        a var that is neither visible in the parent nor mappable is a
+        build-time error instead of a far-away trace-time KeyError.
+        """
+        seq_dim = 1 if self._time_major else 0
+        for x, v in self.seq_inputs:
+            if v.name == batch_ref.name:
+                return x, seq_dim
+        inner = self.program.current_block()
+        if inner.parent is not None and inner.parent.has_var(batch_ref.name):
+            return batch_ref, ref_batch_dim_idx
+        if self.seq_inputs:
+            return self.seq_inputs[0][0], seq_dim
+        raise ValueError(
+            f"memory(batch_ref={batch_ref.name!r}): var is only defined "
+            "inside the rnn step block and no step_input exists yet to take "
+            "the batch size from; call step_input first or pass a "
+            "parent-block var")
+
     def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
-               dtype="float32", init_value=None):
+               dtype="float32", init_value=None, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
         from . import tensor
         if init is None:
             if shape is None:
                 raise ValueError("StaticRNN.memory needs init or shape")
+            fill = value if init_value is None else init_value
+            if batch_ref is not None:
+                # ref control_flow.py:436: shape[init_batch_dim_idx] is
+                # replaced by batch_ref's batch size.
+                src, dim_idx = self._resolve_batch_ref(
+                    batch_ref, ref_batch_dim_idx)
             # build the init in the PARENT block (we're inside the step
             # sub-block here; static_scan reads Init from the parent env)
             with _parent_block(self.program):
-                init = tensor.fill_constant(
-                    shape=list(shape), dtype=dtype,
-                    value=value if init_value is None else init_value)
+                if batch_ref is not None:
+                    init = tensor.fill_constant_batch_size_like(
+                        src, shape=list(shape), dtype=dtype, value=fill,
+                        input_dim_idx=dim_idx,
+                        output_dim_idx=init_batch_dim_idx)
+                else:
+                    init = tensor.fill_constant(
+                        shape=list(shape), dtype=dtype, value=fill)
         block = self.program.current_block()
         v = block.create_var(
             name=self.helper.name + ".mem_" + str(len(self.memories)),
@@ -520,12 +558,12 @@ class DynamicRNN(StaticRNN):
     def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
                dtype="float32", init_value=None, need_reorder=False):
         if init is None and shape is not None and batch_ref is not None:
-            from . import tensor
-            with _parent_block(self.program):
-                init = tensor.fill_constant_batch_size_like(
-                    batch_ref, shape=[1] + list(shape), dtype=dtype,
-                    value=value if init_value is None else init_value)
-            return super().memory(init=init)
+            # ref DynamicRNN.memory: shape excludes batch (prepend the slot
+            # the boot fill replaces); parent vars are batch-major here
+            return super().memory(shape=[1] + list(shape),
+                                  batch_ref=batch_ref, value=value,
+                                  dtype=dtype, init_value=init_value,
+                                  init_batch_dim_idx=0, ref_batch_dim_idx=0)
         return super().memory(init=init, shape=shape, dtype=dtype,
                               value=value, init_value=init_value)
 

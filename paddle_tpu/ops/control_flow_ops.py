@@ -332,6 +332,59 @@ from ..framework.registry import _REGISTRY  # noqa: E402
 _REGISTRY["static_scan"].grad_maker = _static_scan_grad_maker
 
 
+# ---------------------------------------------------------------------------
+# build-time shape inference for the raw (sub-block) ops — the generic
+# eval_shape path can't trace these, so shapes are derived structurally
+# (ref recurrent_op.cc InferShape / conditional_block_infer_op.cc)
+# ---------------------------------------------------------------------------
+
+def _static_scan_infer(op, block):
+    """FinalStates mirror the in-block state vars; Out stacks the in-block
+    step outputs along the time axis (axis 0 time-major, axis 1 otherwise)."""
+    sub = op.attrs["sub_block"]
+    time_major = op.attrs.get("time_major", False)
+    T = None
+    xs = op.input("X")
+    if xs:
+        xv = block.var(xs[0])
+        if xv.shape is not None:
+            if time_major:
+                T = xv.shape[0]
+            elif len(xv.shape) > 1:
+                T = xv.shape[1]
+
+    def inner(name):
+        return sub.var(name) if sub.has_var(name) else None
+
+    for n_out, n_in in zip(op.output("FinalStates"),
+                           op.attrs["state_vars"]):
+        iv, v = inner(n_in), block.var(n_out)
+        if iv is None:
+            continue
+        if iv.shape is not None:
+            v.shape = tuple(iv.shape)
+        v.dtype = iv.dtype
+    for n_out, n_in in zip(op.output("Out"),
+                           op.attrs["step_output_vars"]):
+        iv, v = inner(n_in), block.var(n_out)
+        if iv is None:
+            continue
+        if iv.shape is not None:
+            s = list(iv.shape)
+            t = -1 if T is None else T
+            v.shape = tuple([t] + s) if time_major \
+                else tuple(s[:1] + [t] + s[1:])
+        v.dtype = iv.dtype
+
+
+# conditional_block needs no infer: its Out names resolve to the same
+# Variable objects inside and outside the sub-block (Block.var recurses to
+# ancestors, core.py:270), so the sub-block ops' own append-time inference
+# already populates them.
+
+_REGISTRY["static_scan"].infer = _static_scan_infer
+
+
 @register_op("static_scan_grad", raw=True)
 def _static_scan_grad(ctx, block, op, state):
     sub_block = op.attrs["sub_block"]
