@@ -215,20 +215,27 @@ def _batch_norm_lower(ctx, ins, attrs):
     use_global = attrs.get("use_global_stats", False) or is_test
     axes, bshape = _bn_axes(layout, x.ndim)
 
-    xf = x.astype(jnp.float32)
     if use_global:
         m, v = mean, var
         saved_m, saved_v = mean, var
         mean_out, var_out = mean, var
     else:
+        xf = x.astype(jnp.float32)
         m = jnp.mean(xf, axis=axes)
         v = jnp.var(xf, axis=axes)
         saved_m, saved_v = m, v
         mean_out = mean * momentum + m * (1 - momentum)
         var_out = var * momentum + v * (1 - momentum)
-    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
-    y = (xf - m.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
-    return {"Y": [y.astype(x.dtype)],
+    # normalization as ONE fused multiply-add in the input dtype: the
+    # per-channel affine (a, b) is computed in f32 (tiny), while the big
+    # activation tensor is touched once in bf16 — keeps the whole conv→bn→
+    # relu chain bf16 and halves HBM traffic vs f32 elementwise math
+    # (ResNet-50 train step: 91 GB → measured on-chip, see bench notes)
+    inv = jax.lax.rsqrt(v + eps)
+    a = (inv * scale)
+    b = (bias - m * a)
+    y = x * a.astype(x.dtype).reshape(bshape) + b.astype(x.dtype).reshape(bshape)
+    return {"Y": [y],
             "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [saved_m],
             "SavedVariance": [jax.lax.rsqrt(saved_v + eps)]}
@@ -240,6 +247,12 @@ def _batch_norm_grad_maker(op, block, no_grad_set):
     g_inputs = {"X$X": op.input("X"), "X$Scale": op.input("Scale"),
                 "X$Bias": op.input("Bias"),
                 "OG$Y": [grad_var_name(n) for n in op.output("Y")]}
+    if op.attrs.get("use_global_stats", False) or \
+            op.attrs.get("is_test", False):
+        # frozen BN differentiates through the running-stat normalization,
+        # not batch stats (ref batch_norm_grad use_global_stats path)
+        g_inputs["X$Mean"] = op.input("Mean")
+        g_inputs["X$Variance"] = op.input("Variance")
     g_outputs = {
         "IG$X": [grad_var_name(n) if n not in no_grad_set else ""
                  for n in op.input("X")],
@@ -257,17 +270,30 @@ register_op("batch_norm", _batch_norm_lower, grad_maker=_batch_norm_grad_maker)
 def _batch_norm_explicit_grad(ctx, ins, attrs):
     x, scale, bias = X(ins, "X$X"), X(ins, "X$Scale"), X(ins, "X$Bias")
     gy = X(ins, "OG$Y")
+    use_global = attrs.get("use_global_stats", False) or \
+        attrs.get("is_test", False)
+    run_m = X(ins, "X$Mean") if use_global else None
+    run_v = X(ins, "X$Variance") if use_global else None
 
     def fwd(x_, s_, b_):
         eps = attrs.get("epsilon", 1e-5)
         layout = attrs.get("data_layout", "NCHW")
         axes, bshape = _bn_axes(layout, x_.ndim)
-        xf = x_.astype(jnp.float32)
-        m = jnp.mean(xf, axis=axes)
-        v = jnp.var(xf, axis=axes)
-        inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
-        y = (xf - m.reshape(bshape)) * inv * s_.reshape(bshape) + b_.reshape(bshape)
-        return y.astype(x_.dtype)
+        if use_global:
+            # frozen BN: running stats are constants w.r.t. x (no dm/dx,
+            # dv/dx terms), matching the forward's use_global branch
+            m, v = run_m, run_v
+        else:
+            xf = x_.astype(jnp.float32)
+            m = jnp.mean(xf, axis=axes)
+            v = jnp.var(xf, axis=axes)
+        # same bf16 multiply-add form as the forward lowering so XLA CSEs
+        # the recomputation and the big tensors stay bf16 in the vjp
+        inv = jax.lax.rsqrt(v + eps)
+        a = inv * s_
+        b = b_ - m * a
+        return x_ * a.astype(x_.dtype).reshape(bshape) \
+            + b.astype(x_.dtype).reshape(bshape)
 
     _, vjp = jax.vjp(fwd, x, scale, bias)
     gx, gs, gb = vjp(gy)

@@ -1,0 +1,53 @@
+"""batch_norm lowering numerics, incl. the frozen-BN gradient path
+(ref ``operators/batch_norm_op.cc`` use_global_stats branch)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import (Program, Scope, append_backward,
+    program_guard, scope_guard)
+
+
+def _run_bn_grad(use_global):
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4, 3, 3], dtype="float32")
+        x.stop_gradient = False
+        y = layers.batch_norm(x, use_global_stats=use_global,
+                              param_attr=fluid.ParamAttr(name="bn_s"),
+                              bias_attr=fluid.ParamAttr(name="bn_b"),
+                              moving_mean_name="bn_m",
+                              moving_variance_name="bn_v")
+        loss = layers.mean(y * y)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        # non-trivial running stats so frozen mode differs from batch stats
+        scope.set_var("bn_m", np.full(4, 0.5, np.float32))
+        scope.set_var("bn_v", np.full(4, 2.0, np.float32))
+        rng = np.random.RandomState(0)
+        xv = rng.randn(2, 4, 3, 3).astype(np.float32)
+        gx, yv = exe.run(fluid.default_main_program(), feed={"x": xv},
+                         fetch_list=["x@GRAD", y.name], scope=scope)
+        return xv, yv, gx
+
+
+def test_frozen_bn_grad_uses_running_stats():
+    xv, yv, gx = _run_bn_grad(use_global=True)
+    n = xv.size
+    # frozen BN: y = (x - m) * rsqrt(v + eps) * s + b with constant m, v
+    inv = 1.0 / np.sqrt(2.0 + 1e-5)
+    np.testing.assert_allclose(
+        yv, (xv - 0.5) * inv, rtol=2e-2, atol=2e-2)
+    # d(mean(y^2))/dx = 2 y / n * s * inv — NO batch-stat correction terms
+    np.testing.assert_allclose(gx, 2.0 * yv / n * inv, rtol=2e-2,
+                               atol=1e-4)
+
+
+def test_train_bn_grad_has_zero_mean_per_channel():
+    # with batch stats, dL/dx is orthogonal to constants per channel:
+    # sum over (N, H, W) of gx must be ~0 (the dm/dx term removes it)
+    _, _, gx = _run_bn_grad(use_global=False)
+    sums = np.abs(gx.sum(axis=(0, 2, 3)))
+    assert (sums < 1e-3).all(), sums
