@@ -119,16 +119,18 @@ def bench_bert(dev, on_tpu, peak):
     with scope_guard(scope), program_guard(Program(), Program()):
         if on_tpu:
             cfg = T.BertConfig()           # BERT-base
-            batch, seq_len, steps = 128, 128, 32
+            batch, seq_len, steps = 128, 128, 64
         else:                              # CPU smoke fallback
             cfg = T.BertConfig(vocab_size=1024, d_model=128, n_layer=2,
                                n_head=4, d_inner=256, max_pos=128)
             batch, seq_len, steps = 4, 64, 2
             peak = 1e12
 
-        # fused chunked head: the [tokens, vocab] logits never hit HBM
+        # fused chunked head: the [tokens, vocab] logits never hit HBM;
+        # arange_pos: position embedding as a table slice (no scatter bwd)
         feeds, logits, loss = T.build_bert_pretrain(cfg, seq_len,
-                                                    fused_head=True)
+                                                    fused_head=True,
+                                                    arange_pos=True)
         optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
         optimizer.minimize(loss)
 
@@ -139,8 +141,6 @@ def bench_bert(dev, on_tpu, peak):
         feed = {
             "src_ids": jax.device_put(rng.randint(
                 1, cfg.vocab_size, (batch, seq_len)).astype(np.int32)),
-            "pos_ids": jax.device_put(np.tile(
-                np.arange(seq_len), (batch, 1)).astype(np.int32)),
             "lm_label": jax.device_put(rng.randint(
                 0, cfg.vocab_size, (batch, seq_len)).astype(np.int32)),
         }

@@ -17,9 +17,12 @@ Under XLA's liveness this makes segment intermediates die at the end of the
 forward pass and re-materialize during backward — the effect of
 ``jax.checkpoint``, expressed in the Program IR.
 
-RNG-stateful ops (dropout) are NOT recomputed — re-drawing their mask would
-silently change gradients; their outputs stay stored and feed the
-recomputed chain through barriers.
+RNG-stateful ops are NOT recomputed UNLESS their draw is replay-safe:
+tagged dropout (a nonzero ``seed`` attr) derives its bits purely from
+(per-step key, tag), so re-evaluating it reproduces the identical mask and
+it recomputes like any pure op.  Counter-stream RNG ops (untagged dropout,
+random_crop, …) would re-draw differently, so their outputs stay stored
+and feed the recomputed chain through barriers.
 """
 
 from __future__ import annotations
@@ -33,8 +36,10 @@ RECOMPUTE_SUFFIX = "@RECOMPUTE"
 BARRIER_SUFFIX = "@RBAR"
 
 
-def _is_rng_op(op_type: str) -> bool:
-    info = registry._REGISTRY.get(op_type)
+def _is_rng_op(op: Operator) -> bool:
+    if op.type == "dropout" and op.attrs.get("seed", 0):
+        return False     # tagged dropout replays bit-identically — pure
+    info = registry._REGISTRY.get(op.type)
     return bool(info and info.stateful_rng)
 
 
@@ -86,7 +91,7 @@ def apply_recompute(program: Program,
             if ckpt & set(outs):
                 seen_ckpt = True
             continue
-        if _is_rng_op(op.type) or op.type in ("feed",):
+        if _is_rng_op(op) or op.type in ("feed",):
             continue
         needed = any(o in bwd_reads and o not in ckpt for o in outs)
         feeds_chain = any(o in rename for o in op.input_arg_names())

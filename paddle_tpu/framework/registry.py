@@ -92,6 +92,9 @@ class _AbstractCtx:
     def rng(self):
         return jax.random.key(0)
 
+    def rng_tagged(self, tag):
+        return jax.random.key(0)
+
     @property
     def mesh(self):
         return None

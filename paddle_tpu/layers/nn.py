@@ -485,13 +485,21 @@ def dice_loss(input, label, epsilon=1e-5):
 
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
+    import zlib
     helper = LayerHelper("dropout", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
     mask = helper.create_variable_for_type_inference("uint8", True)
+    # per-op RNG tag (derived from the unique out name when the user gives
+    # no seed): forward and backward fold the same tag into the per-step
+    # key and regenerate identical bits, so the mask is never stored.
+    # An explicit seed IS the tag — as in the reference's fix_seed path
+    # (dropout_op.cc), two ops given the same seed draw the same pattern.
+    tag = seed if seed is not None else \
+        (zlib.crc32(out.name.encode()) & 0x7FFFFFFF) or 1
     helper.append_op("dropout", inputs={"X": [x]},
                      outputs={"Out": [out], "Mask": [mask]},
                      attrs={"dropout_prob": dropout_prob, "is_test": is_test,
-                            "seed": seed or 0,
+                            "seed": tag,
                             "dropout_implementation": dropout_implementation})
     return out
 

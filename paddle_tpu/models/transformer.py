@@ -119,14 +119,28 @@ def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
 
 def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
             n_head, dropout_rate=0.0, attn_bias=None, is_test=False,
-            type_ids=None, n_types=2, attn_impl="base", checkpoints=None):
+            type_ids=None, n_types=2, attn_impl="base", checkpoints=None,
+            arange_pos=False):
     """BERT-style embedding + N encoder layers.  Pass ``checkpoints=[]`` to
     collect each layer's output for RecomputeOptimizer (remat at layer
-    boundaries — the standard transformer memory/compute trade)."""
+    boundaries — the standard transformer memory/compute trade).
+
+    ``arange_pos=True``: positions are the canonical 0..T-1 for every row
+    (always true in the pretrain recipe), so the position embedding is a
+    static slice of the table broadcast over the batch — no [tokens]-sized
+    gather forward and, more importantly, no scatter-add backward."""
     emb = layers.embedding(src_ids, size=[vocab_size, d_model],
                            param_attr=ParamAttr(name="word_embedding"))
-    pos = layers.embedding(pos_ids, size=[max_pos, d_model],
-                           param_attr=ParamAttr(name="pos_embedding"))
+    if arange_pos:
+        seq_len = src_ids.shape[-1]
+        pos_table = layers.create_parameter(
+            [max_pos, d_model], dtype="float32",
+            attr=ParamAttr(name="pos_embedding"))
+        pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq_len])
+        pos = layers.unsqueeze(pos, [0])          # [1, T, D] broadcast-add
+    else:
+        pos = layers.embedding(pos_ids, size=[max_pos, d_model],
+                               param_attr=ParamAttr(name="pos_embedding"))
     x = emb + pos
     if type_ids is not None:
         x = x + layers.embedding(type_ids, size=[n_types, d_model],
@@ -167,7 +181,7 @@ class BertConfig:
 
 def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
                         dropout=None, attn_impl="base", fused_head=False,
-                        checkpoints=None):
+                        checkpoints=None, arange_pos=False):
     """Masked-LM pretraining net: ids+mask-labels → mean masked CE loss.
 
     Labels use 0 ([PAD], never a real MLM target) for unmasked positions;
@@ -180,12 +194,15 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
     step; ``logits`` is returned as None in that mode."""
     dropout = cfg.dropout if dropout is None else dropout
     src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
-    pos_ids = layers.data("pos_ids", shape=[seq_len], dtype="int64")
+    # arange_pos: positions come from a static table slice, so no pos_ids
+    # feed exists at all (no dead input to synthesize and ship)
+    pos_ids = None if arange_pos else \
+        layers.data("pos_ids", shape=[seq_len], dtype="int64")
     lm_label = layers.data("lm_label", shape=[seq_len], dtype="int64")
     enc = encoder(src_ids, pos_ids, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
                   cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
                   is_test=is_test, attn_impl=attn_impl,
-                  checkpoints=checkpoints)
+                  checkpoints=checkpoints, arange_pos=arange_pos)
     if fused_head:
         loss = layers.fused_lm_head_ce(
             enc, cfg.vocab_size, lm_label,
@@ -203,7 +220,9 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
     masked = layers.reduce_sum(loss * layers.unsqueeze(mask, [2]))
     denom = layers.reduce_sum(mask) + 1e-6
     avg_loss = masked / denom
-    return (src_ids, pos_ids, lm_label), logits, avg_loss
+    feeds = (src_ids, lm_label) if arange_pos else \
+        (src_ids, pos_ids, lm_label)
+    return feeds, logits, avg_loss
 
 
 def annotate_tensor_parallel(program=None):

@@ -9,9 +9,10 @@ Reference kernels: ``operators/conv_op.cc`` (+ ``conv_cudnn_op.cu``),
 
 TPU notes: convs lower to ``lax.conv_general_dilated`` which XLA tiles onto
 the MXU; data stays in the framework-visible NCHW layout for API parity and
-XLA picks the internal layout.  Dropout keeps its mask as an op output so its
-grad reuses it (same trick as the reference's Mask output) instead of
-re-deriving RNG state in the backward pass.
+XLA picks the internal layout.  Dropout REGENERATES its keep mask in the
+backward pass from a per-op RNG tag (recompute beats the reference's stored
+Mask on an HBM-bound step); the Mask output remains for API parity and for
+legacy untagged ops.
 """
 
 from __future__ import annotations
@@ -307,14 +308,19 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     lead = x.shape[:begin]
-    xf = x.astype(jnp.float32).reshape(int(np.prod(lead)), -1)
+    x2 = x.reshape(int(np.prod(lead)), -1)
+    xf = x2.astype(jnp.float32)
     m = jnp.mean(xf, axis=1, keepdims=True)
     v = jnp.var(xf, axis=1, keepdims=True)
-    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    # stats in f32 (fused reduce over the bf16 input); the per-row affine
+    # is tiny, so the big tensor is only touched by bf16 elementwise ops —
+    # same traffic-halving treatment as batch_norm's FMA form
+    inv = jax.lax.rsqrt(v + eps)
+    y = (x2 - m.astype(x2.dtype)) * inv.astype(x2.dtype)
     if scale is not None:
-        y = y * scale.reshape(1, -1)
+        y = y * scale.astype(y.dtype).reshape(1, -1)
     if bias is not None:
-        y = y + bias.reshape(1, -1)
+        y = y + bias.astype(y.dtype).reshape(1, -1)
     return {"Y": [y.reshape(x.shape).astype(x.dtype)],
             "Mean": [m.reshape(lead)], "Variance": [v.reshape(lead)]}
 
@@ -626,6 +632,27 @@ def _center_loss(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+def _dropout_keep(ctx, attrs, shape):
+    """The 0/1 keep mask, regenerated identically wherever it's evaluated:
+    the RNG key is a pure function of (per-step seed, op tag), so forward
+    and backward recompute the same bits instead of storing the mask.
+
+    uint8 threshold test: random-bit GENERATION is the dominant dropout
+    cost on TPU (~105 GB/s rbg rate measured on v5e), so one byte per
+    element; resolution 1/256 rounds the keep rate by <0.2% absolute.
+    Compare in int32: the threshold for p→1.0 is 256, which would wrap to
+    0 as uint8 and keep everything.
+    """
+    p = attrs.get("dropout_prob", 0.5)
+    tag = attrs.get("seed", 0)
+    key = ctx.rng_tagged(tag) if tag else ctx.rng()
+    bits = jax.random.bits(key, shape, jnp.uint8)
+    # floor of 1 so tiny-but-nonzero probs still drop ~1/256 instead of
+    # silently becoming a no-op
+    threshold = max(1, int(round(float(p) * 256.0))) if p > 0 else 0
+    return bits.astype(jnp.int32) >= threshold
+
+
 def _dropout_lower(ctx, ins, attrs):
     x = X(ins, "X")
     p = attrs.get("dropout_prob", 0.5)
@@ -634,14 +661,7 @@ def _dropout_lower(ctx, ins, attrs):
     if is_test:
         out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
         return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
-    # uint16 threshold test instead of bernoulli's f32 uniform: 4× less
-    # random-bit traffic for the same mask (resolution 1/65536 ≈ exact for
-    # any printed dropout_prob); dropout masks are pure HBM bandwidth.
-    # Compare in int32: the threshold for p→1.0 is 65536, which would wrap
-    # to 0 as uint16 and keep everything
-    bits = jax.random.bits(ctx.rng(), x.shape, jnp.uint16)
-    threshold = int(round(float(p) * 65536.0))
-    keep = bits.astype(jnp.int32) >= threshold
+    keep = _dropout_keep(ctx, attrs, x.shape)
     if impl == "upscale_in_train":
         scale = 1.0 / (1.0 - p) if p < 1.0 else 0.0
         out = jnp.where(keep, x * scale, 0.0)
@@ -651,8 +671,10 @@ def _dropout_lower(ctx, ins, attrs):
 
 
 def _dropout_grad_maker(op, block, no_grad_set):
-    g_inputs = {"Mask": op.output("Mask"),
-                "OutGrad": [grad_var_name(n) for n in op.output("Out")]}
+    g_inputs = {"OutGrad": [grad_var_name(n) for n in op.output("Out")]}
+    if not op.attrs.get("seed", 0):
+        # legacy untagged op: the stored mask is the only way to replay it
+        g_inputs["Mask"] = op.output("Mask")
     g_outputs = {"XGrad": [grad_var_name(n) for n in op.input("X")]}
     return [{"type": "dropout_grad", "inputs": g_inputs,
              "outputs": g_outputs, "attrs": dict(op.attrs)}]
@@ -662,13 +684,17 @@ register_op("dropout", _dropout_lower, grad_maker=_dropout_grad_maker,
             stateful_rng=True)
 
 
-@register_op("dropout_grad")
+@register_op("dropout_grad", stateful_rng=True)
 def _dropout_grad(ctx, ins, attrs):
-    mask, gout = X(ins, "Mask"), X(ins, "OutGrad")
+    gout = X(ins, "OutGrad")
     p = attrs.get("dropout_prob", 0.5)
     impl = attrs.get("dropout_implementation", "downgrade_in_infer")
     scale = (1.0 / (1.0 - p)) if (impl == "upscale_in_train" and p < 1.0) else 1.0
-    return {"XGrad": [gout * mask.astype(gout.dtype) * scale]}
+    if attrs.get("seed", 0):
+        keep = _dropout_keep(ctx, attrs, gout.shape)
+    else:
+        keep = X(ins, "Mask").astype(bool)
+    return {"XGrad": [jnp.where(keep, gout * scale, 0.0).astype(gout.dtype)]}
 
 
 @register_op("random_crop", no_grad=True, stateful_rng=True)

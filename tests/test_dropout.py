@@ -1,0 +1,92 @@
+"""Dropout mask-regeneration consistency: forward and backward fold the
+same RNG tag, so the gradient's regenerated mask must equal the forward's
+(the mask is never stored — ref dropout_op.cc stores it; on TPU recompute
+beats the HBM round trip)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import (Program, Scope, append_backward,
+                                  program_guard, scope_guard)
+
+
+def test_dropout_grad_mask_matches_forward():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[64], dtype="float32")
+        x.stop_gradient = False
+        y = layers.dropout(x, dropout_prob=0.4,
+                           dropout_implementation="upscale_in_train")
+        loss = layers.mean(y)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xv = np.ones((8, 64), np.float32)
+        yv, gx = exe.run(fluid.default_main_program(), feed={"x": xv},
+                         fetch_list=[y.name, "x@GRAD"], scope=scope)
+        # identical keep pattern: out nonzero <=> grad nonzero
+        np.testing.assert_array_equal(yv != 0, gx != 0)
+        # kept entries carry the upscale factor
+        assert np.allclose(yv[yv != 0], 1.0 / 0.6, rtol=1e-5)
+        n = xv.size
+        assert np.allclose(gx[gx != 0], 1.0 / 0.6 / n, rtol=1e-5)
+        # drop rate lands near p
+        rate = float((yv == 0).mean())
+        assert 0.25 < rate < 0.55, rate
+
+
+def test_two_dropouts_are_decorrelated():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4096], dtype="float32")
+        a = layers.dropout(x, dropout_prob=0.5,
+                           dropout_implementation="upscale_in_train")
+        b = layers.dropout(x, dropout_prob=0.5,
+                           dropout_implementation="upscale_in_train")
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        av, bv = exe.run(fluid.default_main_program(),
+                         feed={"x": np.ones((2, 4096), np.float32)},
+                         fetch_list=[a.name, b.name], scope=scope)
+        agreement = float(((av != 0) == (bv != 0)).mean())
+        assert 0.4 < agreement < 0.6, agreement  # ~50% if independent
+
+
+def test_dropout_explicit_seed_is_the_tag():
+    """Same explicit seed → identical masks (ref fix_seed semantics);
+    different seeds → decorrelated.  Both measured in ONE program/step so
+    the per-step key is shared and only the tag differs."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4096], dtype="float32")
+        a = layers.dropout(x, dropout_prob=0.5, seed=123,
+                           dropout_implementation="upscale_in_train")
+        b = layers.dropout(x, dropout_prob=0.5, seed=123,
+                           dropout_implementation="upscale_in_train")
+        c = layers.dropout(x, dropout_prob=0.5, seed=456,
+                           dropout_implementation="upscale_in_train")
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        av, bv, cv = exe.run(fluid.default_main_program(),
+                             feed={"x": np.ones((2, 4096), np.float32)},
+                             fetch_list=[a.name, b.name, c.name],
+                             scope=scope)
+        np.testing.assert_array_equal(av != 0, bv != 0)
+        agreement = float(((av != 0) == (cv != 0)).mean())
+        assert 0.4 < agreement < 0.6, agreement
+
+
+def test_dropout_tiny_prob_still_drops():
+    """p just above 0 must not quantize to a no-op (threshold floor)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[65536], dtype="float32")
+        y = layers.dropout(x, dropout_prob=0.001,
+                           dropout_implementation="upscale_in_train")
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        yv, = exe.run(fluid.default_main_program(),
+                      feed={"x": np.ones((4, 65536), np.float32)},
+                      fetch_list=[y.name], scope=scope)
+        assert (yv == 0).sum() > 0

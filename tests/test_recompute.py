@@ -56,15 +56,16 @@ def test_recompute_exact_parity():
                for n in op.output_arg_names())
 
 
-def test_recompute_with_dropout_keeps_mask():
-    """RNG ops are excluded: dropout masks stay stored, so grads stay
-    consistent (re-drawing the mask in backward would corrupt them)."""
+def test_recompute_replays_tagged_dropout():
+    """Tagged dropout is replay-safe — its bits are a pure function of
+    (per-step key, tag) — so recompute re-emits it instead of storing its
+    output; untagged (seed=0) dropout stays stored."""
     with program_guard(Program(), Program()), scope_guard(Scope()):
         x = layers.data("x", shape=[16], dtype="float32")
         y = layers.data("y", shape=[1], dtype="float32")
         h = layers.fc(x, size=16, act="tanh")
         c1 = h
-        h = layers.dropout(h, dropout_prob=0.5)
+        h = layers.dropout(h, dropout_prob=0.5)        # tagged (default)
         h = layers.fc(h, size=16, act="tanh")
         pred = layers.fc(h, size=1)
         loss = layers.mean(layers.square_error_cost(pred, y))
@@ -72,11 +73,10 @@ def test_recompute_with_dropout_keeps_mask():
         opt._set_checkpoints([c1])
         opt.minimize(loss)
         prog = fluid.default_main_program()
-        # no dropout op in the recompute chain
-        for op in prog.global_block().ops:
-            if op.type == "dropout":
-                assert not any("@RECOMPUTE" in n
-                               for n in op.output_arg_names())
+        recomputed = [op for op in prog.global_block().ops
+                      if op.type == "dropout" and
+                      any("@RECOMPUTE" in n for n in op.output_arg_names())]
+        assert recomputed, "tagged dropout should re-emit in the remat chain"
         exe = Executor()
         exe.run(fluid.default_startup_program(), seed=3)
         rng = np.random.RandomState(1)
@@ -86,6 +86,37 @@ def test_recompute_with_dropout_keeps_mask():
             yv = xv.sum(1, keepdims=True).astype(np.float32)
             last, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
         assert np.isfinite(float(last))
+
+
+def test_recompute_keeps_untagged_dropout_stored():
+    """seed=0 (legacy untagged) dropout draws from the counter stream, so
+    re-drawing would change gradients — it must stay OUT of the chain."""
+    from paddle_tpu.layer_helper import LayerHelper
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="tanh")
+        c1 = h
+        helper = LayerHelper("dropout")
+        out = helper.create_variable_for_type_inference(h.dtype)
+        mask = helper.create_variable_for_type_inference("uint8", True)
+        helper.append_op("dropout", inputs={"X": [h]},
+                         outputs={"Out": [out], "Mask": [mask]},
+                         attrs={"dropout_prob": 0.5, "is_test": False,
+                                "seed": 0,
+                                "dropout_implementation":
+                                    "downgrade_in_infer"})
+        h = layers.fc(out, size=16, act="tanh")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.RecomputeOptimizer(fluid.optimizer.SGD(0.1))
+        opt._set_checkpoints([c1])
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        for op in prog.global_block().ops:
+            if op.type == "dropout":
+                assert not any("@RECOMPUTE" in n
+                               for n in op.output_arg_names())
 
 
 def test_backward_entry_point_applies_recompute():
