@@ -1118,12 +1118,14 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128, name=None):
+                    block_q=None, block_k=None, name=None):
     """Fused online-softmax attention over [b, h, T, d] tensors.
 
     TPU-native replacement for the matmul→softmax→matmul chain of the
     reference Transformer recipe (ref dist_transformer.py:1034
     scaled_dot_product_attention) — Pallas kernel on TPU, O(T) memory.
+    block_q/block_k default to the kernel's tuned sizes (512/1024 capped
+    at T — the v5e-measured optimum).
     """
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
@@ -1133,7 +1135,7 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     helper.append_op("flash_attention", inputs=inputs,
                      outputs={"Out": [out]},
                      attrs={"causal": causal, "sm_scale": sm_scale or 0.0,
-                            "block_q": block_q, "block_k": block_k})
+                            "block_q": block_q or 0, "block_k": block_k or 0})
     return out
 
 

@@ -27,11 +27,17 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
     """ref dist_transformer.py:958 multi_head_attention.
 
     attn_impl: "base" (matmul→softmax→matmul chain, ref recipe),
-    "flash" (fused Pallas kernel, O(T) memory), or "ring"
-    (sequence-parallel over the mesh's sp axis).  Fused paths skip
-    attention-weight dropout (standard for flash attention).
+    "flash" (fused Pallas kernel, O(T) memory), "ring"
+    (sequence-parallel over the mesh's sp axis), or "auto" — flash when
+    it's the measured winner (T ≥ 1024 on v5e, and exact semantics are
+    preserved, i.e. no attention-weight dropout wanted), else base.
+    Fused paths skip attention-weight dropout (standard for flash).
     """
     d_head = d_model // n_head
+    if attn_impl == "auto":
+        seq = queries.shape[1] if queries.shape is not None else 0
+        exact = (dropout_rate == 0.0) or is_test
+        attn_impl = "flash" if (seq and seq >= 1024 and exact) else "base"
 
     def _proj(x, size, name):
         return layers.fc(x, size=size, num_flatten_dims=2,

@@ -25,11 +25,12 @@ def _flash_attention(ctx, ins, attrs):
     from ..pallas import flash_attention
     q, k, v = X(ins, "Q"), X(ins, "K"), X(ins, "V")
     bias = X(ins, "Bias")
+    bq, bk = attrs.get("block_q"), attrs.get("block_k")
     out = flash_attention(
         q, k, v, bias=bias, causal=bool(attrs.get("causal", False)),
         sm_scale=attrs.get("sm_scale") or None,
-        block_q=int(attrs.get("block_q", 128) or 128),
-        block_k=int(attrs.get("block_k", 128) or 128))
+        block_q=int(bq) if bq else None,     # None → kernel's tuned default
+        block_k=int(bk) if bk else None)
     return {"Out": [out]}
 
 

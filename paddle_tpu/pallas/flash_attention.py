@@ -570,17 +570,26 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Fused attention over [batch, heads, T, head_dim] tensors.
 
     ``bias`` broadcasts over (batch, heads): accepted shapes are
     [b, h, Tq, Tk], [1, 1, Tq, Tk] or [Tq, Tk].
+
+    Default blocks are (512, 1024) capped at the sequence lengths —
+    measured on v5e: 7.6× faster than 128×128 at T=16k (23–25 ms f+b at
+    [1,16,16384,128]), and ahead of XLA's O(T²) attention from T≈1024.
     """
     b, h, tq, d = q.shape
     tk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if block_q is None:
+        block_q = min(512, tq)
+    if block_k is None:
+        block_k = min(1024, tk)
     qc = q.reshape(b * h, tq, d)
     kc = k.reshape(b * h, tk, d)
     vc = v.reshape(b * h, tk, d)
