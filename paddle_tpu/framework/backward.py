@@ -99,29 +99,90 @@ def _append_backward_tagged(block, program, loss, no_grad, relevant, needed,
                     if n:
                         have_grad.add(n)
 
-    # rename duplicate grad producers and insert sum ops
-    # (ref backward.py _addup_repetitive_outputs_)
-    producers: Dict[str, List[Tuple[int, str, int]]] = {}
+    # Resolve grad dataflow: sum parallel contributions (ref backward.py
+    # _addup_repetitive_outputs_) AND version in-place redefinitions (ref
+    # _rename_grad_ for in-place ops).  A desc that consumes grad name N
+    # and produces N again (while_grad on a carried var) REPLACES the
+    # value — its output gets a fresh version and later consumers read
+    # that version; plain producers of the current version are summands,
+    # materialized right before the first desc that reads them.
+    ver: Dict[str, int] = {}
+
+    def rd(n):
+        v = ver.get(n, 0)
+        return n if v == 0 else f"{n}@V{v}"
+
+    contribs: Dict[str, object] = {}
+    MATERIALIZED = object()   # truthy sentinel: sum already scheduled/read
+    sums_before: Dict[int, List[Tuple[str, List[str]]]] = {}
+    end_sums: List[Tuple[str, List[str]]] = []
+    end_assigns: List[Tuple[str, str]] = []
+
+    def _materialize(n, at_di):
+        """Rename this version's pending summands and schedule their sum."""
+        sites = contribs.get(n)
+        if sites is MATERIALIZED or not sites or len(sites) == 1:
+            if sites and sites is not MATERIALIZED:
+                contribs[n] = MATERIALIZED
+            return
+        parts = []
+        for k, (pi, slot, j) in enumerate(sites):
+            pn = f"{rd(n)}@RENAME@{k}"
+            descs[pi]["outputs"][slot][j] = pn
+            parts.append(pn)
+        if at_di is None:
+            end_sums.append((rd(n), parts))
+        else:
+            sums_before.setdefault(at_di, []).append((rd(n), parts))
+        contribs[n] = MATERIALIZED
+
     for di, d in enumerate(descs):
+        raw_ins = {n for names in d["inputs"].values() for n in names if n}
+        for n in raw_ins:
+            _materialize(n, di)
+        for slot, names in d["inputs"].items():
+            d["inputs"][slot] = [rd(n) if n else n for n in names]
         for slot, names in d["outputs"].items():
             for j, n in enumerate(names):
-                if n:
-                    producers.setdefault(n, []).append((di, slot, j))
-    sum_after: Dict[int, List[Tuple[str, List[str]]]] = {}
-    for name, plist in producers.items():
-        if len(plist) <= 1:
-            continue
-        renamed = []
-        for k, (di, slot, j) in enumerate(plist):
-            rn = f"{name}@RENAME@{k}"
-            descs[di]["outputs"][slot][j] = rn
-            renamed.append(rn)
-        last_di = plist[-1][0]
-        sum_after.setdefault(last_di, []).append((name, renamed))
+                if not n:
+                    continue
+                if n in raw_ins and contribs.get(n):
+                    # redefinition: new version, sole producer so far
+                    ver[n] = ver.get(n, 0) + 1
+                    d["outputs"][slot][j] = rd(n)
+                    contribs[n] = [(di, slot, j)]
+                else:
+                    d["outputs"][slot][j] = rd(n)
+                    prev = contribs.setdefault(n, [])
+                    if prev is MATERIALIZED:
+                        # contribution arriving after a consumer already
+                        # read the sum would silently be dropped — reverse
+                        # generation order makes this impossible
+                        raise AssertionError(
+                            f"late grad contribution to {n!r}")
+                    prev.append((di, slot, j))
+
+    for n in list(contribs):
+        _materialize(n, None)          # unconsumed summands (param grads)
+        if rd(n) != n:
+            # optimizers look up the canonical <name>@GRAD
+            end_assigns.append((n, rd(n)))
 
     # append to block, materializing grad vars
+    def _append_sum(name, parts):
+        if not block.has_var(name):
+            src = block.var(parts[0]) if block.has_var(parts[0]) else None
+            block.create_var(name=name,
+                             shape=src.shape if src else None,
+                             dtype=src.dtype if src else "float32",
+                             stop_gradient=True)
+        block.append_op("sum", inputs={"X": parts},
+                        outputs={"Out": [name]})
+
     appended: List[Operator] = []
     for di, d in enumerate(descs):
+        for name, parts in sums_before.get(di, []):
+            _append_sum(name, parts)
         _ensure_grad_vars(block, d)
         op = Operator(block, d["type"], None, None, d["attrs"])
         op.inputs = d["inputs"]
@@ -129,15 +190,17 @@ def _append_backward_tagged(block, program, loss, no_grad, relevant, needed,
         block.ops.append(op)
         program._bump_version()
         appended.append(op)
-        for name, renamed in sum_after.get(di, []):
-            if not block.has_var(name):
-                src = block.var(renamed[0]) if block.has_var(renamed[0]) else None
-                block.create_var(name=name,
-                                 shape=src.shape if src else None,
-                                 dtype=src.dtype if src else "float32",
-                                 stop_gradient=True)
-            block.append_op("sum", inputs={"X": renamed},
-                            outputs={"Out": [name]})
+    for name, parts in end_sums:
+        _append_sum(name, parts)
+    for target, src in end_assigns:
+        if not block.has_var(target):
+            sv = block.var(src) if block.has_var(src) else None
+            block.create_var(name=target,
+                             shape=sv.shape if sv else None,
+                             dtype=sv.dtype if sv else "float32",
+                             stop_gradient=True)
+        block.append_op("assign", inputs={"X": [src]},
+                        outputs={"Out": [target]})
 
     # collect (param, grad) pairs
     if parameter_list is not None:

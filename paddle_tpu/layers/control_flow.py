@@ -10,6 +10,7 @@ shape-static; reverse-mode grads flow through ``StaticRNN``/``DynamicRNN``
 
 from __future__ import annotations
 
+from ..framework import unique_name
 from ..framework.core import Variable, default_main_program
 from ..layer_helper import LayerHelper
 from . import tensor
@@ -68,17 +69,27 @@ def is_empty(x, cond=None):
 
 
 class While:
-    """``while cond: body`` over a sub-block → lax.while_loop.
+    """``while cond: body`` over a sub-block.
 
     ref control_flow.py While / operators/controlflow/while_op.cc:43.
-    Forward-only (lax.while_loop is not reverse-differentiable); use
-    StaticRNN/DynamicRNN (scan) for differentiable recurrence.
+
+    Two lowerings:
+    - unbounded (default): ``lax.while_loop`` — forward-only
+      (``while_loop`` has no reverse-mode rule);
+    - ``max_trip_count=N``: a ``lax.scan`` over N steps with an
+      active-mask (iterations after the condition turns false pass the
+      carry through unchanged), which IS reverse-differentiable — the
+      TPU analog of the reference's ``WhileGradOp``
+      (operators/controlflow/while_op.cc:312).  The loop must converge
+      within N trips; extra trips cost compute but not correctness.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None,
+                 max_trip_count=None):
         self.cond_var = cond
         self.program = default_main_program()
         self.helper = LayerHelper("while", name=name)
+        self.max_trip_count = max_trip_count
 
     def block(self):
         return _WhileBlockGuard(self)
@@ -124,12 +135,30 @@ class _WhileBlockGuard:
         # further up the chain
         carried = sorted({n for n in (read | written) if parent.has_var(n)}
                          | {self.while_op.cond_var.name})
-        parent.append_op(
-            "while",
-            inputs={"Condition": [self.while_op.cond_var.name],
-                    "X": sorted(n for n in read if parent.has_var(n))},
-            outputs={"Out": list(carried)},
-            attrs={"sub_block": inner, "carried_vars": list(carried)})
+        reads = sorted(n for n in read if parent.has_var(n))
+        max_trips = self.while_op.max_trip_count
+        inputs = {"Condition": [self.while_op.cond_var.name], "X": reads}
+        attrs = {"sub_block": inner, "carried_vars": list(carried),
+                 "cond_var": self.while_op.cond_var.name}
+        if max_trips is not None:
+            # differentiable path: snapshot the initial carried values so
+            # while_grad can replay the loop (the loop writes carried vars
+            # in place, destroying their pre-loop values)
+            snaps = []
+            for n in carried:
+                v = parent.var(n)
+                # unique per loop: two Whiles carrying the same var must
+                # not share (and overwrite) one snapshot
+                snap = parent.create_var(
+                    name=unique_name.generate(n + "@WHILE_INIT"),
+                    shape=v.shape, dtype=v.dtype)
+                parent.append_op("assign", inputs={"X": [n]},
+                                 outputs={"Out": [snap.name]}, attrs={})
+                snaps.append(snap.name)
+            inputs["InitSnapshot"] = snaps
+            attrs["max_trip_count"] = int(max_trips)
+        parent.append_op("while", inputs=inputs,
+                         outputs={"Out": list(carried)}, attrs=attrs)
         return False
 
 

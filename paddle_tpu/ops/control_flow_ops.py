@@ -26,7 +26,69 @@ def _trace_subblock(ctx, sub_block, env):
     return state.values
 
 
-@register_op("while", no_grad=True, raw=True)
+def _while_scan(ctx, sub_block, carried, cond_name, consts, init,
+                max_trips):
+    """Bounded while as a scan over max_trips steps: each step is a
+    lax.cond between the body and a pass-through.  Unlike lax.while_loop
+    this is reverse-differentiable (scan + cond both have VJP rules) —
+    the TPU realization of ref WhileGradOp (while_op.cc:312).  lax.cond
+    (not a where-mask) matters twice: dead iterations skip the body's
+    compute, and the body never re-executes on the frozen exit state —
+    so condition-guarded domains (1/(limit-i), sqrt(limit-i), …) can't
+    produce NaNs that would poison the transpose."""
+    def take(carry):
+        env = dict(consts)
+        env.update(zip(carried, carry))
+        env = _trace_subblock(ctx, sub_block, env)
+        return tuple(
+            jnp.asarray(env[n]).astype(c.dtype).reshape(jnp.shape(c))
+            for n, c in zip(carried, carry))
+
+    def body(carry, _):
+        env = dict(consts)
+        env.update(zip(carried, carry))
+        active = jnp.reshape(env[cond_name], ()).astype(bool)
+        return jax.lax.cond(active, take, lambda c: c, carry), None
+
+    final, _ = jax.lax.scan(body, init, None, length=max_trips)
+    return final
+
+
+def _while_grad_maker(op, block, no_grad_set):
+    """Grad op for the bounded (max_trip_count) while: consumes the final
+    carried grads, replays the scan under jax.vjp from the snapshotted
+    initial values, and emits grads for the initial carried values and
+    the read-only captures."""
+    from ..framework.core import grad_var_name
+    if "max_trip_count" not in op.attrs:
+        return []               # unbounded while stays forward-only
+    carried = op.attrs["carried_vars"]
+
+    def _is_float(n):
+        if not block.has_var(n):
+            return False
+        v = block.var(n)
+        return v.dtype is not None and str(v.dtype).startswith("float")
+
+    params = [n for n in op.input("X")
+              if n not in carried and _is_float(n) and n not in no_grad_set]
+    g_inputs = {
+        "InitSnapshot": list(op.input("InitSnapshot")),
+        "Params": params,
+        "OutGrad": [grad_var_name(n) if _is_float(n) else ""
+                    for n in carried],
+    }
+    g_outputs = {
+        "InitGrad": [grad_var_name(n)
+                     if _is_float(n) and n not in no_grad_set else ""
+                     for n in carried],
+        "ParamsGrad": [grad_var_name(n) for n in params],
+    }
+    return [{"type": "while_grad", "inputs": g_inputs,
+             "outputs": g_outputs, "attrs": dict(op.attrs)}]
+
+
+@register_op("while", raw=True, grad_maker=_while_grad_maker)
 def _while(ctx, block, op, state):
     sub_block = op.attrs["sub_block"]
     carried = op.attrs["carried_vars"]
@@ -35,20 +97,73 @@ def _while(ctx, block, op, state):
     consts = {n: state.values[n] for n in read_names
               if n in state.values and n not in carried}
     init = tuple(state.read(block, n) for n in carried)
+    max_trips = op.attrs.get("max_trip_count")
 
-    def cond_fn(carry):
-        env = dict(consts)
-        env.update(zip(carried, carry))
-        return jnp.reshape(env[cond_name], ()).astype(bool)
+    if max_trips is not None:
+        final = _while_scan(ctx, sub_block, carried, cond_name, consts,
+                            init, max_trips)
+    else:
+        def cond_fn(carry):
+            env = dict(consts)
+            env.update(zip(carried, carry))
+            return jnp.reshape(env[cond_name], ()).astype(bool)
 
-    def body_fn(carry):
-        env = dict(consts)
-        env.update(zip(carried, carry))
-        env = _trace_subblock(ctx, sub_block, env)
-        return tuple(env[n] for n in carried)
+        def body_fn(carry):
+            env = dict(consts)
+            env.update(zip(carried, carry))
+            env = _trace_subblock(ctx, sub_block, env)
+            return tuple(env[n] for n in carried)
 
-    final = jax.lax.while_loop(cond_fn, body_fn, init)
+        final = jax.lax.while_loop(cond_fn, body_fn, init)
     for n, v in zip(carried, final):
+        state.write(n, v)
+
+
+def _cot(state, gname, primal):
+    """Default cotangent: the named grad value if present, else zeros —
+    shared by the scan-family grad lowerings."""
+    g = state.values.get(gname) if gname else None
+    if g is None:
+        return jnp.zeros(jnp.shape(primal), primal.dtype)
+    return g.astype(primal.dtype)
+
+
+@register_op("while_grad", raw=True)
+def _while_grad(ctx, block, op, state):
+    sub_block = op.attrs["sub_block"]
+    carried = op.attrs["carried_vars"]
+    max_trips = op.attrs["max_trip_count"]
+    cond_name = op.attrs["cond_var"]
+    snaps = op.input("InitSnapshot")
+    params = op.input("Params")
+    init_vals = tuple(state.read(block, n) for n in snaps)
+    param_vals = tuple(state.read(block, n) for n in params)
+    consts = {n: v for n, v in state.values.items() if n not in carried}
+
+    diff_idx = [i for i, n in enumerate(carried)
+                if op.output("InitGrad")[i]]
+
+    def run(diff_init, pvals):
+        env_consts = dict(consts)
+        env_consts.update(zip(params, pvals))
+        full_init = list(init_vals)
+        for j, i in enumerate(diff_idx):
+            full_init[i] = diff_init[j]
+        final = _while_scan(ctx, sub_block, carried, cond_name,
+                            env_consts, tuple(full_init), max_trips)
+        return tuple(final[i] for i in diff_idx)
+
+    diff_init = tuple(init_vals[i] for i in diff_idx)
+    primals_out, vjp = jax.vjp(run, diff_init, param_vals)
+
+    cots = tuple(_cot(state, op.input("OutGrad")[i], primals_out[j])
+                 for j, i in enumerate(diff_idx))
+    g_init, g_params = vjp(cots)
+    for j, i in enumerate(diff_idx):
+        out_name = op.output("InitGrad")[i]
+        if out_name:
+            state.write(out_name, g_init[j])
+    for n, v in zip(op.output("ParamsGrad"), g_params):
         state.write(n, v)
 
 
@@ -420,14 +535,10 @@ def _static_scan_grad(ctx, block, op, state):
 
     (final, stacked), vjp = jax.vjp(run, seq_vals, init_vals, param_vals)
 
-    def cot(gname, primal):
-        g = state.values.get(gname)
-        if g is None:
-            return jnp.zeros(primal.shape, primal.dtype)
-        return g.astype(primal.dtype)
-
-    og_final = tuple(cot(n, v) for n, v in zip(op.input("FinalGrad"), final))
-    og_out = tuple(cot(n, v) for n, v in zip(op.input("OutGrad"), stacked))
+    og_final = tuple(_cot(state, n, v)
+                     for n, v in zip(op.input("FinalGrad"), final))
+    og_out = tuple(_cot(state, n, v)
+                   for n, v in zip(op.input("OutGrad"), stacked))
     gx, ginit, gparams = vjp((og_final, og_out))
     for n, v in zip(op.output("XGrad"), gx):
         state.write(n, v)

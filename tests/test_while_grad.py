@@ -1,0 +1,215 @@
+"""Differentiable While (max_trip_count path): analytic grads through the
+bounded-scan lowering vs numeric central differences and a hand-derived
+closed form — parity with ref WhileGradOp coverage
+(``operators/controlflow/while_op.cc:312``,
+``tests/unittests/test_while_op.py``)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import (Program, Scope, append_backward,
+                                  program_guard, scope_guard)
+
+
+def _build_geometric_loop(max_trips):
+    """acc = x; repeat 3 times: acc = acc * w  →  loss = mean(acc).
+    d loss/d x = w^3 / n,  d loss/d w = 3 w^2 mean(x)."""
+    x = layers.data("x", shape=[4], dtype="float32")
+    x.stop_gradient = False
+    w = layers.create_parameter([1], "float32", name="w_scale")
+    i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    limit = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+    acc = layers.elementwise_mul(x, layers.ones_like(x))  # copy of x
+    cond = layers.less_than(i, limit)
+    wh = layers.While(cond, max_trip_count=max_trips)
+    with wh.block():
+        layers.assign(layers.elementwise_mul(acc, w), acc)
+        layers.increment(i, 1.0)
+        layers.less_than(i, limit, cond=cond)
+    loss = layers.mean(acc)
+    return x, w, loss
+
+
+def test_while_grad_matches_closed_form():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x, w, loss = _build_geometric_loop(max_trips=5)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        scope.set_var("w_scale", np.array([1.5], np.float32))
+        xv = np.array([[0.5, -1.0, 2.0, 3.0]], np.float32)
+        lv, gx, gw = exe.run(
+            fluid.default_main_program(), feed={"x": xv},
+            fetch_list=[loss.name, "x@GRAD", "w_scale@GRAD"], scope=scope)
+        wv = 1.5
+        np.testing.assert_allclose(lv, (xv * wv ** 3).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            gx, np.full_like(xv, wv ** 3 / xv.size), rtol=1e-5)
+        np.testing.assert_allclose(
+            gw, [3 * wv ** 2 * xv.mean()], rtol=1e-5)
+
+
+def test_while_grad_numeric_parity():
+    """Central-difference check on the loop's parameter gradient."""
+    def run(w_val, want_grads):
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x, w, loss = _build_geometric_loop(max_trips=4)
+            if want_grads:
+                append_backward(loss)
+            exe = fluid.Executor()
+            exe.run(fluid.default_startup_program(), scope=scope)
+            scope.set_var("w_scale", np.array([w_val], np.float32))
+            xv = np.array([[1.0, 2.0, -0.5, 0.25]], np.float32)
+            fetch = [loss.name] + (["w_scale@GRAD"] if want_grads else [])
+            out = exe.run(fluid.default_main_program(), feed={"x": xv},
+                          fetch_list=fetch, scope=scope)
+            return [np.asarray(o) for o in out]
+
+    eps = 1e-2
+    (l_plus,) = run(1.2 + eps, False)
+    (l_minus,) = run(1.2 - eps, False)
+    numeric = (float(l_plus) - float(l_minus)) / (2 * eps)
+    _, gw = run(1.2, True)
+    np.testing.assert_allclose(float(gw[0]), numeric, rtol=1e-3)
+
+
+def test_while_unbounded_stays_forward_only():
+    """No max_trip_count → lax.while_loop path, no grad ops emitted."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        limit = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+        acc = layers.elementwise_mul(x, layers.ones_like(x))
+        cond = layers.less_than(i, limit)
+        wh = layers.While(cond)
+        with wh.block():
+            layers.assign(acc * 2.0, acc)
+            layers.increment(i, 1.0)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.mean(acc)
+        append_backward(loss)
+        prog = fluid.default_main_program()
+        assert not any(op.type == "while_grad"
+                       for op in prog.global_block().ops)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        lv, = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                      fetch_list=[loss.name], scope=scope)
+        np.testing.assert_allclose(lv, 8.0, rtol=1e-5)
+
+
+def test_while_grad_multi_consumer():
+    """The loop output feeding TWO consumers: parallel contributions must
+    sum BEFORE while_grad replays the loop (regression: the grads used to
+    silently skip the loop transpose)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        w = layers.create_parameter([1], "float32", name="w_scale")
+        i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        limit = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+        acc = layers.elementwise_mul(x, layers.ones_like(x))
+        cond = layers.less_than(i, limit)
+        wh = layers.While(cond, max_trip_count=5)
+        with wh.block():
+            layers.assign(layers.elementwise_mul(acc, w), acc)
+            layers.increment(i, 1.0)
+            layers.less_than(i, limit, cond=cond)
+        loss = layers.mean(acc) + layers.mean(acc * 2.0)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        scope.set_var("w_scale", np.array([1.5], np.float32))
+        xv = np.array([[0.5, -1.0, 2.0, 3.0]], np.float32)
+        gx, gw = exe.run(fluid.default_main_program(), feed={"x": xv},
+                         fetch_list=["x@GRAD", "w_scale@GRAD"],
+                         scope=scope)
+        wv = 1.5
+        np.testing.assert_allclose(
+            gx, np.full_like(xv, 3 * wv ** 3 / xv.size), rtol=1e-5)
+        np.testing.assert_allclose(
+            gw, [3 * 3 * wv ** 2 * xv.mean()], rtol=1e-5)
+
+
+def test_two_sequential_while_loops_grad():
+    """Two bounded loops carrying the SAME var: each loop's grad must
+    replay from ITS OWN snapshot (regression: shared snapshot names made
+    loop 1 replay from loop 2's input).  acc = x → x^2 → x^4;
+    d mean(x^4)/dx = 4 x^3 / n."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[2], dtype="float32")
+        x.stop_gradient = False
+        acc = layers.elementwise_mul(x, layers.ones_like(x))
+        for _ in range(2):
+            i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+            lim = layers.fill_constant(shape=[1], dtype="float32",
+                                       value=1.0)
+            cond = layers.less_than(i, lim)
+            wh = layers.While(cond, max_trip_count=2)
+            with wh.block():
+                layers.assign(layers.elementwise_mul(acc, acc), acc)
+                layers.increment(i, 1.0)
+                layers.less_than(i, lim, cond=cond)
+        loss = layers.mean(acc)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xv = np.array([[2.0, 3.0]], np.float32)
+        lv, gx = exe.run(fluid.default_main_program(), feed={"x": xv},
+                         fetch_list=[loss.name, "x@GRAD"], scope=scope)
+        np.testing.assert_allclose(lv, (xv ** 4).mean(), rtol=1e-5)
+        np.testing.assert_allclose(gx, 4 * xv ** 3 / xv.size, rtol=1e-5)
+
+
+def test_while_grad_domain_guard_no_nan():
+    """The condition guards a domain (sqrt(limit - i)); dead iterations
+    must not re-execute the body on the frozen boundary state (lax.cond
+    path) — grads stay finite."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[2], dtype="float32")
+        x.stop_gradient = False
+        acc = layers.elementwise_mul(x, layers.ones_like(x))
+        i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        lim = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+        cond = layers.less_than(i, lim)
+        wh = layers.While(cond, max_trip_count=6)
+        with wh.block():
+            gap = layers.sqrt(lim - i)        # sqrt(<0) past the boundary
+            layers.assign(acc * gap, acc)
+            layers.increment(i, 1.0)
+            layers.less_than(i, lim, cond=cond)
+        loss = layers.mean(acc)
+        append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xv = np.array([[1.0, 2.0]], np.float32)
+        lv, gx = exe.run(fluid.default_main_program(), feed={"x": xv},
+                         fetch_list=[loss.name, "x@GRAD"], scope=scope)
+        expect = np.sqrt(3.0) * np.sqrt(2.0) * np.sqrt(1.0)
+        np.testing.assert_allclose(lv, (xv * expect).mean(), rtol=1e-5)
+        assert np.isfinite(gx).all()
+        np.testing.assert_allclose(gx, np.full_like(xv, expect / xv.size),
+                                   rtol=1e-5)
+
+
+def test_while_bounded_early_exit_masking():
+    """max_trip_count larger than actual trips: extra iterations must not
+    change the result (active-mask passes the carry through)."""
+    for trips in (3, 8, 16):
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x, w, loss = _build_geometric_loop(max_trips=trips)
+            exe = fluid.Executor()
+            exe.run(fluid.default_startup_program(), scope=scope)
+            scope.set_var("w_scale", np.array([2.0], np.float32))
+            xv = np.array([[1.0, 1.0, 1.0, 1.0]], np.float32)
+            lv, = exe.run(fluid.default_main_program(), feed={"x": xv},
+                          fetch_list=[loss.name], scope=scope)
+            np.testing.assert_allclose(lv, 8.0, rtol=1e-5), trips
