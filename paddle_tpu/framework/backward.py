@@ -112,29 +112,43 @@ def _append_backward_tagged(block, program, loss, no_grad, relevant, needed,
         v = ver.get(n, 0)
         return n if v == 0 else f"{n}@V{v}"
 
-    contribs: Dict[str, object] = {}
-    MATERIALIZED = object()   # truthy sentinel: sum already scheduled/read
+    # contribs[n]: pending summands of the CURRENT version of grad n —
+    # ("site", di, slot, j) for a desc output not yet renamed, or
+    # ("value", name) once a reader has materialized the sum.  Within one
+    # version every contribution precedes the first reader (descs are
+    # generated in reverse op order), so a contribution arriving AFTER a
+    # read can only mean the forward program redefined the var in place
+    # without a gradient-redefining op — numerically ambiguous, raised
+    # loudly below rather than silently mis-summed.
+    contribs: Dict[str, List[tuple]] = {}
     sums_before: Dict[int, List[Tuple[str, List[str]]]] = {}
     end_sums: List[Tuple[str, List[str]]] = []
     end_assigns: List[Tuple[str, str]] = []
 
     def _materialize(n, at_di):
-        """Rename this version's pending summands and schedule their sum."""
-        sites = contribs.get(n)
-        if sites is MATERIALIZED or not sites or len(sites) == 1:
-            if sites and sites is not MATERIALIZED:
-                contribs[n] = MATERIALIZED
+        """Collapse this version's pending summands into one value."""
+        entries = contribs.get(n)
+        if not entries:
             return
-        parts = []
-        for k, (pi, slot, j) in enumerate(sites):
-            pn = f"{rd(n)}@RENAME@{k}"
-            descs[pi]["outputs"][slot][j] = pn
-            parts.append(pn)
+        if len(entries) == 1:
+            if entries[0][0] == "site":
+                contribs[n] = [("value", rd(n))]
+            return
+        parts, k = [], 0
+        for e in entries:
+            if e[0] == "value":
+                parts.append(e[1])
+            else:
+                _, pi, slot, j = e
+                pn = f"{rd(n)}@RENAME@{k}"
+                k += 1
+                descs[pi]["outputs"][slot][j] = pn
+                parts.append(pn)
         if at_di is None:
             end_sums.append((rd(n), parts))
         else:
             sums_before.setdefault(at_di, []).append((rd(n), parts))
-        contribs[n] = MATERIALIZED
+        contribs[n] = [("value", rd(n))]
 
     for di, d in enumerate(descs):
         raw_ins = {n for names in d["inputs"].values() for n in names if n}
@@ -150,17 +164,19 @@ def _append_backward_tagged(block, program, loss, no_grad, relevant, needed,
                     # redefinition: new version, sole producer so far
                     ver[n] = ver.get(n, 0) + 1
                     d["outputs"][slot][j] = rd(n)
-                    contribs[n] = [(di, slot, j)]
+                    contribs[n] = [("site", di, slot, j)]
                 else:
+                    entries = contribs.setdefault(n, [])
+                    if entries and entries[0][0] == "value":
+                        raise ValueError(
+                            f"gradient contribution to {n!r} arrives after "
+                            "a grad op already read it: the forward "
+                            "program overwrites this variable in place "
+                            "(e.g. assign with an existing output) between "
+                            "reads, which makes its gradient ambiguous — "
+                            "write the second value to a fresh variable")
                     d["outputs"][slot][j] = rd(n)
-                    prev = contribs.setdefault(n, [])
-                    if prev is MATERIALIZED:
-                        # contribution arriving after a consumer already
-                        # read the sum would silently be dropped — reverse
-                        # generation order makes this impossible
-                        raise AssertionError(
-                            f"late grad contribution to {n!r}")
-                    prev.append((di, slot, j))
+                    entries.append(("site", di, slot, j))
 
     for n in list(contribs):
         _materialize(n, None)          # unconsumed summands (param grads)

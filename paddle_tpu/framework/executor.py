@@ -426,7 +426,7 @@ class Executor:
                 if hasattr(v, "block_until_ready"):
                     v.block_until_ready()
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            return [_fetch_to_numpy(f) for f in fetches]
         return list(fetches)
 
     def infer_from_program(self, *a, **k):
@@ -490,6 +490,18 @@ class Executor:
 
     def infer_from_dataset(self, *a, **k):
         return self.train_from_dataset(*a, **k)
+
+
+def _fetch_to_numpy(f):
+    """Fetch → numpy, including multi-process arrays: a fetch stacked over
+    a cross-host dp axis spans non-addressable devices, so every process
+    allgathers it (ref: each NCCL2 trainer fetches its own loss; here all
+    ranks see the global stack, which is strictly more informative)."""
+    if isinstance(f, jax.Array) and not f.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            f, tiled=True))
+    return np.asarray(f)
 
 
 def _feed_sig(x):

@@ -57,8 +57,10 @@ def _while_scan(ctx, sub_block, carried, cond_name, consts, init,
 def _while_grad_maker(op, block, no_grad_set):
     """Grad op for the bounded (max_trip_count) while: consumes the final
     carried grads, replays the scan under jax.vjp from the snapshotted
-    initial values, and emits grads for the initial carried values and
-    the read-only captures."""
+    initial values, and emits grads for the initial carried values.
+    Read-only captures (params the body multiplies by, etc.) are carried
+    too — the While layer carries every var the body touches — so their
+    grads flow through InitGrad as well."""
     from ..framework.core import grad_var_name
     if "max_trip_count" not in op.attrs:
         return []               # unbounded while stays forward-only
@@ -70,11 +72,8 @@ def _while_grad_maker(op, block, no_grad_set):
         v = block.var(n)
         return v.dtype is not None and str(v.dtype).startswith("float")
 
-    params = [n for n in op.input("X")
-              if n not in carried and _is_float(n) and n not in no_grad_set]
     g_inputs = {
         "InitSnapshot": list(op.input("InitSnapshot")),
-        "Params": params,
         "OutGrad": [grad_var_name(n) if _is_float(n) else ""
                     for n in carried],
     }
@@ -82,7 +81,6 @@ def _while_grad_maker(op, block, no_grad_set):
         "InitGrad": [grad_var_name(n)
                      if _is_float(n) and n not in no_grad_set else ""
                      for n in carried],
-        "ParamsGrad": [grad_var_name(n) for n in params],
     }
     return [{"type": "while_grad", "inputs": g_inputs,
              "outputs": g_outputs, "attrs": dict(op.attrs)}]
@@ -135,36 +133,30 @@ def _while_grad(ctx, block, op, state):
     max_trips = op.attrs["max_trip_count"]
     cond_name = op.attrs["cond_var"]
     snaps = op.input("InitSnapshot")
-    params = op.input("Params")
     init_vals = tuple(state.read(block, n) for n in snaps)
-    param_vals = tuple(state.read(block, n) for n in params)
     consts = {n: v for n, v in state.values.items() if n not in carried}
 
     diff_idx = [i for i, n in enumerate(carried)
                 if op.output("InitGrad")[i]]
 
-    def run(diff_init, pvals):
-        env_consts = dict(consts)
-        env_consts.update(zip(params, pvals))
+    def run(diff_init):
         full_init = list(init_vals)
         for j, i in enumerate(diff_idx):
             full_init[i] = diff_init[j]
         final = _while_scan(ctx, sub_block, carried, cond_name,
-                            env_consts, tuple(full_init), max_trips)
+                            consts, tuple(full_init), max_trips)
         return tuple(final[i] for i in diff_idx)
 
     diff_init = tuple(init_vals[i] for i in diff_idx)
-    primals_out, vjp = jax.vjp(run, diff_init, param_vals)
+    primals_out, vjp = jax.vjp(run, diff_init)
 
     cots = tuple(_cot(state, op.input("OutGrad")[i], primals_out[j])
                  for j, i in enumerate(diff_idx))
-    g_init, g_params = vjp(cots)
+    (g_init,) = vjp(cots)
     for j, i in enumerate(diff_idx):
         out_name = op.output("InitGrad")[i]
         if out_name:
             state.write(out_name, g_init[j])
-    for n, v in zip(op.output("ParamsGrad"), g_params):
-        state.write(n, v)
 
 
 @register_op("conditional_block", no_grad=True, raw=True)

@@ -188,7 +188,27 @@ class PipelineEngine:
         if devices is None:
             devices = [all_devs[s % len(all_devs)]
                        for s in range(len(sections))]
+        # a stage placement is one device OR a list of devices — a list
+        # becomes a per-stage dp submesh (pp × dp composition: the ref
+        # PipelineTrainer pins one worker per stage; here a stage can
+        # itself be data-parallel over its slice of the pod)
         self.devices = devices
+        self._stage_shardings = []
+        for dv in devices:
+            if isinstance(dv, (list, tuple)):
+                if not dv:
+                    raise ValueError(
+                        "a pipeline stage got an EMPTY device list — "
+                        f"{len(all_devs)} device(s) visible; check the "
+                        "per-stage device partition")
+                from jax.sharding import (Mesh, NamedSharding,
+                                          PartitionSpec as P)
+                mesh = Mesh(np.array(dv), ("dp",))
+                self._stage_shardings.append(
+                    (NamedSharding(mesh, P("dp")),      # batch-sharded
+                     NamedSharding(mesh, P())))         # replicated
+            else:
+                self._stage_shardings.append(None)
 
         scope = scope or global_scope()
         self._vbs: List[Dict[str, VarBase]] = []
@@ -201,7 +221,7 @@ class PipelineEngine:
                     raise RuntimeError(
                         f"parameter {name!r} not initialized — run the "
                         f"startup program first")
-                vb = VarBase(jax.device_put(val, devices[s]), name=name,
+                vb = VarBase(self._put(val, s, replicate=True), name=name,
                              persistable=True, trainable=True)
                 vbs[name] = vb
             self._vbs.append(vbs)
@@ -223,6 +243,17 @@ class PipelineEngine:
             self._fwd.append(jax.jit(fwd))
             self._bwd.append(jax.jit(bwd))
         self._scope = scope
+
+    def _put(self, val, s, replicate=False):
+        """Place a value on stage s: its device, or — for a dp-submesh
+        stage — sharded on the batch dim (params/scalars replicated)."""
+        sh = self._stage_shardings[s]
+        if sh is None:
+            return jax.device_put(val, self.devices[s])
+        batch_sh, repl_sh = sh
+        if replicate or np.ndim(val) == 0:
+            return jax.device_put(val, repl_sh)
+        return jax.device_put(val, batch_sh)
 
     def _params(self, s):
         return {n: vb.value for n, vb in self._vbs[s].items()}
@@ -250,10 +281,9 @@ class PipelineEngine:
         acts_by_name = [dict() for _ in range(M)]
         for m in range(M):
             for s, sec in enumerate(self.sections):
-                acts = [jax.device_put(acts_by_name[m][n], self.devices[s])
+                acts = [self._put(acts_by_name[m][n], s)
                         for n in sec.in_names]
-                feeds = [jax.device_put(jnp.asarray(micro[m][n]),
-                                        self.devices[s])
+                feeds = [self._put(jnp.asarray(micro[m][n]), s)
                          for n in sec.feed_names]
                 stash_in[s][m], stash_feed[s][m] = acts, feeds
                 outs = self._fwd[s](self._params(s), acts, feeds)
@@ -274,7 +304,7 @@ class PipelineEngine:
                         g = jnp.full(np.shape(losses[m]), 1.0 / M,
                                      jnp.float32)
                     elif n in gacts_by_name:
-                        g = jax.device_put(gacts_by_name[n], self.devices[s])
+                        g = self._put(gacts_by_name[n], s)
                     else:
                         g = jnp.zeros_like(acts_by_name[m][n])
                     gouts.append(g)
@@ -285,8 +315,8 @@ class PipelineEngine:
                     # connections): cotangents sum across consumers
                     if n in gacts_by_name:
                         prev = gacts_by_name[n]
-                        dev = list(prev.devices())[0]
-                        gacts_by_name[n] = prev + jax.device_put(v, dev)
+                        gacts_by_name[n] = prev + jax.device_put(
+                            v, prev.sharding)
                     else:
                         gacts_by_name[n] = v
                 if gacc[s] is None:
@@ -305,7 +335,8 @@ class PipelineEngine:
                     None, parameter_list=list(vbs.values()))
                 for vb in vbs.values():
                     vb.grad = None
-        return float(np.mean([np.asarray(l) for l in losses]))
+        from ..framework.executor import _fetch_to_numpy
+        return float(np.mean([_fetch_to_numpy(l) for l in losses]))
 
     def sync_to_scope(self):
         """Write stage params back to the scope (for save_persistables)."""

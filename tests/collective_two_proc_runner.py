@@ -1,0 +1,70 @@
+"""Two-process collective trainer (ref test_dist_base.py:442 pattern).
+
+Launched by ``paddle_tpu.distributed.launch --nproc_per_node 2`` (the env
+contract provides rank/endpoints).  Each process joins the cluster via
+``init_parallel_env`` (jax.distributed over the CPU backend — one device
+per process, two global devices), transpiles GradAllReduce, trains a
+deterministic model on the SAME global batch, and prints its per-step
+losses as one JSON line tagged LOSSES.  The pytest driver compares them
+against a single-process run of the identical program.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_and_train(steps=4):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.distributed.transpiler import GradAllReduce
+    from paddle_tpu.distributed.env import Env, init_parallel_env
+    from paddle_tpu.framework import (Program, Scope, program_guard,
+                                      scope_guard)
+
+    env = Env()
+    world = env.world_size
+    if world > 1:
+        init_parallel_env()
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="tanh")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt.SGDOptimizer(0.1).minimize(loss)
+        if world > 1:
+            GradAllReduce().transpile(
+                rank=env.rank, endpoints=env.trainer_endpoints,
+                current_endpoint=env.current_endpoint)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=42)
+
+        rng = np.random.RandomState(7)           # same batch everywhere
+        xv = rng.rand(8, 8).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(feed={"x": xv, "y": yv},
+                          fetch_list=[loss.name], scope=scope)
+            arr = np.asarray(lv)
+            # collective mode returns per-rank stacked losses; equal-size
+            # shards make their mean the global-batch mean
+            losses.append(float(arr.mean()))
+        return losses
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    losses = build_and_train()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

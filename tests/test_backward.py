@@ -104,3 +104,20 @@ def test_stop_gradient_blocks_grad():
     out, = exe.run(feed={"x": np.ones((1, 3), np.float32)}, fetch_list=[xg])
     # only the identity path contributes: d(mean(x))/dx = 1/3
     np.testing.assert_allclose(out, np.full((1, 3), 1.0 / 3.0), rtol=1e-5)
+
+
+def test_fanout_with_consuming_grad_op():
+    """Multi-reader fan-out where one consumer's grad op also reads the
+    shared grad name: contributions are summed before that reader."""
+    x = layers.data("x", shape=[3], dtype="float32")
+    x.stop_gradient = False
+    b = layers.scale(x, scale=2.0)              # b = 2x
+    c = layers.scale(b, scale=3.0)              # consumer of b
+    loss = layers.mean(b) + layers.mean(c) + layers.mean(b * b)
+    append_backward(loss)
+    exe = Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([[1.0, 2.0, 3.0]], np.float32)
+    out, = exe.run(feed={"x": xv}, fetch_list=[grad_var_name("x")])
+    # d/dx [ mean(2x) + mean(6x) + mean(4x^2) ] = (2 + 6 + 8x)/3
+    np.testing.assert_allclose(out, (8.0 + 8.0 * xv) / 3.0, rtol=1e-5)

@@ -212,4 +212,5 @@ def test_while_bounded_early_exit_masking():
             xv = np.array([[1.0, 1.0, 1.0, 1.0]], np.float32)
             lv, = exe.run(fluid.default_main_program(), feed={"x": xv},
                           fetch_list=[loss.name], scope=scope)
-            np.testing.assert_allclose(lv, 8.0, rtol=1e-5), trips
+            np.testing.assert_allclose(lv, 8.0, rtol=1e-5,
+                                       err_msg=f"trips={trips}")
