@@ -1,0 +1,255 @@
+// Native inference demo: load a `save_inference_model` artifact and run it
+// with NO Python at runtime — the deployment-side counterpart of
+// demo_trainer.cc (ref paddle/fluid/inference/api/demo_ci/simple_on_word2vec.cc:
+// load the saved __model__ + params, feed a tensor, run, print outputs).
+//
+// Artifact layout (paddle_tpu/io.py save_inference_model):
+//   <dir>/__model__        JSON program + feed_names/fetch_names
+//   <dir>/__meta__.json    {"filename": null, "vars": {name: {shape,dtype}}}
+//   <dir>/<name>.npy       one .npy (v1.0) per persistable var
+//
+// Build: make demo_predictor   (native/Makefile)
+// Run:   ./demo_predictor <model_dir> <input.npy> [output.npy]
+//
+// Supported op set: the fluid MLP/softmax inference family (mul,
+// elementwise_add/sub/mul, relu, tanh, sigmoid, softmax, scale, feed,
+// fetch) — extend RunOp for wider models.
+
+#include "program_json.h"
+
+// ------------------------------------------------------------- npy io ----
+// Minimal NumPy .npy v1.0 reader/writer for C-order '<f4' ('<f8', '<i8',
+// '<i4' are converted to float on load).
+static Tensor LoadNpy(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  char magic[6];
+  f.read(magic, 6);
+  if (memcmp(magic, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error(path + ": not an npy file");
+  unsigned char ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t hlen = 0;
+  if (ver[0] == 1) {
+    uint16_t h16;
+    f.read(reinterpret_cast<char*>(&h16), 2);
+    hlen = h16;
+  } else {
+    f.read(reinterpret_cast<char*>(&hlen), 4);
+  }
+  std::string header(hlen, '\0');
+  f.read(&header[0], hlen);
+
+  auto find_val = [&](const std::string& key) -> std::string {
+    size_t k = header.find("'" + key + "'");
+    if (k == std::string::npos)
+      throw std::runtime_error(path + ": npy header missing " + key);
+    size_t c = header.find(':', k);
+    return header.substr(c + 1);
+  };
+  std::string descr = find_val("descr");
+  size_t q1 = descr.find('\'');
+  size_t q2 = descr.find('\'', q1 + 1);
+  descr = descr.substr(q1 + 1, q2 - q1 - 1);
+  if (find_val("fortran_order").find("True") != std::string::npos)
+    throw std::runtime_error(path + ": fortran order unsupported");
+  std::string shp = find_val("shape");
+  size_t l = shp.find('('), r = shp.find(')');
+  Tensor t;
+  std::stringstream ss(shp.substr(l + 1, r - l - 1));
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    if (tok.find_first_not_of(" \t") == std::string::npos) continue;
+    t.shape.push_back(strtoll(tok.c_str(), nullptr, 10));
+  }
+  int64_t n = t.numel();
+  t.data.resize(static_cast<size_t>(n));
+  if (descr == "<f4") {
+    f.read(reinterpret_cast<char*>(t.data.data()), n * 4);
+  } else if (descr == "<f8") {
+    std::vector<double> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
+  } else if (descr == "<i8") {
+    std::vector<int64_t> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
+  } else if (descr == "<i4") {
+    std::vector<int32_t> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()), n * 4);
+    for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
+  } else {
+    throw std::runtime_error(path + ": unsupported dtype " + descr);
+  }
+  if (!f) throw std::runtime_error(path + ": truncated data");
+  return t;
+}
+
+static void SaveNpy(const std::string& path, const Tensor& t) {
+  std::string shp = "(";
+  for (size_t i = 0; i < t.shape.size(); ++i)
+    shp += std::to_string(t.shape[i]) + ",";
+  shp += ")";
+  std::string header = "{'descr': '<f4', 'fortran_order': False, 'shape': " +
+                       shp + ", }";
+  size_t total = 10 + header.size();
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header.back() = '\n';
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  std::ofstream f(path, std::ios::binary);
+  f.write("\x93NUMPY\x01\x00", 8);
+  f.write(reinterpret_cast<const char*>(&hlen), 2);
+  f.write(header.data(), header.size());
+  f.write(reinterpret_cast<const char*>(t.data.data()), t.numel() * 4);
+}
+
+// ---------------------------------------------------------- operators ----
+static void RunOp(const Json& op, Scope* scope) {
+  const std::string& type = op.at("type").str;
+
+  if (type == "feed" || type == "fetch") {
+    return;  // feeds pre-placed in the scope; fetches read afterwards
+  }
+  if (type == "mul" || type == "matmul") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    // flatten x to [batch, K] (fluid mul semantics, num_flatten_dims=1)
+    int64_t k = y.shape[0];
+    int64_t m = x.numel() / k;
+    int64_t n2 = y.shape[1];
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({m, n2});
+    for (int64_t i = 0; i < m; ++i)
+      for (int64_t j = 0; j < n2; ++j) {
+        double acc = 0;
+        for (int64_t p = 0; p < k; ++p)
+          acc += static_cast<double>(x.data[i * k + p]) * y.data[p * n2 + j];
+        out.data[i * n2 + j] = static_cast<float>(acc);
+      }
+  } else if (type == "elementwise_add" || type == "elementwise_sub" ||
+             type == "elementwise_mul") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    int64_t n = x.numel(), yn = y.numel();
+    for (int64_t i = 0; i < n; ++i) {
+      float b = y.data[yn == n ? i : i % yn];  // bias row broadcast
+      float a = x.data[i];
+      out.data[i] = type == "elementwise_add" ? a + b
+                    : type == "elementwise_sub" ? a - b : a * b;
+    }
+  } else if (type == "relu") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = x.data[i] > 0 ? x.data[i] : 0.f;
+  } else if (type == "tanh") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = std::tanh(x.data[i]);
+  } else if (type == "sigmoid") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = 1.f / (1.f + std::exp(-x.data[i]));
+  } else if (type == "softmax") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    int64_t cols = x.shape.back();
+    int64_t rows = x.numel() / cols;
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xi = &x.data[r * cols];
+      float* oi = &out.data[r * cols];
+      float mx = xi[0];
+      for (int64_t c = 1; c < cols; ++c) mx = std::max(mx, xi[c]);
+      double s = 0;
+      for (int64_t c = 0; c < cols; ++c) s += std::exp(xi[c] - mx);
+      for (int64_t c = 0; c < cols; ++c)
+        oi[c] = static_cast<float>(std::exp(xi[c] - mx) / s);
+    }
+  } else if (type == "scale") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    float sc = 1.f, bias = 0.f;
+    const Json& attrs = op.at("attrs");
+    if (attrs.has("scale")) sc = static_cast<float>(attrs.at("scale").num);
+    if (attrs.has("bias")) bias = static_cast<float>(attrs.at("bias").num);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = x.data[i] * sc + bias;
+  } else {
+    throw std::runtime_error("demo_predictor: unsupported op '" + type +
+                             "' — extend RunOp for this model");
+  }
+}
+
+// ---------------------------------------------------------------- main ----
+static std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s <model_dir> <input.npy> [output.npy]\n", argv[0]);
+    return 2;
+  }
+  const std::string dir = argv[1];
+  try {
+    Json model = JsonParser(ReadFile(dir + "/__model__")).Parse();
+    Json meta = JsonParser(ReadFile(dir + "/__meta__.json")).Parse();
+
+    Scope scope;
+    for (const auto& kv : meta.at("vars").obj) {
+      std::string fname = kv.first;
+      for (size_t p = fname.find('/'); p != std::string::npos;
+           p = fname.find('/'))
+        fname.replace(p, 1, "__");
+      scope[kv.first] = LoadNpy(dir + "/" + fname + ".npy");
+    }
+
+    const auto& feeds = model.at("feed_names").arr;
+    const auto& fetches = model.at("fetch_names").arr;
+    if (feeds.size() != 1)
+      throw std::runtime_error("demo expects exactly one feed, got " +
+                               std::to_string(feeds.size()));
+    scope[feeds[0].str] = LoadNpy(argv[2]);
+
+    const Json& block = model.at("blocks").arr[0];
+    for (const auto& op : block.at("ops").arr) RunOp(op, &scope);
+
+    for (const auto& name : fetches) {
+      const Tensor& t = scope.at(name.str);
+      printf("fetch %s shape [", name.str.c_str());
+      for (size_t i = 0; i < t.shape.size(); ++i)
+        printf("%s%lld", i ? ", " : "",
+               static_cast<long long>(t.shape[i]));
+      printf("]\n");
+      int64_t cols = t.shape.empty() ? 1 : t.shape.back();
+      for (int64_t r = 0; r < t.numel() / cols; ++r) {
+        int64_t arg = 0;
+        for (int64_t c = 1; c < cols; ++c)
+          if (t.data[r * cols + c] > t.data[r * cols + arg]) arg = c;
+        printf("row %lld argmax %lld prob %.6f\n",
+               static_cast<long long>(r), static_cast<long long>(arg),
+               t.data[r * cols + arg]);
+      }
+    }
+    if (argc > 3) SaveNpy(argv[3], scope.at(fetches[0].str));
+  } catch (const std::exception& e) {
+    fprintf(stderr, "demo_predictor error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,192 @@
+// Shared mini-runtime for the native demos: JSON reader for the Program IR
+// serialization (paddle_tpu/framework/core.py serialize_to_string), a flat
+// name->tensor scope, and op arg helpers.  Used by demo_trainer.cc (train
+// side, ref paddle/fluid/train/demo) and demo_predictor.cc (inference side,
+// ref paddle/fluid/inference/api/demo_ci).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal recursive-descent JSON reader (objects/arrays/strings/numbers/
+// bool/null) — just enough for the Program IR schema.
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json& at(const std::string& key) const {
+    auto it = obj.find(key);
+    if (it == obj.end()) throw std::runtime_error("missing key " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const { return obj.count(key) != 0; }
+  int64_t as_int() const { return static_cast<int64_t>(num); }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : s_(text) {}
+  Json Parse() {
+    Json v = Value();
+    Ws();
+    if (p_ != s_.size()) throw std::runtime_error("trailing json");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t p_ = 0;
+
+  void Ws() {
+    while (p_ < s_.size() && (s_[p_] == ' ' || s_[p_] == '\n' ||
+                              s_[p_] == '\t' || s_[p_] == '\r'))
+      ++p_;
+  }
+  char Peek() {
+    Ws();
+    if (p_ >= s_.size()) throw std::runtime_error("eof");
+    return s_[p_];
+  }
+  void Expect(char c) {
+    if (Peek() != c) throw std::runtime_error(std::string("expected ") + c);
+    ++p_;
+  }
+  Json Value() {
+    switch (Peek()) {
+      case '{': return Object();
+      case '[': return Array();
+      case '"': return String();
+      case 't': Lit("true"); return MakeBool(true);
+      case 'f': Lit("false"); return MakeBool(false);
+      case 'n': Lit("null"); return Json{};
+      default: return Number();
+    }
+  }
+  void Lit(const char* lit) {
+    Ws();
+    for (const char* c = lit; *c; ++c, ++p_)
+      if (p_ >= s_.size() || s_[p_] != *c)
+        throw std::runtime_error("bad literal");
+  }
+  static Json MakeBool(bool b) {
+    Json j;
+    j.kind = Json::kBool;
+    j.b = b;
+    return j;
+  }
+  Json Number() {
+    Ws();
+    size_t start = p_;
+    while (p_ < s_.size() &&
+           (isdigit(s_[p_]) || strchr("+-.eE", s_[p_]) != nullptr))
+      ++p_;
+    Json j;
+    j.kind = Json::kNum;
+    j.num = strtod(s_.substr(start, p_ - start).c_str(), nullptr);
+    return j;
+  }
+  Json String() {
+    Expect('"');
+    Json j;
+    j.kind = Json::kStr;
+    while (p_ < s_.size() && s_[p_] != '"') {
+      char c = s_[p_++];
+      if (c == '\\') {
+        if (p_ >= s_.size()) throw std::runtime_error("unterminated escape");
+        char e = s_[p_++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'u':  // \uXXXX: keep ASCII subset, skip others
+            if (p_ + 4 > s_.size())
+              throw std::runtime_error("truncated \\u escape");
+            c = static_cast<char>(
+                strtol(s_.substr(p_, 4).c_str(), nullptr, 16));
+            p_ += 4;
+            break;
+          default: c = e;
+        }
+      }
+      j.str.push_back(c);
+    }
+    if (p_ >= s_.size()) throw std::runtime_error("unterminated string");
+    ++p_;
+    return j;
+  }
+  Json Array() {
+    Expect('[');
+    Json j;
+    j.kind = Json::kArr;
+    if (Peek() == ']') { ++p_; return j; }
+    while (true) {
+      j.arr.push_back(Value());
+      if (Peek() == ',') { ++p_; continue; }
+      Expect(']');
+      return j;
+    }
+  }
+  Json Object() {
+    Expect('{');
+    Json j;
+    j.kind = Json::kObj;
+    if (Peek() == '}') { ++p_; return j; }
+    while (true) {
+      Json key = String();
+      Expect(':');
+      j.obj[key.str] = Value();
+      if (Peek() == ',') { ++p_; continue; }
+      Expect('}');
+      return j;
+    }
+  }
+};
+
+// -------------------------------------------------------------- tensors ----
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  void Resize(std::vector<int64_t> s) {
+    shape = std::move(s);
+    data.assign(static_cast<size_t>(numel()), 0.f);
+  }
+};
+
+// Scope: name -> tensor (ref framework/scope.h — flat is enough here).
+using Scope = std::map<std::string, Tensor>;
+
+static Tensor& Var(Scope* scope, const std::string& name) {
+  return (*scope)[name];
+}
+
+// ------------------------------------------------------------ operators ----
+static std::string In(const Json& op, const std::string& slot, int i = 0) {
+  if (!op.at("inputs").has(slot)) return "";
+  const auto& arr = op.at("inputs").at(slot).arr;
+  return i < static_cast<int>(arr.size()) ? arr[i].str : "";
+}
+static std::string Out(const Json& op, const std::string& slot, int i = 0) {
+  if (!op.at("outputs").has(slot)) return "";
+  const auto& arr = op.at("outputs").at(slot).arr;
+  return i < static_cast<int>(arr.size()) ? arr[i].str : "";
+}
+

@@ -465,11 +465,23 @@ void* ps_client_connect(const char* host, int port) {
     addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
     freeaddrinfo(res);
   }
+  // request deadline (ref FLAGS_rpc_deadline, grpc_client.h:36 — default
+  // 180s): a wedged server turns into a clean client error, not a hang
+  long deadline_ms = 180000;
+  if (const char* env = getenv("FLAGS_rpc_deadline")) {
+    long v = strtol(env, nullptr, 10);
+    if (v > 0) deadline_ms = v;
+  }
+  timeval tv{};
+  tv.tv_sec = deadline_ms / 1000;
+  tv.tv_usec = (deadline_ms % 1000) * 1000;
   for (int attempt = 0; attempt < 200; attempt++) {
     if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
                   sizeof(addr)) == 0) {
       int one = 1;
       setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
       return c;
     }
     // server may not be up yet (ref WaitServerReady in grpc_client)

@@ -34,6 +34,7 @@ from . import profiler  # noqa
 from . import data  # noqa
 from .data import DataFeeder, DataLoader, PyReader  # noqa
 from .data_feed_desc import DataFeedDesc  # noqa
+from .async_executor import AsyncExecutor  # noqa
 from .data.slot_dataset import DatasetFactory  # noqa
 from .io import (load_inference_model, load_params, load_persistables,  # noqa
                  load_vars, save_inference_model, save_params,

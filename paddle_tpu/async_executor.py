@@ -1,0 +1,48 @@
+"""Legacy AsyncExecutor shim (ref ``framework/async_executor.h:62``).
+
+The reference's Python ``AsyncExecutor`` was already folded into
+``Executor.train_from_dataset`` by Fluid 1.5 (only the C++ header
+survives in the snapshot); this shim keeps the old call shape alive and
+routes it to the modern path — same policy the reference took.
+"""
+
+from __future__ import annotations
+
+from .flags import warn_noop
+from .framework.executor import Executor
+
+
+class AsyncExecutor:
+    """ref AsyncExecutor(place): thread-pool dataset training.  On TPU the
+    step is one XLA computation, so the thread pool degenerates to the
+    sequential feed loop of ``train_from_dataset`` (the reference's own
+    successor API)."""
+
+    def __init__(self, place=None, run_mode=""):
+        warn_noop("AsyncExecutor",
+                  "superseded by Executor.train_from_dataset; routing there")
+        self._exe = Executor(place)
+        self.run_mode = run_mode
+
+    def run(self, program, data_feed, filelist, thread_num=1,
+            fetch=None, mode="", debug=False):
+        """Legacy signature: dataset described by ``data_feed`` (a
+        DataFeedDesc) + a filelist, ``thread_num`` parallel workers."""
+        from .data.slot_dataset import QueueDataset
+        from .framework import default_main_program
+        prog = program or default_main_program()
+        blk = prog.global_block()
+        dataset = QueueDataset()
+        slots = data_feed._slots() if hasattr(data_feed, "_slots") else []
+        dataset.set_batch_size(getattr(
+            getattr(data_feed, "proto_desc", None), "batch_size", 1))
+        names = [s["name"] for s in slots if s.get("is_used")] or \
+            [s["name"] for s in slots]
+        dataset.set_use_var([blk.var(n) for n in names if blk.has_var(n)])
+        dataset.set_thread(thread_num)
+        dataset.set_filelist(list(filelist))
+        fetch_list = [f.name if hasattr(f, "name") else f
+                      for f in (fetch or [])]
+        return self._exe.train_from_dataset(
+            program=program, dataset=dataset, fetch_list=fetch_list,
+            debug=debug)

@@ -63,22 +63,45 @@ class PSClient:
         if host in ("localhost", ""):
             host = "127.0.0.1"
         self._lib = lib
+        # plumb the registered flag to the native client (it reads the env
+        # at connect time) so paddle_tpu.set_flags governs the deadline
+        import os
+        from ..flags import get_flags
+        os.environ["FLAGS_rpc_deadline"] = str(int(
+            get_flags("FLAGS_rpc_deadline")["FLAGS_rpc_deadline"]))
         self._h = lib.ps_client_connect(host.encode(), int(port))
         if not self._h:
             raise ConnectionError(f"cannot connect to pserver {endpoint}")
 
-    def _buf(self, arr):
+    @staticmethod
+    def _check_dtype(dtype):
+        if dtype is not None and np.dtype(dtype).itemsize != 4:
+            raise ValueError(
+                f"PS tables carry 4-byte elements; dtype={np.dtype(dtype)} "
+                "cannot ride the wire format losslessly (use "
+                "int32/uint32/float32)")
+
+    def _buf(self, arr, dtype=None):
         import ctypes
-        a = np.ascontiguousarray(arr, np.float32)
+        self._check_dtype(dtype)
+        a = np.asarray(arr)
+        if dtype is not None:
+            # non-f32 4-byte tables (int32/uint32 counters, frequency
+            # tables): bit-cast through the f32 wire format losslessly
+            a = np.ascontiguousarray(a, dtype).view(np.float32)
+        else:
+            a = np.ascontiguousarray(a, np.float32)
         return a, a.ctypes.data_as(ctypes.c_void_p)
 
-    def put(self, name: str, value) -> None:
-        a, p = self._buf(value)
+    def put(self, name: str, value, dtype=None) -> None:
+        a, p = self._buf(value, dtype)
         rc = self._lib.ps_client_put(self._h, name.encode(), p, a.size)
         if rc != 0:
-            raise RuntimeError(f"ps put({name}) failed (server down?)")
+            raise RuntimeError(
+                f"ps put({name}) failed (server down or FLAGS_rpc_deadline "
+                "exceeded?)")
 
-    def get(self, name: str, size: int, barrier: bool = True):
+    def get(self, name: str, size: int, barrier: bool = True, dtype=None):
         import ctypes
         out = np.empty(size, np.float32)
         fn = self._lib.ps_client_get if barrier else \
@@ -90,7 +113,11 @@ class PSClient:
                 f"ps get({name}): expected {size} floats, got {n} "
                 "(unknown table)" if n == -2 else
                 f"ps get({name}): expected {size} floats, got {n} "
-                "(mis-sized table or connection lost?)")
+                "(mis-sized table, server down, or FLAGS_rpc_deadline "
+                "exceeded?)")
+        self._check_dtype(dtype)
+        if dtype is not None:
+            return out.view(dtype)
         return out
 
     def push_dense(self, name: str, grad) -> None:

@@ -329,6 +329,10 @@ class Block:
 
 _program_ids = itertools.count()
 
+# serialized-program format version (ref framework/version.h kCurProgramVersion
+# — a program saved by a newer format refuses to load on an older framework)
+PROGRAM_FORMAT_VERSION = 1
+
 
 class Program:
     """A list of Blocks; block 0 is global (ref framework.py:2899).
@@ -473,7 +477,10 @@ class Program:
 
     # -- serialization (stands in for protobuf ProgramDesc bytes) -----------
     def to_dict(self):
-        return {"version": 1, "random_seed": self.random_seed,
+        from .. import __version__
+        return {"version": PROGRAM_FORMAT_VERSION,
+                "framework_version": __version__,
+                "random_seed": self.random_seed,
                 "blocks": [b.to_dict() for b in self.blocks]}
 
     def serialize_to_string(self) -> bytes:
@@ -482,6 +489,15 @@ class Program:
     @staticmethod
     def parse_from_string(data: bytes) -> "Program":
         d = json.loads(data.decode("utf-8"))
+        # ref framework/version.h IsProgramVersionSupported: refuse blobs
+        # from a NEWER format (older formats load — fields default)
+        fmt = int(d.get("version", 0))
+        if fmt > PROGRAM_FORMAT_VERSION:
+            raise ValueError(
+                f"program blob has format version {fmt}, newer than this "
+                f"framework supports ({PROGRAM_FORMAT_VERSION}) — upgrade "
+                "paddle_tpu to load it (saved by framework "
+                f"{d.get('framework_version', '<unknown>')!r})")
         p = Program.__new__(Program)
         p.id = next(_program_ids)
         p._version = 0

@@ -288,19 +288,27 @@ class _CompiledBlock:
 def _collect_persistables(program: Program, block: Block, scope: Scope,
                           feed_names) -> Tuple[List[str], List[str], set]:
     """Classify persistable vars referenced by a block into read-only vs
-    read-write (written by some op); also return the set of rw vars that are
-    READ (their scope value matters — write-only vars get dummies)."""
-    read, written = set(), set()
-    def visit(b: Block):
+    read-write (written by some op); also return the set of vars whose
+    INCOMING value matters — read before any top-level write (startup
+    programs init a param then copy it: the copy must not force the param
+    to pre-exist in the scope).  Sub-block reads are ALWAYS incoming:
+    loop lowerings read every carried var's initial value, so no
+    write-before-read exemption applies inside sub-blocks."""
+    read, written, incoming = set(), set(), set()
+
+    def visit(b: Block, is_sub: bool):
         for op in b.ops:
             for n in op.input_arg_names():
                 read.add(n)
-            for n in op.output_arg_names():
-                written.add(n)
+                if is_sub or n not in written:
+                    incoming.add(n)
             for v in op.attrs.values():
                 if isinstance(v, Block):
-                    visit(v)
-    visit(block)
+                    visit(v, True)
+            for n in op.output_arg_names():
+                written.add(n)
+
+    visit(block, False)
     ro, rw = [], []
     for name in sorted(read | written):
         if name in feed_names or not name:
@@ -311,7 +319,7 @@ def _collect_persistables(program: Program, block: Block, scope: Scope,
         if not v.persistable:
             continue
         (rw if name in written else ro).append(name)
-    return ro, rw, read
+    return ro, rw, incoming
 
 
 class Executor:

@@ -34,16 +34,23 @@ class AnalysisConfig:
 
     # parity switches (ref analysis_config.cc)
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        from ..flags import warn_noop
+        warn_noop("AnalysisConfig.enable_use_gpu",
+                  "inference runs on the TPU/XLA backend")
         self._device_id = device_id
 
     def disable_gpu(self):
         pass
 
     def switch_ir_optim(self, flag=True):
+        if not flag:
+            from ..flags import warn_noop
+            warn_noop("AnalysisConfig.switch_ir_optim(False)",
+                      "XLA always optimizes the computation")
         self._ir_optim = flag
 
     def enable_memory_optim(self):
-        self._memory_optim = True
+        self._memory_optim = True   # XLA buffer assignment — always on
 
     def set_model(self, model_dir, params_file=None):
         self.model_dir = model_dir

@@ -59,7 +59,11 @@ def save_vars(executor=None, dirname=None, main_program=None, vars=None,
         vars = [v for v in program.list_vars()
                 if (predicate or _is_persistable)(v)]
     os.makedirs(dirname, exist_ok=True)
-    arrays = {v.name: _scope_value(scope, v.name) for v in vars}
+    # canonical C-order blobs: device fetches can come back
+    # Fortran-contiguous, which non-numpy consumers (demo_predictor.cc)
+    # would reject
+    arrays = {v.name: np.ascontiguousarray(_scope_value(scope, v.name))
+              for v in vars}
     if filename is not None:
         np.savez(os.path.join(dirname, filename), **arrays)
     else:
@@ -67,8 +71,14 @@ def save_vars(executor=None, dirname=None, main_program=None, vars=None,
             np.save(os.path.join(dirname, name.replace("/", "__")), arr)
     meta = {name: {"shape": list(arr.shape), "dtype": str(arr.dtype)}
             for name, arr in arrays.items()}
+    from .framework.core import PROGRAM_FORMAT_VERSION
+    from . import __version__
     with open(os.path.join(dirname, "__meta__.json"), "w") as f:
-        json.dump({"filename": filename, "vars": meta}, f)
+        json.dump({"filename": filename, "vars": meta,
+                   # ref framework/version.h kCurTensorVersion: stamp the
+                   # parameter blobs so cross-version loads are detectable
+                   "version": PROGRAM_FORMAT_VERSION,
+                   "framework_version": __version__}, f)
 
 
 def save_params(executor=None, dirname=None, main_program=None, filename=None,
@@ -90,6 +100,18 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
     """ref io.py load_vars."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
+    meta_path = os.path.join(dirname, "__meta__.json")
+    if os.path.exists(meta_path):
+        from .framework.core import PROGRAM_FORMAT_VERSION
+        with open(meta_path) as f:
+            meta = json.load(f)
+        fmt = int(meta.get("version", 0))
+        if fmt > PROGRAM_FORMAT_VERSION:
+            raise ValueError(
+                f"parameter blobs in {dirname} have format version {fmt}, "
+                f"newer than this framework supports "
+                f"({PROGRAM_FORMAT_VERSION}; saved by framework "
+                f"{meta.get('framework_version', '<unknown>')!r})")
     if vars is None:
         vars = [v for v in program.list_vars()
                 if (predicate or _is_persistable)(v)]

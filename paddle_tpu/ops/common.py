@@ -33,8 +33,26 @@ def broadcast_to_x(x, y, axis=-1):
     return y.reshape(new_shape)
 
 
+def canon_dtype(name):
+    """Canonical device dtype for a declared dtype: int64/uint64/float64
+    map to their 32-bit forms when x64 is disabled (the jax default).
+    Declaring int64 is API parity — fluid ids/labels are int64 — but jax
+    would silently truncate AND emit a UserWarning per call site; mapping
+    here keeps lowerings warning-free with identical results."""
+    if jax.config.jax_enable_x64:
+        return jnp.dtype(name)
+    return jnp.dtype({"int64": "int32", "uint64": "uint32",
+                      "float64": "float32"}.get(str(np.dtype(name)),
+                                                np.dtype(name).name))
+
+
+# ids/labels dtype (declared int64 in the fluid API)
+def ids_dtype():
+    return canon_dtype("int64")
+
+
 def npdtype(name):
-    return jnp.dtype(name)
+    return canon_dtype(name)
 
 
 def static_int(x, what, default=None):

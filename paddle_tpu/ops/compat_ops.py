@@ -14,7 +14,7 @@ import numpy as np
 
 from ..framework import registry
 from ..framework.registry import register_op
-from .common import X, XS
+from .common import X, XS, ids_dtype, canon_dtype
 
 
 def alias_op(new: str, old: str) -> None:
@@ -44,7 +44,7 @@ def _fill(ctx, ins, attrs):
     """ref operators/fill_op.cc: constant tensor from a value list attr."""
     shape = attrs["shape"]
     value = np.asarray(attrs["value"], np.float64).reshape(shape)
-    return {"Out": [jnp.asarray(value, jnp.dtype(
+    return {"Out": [jnp.asarray(value, canon_dtype(
         attrs.get("dtype", "float32")))]}
 
 
@@ -64,7 +64,7 @@ def _uniform_random_batch_size_like(ctx, ins, attrs):
     u = jax.random.uniform(ctx.rng(), tuple(shape),
                            minval=attrs.get("min", -1.0),
                            maxval=attrs.get("max", 1.0))
-    return {"Out": [u.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+    return {"Out": [u.astype(canon_dtype(attrs.get("dtype", "float32")))]}
 
 
 @register_op("gaussian_random_batch_size_like", no_grad=True,
@@ -73,7 +73,7 @@ def _gaussian_random_batch_size_like(ctx, ins, attrs):
     shape = _batch_size_like_shape(ins, attrs)
     g = jax.random.normal(ctx.rng(), tuple(shape)) * \
         attrs.get("std", 1.0) + attrs.get("mean", 0.0)
-    return {"Out": [g.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+    return {"Out": [g.astype(canon_dtype(attrs.get("dtype", "float32")))]}
 
 
 # -- losses / simple math ----------------------------------------------------
@@ -320,7 +320,7 @@ def _lod_rank_table(ctx, ins, attrs):
 def _max_sequence_len(ctx, ins, attrs):
     """ref max_sequence_len_op.cc: longest length in a rank table."""
     table = X(ins, "RankTable")
-    return {"Out": [jnp.max(table[:, 1]).astype(jnp.int64).reshape(())]}
+    return {"Out": [jnp.max(table[:, 1]).astype(ids_dtype()).reshape(())]}
 
 
 @register_op("reorder_lod_tensor_by_rank")
@@ -457,7 +457,7 @@ def _filter_by_instag(ctx, ins, attrs):
             "LossWeight": [w.reshape(-1, 1)],
             "IndexMap": [jnp.stack([jnp.arange(x.shape[0]),
                                     jnp.arange(x.shape[0])],
-                                   axis=1).astype(jnp.int64)]}
+                                   axis=1).astype(ids_dtype())]}
 
 
 # -- PS id sharding (dense-masked; the native PS plane routes rows itself) ---

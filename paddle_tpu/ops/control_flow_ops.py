@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
+from .common import canon_dtype, ids_dtype
 
 
 def _trace_subblock(ctx, sub_block, env):
@@ -286,7 +287,7 @@ def _array_read(ctx, ins, attrs):
 @register_op("array_length", no_grad=True)
 def _array_length(ctx, ins, attrs):
     from .common import X
-    return {"Out": [X(ins, "ArrayLen").astype(jnp.int64)]}
+    return {"Out": [X(ins, "ArrayLen").astype(ids_dtype())]}
 
 
 @register_op("tensor_array_to_tensor")
@@ -327,7 +328,7 @@ def _py_func(ctx, ins, attrs):
     out_specs = []
     for shape, dtype in zip(attrs["out_shapes"], attrs["out_dtypes"]):
         shape = tuple(xs[0].shape[0] if s == -1 else s for s in shape)
-        out_specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)))
+        out_specs.append(jax.ShapeDtypeStruct(shape, canon_dtype(dtype)))
 
     def host_fwd(*arrs):
         outs = fwd(*[np.asarray(a) for a in arrs])

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import X, XS
+from .common import X, XS, ids_dtype
 
 
 # ---------------------------------------------------------------------------
@@ -341,7 +341,7 @@ def _multiclass_nms(ctx, ins, attrs):
 
     out, num, index = jax.vmap(per_image)(bboxes, scores)
     return {"Out": [out], "NmsRoisNum": [num],
-            "Index": [index[..., None].astype(jnp.int64)]}
+            "Index": [index[..., None].astype(ids_dtype())]}
 
 
 @register_op("detection_output", no_grad=True)
@@ -490,7 +490,7 @@ def _rpn_target_assign(ctx, ins, attrs):
     keys = jax.random.split(key, b)
     labels, match, tgt = jax.vmap(per_image)(gt, keys)
     return {"ScoreIndex": [labels], "LocationIndex": [match],
-            "TargetLabel": [labels.astype(jnp.int64)],
+            "TargetLabel": [labels.astype(ids_dtype())],
             "TargetBBox": [tgt],
             "BBoxInsideWeight": [(labels == 1)[..., None].astype(tgt.dtype) *
                                  jnp.ones_like(tgt)]}
@@ -537,7 +537,7 @@ def _retinanet_target_assign(ctx, ins, attrs):
                          jnp.log(jnp.maximum(gw / aw, 1e-10)),
                          jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
         fg_num = jnp.sum(((labels > 0)).astype(jnp.int32)) + 1
-        return labels.astype(jnp.int64), tgt, fg_num
+        return labels.astype(ids_dtype()), tgt, fg_num
 
     labels, tgt, fg = jax.vmap(per_image)(gt, gt_labels)
     return {"TargetLabel": [labels], "TargetBBox": [tgt],
@@ -846,7 +846,7 @@ def _roi_pool(ctx, ins, attrs):
         return jnp.stack(out, -1).reshape(feat.shape[0], ph, pw)
 
     out = jax.vmap(one)(rois, roi_batch)
-    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int64)]}
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, ids_dtype())]}
 
 
 @register_op("psroi_pool")
@@ -947,7 +947,7 @@ def _roi_perspective_transform(ctx, ins, attrs):
         return vals
 
     out = jax.vmap(one)(rois, roi_batch)
-    return {"Out": [out], "Out2InIdx": [jnp.zeros((1,), jnp.int64)],
+    return {"Out": [out], "Out2InIdx": [jnp.zeros((1,), ids_dtype())],
             "Out2InWeights": [jnp.zeros((1,), jnp.float32)],
             "TransformMatrix": [jnp.zeros((rois.shape[0], 9),
                                           jnp.float32)]}
@@ -1182,7 +1182,7 @@ def _generate_proposal_labels(ctx, ins, attrs):
             jnp.where(fg_sel[:, None], tgt, 0.0))
         inside_w = jnp.zeros_like(expand).at[rowi, cols].set(
             jnp.where(fg_sel[:, None], 1.0, 0.0))
-        return (sel_rois, sel_lab.astype(jnp.int64), expand, inside_w,
+        return (sel_rois, sel_lab.astype(ids_dtype()), expand, inside_w,
                 jnp.sum(take[sel].astype(jnp.int32)))
 
     keys = jax.random.split(key, b)

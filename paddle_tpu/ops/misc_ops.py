@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import X, XS, static_int
+from .common import X, XS, static_int, ids_dtype
 
 
 @register_op("hash", no_grad=True)
@@ -38,7 +38,7 @@ def _hash(ctx, ins, attrs):
         for j in range(row.shape[1]):
             h = (h ^ (row[:, j] * seed)) * jnp.uint32(0x9E3779B1)
             h = h ^ (h >> 15)
-        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((h % jnp.uint32(mod_by)).astype(ids_dtype()))
     out = jnp.stack(outs, axis=1)[:, :, None]
     return {"Out": [out]}
 

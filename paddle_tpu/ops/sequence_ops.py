@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import X, XS
+from .common import X, XS, ids_dtype, canon_dtype
 
 
 def _time_mask(x, seq_len, dtype=None):
@@ -33,7 +33,7 @@ def _sequence_mask(ctx, ins, attrs):
         maxlen = int(np.asarray(jnp.max(lens))) if not hasattr(lens, "aval") \
             else lens.shape[-1]
     m = jnp.arange(maxlen)[None, :] < lens.reshape(-1, 1)
-    return {"Y": [m.astype(jnp.dtype(attrs.get("out_dtype", "int64")))]}
+    return {"Y": [m.astype(canon_dtype(attrs.get("out_dtype", "int64")))]}
 
 
 @register_op("sequence_pool")
@@ -123,8 +123,8 @@ def _sequence_pad(ctx, ins, attrs):
     x = X(ins, "X")
     seq_len = X(ins, "SeqLen")
     lengths = seq_len if seq_len is not None else \
-        jnp.full((x.shape[0],), x.shape[1], jnp.int64)
-    return {"Out": [x], "Length": [lengths.astype(jnp.int64)]}
+        jnp.full((x.shape[0],), x.shape[1], ids_dtype())
+    return {"Out": [x], "Length": [lengths.astype(ids_dtype())]}
 
 
 @register_op("sequence_unpad")

@@ -23,7 +23,7 @@ import numpy as np
 import optax
 
 from ..framework.registry import register_op
-from .common import X, XS
+from .common import X, XS, ids_dtype
 
 NEG_INF = -1e9
 
@@ -126,10 +126,10 @@ def _crf_decoding(ctx, ins, attrs):
     path = jnp.concatenate([first[None, :], tags_rest], axis=0)  # [t, b]
     path = jnp.moveaxis(path, 0, 1)                              # [b, t]
     tmask = jnp.arange(t)[None, :] < lengths[:, None]
-    path = jnp.where(tmask, path, 0).astype(jnp.int64)
+    path = jnp.where(tmask, path, 0).astype(ids_dtype())
     if label is not None:
         lab = label[..., 0] if label.ndim == 3 else label
-        out = (path == lab.astype(path.dtype)).astype(jnp.int64)
+        out = (path == lab.astype(path.dtype)).astype(ids_dtype())
         out = jnp.where(tmask, out, 0)
         return {"ViterbiPath": [out]}
     return {"ViterbiPath": [path]}
@@ -159,8 +159,8 @@ def _ctc_align(ctx, ins, attrs):
     out = jnp.full((b, t + 1), pad_val, jnp.int32)
     out = out.at[jnp.arange(b)[:, None], pos].set(x)[:, :t]
     out_len = jnp.sum(keep.astype(jnp.int32), axis=1)
-    return {"Output": [out.astype(jnp.int64)],
-            "OutputLength": [out_len[:, None].astype(jnp.int64)]}
+    return {"Output": [out.astype(ids_dtype())],
+            "OutputLength": [out_len[:, None].astype(ids_dtype())]}
 
 
 @register_op("warpctc")
@@ -227,7 +227,7 @@ def _edit_distance(ctx, ins, attrs):
     if normalized:
         dist = dist / jnp.maximum(rl.astype(dist.dtype), 1.0)
     return {"Out": [dist[:, None]],
-            "SequenceNum": [jnp.array(b, jnp.int64)]}
+            "SequenceNum": [jnp.array(b, ids_dtype())]}
 
 
 # ---------------------------------------------------------------------------
@@ -289,7 +289,7 @@ def _nce(ctx, ins, attrs):
         [label, jnp.broadcast_to(neg[None, :], (x.shape[0], num_neg))], axis=1)
     return {"Cost": [cost[:, None]],
             "SampleLogits": [sample_logits],
-            "SampleLabels": [sample_labels.astype(jnp.int64)]}
+            "SampleLabels": [sample_labels.astype(ids_dtype())]}
 
 
 @register_op("hierarchical_sigmoid")
@@ -344,17 +344,17 @@ def _sample_logits(ctx, ins, attrs):
     # subtract log q (ref sample_logits_op.h ComputeRemoveLogQ)
     sampled_logits = picked - jnp.log(probs * num_samples + 1e-20)
     sampled_label = jnp.broadcast_to(jnp.arange(nt)[None, :], (b, nt))
-    return {"Samples": [samples.astype(jnp.int64)],
+    return {"Samples": [samples.astype(ids_dtype())],
             "Probabilities": [probs],
             "SampledLogits": [sampled_logits],
-            "SampledLabels": [sampled_label.astype(jnp.int64)]}
+            "SampledLabels": [sampled_label.astype(ids_dtype())]}
 
 
 @register_op("sampling_id", no_grad=True, stateful_rng=True)
 def _sampling_id(ctx, ins, attrs):
     x = X(ins, "X")                     # [b, C] probabilities
     ids = jax.random.categorical(ctx.rng(), jnp.log(x + 1e-20), axis=-1)
-    return {"Out": [ids.astype(jnp.int64)]}
+    return {"Out": [ids.astype(ids_dtype())]}
 
 
 # ---------------------------------------------------------------------------
@@ -397,9 +397,9 @@ def _beam_search(ctx, ins, attrs):
     sel_id = jnp.take_along_axis(cand_id, top_i, axis=1)
     parent_in_batch = top_i // k
     parent = parent_in_batch + jnp.arange(batch)[:, None] * beam_size
-    return {"selected_ids": [sel_id.reshape(bb, 1).astype(jnp.int64)],
+    return {"selected_ids": [sel_id.reshape(bb, 1).astype(ids_dtype())],
             "selected_scores": [top_s.reshape(bb, 1)],
-            "parent_idx": [parent.reshape(bb).astype(jnp.int64)]}
+            "parent_idx": [parent.reshape(bb).astype(ids_dtype())]}
 
 
 @register_op("beam_search_decode", no_grad=True)
@@ -435,5 +435,5 @@ def _beam_search_decode(ctx, ins, attrs):
     sent_ids = jnp.moveaxis(toks, 0, 1).reshape(bb // beam_size, beam_size, t)
     sent_scores = jnp.moveaxis(scs, 0, 1).reshape(
         bb // beam_size, beam_size, t)
-    return {"SentenceIds": [sent_ids.astype(jnp.int64)],
+    return {"SentenceIds": [sent_ids.astype(ids_dtype())],
             "SentenceScores": [sent_scores]}

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import X, XS, broadcast_to_x, canon_axis, static_int
+from .common import X, XS, broadcast_to_x, canon_axis, static_int, ids_dtype, canon_dtype
 
 
 @register_op("fill_constant", no_grad=True)
@@ -28,14 +28,14 @@ def _fill_constant(ctx, ins, attrs):
         shape = [int(s) for s in np.asarray(shape_t)]
     dtype = attrs.get("dtype", "float32")
     value = attrs.get("value", 0.0)
-    return {"Out": [jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype))]}
+    return {"Out": [jnp.full(tuple(shape), value, dtype=canon_dtype(dtype))]}
 
 
 @register_op("fill_any_like", no_grad=True)
 def _fill_any_like(ctx, ins, attrs):
     x = X(ins, "X")
     dtype = attrs.get("dtype", None)
-    d = x.dtype if dtype in (None, -1) else jnp.dtype(dtype)
+    d = x.dtype if dtype in (None, -1) else canon_dtype(dtype)
     return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype=d)]}
 
 
@@ -48,7 +48,7 @@ def _fill_zeros_like(ctx, ins, attrs):
 @register_op("gaussian_random", no_grad=True, stateful_rng=True)
 def _gaussian_random(ctx, ins, attrs):
     shape = tuple(attrs.get("shape", []))
-    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    dtype = canon_dtype(attrs.get("dtype", "float32"))
     mean = attrs.get("mean", 0.0)
     std = attrs.get("std", 1.0)
     out = mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
@@ -58,7 +58,7 @@ def _gaussian_random(ctx, ins, attrs):
 @register_op("truncated_gaussian_random", no_grad=True, stateful_rng=True)
 def _truncated_gaussian_random(ctx, ins, attrs):
     shape = tuple(attrs.get("shape", []))
-    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    dtype = canon_dtype(attrs.get("dtype", "float32"))
     mean = attrs.get("mean", 0.0)
     std = attrs.get("std", 1.0)
     out = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, jnp.float32)
@@ -68,7 +68,7 @@ def _truncated_gaussian_random(ctx, ins, attrs):
 @register_op("uniform_random", no_grad=True, stateful_rng=True)
 def _uniform_random(ctx, ins, attrs):
     shape = tuple(attrs.get("shape", []))
-    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    dtype = canon_dtype(attrs.get("dtype", "float32"))
     lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
     out = jax.random.uniform(ctx.rng(), shape, minval=lo, maxval=hi,
                              dtype=jnp.float32)
@@ -78,7 +78,7 @@ def _uniform_random(ctx, ins, attrs):
 @register_op("cast")
 def _cast(ctx, ins, attrs):
     x = X(ins, "X")
-    return {"Out": [x.astype(jnp.dtype(attrs["out_dtype"]))]}
+    return {"Out": [x.astype(canon_dtype(attrs["out_dtype"]))]}
 
 
 @register_op("concat")
@@ -199,7 +199,7 @@ def _assign(ctx, ins, attrs):
 
 @register_op("assign_value", no_grad=True)
 def _assign_value(ctx, ins, attrs):
-    vals = np.array(attrs["values"], dtype=jnp.dtype(attrs.get("dtype", "float32")))
+    vals = np.array(attrs["values"], dtype=canon_dtype(attrs.get("dtype", "float32")))
     return {"Out": [jnp.asarray(vals).reshape(tuple(attrs["shape"]))]}
 
 
@@ -236,7 +236,7 @@ def _shape(ctx, ins, attrs):
 @register_op("size", no_grad=True)
 def _size(ctx, ins, attrs):
     x = X(ins, "Input")
-    return {"Out": [jnp.asarray(int(np.prod(x.shape)), dtype=jnp.int64)]}
+    return {"Out": [jnp.asarray(int(np.prod(x.shape)), dtype=ids_dtype())]}
 
 
 @register_op("gather")
@@ -308,7 +308,7 @@ def _range(ctx, ins, attrs):
     s = float(np.asarray(s)) if s is not None else attrs.get("start", 0)
     e = float(np.asarray(e)) if e is not None else attrs.get("end")
     st = float(np.asarray(st)) if st is not None else attrs.get("step", 1)
-    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    dtype = canon_dtype(attrs.get("dtype", "float32"))
     return {"Out": [jnp.arange(s, e, st, dtype=dtype)]}
 
 
@@ -317,7 +317,7 @@ def _linspace(ctx, ins, attrs):
     s, e, n = X(ins, "Start"), X(ins, "Stop"), X(ins, "Num")
     num = static_int(n, "linspace Num", attrs.get("num"))
     return {"Out": [jnp.linspace(jnp.reshape(s, ()), jnp.reshape(e, ()), num,
-                                 dtype=jnp.dtype(attrs.get("dtype", "float32")))]}
+                                 dtype=canon_dtype(attrs.get("dtype", "float32")))]}
 
 
 @register_op("expand")
@@ -417,7 +417,7 @@ def _reverse(ctx, ins, attrs):
 @register_op("eye", no_grad=True)
 def _eye(ctx, ins, attrs):
     return {"Out": [jnp.eye(attrs["num_rows"], attrs.get("num_columns") or None,
-                            dtype=jnp.dtype(attrs.get("dtype", "float32")))]}
+                            dtype=canon_dtype(attrs.get("dtype", "float32")))]}
 
 
 @register_op("diag", no_grad=True)
@@ -458,19 +458,19 @@ def _argsort(ctx, ins, attrs):
     desc = attrs.get("descending", False)
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(ids_dtype())]}
 
 
 @register_op("arg_max", no_grad=True)
 def _arg_max(ctx, ins, attrs):
     x = X(ins, "X")
-    return {"Out": [jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+    return {"Out": [jnp.argmax(x, axis=attrs.get("axis", -1)).astype(ids_dtype())]}
 
 
 @register_op("arg_min", no_grad=True)
 def _arg_min(ctx, ins, attrs):
     x = X(ins, "X")
-    return {"Out": [jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64)]}
+    return {"Out": [jnp.argmin(x, axis=attrs.get("axis", -1)).astype(ids_dtype())]}
 
 
 @register_op("top_k", no_grad=True)
@@ -481,14 +481,14 @@ def _top_k(ctx, ins, attrs):
     if kt is not None:
         k = static_int(kt, "top_k K")
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(ids_dtype())]}
 
 
 @register_op("where", no_grad=True)
 def _where(ctx, ins, attrs):
     c = X(ins, "Condition")
     return {"Out": [jnp.stack(jnp.nonzero(c, size=int(np.prod(c.shape))),
-                              axis=-1).astype(jnp.int64)]}
+                              axis=-1).astype(ids_dtype())]}
 
 
 @register_op("multiplex")
@@ -546,4 +546,4 @@ def _fill_constant_batch_size_like(ctx, ins, attrs):
     shape[attrs.get("output_dim_idx", 0)] = \
         ref.shape[attrs.get("input_dim_idx", 0)]
     return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0),
-                             dtype=jnp.dtype(attrs["dtype"]))]}
+                             dtype=canon_dtype(attrs["dtype"]))]}

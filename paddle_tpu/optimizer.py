@@ -755,3 +755,80 @@ class RecomputeOptimizer:
                                           grad_clip=grad_clip)
         self._apply(loss.block.program)
         return result
+
+
+class LookaheadOptimizer:
+    """Lookahead (arXiv:1907.08610; ref ``optimizer.py:2980``): the inner
+    optimizer moves the fast weights every step; every k-th step the slow
+    weights move toward the fast ones by ``alpha`` and the fast weights
+    reset to them.
+
+    TPU-native shape: the reference wraps the sync in a Switch over
+    ``step % k`` (dynamic control flow); here the blend runs every step
+    under a 0/1 mask — a handful of fused elementwise ops per parameter,
+    branch-free under XLA, identical math.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None, "inner optimizer can not be None"
+        assert 0.0 <= alpha <= 1.0, "alpha must be in [0, 1]"
+        assert isinstance(k, int) and k > 0, "k must be a positive int"
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self.type = "lookahead"
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        from . import layers
+        from .framework import default_startup_program
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+            grad_clip=grad_clip)
+
+        main_block = loss.block
+        startup = startup_program or default_startup_program()
+        params = [p.name for p in main_block.program.all_parameters()]
+
+        # slow copies live alongside the fast params (ref: <name>@SLOW),
+        # initialized to the fast values by the startup program
+        for name in params:
+            fast = main_block.var(name)
+            main_block.create_var(name=name + "@SLOW", shape=fast.shape,
+                                  dtype=fast.dtype, persistable=True)
+            sb = startup.global_block()
+            sv = sb.create_var(name=name + "@SLOW", shape=fast.shape,
+                               dtype=fast.dtype, persistable=True)
+            if not sb.has_var(name):
+                # params restored via load_persistables instead of init
+                # ops: declare the var so the copy below is well-formed
+                # (its value must be in the scope before startup runs)
+                sb.create_var(name=name, shape=fast.shape,
+                              dtype=fast.dtype, persistable=True)
+            sb.append_op("assign", inputs={"X": [name]},
+                         outputs={"Out": [sv.name]}, attrs={})
+
+        # int32 counter: a float32 step would freeze at 2^24 and silently
+        # stop (or jam on) the sync (ref uses an int32 lookahead_step too)
+        step = layers.create_global_var(name="lookahead_step", shape=[1],
+                                        value=0, dtype="int32",
+                                        persistable=True)
+        layers.increment(step, value=1, in_place=True)
+        # mask = 1.0 every k-th step else 0.0
+        mod = layers.elementwise_mod(step, layers.fill_constant(
+            shape=[1], dtype="int32", value=self.k))
+        mask = layers.cast(layers.equal(mod, layers.fill_constant(
+            shape=[1], dtype="int32", value=0)), "float32")
+        for name in params:
+            fast = main_block.var(name)
+            slow = main_block.var(name + "@SLOW")
+            blend = slow + self.alpha * (fast - slow)
+            new_slow = mask * blend + (1.0 - mask) * slow
+            new_fast = mask * new_slow + (1.0 - mask) * fast
+            layers.assign(new_slow, slow)
+            layers.assign(new_fast, fast)
+        return result

@@ -1,23 +1,48 @@
 """Test config: run on a virtual 8-device CPU mesh so sharding/collective
-tests work without TPU hardware (SURVEY §4 'TPU-build implication' (b))."""
+tests work without TPU hardware (SURVEY §4 'TPU-build implication' (b)).
+
+``PADDLE_TPU_TEST_HW=1 pytest -m tpu_hw tests/test_tpu_numerics.py`` keeps
+the real accelerator backend instead, for the on-hardware numerics sweep.
+"""
 
 import os
 
-# jax may already be imported by the environment (JAX_PLATFORMS=axon), so
-# plain env vars are too late — use the config API, which takes effect as
-# long as no backend has been initialized yet.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = \
-        flags + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
+_ON_HW = os.environ.get("PADDLE_TPU_TEST_HW") == "1"
+
+if not _ON_HW:
+    # jax may already be imported by the environment (JAX_PLATFORMS=axon),
+    # so plain env vars are too late — use the config API, which takes
+    # effect as long as no backend has been initialized yet.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu", (
-    "tests must run on the virtual CPU mesh; got " + jax.default_backend())
-assert len(jax.devices()) == 8
+if not _ON_HW:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", (
+        "tests must run on the virtual CPU mesh; got "
+        + jax.default_backend())
+    assert len(jax.devices()) == 8
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    skip = pytest.mark.skip(
+        reason="hardware numerics sweep: set PADDLE_TPU_TEST_HW=1 and run "
+               "on a TPU backend (pytest -m tpu_hw)")
+    for item in items:
+        if "tpu_hw" in item.keywords and not _ON_HW:
+            item.add_marker(skip)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu_hw: runs on the real TPU chip (needs "
+        "PADDLE_TPU_TEST_HW=1)")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

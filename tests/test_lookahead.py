@@ -1,0 +1,68 @@
+"""LookaheadOptimizer (ref ``optimizer.py:2980``): slow/fast weight
+dynamics vs a numpy simulation of the paper's update rule."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import optimizer as opt
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  scope_guard)
+
+
+def test_lookahead_matches_reference_dynamics():
+    k, alpha, lr, steps = 3, 0.5, 0.1, 8
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        w = layers.create_parameter([4, 1], "float32", name="w_la")
+        loss = layers.mean(layers.matmul(x, w))
+        la = opt.LookaheadOptimizer(opt.SGDOptimizer(lr), alpha=alpha, k=k)
+        la.minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        w0 = np.asarray(scope.find_var("w_la")).copy()
+        slow0 = np.asarray(scope.find_var("w_la@SLOW")).copy()
+        np.testing.assert_allclose(slow0, w0)
+
+        rng = np.random.RandomState(0)
+        xs = [rng.rand(8, 4).astype(np.float32) for _ in range(steps)]
+        for xv in xs:
+            exe.run(fluid.default_main_program(), feed={"x": xv},
+                    fetch_list=[loss.name], scope=scope)
+        got_fast = np.asarray(scope.find_var("w_la"))
+        got_slow = np.asarray(scope.find_var("w_la@SLOW"))
+
+    # numpy simulation: grad of mean(x @ w) wrt w is x.mean(0)/1 per col
+    fast, slow = w0.copy(), w0.copy()
+    for t, xv in enumerate(xs, start=1):
+        g = xv.mean(axis=0, keepdims=True).T / 1.0
+        fast = fast - lr * g
+        if t % k == 0:
+            slow = slow + alpha * (fast - slow)
+            fast = slow.copy()
+    np.testing.assert_allclose(got_fast, fast, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_slow, slow, rtol=1e-5, atol=1e-6)
+
+
+def test_lookahead_trains():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        la = opt.LookaheadOptimizer(opt.AdamOptimizer(1e-2), alpha=0.8, k=5)
+        la.minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        rng = np.random.RandomState(1)
+        xv = rng.rand(32, 8).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        losses = []
+        for _ in range(60):
+            l, = exe.run(fluid.default_main_program(),
+                         feed={"x": xv, "y": yv},
+                         fetch_list=[loss.name], scope=scope)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])

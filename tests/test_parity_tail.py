@@ -1,0 +1,136 @@
+"""Parity long tail (VERDICT r1 missing #5/#6, weak #6/#7): version
+stamping, PS dtype/deadline hardening, and one-time no-op-knob warnings."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  scope_guard)
+from paddle_tpu.framework.core import PROGRAM_FORMAT_VERSION
+
+
+def test_program_blob_is_version_stamped():
+    with program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=2)
+        blob = fluid.default_main_program().serialize_to_string()
+    d = json.loads(blob.decode("utf-8"))
+    assert d["version"] == PROGRAM_FORMAT_VERSION
+    assert d["framework_version"] == fluid.__version__
+    # round trip
+    p = Program.parse_from_string(blob)
+    assert len(p.global_block().ops) > 0
+
+
+def test_newer_program_format_refuses_to_load():
+    with program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=2)
+        d = json.loads(
+            fluid.default_main_program().serialize_to_string().decode())
+    d["version"] = PROGRAM_FORMAT_VERSION + 1
+    with pytest.raises(ValueError, match="newer than this framework"):
+        Program.parse_from_string(json.dumps(d).encode("utf-8"))
+
+
+def test_param_blobs_version_stamped_and_checked(tmp_path):
+    d = str(tmp_path / "params")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=2)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        fluid.io.save_params(exe, d, scope=scope)
+        meta = json.load(open(os.path.join(d, "__meta__.json")))
+        assert meta["version"] == PROGRAM_FORMAT_VERSION
+        meta["version"] = PROGRAM_FORMAT_VERSION + 7
+        json.dump(meta, open(os.path.join(d, "__meta__.json"), "w"))
+        with pytest.raises(ValueError, match="newer than this framework"):
+            fluid.io.load_params(exe, d, scope=scope)
+
+
+def test_noop_knob_warns_once(caplog):
+    from paddle_tpu import flags as F
+    F._warned_noop_knobs.discard("BuildStrategy.memory_optimize")
+    bs = fluid.compiler.BuildStrategy()
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu"):
+        bs.memory_optimize = False
+        bs.memory_optimize = True        # second set: silent
+    msgs = [r.message for r in caplog.records
+            if "memory_optimize" in r.message]
+    assert len(msgs) == 1, msgs
+    assert "no effect on TPU" in msgs[0]
+
+
+def test_ps_int32_table_roundtrip():
+    """Non-f32 4-byte tables ride the f32 wire format losslessly."""
+    from paddle_tpu.distributed import ps as ps_mod
+    server = ps_mod.PSServer(0, 1, True, [])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        vals = np.array([1, -2, 2 ** 30, 7, 0, -(2 ** 31)], np.int32)
+        cli.put("int_table", vals, dtype=np.int32)
+        got = cli.get("int_table", vals.size, barrier=False,
+                      dtype=np.int32)
+        np.testing.assert_array_equal(got, vals)
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_async_executor_shim(tmp_path):
+    """Legacy AsyncExecutor routes to train_from_dataset (the reference's
+    own successor API — ref framework/async_executor.h:62)."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    files = []
+    for fi in range(2):
+        p = str(tmp_path / f"part-{fi}")
+        with open(p, "w") as f:
+            for _ in range(40):
+                feats = rng.randn(4)
+                label = rng.randint(0, 2)
+                f.write("4 " + " ".join(f"{v:.6f}" for v in feats)
+                        + f" 1 {label}\n")
+        files.append(p)
+    proto = tmp_path / "feed.proto"
+    proto.write_text("""
+name: "MultiSlotDataFeed"
+batch_size: 32
+multi_slot_desc {
+     slots {
+         name: "x"
+         type: "float"
+         is_dense: true
+         is_used: true
+     }
+     slots {
+         name: "y"
+         type: "uint64"
+         is_dense: false
+         is_used: true
+    }
+}
+""")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        pred = layers.fc(x, size=2, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe_s = Executor()
+        exe_s.run(fluid.default_startup_program(), scope=scope)
+        desc = fluid.DataFeedDesc(str(proto))
+        ae = fluid.AsyncExecutor()
+        out = ae.run(fluid.default_main_program(), desc, files,
+                     thread_num=2, fetch=[loss])
+    assert out is not None and np.isfinite(np.asarray(out[0])).all()
