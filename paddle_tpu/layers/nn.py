@@ -1492,3 +1492,42 @@ def fused_lm_head_ce(x, size, label, param_attr=None, bias_attr=None,
         "fused_lm_head_ce", inputs=inputs, outputs={"Loss": [loss]},
         attrs={"ignore_index": ignore_index, "chunk_size": chunk_size})
     return loss
+
+
+def switch_moe_ffn(x, num_experts, d_inner, capacity_factor=1.25,
+                   act="relu", param_prefix="moe", name=None):
+    """Switch-Transformer mixture-of-experts FFN over [b, t, d] input.
+
+    Returns (out, aux_loss).  Expert weights carry dist_spec ("ep", ...)
+    so a mesh with an ``ep`` axis shards the experts (GSPMD inserts the
+    dispatch/combine all-to-alls); on an ep-less mesh the annotations are
+    inert and the layer runs dense.  No reference counterpart — TPU-native
+    capability behind parallel/mesh.py's ``ep`` axis.
+    """
+    helper = LayerHelper("switch_ffn", name=name)
+    d = int(x.shape[-1])
+    E, F = int(num_experts), int(d_inner)
+
+    def _p(suffix, shape, ep_spec, is_bias=False):
+        from ..param_attr import ParamAttr
+        v = helper.create_parameter(
+            ParamAttr(name=f"{param_prefix}.{suffix}"), shape, x.dtype,
+            is_bias=is_bias)
+        v.dist_spec = ep_spec
+        return v
+
+    gate_w = _p("gate.w", [d, E], None)
+    w1 = _p("w1", [E, d, F], ("ep", None, None))
+    b1 = _p("b1", [E, F], ("ep", None), is_bias=True)
+    w2 = _p("w2", [E, F, d], ("ep", None, None))
+    b2 = _p("b2", [E, d], ("ep", None), is_bias=True)
+
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "switch_ffn",
+        inputs={"X": [x], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": float(capacity_factor), "act": act})
+    return out, aux

@@ -1,0 +1,66 @@
+"""Mixture-of-experts ops — the capability behind the mesh's ``ep`` axis.
+
+No reference counterpart (the 2019 snapshot has no MoE); design follows
+GShard/Switch-Transformer: top-1 gating, capacity-factor DENSE dispatch
+(one-hot einsums — static shapes, XLA-friendly), per-expert FFN as one
+batched matmul over the expert dimension.  Under a mesh with an ``ep``
+axis the expert-major tensors are GSPMD-sharded on E (the layer annotates
+the expert weights with dist_spec ``("ep", ...)``), which makes the
+dispatch/combine einsums lower to all-to-alls over ICI — the standard
+expert-parallel pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import X
+
+
+@register_op("switch_ffn")
+def _switch_ffn(ctx, ins, attrs):
+    """Switch-Transformer FFN: y = combine(expert_ffn(dispatch(x))).
+
+    Inputs: X [B,T,d], GateW [d,E], W1 [E,d,f], B1 [E,f], W2 [E,f,d],
+    B2 [E,d].  Outputs: Out [B,T,d], AuxLoss [] (load-balancing loss,
+    E·Σ_e fraction_e·prob_e — add a small multiple to the training loss).
+    Tokens beyond an expert's capacity are dropped (contribute zero),
+    per the Switch recipe.
+    """
+    x, gw = X(ins, "X"), X(ins, "GateW")
+    w1, b1 = X(ins, "W1"), X(ins, "B1")
+    w2, b2 = X(ins, "W2"), X(ins, "B2")
+    act = attrs.get("act", "relu")
+    cf = float(attrs.get("capacity_factor", 1.25))
+    B, T, d = x.shape
+    E = gw.shape[-1]
+    S = B * T
+    cap = int(max(1, np.ceil(cf * S / E)))
+    xt = x.reshape(S, d)
+
+    # gating in f32 (tiny [S, E] tensors; router numerics matter)
+    logits = xt.astype(jnp.float32) @ gw.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)
+    idx = probs.argmax(axis=-1)
+
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)            # [S, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1               # [S, E]
+    dispatch = jax.nn.one_hot(pos, cap, dtype=x.dtype)          # [S, E, C]
+
+    xe = jnp.einsum("sec,sd->ecd", dispatch, xt)                # [E, C, d]
+    h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(x.dtype)) \
+        + b1.astype(x.dtype)[:, None, :]
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype)) \
+        + b2.astype(x.dtype)[:, None, :]
+
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]    # [S, E, C]
+    y = jnp.einsum("sec,ecd->sd", combine, ye)
+
+    frac = onehot.astype(jnp.float32).mean(axis=0)              # tokens/e
+    aux = (frac * probs.mean(axis=0)).sum() * E
+    return {"Out": [y.reshape(B, T, d)], "AuxLoss": [aux]}
