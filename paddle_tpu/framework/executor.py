@@ -402,7 +402,7 @@ class Executor:
                                     return_numpy, seed)
 
     def _finish_run(self, cb, key, feed, scope, program, return_numpy, seed):
-        feeds = [_to_device(feed[n]) for n in cb.feed_names]
+        feeds = [_to_device(feed[n], n) for n in cb.feed_names]
         ro_vals = [_scope_fetch(scope, n) for n in cb.persist_ro]
         # read-write persistables that are READ must be initialized (optimizer
         # accumulators, BN stats, step counters) — a silent zero would corrupt
@@ -521,10 +521,36 @@ def _feed_sig(x):
     return (a.shape, str(a.dtype))
 
 
-def _to_device(x):
+_checked_int64_feeds = set()
+
+
+def _check_int64_range(x, name):
+    """With x64 off, int64 feeds land in int32 (uint64 in uint32); values
+    outside the narrow range would wrap SILENTLY (ops/common.py
+    canon_dtype).  Spot-check the FIRST batch per feed name — a one-time
+    host min/max scan, keeping the steady-state dispatch path clean."""
+    if (x.dtype in (np.int64, np.uint64) and x.size
+            and name not in _checked_int64_feeds
+            and not jax.config.jax_enable_x64):
+        _checked_int64_feeds.add(name)
+        lo, hi = int(x.min()), int(x.max())
+        bad = (hi >= 2**32) if x.dtype == np.uint64 else \
+            (lo < -2**31 or hi >= 2**31)
+        if bad:
+            import warnings
+            narrow = "uint32" if x.dtype == np.uint64 else "int32"
+            warnings.warn(
+                f"feed {name!r} holds values outside the {narrow} range "
+                f"([{lo}, {hi}]); these WRAP on device with x64 disabled — "
+                f"set JAX_ENABLE_X64=1 for true 64-bit semantics")
+
+
+def _to_device(x, name=None):
     if isinstance(x, (int, float)):
         return jnp.asarray(x)
     if isinstance(x, np.ndarray):
+        if name is not None:
+            _check_int64_range(x, name)
         return jnp.asarray(x)
     return x
 

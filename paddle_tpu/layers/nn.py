@@ -485,6 +485,15 @@ def dice_loss(input, label, epsilon=1e-5):
 
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
+    """ref layers/nn.py dropout / operators/dropout_op.cc.
+
+    TPU note: the keep mask is drawn as uint8 random bits (one byte per
+    element — bit generation is the dominant dropout cost on TPU), so the
+    effective drop probability is quantized to multiples of 1/256 (up to
+    ~0.2% absolute bias vs the requested rate), and any tiny nonzero
+    ``dropout_prob`` drops at least ~0.39% of elements rather than
+    silently becoming a no-op.
+    """
     import zlib
     helper = LayerHelper("dropout", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)

@@ -38,7 +38,10 @@ def canon_dtype(name):
     map to their 32-bit forms when x64 is disabled (the jax default).
     Declaring int64 is API parity — fluid ids/labels are int64 — but jax
     would silently truncate AND emit a UserWarning per call site; mapping
-    here keeps lowerings warning-free with identical results."""
+    here keeps lowerings warning-free with identical results *for values
+    inside the int32 range*.  Caveat: ids/hashes/labels >= 2**31 wrap —
+    feeds are range-checked in the executor (one warning per var) and
+    ``JAX_ENABLE_X64=1`` restores true int64 end to end."""
     if jax.config.jax_enable_x64:
         return jnp.dtype(name)
     return jnp.dtype({"int64": "int32", "uint64": "uint32",

@@ -71,7 +71,11 @@ def _while_grad_maker(op, block, no_grad_set):
         if not block.has_var(n):
             return False
         v = block.var(n)
-        return v.dtype is not None and str(v.dtype).startswith("float")
+        # unknown dtype (shape-inference couldn't reach it) is treated as
+        # float so gradient flow is never silently dropped — a zeros
+        # cotangent for a genuinely-integer carry is harmless, while the
+        # converse (no grad for a float carry) is a wrong gradient
+        return v.dtype is None or str(v.dtype).startswith("float")
 
     g_inputs = {
         "InitSnapshot": list(op.input("InitSnapshot")),
@@ -101,6 +105,22 @@ def _while(ctx, block, op, state):
     if max_trips is not None:
         final = _while_scan(ctx, sub_block, carried, cond_name, consts,
                             init, max_trips)
+        # an under-sized max_trip_count silently truncates the loop —
+        # forward AND grads would be wrong with no signal.  The final
+        # carried condition must be false; if not, shout at runtime (the
+        # debug branch only executes when triggered, so the happy path
+        # pays one predicate).
+        if cond_name in carried:
+            fin_cond = jnp.reshape(
+                dict(zip(carried, final))[cond_name], ()).astype(bool)
+            jax.lax.cond(
+                fin_cond,
+                lambda: jax.debug.print(
+                    "WARNING: while(max_trip_count={m}) exited with the "
+                    "condition still TRUE - the loop was truncated and "
+                    "its result/gradients are wrong; raise max_trip_count",
+                    m=max_trips),
+                lambda: None)
     else:
         def cond_fn(carry):
             env = dict(consts)
@@ -137,8 +157,14 @@ def _while_grad(ctx, block, op, state):
     init_vals = tuple(state.read(block, n) for n in snaps)
     consts = {n: v for n, v in state.values.items() if n not in carried}
 
+    # grad-maker emits an InitGrad name whenever the var *might* be float
+    # (declared float OR dtype unknown at build time); here the runtime
+    # values are in hand, so drop non-float carries — jax.vjp over integer
+    # primals returns float0 structured arrays, not usable zeros
     diff_idx = [i for i, n in enumerate(carried)
-                if op.output("InitGrad")[i]]
+                if op.output("InitGrad")[i]
+                and jnp.issubdtype(jnp.asarray(init_vals[i]).dtype,
+                                   jnp.floating)]
 
     def run(diff_init):
         full_init = list(init_vals)

@@ -783,7 +783,6 @@ class LookaheadOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
-        from . import layers
         from .framework import default_startup_program
         result = self.inner_optimizer.minimize(
             loss, startup_program=startup_program,
@@ -793,7 +792,12 @@ class LookaheadOptimizer:
         main_block = loss.block
         startup = startup_program or default_startup_program()
         params = [p.name for p in main_block.program.all_parameters()]
+        with main_block.program._op_role_guard("optimize"):
+            self._append_lookahead_ops(main_block, startup, params)
+        return result
 
+    def _append_lookahead_ops(self, main_block, startup, params):
+        from . import layers
         # slow copies live alongside the fast params (ref: <name>@SLOW),
         # initialized to the fast values by the startup program
         for name in params:
@@ -831,4 +835,3 @@ class LookaheadOptimizer:
             new_fast = mask * new_slow + (1.0 - mask) * fast
             layers.assign(new_slow, slow)
             layers.assign(new_fast, fast)
-        return result

@@ -66,3 +66,36 @@ def test_lookahead_trains():
                          fetch_list=[loss.name], scope=scope)
             losses.append(float(l))
         assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_lookahead_ops_pruned_from_test_clone():
+    """clone(for_test=True) must drop the lookahead sync ops (they carry
+    op_role='optimize'); otherwise every eval run would bump
+    lookahead_step and overwrite the parameters."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        w = layers.create_parameter([4, 1], "float32", name="w_la2")
+        loss = layers.mean(layers.matmul(x, w))
+        la = opt.LookaheadOptimizer(opt.SGDOptimizer(0.1), alpha=0.5, k=2)
+        la.minimize(loss)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+
+        test_ops = [op.type for op in test_prog.global_block().ops]
+        assert "increment" not in test_ops
+        for op in test_prog.global_block().ops:
+            for out in op.output_arg_names():
+                assert not out.endswith("@SLOW"), (
+                    f"lookahead sync op {op.type} survived for_test clone")
+
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        w0 = np.asarray(scope.find_var("w_la2")).copy()
+        step0 = np.asarray(scope.find_var("lookahead_step")).copy()
+        xv = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        for _ in range(3):
+            exe.run(test_prog, feed={"x": xv}, fetch_list=[loss.name],
+                    scope=scope)
+        np.testing.assert_allclose(np.asarray(scope.find_var("w_la2")), w0)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("lookahead_step")), step0)

@@ -214,3 +214,25 @@ def test_while_bounded_early_exit_masking():
                           fetch_list=[loss.name], scope=scope)
             np.testing.assert_allclose(lv, 8.0, rtol=1e-5,
                                        err_msg=f"trips={trips}")
+
+
+def test_bounded_while_truncation_warns(capfd):
+    """An under-sized max_trip_count must shout at runtime (ADVICE r2):
+    the final carried condition is still true -> jax.debug.print fires."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        i = layers.fill_constant(shape=[1], dtype="float32", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="float32", value=10)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_trip_count=3)   # loop needs 10 trips
+        with w.block():
+            nxt = i + 1.0
+            layers.assign(nxt, i)
+            layers.less_than(i, limit, cond=cond)
+        exe = fluid.framework.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        out, = exe.run(fluid.default_main_program(),
+                       fetch_list=[i.name], scope=scope)
+    assert float(out[0]) == 3.0          # truncated result
+    captured = capfd.readouterr()
+    assert "truncated" in captured.out or "truncated" in captured.err
