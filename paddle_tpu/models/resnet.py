@@ -60,10 +60,30 @@ _DEPTH_CFG = {
 }
 
 
-def resnet(input, class_dim=1000, depth=50, is_test=False):
+def space_to_depth_nchw(img, block=2):
+    """Host-side space-to-depth for the s2d stem input pipeline (numpy,
+    NCHW): [B,C,H,W] → [B,C·b²,H/b,W/b].  The TPU RN50 stem trick (used
+    by public MLPerf ResNet submissions): blocking 2×2 spatial into
+    channels turns the C_in=3 stem conv — which fills 3 of the MXU's 128
+    lanes — into a C_in=12 conv at a quarter the spatial size."""
+    b, c, h, w = img.shape
+    out = img.reshape(b, c, h // block, block, w // block, block)
+    out = out.transpose(0, 1, 3, 5, 2, 4)
+    return out.reshape(b, c * block * block, h // block, w // block)
+
+
+def resnet(input, class_dim=1000, depth=50, is_test=False, s2d_stem=False):
     block_fn, counts = _DEPTH_CFG[depth]
-    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu", name="stem",
-                         is_test=is_test)
+    if s2d_stem:
+        # input is the space-to-depth image [12,112,112]; a 3×3/s1 conv
+        # here sees a 6×6 receptive field in the original image (vs the
+        # 7×7/s2 stem) and produces the same [64,112,112] output — the
+        # standard TPU reparameterization of the ResNet stem
+        conv = conv_bn_layer(input, 64, 3, stride=1, act="relu",
+                             name="stem", is_test=is_test)
+    else:
+        conv = conv_bn_layer(input, 64, 7, stride=2, act="relu",
+                             name="stem", is_test=is_test)
     pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
     filters = [64, 128, 256, 512]
     x = pool
@@ -78,10 +98,13 @@ def resnet(input, class_dim=1000, depth=50, is_test=False):
 
 
 def build_resnet_train(class_dim=1000, depth=50, image_shape=(3, 224, 224),
-                       is_test=False):
+                       is_test=False, s2d_stem=False):
+    if s2d_stem:
+        c, h, w = image_shape
+        image_shape = (c * 4, h // 2, w // 2)
     img = layers.data("image", shape=list(image_shape), dtype="float32")
     label = layers.data("label", shape=[1], dtype="int64")
-    pred = resnet(img, class_dim, depth, is_test=is_test)
+    pred = resnet(img, class_dim, depth, is_test=is_test, s2d_stem=s2d_stem)
     cost = layers.cross_entropy(pred, label)
     avg_cost = layers.mean(cost)
     acc1 = layers.accuracy(pred, label, k=1)
