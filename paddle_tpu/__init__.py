@@ -77,4 +77,4 @@ def is_compiled_with_tpu():
     return any(d.platform in ("tpu", "axon") for d in jax.devices())
 
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

@@ -24,6 +24,11 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
+# wheel install: the .so is baked into the package by setup.py's
+# build_py hook (no sources, no rebuild — ref ships prebuilt core libs
+# in its wheel the same way)
+_PKG_SO_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "libpaddle_tpu_native.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -41,16 +46,24 @@ def _needs_build() -> bool:
     return False
 
 
+def _locate() -> str:
+    """Prefer the repo-checkout build tree (rebuild on source change);
+    fall back to the .so shipped inside an installed wheel."""
+    if os.path.isdir(os.path.join(_NATIVE_DIR, "src")):
+        if _needs_build():
+            subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                           capture_output=True, text=True)
+        return _SO_PATH
+    return _PKG_SO_PATH
+
+
 def _load():
     global _lib, _build_error
     with _lib_lock:
         if _lib is not None or _build_error is not None:
             return _lib
         try:
-            if _needs_build():
-                subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
-                               capture_output=True, text=True)
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(_locate())
         except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
             _build_error = getattr(e, "stderr", None) or str(e)
             return None

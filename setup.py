@@ -1,0 +1,38 @@
+"""Wheel build with the native host runtime baked in (ref
+``python/setup.py.in``: the reference compiles its C++ core via CMake and
+packages the resulting libraries into the wheel; here the native C-ABI
+library is built with the repo Makefile and shipped as package data).
+
+Building the .so is best-effort: a wheel built on a machine without g++
+still works — ``paddle_tpu.native.available()`` reports False and every
+consumer falls back to pure Python.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        native_dir = os.path.join(ROOT, "native")
+        so = os.path.join(native_dir, "libpaddle_tpu_native.so")
+        if os.path.isdir(os.path.join(native_dir, "src")):
+            try:
+                subprocess.run(["make", "-s"], cwd=native_dir, check=True)
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                print(f"WARNING: native build failed ({e}); wheel will "
+                      "use the pure-Python fallbacks")
+        if os.path.exists(so):
+            dst = os.path.join(ROOT, "paddle_tpu", "native",
+                               "libpaddle_tpu_native.so")
+            shutil.copy2(so, dst)
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})

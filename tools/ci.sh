@@ -18,6 +18,19 @@ JAX_PLATFORMS=cpu python tools/export_demo_program.py "$tmp"
 ./native/demo_trainer "$tmp"
 rm -rf "$tmp"
 
+echo "== wheel build + clean-venv install_check =="
+wheeldir=$(mktemp -d); venvdir=$(mktemp -d)
+pip wheel . -w "$wheeldir" --no-deps --no-build-isolation -q
+python -m venv "$venvdir"
+# zero-egress image: deps (jax/numpy/...) come from the base env via a
+# .pth, not the index — the wheel itself installs clean
+sitedir=$("$venvdir/bin/python" -c 'import site; print(site.getsitepackages()[0])')
+python -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])' > "$sitedir/_basedeps.pth"
+"$venvdir/bin/pip" install -q --no-deps "$wheeldir"/paddle_tpu-*.whl
+(cd "$venvdir" && JAX_PLATFORMS=cpu "$venvdir/bin/python" -c \
+    "import paddle_tpu; paddle_tpu.install_check.run_check()")
+rm -rf "$wheeldir" "$venvdir"
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 
