@@ -29,6 +29,7 @@ from .average import WeightedAverage  # noqa
 from . import device_worker, trainer_desc, trainer_factory  # noqa
 from . import dygraph  # noqa
 from . import io  # noqa
+from . import memory  # noqa
 from . import native  # noqa
 from . import profiler  # noqa
 from . import data  # noqa

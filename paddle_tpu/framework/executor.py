@@ -418,11 +418,22 @@ class Executor:
             program.random_seed * 1000003 + self._step_seed)
         try:
             fetches, new_rw = cb(feeds, ro_vals, rw_vals, jnp.uint32(seed_val))
-        except Exception:
+        except Exception as e:
             # never cache a block whose trace failed (a later run with a
             # fixed scope/feed must re-lower)
             with self._lock:
                 self._cache.pop(key, None)
+            from .. import memory as _memory
+            if _memory._is_oom_error(e):
+                # an on-chip OOM is a raw XLA error; attach what was
+                # actually resident (ref retry_allocator/facade stats
+                # surface the same information on CUDA OOM)
+                try:
+                    wrapped = type(e)(f"{e}\n\n{_memory.summary(scope)}")
+                except Exception:
+                    wrapped = RuntimeError(
+                        f"{e}\n\n{_memory.summary(scope)}")
+                raise wrapped from e
             raise
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
