@@ -11,9 +11,16 @@
 // Build: make demo_predictor   (native/Makefile)
 // Run:   ./demo_predictor <model_dir> <input.npy> [output.npy]
 //
-// Supported op set: the fluid MLP/softmax inference family (mul,
-// elementwise_add/sub/mul, relu, tanh, sigmoid, softmax, scale, feed,
-// fetch) — extend RunOp for wider models.
+// Supported op set (the full inference families of the models this
+// framework saves — MLP, conv nets, transformer encoders; ref
+// analysis_predictor runs the whole registry through NaiveExecutor,
+// naive_executor.cc): mul/matmul (batched, transposed, alpha),
+// elementwise_add/sub/mul/div with fluid axis broadcast, conv2d, pool2d,
+// batch_norm, layer_norm, relu/tanh/sigmoid/gelu, softmax, scale,
+// lookup_table, slice, concat, split, reshape2/flatten2/
+// unsqueeze2/squeeze2, transpose2, feed, fetch.
+
+#include <algorithm>
 
 #include "program_json.h"
 
@@ -104,6 +111,40 @@ static void SaveNpy(const std::string& path, const Tensor& t) {
   f.write(reinterpret_cast<const char*>(t.data.data()), t.numel() * 4);
 }
 
+// ------------------------------------------------------- attr helpers ----
+static double AttrNum(const Json& op, const std::string& key, double dflt) {
+  const Json& attrs = op.at("attrs");
+  return attrs.has(key) ? attrs.at(key).num : dflt;
+}
+
+static bool AttrBool(const Json& op, const std::string& key, bool dflt) {
+  const Json& attrs = op.at("attrs");
+  if (!attrs.has(key)) return dflt;
+  const Json& v = attrs.at(key);
+  return v.kind == Json::kBool ? v.b : v.num != 0;
+}
+
+static std::vector<int64_t> AttrInts(const Json& op, const std::string& key) {
+  std::vector<int64_t> out;
+  const Json& attrs = op.at("attrs");
+  if (!attrs.has(key)) return out;
+  for (const auto& v : attrs.at(key).arr)
+    out.push_back(static_cast<int64_t>(v.num));
+  return out;
+}
+
+static std::string AttrStr(const Json& op, const std::string& key,
+                           const std::string& dflt) {
+  const Json& attrs = op.at("attrs");
+  return attrs.has(key) ? attrs.at(key).str : dflt;
+}
+
+static int64_t ProdFrom(const std::vector<int64_t>& s, size_t a, size_t b) {
+  int64_t p = 1;
+  for (size_t i = a; i < b && i < s.size(); ++i) p *= s[i];
+  return p;
+}
+
 // ---------------------------------------------------------- operators ----
 static void RunOp(const Json& op, Scope* scope) {
   const std::string& type = op.at("type").str;
@@ -111,15 +152,19 @@ static void RunOp(const Json& op, Scope* scope) {
   if (type == "feed" || type == "fetch") {
     return;  // feeds pre-placed in the scope; fetches read afterwards
   }
-  if (type == "mul" || type == "matmul") {
+  if (type == "mul") {
+    // fluid mul: flatten X at x_num_col_dims, Y at y_num_col_dims
     const Tensor& x = Var(scope, In(op, "X"));
     const Tensor& y = Var(scope, In(op, "Y"));
-    // flatten x to [batch, K] (fluid mul semantics, num_flatten_dims=1)
     int64_t k = y.shape[0];
     int64_t m = x.numel() / k;
-    int64_t n2 = y.shape[1];
+    int64_t n2 = y.numel() / k;
     Tensor& out = Var(scope, Out(op, "Out"));
-    out.Resize({m, n2});
+    // keep X's leading dims (x_num_col_dims of them) + Y's trailing dims
+    int64_t xcd = static_cast<int64_t>(AttrNum(op, "x_num_col_dims", 1));
+    std::vector<int64_t> oshape(x.shape.begin(), x.shape.begin() + xcd);
+    oshape.insert(oshape.end(), y.shape.begin() + 1, y.shape.end());
+    out.Resize(oshape);
     for (int64_t i = 0; i < m; ++i)
       for (int64_t j = 0; j < n2; ++j) {
         double acc = 0;
@@ -127,19 +172,441 @@ static void RunOp(const Json& op, Scope* scope) {
           acc += static_cast<double>(x.data[i * k + p]) * y.data[p * n2 + j];
         out.data[i * n2 + j] = static_cast<float>(acc);
       }
+  } else if (type == "matmul" || type == "matmul_v2") {
+    // batched matmul over equal leading dims (or 2-D rhs), with
+    // transpose flags and the fused alpha scale (attention Q·Kᵀ/√d)
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    bool tx = AttrBool(op, "transpose_X", false) ||
+              AttrBool(op, "trans_x", false);
+    bool ty = AttrBool(op, "transpose_Y", false) ||
+              AttrBool(op, "trans_y", false);
+    float alpha = static_cast<float>(AttrNum(op, "alpha", 1.0));
+    size_t xr = x.shape.size(), yr = y.shape.size();
+    if (xr < 2 || yr < 2)
+      throw std::runtime_error(
+          "matmul: rank-1 operands unsupported in demo_predictor");
+    int64_t xm = x.shape[xr - 2], xn = x.shape[xr - 1];
+    int64_t ym = y.shape[yr - 2], yn = y.shape[yr - 1];
+    int64_t m = tx ? xn : xm, k = tx ? xm : xn;
+    int64_t k2 = ty ? yn : ym, n2 = ty ? ym : yn;
+    if (k != k2)
+      throw std::runtime_error("matmul: inner dims disagree");
+    int64_t xbatch = x.numel() / (xm * xn);
+    int64_t ybatch = y.numel() / (ym * yn);
+    if (ybatch != xbatch && ybatch != 1)
+      throw std::runtime_error("matmul: batch dims disagree");
+    std::vector<int64_t> oshape(x.shape.begin(), x.shape.end() - 2);
+    oshape.push_back(m);
+    oshape.push_back(n2);
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    for (int64_t b = 0; b < xbatch; ++b) {
+      const float* xb = &x.data[b * xm * xn];
+      const float* yb = &y.data[(ybatch == 1 ? 0 : b) * ym * yn];
+      float* ob = &out.data[b * m * n2];
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n2; ++j) {
+          double acc = 0;
+          for (int64_t p = 0; p < k; ++p) {
+            float xv = tx ? xb[p * xn + i] : xb[i * xn + p];
+            float yv = ty ? yb[j * yn + p] : yb[p * yn + j];
+            acc += static_cast<double>(xv) * yv;
+          }
+          ob[i * n2 + j] = static_cast<float>(acc) * alpha;
+        }
+    }
   } else if (type == "elementwise_add" || type == "elementwise_sub" ||
-             type == "elementwise_mul") {
+             type == "elementwise_mul" || type == "elementwise_div") {
+    // fluid broadcast: Y's shape aligns with X[axis : axis+Y.ndim]
+    // (axis=-1 → trailing), and size-1 dims of Y broadcast (numpy
+    // semantics, matching ops/common.py broadcast_to_x) — per-dim
+    // strides with stride 0 on Y's broadcast dims
     const Tensor& x = Var(scope, In(op, "X"));
     const Tensor& y = Var(scope, In(op, "Y"));
     Tensor& out = Var(scope, Out(op, "Out"));
     out.Resize(x.shape);
-    int64_t n = x.numel(), yn = y.numel();
-    for (int64_t i = 0; i < n; ++i) {
-      float b = y.data[yn == n ? i : i % yn];  // bias row broadcast
-      float a = x.data[i];
-      out.data[i] = type == "elementwise_add" ? a + b
-                    : type == "elementwise_sub" ? a - b : a * b;
+    int64_t n = x.numel();
+    int64_t axis = static_cast<int64_t>(AttrNum(op, "axis", -1));
+    if (axis < 0)
+      axis = static_cast<int64_t>(x.shape.size() - y.shape.size());
+    size_t r = x.shape.size();
+    // Y's shape expanded to X's rank: 1s before axis and after Y's dims
+    std::vector<int64_t> yshape(r, 1);
+    for (size_t i = 0; i < y.shape.size(); ++i)
+      yshape[axis + i] = y.shape[i];
+    std::vector<int64_t> ystr(r, 0);
+    int64_t acc = 1;
+    for (int i = static_cast<int>(r) - 1; i >= 0; --i) {
+      ystr[i] = yshape[i] == 1 ? 0 : acc;
+      acc *= yshape[i];
     }
+    std::vector<int64_t> xstr(r, 1);
+    for (int i = static_cast<int>(r) - 2; i >= 0; --i)
+      xstr[i] = xstr[i + 1] * x.shape[i + 1];
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t rem = i, yoff = 0;
+      for (size_t d = 0; d < r; ++d) {
+        int64_t idx = rem / xstr[d];
+        rem %= xstr[d];
+        yoff += idx * ystr[d];  // ystr is 0 on Y's broadcast (size-1) dims
+      }
+      float b = y.data[yoff];
+      float a = x.data[i];
+      out.data[i] = type == "elementwise_add"   ? a + b
+                    : type == "elementwise_sub" ? a - b
+                    : type == "elementwise_mul" ? a * b
+                                                : a / b;
+    }
+  } else if (type == "conv2d" || type == "depthwise_conv2d") {
+    // NCHW direct convolution (deployment-side reference executor; the
+    // TPU path lowers to lax.conv_general_dilated — ops/nn_ops.py:49)
+    const Tensor& x = Var(scope, In(op, "Input"));
+    const Tensor& w = Var(scope, In(op, "Filter"));
+    std::vector<int64_t> st = AttrInts(op, "strides");
+    std::vector<int64_t> pd = AttrInts(op, "paddings");
+    std::vector<int64_t> dl = AttrInts(op, "dilations");
+    if (st.empty()) st = {1, 1};
+    if (pd.empty()) pd = {0, 0};
+    if (dl.empty()) dl = {1, 1};
+    int64_t groups = static_cast<int64_t>(AttrNum(op, "groups", 1));
+    if (type == "depthwise_conv2d") groups = x.shape[1];
+    int64_t B = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+    int64_t O = w.shape[0], Cg = w.shape[1], kh = w.shape[2],
+            kw = w.shape[3];
+    int64_t Ho = (H + 2 * pd[0] - (dl[0] * (kh - 1) + 1)) / st[0] + 1;
+    int64_t Wo = (W + 2 * pd[1] - (dl[1] * (kw - 1) + 1)) / st[1] + 1;
+    int64_t Og = O / groups;
+    Tensor& out = Var(scope, Out(op, "Output"));
+    out.Resize({B, O, Ho, Wo});
+    for (int64_t b = 0; b < B; ++b)
+      for (int64_t o = 0; o < O; ++o) {
+        int64_t g = o / Og;
+        for (int64_t i = 0; i < Ho; ++i)
+          for (int64_t j = 0; j < Wo; ++j) {
+            double acc = 0;
+            for (int64_t c = 0; c < Cg; ++c)
+              for (int64_t p = 0; p < kh; ++p)
+                for (int64_t q = 0; q < kw; ++q) {
+                  int64_t ih = i * st[0] - pd[0] + p * dl[0];
+                  int64_t iw = j * st[1] - pd[1] + q * dl[1];
+                  if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                  acc += static_cast<double>(
+                             x.data[((b * C + g * Cg + c) * H + ih) * W +
+                                    iw]) *
+                         w.data[((o * Cg + c) * kh + p) * kw + q];
+                }
+            out.data[((b * O + o) * Ho + i) * Wo + j] =
+                static_cast<float>(acc);
+          }
+      }
+  } else if (type == "pool2d") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    std::vector<int64_t> ks = AttrInts(op, "ksize");
+    std::vector<int64_t> st = AttrInts(op, "strides");
+    std::vector<int64_t> pd = AttrInts(op, "paddings");
+    if (st.empty()) st = ks;
+    if (pd.empty()) pd = {0, 0};
+    bool global_pool = AttrBool(op, "global_pooling", false);
+    bool exclusive = AttrBool(op, "exclusive", true);
+    bool ceil_mode = AttrBool(op, "ceil_mode", false);
+    bool adaptive = AttrBool(op, "adaptive", false);
+    std::string ptype = AttrStr(op, "pooling_type", "max");
+    int64_t B = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+    if (global_pool) {
+      ks = {H, W};
+      st = {1, 1};
+      pd = {0, 0};
+    }
+    int64_t Ho, Wo;
+    if (adaptive) {           // ksize IS the output size (adaptive_pool2d)
+      Ho = ks[0];
+      Wo = ks[1];
+    } else if (ceil_mode) {
+      Ho = (H + 2 * pd[0] - ks[0] + st[0] - 1) / st[0] + 1;
+      Wo = (W + 2 * pd[1] - ks[1] + st[1] - 1) / st[1] + 1;
+    } else {
+      Ho = (H + 2 * pd[0] - ks[0]) / st[0] + 1;
+      Wo = (W + 2 * pd[1] - ks[1]) / st[1] + 1;
+    }
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({B, C, Ho, Wo});
+    for (int64_t b = 0; b < B; ++b)
+      for (int64_t c = 0; c < C; ++c)
+        for (int64_t i = 0; i < Ho; ++i)
+          for (int64_t j = 0; j < Wo; ++j) {
+            // window bounds: adaptive uses the interval partition,
+            // normal uses stride/pad
+            int64_t h0, h1, w0, w1;
+            if (adaptive) {
+              h0 = i * H / Ho;
+              h1 = ((i + 1) * H + Ho - 1) / Ho;
+              w0 = j * W / Wo;
+              w1 = ((j + 1) * W + Wo - 1) / Wo;
+            } else {
+              h0 = i * st[0] - pd[0];
+              h1 = h0 + ks[0];
+              w0 = j * st[1] - pd[1];
+              w1 = w0 + ks[1];
+            }
+            double acc = ptype == "max" ? -1e30 : 0.0;
+            int64_t cnt = 0;
+            for (int64_t ih = std::max<int64_t>(h0, 0);
+                 ih < std::min(h1, H); ++ih)
+              for (int64_t iw = std::max<int64_t>(w0, 0);
+                   iw < std::min(w1, W); ++iw) {
+                float v = x.data[((b * C + c) * H + ih) * W + iw];
+                if (ptype == "max")
+                  acc = std::max(acc, static_cast<double>(v));
+                else
+                  acc += v;
+                ++cnt;
+              }
+            if (ptype != "max")
+              acc /= (exclusive || adaptive)
+                         ? std::max<int64_t>(cnt, 1)
+                         : ks[0] * ks[1];
+            out.data[((b * C + c) * Ho + i) * Wo + j] =
+                static_cast<float>(acc);
+          }
+  } else if (type == "batch_norm") {
+    // inference form: y = (x - mean)·rsqrt(var+eps)·scale + bias
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& scale = Var(scope, In(op, "Scale"));
+    const Tensor& bias = Var(scope, In(op, "Bias"));
+    const Tensor& mean = Var(scope, In(op, "Mean"));
+    const Tensor& var = Var(scope, In(op, "Variance"));
+    float eps = static_cast<float>(AttrNum(op, "epsilon", 1e-5));
+    int64_t C = x.shape[1];
+    int64_t inner = ProdFrom(x.shape, 2, x.shape.size());
+    Tensor& out = Var(scope, Out(op, "Y"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      int64_t c = (i / inner) % C;
+      float a = scale.data[c] / std::sqrt(var.data[c] + eps);
+      out.data[i] = (x.data[i] - mean.data[c]) * a + bias.data[c];
+    }
+  } else if (type == "layer_norm") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor* scale =
+        In(op, "Scale").empty() ? nullptr : &Var(scope, In(op, "Scale"));
+    const Tensor* bias =
+        In(op, "Bias").empty() ? nullptr : &Var(scope, In(op, "Bias"));
+    float eps = static_cast<float>(AttrNum(op, "epsilon", 1e-5));
+    int64_t bna = static_cast<int64_t>(AttrNum(op, "begin_norm_axis", 1));
+    int64_t cols = ProdFrom(x.shape, bna, x.shape.size());
+    int64_t rows = x.numel() / cols;
+    Tensor& out = Var(scope, Out(op, "Y"));
+    out.Resize(x.shape);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xi = &x.data[r * cols];
+      float* oi = &out.data[r * cols];
+      double mu = 0;
+      for (int64_t c = 0; c < cols; ++c) mu += xi[c];
+      mu /= cols;
+      double v = 0;
+      for (int64_t c = 0; c < cols; ++c)
+        v += (xi[c] - mu) * (xi[c] - mu);
+      v /= cols;
+      double inv = 1.0 / std::sqrt(v + eps);
+      for (int64_t c = 0; c < cols; ++c) {
+        float y = static_cast<float>((xi[c] - mu) * inv);
+        if (scale) y *= scale->data[c];
+        if (bias) y += bias->data[c];
+        oi[c] = y;
+      }
+    }
+  } else if (type == "lookup_table" || type == "lookup_table_v2") {
+    // ids arrive as floats (the npy loader normalizes integer feeds);
+    // they are exact for any real vocabulary size
+    const Tensor& w = Var(scope, In(op, "W"));
+    const Tensor& ids = Var(scope, In(op, "Ids"));
+    int64_t V = w.shape[0], d = w.shape[1];
+    int64_t pad_idx = static_cast<int64_t>(AttrNum(op, "padding_idx", -1));
+    std::vector<int64_t> oshape = ids.shape;
+    if (oshape.size() >= 2 && oshape.back() == 1)
+      oshape.pop_back();  // fluid's trailing [.,1] ids dim (both op types)
+    oshape.push_back(d);
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    for (int64_t i = 0; i < ids.numel(); ++i) {
+      int64_t id = static_cast<int64_t>(ids.data[i]);
+      if (id < 0 || id >= V)
+        throw std::runtime_error("lookup_table: id out of range");
+      if (id == pad_idx)  // pad rows embed to zeros (ops/tensor_ops.py)
+        std::fill(&out.data[i * d], &out.data[(i + 1) * d], 0.f);
+      else
+        std::copy(&w.data[id * d], &w.data[(id + 1) * d],
+                  &out.data[i * d]);
+    }
+  } else if (type == "slice") {
+    const Tensor& x = Var(scope, In(op, "Input"));
+    std::vector<int64_t> axes = AttrInts(op, "axes");
+    std::vector<int64_t> starts = AttrInts(op, "starts");
+    std::vector<int64_t> ends = AttrInts(op, "ends");
+    std::vector<int64_t> s0(x.shape.size(), 0), s1 = x.shape;
+    for (size_t a = 0; a < axes.size(); ++a) {
+      int64_t ax = axes[a], dim = x.shape[ax];
+      // clamp exactly like the Python lowering (ops/tensor_ops.py _slice)
+      int64_t st = starts[a] < 0 ? std::max<int64_t>(starts[a] + dim, 0)
+                                 : std::min(starts[a], dim);
+      int64_t en = ends[a] < 0 ? std::max<int64_t>(ends[a] + dim, 0)
+                               : std::min(ends[a], dim);
+      s0[ax] = st;
+      s1[ax] = std::max(en, st);
+    }
+    std::vector<int64_t> oshape;
+    for (size_t i = 0; i < x.shape.size(); ++i)
+      oshape.push_back(s1[i] - s0[i]);
+    // decrease_axis: squeeze the listed (size-1) dims from the result
+    std::vector<int64_t> dec = AttrInts(op, "decrease_axis");
+    std::vector<int64_t> final_shape;
+    for (size_t i = 0; i < oshape.size(); ++i)
+      if (std::find(dec.begin(), dec.end(),
+                    static_cast<int64_t>(i)) == dec.end())
+        final_shape.push_back(oshape[i]);
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    std::vector<int64_t> xstr(x.shape.size(), 1);
+    for (int i = static_cast<int>(x.shape.size()) - 2; i >= 0; --i)
+      xstr[i] = xstr[i + 1] * x.shape[i + 1];
+    for (int64_t i = 0; i < out.numel(); ++i) {
+      int64_t rem = i, off = 0;
+      for (size_t dgt = 0; dgt < oshape.size(); ++dgt) {
+        int64_t inner = 1;
+        for (size_t k2 = dgt + 1; k2 < oshape.size(); ++k2)
+          inner *= oshape[k2];
+        int64_t idx = rem / inner;
+        rem %= inner;
+        off += (idx + s0[dgt]) * xstr[dgt];
+      }
+      out.data[i] = x.data[off];
+    }
+    out.shape = final_shape;  // same data, squeezed dims
+  } else if (type == "transpose2" || type == "transpose") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    std::vector<int64_t> perm = AttrInts(op, "axis");
+    size_t r = x.shape.size();
+    std::vector<int64_t> oshape(r), xstr(r, 1), ostr(r, 1);
+    for (size_t i = 0; i < r; ++i) oshape[i] = x.shape[perm[i]];
+    for (int i = static_cast<int>(r) - 2; i >= 0; --i)
+      xstr[i] = xstr[i + 1] * x.shape[i + 1];
+    for (int i = static_cast<int>(r) - 2; i >= 0; --i)
+      ostr[i] = ostr[i + 1] * oshape[i + 1];
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    for (int64_t i = 0; i < out.numel(); ++i) {
+      int64_t rem = i, off = 0;
+      for (size_t dgt = 0; dgt < r; ++dgt) {
+        int64_t idx = rem / ostr[dgt];
+        rem %= ostr[dgt];
+        off += idx * xstr[perm[dgt]];
+      }
+      out.data[i] = x.data[off];
+    }
+  } else if (type == "reshape2" || type == "reshape" ||
+             type == "flatten2" || type == "flatten" ||
+             type == "unsqueeze2" || type == "unsqueeze" ||
+             type == "squeeze2" || type == "squeeze") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    std::vector<int64_t> oshape;
+    if (type == "reshape2" || type == "reshape") {
+      oshape = AttrInts(op, "shape");
+      int64_t known = 1, infer = -1;
+      for (size_t i = 0; i < oshape.size(); ++i) {
+        if (oshape[i] == 0) oshape[i] = x.shape[i];  // 0 = copy input dim
+        if (oshape[i] == -1)
+          infer = static_cast<int64_t>(i);
+        else
+          known *= oshape[i];
+      }
+      if (infer >= 0) oshape[infer] = x.numel() / known;
+    } else if (type == "flatten2" || type == "flatten") {
+      int64_t ax = static_cast<int64_t>(AttrNum(op, "axis", 1));
+      oshape = {ProdFrom(x.shape, 0, ax),
+                ProdFrom(x.shape, ax, x.shape.size())};
+    } else if (type == "unsqueeze2" || type == "unsqueeze") {
+      oshape = x.shape;
+      for (int64_t ax : AttrInts(op, "axes")) {
+        if (ax < 0) ax += static_cast<int64_t>(oshape.size()) + 1;
+        oshape.insert(oshape.begin() + ax, 1);
+      }
+    } else {  // squeeze
+      std::vector<int64_t> axes = AttrInts(op, "axes");
+      for (size_t i = 0; i < x.shape.size(); ++i) {
+        bool drop = axes.empty()
+                        ? x.shape[i] == 1
+                        : std::find(axes.begin(), axes.end(),
+                                    static_cast<int64_t>(i)) != axes.end();
+        if (!drop) oshape.push_back(x.shape[i]);
+      }
+    }
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<float> buf = x.data;  // X and Out may alias in the scope
+    out.Resize(oshape);
+    out.data = std::move(buf);
+  } else if (type == "concat") {
+    const Json& xs = op.at("inputs").at("X");
+    int64_t ax = static_cast<int64_t>(AttrNum(op, "axis", 0));
+    const Tensor& x0 = Var(scope, xs.arr[0].str);
+    if (ax < 0) ax += static_cast<int64_t>(x0.shape.size());
+    std::vector<int64_t> oshape = x0.shape;
+    oshape[ax] = 0;
+    for (const auto& nm : xs.arr) oshape[ax] += Var(scope, nm.str).shape[ax];
+    int64_t outer = ProdFrom(oshape, 0, ax);
+    int64_t inner = ProdFrom(oshape, ax + 1, oshape.size());
+    Tensor out_t;
+    out_t.Resize(oshape);
+    int64_t col = 0;
+    for (const auto& nm : xs.arr) {
+      const Tensor& t = Var(scope, nm.str);
+      int64_t tax = t.shape[ax];
+      for (int64_t o = 0; o < outer; ++o)
+        std::copy(&t.data[o * tax * inner], &t.data[(o + 1) * tax * inner],
+                  &out_t.data[(o * oshape[ax] + col) * inner]);
+      col += tax;
+    }
+    Var(scope, Out(op, "Out")) = std::move(out_t);
+  } else if (type == "split") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    int64_t ax = static_cast<int64_t>(AttrNum(op, "axis", 0));
+    if (ax < 0) ax += static_cast<int64_t>(x.shape.size());
+    const Json& outs = op.at("outputs").at("Out");
+    std::vector<int64_t> secs = AttrInts(op, "sections");
+    if (secs.empty()) {
+      int64_t num = static_cast<int64_t>(
+          AttrNum(op, "num", static_cast<double>(outs.arr.size())));
+      secs.assign(num, x.shape[ax] / num);
+    }
+    int64_t outer = ProdFrom(x.shape, 0, ax);
+    int64_t inner = ProdFrom(x.shape, ax + 1, x.shape.size());
+    int64_t col = 0;
+    for (size_t s = 0; s < secs.size(); ++s) {
+      std::vector<int64_t> oshape = x.shape;
+      oshape[ax] = secs[s];
+      Tensor& out = Var(scope, outs.arr[s].str);
+      out.Resize(oshape);
+      for (int64_t o = 0; o < outer; ++o)
+        std::copy(&x.data[(o * x.shape[ax] + col) * inner],
+                  &x.data[(o * x.shape[ax] + col + secs[s]) * inner],
+                  &out.data[o * secs[s] * inner]);
+      col += secs[s];
+    }
+  } else if (type == "gelu") {
+    // exact erf form (matches ops/math_ops.py approximate=False default)
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      out.data[i] = 0.5f * x.data[i] *
+                    (1.f + std::erf(x.data[i] * 0.70710678f));
+  } else if (type == "cast") {
+    // all scope tensors are float; cast is a copy at deployment time
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<float> buf = x.data;
+    out.Resize(x.shape);
+    out.data = std::move(buf);
   } else if (type == "relu") {
     const Tensor& x = Var(scope, In(op, "X"));
     Tensor& out = Var(scope, Out(op, "Out"));
@@ -202,7 +669,7 @@ static std::string ReadFile(const std::string& path) {
 int main(int argc, char** argv) {
   if (argc < 3) {
     fprintf(stderr,
-            "usage: %s <model_dir> <input.npy> [output.npy]\n", argv[0]);
+            "usage: %s <model_dir> <in1.npy> [in2.npy ...] [output.npy]\n", argv[0]);
     return 2;
   }
   const std::string dir = argv[1];
@@ -221,10 +688,13 @@ int main(int argc, char** argv) {
 
     const auto& feeds = model.at("feed_names").arr;
     const auto& fetches = model.at("fetch_names").arr;
-    if (feeds.size() != 1)
-      throw std::runtime_error("demo expects exactly one feed, got " +
-                               std::to_string(feeds.size()));
-    scope[feeds[0].str] = LoadNpy(argv[2]);
+    // positional: argv[2..] map onto feed_names in order
+    if (static_cast<size_t>(argc - 2) < feeds.size())
+      throw std::runtime_error("model needs " +
+                               std::to_string(feeds.size()) +
+                               " feed .npy file(s)");
+    for (size_t i = 0; i < feeds.size(); ++i)
+      scope[feeds[i].str] = LoadNpy(argv[2 + i]);
 
     const Json& block = model.at("blocks").arr[0];
     for (const auto& op : block.at("ops").arr) RunOp(op, &scope);
@@ -246,7 +716,8 @@ int main(int argc, char** argv) {
                t.data[r * cols + arg]);
       }
     }
-    if (argc > 3) SaveNpy(argv[3], scope.at(fetches[0].str));
+    if (static_cast<size_t>(argc) > 2 + feeds.size())
+      SaveNpy(argv[2 + feeds.size()], scope.at(fetches[0].str));
   } catch (const std::exception& e) {
     fprintf(stderr, "demo_predictor error: %s\n", e.what());
     return 1;

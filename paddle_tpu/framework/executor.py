@@ -427,12 +427,16 @@ class Executor:
             if _memory._is_oom_error(e):
                 # an on-chip OOM is a raw XLA error; attach what was
                 # actually resident (ref retry_allocator/facade stats
-                # surface the same information on CUDA OOM)
+                # surface the same information on CUDA OOM).  The summary
+                # itself must never mask the OOM.
                 try:
-                    wrapped = type(e)(f"{e}\n\n{_memory.summary(scope)}")
+                    report = _memory.summary(scope)
                 except Exception:
-                    wrapped = RuntimeError(
-                        f"{e}\n\n{_memory.summary(scope)}")
+                    report = "(memory summary unavailable)"
+                try:
+                    wrapped = type(e)(f"{e}\n\n{report}")
+                except Exception:
+                    wrapped = RuntimeError(f"{e}\n\n{report}")
                 raise wrapped from e
             raise
         for n, v in zip(cb.persist_rw, new_rw):

@@ -63,19 +63,137 @@ def test_cpp_predictor_matches_python(tmp_path):
 
 def test_cpp_predictor_rejects_unknown_op(tmp_path):
     """Clear failure (not garbage output) on models beyond the op set."""
-    model_dir = str(tmp_path / "conv_model")
+    model_dir = str(tmp_path / "erf_model")
     scope = Scope()
     with scope_guard(scope), program_guard(Program(), Program()):
-        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
-        conv = layers.conv2d(img, num_filters=2, filter_size=3)
+        x = layers.data("x", shape=[8], dtype="float32")
+        out = layers.erf(x)
         exe = Executor()
         exe.run(fluid.default_startup_program(), scope=scope)
-        fluid.io.save_inference_model(model_dir, ["img"], [conv],
+        fluid.io.save_inference_model(model_dir, ["x"], [out],
                                       executor=exe, scope=scope)
     binary = _build_binary()
-    x = np.zeros((1, 1, 8, 8), np.float32)
-    np.save(str(tmp_path / "x.npy"), x)
+    xv = np.zeros((1, 8), np.float32)
+    np.save(str(tmp_path / "x.npy"), xv)
     r = subprocess.run([binary, model_dir, str(tmp_path / "x.npy")],
                        capture_output=True, text=True, timeout=120)
     assert r.returncode != 0
     assert "unsupported op" in r.stderr
+
+
+def test_cpp_predictor_runs_mnist_conv(tmp_path):
+    """A saved conv net (conv/pool/bn/flatten/fc families — the MNIST book
+    recipe) served natively, matching the Python executor (VERDICT r2 #5)."""
+    model_dir = str(tmp_path / "mnist_conv")
+    rng = np.random.RandomState(3)
+    xv = rng.rand(4, 1, 28, 28).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+        c1 = layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
+        p1 = layers.pool2d(c1, pool_size=2, pool_stride=2)
+        bn = layers.batch_norm(p1, is_test=True)
+        c2 = layers.conv2d(bn, num_filters=16, filter_size=5, padding=2,
+                           stride=2, act="relu")
+        p2 = layers.pool2d(c2, pool_size=2, pool_stride=2, pool_type="avg")
+        pred = layers.fc(layers.flatten(p2), size=10, act="softmax")
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=5)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"img": xv}, fetch_list=[pred.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["img"], [pred],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "input.npy"), xv)
+    out_npy = str(tmp_path / "output.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "input.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_runs_bert_encoder(tmp_path):
+    """A saved transformer encoder (embedding/layer_norm/attention matmul/
+    split/transpose/gelu families) served natively — the BERT inference
+    artifact the framework actually produces (VERDICT r2 #5)."""
+    from paddle_tpu.models import transformer as T
+
+    model_dir = str(tmp_path / "bert_enc")
+    B, S = 2, 16
+    rng = np.random.RandomState(7)
+    ids = rng.randint(1, 120, (B, S)).astype(np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        cfg = T.BertConfig(vocab_size=128, d_model=32, n_layer=2,
+                           n_head=2, d_inner=64, max_pos=32)
+        feeds, logits, loss = T.build_bert_pretrain(
+            cfg, S, is_test=True, dropout=0.0, arange_pos=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=9)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"src_ids": ids,
+                                  "lm_label": np.zeros_like(ids)},
+                            fetch_list=[logits.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["src_ids"], [logits],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "ids.npy"), ids)
+    out_npy = str(tmp_path / "logits.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "ids.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_predictor_edge_semantics(tmp_path):
+    """Edge cases that must match the Python executor exactly (r3 review):
+    embedding padding_idx→zeros, adaptive avg pool, negative slice
+    bounds, and size-1-dim broadcast in elementwise ops."""
+    model_dir = str(tmp_path / "edge_model")
+    ids = np.array([[0, 3, 1, 0]], dtype=np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        idv = layers.data("ids", shape=[4], dtype="int64")
+        emb = layers.embedding(idv, size=[8, 6], padding_idx=0)   # [B,4,6]
+        img = layers.reshape(emb, shape=[-1, 1, 4, 6])
+        pooled = layers.adaptive_pool2d(img, pool_size=2,
+                                        pool_type="avg")          # [B,1,2,2]
+        sl = layers.slice(emb, axes=[1], starts=[-3], ends=[100]) # clamps
+        # per-channel [C,1,1] bias: interior size-1 broadcast at axis=1
+        bias = layers.create_parameter([1, 1, 1], "float32", name="edge_b")
+        biased = layers.elementwise_add(img, bias, axis=1)
+        out = layers.concat([layers.flatten(pooled), layers.flatten(sl),
+                             layers.flatten(biased)], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=13)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"ids": ids}, fetch_list=[out.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["ids"], [out],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "ids.npy"), ids)
+    out_npy = str(tmp_path / "out.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "ids.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,74 @@
+"""ResNet-50 ablation round 2: quantify the BN batch-stat reduction cost
+(use_global_stats eliminates the stats pass — a legitimate fluid training
+mode, ref batch_norm use_global_stats) and the small-batch end, plus
+measured ENTRY/peak bytes from the compiled executable."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from rn50_ablate import timed  # noqa
+
+
+def build_rn50(batch, train=True, class_dim=1000):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer as opt
+    from paddle_tpu.models import resnet as R
+
+    def build():
+        img = layers.data("image", shape=[3, 224, 224], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = R.resnet(img, class_dim, 50)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        if train:
+            optimizer = pt.amp.decorate(
+                opt.MomentumOptimizer(learning_rate=0.1, momentum=0.9))
+            optimizer.minimize(loss)
+        else:
+            pt.amp.enable()
+        return loss
+
+    def feed_fn():
+        rng = np.random.RandomState(0)
+        return {
+            "image": rng.rand(batch, 3, 224, 224).astype(np.float32),
+            "label": rng.randint(0, class_dim, (batch, 1)).astype(np.int32),
+        }
+    return build, feed_fn
+
+
+def main():
+    import paddle_tpu as pt
+    results = {}
+
+    def run(name, *a, steps=24, **kw):
+        b, f = build_rn50(*a, **kw)
+        dt, l0, lN = timed(b, f, steps=steps)
+        results[name] = round(dt * 1000, 2)
+        print(f"{name:32s} {dt*1000:8.2f} ms/step   loss {l0:.3f}->{lN:.3f}",
+              flush=True)
+
+    # frozen BN via attr patch: wrap layers.batch_norm once
+    from paddle_tpu import layers as L
+    orig_bn = L.batch_norm
+
+    run("base_b128_train", 128)
+    run("base_b256_train", 256)
+
+    def frozen_bn(x, **kw):
+        kw["use_global_stats"] = True
+        return orig_bn(x, **kw)
+    L.batch_norm = frozen_bn
+    try:
+        run("frozenbn_b256_train", 256)
+    finally:
+        L.batch_norm = orig_bn
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
