@@ -15,6 +15,11 @@
 //   response: u64 payload_len | payload
 // ops: 0 PUT  1 GET  2 PUSH_DENSE  3 BARRIER  4 PUSH_SPARSE  5 GET_ROWS
 //      6 STOP 7 GET_NOBARRIER
+// typed ops (8 PUT_TYPED 9 GET_TYPED 10 PUSH_TYPED) carry one extra u8
+// dtype right after the op byte and move raw element bytes (ref
+// send_recv.proto.in:47 VariableMessage.dtype): bf16 tables ride the
+// wire at half the bytes with an f32 master copy server-side; int64
+// tables (CTR frequency counters) are exact end to end.
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -46,14 +51,39 @@ enum Op : uint8_t {
   kGetRows = 5,
   kStop = 6,
   kGetNoBarrier = 7,
+  kPutTyped = 8,
+  kGetTyped = 9,
+  kPushTyped = 10,
 };
 
 enum Optim : int32_t { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
+
+enum Dtype : uint8_t { kF32 = 0, kBF16 = 1, kI64 = 2 };
+
+inline size_t dtype_size(uint8_t d) { return d == kI64 ? 8 : d == kBF16 ? 2 : 4; }
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even on the dropped 16 bits
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7FFFu + lsb;
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
 
 struct Param {
   std::vector<float> value;
   std::vector<float> grad_acc;    // sync-mode accumulator
   std::vector<float> m0, m1;      // optimizer slots
+  std::vector<int64_t> vi64;      // int64 table storage (dtype==kI64)
+  uint8_t dtype = kF32;           // wire dtype (bf16 keeps f32 master)
   int64_t rows = 0;               // >0: sparse table [rows, width]
   int64_t width = 0;
   int optim = kSGD;
@@ -105,6 +135,12 @@ bool send_payload(int fd, const float* data, size_t n_floats) {
   uint64_t len = n_floats * sizeof(float);
   if (!write_full(fd, &len, sizeof(len))) return false;
   return n_floats == 0 || write_full(fd, data, len);
+}
+
+bool send_bytes(int fd, const void* data, size_t n_bytes) {
+  uint64_t len = n_bytes;
+  if (!write_full(fd, &len, sizeof(len))) return false;
+  return n_bytes == 0 || write_full(fd, data, n_bytes);
 }
 
 // Error response: payload_len sentinel of all-ones (a real payload is
@@ -180,6 +216,9 @@ void handle_conn(Server* s, int fd) {
   while (s->running.load()) {
     uint8_t op;
     if (!read_full(fd, &op, 1)) break;
+    uint8_t dtype = kF32;
+    bool typed = op == kPutTyped || op == kGetTyped || op == kPushTyped;
+    if (typed && !read_full(fd, &dtype, 1)) break;
     uint16_t name_len;
     if (!read_full(fd, &name_len, sizeof(name_len))) break;
     std::string name(name_len, '\0');
@@ -188,12 +227,19 @@ void handle_conn(Server* s, int fd) {
     if (!read_full(fd, &n_rows, sizeof(n_rows))) break;
     uint64_t payload_len;
     if (!read_full(fd, &payload_len, sizeof(payload_len))) break;
-    if (payload_len % sizeof(float) != 0 ||
+    if (payload_len % dtype_size(dtype) != 0 ||
         payload_len > (1ull << 34)) break;  // malformed request
     std::vector<uint32_t> rows(n_rows);
     if (n_rows && !read_full(fd, rows.data(), n_rows * 4)) break;
-    std::vector<float> payload(payload_len / sizeof(float));
-    if (payload_len && !read_full(fd, payload.data(), payload_len)) break;
+    std::vector<uint8_t> raw;           // typed ops: raw element bytes
+    std::vector<float> payload;
+    if (typed) {
+      raw.resize(payload_len);
+      if (payload_len && !read_full(fd, raw.data(), payload_len)) break;
+    } else {
+      payload.resize(payload_len / sizeof(float));
+      if (payload_len && !read_full(fd, payload.data(), payload_len)) break;
+    }
 
     if (op == kStop) {
       std::lock_guard<std::mutex> lk(s->mu);
@@ -209,7 +255,7 @@ void handle_conn(Server* s, int fd) {
 
     std::unique_lock<std::mutex> lk(s->mu);
     Param* pp = nullptr;
-    if (op == kPut) {
+    if (op == kPut || op == kPutTyped) {
       pp = &s->table[name];  // PUT registers the table
     } else if (op != kBarrier) {
       // never default-insert on reads/pushes: a misrouted or typo'd name
@@ -300,6 +346,96 @@ void handle_conn(Server* s, int fd) {
                         w * sizeof(float));
         }
         send_payload(fd, out.data(), out.size());
+        break;
+      }
+      case kPutTyped: {
+        p.dtype = dtype;
+        if (dtype == kI64) {
+          p.vi64.assign(
+              reinterpret_cast<const int64_t*>(raw.data()),
+              reinterpret_cast<const int64_t*>(raw.data() + raw.size()));
+          if (p.width == 0) p.width = static_cast<int64_t>(p.vi64.size());
+        } else if (dtype == kBF16) {
+          const uint16_t* src = reinterpret_cast<const uint16_t*>(raw.data());
+          p.value.resize(raw.size() / 2);
+          for (size_t i = 0; i < p.value.size(); i++)
+            p.value[i] = bf16_to_f32(src[i]);  // f32 master server-side
+          if (p.width == 0) p.width = static_cast<int64_t>(p.value.size());
+        } else {
+          p.value.assign(
+              reinterpret_cast<const float*>(raw.data()),
+              reinterpret_cast<const float*>(raw.data() + raw.size()));
+          if (p.width == 0) p.width = static_cast<int64_t>(p.value.size());
+        }
+        send_payload(fd, nullptr, 0);
+        break;
+      }
+      case kGetTyped: {
+        if (dtype != p.dtype) {
+          send_error(fd);
+          break;
+        }
+        if (dtype == kI64) {
+          send_bytes(fd, p.vi64.data(), p.vi64.size() * 8);
+        } else if (dtype == kBF16) {
+          std::vector<uint16_t> out(p.value.size());
+          for (size_t i = 0; i < out.size(); i++)
+            out[i] = f32_to_bf16(p.value[i]);
+          send_bytes(fd, out.data(), out.size() * 2);
+        } else {
+          send_payload(fd, p.value.data(), p.value.size());
+        }
+        break;
+      }
+      case kPushTyped: {
+        if (dtype != p.dtype) {
+          send_error(fd);
+          break;
+        }
+        if (dtype == kI64) {
+          // int64 tables are accumulators (CTR show/click counters):
+          // dense add, or per-row add when rows are given
+          const int64_t* g = reinterpret_cast<const int64_t*>(raw.data());
+          size_t n = raw.size() / 8;
+          if (n_rows) {
+            // row width comes from the push payload itself (a dense PUT
+            // can't know the row structure)
+            int64_t w = static_cast<int64_t>(n / n_rows);
+            for (uint32_t r = 0; r < n_rows; r++) {
+              size_t off = static_cast<size_t>(rows[r]) * w;
+              for (int64_t i = 0; i < w && off + i < p.vi64.size(); i++)
+                p.vi64[off + i] += g[r * w + i];
+            }
+          } else {
+            for (size_t i = 0; i < n && i < p.vi64.size(); i++)
+              p.vi64[i] += g[i];
+          }
+        } else {
+          // bf16 grads: widen to f32 and run the table's optimizer
+          // against the f32 master (dense or per-row)
+          std::vector<float> g;
+          if (dtype == kBF16) {
+            const uint16_t* src =
+                reinterpret_cast<const uint16_t*>(raw.data());
+            g.resize(raw.size() / 2);
+            for (size_t i = 0; i < g.size(); i++) g[i] = bf16_to_f32(src[i]);
+          } else {
+            g.assign(reinterpret_cast<const float*>(raw.data()),
+                     reinterpret_cast<const float*>(raw.data() + raw.size()));
+          }
+          if (p.optim == kAdam) p.adam_t++;
+          if (n_rows) {
+            int64_t w = static_cast<int64_t>(g.size() / n_rows);
+            for (uint32_t r = 0; r < n_rows; r++) {
+              size_t off = static_cast<size_t>(rows[r]) * w;
+              if (off + w <= p.value.size())
+                apply_update(p, g.data() + r * w, off, w);
+            }
+          } else {
+            apply_update(p, g.data(), 0, g.size());
+          }
+        }
+        send_payload(fd, nullptr, 0);
         break;
       }
       case kBarrier: {
@@ -442,82 +578,119 @@ void ps_server_destroy(void* h) {
 struct Client {
   int fd = -1;
   std::mutex mu;
+  std::string host;
+  int port = 0;
+  long deadline_ms = 180000;
 };
 
-void* ps_client_connect(const char* host, int port) {
-  Client* c = new Client();
-  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+namespace {
+long rpc_deadline_ms() {
+  // ref FLAGS_rpc_deadline, grpc_client.h:36 — default 180s: a wedged
+  // server turns into a clean client error, not a hang
+  long deadline_ms = 180000;
+  if (const char* env = getenv("FLAGS_rpc_deadline")) {
+    long v = strtol(env, nullptr, 10);
+    if (v > 0) deadline_ms = v;
+  }
+  return deadline_ms;
+}
+
+int rpc_retry_times() {
+  // ref FLAGS_rpc_retry_times (grpc_client retry loop): bounded retries
+  // with exponential backoff before surfacing the error
+  long v = 3;
+  if (const char* env = getenv("FLAGS_rpc_retry_times")) {
+    long e = strtol(env, nullptr, 10);
+    if (e >= 0) v = e;
+  }
+  return static_cast<int>(v);
+}
+
+// one TCP connect attempt loop (server may not be up yet — ref
+// WaitServerReady in grpc_client); returns fd or -1
+int connect_fd(const std::string& host, int port, long deadline_ms,
+               int attempts) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
     // not dotted-quad: resolve the hostname (PaddleCloud-style endpoints
     // are usually names, not IPs)
     addrinfo hints{};
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
     addrinfo* res = nullptr;
-    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
-      ::close(c->fd);
-      delete c;
-      return nullptr;
-    }
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr)
+      return -1;
     addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
     freeaddrinfo(res);
-  }
-  // request deadline (ref FLAGS_rpc_deadline, grpc_client.h:36 — default
-  // 180s): a wedged server turns into a clean client error, not a hang
-  long deadline_ms = 180000;
-  if (const char* env = getenv("FLAGS_rpc_deadline")) {
-    long v = strtol(env, nullptr, 10);
-    if (v > 0) deadline_ms = v;
   }
   timeval tv{};
   tv.tv_sec = deadline_ms / 1000;
   tv.tv_usec = (deadline_ms % 1000) * 1000;
-  for (int attempt = 0; attempt < 200; attempt++) {
-    if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
+  for (int attempt = 0; attempt < attempts; attempt++) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
                   sizeof(addr)) == 0) {
       int one = 1;
-      setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-      setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-      return c;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      return fd;
     }
-    // server may not be up yet (ref WaitServerReady in grpc_client)
-    ::close(c->fd);
-    c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ::close(fd);
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
-  ::close(c->fd);
-  delete c;
-  return nullptr;
+  return -1;
+}
+}  // namespace
+
+void* ps_client_connect(const char* host, int port) {
+  Client* c = new Client();
+  c->host = host;
+  c->port = port;
+  c->deadline_ms = rpc_deadline_ms();
+  c->fd = connect_fd(c->host, c->port, c->deadline_ms, 200);
+  if (c->fd < 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
 }
 
 namespace {
-int64_t request(Client* c, uint8_t op, const char* name,
-                const uint32_t* rows, uint32_t n_rows, const float* payload,
-                uint64_t n_floats, float* out, int64_t out_cap) {
-  std::lock_guard<std::mutex> lk(c->mu);
+// single attempt.  `sent` reports whether the full request reached the
+// kernel send path — the retry policy depends on it (a request that was
+// never delivered is safe to resend for ANY op; one that may have been
+// applied is only safe for idempotent ops).
+int64_t request_once(Client* c, uint8_t op, int dtype, const char* name,
+                     const uint32_t* rows, uint32_t n_rows,
+                     const void* payload, uint64_t payload_len,
+                     void* out, uint64_t out_cap_bytes, bool* sent) {
+  *sent = false;
   uint16_t name_len = static_cast<uint16_t>(std::strlen(name));
-  uint64_t payload_len = n_floats * sizeof(float);
   if (!write_full(c->fd, &op, 1)) return -1;
+  if (dtype >= 0) {
+    uint8_t d = static_cast<uint8_t>(dtype);
+    if (!write_full(c->fd, &d, 1)) return -1;
+  }
   if (!write_full(c->fd, &name_len, sizeof(name_len))) return -1;
   if (name_len && !write_full(c->fd, name, name_len)) return -1;
   if (!write_full(c->fd, &n_rows, sizeof(n_rows))) return -1;
   if (!write_full(c->fd, &payload_len, sizeof(payload_len))) return -1;
   if (n_rows && !write_full(c->fd, rows, n_rows * 4)) return -1;
   if (payload_len && !write_full(c->fd, payload, payload_len)) return -1;
+  *sent = true;
   uint64_t resp_len;
   if (!read_full(c->fd, &resp_len, sizeof(resp_len))) return -1;
-  if (resp_len == ~0ull) return -2;  // server error: unknown table
-  int64_t n = static_cast<int64_t>(resp_len / sizeof(float));
+  if (resp_len == ~0ull) return -2;  // server error: unknown table/dtype
   // read straight into the caller's buffer (no temp copy on the hot
   // recv path); drain any excess to keep the stream in sync
   uint64_t remaining = resp_len;
-  if (out && out_cap > 0 && remaining > 0) {
-    uint64_t take =
-        std::min<uint64_t>(remaining, static_cast<uint64_t>(out_cap) * 4);
+  if (out && out_cap_bytes > 0 && remaining > 0) {
+    uint64_t take = std::min<uint64_t>(remaining, out_cap_bytes);
     if (!read_full(c->fd, out, take)) return -1;
     remaining -= take;
   }
@@ -528,7 +701,62 @@ int64_t request(Client* c, uint8_t op, const char* name,
     if (!read_full(c->fd, scratch, chunk)) return -1;
     remaining -= chunk;
   }
-  return n;
+  return static_cast<int64_t>(resp_len);
+}
+
+bool op_idempotent(uint8_t op) {
+  // PUT overwrites, GETs read — safe to replay after an ambiguous
+  // failure.  PUSH accumulates and BARRIER counts arrivals: replaying
+  // one that may have been applied would double-count.
+  switch (op) {
+    case kPut:
+    case kPutTyped:
+    case kGet:
+    case kGetNoBarrier:
+    case kGetTyped:
+    case kGetRows:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// retries with reconnect + bounded exponential backoff (100ms·2^k); the
+// byte count of the response is returned, -1 on exhausted retries, -2
+// on a server-reported error (no retry — the request WAS served).
+int64_t request_bytes(Client* c, uint8_t op, int dtype, const char* name,
+                      const uint32_t* rows, uint32_t n_rows,
+                      const void* payload, uint64_t payload_len,
+                      void* out, uint64_t out_cap_bytes) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  int retries = rpc_retry_times();
+  for (int attempt = 0; ; attempt++) {
+    bool sent = false;
+    int64_t n = request_once(c, op, dtype, name, rows, n_rows, payload,
+                             payload_len, out, out_cap_bytes, &sent);
+    if (n >= 0 || n == -2) return n;
+    // transport failure: after a timeout the stream is desynced —
+    // reconnect before any retry
+    bool may_have_applied = sent;
+    if (attempt >= retries ||
+        (may_have_applied && !op_idempotent(op)))
+      return -1;
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(100L << std::min(attempt, 6)));
+    if (c->fd >= 0) ::close(c->fd);
+    c->fd = connect_fd(c->host, c->port, c->deadline_ms, 1);
+    // c->fd may still be -1: the next attempt fails fast (write to a
+    // bad fd) and the loop backs off again until retries run out
+  }
+}
+
+int64_t request(Client* c, uint8_t op, const char* name,
+                const uint32_t* rows, uint32_t n_rows, const float* payload,
+                uint64_t n_floats, float* out, int64_t out_cap) {
+  int64_t nb = request_bytes(c, op, -1, name, rows, n_rows, payload,
+                             n_floats * sizeof(float), out,
+                             static_cast<uint64_t>(out_cap) * 4);
+  return nb < 0 ? nb : nb / static_cast<int64_t>(sizeof(float));
 }
 }  // namespace
 
@@ -564,6 +792,66 @@ int64_t ps_client_get_rows(void* h, const char* name, const uint32_t* rows,
                            uint32_t n_rows, float* out, int64_t cap) {
   return request(static_cast<Client*>(h), kGetRows, name, rows, n_rows,
                  nullptr, 0, out, cap);
+}
+
+// ---- typed tables (dtype: 0 f32, 1 bf16, 2 int64) ----------------------
+
+int ps_client_put_typed(void* h, const char* name, const void* data,
+                        int64_t n_elems, int dtype) {
+  return request_bytes(static_cast<Client*>(h), kPutTyped, dtype, name,
+                       nullptr, 0, data,
+                       static_cast<uint64_t>(n_elems) *
+                           dtype_size(static_cast<uint8_t>(dtype)),
+                       nullptr, 0) >= 0 ? 0 : -1;
+}
+
+int64_t ps_client_get_typed(void* h, const char* name, void* out,
+                            int64_t cap_elems, int dtype) {
+  size_t esz = dtype_size(static_cast<uint8_t>(dtype));
+  int64_t nb = request_bytes(static_cast<Client*>(h), kGetTyped, dtype,
+                             name, nullptr, 0, nullptr, 0, out,
+                             static_cast<uint64_t>(cap_elems) * esz);
+  return nb < 0 ? nb : nb / static_cast<int64_t>(esz);
+}
+
+int ps_client_push_typed(void* h, const char* name, const uint32_t* rows,
+                         uint32_t n_rows, const void* data, int64_t n_elems,
+                         int dtype) {
+  return request_bytes(static_cast<Client*>(h), kPushTyped, dtype, name,
+                       rows, n_rows, data,
+                       static_cast<uint64_t>(n_elems) *
+                           dtype_size(static_cast<uint8_t>(dtype)),
+                       nullptr, 0) >= 0 ? 0 : -1;
+}
+
+// Register a typed table server-side before start (dense size or
+// rows×width like ps_server_add_param); init points at `size` elements
+// of `dtype`.
+int ps_server_add_param_typed(void* h, const char* name, int64_t size,
+                              const void* init, int dtype, int optim,
+                              float lr, float hp1, float hp2, int64_t rows) {
+  Server* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  Param& p = s->table[name];
+  p.dtype = static_cast<uint8_t>(dtype);
+  if (p.dtype == kI64) {
+    const int64_t* src = static_cast<const int64_t*>(init);
+    p.vi64.assign(src, src + size);
+  } else if (p.dtype == kBF16) {
+    const uint16_t* src = static_cast<const uint16_t*>(init);
+    p.value.resize(size);
+    for (int64_t i = 0; i < size; i++) p.value[i] = bf16_to_f32(src[i]);
+  } else {
+    const float* src = static_cast<const float*>(init);
+    p.value.assign(src, src + size);
+  }
+  p.optim = optim;
+  p.lr = lr;
+  if (optim == kMomentum) p.mom = hp1;
+  if (optim == kAdam) { p.beta1 = hp1; p.beta2 = hp2; }
+  p.rows = rows;
+  p.width = rows > 0 ? size / rows : size;
+  return 0;
 }
 
 int ps_client_barrier(void* h) {

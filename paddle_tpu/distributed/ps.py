@@ -160,6 +160,66 @@ class PSClient:
     def stop_server(self) -> None:
         self._lib.ps_client_stop_server(self._h)
 
+    # -- typed tables (ref VariableMessage.dtype, send_recv.proto.in:47):
+    # bf16 embeddings ride the wire at half the bytes (f32 master on the
+    # server); int64 tables (CTR show/click counters) are exact end to
+    # end and accumulate on push.
+
+    @staticmethod
+    def _typed_code(dtype):
+        import ml_dtypes
+        d = np.dtype(dtype)
+        if d == np.dtype(ml_dtypes.bfloat16):
+            return 1, d
+        if d == np.dtype(np.int64):
+            return 2, d
+        if d == np.dtype(np.float32):
+            return 0, d
+        raise ValueError(
+            f"typed PS tables support float32/bfloat16/int64, got {d}")
+
+    def put_typed(self, name: str, value, dtype) -> None:
+        import ctypes
+        code, d = self._typed_code(dtype)
+        a = np.ascontiguousarray(np.asarray(value).ravel(), d)
+        rc = self._lib.ps_client_put_typed(
+            self._h, name.encode(), a.ctypes.data_as(ctypes.c_void_p),
+            a.size, code)
+        if rc != 0:
+            raise RuntimeError(f"ps put_typed({name}) failed")
+
+    def get_typed(self, name: str, size: int, dtype):
+        import ctypes
+        code, d = self._typed_code(dtype)
+        out = np.empty(size, d)
+        n = self._lib.ps_client_get_typed(
+            self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
+            size, code)
+        if n != size:
+            raise RuntimeError(
+                f"ps get_typed({name}): expected {size} elems, got {n} "
+                "(unknown table or dtype mismatch)" if n == -2 else
+                f"ps get_typed({name}): expected {size} elems, got {n}")
+        return out
+
+    def push_typed(self, name: str, grad, dtype, rows=None) -> None:
+        """int64 tables: accumulate-add (counters); bf16/f32 tables: run
+        the table's optimizer against the f32 master.  ``rows`` selects
+        per-row sparse application."""
+        import ctypes
+        code, d = self._typed_code(dtype)
+        a = np.ascontiguousarray(np.asarray(grad).ravel(), d)
+        if rows is None:
+            rp, nr = None, 0
+        else:
+            r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
+            rp, nr = r.ctypes.data_as(ctypes.c_void_p), len(r)
+        rc = self._lib.ps_client_push_typed(
+            self._h, name.encode(), rp, nr,
+            a.ctypes.data_as(ctypes.c_void_p), a.size, code)
+        if rc != 0:
+            raise RuntimeError(f"ps push_typed({name}) failed")
+
     def close(self) -> None:
         if self._h:
             self._lib.ps_client_destroy(self._h)

@@ -134,6 +134,13 @@ def _declare(lib):
         "ps_client_push_dense": ([p, cp, p, i64], c.c_int),
         "ps_client_push_sparse": ([p, cp, p, c.c_uint32, p, i64], c.c_int),
         "ps_client_get_rows": ([p, cp, p, c.c_uint32, p, i64], i64),
+        "ps_client_put_typed": ([p, cp, p, i64, c.c_int], c.c_int),
+        "ps_client_get_typed": ([p, cp, p, i64, c.c_int], i64),
+        "ps_client_push_typed": ([p, cp, p, c.c_uint32, p, i64, c.c_int],
+                                 c.c_int),
+        "ps_server_add_param_typed": ([p, cp, i64, p, c.c_int, c.c_int,
+                                       c.c_float, c.c_float, c.c_float,
+                                       i64], c.c_int),
         "ps_client_barrier": ([p], c.c_int),
         "ps_client_stop_server": ([p], c.c_int),
         "ps_client_destroy": ([p], None),

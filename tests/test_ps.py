@@ -493,3 +493,132 @@ def test_sparse_shared_table_and_padding():
         if pt.global_scope().find_var("shared_emb") is not None else None
     cli.stop_server()
     srv.join(timeout=5)
+
+
+def test_typed_bf16_table():
+    """bf16 table (ref VariableMessage.dtype): values ride the wire as
+    bf16, the server keeps an f32 master and runs the optimizer on it."""
+    import ml_dtypes
+    bf16 = ml_dtypes.bfloat16
+    server = ps_mod.PSServer(0, 1, True, [])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        vals = np.array([0.5, -1.25, 3.0, 1e-3], np.float32)
+        cli.put_typed("emb", vals.astype(bf16), bf16)
+        got = cli.get_typed("emb", 4, bf16)
+        np.testing.assert_allclose(got.astype(np.float32),
+                                   vals.astype(bf16).astype(np.float32))
+        # bf16 grads apply through the table's optimizer (default SGD,
+        # lr 0.01): w -= lr * g
+        g = np.ones(4, np.float32)
+        cli.push_typed("emb", g.astype(bf16), bf16)
+        got2 = cli.get_typed("emb", 4, bf16).astype(np.float32)
+        want = (vals.astype(bf16).astype(np.float32) - 0.01).astype(
+            bf16).astype(np.float32)
+        np.testing.assert_allclose(got2, want, rtol=1e-2)
+        # dtype mismatch is a loud error, not garbage
+        with pytest.raises(RuntimeError):
+            cli.get_typed("emb", 4, np.int64)
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_typed_int64_counter_table():
+    """int64 tables are exact beyond 2^31 and accumulate on push — the
+    CTR show/click counter shape (ref downpour frequency tables)."""
+    server = ps_mod.PSServer(0, 1, True, [])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        big = np.array([2**40 + 7, 5, -3, 2**33], np.int64)
+        cli.put_typed("clicks", big, np.int64)
+        got = cli.get_typed("clicks", 4, np.int64)
+        np.testing.assert_array_equal(got, big)          # exact, no f32 wire
+        cli.push_typed("clicks", np.array([1, 1, 1, 1], np.int64), np.int64)
+        got = cli.get_typed("clicks", 4, np.int64)
+        np.testing.assert_array_equal(got, big + 1)
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_typed_int64_sparse_rows():
+    """Per-row counter increments on a [rows, width] int64 table."""
+    import ctypes
+    server = ps_mod.PSServer(0, 1, True, [])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        table = np.arange(12, dtype=np.int64)            # 4 rows × 3
+        cli.put_typed("freq", table, np.int64)
+        # width comes from the first put unless registered; push rows 1,3
+        cli.push_typed("freq", np.full(6, 100, np.int64), np.int64,
+                       rows=[1, 3])
+        got = cli.get_typed("freq", 12, np.int64).reshape(4, 3)
+        want = table.reshape(4, 3).copy()
+        want[1] += 100
+        want[3] += 100
+        np.testing.assert_array_equal(got, want)
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
+
+
+def test_client_retry_bounded_on_dead_server(monkeypatch):
+    """A killed server must surface a clean client error after the
+    bounded retry budget — not hang (ref FLAGS_rpc_retry_times,
+    grpc_client retry loop)."""
+    monkeypatch.setenv("FLAGS_rpc_deadline", "500")       # ms
+    monkeypatch.setenv("FLAGS_rpc_retry_times", "2")
+    server = ps_mod.PSServer(0, 1, True, [
+        {"name": "w", "size": 2, "optimizer": "sgd", "lr": 0.1}])
+    port = server.start()
+    cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+    np.testing.assert_allclose(cli.get("w", 2), [0, 0])
+    server.stop()
+    server.destroy()
+    t0 = time.time()
+    with pytest.raises(RuntimeError):
+        cli.get("w", 2)
+    # 2 retries × (deadline + backoff) — well under 30s, no hang
+    assert time.time() - t0 < 30
+    cli.close()
+
+
+def test_client_retry_recovers_across_server_restart(monkeypatch):
+    """An idempotent request must transparently reconnect and succeed
+    when the server comes back on the same port (retry + backoff)."""
+    monkeypatch.setenv("FLAGS_rpc_deadline", "2000")
+    monkeypatch.setenv("FLAGS_rpc_retry_times", "4")
+    port = _free_port()
+    server = ps_mod.PSServer(port, 1, True, [
+        {"name": "w", "size": 2, "optimizer": "sgd", "lr": 0.1}])
+    server.start()
+    cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+    cli.put("w", np.array([1.0, 2.0], np.float32))
+    server.stop()
+    server.destroy()
+
+    # bring a new server up on the same port after a short outage,
+    # while the client retries in the background
+    def revive():
+        time.sleep(0.8)
+        s2 = ps_mod.PSServer(port, 1, True, [
+            {"name": "w", "size": 2, "optimizer": "sgd", "lr": 0.1}])
+        s2.start()
+        revive.server = s2
+    th = threading.Thread(target=revive)
+    th.start()
+    try:
+        got = cli.get("w", 2)        # first attempt hits the dead server
+        np.testing.assert_allclose(got, [0, 0])   # fresh server's init
+    finally:
+        th.join()
+        cli.close()
+        revive.server.stop()
+        revive.server.destroy()
