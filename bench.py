@@ -239,10 +239,80 @@ def bench_bert_long(dev, on_tpu, peak):
     }))
 
 
+def bench_transformer_wmt(dev, on_tpu, peak):
+    """Transformer-base WMT14 en-de (BASELINE target #4; ref recipe
+    dist_transformer.py:958 transformer-base: d512/6L/8H/2048, shared
+    37k BPE vocab).  Encoder-decoder training step, seq 256."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+    from paddle_tpu.models import transformer as T
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        if on_tpu:
+            V, d, L, H, F = 37000, 512, 6, 8, 2048
+            batch, seq_len, steps = 32, 256, 32
+        else:
+            V, d, L, H, F = 512, 64, 2, 2, 128
+            batch, seq_len, steps = 2, 16, 2
+            peak = 1e12
+        feeds, logits, loss = T.build_transformer_nmt(
+            V, V, seq_len, d_model=d, n_layer=L, n_head=H, d_inner=F)
+        optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
+        optimizer.minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+
+        rng = np.random.RandomState(0)
+        pos = np.tile(np.arange(seq_len), (batch, 1)).astype(np.int32)
+        feed = {
+            "src_ids": jax.device_put(rng.randint(
+                1, V, (batch, seq_len)).astype(np.int32)),
+            "src_pos": jax.device_put(pos),
+            "trg_ids": jax.device_put(rng.randint(
+                1, V, (batch, seq_len)).astype(np.int32)),
+            "trg_pos": jax.device_put(pos),
+            "label": jax.device_put(rng.randint(
+                1, V, (batch, seq_len)).astype(np.int32)),
+        }
+        lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+        l0 = float(np.asarray(lv))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                          return_numpy=False)
+        lN = float(np.asarray(lv))
+        dt = (time.perf_counter() - t0) / steps
+
+        tokens = batch * seq_len
+        enc_m = L * (4 * d * d + 2 * d * F)
+        dec_m = L * (8 * d * d + 2 * d * F)
+        head = V * d
+        flops = 6 * (enc_m + dec_m + head) * tokens \
+            + 12 * L * d * seq_len * tokens \
+            + 24 * L * d * seq_len * tokens
+        mfu = flops / dt / peak
+        print(json.dumps({
+            "metric": "transformer_wmt14_train_mfu" if on_tpu
+            else "transformer_tiny_train_smoke",
+            "value": round(mfu * 100, 2),
+            "unit": "% MFU",
+            "vs_baseline": round(mfu / 0.35, 4),
+            "step_time_s": round(dt, 4),
+            "tokens_per_s": round(tokens / dt, 1),
+            "device": str(dev), "batch": batch, "seq_len": seq_len,
+            "loss_first_last": [round(l0, 3), round(lN, 3)],
+        }))
+
+
 def main():
     dev, on_tpu, peak = _device_info()
     bench_resnet50(dev, on_tpu, peak)
     bench_bert_long(dev, on_tpu, peak)
+    bench_transformer_wmt(dev, on_tpu, peak)
     bench_bert(dev, on_tpu, peak)          # flagship metric printed last
 
 
