@@ -238,6 +238,52 @@ def bench_bert_long(dev, on_tpu, peak):
         "attn": "pallas flash (auto)",
     }))
 
+    # 8k/16k: where the tuned flash blocks compound (the XLA base path
+    # OOMs beyond ~8k — flash is the only option, so no "base" column)
+    for seq_len, batch in ((8192, 2), (16384, 1)):
+        cfg = T.BertConfig(max_pos=seq_len)
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            feeds, logits, loss = T.build_bert_pretrain(
+                cfg, seq_len, fused_head=True, arange_pos=True,
+                attn_impl="auto", dropout=0.0)
+            optimizer = pt.amp.decorate(
+                opt.AdamOptimizer(learning_rate=1e-4))
+            optimizer.minimize(loss)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            rng = np.random.RandomState(0)
+            feed = {
+                "src_ids": jax.device_put(rng.randint(
+                    1, cfg.vocab_size,
+                    (batch, seq_len)).astype(np.int32)),
+                "lm_label": jax.device_put(rng.randint(
+                    0, cfg.vocab_size,
+                    (batch, seq_len)).astype(np.int32)),
+            }
+            lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+            float(np.asarray(lv))
+            t0 = time.perf_counter()
+            for _ in range(8):
+                lv, = exe.run(feed=feed, fetch_list=[loss.name],
+                              scope=scope, return_numpy=False)
+            float(np.asarray(lv))
+            dt = (time.perf_counter() - t0) / 8
+        tokens = batch * seq_len
+        flops = 6 * (L * (4 * d * d + 2 * d * F) + V * d) * tokens \
+            + 12 * L * d * seq_len * tokens
+        mfu = flops / dt / peak
+        print(json.dumps({
+            "metric": f"bert_long{seq_len // 1024}k_train_mfu",
+            "value": round(mfu * 100, 2),
+            "unit": "% MFU",
+            "vs_baseline": round(mfu / 0.35, 4),
+            "step_time_s": round(dt, 4),
+            "tokens_per_s": round(tokens / dt, 1),
+            "device": str(dev), "batch": batch, "seq_len": seq_len,
+            "attn": "pallas flash (auto)",
+        }))
+
 
 def bench_transformer_wmt(dev, on_tpu, peak):
     """Transformer-base WMT14 en-de (BASELINE target #4; ref recipe
