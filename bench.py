@@ -305,8 +305,11 @@ def bench_transformer_wmt(dev, on_tpu, peak):
             V, d, L, H, F = 512, 64, 2, 2, 128
             batch, seq_len, steps = 2, 16, 2
             peak = 1e12
+        # fused chunked head: the [tokens, 37k] logits never hit HBM
+        # (measured r3: 44.8 ms vs 49.8 ms dense head = 37.7% vs 33.9% MFU)
         feeds, logits, loss = T.build_transformer_nmt(
-            V, V, seq_len, d_model=d, n_layer=L, n_head=H, d_inner=F)
+            V, V, seq_len, d_model=d, n_layer=L, n_head=H, d_inner=F,
+            fused_head=True)
         optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
         optimizer.minimize(loss)
         exe = pt.Executor()

@@ -257,11 +257,13 @@ def annotate_tensor_parallel(program=None):
 
 def build_transformer_nmt(src_vocab, trg_vocab, seq_len, d_model=512,
                           n_layer=6, n_head=8, d_inner=2048, dropout=0.1,
-                          is_test=False):
+                          is_test=False, fused_head=False):
     """Encoder-decoder NMT Transformer (ref dist_transformer.py transformer()).
 
     Decoder self-attention uses a causal additive bias; cross-attention
-    attends encoder output."""
+    attends encoder output.  ``fused_head=True`` computes projection+CE
+    with the chunked ``fused_lm_head_ce`` op (the [tokens, 37k] logits
+    never hit HBM); ``logits`` is returned as None in that mode."""
     src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
     src_pos = layers.data("src_pos", shape=[seq_len], dtype="int64")
     trg_ids = layers.data("trg_ids", shape=[seq_len], dtype="int64")
@@ -295,6 +297,14 @@ def build_transformer_nmt(src_vocab, trg_vocab, seq_len, d_model=512,
                                param_prefix=f"dec_{i}.ffn", act="relu")
         x = layers.layer_norm(x + ffn, begin_norm_axis=2)
 
+    if fused_head:
+        loss = layers.fused_lm_head_ce(
+            x, trg_vocab, label, bias_attr=False,
+            param_attr=ParamAttr(name="nmt_out.w"), ignore_index=0)
+        mask = layers.cast(label > 0, "float32")
+        avg_loss = layers.reduce_sum(loss * layers.unsqueeze(mask, [2])) / \
+            (layers.reduce_sum(mask) + 1e-6)
+        return (src_ids, src_pos, trg_ids, trg_pos, label), None, avg_loss
     logits = layers.fc(x, size=trg_vocab, num_flatten_dims=2,
                        param_attr=ParamAttr(name="nmt_out.w"),
                        bias_attr=False)
