@@ -6,8 +6,9 @@ TPU mapping: knobs that steer CUDA allocators/cudnn autotune have no
 hardware meaning here and are accepted as inert parity flags; the ones
 with a real XLA-side effect are wired:
 
-- ``check_nan_inf``   → ``jax.config jax_debug_nans/jax_debug_infs`` (the
-  per-kernel output validation of ``FLAGS_check_nan_inf``)
+- ``check_nan_inf``   → per-op output finite-checks naming the fluid op
+  (executor.py _sanitize_outputs; the per-kernel validation of
+  ``FLAGS_check_nan_inf``, tests/test_sanitizers.py)
 - ``benchmark``       → per-step host sync in the executor (the reference
   adds per-op sync timing)
 - ``allocator_strategy`` / ``eager_delete_tensor_gb`` → recorded; XLA owns
@@ -71,10 +72,12 @@ def _coerce(name: str, raw):
 
 
 def _apply_side_effects(name: str, value):
-    if name == "FLAGS_check_nan_inf":
-        import jax
-        jax.config.update("jax_debug_nans", bool(value))
-        jax.config.update("jax_debug_infs", bool(value))
+    # FLAGS_check_nan_inf is implemented at the framework level: the
+    # executor binds a finite-check to every float output and reports the
+    # producing FLUID op by name (executor.py _sanitize_outputs) — more
+    # actionable than jax_debug_nans, which names XLA ops and aborts the
+    # step before any framework-side reporting can run.
+    pass
 
 
 def set_flags(flags: Dict[str, Any]):

@@ -117,9 +117,56 @@ def run_block(ctx: LowerCtx, block: Block, state: _ExecState) -> None:
         run_op(ctx, block, op, state)
 
 
+def _op_context(block, op) -> str:
+    """Enforce-style diagnostic context (ref platform/enforce.h — the
+    reference enriches every kernel error with op/var context)."""
+    parts = [f"op={op.type!r}"]
+    for slot, names in op.inputs.items():
+        for n in names:
+            shape = None
+            if n and block.has_var(n):
+                shape = block.var(n).shape
+            parts.append(f"in {slot}:{n} shape={shape}")
+    parts.append(f"outs={[n for ns in op.outputs.values() for n in ns]}")
+    return "\n  ".join(parts)
+
+
+def _sanitize_outputs(op, outs):
+    """FLAGS_check_nan_inf at the framework level: bind each float output
+    to the producing FLUID op (jax_debug_nans reports XLA ops, which users
+    can't map back to their program).  The debug branch only executes on a
+    hit, so the clean path pays one reduction per output."""
+    import jax
+    for slot, vals in outs.items():
+        for i, v in enumerate(vals):
+            if v is None or not hasattr(v, "dtype") or \
+                    not jnp.issubdtype(v.dtype, jnp.floating):
+                continue
+            bad = ~jnp.all(jnp.isfinite(v))
+            jax.lax.cond(
+                bad,
+                lambda t=op.type, s=slot, j=i: jax.debug.print(
+                    "FLAGS_check_nan_inf: non-finite value in output "
+                    "{s}[{j}] of op {t}", t=t, s=s, j=j),
+                lambda: None)
+
+
 def run_op(ctx: LowerCtx, block: Block, op: Operator, state: _ExecState) -> None:
     if op.type in ("feed", "fetch"):
         return
+    try:
+        _run_op_inner(ctx, block, op, state)
+    except Exception as e:
+        if getattr(e, "_pt_op_context", False):
+            raise               # already annotated by the failing inner op
+        msg = (f"{type(e).__name__} while lowering op {op.type!r}: {e}\n"
+               f"  {_op_context(block, op)}")
+        err = RuntimeError(msg)
+        err._pt_op_context = True
+        raise err from e
+
+
+def _run_op_inner(ctx, block, op, state) -> None:
     if op.type.endswith("_grad") and not registry.has_op(op.type):
         _run_generic_grad(ctx, block, op, state)
         return
@@ -133,6 +180,9 @@ def run_op(ctx: LowerCtx, block: Block, op: Operator, state: _ExecState) -> None
         from .. import amp as _amp
         ins = _amp.cast_ins(op.type, ins)
     outs = info.lower(ctx, ins, op.attrs) or {}
+    from ..flags import get_flags
+    if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+        _sanitize_outputs(op, outs)
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
         for i, n in enumerate(names):
@@ -412,6 +462,19 @@ class Executor:
         for n in cb.persist_rw:
             v = _scope_fetch(scope, n, allow_missing=n not in cb.rw_read)
             rw_vals.append(v if v is not None else jnp.zeros((), jnp.float32))
+        # donation-aliasing sanitizer: the jitted step donates the rw
+        # buffers, so the SAME jax array under two scope names would be
+        # donated twice — a cryptic XLA crash.  Catch it here with names.
+        seen_ids = {}
+        for n, v in zip(cb.persist_rw, rw_vals):
+            if isinstance(v, jax.Array):
+                other = seen_ids.setdefault(id(v), n)
+                if other is not n:
+                    raise ValueError(
+                        f"scope vars {other!r} and {n!r} alias the SAME "
+                        "device array; the executor donates read-write "
+                        "buffers, so aliased scope entries are invalid — "
+                        "np.copy() the value when duplicating it")
 
         self._step_seed += 1
         seed_val = seed if seed is not None else (

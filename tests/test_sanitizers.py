@@ -1,0 +1,93 @@
+"""Sanitizers + enriched errors (SURVEY §5.2 / ref enforce.h +
+FLAGS_check_nan_inf): framework-level non-finite localization naming the
+fluid op, donation-aliasing detection, and Enforce-style op context on
+lowering failures."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor, Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def test_nan_inf_sanitizer_names_the_op(capfd):
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.log(x)            # log(-1) = nan
+            z = y * 2.0
+            exe = Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            exe.run(feed={"x": -np.ones((2, 4), np.float32)},
+                    fetch_list=[z.name], scope=scope)
+        out = capfd.readouterr()
+        text = out.out + out.err
+        assert "FLAGS_check_nan_inf" in text
+        assert "'log'" in text or "op log" in text
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_nan_inf_sanitizer_silent_when_clean(capfd):
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.exp(x)
+            exe = Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[y.name], scope=scope)
+        out = capfd.readouterr()
+        assert "FLAGS_check_nan_inf" not in out.out + out.err
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_donation_aliasing_detected():
+    """Two scope names bound to the SAME device array must fail with a
+    named error, not a cryptic XLA donation crash (the executor donates
+    read-write buffers)."""
+    import jax.numpy as jnp
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        w = layers.create_parameter([4], "float32", name="w_alias_a")
+        w2 = layers.create_parameter([4], "float32", name="w_alias_b")
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(x * w + x * w2)
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        shared = jnp.ones(4, jnp.float32)
+        scope.set_var("w_alias_a", shared)
+        scope.set_var("w_alias_b", shared)            # the footgun
+        with pytest.raises(ValueError, match="alias the SAME"):
+            exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss.name], scope=scope)
+
+
+def test_lowering_error_carries_op_context():
+    """A failing lowering must name the op and its inputs/shapes (ref
+    enforce.h enriched errors), not surface a bare jax traceback."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[6], dtype="float32")
+        # build-time shape inference can't see the runtime mismatch for
+        # matmul with compatible symbolic dims; force one at lowering by
+        # feeding incompatible shapes through elementwise_add
+        out = layers.elementwise_add(x, y)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        with pytest.raises(RuntimeError) as ei:
+            exe.run(feed={"x": np.ones((2, 4), np.float32),
+                          "y": np.ones((2, 6), np.float32)},
+                    fetch_list=[out.name], scope=scope)
+    msg = str(ei.value)
+    assert "elementwise_add" in msg
+    assert "shape" in msg
