@@ -10,13 +10,17 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <new>
 #include <set>
 #include <utility>
+#include <vector>
 
 #include "common.h"
 
@@ -149,6 +153,92 @@ class BestFitPool {
   std::map<int64_t, int64_t> allocated_;                // offset -> size
 };
 
+// ---------------------------------------------------------------------------
+// Growth + retry wrapper (ref memory/detail/buddy_allocator.h auto-growth
+// chunks under FLAGS_allocator_strategy=auto_growth, and
+// memory/allocation/retry_allocator.h: a failed allocation WAITS for a
+// concurrent free before surfacing OOM).
+// ---------------------------------------------------------------------------
+
+class GrowingPool {
+ public:
+  GrowingPool(int64_t chunk_bytes, bool auto_growth)
+      : chunk_bytes_(chunk_bytes), auto_growth_(auto_growth) {
+    chunks_.emplace_back(new BestFitPool(chunk_bytes));
+  }
+
+  void* Alloc(int64_t want, long retry_ms = 0) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(retry_ms);
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        for (auto& c : chunks_) {
+          void* p = c->Alloc(want);
+          if (p) return p;
+        }
+        if (auto_growth_) {
+          // new chunk sized to fit the request (buddy-allocator growth)
+          int64_t sz = std::max(chunk_bytes_, want * 2);
+          try {
+            chunks_.emplace_back(new BestFitPool(sz));
+          } catch (...) {
+            return nullptr;  // host truly out of memory
+          }
+          return chunks_.back()->Alloc(want);
+        }
+        if (retry_ms <= 0) return nullptr;
+        // retry_allocator semantics: wait for a Free to race in
+        if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+          // one final attempt under the lock, then give up
+          for (auto& c : chunks_) {
+            void* p = c->Alloc(want);
+            if (p) return p;
+          }
+          return nullptr;
+        }
+      }
+    }
+  }
+
+  bool Free(void* p) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& c : chunks_) {
+      if (c->Free(p)) {
+        cv_.notify_all();
+        return true;
+      }
+    }
+    return false;
+  }
+
+  int64_t InUse() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t t = 0;
+    for (auto& c : chunks_) t += c->InUse();
+    return t;
+  }
+
+  int64_t Peak() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t t = 0;
+    for (auto& c : chunks_) t += c->Peak();
+    return t;
+  }
+
+  int64_t NumChunks() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return static_cast<int64_t>(chunks_.size());
+  }
+
+ private:
+  int64_t chunk_bytes_;
+  bool auto_growth_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::unique_ptr<BestFitPool>> chunks_;
+};
+
 }  // namespace
 }  // namespace ptn
 
@@ -183,28 +273,49 @@ PTN_EXPORT void ptn_memory_stats_reset() {
 
 PTN_EXPORT void* ptn_pool_create(int64_t bytes) {
   try {
-    return new BestFitPool(bytes);
+    return new GrowingPool(bytes, /*auto_growth=*/false);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+// auto_growth != 0 → FLAGS_allocator_strategy=auto_growth semantics:
+// exhaustion adds a new chunk instead of failing (buddy_allocator.h)
+PTN_EXPORT void* ptn_pool_create2(int64_t chunk_bytes, int auto_growth) {
+  try {
+    return new GrowingPool(chunk_bytes, auto_growth != 0);
   } catch (...) {
     return nullptr;
   }
 }
 
 PTN_EXPORT void ptn_pool_destroy(void* pool) {
-  delete static_cast<BestFitPool*>(pool);
+  delete static_cast<GrowingPool*>(pool);
 }
 
 PTN_EXPORT void* ptn_pool_alloc(void* pool, int64_t size) {
-  return static_cast<BestFitPool*>(pool)->Alloc(size);
+  return static_cast<GrowingPool*>(pool)->Alloc(size);
+}
+
+// retry_allocator.h: block up to retry_ms for a concurrent free before
+// reporting exhaustion
+PTN_EXPORT void* ptn_pool_alloc_retry(void* pool, int64_t size,
+                                      long retry_ms) {
+  return static_cast<GrowingPool*>(pool)->Alloc(size, retry_ms);
 }
 
 PTN_EXPORT int ptn_pool_free(void* pool, void* p) {
-  return static_cast<BestFitPool*>(pool)->Free(p) ? 0 : -1;
+  return static_cast<GrowingPool*>(pool)->Free(p) ? 0 : -1;
 }
 
 PTN_EXPORT int64_t ptn_pool_in_use(void* pool) {
-  return static_cast<BestFitPool*>(pool)->InUse();
+  return static_cast<GrowingPool*>(pool)->InUse();
 }
 
 PTN_EXPORT int64_t ptn_pool_peak(void* pool) {
-  return static_cast<BestFitPool*>(pool)->Peak();
+  return static_cast<GrowingPool*>(pool)->Peak();
+}
+
+PTN_EXPORT int64_t ptn_pool_num_chunks(void* pool) {
+  return static_cast<GrowingPool*>(pool)->NumChunks();
 }

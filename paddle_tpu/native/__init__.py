@@ -104,7 +104,10 @@ def _declare(lib):
         "ptn_memory_stats_reset": ([], None),
         "ptn_pool_create": ([i64], p),
         "ptn_pool_destroy": ([p], None),
+        "ptn_pool_create2": ([i64, c.c_int], p),
         "ptn_pool_alloc": ([p, i64], p),
+        "ptn_pool_alloc_retry": ([p, i64, c.c_long], p),
+        "ptn_pool_num_chunks": ([p], i64),
         "ptn_pool_free": ([p, p], c.c_int),
         "ptn_pool_in_use": ([p], i64),
         "ptn_pool_peak": ([p], i64),
@@ -286,27 +289,44 @@ class _PoolArray(np.ndarray):
 
 
 class BestFitPool:
-    """Best-fit arena for host staging buffers (ref best_fit_allocator.cc).
-    ``alloc`` returns a numpy view over pool memory; ``free`` recycles it."""
+    """Best-fit arena for host staging buffers (ref best_fit_allocator.cc
+    + buddy_allocator auto-growth + retry_allocator).
 
-    def __init__(self, nbytes: int):
+    ``auto_growth=None`` reads ``FLAGS_allocator_strategy`` (the reference
+    selects its allocator stack the same way, allocator_facade.h); when
+    growing, exhaustion adds a chunk instead of failing.  ``alloc`` returns
+    a numpy view over pool memory; ``free`` recycles it."""
+
+    def __init__(self, nbytes: int, auto_growth: Optional[bool] = None):
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError(f"native library unavailable: {_build_error}")
-        self._h = self._lib.ptn_pool_create(nbytes)
+        if auto_growth is None:
+            from ..flags import get_flags
+            auto_growth = get_flags("FLAGS_allocator_strategy")[
+                "FLAGS_allocator_strategy"] == "auto_growth"
+        self._h = self._lib.ptn_pool_create2(nbytes, 1 if auto_growth else 0)
         if not self._h:
             raise MemoryError(f"cannot reserve {nbytes} bytes")
 
-    def alloc(self, shape, dtype) -> Optional[np.ndarray]:
+    def alloc(self, shape, dtype, retry_ms: int = 0) -> Optional[np.ndarray]:
+        """retry_ms > 0 blocks up to that long for a concurrent free
+        before reporting exhaustion (ref retry_allocator.h)."""
         dt = np.dtype(dtype)
         nbytes = int(np.prod(shape)) * dt.itemsize
-        ptr = self._lib.ptn_pool_alloc(self._h, nbytes)
+        if retry_ms > 0:
+            ptr = self._lib.ptn_pool_alloc_retry(self._h, nbytes, retry_ms)
+        else:
+            ptr = self._lib.ptn_pool_alloc(self._h, nbytes)
         if not ptr:
             return None  # pool exhausted — caller falls back to np.empty
         buf = (ctypes.c_char * nbytes).from_address(ptr)
         arr = np.frombuffer(buf, dtype=dt).reshape(shape).view(_PoolArray)
         arr._ptn_ptr = ptr  # keep address for free()
         return arr
+
+    def num_chunks(self) -> int:
+        return int(self._lib.ptn_pool_num_chunks(self._h))
 
     def free(self, arr: np.ndarray) -> bool:
         ptr = getattr(arr, "_ptn_ptr", None)

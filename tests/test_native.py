@@ -77,7 +77,8 @@ def test_best_fit_pool_alloc_free_coalesce():
 
 
 def test_pool_exhaustion_returns_none():
-    pool = native.BestFitPool(1024)
+    # fixed-size arena (auto_growth off): exhaustion falls back cleanly
+    pool = native.BestFitPool(1024, auto_growth=False)
     a = pool.alloc((4096,), "float32")
     assert a is None
 
@@ -158,3 +159,37 @@ def test_py_reader_native_queue():
     r.decorate_batch_generator(gen)
     seen = [b["a"][0, 0] for b in r]
     assert seen == [0, 1, 2, 3, 4]
+
+
+def test_pool_auto_growth_and_retry():
+    """buddy-allocator growth + retry-allocator semantics (ref
+    memory/detail/buddy_allocator.h, memory/allocation/retry_allocator.h):
+    a growing pool adds chunks on exhaustion; a fixed pool alloc with
+    retry succeeds when a concurrent free races in."""
+    import threading
+    import time as _time
+    from paddle_tpu.native import BestFitPool
+
+    # auto-growth: second chunk appears instead of failure
+    grow = BestFitPool(1 << 12, auto_growth=True)
+    a = grow.alloc((1 << 10,), "uint8")
+    assert a is not None and grow.num_chunks() == 1
+    b = grow.alloc((1 << 13,), "uint8")          # bigger than the chunk
+    assert b is not None and grow.num_chunks() == 2
+    grow.free(a)
+    grow.free(b)
+
+    # fixed pool: exhausted alloc fails fast without retry...
+    fixed = BestFitPool(1 << 12, auto_growth=False)
+    big = fixed.alloc(((1 << 12) - 64,), "uint8")
+    assert big is not None
+    assert fixed.alloc((1 << 11,), "uint8") is None
+    # ...but with retry it waits out a concurrent free
+    freed = threading.Timer(0.15, lambda: fixed.free(big))
+    freed.start()
+    t0 = _time.time()
+    c = fixed.alloc((1 << 11,), "uint8", retry_ms=3000)
+    freed.join()
+    assert c is not None, "retry alloc must pick up the freed block"
+    assert _time.time() - t0 < 3.0
+    fixed.free(c)
