@@ -16,4 +16,5 @@ is the dominant one, so this package provides
 """
 
 from .flash_attention import flash_attention, mha_reference  # noqa
+from .layer_norm import fused_layer_norm  # noqa
 from .ring_attention import ring_attention  # noqa

@@ -162,3 +162,52 @@ def test_flash_pallas_backward_kernels_interpret(causal):
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+# -- fused LayerNorm kernel (pallas/layer_norm.py) ---------------------------
+
+def test_fused_layer_norm_matches_reference():
+    from paddle_tpu.pallas.layer_norm import _ln_ref, fused_layer_norm
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 8, 256).astype(np.float32))
+    s = jnp.asarray(rng.randn(256).astype(np.float32))
+    b = jnp.asarray(rng.randn(256).astype(np.float32))
+    got = fused_layer_norm(x, s, b, interpret=True)
+    want = _ln_ref(x, s, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_grads_match_reference():
+    from paddle_tpu.pallas.layer_norm import _ln_ref, fused_layer_norm
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 16, 128).astype(np.float32))
+    s = jnp.asarray(rng.randn(128).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+    w = jnp.asarray(rng.randn(2, 16, 128).astype(np.float32))
+
+    def lk(x, s, b):
+        return jnp.sum(fused_layer_norm(x, s, b, interpret=True) * w)
+
+    def lr(x, s, b):
+        return jnp.sum(_ln_ref(x, s, b, 1e-5) * w)
+
+    gk = jax.grad(lk, argnums=(0, 1, 2))(x, s, b)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, s, b)
+    for a, c in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fused_layer_norm_bf16_input():
+    from paddle_tpu.pallas.layer_norm import _ln_ref, fused_layer_norm
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 128).astype(np.float32)).astype(jnp.bfloat16)
+    s = jnp.asarray(rng.randn(128).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+    got = fused_layer_norm(x, s, b, interpret=True)
+    want = _ln_ref(x, s, b, 1e-5)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
