@@ -357,11 +357,39 @@ def bench_transformer_wmt(dev, on_tpu, peak):
         }))
 
 
+def bench_deepfm_ps():
+    """BASELINE workload #5: DeepFM distributed sparse training in PS
+    mode — 1 native pserver + 2 trainer processes on the host CPU (the
+    PS plane is the reference's CPU sparse path; it never touches the
+    chip).  Delegates to tools/bench_deepfm_ps.py and passes the JSON
+    line through."""
+    import subprocess
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "bench_deepfm_ps.py")
+    try:
+        r = subprocess.run([sys.executable, tool], capture_output=True,
+                           text=True, timeout=900)
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("{\"metric\"")]
+        if line:
+            print(line[-1])
+        else:
+            print(json.dumps({"metric": "deepfm_ps_examples_per_s",
+                              "value": 0, "unit": "examples/s",
+                              "vs_baseline": 0,
+                              "error": (r.stderr or r.stdout)[-300:]}))
+    except Exception as e:  # never let the PS line break the bench run
+        print(json.dumps({"metric": "deepfm_ps_examples_per_s",
+                          "value": 0, "unit": "examples/s",
+                          "vs_baseline": 0, "error": str(e)[:300]}))
+
+
 def main():
     dev, on_tpu, peak = _device_info()
     bench_resnet50(dev, on_tpu, peak)
     bench_bert_long(dev, on_tpu, peak)
     bench_transformer_wmt(dev, on_tpu, peak)
+    bench_deepfm_ps()
     bench_bert(dev, on_tpu, peak)          # flagship metric printed last
 
 

@@ -1,0 +1,126 @@
+"""DeepFM distributed PS-mode bench (BASELINE workload #5: "DeepFM /
+Wide&Deep CTR — distributed sparse training (PS mode)").
+
+Real processes: 1 native pserver + 2 trainers over the TCP PS plane
+(sparse embedding tables row-sharded server-side), synthetic Criteo-shaped
+batches.  The reference publishes no number for this workload
+(BASELINE.md: "tool only"); the target is the *capability* — the line
+reports aggregate examples/s and a decreasing loss as evidence.
+
+Run: python tools/bench_deepfm_ps.py          (parent; prints one JSON line)
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BATCH = 512
+STEPS = 30
+WARMUP = 5
+N_TRAINERS = 2
+SPARSE_DIM = 10000
+IS_SPARSE = True
+
+
+def _child(role, trainer_id, port, n_trainers):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework import Executor
+    from paddle_tpu.distributed import DistributeTranspiler
+    from paddle_tpu.models.ctr import build_ctr_train
+
+    eps = f"127.0.0.1:{port}"
+    avg_loss, prob, feeds = build_ctr_train(
+        sparse_dim=SPARSE_DIM, embed_size=16, is_sparse=IS_SPARSE)
+    pt.optimizer.Adam(0.01).minimize(avg_loss)
+    t = DistributeTranspiler()
+    t.transpile(trainer_id, pservers=eps, trainers=n_trainers)
+    exe = Executor()
+    if role == "pserver":
+        prog, startup = t.get_pserver_programs(eps)
+        exe.run(startup)
+        exe.run(prog)
+        return
+    trainer_prog = t.get_trainer_program()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(trainer_id)
+
+    def batch():
+        dense = rng.rand(BATCH, 13).astype(np.float32)
+        sparse = rng.randint(0, SPARSE_DIM, (BATCH, 26)).astype(np.int64)
+        # learnable synthetic objective: click correlates with the dense
+        # features (loss visibly decreases from ln 2)
+        click = (dense.sum(1, keepdims=True) > 6.5).astype(np.int64)
+        return {"dense": dense, "sparse": sparse, "click": click}
+
+    losses = []
+    t0 = None
+    for i in range(STEPS):
+        if i == WARMUP:
+            t0 = time.perf_counter()
+        lv, = exe.run(trainer_prog, feed=batch(),
+                      fetch_list=[avg_loss.name])
+        losses.append(float(np.asarray(lv)))
+    dt = time.perf_counter() - t0
+    eps_rate = BATCH * (STEPS - WARMUP) / dt
+    print(json.dumps({"examples_per_s": eps_rate,
+                      "loss_first": losses[0], "loss_last": losses[-1]}),
+          flush=True)
+
+
+def main():
+    if len(sys.argv) > 1:
+        _child(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+               int(sys.argv[4]))
+        return
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, __file__, "pserver", "0", str(port),
+         str(N_TRAINERS)], env=env)]
+    time.sleep(0.5)
+    trainers = []
+    for tid in range(N_TRAINERS):
+        trainers.append(subprocess.Popen(
+            [sys.executable, __file__, "trainer", str(tid), str(port),
+             str(N_TRAINERS)], env=env, stdout=subprocess.PIPE, text=True))
+    results = []
+    for p in trainers:
+        out, _ = p.communicate(timeout=900)
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        results.append(json.loads(line))
+    # trainers are done: stop the server (the PS client is pure ctypes —
+    # safe to use from the parent without touching a jax backend)
+    from paddle_tpu.distributed import ps as ps_mod
+    ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+    procs[0].wait(timeout=60)
+
+    total = sum(r["examples_per_s"] for r in results)
+    print(json.dumps({
+        "metric": "deepfm_ps_examples_per_s",
+        "value": round(total, 1),
+        "unit": "examples/s",
+        "vs_baseline": 1.0,     # functional target (no published number)
+        "n_trainers": N_TRAINERS,
+        "sparse_dim": SPARSE_DIM, "batch": BATCH,
+        "loss_first_last": [round(results[0]["loss_first"], 4),
+                            round(results[0]["loss_last"], 4)],
+        "mode": "native TCP PS, sparse tables, sync",
+    }))
+
+
+if __name__ == "__main__":
+    main()
