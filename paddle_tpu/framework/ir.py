@@ -494,6 +494,107 @@ class FCFusePass(Pass):
         return graph
 
 
+@register_pass("attention_fuse_pass")
+class AttentionFusePass(Pass):
+    """matmul(Q,Kᵀ,α) [+ mask add] → softmax → matmul(·,V)  ⇒  one
+    ``flash_attention`` op.
+
+    TPU-native pass with no reference counterpart: saved inference
+    artifacts built with the dense attention recipe (ref
+    dist_transformer.py scaled_dot_product_attention — materializes
+    [b,h,T,T] scores) get rewritten onto the Pallas flash kernel, which
+    wins from T≈1024 and is the only runnable path beyond ~8k
+    (models/transformer.py attn_impl="auto" makes the same call at build
+    time; this pass makes it at LOAD time for existing artifacts).
+    Set ``min_seq_len`` (default 1024) to control the crossover."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        min_seq = int(self.get("min_seq_len", 1024) or 0)
+        protected = self.protected_vars()
+        count = 0
+        for mm1 in list(graph.ops_of_type("matmul")):
+            if mm1 not in graph.op_nodes:
+                continue
+            a = mm1.op.attrs
+            if not a.get("transpose_Y") or a.get("transpose_X"):
+                continue
+            scores = mm1.outputs[0] if mm1.outputs else None
+            if scores is None or len(scores.outputs) != 1 or \
+                    scores.name in protected:
+                continue
+            # optional additive mask between scores and softmax
+            nxt = scores.outputs[0]
+            bias_node, doomed_mask = None, []
+            if nxt.is_op("elementwise_add"):
+                add = nxt
+                m_out = add.outputs[0] if add.outputs else None
+                if m_out is None or len(m_out.outputs) != 1 or \
+                        m_out.name in protected:
+                    continue
+                by_name = {v.name: v for v in add.inputs}
+                x_name = add.op.input("X")[0]
+                y_name = add.op.input("Y")[0]
+                if by_name.get(x_name) is not scores:
+                    continue
+                bias_node = by_name.get(y_name)
+                doomed_mask = [add, m_out]
+                nxt = m_out.outputs[0]
+            if not nxt.is_op("softmax"):
+                continue
+            sm = nxt
+            probs = sm.outputs[0] if sm.outputs else None
+            if probs is None or len(probs.outputs) != 1 or \
+                    probs.name in protected:
+                continue
+            mm2 = probs.outputs[0]
+            if not mm2.is_op("matmul"):
+                continue
+            a2 = mm2.op.attrs
+            if a2.get("transpose_X") or a2.get("transpose_Y") or \
+                    a2.get("alpha", 1.0) != 1.0:
+                continue
+            if mm2.op.input("X")[0] != probs.name:
+                continue
+            # bind Q, K, V var nodes by slot
+            q_node = next((v for v in mm1.inputs
+                           if v.name == mm1.op.input("X")[0]), None)
+            k_node = next((v for v in mm1.inputs
+                           if v.name == mm1.op.input("Y")[0]), None)
+            v_node = next((v for v in mm2.inputs
+                           if v.name == mm2.op.input("Y")[0]), None)
+            if q_node is None or k_node is None or v_node is None:
+                continue
+            # crossover gate: flash wins from ~1k tokens; shorter
+            # sequences keep XLA's dense attention
+            shape = getattr(q_node.var, "shape", None)
+            if shape is None or len(shape) < 2 or shape[-2] is None:
+                continue
+            if shape[-2] != -1 and shape[-2] < min_seq:
+                continue
+            if bias_node is not None:
+                # the flash kernel takes [*,*,Tq,Tk]-shaped biases; the
+                # [B,1,1,Tk] padding-mask form would need an explicit
+                # broadcast — keep those on the dense path
+                bshape = getattr(bias_node.var, "shape", None)
+                if bshape is None or len(bshape) < 2 or \
+                        bshape[-2] in (1, None):
+                    continue
+            inputs = {"Q": [q_node], "K": [k_node], "V": [v_node]}
+            if bias_node is not None:
+                inputs["Bias"] = [bias_node]
+            out_node = mm2.outputs[0]
+            graph.create_op_node(
+                "flash_attention", inputs=inputs,
+                outputs={"Out": [out_node]},
+                attrs={"sm_scale": float(a.get("alpha", 1.0)),
+                       "causal": False})
+            graph.safe_remove_nodes(
+                [mm1, scores, sm, probs, mm2] + doomed_mask)
+            count += 1
+        graph.attrs["attention_fuse_count"] = count
+        return graph
+
+
 @register_pass("fuse_elewise_add_act_pass")
 class FuseElewiseAddActPass(Pass):
     """elementwise_add + activation → fused_elemwise_activation

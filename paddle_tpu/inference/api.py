@@ -123,6 +123,9 @@ class AnalysisPredictor:
             g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
             g = ir.get_pass("fuse_elewise_add_act_pass",
                             protected=keep).apply(g)
+            # long-seq artifacts built with dense attention get the
+            # Pallas flash kernel at load time (crossover ≥1024)
+            g = ir.get_pass("attention_fuse_pass", protected=keep).apply(g)
             self.program = g.to_program()
         self._params = {name: jnp.asarray(np.asarray(val))
                         for name, val in self.scope.items() if val is not None}

@@ -225,3 +225,61 @@ def test_fc_fuse_binds_slots_not_roles():
         g = ir.Graph(fluid.default_main_program())
         g = ir.get_pass("fc_fuse_pass").apply(g)
         assert g.attrs["fc_fuse_count"] == 0
+
+
+def test_attention_fuse_pass_rewrites_and_matches():
+    """QKᵀ→softmax→PV chains rewrite to one flash_attention op at load
+    time (TPU-native pass; crossover gate at min_seq_len), numerically
+    identical on the CPU fallback path."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor, Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework import ir
+
+    B, H, T, D = 2, 2, 32, 8
+    rng = np.random.RandomState(0)
+    qv, kv, vv = (rng.randn(B, H, T, D).astype(np.float32) * 0.3
+                  for _ in range(3))
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.data("k", shape=[H, T, D], dtype="float32")
+        v = layers.data("v", shape=[H, T, D], dtype="float32")
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.25)
+        probs = layers.softmax(scores)
+        out = layers.matmul(probs, v)
+        marker = layers.scale(out, scale=1.0)
+        prog = pt.default_main_program()
+
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        feed = {"q": qv, "k": kv, "v": vv}
+        want, = exe.run(prog, feed=feed, fetch_list=[marker.name],
+                        scope=scope)
+
+        g = ir.Graph(prog.clone())
+        g = ir.get_pass("attention_fuse_pass", min_seq_len=16).apply(g)
+        assert g.attrs["attention_fuse_count"] == 1
+        fused = g.to_program()
+        types = [op.type for op in fused.global_block().ops]
+        assert "flash_attention" in types
+        assert "softmax" not in types
+
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    # below the crossover the pass must leave the program alone
+    with scope_guard(Scope()), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.data("k", shape=[H, T, D], dtype="float32")
+        v = layers.data("v", shape=[H, T, D], dtype="float32")
+        out = layers.matmul(layers.softmax(
+            layers.matmul(q, k, transpose_y=True, alpha=0.25)), v)
+        g2 = ir.Graph(pt.default_main_program())
+        g2 = ir.get_pass("attention_fuse_pass", min_seq_len=1024).apply(g2)
+        assert g2.attrs["attention_fuse_count"] == 0
