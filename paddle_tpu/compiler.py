@@ -141,12 +141,19 @@ class CompiledProgram:
         self._mesh = make_mesh({"dp": len(devices)}, devices)
         return self
 
-    def with_distributed(self, mesh=None, axes=None, input_specs=None):
+    def with_distributed(self, mesh=None, axes=None, input_specs=None,
+                         zero_stage=0):
         """General SPMD: shard params by their ``dist_spec`` annotations and
         feeds by ``input_specs`` (default: batch axis on 'dp') over an
         explicit mesh — dp/tp/sp in one jit, XLA inserts the collectives.
         This is the capability jump over the reference, whose multi-device
-        pass only replicated (AllReduce) or row-sharded (Reduce) params."""
+        pass only replicated (AllReduce) or row-sharded (Reduce) params.
+
+        ``zero_stage=1`` additionally shards OPTIMIZER STATE over the dp
+        axis (ZeRO-1): accumulators whose leading dim divides the dp size
+        live partitioned in the scope between steps, cutting per-device
+        optimizer memory by the dp degree; GSPMD inserts the
+        gather/scatter around the update."""
         from .parallel.mesh import make_mesh
         self._is_data_parallel = True
         if mesh is None and axes is None:
@@ -155,6 +162,10 @@ class CompiledProgram:
                 " or `axes` (e.g. {'dp': 2, 'mp': 4})")
         self._mesh = mesh if mesh is not None else make_mesh(axes)
         self._input_specs = dict(input_specs or {})
+        if zero_stage not in (0, 1):
+            raise ValueError("zero_stage must be 0 or 1 (ZeRO-1: "
+                             "optimizer-state sharding)")
+        self._zero_stage = int(zero_stage)
         return self
 
     def _build_in_shardings(self, feed_names, ro, rw):
@@ -173,6 +184,9 @@ class CompiledProgram:
                 return NamedSharding(mesh, P("dp"))
             return NamedSharding(mesh, P())
 
+        zero = getattr(self, "_zero_stage", 0)
+        dp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+
         def var_shard(name):
             if not block.has_var(name):
                 return NamedSharding(mesh, P())
@@ -181,10 +195,23 @@ class CompiledProgram:
             # optimizer accumulators inherit their parameter's layout,
             # resolved here so late TP annotation still applies
             link = getattr(v, "shard_like", None)
-            if spec is None and link and block.has_var(link):
+            is_acc = bool(link and block.has_var(link))
+            if spec is None and is_acc:
                 p = block.var(link)
                 if tuple(v.shape or ()) == tuple(p.shape or ()):
                     spec = p.dist_spec
+            # ZeRO-1: optimizer state additionally partitions its leading
+            # dim over dp (when free and divisible) — the state lives
+            # sharded in the scope across steps
+            if zero and is_acc and dp_size > 1:
+                shape = tuple(v.shape or ())
+                cur = list(spec) if spec is not None else \
+                    [None] * len(shape)
+                if (shape and len(cur) == len(shape) and cur
+                        and cur[0] is None and shape[0] is not None
+                        and shape[0] % dp_size == 0):
+                    cur[0] = "dp"
+                    spec = tuple(cur)
             return sharding_for(mesh, spec)
 
         return ([feed_shard(n) for n in feed_names],
