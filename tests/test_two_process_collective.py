@@ -78,3 +78,12 @@ def test_two_process_collective_loss_parity(tmp_path):
     np.testing.assert_allclose(l0, single, atol=1e-5)
     # training actually progressed
     assert single[-1] < single[0]
+
+    # ring attention with the sp ring spanning the two REAL processes
+    # (KV rotation via cross-process ppermute) matched a dense local
+    # reference on every rank — the multi-host long-context proof
+    rings = re.findall(r"RING (\{.*\})", combined)
+    assert len(rings) == 2, combined[-4000:]
+    for s in rings:
+        res = json.loads(s)
+        assert res["ok"], f"cross-process ring attention diverged: {res}"
