@@ -18,6 +18,10 @@ JAX_PLATFORMS=cpu python tools/export_demo_program.py "$tmp"
 ./native/demo_trainer "$tmp"
 rm -rf "$tmp"
 
+echo "== multichip dryrun (virtual 8-device mesh, driver contract) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python __graft_entry__.py --multichip 8
+
 echo "== wheel build + clean-venv install_check =="
 wheeldir=$(mktemp -d); venvdir=$(mktemp -d)
 pip wheel . -w "$wheeldir" --no-deps --no-build-isolation -q
