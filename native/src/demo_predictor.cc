@@ -21,6 +21,8 @@
 // unsqueeze2/squeeze2, transpose2, feed, fetch.
 
 #include <algorithm>
+#include <chrono>
+#include <numeric>
 
 #include "program_json.h"
 
@@ -667,9 +669,21 @@ static std::string ReadFile(const std::string& path) {
 }
 
 int main(int argc, char** argv) {
+  // --bench N: repeat the run N times and report latency percentiles
+  // (ref inference/api/demo_ci timing loops)
+  int bench_iters = 0;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (std::string(argv[i]) == "--bench") {
+      bench_iters = atoi(argv[i + 1]);
+      for (int j = i; j + 2 < argc; ++j) argv[j] = argv[j + 2];
+      argc -= 2;
+      break;
+    }
+  }
   if (argc < 3) {
     fprintf(stderr,
-            "usage: %s <model_dir> <in1.npy> [in2.npy ...] [output.npy]\n", argv[0]);
+            "usage: %s [--bench N] <model_dir> <in1.npy> [in2.npy ...] "
+            "[output.npy]\n", argv[0]);
     return 2;
   }
   const std::string dir = argv[1];
@@ -698,6 +712,22 @@ int main(int argc, char** argv) {
 
     const Json& block = model.at("blocks").arr[0];
     for (const auto& op : block.at("ops").arr) RunOp(op, &scope);
+
+    if (bench_iters > 0) {
+      std::vector<double> ms(bench_iters);
+      for (int it = 0; it < bench_iters; ++it) {
+        auto t0 = std::chrono::steady_clock::now();
+        for (const auto& op : block.at("ops").arr) RunOp(op, &scope);
+        ms[it] = std::chrono::duration<double, std::milli>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+      }
+      std::sort(ms.begin(), ms.end());
+      printf("bench iters %d p50 %.3f ms p99 %.3f ms mean %.3f ms\n",
+             bench_iters, ms[bench_iters / 2],
+             ms[(bench_iters * 99) / 100],
+             std::accumulate(ms.begin(), ms.end(), 0.0) / bench_iters);
+    }
 
     for (const auto& name : fetches) {
       const Tensor& t = scope.at(name.str);

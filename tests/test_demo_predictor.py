@@ -197,3 +197,25 @@ def test_cpp_predictor_edge_semantics(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_bench_mode(tmp_path):
+    """--bench N reports latency percentiles (ref demo_ci timing loop)."""
+    model_dir = str(tmp_path / "bench_model")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[32], dtype="float32")
+        out = layers.fc(x, size=8, act="relu")
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [out],
+                                      executor=exe, scope=scope)
+    binary = _build_binary()
+    np.save(str(tmp_path / "x.npy"), np.ones((4, 32), np.float32))
+    r = subprocess.run(
+        [binary, "--bench", "20", model_dir, str(tmp_path / "x.npy")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"bench iters 20 p50 ([\d.]+) ms p99 ([\d.]+) ms", r.stdout)
+    assert m, r.stdout
+    assert float(m.group(1)) <= float(m.group(2))
