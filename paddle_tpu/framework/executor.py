@@ -311,6 +311,8 @@ class _CompiledBlock:
         kwargs = {}
         if donate and persist_rw:
             kwargs["donate_argnums"] = (2,)
+        self.in_shardings = in_shardings     # kept for multi-host feeds
+        self.mesh = mesh
         if in_shardings is not None:
             kwargs["in_shardings"] = in_shardings
             # updated state must come back in its declared layout, or the
@@ -479,8 +481,18 @@ class Executor:
         self._step_seed += 1
         seed_val = seed if seed is not None else (
             program.random_seed * 1000003 + self._step_seed)
+        seed_arr = jnp.uint32(seed_val)
+        mesh = getattr(cb, "mesh", None)
+        if mesh is not None and _mesh_is_multiprocess(mesh):
+            # multi-host GSPMD: each process holds its LOCAL slice of the
+            # batch and a full copy of host-side state; assemble global
+            # arrays before the pjit call (the reference reaches multi-
+            # host through NCCL ranks — here through jax.distributed +
+            # GSPMD, SURVEY §7's comm-backend design)
+            feeds, ro_vals, rw_vals, seed_arr = _to_global_arrays(
+                cb, mesh, feeds, ro_vals, rw_vals, seed_arr)
         try:
-            fetches, new_rw = cb(feeds, ro_vals, rw_vals, jnp.uint32(seed_val))
+            fetches, new_rw = cb(feeds, ro_vals, rw_vals, seed_arr)
         except Exception as e:
             # never cache a block whose trace failed (a later run with a
             # fixed scope/feed must re-lower)
@@ -597,6 +609,39 @@ def _feed_sig(x):
         return (tuple(x.shape), str(x.dtype))
     a = np.asarray(x)
     return (a.shape, str(a.dtype))
+
+
+def _mesh_is_multiprocess(mesh) -> bool:
+    pi = jax.process_index()
+    return any(d.process_index != pi for d in mesh.devices.flat)
+
+
+def _to_global_arrays(cb, mesh, feeds, ro_vals, rw_vals, seed_arr):
+    """Host-local values → global arrays for a mesh spanning processes.
+
+    Feeds follow their partition spec (each host's array is its shard of
+    the sharded dims — the standard per-host input pipeline contract);
+    replicated state asserts same-shape on every host.  Values that are
+    already global (scope state from a previous step) pass through."""
+    from jax.experimental import multihost_utils as mhu
+    from jax.sharding import PartitionSpec as P
+
+    fsh, rosh, rwsh, ssh = cb.in_shardings
+
+    def conv(v, sharding):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            return v                     # already global
+        a = np.asarray(v)
+        spec = sharding.spec
+        if len(spec) > a.ndim:           # dummy zeros for write-only rw
+            spec = P()
+        return mhu.host_local_array_to_global_array(a, mesh, spec)
+
+    return ([conv(v, s) for v, s in zip(feeds, fsh)],
+            [conv(v, s) for v, s in zip(ro_vals, rosh)],
+            [conv(v, s) for v, s in zip(rw_vals, rwsh)],
+            mhu.host_local_array_to_global_array(
+                np.asarray(seed_arr), mesh, P()))
 
 
 _checked_int64_feeds = set()

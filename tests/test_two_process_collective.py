@@ -34,7 +34,7 @@ def _clean_env(port):
     return env
 
 
-def _run_single():
+def _run_single_raw():
     env = _clean_env(0)
     for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
               "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT"):
@@ -42,7 +42,11 @@ def _run_single():
     r = subprocess.run([sys.executable, _RUNNER], env=env,
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
-    return _extract_losses(r.stdout)
+    return r.stdout
+
+
+def _run_single():
+    return _extract_losses(_run_single_raw())
 
 
 def _free_port():
@@ -73,7 +77,8 @@ def test_two_process_collective_loss_parity(tmp_path):
     np.testing.assert_allclose(l0, l1, atol=1e-6)
 
     # ... and it matches the single-process run on the same global batch
-    single = _run_single()
+    single_out = _run_single_raw()
+    single = _extract_losses(single_out)
     assert len(single) == len(l0) and len(l0) >= 4
     np.testing.assert_allclose(l0, single, atol=1e-5)
     # training actually progressed
@@ -87,3 +92,12 @@ def test_two_process_collective_loss_parity(tmp_path):
     for s in rings:
         res = json.loads(s)
         assert res["ok"], f"cross-process ring attention diverged: {res}"
+
+    # multi-host GSPMD: with_distributed(dp=2) over the global mesh with
+    # per-host half-batches matches the single-process full-batch run
+    gs = re.findall(r"GSPMD (\[.*\])", combined)
+    assert len(gs) == 2, combined[-4000:]
+    g0, g1 = (json.loads(s) for s in gs)
+    np.testing.assert_allclose(g0, g1, atol=1e-6)
+    single_g = json.loads(re.search(r"GSPMD (\[.*\])", single_out).group(1))
+    np.testing.assert_allclose(g0, single_g, atol=1e-5)
