@@ -47,6 +47,13 @@ def _scope_value(scope: Scope, name: str) -> np.ndarray:
     if v is None:
         raise ValueError(f"variable {name!r} has no value in scope — run the "
                          f"startup program before saving")
+    import jax
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        # multi-host sharded state (GSPMD meshes spanning processes,
+        # ZeRO-1 accumulators): gather the global value before
+        # serializing — np.asarray alone cannot see remote shards
+        from jax.experimental import multihost_utils as mhu
+        return np.asarray(mhu.process_allgather(v, tiled=True))
     return np.asarray(v)
 
 
