@@ -70,21 +70,40 @@ class Collective:
     def _transpile_main(self, main):
         raise NotImplementedError
 
-    def _append_dense_allreduce(self, block, at, grads):
+    def _append_dense_allreduce(self, block, at, grads, compress=None):
         """scale 1/nranks + c_allreduce_sum per grad (ref collective.py
         :189,:208); shared by GradAllReduce and the DGC transpiler's
-        non-compressed tail."""
+        non-compressed tail.
+
+        ``compress="bf16"`` casts each gradient to bf16 around the
+        allreduce — half the inter-host bytes for ~1e-3-relative noise on
+        an already-averaged gradient (the XLA-native take on quantized
+        allreduce, EQuARX arXiv:2506.17615; the reference's analog is
+        fp16 allreduce in its DGC/LocalSGD family)."""
         ring = 0
         for g in grads:
             block.insert_op(at, "scale",
                             inputs={"X": [g]}, outputs={"Out": [g]},
                             attrs={"scale": 1.0 / self.nranks, "bias": 0.0,
                                    "bias_after_scale": False})
-            block.insert_op(at + 1, "c_allreduce_sum",
+            at += 1
+            if compress == "bf16":
+                block.insert_op(at, "cast",
+                                inputs={"X": [g]}, outputs={"Out": [g]},
+                                attrs={"in_dtype": "float32",
+                                       "out_dtype": "bfloat16"})
+                at += 1
+            block.insert_op(at, "c_allreduce_sum",
                             inputs={"X": [g]}, outputs={"Out": [g]},
                             attrs={"ring_id": ring % self.nrings,
                                    "use_calc_stream": True})
-            at += 2
+            at += 1
+            if compress == "bf16":
+                block.insert_op(at, "cast",
+                                inputs={"X": [g]}, outputs={"Out": [g]},
+                                attrs={"in_dtype": "bfloat16",
+                                       "out_dtype": "float32"})
+                at += 1
             ring += 1
         return at
 
@@ -96,7 +115,15 @@ class GradAllReduce(Collective):
     optimizer consumes it; with batch feeds sharded over ranks this makes
     the update the global-batch mean gradient — loss parity with a
     single-process run on the full batch.
-    """
+
+    ``compress="bf16"`` halves the allreduce bytes (see
+    ``_append_dense_allreduce``)."""
+
+    def __init__(self, nrings: int = 1, compress=None):
+        super().__init__(nrings)
+        if compress not in (None, "bf16"):
+            raise ValueError("compress must be None or 'bf16'")
+        self._compress = compress
 
     def _transpile_main(self, main):
         block = main.global_block()
@@ -111,7 +138,8 @@ class GradAllReduce(Collective):
                         grads.append(g)
         if first_opt is None or not grads:
             return
-        self._append_dense_allreduce(block, first_opt, grads)
+        self._append_dense_allreduce(block, first_opt, grads,
+                                     compress=self._compress)
 
 
 class LocalSGD(Collective):

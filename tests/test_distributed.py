@@ -212,3 +212,14 @@ def test_collective_bn_stats_and_scalar_feed():
         assert stats, "BN running stats should be persisted"
         for n in stats:
             assert np.isfinite(np.asarray(sc.find_var(n))).all()
+
+
+def test_grad_allreduce_bf16_compress_close_to_f32():
+    """compress="bf16" halves allreduce bytes (EQuARX-style quantized
+    allreduce); losses track the f32 collective run to bf16 precision."""
+    f32 = _run(lambda: GradAllReduce().transpile(
+        rank=0, endpoints=_EPS, current_endpoint="127.0.0.1:6170"))
+    bf16 = _run(lambda: GradAllReduce(compress="bf16").transpile(
+        rank=0, endpoints=_EPS, current_endpoint="127.0.0.1:6170"))
+    assert all(np.isfinite(bf16))
+    np.testing.assert_allclose(bf16, f32, rtol=5e-3, atol=5e-3)
