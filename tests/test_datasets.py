@@ -67,3 +67,28 @@ def test_reader_decorators_compose():
     assert len(mapped) == 3
     cached = reader.cache(reader.firstn(base, 4))
     assert len(list(cached())) == 4 and len(list(cached())) == 4
+
+
+def test_prefetch_to_device_preserves_stream():
+    """prefetch_to_device keeps `depth` batches resident on device ahead
+    of the consumer; values and order are untouched, outputs are device
+    arrays (TPU-native double-buffering, ref py_reader's pinned-memory
+    analog)."""
+    import jax
+    from paddle_tpu.data import reader as R
+
+    def src():
+        for i in range(7):
+            yield {"x": np.full((2, 3), i, np.float32), "i": np.array([i])}
+
+    got = list(R.prefetch_to_device(lambda: src(), depth=3)())
+    assert len(got) == 7
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_allclose(np.asarray(b["x"]), np.full((2, 3), i))
+        assert int(np.asarray(b["i"])[0]) == i
+
+    # short stream (< depth) still drains completely
+    short = list(R.prefetch_to_device(lambda: iter([{"x": np.ones(2)}]),
+                                      depth=4)())
+    assert len(short) == 1
