@@ -53,6 +53,10 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_rpc_deadline": 180000,
     "FLAGS_rpc_retry_times": 3,
     "FLAGS_tracer_profile_fname": "",
+    # persistent XLA compilation cache (no reference analog — its CUDA
+    # kernels ship precompiled; here first-compile is the analogous cost,
+    # 20-40 s for a big train step, and the cache removes it on re-runs)
+    "FLAGS_xla_compile_cache_dir": "",
 }
 
 _values: Dict[str, Any] = dict(_DEFAULTS)
@@ -77,7 +81,16 @@ def _apply_side_effects(name: str, value):
     # producing FLUID op by name (executor.py _sanitize_outputs) — more
     # actionable than jax_debug_nans, which names XLA ops and aborts the
     # step before any framework-side reporting can run.
-    pass
+    if name == "FLAGS_xla_compile_cache_dir":
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          str(value) if value else None)
+        if value:
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception:
+                pass  # knob varies across jax versions; dir alone works
 
 
 def set_flags(flags: Dict[str, Any]):

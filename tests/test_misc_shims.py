@@ -148,3 +148,16 @@ def test_flags_system(monkeypatch):
         F._values.update(F._DEFAULTS)
         jax.config.update("jax_debug_nans", False)
         jax.config.update("jax_debug_infs", False)
+
+
+def test_xla_compile_cache_flag(tmp_path):
+    """FLAGS_xla_compile_cache_dir wires jax's persistent compilation
+    cache (first-compile is the TPU analog of the reference's CUDA
+    kernel-build cost)."""
+    import jax
+    d = str(tmp_path / "xla_cache")
+    fluid.set_flags({"FLAGS_xla_compile_cache_dir": d})
+    try:
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        fluid.set_flags({"FLAGS_xla_compile_cache_dir": ""})
