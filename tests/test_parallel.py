@@ -205,3 +205,31 @@ def test_zero1_optimizer_state_sharding():
     spec = m1.sharding.spec
     assert spec and spec[0] == "dp", f"moment not dp-sharded: {spec}"
     assert m0.sharding.spec[0] is None if m0.sharding.spec else True
+
+
+def test_zero1_composes_with_tensor_parallel():
+    """ZeRO-1 must COMBINE with TP: an accumulator of an mp-sharded
+    param gets dim-0 dp sharding on top of the inherited mp spec."""
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        w = layers.create_parameter([8, 16], "float32", name="ztp_w")
+        w.dist_spec = (None, "mp")          # Megatron column-parallel
+        loss = layers.mean(layers.matmul(x, w) ** 2)
+        opt.AdamOptimizer(learning_rate=0.01).minimize(loss)
+        compiled = pt.CompiledProgram(main).with_distributed(
+            axes={"dp": 2, "mp": 4}, zero_stage=1)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=5)
+        lv, = exe.run(compiled,
+                      feed={"x": np.ones((4, 8), np.float32)},
+                      fetch_list=[loss.name])
+        assert np.isfinite(float(np.asarray(lv)))
+        from paddle_tpu.framework.scope import global_scope
+        scope = global_scope()
+        moment = next(
+            (scope.find_var(n) for n in scope.local_var_names()
+             if "moment1" in n and "ztp_w" in n), None)
+    assert moment is not None
+    spec = moment.sharding.spec
+    assert tuple(spec) == ("dp", "mp"), f"want (dp, mp), got {spec}"
