@@ -141,3 +141,37 @@ def test_stablehlo_export(tmp_path):
     text = predictor.export_stablehlo([xs], str(tmp_path / "model.stablehlo"))
     assert "module" in text and ("stablehlo" in text or "mhlo" in text)
     assert (tmp_path / "model.stablehlo").exists()
+
+
+def test_analysis_predictor_fuses_long_seq_attention(tmp_path):
+    """A saved long-seq transformer artifact gets its dense attention
+    rewritten onto the flash kernel by the predictor's pass pipeline
+    (attention_fuse_pass, crossover >=1024) with output parity."""
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                      create_paddle_predictor)
+
+    B, H, T, D = 1, 2, 1024, 8
+    rng = np.random.RandomState(4)
+    qv = (rng.randn(B, H, T, D) * 0.3).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.create_parameter([B, H, T, D], "float32", name="fk")
+        v = layers.create_parameter([B, H, T, D], "float32", name="fv")
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.35)
+        out = layers.matmul(layers.softmax(scores), v)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=3)
+        want, = exe.run(feed={"q": qv}, fetch_list=[out.name], scope=scope)
+        pt.save_inference_model(str(tmp_path / "att"), ["q"], [out], exe,
+                                scope=scope)
+
+    predictor = create_paddle_predictor(AnalysisConfig(str(tmp_path / "att")))
+    types = [op.type for op in predictor.program.global_block().ops]
+    assert "flash_attention" in types and "softmax" not in types
+    outs = predictor.run([PaddleTensor(qv, name="q")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
