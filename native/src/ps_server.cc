@@ -296,6 +296,12 @@ void handle_conn(Server* s, int fd) {
       }
       case kPushDense: {
         if (p.value.empty()) p.value.assign(payload.size(), 0.f);
+        if (payload.size() != p.value.size()) {
+          // push_dense always carries the full parameter: oversize would
+          // write past the table, undersize would reset grad_acc mid-round
+          send_error(fd);
+          break;
+        }
         pending[name] = p.version;      // this round's watermark
         if (s->sync_mode && s->num_trainers > 1) {
           if (p.grad_acc.size() != payload.size())
@@ -422,6 +428,14 @@ void handle_conn(Server* s, int fd) {
           } else {
             g.assign(reinterpret_cast<const float*>(raw.data()),
                      reinterpret_cast<const float*>(raw.data() + raw.size()));
+          }
+          if (!n_rows && g.size() != p.value.size()) {
+            // DENSE pushes always carry the full parameter: oversize
+            // would write past the table (and its m0/m1 slots),
+            // undersize would train only a prefix — reject both;
+            // per-row pushes are bounds-checked row by row below
+            send_error(fd);
+            break;
           }
           if (p.optim == kAdam) p.adam_t++;
           if (n_rows) {

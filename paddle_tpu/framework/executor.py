@@ -424,9 +424,14 @@ class Executor:
 
         block = program.global_block()
         collective = program._attrs.get("collective")
+        from ..flags import get_flags
+        check_nan = bool(
+            get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"])
+        # the flag is read at trace time (_run_op_inner) — it must be part
+        # of the cache key, or toggling it after a first run is a no-op
         key = (program.fingerprint(), feed_names,
                tuple(_feed_sig(feed[n]) for n in feed_names),
-               fetch_names, id(scope), id(mesh),
+               fetch_names, id(scope), id(mesh), check_nan,
                tuple(sorted(collective.items())) if collective else None)
         with self._lock:
             cb = self._cache.get(key)
@@ -637,9 +642,26 @@ def _to_global_arrays(cb, mesh, feeds, ro_vals, rw_vals, seed_arr):
             spec = P()
         return mhu.host_local_array_to_global_array(a, mesh, spec)
 
+    def conv_state(v, sharding):
+        # Scope state is host-FULL: every process initialized the whole
+        # array (first step) or holds the previous step's global array.
+        # For a spec sharding an axis that spans processes (e.g. ZeRO-1
+        # accumulators over a cross-host dp axis),
+        # host_local_array_to_global_array would treat the full copy as
+        # this host's shard and inflate the global dim by the process
+        # count — slice each device's shard out of the full copy instead.
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            return v                     # already global
+        a = np.asarray(v)
+        spec = sharding.spec
+        if len(spec) > a.ndim or all(ax is None for ax in spec):
+            return conv(v, sharding)     # replicated: keep the checked path
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx])
+
     return ([conv(v, s) for v, s in zip(feeds, fsh)],
-            [conv(v, s) for v, s in zip(ro_vals, rosh)],
-            [conv(v, s) for v, s in zip(rw_vals, rwsh)],
+            [conv_state(v, s) for v, s in zip(ro_vals, rosh)],
+            [conv_state(v, s) for v, s in zip(rw_vals, rwsh)],
             mhu.host_local_array_to_global_array(
                 np.asarray(seed_arr), mesh, P()))
 

@@ -542,6 +542,11 @@ class AttentionFusePass(Pass):
             if not nxt.is_op("softmax"):
                 continue
             sm = nxt
+            # flash_attention normalizes over the last (key) axis of
+            # rank-4 [B,H,T,D] operands; a softmax over any other axis
+            # must stay on the dense path (the lowering honors axis —
+            # ops/nn_ops.py softmax)
+            sm_axis = sm.op.attrs.get("axis", -1)
             probs = sm.outputs[0] if sm.outputs else None
             if probs is None or len(probs.outputs) != 1 or \
                     probs.name in protected:
@@ -567,9 +572,17 @@ class AttentionFusePass(Pass):
             # crossover gate: flash wins from ~1k tokens; shorter
             # sequences keep XLA's dense attention
             shape = getattr(q_node.var, "shape", None)
-            if shape is None or len(shape) < 2 or shape[-2] is None:
+            if shape is None or len(shape) != 4 or shape[-2] is None:
                 continue
             if shape[-2] != -1 and shape[-2] < min_seq:
+                continue
+            # operand-rank + softmax-axis gates: the kernel is rank-4,
+            # last-axis only
+            if any(len(getattr(n.var, "shape", None) or ()) != 4
+                   for n in (k_node, v_node)):
+                continue
+            scores_rank = len(getattr(scores.var, "shape", None) or shape)
+            if sm_axis not in (-1, scores_rank - 1):
                 continue
             if bias_node is not None:
                 # the flash kernel takes [*,*,Tq,Tk]-shaped biases; the

@@ -11,9 +11,12 @@ Backward recomputes the row stats from the x tile instead of saving
 mean/rstd — the tile is already in VMEM, so recomputation is free while
 saved stats would be extra HBM traffic.
 
-Used by the ``layer_norm`` lowering when normalizing the last dim on TPU
-(ops/nn_ops.py); elsewhere the plain jnp math runs (also the reference
-semantics oracle for the parity tests).
+Available as a library kernel but NOT wired as the default ``layer_norm``
+lowering: measured end-to-end (BERT_ABLATION.md) the kernel boundary
+costs more in lost XLA fusion/overlap than the one-sweep HBM saving
+recoups (132.7 ms vs 127.3 ms step), so ops/nn_ops.py deliberately keeps
+the plain jnp math as the lowering; call ``fused_layer_norm`` directly
+where a standalone LN dominates.
 """
 
 from __future__ import annotations
