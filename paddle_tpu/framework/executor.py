@@ -90,6 +90,9 @@ class _ExecState:
     def __init__(self, values: Dict[str, Any]):
         self.values = values
         self.written: set = set()
+        # fwd-output name -> ctx._counter before that op's lowering; lets
+        # generic grad ops replay a sampling op's rng stream (see run_op)
+        self.rng_marks: Dict[str, int] = {}
 
     def read(self, block: Block, name: str):
         if name == "" or name is None:
@@ -179,6 +182,17 @@ def _run_op_inner(ctx, block, op, state) -> None:
     if ctx.amp:
         from .. import amp as _amp
         ins = _amp.cast_ins(op.type, ins)
+    if info.stateful_rng:
+        # remember where the counter stream stood so a generic-vjp grad op
+        # can REPLAY the same draws when it retraces this forward (else the
+        # backward would differentiate a different sample set — the dropout
+        # hand-maker avoids this with its saved mask; every other sampling
+        # op goes through here)
+        mark = ctx._counter
+        for names in op.outputs.values():
+            for n in names:
+                if n:
+                    state.rng_marks[n] = mark
     outs = info.lower(ctx, ins, op.attrs) or {}
     from ..flags import get_flags
     if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
@@ -201,7 +215,30 @@ def _run_generic_grad(ctx, block: Block, op: Operator, state: _ExecState):
     # NO amp cast here: generic_grad_lower casts INSIDE its vjp closure,
     # which keeps master-weight grads f32 (a pre-cast would differentiate
     # wrt the bf16 copy and round every weight grad)
-    outs = registry.generic_grad_lower(ctx, ins, op.attrs)
+    mark = None
+    finfo = registry._REGISTRY.get(op.attrs.get("__fwd_type__"))
+    if finfo is not None and finfo.stateful_rng:
+        for slot, names in op.inputs.items():
+            if not slot.startswith("OG$"):
+                continue
+            for gn in names:
+                base = gn[:-5] if gn and gn.endswith("@GRAD") else None
+                if base is not None and base in state.rng_marks:
+                    mark = state.rng_marks[base]
+                    break
+            if mark is not None:
+                break
+    if mark is None:
+        outs = registry.generic_grad_lower(ctx, ins, op.attrs)
+    else:
+        # rewind the counter so the vjp's retraced forward draws the SAME
+        # randomness the forward op consumed, then restore it
+        saved = ctx._counter
+        ctx._counter = mark
+        try:
+            outs = registry.generic_grad_lower(ctx, ins, op.attrs)
+        finally:
+            ctx._counter = saved
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
         for i, n in enumerate(names):
