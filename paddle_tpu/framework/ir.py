@@ -506,7 +506,37 @@ class AttentionFusePass(Pass):
     wins from T≈1024 and is the only runnable path beyond ~8k
     (models/transformer.py attn_impl="auto" makes the same call at build
     time; this pass makes it at LOAD time for existing artifacts).
-    Set ``min_seq_len`` (default 1024) to control the crossover."""
+    Set ``min_seq_len`` (default 1024) to control the crossover.
+
+    Matched shapes of the chain:
+    - bidirectional self-attention (no mask add);
+    - masked attention — the additive [*,*,Tq,Tk] bias rides into the
+      kernel's Bias input;
+    - CAUSAL decoder self-attention: when ``scope=`` is given (the
+      predictor passes its loaded scope) and the bias is a persistable
+      frozen causal mask (zeros on/below the diagonal, large-negative
+      above), the mask is dropped and the op gets ``causal=True`` — the
+      kernel then skips the masked key blocks outright (~2× at long T)
+      instead of reading a [T,T] bias;
+    - cross-attention (decoder→encoder): Tq and Tk differ; the kernel is
+      rectangular, so the same pattern fuses with no extra handling."""
+
+    @staticmethod
+    def _is_frozen_causal_mask(arr) -> bool:
+        """True for [*..,T,T] masks with ~0 on/below the diagonal and a
+        large negative constant strictly above (the dist_transformer.py
+        recipe freezes exactly this into decoder artifacts)."""
+        import numpy as np
+        if arr.ndim < 2 or arr.shape[-1] != arr.shape[-2]:
+            return False
+        t = arr.shape[-1]
+        m = arr.reshape(-1, t, t)
+        if not np.allclose(m, m[0], atol=1e-6):
+            return False       # must be the same mask for every batch/head
+        low = np.tril(m[0])
+        up = m[0][np.triu_indices(t, k=1)]
+        return (np.allclose(low, 0.0, atol=1e-6)
+                and up.size > 0 and bool((up <= -1e4).all()))
 
     def apply_impl(self, graph: Graph) -> Graph:
         min_seq = int(self.get("min_seq_len", 1024) or 0)
@@ -584,6 +614,7 @@ class AttentionFusePass(Pass):
             scores_rank = len(getattr(scores.var, "shape", None) or shape)
             if sm_axis not in (-1, scores_rank - 1):
                 continue
+            causal = False
             if bias_node is not None:
                 # the flash kernel takes [*,*,Tq,Tk]-shaped biases; the
                 # [B,1,1,Tk] padding-mask form would need an explicit
@@ -592,15 +623,34 @@ class AttentionFusePass(Pass):
                 if bshape is None or len(bshape) < 2 or \
                         bshape[-2] in (1, None):
                     continue
+                # a frozen causal mask becomes causal=True with no Bias:
+                # the kernel skips masked key blocks instead of reading
+                # a [T,T] tensor of -1e9s
+                scope = self.get("scope")
+                if scope is not None and \
+                        getattr(bias_node.var, "persistable", False) and \
+                        not bias_node.inputs:
+                    try:
+                        val = scope.find_var(bias_node.name)
+                    except Exception:
+                        val = None
+                    if val is not None:
+                        import numpy as np
+                        if self._is_frozen_causal_mask(np.asarray(val)):
+                            causal = True
             inputs = {"Q": [q_node], "K": [k_node], "V": [v_node]}
-            if bias_node is not None:
+            if bias_node is not None and not causal:
                 inputs["Bias"] = [bias_node]
+            elif causal and len(bias_node.outputs) == 1 and \
+                    bias_node.name not in protected:
+                # mask var fed only this add: drop the orphan node too
+                doomed_mask.append(bias_node)
             out_node = mm2.outputs[0]
             graph.create_op_node(
                 "flash_attention", inputs=inputs,
                 outputs={"Out": [out_node]},
                 attrs={"sm_scale": float(a.get("alpha", 1.0)),
-                       "causal": False})
+                       "causal": causal})
             graph.safe_remove_nodes(
                 [mm1, scores, sm, probs, mm2] + doomed_mask)
             count += 1

@@ -124,8 +124,11 @@ class AnalysisPredictor:
             g = ir.get_pass("fuse_elewise_add_act_pass",
                             protected=keep).apply(g)
             # long-seq artifacts built with dense attention get the
-            # Pallas flash kernel at load time (crossover ≥1024)
-            g = ir.get_pass("attention_fuse_pass", protected=keep).apply(g)
+            # Pallas flash kernel at load time (crossover ≥1024); the
+            # scope lets the pass recognize frozen causal masks and turn
+            # them into causal=True (kernel skips masked key blocks)
+            g = ir.get_pass("attention_fuse_pass", protected=keep,
+                            scope=self.scope).apply(g)
             self.program = g.to_program()
         self._params = {name: jnp.asarray(np.asarray(val))
                         for name, val in self.scope.items() if val is not None}

@@ -283,3 +283,130 @@ def test_attention_fuse_pass_rewrites_and_matches():
         g2 = ir.Graph(pt.default_main_program())
         g2 = ir.get_pass("attention_fuse_pass", min_seq_len=1024).apply(g2)
         assert g2.attrs["attention_fuse_count"] == 0
+
+
+def test_attention_fuse_pass_causal_and_cross():
+    """Decoder-shaped chains: a frozen persistable causal mask flips the
+    fused op to causal=True (Bias dropped — the kernel skips masked key
+    blocks), and a rectangular cross-attention chain (Tq != Tk) fuses
+    through the same pattern.  Parity against the dense program."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import initializer
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor, Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework import ir
+
+    B, H, T, TK, D = 2, 2, 32, 48, 8
+    rng = np.random.RandomState(3)
+    qv, kv, vv = (rng.randn(B, H, T, D).astype(np.float32) * 0.3
+                  for _ in range(3))
+    ek, ev = (rng.randn(B, H, TK, D).astype(np.float32) * 0.3
+              for _ in range(2))
+    mask_np = np.triu(np.full((T, T), -1e9, np.float32), k=1)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.data("k", shape=[H, T, D], dtype="float32")
+        v = layers.data("v", shape=[H, T, D], dtype="float32")
+        enc_k = layers.data("enc_k", shape=[H, TK, D], dtype="float32")
+        enc_v = layers.data("enc_v", shape=[H, TK, D], dtype="float32")
+        mask = layers.create_parameter(
+            [T, T], "float32", name="causal_mask",
+            default_initializer=initializer.NumpyArrayInitializer(mask_np))
+        mask.stop_gradient = True
+        # causal self-attention (dist_transformer.py decoder recipe)
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.25)
+        probs = layers.softmax(scores + mask)
+        self_out = layers.matmul(probs, v)
+        # cross-attention onto the (longer) encoder sequence
+        scores2 = layers.matmul(self_out, enc_k, transpose_y=True,
+                                alpha=0.25)
+        cross_out = layers.matmul(layers.softmax(scores2), enc_v)
+        marker = layers.scale(cross_out, scale=1.0)
+        prog = pt.default_main_program()
+
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        feed = {"q": qv, "k": kv, "v": vv, "enc_k": ek, "enc_v": ev}
+        want, = exe.run(prog, feed=feed, fetch_list=[marker.name],
+                        scope=scope)
+
+        g = ir.Graph(prog.clone())
+        g = ir.get_pass("attention_fuse_pass", min_seq_len=16,
+                        scope=scope).apply(g)
+        assert g.attrs["attention_fuse_count"] == 2
+        fused = g.to_program()
+        flash = [op for op in fused.global_block().ops
+                 if op.type == "flash_attention"]
+        assert len(flash) == 2
+        causal_flags = sorted(bool(op.attrs.get("causal")) for op in flash)
+        assert causal_flags == [False, True]
+        for op in flash:
+            if op.attrs.get("causal"):
+                assert not op.input("Bias"), \
+                    "causal rewrite must drop the frozen mask input"
+        assert "softmax" not in [op.type for op in fused.global_block().ops]
+
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_attention_fuse_pass_keeps_noncausal_bias_and_axis_gates():
+    """A generic (non-causal) additive bias must ride into the kernel's
+    Bias input unchanged, and a softmax over a non-last axis must NOT be
+    rewritten (the r3 advisor's mis-fusion window)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor, Program, program_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework import ir
+
+    B, H, T, D = 2, 2, 32, 8
+    rng = np.random.RandomState(5)
+    qv, kv, vv = (rng.randn(B, H, T, D).astype(np.float32) * 0.3
+                  for _ in range(3))
+    bias_np = rng.randn(B, H, T, T).astype(np.float32) * 0.1
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.data("k", shape=[H, T, D], dtype="float32")
+        v = layers.data("v", shape=[H, T, D], dtype="float32")
+        bias = layers.data("bias", shape=[H, T, T], dtype="float32")
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.25)
+        out = layers.matmul(layers.softmax(scores + bias), v)
+        marker = layers.scale(out, scale=1.0)
+        prog = pt.default_main_program()
+        exe = Executor()
+        feed = {"q": qv, "k": kv, "v": vv, "bias": bias_np}
+        want, = exe.run(prog, feed=feed, fetch_list=[marker.name],
+                        scope=scope)
+        g = ir.Graph(prog.clone())
+        g = ir.get_pass("attention_fuse_pass", min_seq_len=16,
+                        scope=scope).apply(g)
+        assert g.attrs["attention_fuse_count"] == 1
+        fused = g.to_program()
+        fl = [op for op in fused.global_block().ops
+              if op.type == "flash_attention"]
+        assert fl and fl[0].input("Bias") and not fl[0].attrs.get("causal")
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=scope)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    # non-last-axis softmax: no rewrite
+    with scope_guard(Scope()), program_guard(Program(), Program()):
+        q = layers.data("q", shape=[H, T, D], dtype="float32")
+        k = layers.data("k", shape=[H, T, D], dtype="float32")
+        v = layers.data("v", shape=[H, T, D], dtype="float32")
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.25)
+        out = layers.matmul(layers.softmax(scores, axis=2), v)
+        g2 = ir.Graph(pt.default_main_program())
+        g2 = ir.get_pass("attention_fuse_pass", min_seq_len=16).apply(g2)
+        assert g2.attrs["attention_fuse_count"] == 0
