@@ -24,6 +24,7 @@
 #include <chrono>
 #include <numeric>
 
+#include "bf16.h"
 #include "program_json.h"
 
 // ------------------------------------------------------------- npy io ----
@@ -80,13 +81,22 @@ static Tensor LoadNpy(const std::string& path) {
     f.read(reinterpret_cast<char*>(buf.data()), n * 8);
     for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
   } else if (descr == "<i8") {
-    std::vector<int64_t> buf(n);
-    f.read(reinterpret_cast<char*>(buf.data()), n * 8);
-    for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
+    // exact int64 payload kept alongside the float working copy
+    t.i64.resize(n);
+    f.read(reinterpret_cast<char*>(t.i64.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(t.i64[i]);
+    t.dtype = "int64";
   } else if (descr == "<i4") {
     std::vector<int32_t> buf(n);
     f.read(reinterpret_cast<char*>(buf.data()), n * 4);
     for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(buf[i]);
+  } else if (descr == "<u2") {
+    // bfloat16 payload stored as a uint16 view (io.py save_vars writes
+    // bf16 params this way); widen to f32 by shifting into the exponent
+    std::vector<uint16_t> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()), n * 2);
+    for (int64_t i = 0; i < n; ++i) t.data[i] = bf16_to_f32(buf[i]);
+    t.dtype = "bfloat16";
   } else {
     throw std::runtime_error(path + ": unsupported dtype " + descr);
   }
@@ -95,12 +105,15 @@ static Tensor LoadNpy(const std::string& path) {
 }
 
 static void SaveNpy(const std::string& path, const Tensor& t) {
+  std::string descr = "<f4";
+  if (t.dtype == "int64") descr = "<i8";
+  else if (t.dtype == "bfloat16") descr = "<u2";
   std::string shp = "(";
   for (size_t i = 0; i < t.shape.size(); ++i)
     shp += std::to_string(t.shape[i]) + ",";
   shp += ")";
-  std::string header = "{'descr': '<f4', 'fortran_order': False, 'shape': " +
-                       shp + ", }";
+  std::string header = "{'descr': '" + descr +
+                       "', 'fortran_order': False, 'shape': " + shp + ", }";
   size_t total = 10 + header.size();
   size_t pad = (64 - total % 64) % 64;
   header += std::string(pad, ' ');
@@ -110,7 +123,22 @@ static void SaveNpy(const std::string& path, const Tensor& t) {
   f.write("\x93NUMPY\x01\x00", 8);
   f.write(reinterpret_cast<const char*>(&hlen), 2);
   f.write(header.data(), header.size());
-  f.write(reinterpret_cast<const char*>(t.data.data()), t.numel() * 4);
+  if (t.dtype == "int64") {
+    std::vector<int64_t> buf(t.i64);
+    if (buf.empty()) {
+      buf.resize(t.data.size());
+      for (size_t i = 0; i < t.data.size(); ++i)
+        buf[i] = static_cast<int64_t>(std::llround(t.data[i]));
+    }
+    f.write(reinterpret_cast<const char*>(buf.data()), buf.size() * 8);
+  } else if (t.dtype == "bfloat16") {
+    std::vector<uint16_t> buf(t.data.size());
+    for (size_t i = 0; i < t.data.size(); ++i)
+      buf[i] = f32_to_bf16(t.data[i]);
+    f.write(reinterpret_cast<const char*>(buf.data()), buf.size() * 2);
+  } else {
+    f.write(reinterpret_cast<const char*>(t.data.data()), t.numel() * 4);
+  }
 }
 
 // ------------------------------------------------------- attr helpers ----
@@ -653,6 +681,349 @@ static void RunOp(const Json& op, Scope* scope) {
     if (attrs.has("bias")) bias = static_cast<float>(attrs.at("bias").num);
     for (int64_t i = 0; i < x.numel(); ++i)
       out.data[i] = x.data[i] * sc + bias;
+  } else if (type == "top_k" || type == "top_k_v2") {
+    // ref operators/top_k_op.cc (last axis); ties keep lower index like
+    // jax.lax.top_k (stable sort)
+    const Tensor& x = Var(scope, In(op, "X"));
+    int64_t cols = x.shape.empty() ? 1 : x.shape.back();
+    int64_t rows = x.numel() / std::max<int64_t>(cols, 1);
+    int64_t k = static_cast<int64_t>(AttrNum(op, "k", 1));
+    if (k > cols) k = cols;
+    std::vector<int64_t> oshape(x.shape);
+    if (oshape.empty()) oshape.push_back(1);
+    oshape.back() = k;
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    Tensor& idx = Var(scope, Out(op, "Indices"));
+    idx.Resize(oshape);
+    idx.dtype = "int64";
+    idx.i64.assign(idx.data.size(), 0);
+    std::vector<int64_t> ord(cols);
+    for (int64_t r = 0; r < rows; ++r) {
+      for (int64_t c = 0; c < cols; ++c) ord[c] = c;
+      std::stable_sort(ord.begin(), ord.end(), [&](int64_t a, int64_t b) {
+        return x.data[r * cols + a] > x.data[r * cols + b];
+      });
+      for (int64_t j = 0; j < k; ++j) {
+        out.data[r * k + j] = x.data[r * cols + ord[j]];
+        idx.i64[r * k + j] = ord[j];
+        idx.data[r * k + j] = static_cast<float>(ord[j]);
+      }
+    }
+  } else if (type == "argsort" || type == "arg_max" || type == "arg_min") {
+    // ref operators/argsort_op.cc / arg_min_max_op_base.h
+    const Tensor& x = Var(scope, In(op, "X"));
+    int64_t nd = static_cast<int64_t>(x.shape.size());
+    int64_t axis = static_cast<int64_t>(AttrNum(op, "axis", -1));
+    if (axis < 0) axis += nd;
+    int64_t n = x.shape[axis];
+    int64_t inner = ProdFrom(x.shape, axis + 1, x.shape.size());
+    int64_t outer = x.numel() / (n * inner);
+    bool desc = AttrBool(op, "descending", false);
+    std::vector<int64_t> ord(n);
+    if (type == "argsort") {
+      Tensor& out = Var(scope, Out(op, "Out"));
+      Tensor& idx = Var(scope, Out(op, "Indices"));
+      out.Resize(x.shape);
+      idx.Resize(x.shape);
+      idx.dtype = "int64";
+      idx.i64.assign(idx.data.size(), 0);
+      for (int64_t o = 0; o < outer; ++o)
+        for (int64_t in = 0; in < inner; ++in) {
+          auto at = [&](int64_t j) { return (o * n + j) * inner + in; };
+          for (int64_t j = 0; j < n; ++j) ord[j] = j;
+          std::stable_sort(ord.begin(), ord.end(),
+                           [&](int64_t a, int64_t b) {
+            return desc ? x.data[at(a)] > x.data[at(b)]
+                        : x.data[at(a)] < x.data[at(b)];
+          });
+          for (int64_t j = 0; j < n; ++j) {
+            out.data[at(j)] = x.data[at(ord[j])];
+            idx.i64[at(j)] = ord[j];
+            idx.data[at(j)] = static_cast<float>(ord[j]);
+          }
+        }
+    } else {
+      std::vector<int64_t> oshape;
+      for (int64_t d = 0; d < nd; ++d)
+        if (d != axis) oshape.push_back(x.shape[d]);
+      Tensor& out = Var(scope, Out(op, "Out"));
+      out.Resize(oshape);
+      out.dtype = "int64";
+      out.i64.assign(out.data.size(), 0);
+      bool mx = (type == "arg_max");
+      for (int64_t o = 0; o < outer; ++o)
+        for (int64_t in = 0; in < inner; ++in) {
+          int64_t best = 0;
+          for (int64_t j = 1; j < n; ++j) {
+            float a = x.data[(o * n + j) * inner + in];
+            float b = x.data[(o * n + best) * inner + in];
+            if (mx ? a > b : a < b) best = j;
+          }
+          out.i64[o * inner + in] = best;
+          out.data[o * inner + in] = static_cast<float>(best);
+        }
+    }
+  } else if (type == "gru" || type == "lstm") {
+    // ref operators/gru_op.cc / lstm_op.cc — dense [b,t,G*d] pre-projected
+    // input, recurrent Weight [d,G*d], the layout paddle_tpu/ops/rnn_ops.py
+    // lowers (G=3 gru u,r,c; G=4 lstm i,f,c,o)
+    const Tensor& x = Var(scope, In(op, "Input"));
+    const Tensor& w = Var(scope, In(op, "Weight"));
+    const std::string bname = In(op, "Bias");
+    const Tensor* bias = bname.empty() ? nullptr : &Var(scope, bname);
+    bool is_gru = (type == "gru");
+    int64_t G = is_gru ? 3 : 4;
+    int64_t b = x.shape[0], t = x.shape[1], gd = x.shape[2];
+    int64_t d = gd / G;
+    bool reverse = AttrBool(op, "is_reverse", false);
+    bool origin = AttrBool(op, "origin_mode", false);
+    // unsupported attr combinations must error, not silently diverge
+    // from the Python lowering (rnn_ops.py handles these)
+    if (!is_gru && AttrBool(op, "use_peepholes", true))
+      throw std::runtime_error(
+          "demo_predictor lstm: use_peepholes=True unsupported — save the "
+          "model with use_peepholes=False");
+    if (AttrStr(op, "gate_activation", "sigmoid") != "sigmoid" ||
+        AttrStr(op, is_gru ? "activation" : "candidate_activation",
+                "tanh") != "tanh" ||
+        (!is_gru && AttrStr(op, "cell_activation", "tanh") != "tanh"))
+      throw std::runtime_error("demo_predictor " + type +
+                               ": non-default activations unsupported");
+    if (!In(op, "SeqLen").empty())
+      throw std::runtime_error("demo_predictor " + type +
+                               ": SeqLen masking unsupported");
+    auto sigmoid = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    Tensor& hidden = Var(scope, Out(op, "Hidden"));
+    hidden.Resize({b, t, d});
+    Tensor* cell = nullptr;
+    if (!is_gru && !Out(op, "Cell").empty()) {
+      cell = &Var(scope, Out(op, "Cell"));
+      cell->Resize({b, t, d});
+    }
+    std::vector<float> h(d), c(d), xt(gd), hw(gd);
+    const std::string h0n = In(op, "H0"), c0n = In(op, "C0");
+    for (int64_t bi = 0; bi < b; ++bi) {
+      if (!h0n.empty()) {
+        const Tensor& h0 = Var(scope, h0n);
+        std::copy(h0.data.begin() + bi * d, h0.data.begin() + (bi + 1) * d,
+                  h.begin());
+      } else {
+        std::fill(h.begin(), h.end(), 0.f);
+      }
+      if (!is_gru) {
+        if (!c0n.empty()) {
+          const Tensor& c0 = Var(scope, c0n);
+          std::copy(c0.data.begin() + bi * d,
+                    c0.data.begin() + (bi + 1) * d, c.begin());
+        } else {
+          std::fill(c.begin(), c.end(), 0.f);
+        }
+      }
+      for (int64_t step = 0; step < t; ++step) {
+        int64_t ti = reverse ? t - 1 - step : step;
+        for (int64_t j = 0; j < gd; ++j) {
+          xt[j] = x.data[(bi * t + ti) * gd + j];
+          if (bias) xt[j] += bias->data[j % gd];
+        }
+        if (is_gru) {
+          // h @ w[:, :2d] for the u,r gates
+          for (int64_t j = 0; j < 2 * d; ++j) {
+            float acc = 0.f;
+            for (int64_t dd = 0; dd < d; ++dd)
+              acc += h[dd] * w.data[dd * gd + j];
+            hw[j] = acc;
+          }
+          std::vector<float> u(d), r(d);
+          for (int64_t j = 0; j < d; ++j) {
+            u[j] = sigmoid(xt[j] + hw[j]);
+            r[j] = sigmoid(xt[d + j] + hw[d + j]);
+          }
+          for (int64_t j = 0; j < d; ++j) {
+            float acc = xt[2 * d + j];
+            for (int64_t dd = 0; dd < d; ++dd)
+              acc += (r[dd] * h[dd]) * w.data[dd * gd + 2 * d + j];
+            float cand = std::tanh(acc);
+            h[j] = origin ? u[j] * h[j] + (1 - u[j]) * cand
+                          : (1 - u[j]) * h[j] + u[j] * cand;
+          }
+        } else {
+          for (int64_t j = 0; j < gd; ++j) {
+            float acc = xt[j];
+            for (int64_t dd = 0; dd < d; ++dd)
+              acc += h[dd] * w.data[dd * gd + j];
+            hw[j] = acc;
+          }
+          for (int64_t j = 0; j < d; ++j) {
+            float gi = sigmoid(hw[j]);
+            float gf = sigmoid(hw[d + j]);
+            float cand = std::tanh(hw[2 * d + j]);
+            float go = sigmoid(hw[3 * d + j]);
+            c[j] = gf * c[j] + gi * cand;
+            h[j] = go * std::tanh(c[j]);
+          }
+        }
+        for (int64_t j = 0; j < d; ++j) {
+          hidden.data[(bi * t + ti) * d + j] = h[j];
+          if (cell) cell->data[(bi * t + ti) * d + j] = c[j];
+        }
+      }
+      if (!Out(op, "LastH").empty()) {
+        Tensor& lh = Var(scope, Out(op, "LastH"));
+        if (lh.shape.empty()) lh.Resize({b, d});
+        for (int64_t j = 0; j < d; ++j) lh.data[bi * d + j] = h[j];
+      }
+      if (!is_gru && !Out(op, "LastC").empty()) {
+        Tensor& lc = Var(scope, Out(op, "LastC"));
+        if (lc.shape.empty()) lc.Resize({b, d});
+        for (int64_t j = 0; j < d; ++j) lc.data[bi * d + j] = c[j];
+      }
+    }
+  } else if (type == "yolo_box") {
+    // ref operators/detection/yolo_box_op.h; mirrors
+    // paddle_tpu/ops/detection_ops.py _yolo_box exactly
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& img = Var(scope, In(op, "ImgSize"));
+    std::vector<int64_t> anchors = AttrInts(op, "anchors");
+    int64_t cls = static_cast<int64_t>(AttrNum(op, "class_num", 1));
+    float conf_th = static_cast<float>(AttrNum(op, "conf_thresh", 0.01));
+    int64_t down = static_cast<int64_t>(AttrNum(op, "downsample_ratio", 32));
+    bool clip = AttrBool(op, "clip_bbox", true);
+    int64_t an = static_cast<int64_t>(anchors.size()) / 2;
+    int64_t b = x.shape[0], h = x.shape[2], w = x.shape[3];
+    float in_h = static_cast<float>(h * down);
+    float in_w = static_cast<float>(w * down);
+    int64_t m = an * h * w;
+    Tensor& boxes = Var(scope, Out(op, "Boxes"));
+    boxes.Resize({b, m, 4});
+    Tensor& scores = Var(scope, Out(op, "Scores"));
+    scores.Resize({b, m, cls});
+    auto sigmoid = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    int64_t ch = 5 + cls;
+    for (int64_t bi = 0; bi < b; ++bi) {
+      float imh = img.data[bi * 2 + 0];
+      float imw = img.data[bi * 2 + 1];
+      for (int64_t ai = 0; ai < an; ++ai)
+        for (int64_t yi = 0; yi < h; ++yi)
+          for (int64_t xi = 0; xi < w; ++xi) {
+            auto v = [&](int64_t c) {
+              return x.data[((bi * an + ai) * ch + c) * h * w + yi * w + xi];
+            };
+            float cx = (sigmoid(v(0)) + xi) / w;
+            float cy = (sigmoid(v(1)) + yi) / h;
+            float bw = std::exp(v(2)) * anchors[2 * ai] / in_w;
+            float bh = std::exp(v(3)) * anchors[2 * ai + 1] / in_h;
+            float conf = sigmoid(v(4));
+            bool keep = conf > conf_th;
+            float x1 = (cx - bw / 2) * imw, y1 = (cy - bh / 2) * imh;
+            float x2 = (cx + bw / 2) * imw, y2 = (cy + bh / 2) * imh;
+            if (clip) {
+              x1 = std::max(x1, 0.f); y1 = std::max(y1, 0.f);
+              x2 = std::min(x2, imw - 1); y2 = std::min(y2, imh - 1);
+            }
+            int64_t row = (ai * h + yi) * w + xi;
+            float* bo = &boxes.data[(bi * m + row) * 4];
+            bo[0] = keep ? x1 : 0.f; bo[1] = keep ? y1 : 0.f;
+            bo[2] = keep ? x2 : 0.f; bo[3] = keep ? y2 : 0.f;
+            for (int64_t ci = 0; ci < cls; ++ci)
+              scores.data[(bi * m + row) * cls + ci] =
+                  keep ? sigmoid(v(5 + ci)) * conf : 0.f;
+          }
+    }
+  } else if (type == "multiclass_nms" || type == "multiclass_nms2") {
+    // ref operators/detection/multiclass_nms_op.cc; mirrors the dense
+    // padded layout of detection_ops.py _multiclass_nms (Out [b,K,6])
+    const Tensor& bboxes = Var(scope, In(op, "BBoxes"));   // [b, m, 4]
+    const Tensor& sc = Var(scope, In(op, "Scores"));       // [b, c, m]
+    int64_t bg = static_cast<int64_t>(AttrNum(op, "background_label", 0));
+    float score_th = static_cast<float>(AttrNum(op, "score_threshold", 0.0));
+    float nms_th = static_cast<float>(AttrNum(op, "nms_threshold", 0.3));
+    int64_t nms_top_k = static_cast<int64_t>(AttrNum(op, "nms_top_k", 400));
+    int64_t keep_top_k =
+        static_cast<int64_t>(AttrNum(op, "keep_top_k", 200));
+    bool normalized = AttrBool(op, "normalized", true);
+    int64_t b = sc.shape[0], c = sc.shape[1], m = sc.shape[2];
+    int64_t k_cls = (nms_top_k > 0) ? std::min(nms_top_k, m) : m;
+    if (keep_top_k < 0) keep_top_k = c * k_cls;
+    int64_t k_eff = std::min(keep_top_k, c * k_cls);
+    float off = normalized ? 0.f : 1.f;
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({b, keep_top_k, 6});
+    std::fill(out.data.begin(), out.data.end(), -1.f);
+    Tensor* num = nullptr;
+    if (!Out(op, "NmsRoisNum").empty()) {
+      num = &Var(scope, Out(op, "NmsRoisNum"));
+      num->Resize({b});
+      num->dtype = "int64";
+      num->i64.assign(b, 0);
+    }
+    auto area = [&](const float* box) {
+      return std::max(box[2] - box[0] + off, 0.f) *
+             std::max(box[3] - box[1] + off, 0.f);
+    };
+    auto iou = [&](const float* p, const float* q) {
+      float x1 = std::max(p[0], q[0]), y1 = std::max(p[1], q[1]);
+      float x2 = std::min(p[2], q[2]), y2 = std::min(p[3], q[3]);
+      float inter = std::max(x2 - x1 + off, 0.f) *
+                    std::max(y2 - y1 + off, 0.f);
+      float uni = area(p) + area(q) - inter;
+      return uni > 0 ? inter / std::max(uni, 1e-10f) : 0.f;
+    };
+    std::vector<int64_t> ord(m);
+    for (int64_t bi = 0; bi < b; ++bi) {
+      const float* bx = &bboxes.data[bi * m * 4];
+      // per-class: top-k by score, then greedy NMS on the sorted list
+      std::vector<float> top_s(c * k_cls);
+      std::vector<int64_t> top_i(c * k_cls);
+      std::vector<char> valid(c * k_cls, 0);
+      for (int64_t ci = 0; ci < c; ++ci) {
+        const float* s = &sc.data[(bi * c + ci) * m];
+        for (int64_t j = 0; j < m; ++j) ord[j] = j;
+        std::stable_sort(ord.begin(), ord.end(),
+                         [&](int64_t a, int64_t bb) {
+          return s[a] > s[bb];
+        });
+        for (int64_t j = 0; j < k_cls; ++j) {
+          top_s[ci * k_cls + j] = s[ord[j]];
+          top_i[ci * k_cls + j] = ord[j];
+        }
+        // greedy suppression in descending-score order (nms_keep)
+        for (int64_t j = 0; j < k_cls; ++j) {
+          bool sup = false;
+          for (int64_t p = 0; p < j && !sup; ++p)
+            if (valid[ci * k_cls + p] &&
+                iou(&bx[top_i[ci * k_cls + j] * 4],
+                    &bx[top_i[ci * k_cls + p] * 4]) > nms_th)
+              sup = true;
+          bool ok = !sup && top_s[ci * k_cls + j] > score_th && ci != bg;
+          valid[ci * k_cls + j] = ok ? 1 : 0;
+        }
+      }
+      // global top-k_eff over the surviving (class, candidate) entries
+      std::vector<int64_t> flat(c * k_cls);
+      for (int64_t j = 0; j < c * k_cls; ++j) flat[j] = j;
+      std::stable_sort(flat.begin(), flat.end(),
+                       [&](int64_t a, int64_t bb) {
+        float sa = valid[a] ? top_s[a] : -std::numeric_limits<float>::infinity();
+        float sb = valid[bb] ? top_s[bb] : -std::numeric_limits<float>::infinity();
+        return sa > sb;
+      });
+      int64_t kept = 0;
+      for (int64_t j = 0; j < k_eff; ++j) {
+        int64_t fi = flat[j];
+        if (!valid[fi]) continue;   // -inf tail: stays the -1 padding
+        float* row = &out.data[(bi * keep_top_k + j) * 6];
+        row[0] = static_cast<float>(fi / k_cls);          // class id
+        row[1] = top_s[fi];
+        const float* bo = &bx[top_i[fi] * 4];
+        row[2] = bo[0]; row[3] = bo[1]; row[4] = bo[2]; row[5] = bo[3];
+        ++kept;
+      }
+      if (num) {
+        num->i64[bi] = kept;
+        num->data[bi] = static_cast<float>(kept);
+      }
+    }
   } else {
     throw std::runtime_error("demo_predictor: unsupported op '" + type +
                              "' — extend RunOp for this model");

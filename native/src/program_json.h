@@ -160,6 +160,11 @@ class JsonParser {
 struct Tensor {
   std::vector<int64_t> shape;
   std::vector<float> data;
+  // payload dtype tag: "float32" (default), "int64" (exact values kept in
+  // i64 alongside the float working copy), or "bfloat16" (widened to f32
+  // on load, rounded back on save) — ref framework::Tensor dtype
+  std::string dtype = "float32";
+  std::vector<int64_t> i64;
   int64_t numel() const {
     int64_t n = 1;
     for (auto d : shape) n *= d;
@@ -168,6 +173,8 @@ struct Tensor {
   void Resize(std::vector<int64_t> s) {
     shape = std::move(s);
     data.assign(static_cast<size_t>(numel()), 0.f);
+    dtype = "float32";
+    i64.clear();
   }
 };
 

@@ -40,6 +40,8 @@
 #include <thread>
 #include <vector>
 
+#include "bf16.h"
+
 namespace {
 
 enum Op : uint8_t {
@@ -61,22 +63,6 @@ enum Optim : int32_t { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
 enum Dtype : uint8_t { kF32 = 0, kBF16 = 1, kI64 = 2 };
 
 inline size_t dtype_size(uint8_t d) { return d == kI64 ? 8 : d == kBF16 ? 2 : 4; }
-
-inline uint16_t f32_to_bf16(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, 4);
-  // round-to-nearest-even on the dropped 16 bits
-  uint32_t lsb = (bits >> 16) & 1;
-  bits += 0x7FFFu + lsb;
-  return static_cast<uint16_t>(bits >> 16);
-}
-
-inline float bf16_to_f32(uint16_t h) {
-  uint32_t bits = static_cast<uint32_t>(h) << 16;
-  float f;
-  std::memcpy(&f, &bits, 4);
-  return f;
-}
 
 struct Param {
   std::vector<float> value;

