@@ -4,10 +4,17 @@ Wide&Deep CTR — distributed sparse training (PS mode)").
 Real processes: 1 native pserver + 2 trainers over the TCP PS plane
 (sparse embedding tables row-sharded server-side), synthetic Criteo-shaped
 batches.  The reference publishes no number for this workload
-(BASELINE.md: "tool only"); the target is the *capability* — the line
+(BASELINE.md: "tool only"); the target is the *capability* — each line
 reports aggregate examples/s and a decreasing loss as evidence.
 
-Run: python tools/bench_deepfm_ps.py          (parent; prints one JSON line)
+All three reference training modes run (ref
+distribute_transpiler.py:131 sync/async/geo config):
+- sync:  trainers barrier each step, server averages gradients
+- async: no barrier; server applies each trainer's grads as they arrive
+- geo:   trainers run the LOCAL optimizer and push parameter deltas every
+         ``geo_sgd_need_push_nums`` steps (GeoCommunicator)
+
+Run: python tools/bench_deepfm_ps.py        (parent; prints 3 JSON lines)
 """
 import json
 import os
@@ -25,23 +32,34 @@ WARMUP = 5
 N_TRAINERS = 2
 SPARSE_DIM = 10000
 IS_SPARSE = True
+GEO_PUSH_NUMS = 5
 
 
-def _child(role, trainer_id, port, n_trainers):
+def _child(role, trainer_id, port, n_trainers, mode):
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import paddle_tpu as pt
     from paddle_tpu.framework import Executor
     from paddle_tpu.distributed import DistributeTranspiler
+    from paddle_tpu.distributed.ps import (DistributeTranspilerConfig,
+                                           GeoCommunicator)
     from paddle_tpu.models.ctr import build_ctr_train
 
     eps = f"127.0.0.1:{port}"
     avg_loss, prob, feeds = build_ctr_train(
         sparse_dim=SPARSE_DIM, embed_size=16, is_sparse=IS_SPARSE)
     pt.optimizer.Adam(0.01).minimize(avg_loss)
-    t = DistributeTranspiler()
-    t.transpile(trainer_id, pservers=eps, trainers=n_trainers)
+    if mode == "geo":
+        cfg = DistributeTranspilerConfig(
+            geo_sgd_mode=True, geo_sgd_need_push_nums=GEO_PUSH_NUMS,
+            sync_mode=False)
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id, pservers=eps, trainers=n_trainers)
+    else:
+        t = DistributeTranspiler()
+        t.transpile(trainer_id, pservers=eps, trainers=n_trainers,
+                    sync_mode=(mode == "sync"))
     exe = Executor()
     if role == "pserver":
         prog, startup = t.get_pserver_programs(eps)
@@ -50,6 +68,10 @@ def _child(role, trainer_id, port, n_trainers):
         return
     trainer_prog = t.get_trainer_program()
     exe.run(pt.default_startup_program())
+    geo = None
+    if mode == "geo":
+        geo = GeoCommunicator(t)
+        geo.init_snapshots()
     rng = np.random.RandomState(trainer_id)
 
     def batch():
@@ -67,6 +89,8 @@ def _child(role, trainer_id, port, n_trainers):
             t0 = time.perf_counter()
         lv, = exe.run(trainer_prog, feed=batch(),
                       fetch_list=[avg_loss.name])
+        if geo is not None:
+            geo.step()
         losses.append(float(np.asarray(lv)))
     dt = time.perf_counter() - t0
     eps_rate = BATCH * (STEPS - WARMUP) / dt
@@ -75,12 +99,7 @@ def _child(role, trainer_id, port, n_trainers):
           flush=True)
 
 
-def main():
-    if len(sys.argv) > 1:
-        _child(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
-               int(sys.argv[4]))
-        return
-
+def _run_mode(mode):
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -88,15 +107,16 @@ def main():
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
+    server = subprocess.Popen(
         [sys.executable, __file__, "pserver", "0", str(port),
-         str(N_TRAINERS)], env=env)]
+         str(N_TRAINERS), mode], env=env)
     time.sleep(0.5)
     trainers = []
     for tid in range(N_TRAINERS):
         trainers.append(subprocess.Popen(
             [sys.executable, __file__, "trainer", str(tid), str(port),
-             str(N_TRAINERS)], env=env, stdout=subprocess.PIPE, text=True))
+             str(N_TRAINERS), mode], env=env, stdout=subprocess.PIPE,
+            text=True))
     results = []
     for p in trainers:
         out, _ = p.communicate(timeout=900)
@@ -106,11 +126,15 @@ def main():
     # safe to use from the parent without touching a jax backend)
     from paddle_tpu.distributed import ps as ps_mod
     ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
-    procs[0].wait(timeout=60)
+    server.wait(timeout=60)
+    ps_mod.reset_clients()
 
     total = sum(r["examples_per_s"] for r in results)
+    suffix = {"sync": "", "async": "_async", "geo": "_geo"}[mode]
+    desc = {"sync": "sync", "async": "async, no barrier",
+            "geo": f"geo-SGD, push every {GEO_PUSH_NUMS} steps"}[mode]
     print(json.dumps({
-        "metric": "deepfm_ps_examples_per_s",
+        "metric": f"deepfm_ps{suffix}_examples_per_s",
         "value": round(total, 1),
         "unit": "examples/s",
         "vs_baseline": 1.0,     # functional target (no published number)
@@ -118,8 +142,17 @@ def main():
         "sparse_dim": SPARSE_DIM, "batch": BATCH,
         "loss_first_last": [round(results[0]["loss_first"], 4),
                             round(results[0]["loss_last"], 4)],
-        "mode": "native TCP PS, sparse tables, sync",
-    }))
+        "mode": f"native TCP PS, sparse tables, {desc}",
+    }), flush=True)
+
+
+def main():
+    if len(sys.argv) > 1:
+        _child(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+               int(sys.argv[4]), sys.argv[5])
+        return
+    for mode in ("sync", "async", "geo"):
+        _run_mode(mode)
 
 
 if __name__ == "__main__":
