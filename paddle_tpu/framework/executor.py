@@ -22,6 +22,7 @@ assignment subsumes it).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -259,6 +260,7 @@ class _CompiledBlock:
         self.persist_ro = persist_ro
         self.persist_rw = persist_rw
         self.collective_nranks = None
+        self._donating = bool(donate and persist_rw)
         block = program.blocks[block_idx]
         amp_on = bool(program._attrs.get("amp", False))
 
@@ -370,7 +372,39 @@ class _CompiledBlock:
             return
         self.jitted = jax.jit(step, **kwargs)
 
+    _hbm_recorded = False
+    _compiled_aot = None
+
     def __call__(self, feeds, ro, rw, seed):
+        if not self._hbm_recorded and \
+                os.environ.get("PADDLE_TPU_RECORD_HBM"):
+            # capture the executable's HBM allocation plan (ref
+            # allocator_facade stats): device.memory_stats() is unavailable
+            # through the axon tunnel, but the AOT-compiled executable's
+            # memory_analysis IS the on-chip buffer assignment — arguments
+            # + temps + outputs is what the runtime allocates for a step.
+            # The AOT object is then used for execution, so recording costs
+            # no extra compile.
+            self._hbm_recorded = True
+            try:
+                compiled = self.jitted.lower(feeds, ro, rw, seed).compile()
+                from .. import memory as _mem
+                _mem.record_hbm_plan(
+                    ",".join(self.fetch_names) or "<block>",
+                    compiled.memory_analysis())
+                self._compiled_aot = compiled
+            except Exception:
+                pass
+        if self._compiled_aot is not None:
+            if self._donating:
+                # rw buffers are donated: a mid-execution failure leaves
+                # them deleted, so a fallback retry would mask the real
+                # error with 'Array has been deleted' — just run it
+                return self._compiled_aot(feeds, ro, rw, seed)
+            try:
+                return self._compiled_aot(feeds, ro, rw, seed)
+            except Exception:
+                self._compiled_aot = None
         return self.jitted(feeds, ro, rw, seed)
 
 

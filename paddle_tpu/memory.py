@@ -110,9 +110,51 @@ def summary(scope: Optional[object] = None, max_rows: int = 40) -> str:
                 parts.append(f"{k}={_fmt_bytes(stats[k]).strip()}")
         if parts:
             lines.append("allocator: " + "  ".join(parts))
+    for tag, plan in hbm_plans().items():
+        lines.append(
+            f"hbm plan [{tag[:48]}]: peak "
+            f"{_fmt_bytes(plan['peak_bytes']).strip()} "
+            f"(args {_fmt_bytes(plan['argument_bytes']).strip()}, temps "
+            f"{_fmt_bytes(plan['temp_bytes']).strip()}, out "
+            f"{_fmt_bytes(plan['output_bytes']).strip()}, aliased "
+            f"-{_fmt_bytes(plan['alias_bytes']).strip()})")
     lines.append(f"total live device bytes: "
                  f"{_fmt_bytes(total_named + total_anon).strip()}")
     return "\n".join(lines)
+
+
+# --- compiled-executable HBM plans (ref allocator_facade.h stats) ----------
+# device.memory_stats() returns nothing through the axon tunnel, so the
+# measured footprint comes from the XLA buffer assignment of each compiled
+# step: the executor records memory_analysis() here when
+# PADDLE_TPU_RECORD_HBM=1 (framework/executor.py _CompiledBlock.__call__).
+
+_HBM_PLANS: dict = {}
+
+
+def record_hbm_plan(tag: str, ma) -> None:
+    # distinct compiled blocks can share a fetch list (startup programs
+    # all tag '<block>') — suffix instead of silently overwriting
+    if tag in _HBM_PLANS:
+        n = 2
+        while f"{tag}#{n}" in _HBM_PLANS:
+            n += 1
+        tag = f"{tag}#{n}"
+    arg = int(getattr(ma, "argument_size_in_bytes", 0))
+    out = int(getattr(ma, "output_size_in_bytes", 0))
+    tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+    alias = int(getattr(ma, "alias_size_in_bytes", 0))
+    code = int(getattr(ma, "generated_code_size_in_bytes", 0))
+    _HBM_PLANS[tag] = {
+        "argument_bytes": arg, "output_bytes": out, "temp_bytes": tmp,
+        "alias_bytes": alias, "generated_code_bytes": code,
+        # donated (aliased) outputs reuse their argument buffers
+        "peak_bytes": arg + out + tmp + code - alias,
+    }
+
+
+def hbm_plans() -> dict:
+    return dict(_HBM_PLANS)
 
 
 def _is_oom_error(e: BaseException) -> bool:

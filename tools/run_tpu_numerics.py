@@ -1,11 +1,12 @@
 """Run the on-hardware numerics sweep and emit a committed artifact
 (VERDICT r2 #7: claimed-but-unrecorded is indistinguishable from
-not-run).
+not-run; r3 ask #5: hbm_stats measured via the compiled step's XLA
+buffer assignment — tools/record_hbm.py).
 
 Usage (on a chip session):
     PYTHONPATH=/root/repo:$PYTHONPATH python tools/run_tpu_numerics.py
 
-Writes TPU_NUMERICS_r03.json at the repo root: per-test pass/fail, the
+Writes TPU_NUMERICS_r04.json at the repo root: per-test pass/fail, the
 error norms tests record via PADDLE_TPU_NUMERICS_OUT, device identity,
 and the allocator's peak-HBM counters.
 """
@@ -51,6 +52,23 @@ def main():
                  if "bytes" in k}
     except Exception:
         pass
+    if not stats:
+        # no allocator counters through the tunnel: record the measured
+        # per-step HBM allocation plans instead (args+temps+outs-aliased
+        # of the compiled RN50/BERT training steps)
+        try:
+            rh = subprocess.run(
+                [sys.executable,
+                 os.path.join(ROOT, "tools", "record_hbm.py")],
+                capture_output=True, text=True, timeout=3600, env=env)
+            for line in reversed(rh.stdout.splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    stats = json.loads(line)
+                    break
+        except Exception as e:
+            # the artifact (sweep results) must be written regardless
+            stats = {"error": str(e)[:300]}
 
     artifact = {
         "device": str(dev),
@@ -63,7 +81,7 @@ def main():
         "error_norms": norms,
         "hbm_stats": stats,
     }
-    out = os.path.join(ROOT, "TPU_NUMERICS_r03.json")
+    out = os.path.join(ROOT, "TPU_NUMERICS_r04.json")
     with open(out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact, indent=1))
