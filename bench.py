@@ -174,6 +174,75 @@ def bench_bert(dev, on_tpu, peak):
         }))
 
 
+def bench_bert_masked(dev, on_tpu, peak):
+    """The LARK/BERT pretraining recipe proper: mask_pos gather before the
+    LM head, so the [*, vocab] projection runs on 20 masked positions per
+    sequence instead of all 128 (VERDICT r3 ask #2 — separate line; the
+    dense-MLM line above stays the honest upper-bound config)."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+    from paddle_tpu.models import transformer as T
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        if on_tpu:
+            cfg = T.BertConfig()
+            batch, seq_len, n_mask, steps = 128, 128, 20, 64
+        else:
+            cfg = T.BertConfig(vocab_size=1024, d_model=128, n_layer=2,
+                               n_head=4, d_inner=256, max_pos=128)
+            batch, seq_len, n_mask, steps = 4, 64, 5, 2
+            peak = 1e12
+        feeds, logits, loss = T.build_bert_pretrain(
+            cfg, seq_len, fused_head=True, arange_pos=True,
+            masked_gather=n_mask)
+        optimizer = pt.amp.decorate(opt.AdamOptimizer(learning_rate=1e-4))
+        optimizer.minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+
+        rng = np.random.RandomState(0)
+        pos = np.stack([rng.choice(seq_len, n_mask, replace=False) + i * seq_len
+                        for i in range(batch)]).astype(np.int32)
+        feed = {
+            "src_ids": jax.device_put(rng.randint(
+                1, cfg.vocab_size, (batch, seq_len)).astype(np.int32)),
+            "mask_pos": jax.device_put(pos),
+            "lm_label": jax.device_put(rng.randint(
+                1, cfg.vocab_size, (batch, n_mask)).astype(np.int32)),
+        }
+        lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+        l0 = float(np.asarray(lv))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                          return_numpy=False)
+        lN = float(np.asarray(lv))
+        dt = (time.perf_counter() - t0) / steps
+
+        d, L, F, V = cfg.d_model, cfg.n_layer, cfg.d_inner, cfg.vocab_size
+        tokens = batch * seq_len
+        flops = 6 * L * (4 * d * d + 2 * d * F) * tokens \
+            + 6 * V * d * batch * n_mask \
+            + 12 * L * d * seq_len * tokens
+        mfu = flops / dt / peak
+        print(json.dumps({
+            "metric": "bert_base_masked_mlm_train_mfu" if on_tpu
+            else "bert_masked_tiny_train_smoke",
+            "value": round(mfu * 100, 2),
+            "unit": "% MFU",
+            "vs_baseline": round(mfu / 0.35, 4),
+            "step_time_s": round(dt, 4),
+            "tokens_per_s": round(tokens / dt, 1),
+            "device": str(dev), "batch": batch, "seq_len": seq_len,
+            "masked_per_seq": n_mask,
+            "loss_first_last": [round(l0, 3), round(lN, 3)],
+        }))
+
+
 def bench_bert_long(dev, on_tpu, peak):
     """Long-context line: BERT-base at seq 4096 where the Pallas flash
     kernel is the measured winner over XLA's O(T²) attention (v5e r2:
@@ -400,6 +469,7 @@ def main():
     bench_bert_long(dev, on_tpu, peak)
     bench_transformer_wmt(dev, on_tpu, peak)
     bench_deepfm_ps()
+    bench_bert_masked(dev, on_tpu, peak)
     bench_bert(dev, on_tpu, peak)          # flagship metric printed last
 
 

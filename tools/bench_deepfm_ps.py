@@ -107,27 +107,38 @@ def _run_mode(mode):
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # DEVNULL: the server must NOT inherit the parent's stdout — when
+    # bench.py captures this tool's output, an orphaned server holding the
+    # pipe's write end would block the parent's communicate() forever
     server = subprocess.Popen(
         [sys.executable, __file__, "pserver", "0", str(port),
-         str(N_TRAINERS), mode], env=env)
-    time.sleep(0.5)
+         str(N_TRAINERS), mode], env=env, stdout=subprocess.DEVNULL)
     trainers = []
-    for tid in range(N_TRAINERS):
-        trainers.append(subprocess.Popen(
-            [sys.executable, __file__, "trainer", str(tid), str(port),
-             str(N_TRAINERS), mode], env=env, stdout=subprocess.PIPE,
-            text=True))
-    results = []
-    for p in trainers:
-        out, _ = p.communicate(timeout=900)
-        line = [l for l in out.splitlines() if l.startswith("{")][-1]
-        results.append(json.loads(line))
-    # trainers are done: stop the server (the PS client is pure ctypes —
-    # safe to use from the parent without touching a jax backend)
     from paddle_tpu.distributed import ps as ps_mod
-    ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
-    server.wait(timeout=60)
-    ps_mod.reset_clients()
+    try:
+        time.sleep(0.5)
+        for tid in range(N_TRAINERS):
+            trainers.append(subprocess.Popen(
+                [sys.executable, __file__, "trainer", str(tid), str(port),
+                 str(N_TRAINERS), mode], env=env, stdout=subprocess.PIPE,
+                text=True))
+        results = []
+        for p in trainers:
+            out, _ = p.communicate(timeout=900)
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            results.append(json.loads(line))
+        # trainers are done: stop the server (the PS client is pure
+        # ctypes — safe from the parent without touching a jax backend)
+        ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+        server.wait(timeout=60)
+    finally:
+        # a failed mode must not leak processes or wedge later modes
+        for p in trainers:
+            if p.poll() is None:
+                p.kill()
+        if server.poll() is None:
+            server.kill()
+        ps_mod.reset_clients()
 
     total = sum(r["examples_per_s"] for r in results)
     suffix = {"sync": "", "async": "_async", "geo": "_geo"}[mode]

@@ -15,7 +15,8 @@ from rn50_ablate import timed  # noqa
 
 
 def bert_build(batch=128, seq=128, train=True, dropout=None, adam=True,
-               fused_head=True, nlayer=12):
+               fused_head=True, nlayer=12, fused_adam=False,
+               fused_max_numel=None):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.models import transformer as T
@@ -26,8 +27,9 @@ def bert_build(batch=128, seq=128, train=True, dropout=None, adam=True,
             cfg, seq, fused_head=fused_head, arange_pos=True,
             dropout=dropout)
         if train:
-            o = opt.AdamOptimizer(1e-4) if adam else \
-                opt.SGDOptimizer(1e-4)
+            o = opt.AdamOptimizer(1e-4, fused_flat=fused_adam,
+                                  fused_max_numel=fused_max_numel) \
+                if adam else opt.SGDOptimizer(1e-4)
             pt.amp.decorate(o).minimize(loss)
         else:
             pt.amp.enable()
