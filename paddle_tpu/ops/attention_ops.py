@@ -30,7 +30,8 @@ def _flash_attention(ctx, ins, attrs):
         q, k, v, bias=bias, causal=bool(attrs.get("causal", False)),
         sm_scale=attrs.get("sm_scale") or None,
         block_q=int(bq) if bq else None,     # None → kernel's tuned default
-        block_k=int(bk) if bk else None)
+        block_k=int(bk) if bk else None,
+        bwd_impl=attrs.get("bwd_impl") or None)
     return {"Out": [out]}
 
 

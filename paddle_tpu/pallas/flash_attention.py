@@ -308,15 +308,86 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
-                      block_k, offset, interpret):
-    """(dq, dk, dv) via the two kernels above (no-bias path)."""
+def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dkp_ref, dvp_ref, dq_sc, *, sm_scale,
+                         causal, block_q, block_k, tq_real, tk_real,
+                         offset):
+    """ONE recompute per (i, j) block pair: 5 MXU contractions instead of
+    the split kernels' 9 (each pass recomputes S).  Grid (bh, iq, ik) —
+    dq accumulates in VMEM scratch over the inner k axis exactly like
+    _bwd_dq_kernel; dk/dv come out as PER-q-BLOCK PARTIALS (written once
+    per grid step, no revisiting constraint) and are summed over the nq
+    axis by XLA outside.  The partial-sum HBM round trip costs
+    2·bh·nq·Tk·d·4 B — quadratic in T, so big bwd q-blocks matter (the
+    (512,1024)-block first attempt LOST 20 ms at 8k; (1024,512) wins by
+    5–7%, LONGCTX_ABLATION.md), and _flash_bwd_pallas falls back to the
+    split kernels past _COMBINED_PARTIAL_BUDGET."""
+    import jax.lax as lax
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                             # (bq, 1)
+        delta = delta_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mask = (k_pos < tk_real) & (q_pos < tq_real)
+        if causal:
+            mask = mask & (q_pos + offset >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[...] = dq_sc[...] + sm_scale * lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dvp_ref[0, 0] = lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
+        dkp_ref[0, 0] = (sm_scale * lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)).astype(dkp_ref.dtype)
+
+    if causal:
+        live = iq * block_q + block_q - 1 + offset >= ik * block_k
+
+        @pl.when(live)
+        def _():
+            _compute()
+
+        @pl.when(jnp.logical_not(live))
+        def _zero():
+            # skipped blocks must still define their partial outputs
+            dkp_ref[0, 0] = jnp.zeros_like(dkp_ref[0, 0])
+            dvp_ref[0, 0] = jnp.zeros_like(dvp_ref[0, 0])
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _bwd_prologue(q, k, v, o, lse, do, block_q, block_k):
+    """Shared pad/delta setup for both backward implementations."""
     bh, tq, d = q.shape
     tk = k.shape[1]
-    tq_real, tk_real = tq, tk
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     pad_q = (-tq) % block_q
@@ -331,7 +402,89 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
-    tqp, tkp = tq + pad_q, tk + pad_k
+    return (q, k, v, do, lse, delta, block_q, block_k,
+            tq + pad_q, tk + pad_k)
+
+
+def _flash_bwd_pallas_combined(q, k, v, o, lse, do, causal, sm_scale,
+                               block_q, block_k, offset, interpret):
+    """(dq, dk, dv) via the single-recompute combined kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    tq_real, tk_real = tq, tk
+    (q, k, v, do, lse, delta, block_q, block_k, tqp, tkp) = \
+        _bwd_prologue(q, k, v, o, lse, do, block_q, block_k)
+    nq, nk = tqp // block_q, tkp // block_k
+
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    part_spec = pl.BlockSpec((1, 1, block_k, d),
+                             lambda b, i, j: (b, i, j, 0))
+    dq, dkp, dvp = pl.pallas_call(
+        functools.partial(_bwd_combined_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            part_spec, part_spec,
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, tqp, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, nq, tkp, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, nq, tkp, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+    dk = jnp.sum(dkp, axis=1).astype(k.dtype)
+    dv = jnp.sum(dvp, axis=1).astype(v.dtype)
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
+# default pallas backward: "combined" (one recompute, dk/dv partial sums —
+# the r4 winner at long T) or "split" (the two-pass r2 kernels).
+# Overridable per call via flash_attention(bwd_impl=...).
+_BWD_IMPL = "combined"
+
+# the combined kernel's dk/dv partials cost 2·bh·nq·Tk·d·4 B of HBM —
+# QUADRATIC in T (nq = Tq/block_q).  Past this budget the split kernels'
+# O(bh·T·d) memory wins by not OOMing; fall back automatically.
+_COMBINED_PARTIAL_BUDGET = 2 << 30
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                      block_k, offset, interpret, impl=None):
+    impl = impl or _BWD_IMPL
+    if impl == "combined":
+        bh, tq, d = q.shape
+        tk = k.shape[1]
+        nq = -(-tq // min(block_q, tq))
+        partial_bytes = 2 * bh * nq * tk * d * 4
+        if partial_bytes <= _COMBINED_PARTIAL_BUDGET:
+            return _flash_bwd_pallas_combined(q, k, v, o, lse, do, causal,
+                                              sm_scale, block_q, block_k,
+                                              offset, interpret)
+    return _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
+                                   block_q, block_k, offset, interpret)
+
+
+def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                            block_k, offset, interpret):
+    """(dq, dk, dv) via the two kernels above (no-bias path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    tq_real, tk_real = tq, tk
+    (q, k, v, do, lse, delta, block_q, block_k, tqp, tkp) = \
+        _bwd_prologue(q, k, v, o, lse, do, block_q, block_k)
     nq, nk = tqp // block_q, tkp // block_k
 
     # lse/delta ride as [bh, tq, 1]: block (1, block_q, 1) keeps the last
@@ -528,8 +681,9 @@ def _flash_bwd_jax(q, k, v, bias, o, lse, do, causal, sm_scale, block_k,
 # Public custom-vjp op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, bwd_blocks,
+           bwd_impl, interpret):
     o, _ = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
                       interpret)
     return o
@@ -546,32 +700,46 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                   interpret):
+                   bwd_blocks, bwd_impl, interpret):
     o, lse = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
                         interpret)
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, bwd_blocks,
+                   bwd_impl, interpret, res, do):
     q, k, v, bias, o, lse = res
     offset = k.shape[1] - q.shape[1]
+    bq_b, bk_b = bwd_blocks if bwd_blocks is not None else (block_q, block_k)
     if bias is None and (_on_tpu() or interpret):
         dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, causal,
-                                       sm_scale, block_q, block_k, offset,
-                                       interpret)
+                                       sm_scale, bq_b, bk_b, offset,
+                                       interpret, impl=bwd_impl)
         return dq, dk, dv, None
     dq, dk, dv, db = _flash_bwd_jax(q, k, v, bias, o, lse, do, causal,
-                                    sm_scale, block_k, offset)
+                                    sm_scale, bk_b, offset)
     return dq, dk, dv, db
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# End-to-end-validated block defaults per sequence length (r4 sweep,
+# LONGCTX_ABLATION.md).  Keys are max(Tq, Tk); anything else takes the
+# (512, 1024) baseline.  The bwd table feeds the combined single-recompute
+# kernel: big q-blocks keep its dk/dv partial-sum traffic low.
+_FWD_DEFAULTS = {4096: (1024, 1024), 8192: (1024, 1024),
+                 16384: (512, 2048)}
+_BWD_DEFAULTS = {4096: (1024, 512), 8192: (1024, 512), 16384: (1024, 512)}
+
+
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     causal: bool = False, sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
+                    bwd_impl: Optional[str] = None,
                     interpret: bool = False):
     """Fused attention over [batch, heads, T, head_dim] tensors.
 
@@ -581,15 +749,37 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     Default blocks are (512, 1024) capped at the sequence lengths —
     measured on v5e: 7.6× faster than 128×128 at T=16k (23–25 ms f+b at
     [1,16,16384,128]), and ahead of XLA's O(T²) attention from T≈1024.
+    The backward kernels take their own ``block_q_bwd``/``block_k_bwd``
+    (default: same as forward) — swept separately in LONGCTX_ABLATION.md.
+    ``bwd_impl``: "combined" (single-recompute, dk/dv partial sums;
+    auto-falls back to split when the partials would exceed
+    ``_COMBINED_PARTIAL_BUDGET`` HBM) or "split" (two-pass);
+    default = module `_BWD_IMPL`.
     """
     b, h, tq, d = q.shape
     tk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    # per-length defaults from the r4 IN-GRAPH sweep on v5e (d=64,
+    # bh 12–48, LONGCTX_ABLATION.md): standalone-kernel optima do NOT
+    # transfer (XLA overlap + VMEM pressure shift the landscape), so the
+    # tables hold the end-to-end winners
+    if block_q is None and block_k is None:
+        block_q, block_k = _FWD_DEFAULTS.get(max(tq, tk), (512, 1024))
     if block_q is None:
         block_q = min(512, tq)
     if block_k is None:
         block_k = min(1024, tk)
+    block_q, block_k = min(block_q, tq), min(block_k, tk)
+    bwd_blocks = None
+    if block_q_bwd is not None or block_k_bwd is not None:
+        bwd_blocks = (min(block_q_bwd or block_q, tq),
+                      min(block_k_bwd or block_k, tk))
+    else:
+        t = max(tq, tk)
+        if t in _BWD_DEFAULTS:
+            bq_b, bk_b = _BWD_DEFAULTS[t]
+            bwd_blocks = (min(bq_b, tq), min(bk_b, tk))
     qc = q.reshape(b * h, tq, d)
     kc = k.reshape(b * h, tk, d)
     vc = v.reshape(b * h, tk, d)
@@ -604,5 +794,5 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             bc = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(
                 b * h, tq, tk)
     o = _flash(qc, kc, vc, bc, causal, sm_scale, block_q, block_k,
-               interpret)
+               bwd_blocks, bwd_impl, interpret)
     return o.reshape(b, h, tq, d)
