@@ -763,8 +763,11 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     # per-length defaults from the r4 IN-GRAPH sweep on v5e (d=64,
     # bh 12–48, LONGCTX_ABLATION.md): standalone-kernel optima do NOT
     # transfer (XLA overlap + VMEM pressure shift the landscape), so the
-    # tables hold the end-to-end winners
-    if block_q is None and block_k is None:
+    # tables hold the end-to-end winners.  Swept at d=64 ONLY — wider
+    # heads double the tile VMEM (2048-wide K/V at d=128 matches configs
+    # that failed to compile), so d>64 keeps the long-validated baseline
+    use_tables = d <= 64
+    if block_q is None and block_k is None and use_tables:
         block_q, block_k = _FWD_DEFAULTS.get(max(tq, tk), (512, 1024))
     if block_q is None:
         block_q = min(512, tq)
@@ -777,7 +780,7 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                       min(block_k_bwd or block_k, tk))
     else:
         t = max(tq, tk)
-        if t in _BWD_DEFAULTS:
+        if use_tables and t in _BWD_DEFAULTS:
             bq_b, bk_b = _BWD_DEFAULTS[t]
             bwd_blocks = (min(bq_b, tq), min(bk_b, tk))
     qc = q.reshape(b * h, tq, d)

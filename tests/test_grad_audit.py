@@ -81,335 +81,369 @@ def _configs(op):
     r = _rng(op)
     f, i = (lambda *s, **k: _f(r, *s, **k)), (lambda *s, **k: _i(r, *s, **k))
     C = {
-        "acos": _Cfg({"X": [f(2, 3, lo=-0.8, hi=0.8)]}),
-        "asin": _Cfg({"X": [f(2, 3, lo=-0.8, hi=0.8)]}),
-        "add_position_encoding": _Cfg({"X": [f(2, 3, 4)]},
+        "acos": lambda: _Cfg({"X": [f(2, 3, lo=-0.8, hi=0.8)]}),
+        "asin": lambda: _Cfg({"X": [f(2, 3, lo=-0.8, hi=0.8)]}),
+        "add_position_encoding": lambda: _Cfg({"X": [f(2, 3, 4)]},
                                       {"alpha": 1.0, "beta": 1.0}),
-        "affine_channel": _Cfg({"X": [f(2, 3, 2, 2)], "Scale": [f(3)],
+        "affine_channel": lambda: _Cfg({"X": [f(2, 3, 2, 2)], "Scale": [f(3)],
                                 "Bias": [f(3)]}, {"data_layout": "NCHW"}),
-        "affine_grid": _Cfg({"Theta": [f(2, 2, 3)]},
+        "affine_grid": lambda: _Cfg({"Theta": [f(2, 2, 3)]},
                             {"output_shape": [2, 1, 3, 3]}),
-        "batch_norm": _Cfg(
+        "batch_norm": lambda: _Cfg(
             {"X": [f(2, 3, 2, 2)], "Scale": [f(3)], "Bias": [f(3)],
              "Mean": [f(3)], "Variance": [f(3)]},
             {"is_test": False, "momentum": 0.9, "epsilon": 1e-5},
             nodiff={"Mean", "Variance"}, loss_outputs=["Y"]),
-        "sync_batch_norm": _Cfg(
+        "sync_batch_norm": lambda: _Cfg(
             {"X": [f(2, 3, 2, 2)], "Scale": [f(3)], "Bias": [f(3)],
              "Mean": [f(3)], "Variance": [f(3)]},
             {"is_test": False, "momentum": 0.9, "epsilon": 1e-5},
             nodiff={"Mean", "Variance"}, loss_outputs=["Y"]),
-        "bilinear_tensor_product": _Cfg(
+        "bilinear_tensor_product": lambda: _Cfg(
             {"X": [f(2, 3)], "Y": [f(2, 4)], "Weight": [f(5, 3, 4)],
              "Bias": [f(1, 5)]}),
-        "cast": _Cfg({"X": [f(2, 3)]},
+        "cast": lambda: _Cfg({"X": [f(2, 3)]},
                      {"in_dtype": "float32", "out_dtype": "float32"}),
-        "center_loss": _Cfg(
+        "center_loss": lambda: _Cfg(
             {"X": [f(4, 3)], "Label": [i(4, 1, n=5)], "Centers": [f(5, 3)],
              "CenterUpdateRate": [np.float32([0.1])]},
             {"need_update": False, "cluster_num": 5},
             nodiff={"Centers", "CenterUpdateRate"}, loss_outputs=["Loss"]),
-        "clip": _Cfg({"X": [f(2, 3)]}, {"min": 0.0, "max": 2.0}),
-        "clip_by_norm": _Cfg({"X": [f(2, 3)]}, {"max_norm": 0.8}),
-        "conv2d": _Cfg({"Input": [f(1, 2, 4, 4)], "Filter": [f(3, 2, 3, 3)]},
+        "clip": lambda: _Cfg({"X": [f(2, 3)]}, {"min": 0.0, "max": 2.0}),
+        "clip_by_norm": lambda: _Cfg({"X": [f(2, 3)]}, {"max_norm": 0.8}),
+        "conv2d": lambda: _Cfg({"Input": [f(1, 2, 4, 4)], "Filter": [f(3, 2, 3, 3)]},
                        {"strides": [1, 1], "paddings": [0, 0],
                         "dilations": [1, 1], "groups": 1}),
-        "conv2d_transpose": _Cfg(
+        "conv2d_transpose": lambda: _Cfg(
             {"Input": [f(1, 3, 3, 3)], "Filter": [f(3, 2, 2, 2)]},
             {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
              "groups": 1}),
-        "conv3d": _Cfg(
+        "conv3d": lambda: _Cfg(
             {"Input": [f(1, 2, 3, 3, 3)], "Filter": [f(2, 2, 2, 2, 2)]},
             {"strides": [1, 1, 1], "paddings": [0, 0, 0],
              "dilations": [1, 1, 1], "groups": 1}),
-        "conv3d_transpose": _Cfg(
+        "conv3d_transpose": lambda: _Cfg(
             {"Input": [f(1, 2, 2, 2, 2)], "Filter": [f(2, 2, 2, 2, 2)]},
             {"strides": [1, 1, 1], "paddings": [0, 0, 0],
              "dilations": [1, 1, 1], "groups": 1}),
-        "depthwise_conv2d": _Cfg(
+        "depthwise_conv2d": lambda: _Cfg(
             {"Input": [f(1, 2, 4, 4)], "Filter": [f(2, 1, 3, 3)]},
             {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
              "groups": 2}),
-        "depthwise_conv2d_transpose": _Cfg(
+        "depthwise_conv2d_transpose": lambda: _Cfg(
             {"Input": [f(1, 2, 3, 3)], "Filter": [f(2, 1, 2, 2)]},
             {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
              "groups": 2}),
-        "crop": _Cfg({"X": [f(3, 4)]}, {"shape": [2, 2], "offsets": [0, 1]}),
-        "crop_tensor": _Cfg({"X": [f(3, 4)]},
+        "crop": lambda: _Cfg({"X": [f(3, 4)]}, {"shape": [2, 2], "offsets": [0, 1]}),
+        "crop_tensor": lambda: _Cfg({"X": [f(3, 4)]},
                             {"shape": [2, 2], "offsets": [0, 1]}),
-        "cudnn_lstm": _Cfg(
+        "cudnn_lstm": lambda: _Cfg(
             {"Input": [f(3, 2, 3)], "W": [f(56)],
              "InitH": [f(1, 2, 2)], "InitC": [f(1, 2, 2)]},
             {"hidden_size": 2, "num_layers": 1, "is_bidirec": False},
             loss_outputs=["Out"]),
-        "data_norm": _Cfg(
+        "data_norm": lambda: _Cfg(
             {"X": [f(4, 3)], "BatchSize": [f(3, lo=5, hi=6)],
              "BatchSum": [f(3)], "BatchSquareSum": [f(3, lo=5, hi=6)]},
             nodiff={"BatchSize", "BatchSum", "BatchSquareSum"},
             loss_outputs=["Y"]),
-        "deformable_conv": _Cfg(
+        "deformable_conv": lambda: _Cfg(
             {"Input": [f(1, 2, 4, 4)], "Offset": [f(1, 36, 4, 4, lo=-.2,
                                                     hi=.2)],
              "Mask": [f(1, 18, 4, 4)], "Filter": [f(3, 2, 3, 3)]},
             {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
              "groups": 1, "deformable_groups": 2, "im2col_step": 1},
             rtol=8e-2, atol=2e-2),
-        "deformable_conv_v1": _Cfg(
+        "deformable_conv_v1": lambda: _Cfg(
             {"Input": [f(1, 2, 4, 4)], "Offset": [f(1, 36, 4, 4, lo=-.2,
                                                     hi=.2)],
              "Filter": [f(3, 2, 3, 3)]},
             {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
              "groups": 1, "deformable_groups": 2, "im2col_step": 1},
             rtol=8e-2, atol=2e-2),
-        "dropout": _Cfg({"X": [f(2, 6)]},
+        "dropout": lambda: _Cfg({"X": [f(2, 6)]},
                         {"dropout_prob": 0.35, "is_test": False, "seed": 7,
                          "dropout_implementation": "upscale_in_train"},
                         loss_outputs=["Out"]),
-        "elementwise_max": _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
+        "elementwise_max": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
                                                          hi=3.5)]}),
-        "elementwise_min": _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
+        "elementwise_min": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
                                                          hi=3.5)]}),
-        "elementwise_mod": _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
+        "elementwise_mod": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(2, 3, lo=2.5,
                                                          hi=3.5)]}),
-        "elementwise_floordiv": _Cfg({"X": [f(2, 3)],
+        "elementwise_floordiv": lambda: _Cfg({"X": [f(2, 3)],
                                       "Y": [f(2, 3, lo=2.5, hi=3.5)]}),
-        "expand": _Cfg({"X": [f(2, 3)]}, {"expand_times": [2, 2]}),
-        "expand_as": _Cfg({"X": [f(2, 3)], "target_tensor": [f(4, 6)]},
+        "expand": lambda: _Cfg({"X": [f(2, 3)]}, {"expand_times": [2, 2]}),
+        "expand_as": lambda: _Cfg({"X": [f(2, 3)], "target_tensor": [f(4, 6)]},
                           nodiff={"target_tensor"}),
-        "fc": _Cfg({"Input": [f(2, 3)], "W": [f(3, 4)], "Bias": [f(4)]},
+        "fc": lambda: _Cfg({"Input": [f(2, 3)], "W": [f(3, 4)], "Bias": [f(4)]},
                    {"in_num_col_dims": 1}),
-        "flash_attention": _Cfg(
+        "flash_attention": lambda: _Cfg(
             {"Q": [f(1, 2, 8, 4)], "K": [f(1, 2, 8, 4)],
              "V": [f(1, 2, 8, 4)]},
             {"sm_scale": 0.5, "causal": False}, rtol=8e-2, atol=2e-2),
-        "fsp": _Cfg({"X": [f(1, 2, 3, 3)], "Y": [f(1, 4, 3, 3)]}),
-        "fused_elemwise_activation": _Cfg(
+        "fsp": lambda: _Cfg({"X": [f(1, 2, 3, 3)], "Y": [f(1, 4, 3, 3)]}),
+        # bf16 MXU matmul inside (like fused_lm_head_ce): central
+        # differences at f32 eps sample bf16 quantization — widen; the
+        # analytic grads match an f32 reference to 1e-6 (checked in
+        # test_ir.py's trajectory parity too)
+        "fused_conv1x1_bn": lambda: _Cfg(
+            {"X": [f(2, 3, 4, 4)], "Filter": [f(5, 3, 1, 1)],
+             "Scale": [f(5)], "Bias": [f(5)], "Mean": [f(5)],
+             "Variance": [f(5)]},
+            {"stride": 1, "act": "relu", "momentum": 0.9,
+             "epsilon": 1e-5, "is_test": False,
+             "use_global_stats": False},
+            nodiff={"Mean", "Variance"}, loss_outputs=["Y"],
+            eps=5e-2, rtol=1.5e-1, atol=5e-2),
+        "fused_elemwise_activation": lambda: _Cfg(
             {"X": [f(2, 3)], "Y": [f(2, 3)]},
             {"functor_list": ["elementwise_add", "relu"], "axis": -1}),
-        "fused_embedding_seq_pool": _Cfg(
+        "fused_embedding_seq_pool": lambda: _Cfg(
             {"W": [f(10, 4)], "Ids": [i(2, 3, 1, n=10)]},
             {"combiner": "sum", "is_sparse": False}),
         # the chunk body matmuls in bf16 (MXU native): central differences
         # at f32 eps measure bf16 quantization, so widen eps/tol (ref
         # OpTest uses max_relative_error≈0.15 for fp16 kernels likewise)
-        "fused_lm_head_ce": _Cfg(
+        "fused_lm_head_ce": lambda: _Cfg(
             {"X": [f(4, 3)], "W": [f(3, 7)], "Bias": [f(7)],
              "Label": [i(4, n=7)]},
             {"chunk_size": 2, "ignore_index": -1}, loss_outputs=["Loss"],
             eps=5e-2, rtol=1.5e-1, atol=5e-2),
-        "gather": _Cfg({"X": [f(5, 3)], "Index": [i(4, n=5)]}, {"axis": 0}),
-        "gather_nd": _Cfg({"X": [f(3, 4)], "Index": [i(2, 2, n=3)]}),
-        "grid_sampler": _Cfg({"X": [f(1, 2, 4, 4)],
+        "gather": lambda: _Cfg({"X": [f(5, 3)], "Index": [i(4, n=5)]}, {"axis": 0}),
+        "gather_nd": lambda: _Cfg({"X": [f(3, 4)], "Index": [i(2, 2, n=3)]}),
+        "grid_sampler": lambda: _Cfg({"X": [f(1, 2, 4, 4)],
                               "Grid": [f(1, 3, 3, 2, lo=-.7, hi=.7)]},
                              rtol=8e-2, atol=2e-2),
-        "group_norm": _Cfg({"X": [f(2, 4, 3, 3)], "Scale": [f(4)],
+        "group_norm": lambda: _Cfg({"X": [f(2, 4, 3, 3)], "Scale": [f(4)],
                             "Bias": [f(4)]},
                            {"groups": 2, "epsilon": 1e-5},
                            loss_outputs=["Y"]),
-        "gru": _Cfg({"Input": [f(2, 3, 9)], "Weight": [f(3, 9)],
+        "gru": lambda: _Cfg({"Input": [f(2, 3, 9)], "Weight": [f(3, 9)],
                      "Bias": [f(1, 9)]},
                     {"gate_activation": "sigmoid", "activation": "tanh"},
                     loss_outputs=["Hidden"]),
-        "gru_unit": _Cfg({"Input": [f(2, 9)], "HiddenPrev": [f(2, 3)],
+        "gru_unit": lambda: _Cfg({"Input": [f(2, 9)], "HiddenPrev": [f(2, 3)],
                           "Weight": [f(3, 9)], "Bias": [f(1, 9)]},
                          loss_outputs=["Hidden"]),
-        "hard_shrink": _Cfg({"X": [f(2, 3, lo=0.8, hi=1.5)]},
+        "hard_shrink": lambda: _Cfg({"X": [f(2, 3, lo=0.8, hi=1.5)]},
                             {"threshold": 0.5}),
-        "softshrink": _Cfg({"X": [f(2, 3, lo=0.8, hi=1.5)]},
+        "softshrink": lambda: _Cfg({"X": [f(2, 3, lo=0.8, hi=1.5)]},
                            {"lambda": 0.5}),
-        "thresholded_relu": _Cfg({"X": [f(2, 3, lo=1.2, hi=1.8)]},
+        "thresholded_relu": lambda: _Cfg({"X": [f(2, 3, lo=1.2, hi=1.8)]},
                                  {"threshold": 1.0}),
-        "hierarchical_sigmoid": _Cfg(
+        "hierarchical_sigmoid": lambda: _Cfg(
             {"X": [f(3, 4)], "W": [f(3, 4)], "Label": [i(3, 1, n=4)],
              "Bias": [f(3, 1)]},
             {"num_classes": 4}, loss_outputs=["Out"]),
-        "hinge_loss": _Cfg({"Logits": [f(3, 1, lo=0.2, hi=0.6)],
+        "hinge_loss": lambda: _Cfg({"Logits": [f(3, 1, lo=0.2, hi=0.6)],
                             "Labels": [np.float32([[0], [1], [1]])]},
                            nodiff={"Labels"}),
-        "im2sequence": _Cfg({"X": [f(1, 2, 4, 4)]},
+        "im2sequence": lambda: _Cfg({"X": [f(1, 2, 4, 4)]},
                             {"kernels": [2, 2], "strides": [2, 2],
                              "paddings": [0, 0, 0, 0]}),
-        "kldiv_loss": _Cfg({"X": [f(3, 4, lo=-2, hi=-0.5)],
+        "kldiv_loss": lambda: _Cfg({"X": [f(3, 4, lo=-2, hi=-0.5)],
                             "Target": [f(3, 4, lo=0.2, hi=0.8)]},
                            {"reduction": "mean"}, nodiff={"Target"}),
-        "linear_chain_crf": _Cfg(
+        "linear_chain_crf": lambda: _Cfg(
             {"Emission": [f(2, 3, 4)], "Transition": [f(6, 4)],
              "Label": [i(2, 3, 1, n=4)],
              "Length": [np.int64([3, 2])]},
             loss_outputs=["LogLikelihood"]),
-        "log_loss": _Cfg({"Predicted": [f(3, 1, lo=0.2, hi=0.8)],
+        "log_loss": lambda: _Cfg({"Predicted": [f(3, 1, lo=0.2, hi=0.8)],
                           "Labels": [np.float32([[0], [1], [1]])]},
                          {"epsilon": 1e-4}, nodiff={"Labels"}),
-        "lookup_table": _Cfg({"W": [f(10, 4)], "Ids": [i(3, 1, n=10)]},
+        "lookup_table": lambda: _Cfg({"W": [f(10, 4)], "Ids": [i(3, 1, n=10)]},
                              {"padding_idx": -1}),
-        "lookup_table_v2": _Cfg({"W": [f(10, 4)], "Ids": [i(3, n=10)]},
+        "lookup_table_v2": lambda: _Cfg({"W": [f(10, 4)], "Ids": [i(3, n=10)]},
                                 {"padding_idx": -1}),
-        "lstm": _Cfg({"Input": [f(2, 3, 8)], "Weight": [f(2, 8)],
+        "lstm": lambda: _Cfg({"Input": [f(2, 3, 8)], "Weight": [f(2, 8)],
                       "Bias": [f(1, 8)]},
                      {"use_peepholes": False}, loss_outputs=["Hidden"]),
-        "lstm_unit": _Cfg({"X": [f(2, 8)], "C_prev": [f(2, 2)]},
+        "lstm_unit": lambda: _Cfg({"X": [f(2, 8)], "C_prev": [f(2, 2)]},
                           {"forget_bias": 0.0}),
-        "lstmp": _Cfg({"Input": [f(2, 3, 8)], "Weight": [f(3, 8)],
+        "lstmp": lambda: _Cfg({"Input": [f(2, 3, 8)], "Weight": [f(3, 8)],
                        "ProjWeight": [f(2, 3)], "Bias": [f(1, 8)]},
                       {"use_peepholes": False},
                       loss_outputs=["Projection"]),
-        "margin_rank_loss": _Cfg(
+        "margin_rank_loss": lambda: _Cfg(
             {"X1": [f(3, 1)], "X2": [f(3, 1, lo=1.8, hi=2.5)],
              "Label": [np.ones((3, 1), np.float32)]},
             {"margin": 0.1}, nodiff={"Label"}),
-        "match_matrix_tensor": _Cfg(
+        "match_matrix_tensor": lambda: _Cfg(
             {"X": [f(1, 3, 4)], "Y": [f(1, 2, 4)], "W": [f(4, 2, 4)]},
             {"dim_t": 2}),
-        "matmul": _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
+        "matmul": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
                        {"transpose_X": False, "transpose_Y": False,
                         "alpha": 1.0}),
-        "matmul_v2": _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
+        "matmul_v2": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
                           {"trans_x": False, "trans_y": False}),
-        "max_pool2d_with_index": _Cfg(
-            {"X": [f(1, 2, 4, 4)]},
+        # max pools: permutation data guarantees every within-window gap
+        # >= 0.1 > 2*eps, so central differences can't flip an argmax
+        "max_pool2d_with_index": lambda: _Cfg(
+            {"X": [(r.permutation(32).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(1, 2, 4, 4)]},
             {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
             loss_outputs=["Out"]),
-        "max_pool3d_with_index": _Cfg(
-            {"X": [f(1, 1, 4, 4, 4)]},
+        "max_pool3d_with_index": lambda: _Cfg(
+            {"X": [(r.permutation(64).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(1, 1, 4, 4, 4)]},
             {"ksize": [2, 2, 2], "strides": [2, 2, 2],
              "paddings": [0, 0, 0]}, loss_outputs=["Out"]),
+        "spp": lambda: _Cfg(
+            {"X": [(r.permutation(32).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(1, 2, 4, 4)]}),
+        "pool2d": lambda: _Cfg(
+            {"X": [(r.permutation(32).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(1, 2, 4, 4)]},
+            {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0]}),
+        "reduce_max": lambda: _Cfg(
+            {"X": [(r.permutation(6).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(2, 3)]}),
+        "reduce_min": lambda: _Cfg(
+            {"X": [(r.permutation(6).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(2, 3)]}),
+        "max": lambda: _Cfg(
+            {"X": [(r.permutation(6).astype(np.float32) * 0.1 + 0.05
+                    ).reshape(2, 3)]}),
         # distinct well-separated values so no cross-group max tie sits
         # within ±eps of another candidate
-        "maxout": _Cfg(
+        "maxout": lambda: _Cfg(
             {"X": [(r.permutation(36).astype(np.float32) * 0.1 + 0.05
                     ).reshape(1, 4, 3, 3)]}, {"groups": 2}),
-        "mul": _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
+        "mul": lambda: _Cfg({"X": [f(2, 3)], "Y": [f(3, 4)]},
                     {"x_num_col_dims": 1, "y_num_col_dims": 1}),
-        "multiplex": _Cfg({"Ids": [i(3, 1, n=2)],
+        "multiplex": lambda: _Cfg({"Ids": [i(3, 1, n=2)],
                            "X": [f(3, 4), f(3, 4)]}),
-        "nce": _Cfg({"Input": [f(3, 4)], "Weight": [f(6, 4)],
+        "nce": lambda: _Cfg({"Input": [f(3, 4)], "Weight": [f(6, 4)],
                      "Bias": [f(6)], "Label": [i(3, 1, n=6)]},
                     {"num_total_classes": 6, "num_neg_samples": 2,
                      "sampler": 0, "seed": 3}, loss_outputs=["Cost"]),
-        "npair_loss": _Cfg({"Anchor": [f(3, 4)], "Positive": [f(3, 4)],
+        "npair_loss": lambda: _Cfg({"Anchor": [f(3, 4)], "Positive": [f(3, 4)],
                             "Labels": [i(3, n=3).astype(np.float32)]},
                            {"l2_reg": 0.01}, nodiff={"Labels"}),
-        "pad": _Cfg({"X": [f(2, 3)]},
+        "pad": lambda: _Cfg({"X": [f(2, 3)]},
                     {"paddings": [1, 1, 0, 2], "pad_value": 0.3}),
-        "pad2d": _Cfg({"X": [f(1, 2, 3, 3)]},
+        "pad2d": lambda: _Cfg({"X": [f(1, 2, 3, 3)]},
                       {"paddings": [1, 0, 1, 0], "mode": "constant",
                        "pad_value": 0.0, "data_format": "NCHW"}),
-        "pad_constant_like": _Cfg({"X": [f(4, 5)], "Y": [f(2, 3)]},
+        "pad_constant_like": lambda: _Cfg({"X": [f(4, 5)], "Y": [f(2, 3)]},
                                   {"pad_value": 0.1}, nodiff={"X"}),
-        "pool3d": _Cfg({"X": [f(1, 1, 4, 4, 4)]},
+        "pool3d": lambda: _Cfg({"X": [f(1, 1, 4, 4, 4)]},
                        {"pooling_type": "avg", "ksize": [2, 2, 2],
                         "strides": [2, 2, 2], "paddings": [0, 0, 0],
                         "global_pooling": False}),
-        "prelu": _Cfg({"X": [np.float32([[-1.2, 0.8, -0.5],
+        "prelu": lambda: _Cfg({"X": [np.float32([[-1.2, 0.8, -0.5],
                                          [1.1, -0.9, 0.7]])],
                        "Alpha": [f(1)]}, {"mode": "all"}),
-        "prroi_pool": _Cfg(
+        "prroi_pool": lambda: _Cfg(
             {"X": [f(1, 2, 5, 5)],
              "ROIs": [np.float32([[0.4, 0.4, 3.6, 3.6],
                                   [1.2, 0.7, 4.2, 3.3]])]},
             {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
             nodiff={"ROIs"}),
-        "psroi_pool": _Cfg(
+        "psroi_pool": lambda: _Cfg(
             {"X": [f(1, 8, 4, 4)],
              "ROIs": [np.float32([[0.4, 0.4, 3.6, 3.6]])]},
             {"output_channels": 2, "pooled_height": 2, "pooled_width": 2,
              "spatial_scale": 1.0}, nodiff={"ROIs"}),
-        "roi_align": _Cfg(
+        "roi_align": lambda: _Cfg(
             {"X": [f(1, 2, 5, 5)],
              "ROIs": [np.float32([[0.4, 0.4, 3.6, 3.6],
                                   [1.2, 0.7, 4.2, 3.3]])]},
             {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0,
              "sampling_ratio": 2}, nodiff={"ROIs"}),
-        "roi_pool": _Cfg(
+        "roi_pool": lambda: _Cfg(
             {"X": [f(1, 2, 5, 5)],
              "ROIs": [np.float32([[0.4, 0.4, 3.6, 3.6]])]},
             {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
             nodiff={"ROIs"}, loss_outputs=["Out"]),
-        "rank_loss": _Cfg({"Label": [np.float32([[1], [0], [1]])],
+        "rank_loss": lambda: _Cfg({"Label": [np.float32([[1], [0], [1]])],
                            "Left": [f(3, 1)], "Right": [f(3, 1)]},
                           nodiff={"Label"}),
         # piecewise-constant ops: keep inputs clear of the jump points so
         # ±eps stays on one step (analytic 0 == numeric 0)
-        "round": _Cfg({"X": [f(2, 3, lo=0.55, hi=0.95)]}),
-        "floor": _Cfg({"X": [f(2, 3, lo=0.1, hi=0.9)]}),
-        "ceil": _Cfg({"X": [f(2, 3, lo=0.1, hi=0.9)]}),
-        "reshape": _Cfg({"X": [f(2, 3)]}, {"shape": [3, 2]}),
-        "reshape2": _Cfg({"X": [f(2, 3)]}, {"shape": [3, 2]}),
-        "reverse": _Cfg({"X": [f(2, 3)]}, {"axis": [0]}),
-        "row_conv": _Cfg({"X": [f(2, 4, 3)], "Filter": [f(2, 3)]}),
-        "sample_logits": _Cfg(
+        "round": lambda: _Cfg({"X": [f(2, 3, lo=0.55, hi=0.95)]}),
+        "floor": lambda: _Cfg({"X": [f(2, 3, lo=0.1, hi=0.9)]}),
+        "ceil": lambda: _Cfg({"X": [f(2, 3, lo=0.1, hi=0.9)]}),
+        "reshape": lambda: _Cfg({"X": [f(2, 3)]}, {"shape": [3, 2]}),
+        "reshape2": lambda: _Cfg({"X": [f(2, 3)]}, {"shape": [3, 2]}),
+        "reverse": lambda: _Cfg({"X": [f(2, 3)]}, {"axis": [0]}),
+        "row_conv": lambda: _Cfg({"X": [f(2, 4, 3)], "Filter": [f(2, 3)]}),
+        "sample_logits": lambda: _Cfg(
             {"Logits": [f(3, 5)], "Labels": [i(3, 1, n=5)]},
             {"num_samples": 2, "seed": 3}, loss_outputs=["SampledLogits"]),
-        "scale": _Cfg({"X": [f(2, 3)]}, {"scale": 1.7, "bias": 0.2}),
-        "scatter": _Cfg({"X": [f(5, 3)],
+        "scale": lambda: _Cfg({"X": [f(2, 3)]}, {"scale": 1.7, "bias": 0.2}),
+        "scatter": lambda: _Cfg({"X": [f(5, 3)],
                          "Ids": [np.int64([0, 2, 4])],
                          "Updates": [f(3, 3)]}, {"overwrite": True}),
-        "scatter_nd": _Cfg({"Index": [np.int64([[0], [2]])],
+        "scatter_nd": lambda: _Cfg({"Index": [np.int64([[0], [2]])],
                             "Updates": [f(2, 3)]}, {"shape": [4, 3]}),
-        "scatter_nd_add": _Cfg({"X": [f(4, 3)],
+        "scatter_nd_add": lambda: _Cfg({"X": [f(4, 3)],
                                 "Index": [np.int64([[0], [2]])],
                                 "Updates": [f(2, 3)]}),
-        "sequence_conv": _Cfg({"X": [f(1, 4, 2)], "Filter": [f(6, 4)]},
+        "sequence_conv": lambda: _Cfg({"X": [f(1, 4, 2)], "Filter": [f(6, 4)]},
                               {"context_length": 3, "context_start": -1}),
-        "sequence_reshape": _Cfg({"X": [f(1, 3, 4)]}, {"new_dim": 2}),
-        "sequence_scatter": _Cfg(
+        "sequence_reshape": lambda: _Cfg({"X": [f(1, 3, 4)]}, {"new_dim": 2}),
+        "sequence_scatter": lambda: _Cfg(
             {"X": [f(2, 4)], "Ids": [i(1, 3, n=4)], "Updates": [f(1, 3)]}),
-        "sequence_slice": _Cfg(
+        "sequence_slice": lambda: _Cfg(
             {"X": [f(1, 4, 3)], "Offset": [np.int64([[1]])],
              "Length": [np.int64([[2]])]}),
-        "sigmoid_focal_loss": _Cfg(
+        "sigmoid_focal_loss": lambda: _Cfg(
             {"X": [f(3, 4)], "Label": [i(3, 1, n=5)],
              "FgNum": [np.int64([2])]},
             {"gamma": 2.0, "alpha": 0.25}),
-        "slice": _Cfg({"Input": [f(3, 4)]},
+        "slice": lambda: _Cfg({"Input": [f(3, 4)]},
                       {"axes": [0, 1], "starts": [0, 1], "ends": [2, 3],
                        "decrease_axis": []}),
-        "softmax_with_cross_entropy": _Cfg(
+        "softmax_with_cross_entropy": lambda: _Cfg(
             {"Logits": [f(4, 5)], "Label": [i(4, 1, n=5)]},
             {"soft_label": False}, loss_outputs=["Loss"]),
-        "space_to_depth": _Cfg({"X": [f(1, 2, 4, 4)]}, {"blocksize": 2}),
-        "spectral_norm": _Cfg({"Weight": [f(3, 4)], "U": [f(3)],
+        "space_to_depth": lambda: _Cfg({"X": [f(1, 2, 4, 4)]}, {"blocksize": 2}),
+        "spectral_norm": lambda: _Cfg({"Weight": [f(3, 4)], "U": [f(3)],
                                "V": [f(4)]},
                               {"dim": 0, "power_iters": 1, "eps": 1e-12},
                               nodiff={"U", "V"}),
-        "split": _Cfg({"X": [f(2, 4)]}, {"axis": 1, "num": 2}),
-        "split_byref": _Cfg({"X": [f(2, 4)]}, {"axis": 1, "num": 2}),
-        "strided_slice": _Cfg({"Input": [f(4, 5)]},
+        "split": lambda: _Cfg({"X": [f(2, 4)]}, {"axis": 1, "num": 2}),
+        "split_byref": lambda: _Cfg({"X": [f(2, 4)]}, {"axis": 1, "num": 2}),
+        "strided_slice": lambda: _Cfg({"Input": [f(4, 5)]},
                               {"axes": [0, 1], "starts": [0, 1],
                                "ends": [4, 5], "strides": [2, 2]}),
-        "switch_ffn": _Cfg(
+        "switch_ffn": lambda: _Cfg(
             {"X": [f(2, 2, 3)], "GateW": [f(3, 2)], "W1": [f(2, 3, 5)],
              "B1": [f(2, 5)], "W2": [f(2, 5, 3)], "B2": [f(2, 3)]},
             {"capacity_factor": 2.0}, rtol=8e-2, atol=2e-2),
-        "temporal_shift": _Cfg({"X": [f(4, 4, 2, 2)]},
+        "temporal_shift": lambda: _Cfg({"X": [f(4, 4, 2, 2)]},
                                {"seg_num": 2, "shift_ratio": 0.25}),
-        "tile": _Cfg({"X": [f(2, 3)]}, {"repeat_times": [2, 1]}),
-        "transpose": _Cfg({"X": [f(2, 3)]}, {"axis": [1, 0]}),
-        "transpose2": _Cfg({"X": [f(2, 3)]}, {"axis": [1, 0]}),
-        "tree_conv": _Cfg(
+        "tile": lambda: _Cfg({"X": [f(2, 3)]}, {"repeat_times": [2, 1]}),
+        "transpose": lambda: _Cfg({"X": [f(2, 3)]}, {"axis": [1, 0]}),
+        "transpose2": lambda: _Cfg({"X": [f(2, 3)]}, {"axis": [1, 0]}),
+        "tree_conv": lambda: _Cfg(
             {"NodesVector": [f(1, 4, 3)],
              "EdgeSet": [np.int64([[[0, 1], [0, 2], [1, 3]]])],
              "Filter": [f(3, 3, 2, 4)]}, {"max_depth": 2}),
-        "trilinear_interp": _Cfg({"X": [f(1, 2, 3, 3, 3)]},
+        "trilinear_interp": lambda: _Cfg({"X": [f(1, 2, 3, 3, 3)]},
                                  {"out_d": 4, "out_h": 4, "out_w": 4}),
-        "unfold": _Cfg({"X": [f(1, 2, 4, 4)]},
+        "unfold": lambda: _Cfg({"X": [f(1, 2, 4, 4)]},
                        {"kernel_sizes": [2, 2], "strides": [2, 2],
                         "paddings": [0, 0, 0, 0], "dilations": [1, 1]}),
-        "unpool": _Cfg({"X": [f(1, 1, 2, 2)],
+        "unpool": lambda: _Cfg({"X": [f(1, 1, 2, 2)],
                         "Indices": [np.int64([[[[5, 7], [13, 15]]]])]},
                        {"unpooled_height": 4, "unpooled_width": 4}),
-        "var_conv_2d": _Cfg({"X": [f(1, 3, 4, 4)], "W": [f(2, 3, 2, 2)]},
+        "var_conv_2d": lambda: _Cfg({"X": [f(1, 3, 4, 4)], "W": [f(2, 3, 2, 2)]},
                             {"output_channel": 2, "input_channel": 3,
                              "kernel_h": 2, "kernel_w": 2,
                              "stride_h": 1, "stride_w": 1}),
-        "warpctc": _Cfg(
+        "warpctc": lambda: _Cfg(
             {"Logits": [f(2, 4, 5)],
              "Label": [i(2, 3, n=4) + 1],
              "LogitsLength": [np.int64([4, 4])],
              "LabelLength": [np.int64([3, 2])]},
             {"blank": 0, "norm_by_times": False}, loss_outputs=["Loss"],
             rtol=8e-2, atol=2e-2),
-        "yolov3_loss": _Cfg(
+        "yolov3_loss": lambda: _Cfg(
             {"X": [f(1, 14, 4, 4)],
              "GTBox": [f(1, 3, 4, lo=0.2, hi=0.7)],
              "GTLabel": [i(1, 3, n=2)]},
@@ -422,7 +456,8 @@ def _configs(op):
             nodiff={"GTBox"},
             loss_outputs=["Loss"], rtol=1e-1, atol=3e-2),
     }
-    return C.get(op)
+    fn = C.get(op)
+    return fn() if fn is not None else None
 
 
 # ---------------------------------------------------------------------------

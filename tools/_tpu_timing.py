@@ -1,0 +1,27 @@
+"""Shared tunnel-aware timing for the on-chip ablation tools.
+
+Through the axon tunnel jax.block_until_ready is a no-op and a host
+transfer is the only real sync, at a measured ~115 ms round trip and
+~7 MB/s bandwidth.  So: the timed callable must return a SCALAR (a big
+output would measure the transfer, not the kernel), steps are chained on
+device, ONE closing sync, RTT subtracted, clamped non-negative.
+"""
+import time
+
+import numpy as np
+
+TUNNEL_RTT = 0.115
+
+
+def sync(x):
+    return np.asarray(x)
+
+
+def time_fn(f, *args, iters=8):
+    out = f(*args)
+    assert np.asarray(out).size == 1, "time_fn needs a scalar-returning f"
+    sync(out)
+    t0 = time.perf_counter()
+    outs = [f(*args) for _ in range(iters)]
+    sync(outs[-1])
+    return max(time.perf_counter() - t0 - TUNNEL_RTT, 1e-9) / iters

@@ -22,22 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def sync(x):
-    return np.asarray(x)
-
-
-def time_fn(f, *args, iters=8):
-    """Tunnel-aware timing: f MUST return a scalar (the sync is a host
-    transfer — fetching a [bh,T,d] output would measure the ~7 MB/s
-    tunnel, not the kernel).  One sync for the whole chain, minus the
-    ~115 ms tunnel RTT."""
-    out = f(*args)
-    assert np.asarray(out).size == 1, "time_fn needs a scalar-returning f"
-    sync(out)
-    t0 = time.perf_counter()
-    outs = [f(*args) for _ in range(iters)]
-    sync(outs[-1])
-    return (time.perf_counter() - t0 - 0.115) / iters
+from _tpu_timing import TUNNEL_RTT, sync, time_fn  # noqa: E402
 
 
 def attn_sweep(seq, bh, d=64):
@@ -135,7 +120,7 @@ def e2e(seq, batch, train=True, nlayer=12, steps=8, fused_head=True,
             lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
                           return_numpy=False)
         sync(lv)
-        return (time.perf_counter() - t0 - 0.115) / steps
+        return max(time.perf_counter() - t0 - TUNNEL_RTT, 1e-9) / steps
 
 
 def main():

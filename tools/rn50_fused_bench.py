@@ -1,0 +1,81 @@
+"""Head-to-head: fused pallas matmul+BN-stats (+normalize prologue) vs
+XLA's own conv+BN chain at the ResNet-50 bandwidth-bound stage shapes
+(VERDICT r3 ask #1).  Measures the FORWARD bottleneck-1x1 pattern:
+
+    y1_raw, stats = conv1x1(x)            # + BN stats
+    y2 = conv1x1(normalize(relu'(y1)))    # consumer folds the normalize
+
+vs the XLA chain: conv -> batch stats (2 reductions) -> normalize+relu
+-> conv.  Both read/write the same logical tensors; the fused version
+saves the stats pass and the normalize round trip.
+
+Run on chip: PYTHONPATH=/root/repo:$PYTHONPATH python tools/rn50_fused_bench.py
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _tpu_timing import sync, time_fn  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.pallas.conv_bn import matmul_bn_stats
+
+    rng = np.random.RandomState(0)
+    eps = 1e-5
+    # (name, M=N*H*W, Cin, Cmid): stage0 56^2/C64, stage1 28^2/C128
+    shapes = [("stage0_56x56", 256 * 56 * 56, 256, 64),
+              ("stage1_28x28", 256 * 28 * 28, 512, 128)]
+    for name, m, cin, cmid in shapes:
+        x = jax.device_put(rng.randn(m, cin).astype(np.float32) * 0.5
+                           ).astype(jnp.bfloat16)
+        w1 = jax.device_put(rng.randn(cin, cmid).astype(np.float32) * 0.05
+                            ).astype(jnp.bfloat16)
+        w2 = jax.device_put(rng.randn(cmid, cmid).astype(np.float32) * 0.05
+                            ).astype(jnp.bfloat16)
+        g1 = jnp.ones((cmid,), jnp.float32)
+        b1 = jnp.zeros((cmid,), jnp.float32)
+
+        def xla_chain(x, w1, w2, g1, b1):
+            y1 = (x @ w1).astype(jnp.float32)
+            mu = jnp.mean(y1, axis=0)
+            var = jnp.mean(jnp.square(y1), axis=0) - mu * mu
+            inv = jax.lax.rsqrt(var + eps)
+            y1n = jnp.maximum((y1 - mu) * inv * g1 + b1, 0.0)
+            y2 = y1n.astype(jnp.bfloat16) @ w2
+            return y2.astype(jnp.float32).sum()
+
+        def fused_chain(x, w1, w2, g1, b1):
+            y1, s, s2 = matmul_bn_stats(x, w1, None, relu=False)
+            mu = s / m
+            var = s2 / m - mu * mu
+            inv = jax.lax.rsqrt(var + eps)
+            y2, _, _ = matmul_bn_stats(y1, w2, (mu, inv, g1, b1),
+                                       relu=True)
+            return y2.astype(jnp.float32).sum()
+
+        fx = jax.jit(xla_chain)
+        ff = jax.jit(fused_chain)
+        # parity first
+        a = float(np.asarray(fx(x, w1, w2, g1, b1)))
+        b = float(np.asarray(ff(x, w1, w2, g1, b1)))
+        rel = abs(a - b) / max(abs(a), 1)
+        dt_x = time_fn(fx, x, w1, w2, g1, b1)
+        dt_f = time_fn(ff, x, w1, w2, g1, b1)
+        gb = (m * cin * 2 + m * cmid * 2 * 2 + m * cmid * 2) / 1e9
+        print(f"{name}: XLA {dt_x*1000:7.2f} ms | fused {dt_f*1000:7.2f} ms"
+              f" | speedup {dt_x/dt_f:5.2f}x | rel-err {rel:.2e}"
+              f" | ~{gb:.1f} GB logical", flush=True)
+
+
+if __name__ == "__main__":
+    main()
