@@ -12,13 +12,20 @@
 // Run:   ./demo_predictor <model_dir> <input.npy> [output.npy]
 //
 // Supported op set (the full inference families of the models this
-// framework saves — MLP, conv nets, transformer encoders; ref
-// analysis_predictor runs the whole registry through NaiveExecutor,
-// naive_executor.cc): mul/matmul (batched, transposed, alpha),
-// elementwise_add/sub/mul/div with fluid axis broadcast, conv2d, pool2d,
-// batch_norm, layer_norm, relu/tanh/sigmoid/gelu, softmax, scale,
-// lookup_table, slice, concat, split, reshape2/flatten2/
-// unsqueeze2/squeeze2, transpose2, feed, fetch.
+// framework saves — MLP, conv nets, transformer encoders, detection
+// heads, recurrent taggers; ref analysis_predictor runs the whole
+// registry through NaiveExecutor, naive_executor.cc):
+//   mul/matmul (batched, transposed, alpha), elementwise
+//   add/sub/mul/div/max/min/pow with fluid axis broadcast, conv2d,
+//   pool2d, batch_norm, layer_norm, activations (relu/tanh/sigmoid/
+//   gelu/leaky_relu/relu6/hard_sigmoid/hard_swish/swish/elu/softplus/
+//   softsign + exp/log/sqrt/rsqrt/abs/square/floor/ceil/round/
+//   reciprocal/sign/clip), softmax, scale, reduce_sum/mean/max/min,
+//   dropout (inference), fill_constant, lookup_table, slice, concat,
+//   split, reshape2/flatten2/unsqueeze2/squeeze2, transpose2,
+//   top_k/argsort/arg_max/arg_min, gru/lstm, yolo_box,
+//   multiclass_nms, feed, fetch.  Payloads: f32 + exact int64 + bf16
+//   (u2 view).
 
 #include <algorithm>
 #include <chrono>
@@ -247,7 +254,9 @@ static void RunOp(const Json& op, Scope* scope) {
         }
     }
   } else if (type == "elementwise_add" || type == "elementwise_sub" ||
-             type == "elementwise_mul" || type == "elementwise_div") {
+             type == "elementwise_mul" || type == "elementwise_div" ||
+             type == "elementwise_max" || type == "elementwise_min" ||
+             type == "elementwise_pow") {
     // fluid broadcast: Y's shape aligns with X[axis : axis+Y.ndim]
     // (axis=-1 → trailing), and size-1 dims of Y broadcast (numpy
     // semantics, matching ops/common.py broadcast_to_x) — per-dim
@@ -286,7 +295,10 @@ static void RunOp(const Json& op, Scope* scope) {
       out.data[i] = type == "elementwise_add"   ? a + b
                     : type == "elementwise_sub" ? a - b
                     : type == "elementwise_mul" ? a * b
-                                                : a / b;
+                    : type == "elementwise_div" ? a / b
+                    : type == "elementwise_max" ? std::max(a, b)
+                    : type == "elementwise_min" ? std::min(a, b)
+                                                : std::pow(a, b);
     }
   } else if (type == "conv2d" || type == "depthwise_conv2d") {
     // NCHW direct convolution (deployment-side reference executor; the
@@ -681,6 +693,115 @@ static void RunOp(const Json& op, Scope* scope) {
     if (attrs.has("bias")) bias = static_cast<float>(attrs.at("bias").num);
     for (int64_t i = 0; i < x.numel(); ++i)
       out.data[i] = x.data[i] * sc + bias;
+  } else if (type == "exp" || type == "log" || type == "sqrt" ||
+             type == "rsqrt" || type == "abs" || type == "square" ||
+             type == "floor" || type == "ceil" || type == "round" ||
+             type == "reciprocal" || type == "sign" ||
+             type == "softplus" || type == "softsign" ||
+             type == "leaky_relu" || type == "relu6" ||
+             type == "hard_sigmoid" || type == "hard_swish" ||
+             type == "swish" || type == "elu" || type == "clip") {
+    // elementwise unary family (ref activation_op.cc kernel table)
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(x.shape);
+    float alpha = static_cast<float>(AttrNum(op, "alpha", 0.02));
+    float t = static_cast<float>(AttrNum(op, "threshold", 6.0));
+    float slope = static_cast<float>(AttrNum(op, "slope", 0.2));
+    float offset = static_cast<float>(AttrNum(op, "offset", 0.5));
+    float cmin = static_cast<float>(AttrNum(op, "min", 0.0));
+    float cmax = static_cast<float>(AttrNum(op, "max", 0.0));
+    float beta = static_cast<float>(AttrNum(op, "beta", 1.0));
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      float v = x.data[i], r;
+      if (type == "exp") r = std::exp(v);
+      else if (type == "log") r = std::log(v);
+      else if (type == "sqrt") r = std::sqrt(v);
+      else if (type == "rsqrt") r = 1.f / std::sqrt(v);
+      else if (type == "abs") r = std::fabs(v);
+      else if (type == "square") r = v * v;
+      else if (type == "floor") r = std::floor(v);
+      else if (type == "ceil") r = std::ceil(v);
+      else if (type == "round") r = std::nearbyint(v);
+      else if (type == "reciprocal") r = 1.f / v;
+      else if (type == "sign") r = v > 0 ? 1.f : (v < 0 ? -1.f : 0.f);
+      else if (type == "softplus")
+        r = v > 20.f ? v : std::log1p(std::exp(v));  // overflow guard
+      else if (type == "softsign") r = v / (1.f + std::fabs(v));
+      else if (type == "leaky_relu") r = v > 0 ? v : alpha * v;
+      else if (type == "relu6") r = std::min(std::max(v, 0.f), t);
+      else if (type == "hard_sigmoid")
+        r = std::min(std::max(v * slope + offset, 0.f), 1.f);
+      else if (type == "hard_swish")
+        r = v * std::min(std::max(v + 3.f, 0.f), 6.f) / 6.f;
+      else if (type == "swish")
+        r = v / (1.f + std::exp(-beta * v));
+      else if (type == "elu")
+        r = v > 0 ? v : alpha * (std::exp(v) - 1.f);
+      else  // clip
+        r = std::min(std::max(v, cmin), cmax);
+      out.data[i] = r;
+    }
+  } else if (type == "reduce_sum" || type == "reduce_mean" ||
+             type == "reduce_max" || type == "reduce_min") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<int64_t> dims = AttrInts(op, "dim");
+    bool keep = AttrBool(op, "keep_dim", false);
+    bool all = AttrBool(op, "reduce_all", false) || dims.empty();
+    int64_t nd = static_cast<int64_t>(x.shape.size());
+    std::vector<bool> red(nd, all);
+    for (int64_t d : dims) red[(d + nd) % nd] = true;
+    std::vector<int64_t> oshape;
+    for (int64_t d = 0; d < nd; ++d) {
+      if (!red[d]) oshape.push_back(x.shape[d]);
+      else if (keep) oshape.push_back(1);
+    }
+    if (oshape.empty()) oshape.push_back(1);
+    out.Resize(oshape);
+    bool mx = type == "reduce_max", mn = type == "reduce_min";
+    if (mx) std::fill(out.data.begin(), out.data.end(),
+                      -std::numeric_limits<float>::infinity());
+    if (mn) std::fill(out.data.begin(), out.data.end(),
+                      std::numeric_limits<float>::infinity());
+    int64_t red_n = 1;
+    for (int64_t d = 0; d < nd; ++d) if (red[d]) red_n *= x.shape[d];
+    std::vector<int64_t> stridex(nd, 1);
+    for (int64_t d = nd - 2; d >= 0; --d)
+      stridex[d] = stridex[d + 1] * x.shape[d + 1];
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      int64_t oi = 0, rem = i;
+      for (int64_t d = 0; d < nd; ++d) {
+        int64_t c = rem / stridex[d];
+        rem %= stridex[d];
+        if (!red[d]) oi = oi * x.shape[d] + c;
+      }
+      float v = x.data[i];
+      if (mx) out.data[oi] = std::max(out.data[oi], v);
+      else if (mn) out.data[oi] = std::min(out.data[oi], v);
+      else out.data[oi] += v;
+    }
+    if (type == "reduce_mean")
+      for (auto& v : out.data) v /= static_cast<float>(red_n);
+  } else if (type == "fill_constant") {
+    Tensor& out = Var(scope, Out(op, "Out"));
+    std::vector<int64_t> shape = AttrInts(op, "shape");
+    if (shape.empty()) shape.push_back(1);
+    out.Resize(shape);
+    float v = static_cast<float>(AttrNum(op, "value", 0.0));
+    std::fill(out.data.begin(), out.data.end(), v);
+  } else if (type == "dropout") {
+    // inference mode only (is_test artifacts): identity under
+    // upscale_in_train, (1-p) scaling under downgrade_in_infer
+    const Tensor& x = Var(scope, In(op, "X"));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out = x;
+    if (AttrStr(op, "dropout_implementation", "downgrade_in_infer") ==
+        "downgrade_in_infer") {
+      float keep = 1.f - static_cast<float>(
+          AttrNum(op, "dropout_prob", 0.5));
+      for (auto& v : out.data) v *= keep;
+    }
   } else if (type == "top_k" || type == "top_k_v2") {
     // ref operators/top_k_op.cc (last axis); ties keep lower index like
     // jax.lax.top_k (stable sort)

@@ -58,8 +58,12 @@ class Node:
         self.inputs: List[Node] = []
         self.outputs: List[Node] = []
 
-    def is_op(self, type: Optional[str] = None) -> bool:
-        return self.kind == "op" and (type is None or self.name == type)
+    def is_op(self, type=None) -> bool:
+        if self.kind != "op" or type is None:
+            return self.kind == "op"
+        if isinstance(type, (tuple, list, set, frozenset)):
+            return self.name in type
+        return self.name == type
 
     def is_var(self) -> bool:
         return self.kind == "var"
@@ -758,6 +762,7 @@ class ConvBNTrainFusePass(Pass):
     (ops/conv_bn_ops.py; measured deltas in RN50_ABLATION.md)."""
 
     def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
         count = 0
         for bn in list(graph.ops_of_type("batch_norm")):
             if bn not in graph.op_nodes:
@@ -772,8 +777,8 @@ class ConvBNTrainFusePass(Pass):
             if x_in is None or not x_in.inputs or \
                     not x_in.inputs[0].is_op("conv2d"):
                 continue
-            if len(x_in.outputs) != 1:       # conv output must feed BN only
-                continue
+            if len(x_in.outputs) != 1 or x_in.name in protected:
+                continue                     # conv output must feed BN only
             conv = x_in.inputs[0]
             ca = conv.op.attrs
             strides = ca.get("strides", [1, 1])
@@ -798,9 +803,12 @@ class ConvBNTrainFusePass(Pass):
                            if v.name in bn.op.output("Y")), None)
             if y_node is None:
                 continue
-            # fold a following exclusive relu into the act attr
+            # fold a following exclusive relu into the act attr (never
+            # when the BN output itself is fetched/protected)
             act, doomed_act = "", []
-            if len(y_node.outputs) == 1 and y_node.outputs[0].is_op("relu"):
+            if len(y_node.outputs) == 1 and \
+                    y_node.outputs[0].is_op("relu") and \
+                    y_node.name not in protected:
                 relu = y_node.outputs[0]
                 act = "relu"
                 out_node = relu.outputs[0]
@@ -832,6 +840,253 @@ class ConvBNTrainFusePass(Pass):
             graph.safe_remove_nodes([conv, x_in, bn] + doomed_act)
             count += 1
         graph.attrs["conv_bn_train_fuse_count"] = count
+        return graph
+
+
+@register_pass("repeated_fc_relu_fuse_pass")
+class RepeatedFCReluFusePass(Pass):
+    """Chains of fc(act=relu) → one ``fusion_repeated_fc_relu``
+    (ref ir/fc_gru_fuse... family; fused op:
+    fused/fusion_repeated_fc_relu_op.cc).  Runs after fc_fuse_pass, which
+    produces the canonical fc nodes this pass chains."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        consumed = set()
+        for fc in list(graph.ops_of_type("fc")):
+            if fc not in graph.op_nodes or fc in consumed:
+                continue
+            if fc.op.attrs.get("activation_type") != "relu":
+                continue
+            # only chain HEADS: input not itself produced by a relu-fc
+            x_node = next((v for v in fc.inputs
+                           if v.name == fc.op.input("Input")[0]), None)
+            if x_node is None:
+                continue
+            if x_node.inputs and x_node.inputs[0].is_op("fc") and \
+                    x_node.inputs[0].op.attrs.get("activation_type") == \
+                    "relu":
+                continue
+            chain = [fc]
+            while True:
+                out = chain[-1].outputs[0]
+                if len(out.outputs) != 1 or out.name in protected:
+                    break
+                nxt = out.outputs[0]
+                if not nxt.is_op("fc") or \
+                        nxt.op.attrs.get("activation_type") != "relu" or \
+                        nxt.op.input("Input")[0] != out.name:
+                    break
+                chain.append(nxt)
+            if len(chain) < 2:
+                continue
+            ws, bs, doomed = [], [], []
+            ok = True
+            for i, node in enumerate(chain):
+                by_name = {v.name: v for v in node.inputs}
+                w = by_name.get(node.op.input("W")[0])
+                b = by_name.get(node.op.input("Bias")[0]) \
+                    if node.op.input("Bias") else None
+                if w is None or b is None:
+                    ok = False
+                    break
+                ws.append(w)
+                bs.append(b)
+                doomed.append(node)
+                if i < len(chain) - 1:
+                    doomed.append(node.outputs[0])
+            if not ok:
+                continue
+            out_node = chain[-1].outputs[0]
+            graph.create_op_node(
+                "fusion_repeated_fc_relu",
+                inputs={"X": [x_node], "W": ws, "Bias": bs},
+                outputs={"Out": [out_node]}, attrs={})
+            graph.safe_remove_nodes(doomed)
+            consumed.update(chain)
+            count += 1
+        graph.attrs["repeated_fc_relu_fuse_count"] = count
+        return graph
+
+
+@register_pass("squared_mat_sub_fuse_pass")
+class SquaredMatSubFusePass(Pass):
+    """square(X·Y) − square(X)·square(Y) [→ scale] → one
+    ``fusion_squared_mat_sub`` (ref ir/squared_mat_sub_fuse_pass.cc —
+    the MatchMatrix/pyramid-DNN serving pattern)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for sub in list(graph.ops_of_type("elementwise_sub")):
+            if sub not in graph.op_nodes:
+                continue
+            by_name = {v.name: v for v in sub.inputs}
+            lhs = by_name.get(sub.op.input("X")[0])
+            rhs = by_name.get(sub.op.input("Y")[0])
+            if lhs is None or rhs is None or not lhs.inputs or \
+                    not rhs.inputs:
+                continue
+            sq_xy, mm2 = lhs.inputs[0], rhs.inputs[0]
+            if not sq_xy.is_op("square") or not mm2.is_op("matmul"):
+                continue
+            mm1_out = sq_xy.inputs[0]
+            if not mm1_out.inputs or not mm1_out.inputs[0].is_op("matmul"):
+                continue
+            mm1 = mm1_out.inputs[0]
+            a1, a2 = mm1.op.attrs, mm2.op.attrs
+            if any(a.get("transpose_X") or a.get("transpose_Y") or
+                   a.get("alpha", 1.0) != 1.0 for a in (a1, a2)):
+                continue
+            # mm2's operands must be square(x), square(y) of mm1's operands
+            m1n = {v.name: v for v in mm1.inputs}
+            x_node = m1n.get(mm1.op.input("X")[0])
+            y_node = m1n.get(mm1.op.input("Y")[0])
+            m2n = {v.name: v for v in mm2.inputs}
+            sqx_v = m2n.get(mm2.op.input("X")[0])
+            sqy_v = m2n.get(mm2.op.input("Y")[0])
+            if None in (x_node, y_node, sqx_v, sqy_v):
+                continue
+            if not sqx_v.inputs or not sqx_v.inputs[0].is_op("square") or \
+                    not sqy_v.inputs or not sqy_v.inputs[0].is_op("square"):
+                continue
+            sqx_op, sqy_op = sqx_v.inputs[0], sqy_v.inputs[0]
+            if sqx_op.inputs[0] is not x_node or \
+                    sqy_op.inputs[0] is not y_node:
+                continue
+            inter = [mm1_out, lhs, rhs, sqx_v, sqy_v]
+            if any(len(v.outputs) != 1 or v.name in protected
+                   for v in inter):
+                continue
+            out_node = sub.outputs[0]
+            scalar = 1.0
+            doomed_scale = []
+            if len(out_node.outputs) == 1 and out_node.name not in \
+                    protected and out_node.outputs[0].is_op("scale"):
+                sc = out_node.outputs[0]
+                if sc.op.attrs.get("bias", 0.0) == 0.0:
+                    scalar = float(sc.op.attrs.get("scale", 1.0))
+                    doomed_scale = [sc, out_node]
+                    out_node = sc.outputs[0]
+            graph.create_op_node(
+                "fusion_squared_mat_sub",
+                inputs={"X": [x_node], "Y": [y_node]},
+                outputs={"Out": [out_node]}, attrs={"scalar": scalar})
+            graph.safe_remove_nodes(
+                [mm1, mm1_out, sq_xy, lhs, sqx_op, sqx_v, sqy_op, sqy_v,
+                 mm2, rhs, sub] + doomed_scale)
+            count += 1
+        graph.attrs["squared_mat_sub_fuse_count"] = count
+        return graph
+
+
+@register_pass("transpose_flatten_concat_fuse_pass")
+class TransposeFlattenConcatFusePass(Pass):
+    """N × (transpose2 → flatten2) → concat ⇒ one
+    ``fusion_transpose_flatten_concat``
+    (ref ir/transpose_flatten_concat_fuse_pass.cc — the detection-head
+    serving pattern)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for cc in list(graph.ops_of_type("concat")):
+            if cc not in graph.op_nodes:
+                continue
+            srcs, doomed, perms = [], [cc], []
+            ok = True
+            for v in cc.inputs:
+                if v.name in protected or len(v.outputs) != 1 or \
+                        not v.inputs or not v.inputs[0].is_op(
+                            ("flatten2", "flatten")):
+                    ok = False
+                    break
+                fl = v.inputs[0]
+                if fl.op.attrs.get("axis", 1) != 1:
+                    ok = False
+                    break
+                fv = next((u for u in fl.inputs
+                           if u.name == fl.op.input("X")[0]), None)
+                if fv is None or len(fv.outputs) != 1 or \
+                        fv.name in protected or not fv.inputs or \
+                        not fv.inputs[0].is_op(("transpose2", "transpose")):
+                    ok = False
+                    break
+                tr = fv.inputs[0]
+                perms.append(tuple(tr.op.attrs.get("axis", [])))
+                src = next((u for u in tr.inputs
+                            if u.name == tr.op.input("X")[0]), None)
+                # transpose2/flatten2 emit XShape side outputs: doom the
+                # unconsumed ones with their producers (no orphans)
+                extra = [o for node in (tr, fl) for o in node.outputs
+                         if o is not fv and o is not v]
+                if src is None or any(
+                        o.outputs or o.name in protected for o in extra):
+                    ok = False
+                    break
+                srcs.append(src)
+                doomed += [fl, v, tr, fv] + extra
+            if not ok or len(srcs) < 2 or len(set(perms)) != 1:
+                continue
+            out_node = cc.outputs[0]
+            graph.create_op_node(
+                "fusion_transpose_flatten_concat",
+                inputs={"X": srcs}, outputs={"Out": [out_node]},
+                attrs={"trans_axis": list(perms[0]),
+                       "concat_axis": cc.op.attrs.get("axis", 1)})
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs["transpose_flatten_concat_fuse_count"] = count
+        return graph
+
+
+@register_pass("seqpool_concat_fuse_pass")
+class SeqpoolConcatFusePass(Pass):
+    """N × sequence_pool → concat ⇒ one ``fusion_seqpool_concat``
+    (ref ir/seqpool_concat_fuse_pass.cc — the CTR/recall serving
+    pattern)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for cc in list(graph.ops_of_type("concat")):
+            if cc not in graph.op_nodes:
+                continue
+            if cc.op.attrs.get("axis", 1) not in (1, -1):
+                continue
+            srcs, doomed, ptypes = [], [cc], set()
+            ok = True
+            for v in cc.inputs:
+                if v.name in protected or len(v.outputs) != 1 or \
+                        not v.inputs or \
+                        not v.inputs[0].is_op("sequence_pool"):
+                    ok = False
+                    break
+                sp = v.inputs[0]
+                if sp.op.input("SeqLen"):
+                    ok = False     # per-branch lengths stay unfused
+                    break
+                ptypes.add(sp.op.attrs.get("pooltype", "AVERAGE").upper())
+                src = next((u for u in sp.inputs
+                            if u.name == sp.op.input("X")[0]), None)
+                extra = [o for o in sp.outputs if o is not v]
+                if src is None or any(
+                        o.outputs or o.name in protected for o in extra):
+                    ok = False   # MaxIndex consumed/fetched: stay unfused
+                    break
+                srcs.append(src)
+                doomed += [sp, v] + extra
+            if not ok or len(srcs) < 2 or len(ptypes) != 1:
+                continue
+            out_node = cc.outputs[0]
+            graph.create_op_node(
+                "fusion_seqpool_concat",
+                inputs={"X": srcs}, outputs={"Out": [out_node]},
+                attrs={"pooltype": next(iter(ptypes))})
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs["seqpool_concat_fuse_count"] = count
         return graph
 
 

@@ -123,6 +123,13 @@ class AnalysisPredictor:
             g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
             g = ir.get_pass("fuse_elewise_add_act_pass",
                             protected=keep).apply(g)
+            # serving-path canonicalizations (ref ir_pass_manager's ~25
+            # CPU passes — the families with a TPU-meaningful analog)
+            for name in ("repeated_fc_relu_fuse_pass",
+                         "squared_mat_sub_fuse_pass",
+                         "transpose_flatten_concat_fuse_pass",
+                         "seqpool_concat_fuse_pass"):
+                g = ir.get_pass(name, protected=keep).apply(g)
             # long-seq artifacts built with dense attention get the
             # Pallas flash kernel at load time (crossover ≥1024); the
             # scope lets the pass recognize frozen causal masks and turn

@@ -352,3 +352,43 @@ def test_cpp_predictor_topk_argsort(tmp_path):
     got = np.load(out_npy)
     np.testing.assert_allclose(got, np.asarray(expected),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_cpp_predictor_wide_op_families(tmp_path):
+    """The round-4 op-family widening (activations, elementwise max/min/
+    pow, axis reductions, inference dropout) served natively with parity."""
+    model_dir = str(tmp_path / "wide_model")
+    rng = np.random.RandomState(17)
+    xv = (rng.rand(4, 6).astype(np.float32) + 0.5)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[6], dtype="float32")
+        h = layers.leaky_relu(layers.fc(x, size=8), alpha=0.1)
+        h = layers.clip(h, min=-1.0, max=2.5)
+        h = layers.elementwise_max(h, layers.scale(h, scale=0.3))
+        h = layers.swish(h) + layers.relu6(h)
+        h = layers.dropout(h, dropout_prob=0.3, is_test=True)
+        h = layers.sqrt(layers.abs(h) + 1.0) * layers.exp(
+            layers.scale(h, scale=0.01))
+        red = layers.reduce_mean(h, dim=[1], keep_dim=True)
+        out = layers.concat([layers.reduce_sum(h, dim=[1], keep_dim=True),
+                             red, layers.reduce_max(h, dim=[1],
+                                                    keep_dim=True)], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=9)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv}, fetch_list=[out.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [out],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "x.npy"), xv)
+    out_npy = str(tmp_path / "out.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "x.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    np.testing.assert_allclose(np.load(out_npy), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)

@@ -460,3 +460,116 @@ def test_conv_bn_train_fuse_pass_parity():
             return out
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-3, atol=2e-4)
+
+
+def test_serving_fusion_passes():
+    """The four serving-path canonicalization passes (ref
+    ir/*_fuse_pass.cc families): pattern counts + numeric parity."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Executor, Program, program_guard, ir
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    rng = np.random.RandomState(2)
+
+    # -- repeated fc+relu chain ------------------------------------------
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = x
+        for i in range(3):
+            h = layers.fc(h, size=8, act="relu")
+        marker = layers.scale(h, scale=1.0)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=1)
+        feed = {"x": rng.rand(4, 8).astype(np.float32)}
+        want, = exe.run(feed=feed, fetch_list=[marker.name], scope=scope)
+        g = ir.Graph(pt.default_main_program().clone())
+        g = ir.get_pass("fc_fuse_pass").apply(g)
+        assert g.attrs["fc_fuse_count"] == 3
+        g = ir.get_pass("repeated_fc_relu_fuse_pass").apply(g)
+        assert g.attrs["repeated_fc_relu_fuse_count"] == 1
+        fused = g.to_program()
+        types = [o.type for o in fused.global_block().ops]
+        assert types.count("fusion_repeated_fc_relu") == 1
+        assert "fc" not in types
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=scope)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    # -- squared mat sub -------------------------------------------------
+    with scope_guard(Scope()), program_guard(Program(), Program()):
+        a = layers.data("a", shape=[4], dtype="float32")
+        b = layers.data("b", shape=[4, 6], dtype="float32",
+                        append_batch_size=False)
+        xy = layers.matmul(a, b)
+        out = layers.scale(
+            layers.square(xy) - layers.matmul(layers.square(a),
+                                              layers.square(b)),
+            scale=0.5)
+        marker = layers.scale(out, scale=1.0)
+        exe = Executor()
+        feed = {"a": rng.rand(3, 4).astype(np.float32),
+                "b": rng.rand(4, 6).astype(np.float32)}
+        want, = exe.run(feed=feed, fetch_list=[marker.name],
+                        scope=pt.global_scope())
+        g = ir.Graph(pt.default_main_program().clone())
+        g = ir.get_pass("squared_mat_sub_fuse_pass").apply(g)
+        assert g.attrs["squared_mat_sub_fuse_count"] == 1
+        fused = g.to_program()
+        assert "fusion_squared_mat_sub" in \
+            [o.type for o in fused.global_block().ops]
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=pt.global_scope())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    # -- transpose + flatten + concat ------------------------------------
+    with scope_guard(Scope()), program_guard(Program(), Program()):
+        u = layers.data("u", shape=[2, 3, 4], dtype="float32")
+        v = layers.data("v", shape=[2, 5, 4], dtype="float32")
+        flat = [layers.flatten(layers.transpose(t, perm=[0, 2, 3, 1]))
+                for t in (u, v)]
+        out = layers.concat(flat, axis=1)
+        marker = layers.scale(out, scale=1.0)
+        exe = Executor()
+        feed = {"u": rng.rand(2, 2, 3, 4).astype(np.float32),
+                "v": rng.rand(2, 2, 5, 4).astype(np.float32)}
+        want, = exe.run(feed=feed, fetch_list=[marker.name],
+                        scope=pt.global_scope())
+        g = ir.Graph(pt.default_main_program().clone())
+        g = ir.get_pass("transpose_flatten_concat_fuse_pass").apply(g)
+        assert g.attrs["transpose_flatten_concat_fuse_count"] == 1
+        fused = g.to_program()
+        assert "fusion_transpose_flatten_concat" in \
+            [o.type for o in fused.global_block().ops]
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=pt.global_scope())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    # -- seqpool + concat -------------------------------------------------
+    with scope_guard(Scope()), program_guard(Program(), Program()):
+        u = layers.data("u", shape=[5, 3], dtype="float32")
+        v = layers.data("v", shape=[7, 3], dtype="float32")
+        pooled = [layers.sequence_pool(t, pool_type="sum")
+                  for t in (u, v)]
+        out = layers.concat(pooled, axis=1)
+        marker = layers.scale(out, scale=1.0)
+        exe = Executor()
+        feed = {"u": rng.rand(2, 5, 3).astype(np.float32),
+                "v": rng.rand(2, 7, 3).astype(np.float32)}
+        want, = exe.run(feed=feed, fetch_list=[marker.name],
+                        scope=pt.global_scope())
+        g = ir.Graph(pt.default_main_program().clone())
+        g = ir.get_pass("seqpool_concat_fuse_pass").apply(g)
+        assert g.attrs["seqpool_concat_fuse_count"] == 1
+        fused = g.to_program()
+        assert "fusion_seqpool_concat" in \
+            [o.type for o in fused.global_block().ops]
+        got, = exe.run(fused, feed=feed, fetch_list=[marker.name],
+                       scope=pt.global_scope())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
