@@ -245,10 +245,10 @@ def bench_bert_masked(dev, on_tpu, peak):
 
 def bench_bert_long(dev, on_tpu, peak):
     """Long-context line: BERT-base at seq 4096 where the Pallas flash
-    kernel is the measured winner over XLA's O(T²) attention (v5e r2:
-    flash 325 ms vs base 409 ms per step; beyond ~8k tokens the base
-    path OOMs outright and flash is the only option — 23 ms f+b at
-    [1,16,16384,128] attention-only)."""
+    kernel is the measured winner over XLA's O(T²) attention (v5e r4:
+    flash 298 ms vs base 407 ms per step; beyond ~8k tokens the base
+    path OOMs outright and flash is the only option — 11 ms fwd /
+    45 ms f+b at [12,16384,64] attention-only, LONGCTX_ABLATION.md)."""
     if not on_tpu:
         return                             # pallas path is TPU-only
     import jax

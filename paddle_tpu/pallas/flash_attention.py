@@ -746,9 +746,13 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     ``bias`` broadcasts over (batch, heads): accepted shapes are
     [b, h, Tq, Tk], [1, 1, Tq, Tk] or [Tq, Tk].
 
-    Default blocks are (512, 1024) capped at the sequence lengths —
-    measured on v5e: 7.6× faster than 128×128 at T=16k (23–25 ms f+b at
-    [1,16,16384,128]), and ahead of XLA's O(T²) attention from T≈1024.
+    Default blocks are per-sequence-length tables (below) at d≤64, else
+    (512, 1024) capped at the sequence lengths — measured on v5e: ahead
+    of XLA's O(T²) attention from T≈1024, and the only runnable path
+    beyond ~8k.  (An r2 "23 ms f+b at 16k" figure was timed with the
+    no-op block_until_ready through the tunnel and is void; real r4
+    numbers: 11.0 ms fwd / 45.1 ms f+b at [12,16384,64] —
+    LONGCTX_ABLATION.md.)
     The backward kernels take their own ``block_q_bwd``/``block_k_bwd``
     (default: same as forward) — swept separately in LONGCTX_ABLATION.md.
     ``bwd_impl``: "combined" (single-recompute, dk/dv partial sums;
