@@ -754,7 +754,8 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     numbers: 11.0 ms fwd / 45.1 ms f+b at [12,16384,64] —
     LONGCTX_ABLATION.md.)
     The backward kernels take their own ``block_q_bwd``/``block_k_bwd``
-    (default: same as forward) — swept separately in LONGCTX_ABLATION.md.
+    (default: the ``_BWD_DEFAULTS`` table at d≤64 for 4k/8k/16k, else the
+    forward blocks) — swept separately in LONGCTX_ABLATION.md.
     ``bwd_impl``: "combined" (single-recompute, dk/dv partial sums;
     auto-falls back to split when the partials would exceed
     ``_COMBINED_PARTIAL_BUDGET`` HBM) or "split" (two-pass);

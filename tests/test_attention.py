@@ -235,3 +235,33 @@ def test_flash_pallas_bwd_kernels_interpret(impl, causal):
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bwd_partial_budget_fallback(monkeypatch):
+    """Past _COMBINED_PARTIAL_BUDGET the combined backward must fall back
+    to the split kernels (its dk/dv partials are quadratic in T); an
+    explicit impl override always wins."""
+    import importlib
+    FA = importlib.import_module("paddle_tpu.pallas.flash_attention")
+    calls = []
+    orig_comb = FA._flash_bwd_pallas_combined
+    orig_split = FA._flash_bwd_pallas_split
+    monkeypatch.setattr(
+        FA, "_flash_bwd_pallas_combined",
+        lambda *a, **k: calls.append("combined") or orig_comb(*a, **k))
+    monkeypatch.setattr(
+        FA, "_flash_bwd_pallas_split",
+        lambda *a, **k: calls.append("split") or orig_split(*a, **k))
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(2, 32, 8).astype(np.float32) * 0.3)
+    o = jnp.asarray(r.randn(2, 32, 8).astype(np.float32) * 0.3)
+    lse = jnp.asarray(r.randn(2, 32).astype(np.float32))
+    do = jnp.asarray(r.randn(2, 32, 8).astype(np.float32) * 0.3)
+    FA._flash_bwd_pallas(q, q, q, o, lse, do, False, 1.0, 8, 8, 0, True)
+    assert calls[-1] == "combined"
+    monkeypatch.setattr(FA, "_COMBINED_PARTIAL_BUDGET", 0)
+    FA._flash_bwd_pallas(q, q, q, o, lse, do, False, 1.0, 8, 8, 0, True)
+    assert calls[-1] == "split"
+    FA._flash_bwd_pallas(q, q, q, o, lse, do, False, 1.0, 8, 8, 0, True,
+                         impl="split")
+    assert calls[-1] == "split"
