@@ -764,6 +764,33 @@ class GeoCommunicator:
             cur = np.asarray(self.scope.find_var(pname), np.float32)
             delta = (cur - self._snapshots[pname]) / self.t.trainer_num
             cli = get_client(ep)
+            if spec.get("rows") and cur.ndim == 2:
+                # SPARSE geo (the reference's geo_sgd_mode proper,
+                # geo_sgd_communicator.cc): only rows this trainer touched
+                # since the last sync have nonzero deltas — push those
+                # rows and pull back just their merged values.  Untouched
+                # rows keep the local copy (and their snapshot), so their
+                # delta keeps accumulating; rows other trainers changed
+                # converge when this trainer next touches them — the
+                # documented geo approximation.  At CTR sparsity this is
+                # ~batch·slots rows instead of the whole table.  A HOT
+                # interval (≥ half the rows touched) falls through to the
+                # dense path: row ids + per-row applies + row pulls cost
+                # more than one dense round trip.
+                changed = np.flatnonzero(np.abs(delta).max(axis=1) > 0)
+                if changed.size == 0:
+                    continue
+                if changed.size * 2 < cur.shape[0]:
+                    cli.push_sparse(pname, changed,
+                                    (-delta[changed]).astype(np.float32))
+                    fresh = np.asarray(
+                        cli.get_rows(pname, changed, width=cur.shape[1]),
+                        np.float32)
+                    cur = cur.copy()
+                    cur[changed] = fresh
+                    self.scope.set_var(pname, cur)
+                    self._snapshots[pname][changed] = fresh
+                    continue
             cli.push_dense(pname, -delta.ravel())   # server lr=1 → +=delta
             fresh = cli.get(pname, spec["size"], barrier=False)
             fresh = fresh.reshape(spec["shape"]).astype(np.float32)

@@ -622,3 +622,78 @@ def test_client_retry_recovers_across_server_restart(monkeypatch):
         cli.close()
         revive.server.stop()
         revive.server.destroy()
+
+
+def test_geo_sgd_sparse_row_pushes():
+    """Geo-SGD with an is_sparse embedding pushes only the TOUCHED rows
+    (ref geo_sgd_communicator.cc sparse path) — untouched server rows
+    keep their seeded values, touched ones match the trainer."""
+    from paddle_tpu.framework import core
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.core import program_guard
+    with scope_guard(Scope()), program_guard(core.Program(), core.Program()):
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[8, 4], is_sparse=True,
+                               param_attr=pt.ParamAttr(name="geo_emb"))
+        pred = layers.fc(layers.reduce_sum(emb, dim=[1]), size=1,
+                         bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(
+            pred, layers.fill_constant([1, 1], "float32", 1.0)))
+        opt.SGD(learning_rate=0.5).minimize(loss)
+
+        port = _free_port()
+        cfg = DistributeTranspilerConfig(geo_sgd_mode=True,
+                                         geo_sgd_need_push_nums=2,
+                                         sync_mode=False)
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+        assert t._param_specs["geo_emb"]["rows"] == 8
+        pserver_prog, pserver_startup = t.get_pserver_programs(
+            f"127.0.0.1:{port}")
+        trainer_prog = t.get_trainer_program()
+
+        exe = Executor()
+        exe.run(pserver_startup)
+        srv = threading.Thread(target=exe.run, args=(pserver_prog,),
+                               daemon=True)
+        srv.start()
+        time.sleep(0.2)
+        exe.run(pt.default_startup_program())
+        geo = GeoCommunicator(t)
+        geo.init_snapshots()
+        init_table = np.asarray(
+            pt.global_scope().find_var("geo_emb"), np.float32).copy()
+
+        feed_ids = np.array([[1], [3], [1], [6]], np.int64)
+        for _ in range(4):                    # 2 sync intervals
+            exe.run(trainer_prog, feed={"ids": feed_ids},
+                    fetch_list=[loss])
+            geo.step()
+
+        local = np.asarray(pt.global_scope().find_var("geo_emb"),
+                           np.float32)
+        srv_rows = ps_mod.get_client(f"127.0.0.1:{port}").get_rows(
+            "geo_emb", list(range(8)), width=4)
+        touched = [1, 3, 6]
+        untouched = [0, 2, 4, 5, 7]
+        np.testing.assert_allclose(np.asarray(srv_rows)[touched],
+                                   local[touched], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(srv_rows)[untouched],
+                                   init_table[untouched], rtol=1e-6)
+        # training moved the touched rows
+        assert np.abs(local[touched] - init_table[touched]).max() > 1e-4
+
+        # HOT interval (>= half the rows touched) takes the dense
+        # fallback: now every server row must match the trainer exactly
+        hot_ids = np.arange(8).reshape(8, 1).astype(np.int64)
+        for _ in range(2):                     # one more sync interval
+            exe.run(trainer_prog, feed={"ids": hot_ids},
+                    fetch_list=[loss])
+            geo.step()
+        local = np.asarray(pt.global_scope().find_var("geo_emb"),
+                           np.float32)
+        srv_rows = ps_mod.get_client(f"127.0.0.1:{port}").get_rows(
+            "geo_emb", list(range(8)), width=4)
+        np.testing.assert_allclose(np.asarray(srv_rows), local, rtol=1e-5)
+        ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+        srv.join(timeout=5)
