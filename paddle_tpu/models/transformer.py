@@ -73,6 +73,16 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
                                alpha=float(d_head) ** -0.5)
         if attn_bias is not None:
             scores = scores + attn_bias
+        if causal:
+            # [T,T] additive mask built from ops (no tril op in the
+            # registry): -1e9 where j > i, broadcast over [b,h,T,T]
+            t = q.shape[2]
+            r = layers.range(0, t, 1, "float32")
+            row = layers.expand(layers.unsqueeze(r, [1]), [1, t])
+            col = layers.expand(layers.unsqueeze(r, [0]), [t, 1])
+            mask = layers.scale(layers.relu(layers.sign(col - row)),
+                                scale=-1e9)
+            scores = scores + mask
         weights = layers.softmax(scores)
         if dropout_rate:
             weights = layers.dropout(
@@ -100,12 +110,13 @@ def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0, is_test=False,
 
 
 def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
-                  attn_bias=None, is_test=False, idx=0, attn_impl="base"):
+                  attn_bias=None, is_test=False, idx=0, attn_impl="base",
+                  causal=False):
     """post-LN residual block (ref dist_transformer encoder_layer)."""
     attn = multi_head_attention(x, x, x, d_model, n_head, dropout_rate,
                                 attn_bias, is_test,
                                 param_prefix=f"enc_{idx}.attn",
-                                attn_impl=attn_impl)
+                                attn_impl=attn_impl, causal=causal)
     if dropout_rate:
         attn = layers.dropout(attn, dropout_prob=dropout_rate,
                               is_test=is_test,
@@ -126,7 +137,7 @@ def encoder_layer(x, d_model, d_inner, n_head, dropout_rate=0.0,
 def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
             n_head, dropout_rate=0.0, attn_bias=None, is_test=False,
             type_ids=None, n_types=2, attn_impl="base", checkpoints=None,
-            arange_pos=False):
+            arange_pos=False, causal=False):
     """BERT-style embedding + N encoder layers.  Pass ``checkpoints=[]`` to
     collect each layer's output for RecomputeOptimizer (remat at layer
     boundaries — the standard transformer memory/compute trade).
@@ -159,7 +170,8 @@ def encoder(src_ids, pos_ids, vocab_size, max_pos, n_layer, d_model, d_inner,
                            dropout_implementation="upscale_in_train")
     for i in range(n_layer):
         x = encoder_layer(x, d_model, d_inner, n_head, dropout_rate,
-                          attn_bias, is_test, idx=i, attn_impl=attn_impl)
+                          attn_bias, is_test, idx=i, attn_impl=attn_impl,
+                          causal=causal)
         if checkpoints is not None:
             checkpoints.append(x)
     return x
@@ -183,6 +195,27 @@ class BertConfig:
                          self.d_inner, self.max_pos)
         per_layer = 4 * D * D + 4 * D + 2 * D * F + F + D + 4 * D
         return V * D + P * D + 2 * D + L * per_layer
+
+
+def _lm_head_loss(enc, cfg, lm_label, fused_head, param_name):
+    """Shared LM head + masked-mean CE (label 0 = [PAD] excluded) used by
+    both the MLM and causal-LM builders."""
+    if fused_head:
+        loss = layers.fused_lm_head_ce(
+            enc, cfg.vocab_size, lm_label,
+            param_attr=ParamAttr(name=f"{param_name}.w"),
+            bias_attr=ParamAttr(name=f"{param_name}.b"), ignore_index=0)
+        logits = None
+    else:
+        logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
+                           param_attr=ParamAttr(name=f"{param_name}.w"),
+                           bias_attr=ParamAttr(name=f"{param_name}.b"))
+        loss = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lm_label, [2]), ignore_index=0)
+    mask = layers.cast(lm_label > 0, "float32")
+    masked = layers.reduce_sum(loss * layers.unsqueeze(mask, [2]))
+    denom = layers.reduce_sum(mask) + 1e-6
+    return logits, masked / denom
 
 
 def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
@@ -225,28 +258,38 @@ def build_bert_pretrain(cfg: BertConfig, seq_len, is_test=False,
         enc = layers.reshape(
             layers.gather(flat, layers.reshape(mask_pos, shape=[-1])),
             shape=[-1, label_len, cfg.d_model])
-    if fused_head:
-        loss = layers.fused_lm_head_ce(
-            enc, cfg.vocab_size, lm_label,
-            param_attr=ParamAttr(name="mlm_out.w"),
-            bias_attr=ParamAttr(name="mlm_out.b"), ignore_index=0)
-        logits = None
-    else:
-        logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
-                           param_attr=ParamAttr(name="mlm_out.w"),
-                           bias_attr=ParamAttr(name="mlm_out.b"))
-        # masked positions only: label 0 ([PAD]) is ignored
-        loss = layers.softmax_with_cross_entropy(
-            logits, layers.unsqueeze(lm_label, [2]), ignore_index=0)
-    mask = layers.cast(lm_label > 0, "float32")
-    masked = layers.reduce_sum(loss * layers.unsqueeze(mask, [2]))
-    denom = layers.reduce_sum(mask) + 1e-6
-    avg_loss = masked / denom
+    logits, avg_loss = _lm_head_loss(enc, cfg, lm_label, fused_head,
+                                     "mlm_out")
     feeds = [src_ids] if arange_pos else [src_ids, pos_ids]
     if mask_pos is not None:
         feeds.append(mask_pos)
     feeds.append(lm_label)
     return tuple(feeds), logits, avg_loss
+
+
+def build_gpt_pretrain(cfg: BertConfig, seq_len, is_test=False,
+                       dropout=None, attn_impl="auto", fused_head=True,
+                       checkpoints=None):
+    """Decoder-only causal LM (GPT recipe): ids → causal transformer →
+    next-token CE.  No reference counterpart (the 2019 snapshot has no
+    decoder-only family) — TPU-native addition exercising the causal
+    flash path at train time (attn_impl="auto" picks the Pallas kernel
+    from T≥1024, where causal=True skips the masked key blocks outright,
+    ~2× over a masked dense chain).
+
+    ``lm_label`` is the next-token target (the input pipeline shifts;
+    label 0 = [PAD] is excluded from loss, matching build_bert_pretrain's
+    convention)."""
+    dropout = cfg.dropout if dropout is None else dropout
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    lm_label = layers.data("lm_label", shape=[seq_len], dtype="int64")
+    enc = encoder(src_ids, None, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
+                  cfg.d_model, cfg.d_inner, cfg.n_head, dropout,
+                  is_test=is_test, attn_impl=attn_impl,
+                  checkpoints=checkpoints, arange_pos=True, causal=True)
+    logits, avg_loss = _lm_head_loss(enc, cfg, lm_label, fused_head,
+                                     "lm_out")
+    return (src_ids, lm_label), logits, avg_loss
 
 
 def annotate_tensor_parallel(program=None):

@@ -728,9 +728,10 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # LONGCTX_ABLATION.md).  Keys are max(Tq, Tk); anything else takes the
 # (512, 1024) baseline.  The bwd table feeds the combined single-recompute
 # kernel: big q-blocks keep its dk/dv partial-sum traffic low.
-_FWD_DEFAULTS = {4096: (1024, 1024), 8192: (1024, 1024),
-                 16384: (512, 2048)}
-_BWD_DEFAULTS = {4096: (1024, 512), 8192: (1024, 512), 16384: (1024, 512)}
+_FWD_DEFAULTS = {2048: (1024, 1024), 4096: (1024, 1024),
+                 8192: (1024, 1024), 16384: (512, 2048)}
+_BWD_DEFAULTS = {2048: (1024, 512), 4096: (1024, 512), 8192: (1024, 512),
+                 16384: (1024, 512)}
 
 
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
@@ -754,8 +755,8 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     numbers: 11.0 ms fwd / 45.1 ms f+b at [12,16384,64] —
     LONGCTX_ABLATION.md.)
     The backward kernels take their own ``block_q_bwd``/``block_k_bwd``
-    (default: the ``_BWD_DEFAULTS`` table at d≤64 for 4k/8k/16k, else the
-    forward blocks) — swept separately in LONGCTX_ABLATION.md.
+    (default: the ``_BWD_DEFAULTS`` table at d≤64 for 2k/4k/8k/16k, else
+    the forward blocks) — swept separately in LONGCTX_ABLATION.md.
     ``bwd_impl``: "combined" (single-recompute, dk/dv partial sums;
     auto-falls back to split when the partials would exceed
     ``_COMBINED_PARTIAL_BUDGET`` HBM) or "split" (two-pass);
