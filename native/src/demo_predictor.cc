@@ -21,7 +21,8 @@
 //   gelu/leaky_relu/relu6/hard_sigmoid/hard_swish/swish/elu/softplus/
 //   softsign + exp/log/sqrt/rsqrt/abs/square/floor/ceil/round/
 //   reciprocal/sign/clip), softmax, scale, reduce_sum/mean/max/min,
-//   dropout (inference), fill_constant, lookup_table, slice, concat,
+//   dropout (inference), fill_constant, range, expand, lookup_table,
+//   slice, concat,
 //   split, reshape2/flatten2/unsqueeze2/squeeze2, transpose2,
 //   top_k/argsort/arg_max/arg_min, gru/lstm, yolo_box,
 //   multiclass_nms, feed, fetch.  Payloads: f32 + exact int64 + bf16
@@ -783,6 +784,59 @@ static void RunOp(const Json& op, Scope* scope) {
     }
     if (type == "reduce_mean")
       for (auto& v : out.data) v /= static_cast<float>(red_n);
+  } else if (type == "range") {
+    // start/end/step as attrs or 1-element inputs (layers/tensor.py range)
+    auto val = [&](const char* slot, const char* attr, double dflt) {
+      std::string n = In(op, slot);
+      if (!n.empty()) return Var(scope, n).data[0];
+      return static_cast<float>(AttrNum(op, attr, dflt));
+    };
+    float start = val("Start", "start", 0.0);
+    float end = val("End", "end", 0.0);
+    float step = val("Step", "step", 1.0);
+    if (step == 0.f)
+      throw std::runtime_error("range: step must be nonzero");
+    // empty like jnp.arange when the direction doesn't reach end
+    int64_t n = std::max<int64_t>(
+        0, static_cast<int64_t>(std::ceil((end - start) / step)));
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({n});
+    for (int64_t i = 0; i < n; ++i) out.data[i] = start + i * step;
+    std::string dt = AttrStr(op, "dtype", "float32");
+    if (dt == "int64" || dt == "int32") {
+      out.dtype = "int64";
+      out.i64.resize(out.data.size());
+      for (size_t i = 0; i < out.data.size(); ++i)
+        out.i64[i] = static_cast<int64_t>(std::llround(out.data[i]));
+    }
+  } else if (type == "expand") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    std::vector<int64_t> times = AttrInts(op, "expand_times");
+    int64_t nd = static_cast<int64_t>(x.shape.size());
+    if (static_cast<int64_t>(times.size()) > nd)
+      throw std::runtime_error(
+          "demo_predictor expand: rank-promoting expand_times unsupported");
+    // jnp.tile alignment: a short reps list applies to the TRAILING dims
+    while (static_cast<int64_t>(times.size()) < nd)
+      times.insert(times.begin(), 1);
+    std::vector<int64_t> oshape(nd);
+    for (int64_t d = 0; d < nd; ++d) oshape[d] = x.shape[d] * times[d];
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize(oshape);
+    std::vector<int64_t> xstr(nd, 1), ostr(nd, 1);
+    for (int64_t d = nd - 2; d >= 0; --d) {
+      xstr[d] = xstr[d + 1] * x.shape[d + 1];
+      ostr[d] = ostr[d + 1] * oshape[d + 1];
+    }
+    for (int64_t i = 0; i < out.numel(); ++i) {
+      int64_t rem = i, xi = 0;
+      for (int64_t d = 0; d < nd; ++d) {
+        int64_t c = rem / ostr[d];
+        rem %= ostr[d];
+        xi += (c % x.shape[d]) * xstr[d];
+      }
+      out.data[i] = x.data[xi];
+    }
   } else if (type == "fill_constant") {
     Tensor& out = Var(scope, Out(op, "Out"));
     std::vector<int64_t> shape = AttrInts(op, "shape");

@@ -392,3 +392,42 @@ def test_cpp_predictor_wide_op_families(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     np.testing.assert_allclose(np.load(out_npy), np.asarray(expected),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_serves_causal_decoder(tmp_path):
+    """A saved decoder-only causal LM (GPT family, dense-masked attention
+    path: range/expand/sign causal mask + matmul/softmax chain) served
+    natively with logits parity."""
+    from paddle_tpu.models import transformer as T
+
+    model_dir = str(tmp_path / "gpt_mini")
+    cfg = T.BertConfig(vocab_size=64, d_model=16, n_layer=2, n_head=2,
+                       d_inner=32, max_pos=16, dropout=0.0)
+    S = 8
+    rng = np.random.RandomState(21)
+    ids = rng.randint(1, cfg.vocab_size, (2, S)).astype(np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        feeds, logits, loss = T.build_gpt_pretrain(
+            cfg, S, is_test=True, fused_head=False, attn_impl="base")
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=13)
+        labels = np.zeros((2, S), np.int64)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"src_ids": ids, "lm_label": labels},
+                            fetch_list=[logits.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["src_ids"], [logits],
+                                      executor=exe, scope=scope)
+
+    binary = _build_binary()
+    np.save(str(tmp_path / "ids.npy"), ids)
+    out_npy = str(tmp_path / "logits.npy")
+    r = subprocess.run(
+        [binary, model_dir, str(tmp_path / "ids.npy"), out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(out_npy)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
