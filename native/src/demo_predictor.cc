@@ -25,8 +25,13 @@
 //   slice, concat,
 //   split, reshape2/flatten2/unsqueeze2/squeeze2, transpose2,
 //   top_k/argsort/arg_max/arg_min, gru/lstm, yolo_box,
-//   multiclass_nms, feed, fetch.  Payloads: f32 + exact int64 + bf16
-//   (u2 view).
+//   multiclass_nms, feed, fetch; plus the widened families in
+//   predictor_ops_wide.inc — nearest/bilinear resize, conv2d_transpose,
+//   SSD (prior_box/box_coder/detection_output), roi_align, crf_decoding,
+//   group_norm, l2_normalize, prelu/pow/stanh/trig, compare + logical,
+//   where, one_hot, cumsum, gather(_nd), stack/unstack, pad/pad2d,
+//   reverse, eye, increment, strided_slice, shape/size, fill_*_like,
+//   assign, sum.  Payloads: f32 + exact int64 + bf16 (u2 view).
 
 #include <algorithm>
 #include <chrono>
@@ -183,6 +188,10 @@ static int64_t ProdFrom(const std::vector<int64_t>& s, size_t a, size_t b) {
   return p;
 }
 
+// Widened op families (SSD chain, resize, transpose conv, roi_align, CRF
+// decode, compare/logical/tensor tail) — tried before rejecting an op.
+#include "predictor_ops_wide.inc"
+
 // ---------------------------------------------------------- operators ----
 static void RunOp(const Json& op, Scope* scope) {
   const std::string& type = op.at("type").str;
@@ -260,47 +269,21 @@ static void RunOp(const Json& op, Scope* scope) {
              type == "elementwise_pow") {
     // fluid broadcast: Y's shape aligns with X[axis : axis+Y.ndim]
     // (axis=-1 → trailing), and size-1 dims of Y broadcast (numpy
-    // semantics, matching ops/common.py broadcast_to_x) — per-dim
-    // strides with stride 0 on Y's broadcast dims
+    // semantics, matching ops/common.py broadcast_to_x) — shared with
+    // the compare family via BroadcastBinary
     const Tensor& x = Var(scope, In(op, "X"));
     const Tensor& y = Var(scope, In(op, "Y"));
     Tensor& out = Var(scope, Out(op, "Out"));
-    out.Resize(x.shape);
-    int64_t n = x.numel();
     int64_t axis = static_cast<int64_t>(AttrNum(op, "axis", -1));
-    if (axis < 0)
-      axis = static_cast<int64_t>(x.shape.size() - y.shape.size());
-    size_t r = x.shape.size();
-    // Y's shape expanded to X's rank: 1s before axis and after Y's dims
-    std::vector<int64_t> yshape(r, 1);
-    for (size_t i = 0; i < y.shape.size(); ++i)
-      yshape[axis + i] = y.shape[i];
-    std::vector<int64_t> ystr(r, 0);
-    int64_t acc = 1;
-    for (int i = static_cast<int>(r) - 1; i >= 0; --i) {
-      ystr[i] = yshape[i] == 1 ? 0 : acc;
-      acc *= yshape[i];
-    }
-    std::vector<int64_t> xstr(r, 1);
-    for (int i = static_cast<int>(r) - 2; i >= 0; --i)
-      xstr[i] = xstr[i + 1] * x.shape[i + 1];
-    for (int64_t i = 0; i < n; ++i) {
-      int64_t rem = i, yoff = 0;
-      for (size_t d = 0; d < r; ++d) {
-        int64_t idx = rem / xstr[d];
-        rem %= xstr[d];
-        yoff += idx * ystr[d];  // ystr is 0 on Y's broadcast (size-1) dims
-      }
-      float b = y.data[yoff];
-      float a = x.data[i];
-      out.data[i] = type == "elementwise_add"   ? a + b
-                    : type == "elementwise_sub" ? a - b
-                    : type == "elementwise_mul" ? a * b
-                    : type == "elementwise_div" ? a / b
-                    : type == "elementwise_max" ? std::max(a, b)
-                    : type == "elementwise_min" ? std::min(a, b)
-                                                : std::pow(a, b);
-    }
+    BroadcastBinary(x, y, axis, &out, [&](float a, float b) -> float {
+      return type == "elementwise_add"   ? a + b
+             : type == "elementwise_sub" ? a - b
+             : type == "elementwise_mul" ? a * b
+             : type == "elementwise_div" ? a / b
+             : type == "elementwise_max" ? std::max(a, b)
+             : type == "elementwise_min" ? std::min(a, b)
+                                         : std::pow(a, b);
+    });
   } else if (type == "conv2d" || type == "depthwise_conv2d") {
     // NCHW direct convolution (deployment-side reference executor; the
     // TPU path lowers to lax.conv_general_dilated — ops/nn_ops.py:49)
@@ -1107,99 +1090,12 @@ static void RunOp(const Json& op, Scope* scope) {
     }
   } else if (type == "multiclass_nms" || type == "multiclass_nms2") {
     // ref operators/detection/multiclass_nms_op.cc; mirrors the dense
-    // padded layout of detection_ops.py _multiclass_nms (Out [b,K,6])
+    // padded layout of detection_ops.py _multiclass_nms (Out [b,K,6]) —
+    // body shared with detection_output via MulticlassNMSCore
     const Tensor& bboxes = Var(scope, In(op, "BBoxes"));   // [b, m, 4]
     const Tensor& sc = Var(scope, In(op, "Scores"));       // [b, c, m]
-    int64_t bg = static_cast<int64_t>(AttrNum(op, "background_label", 0));
-    float score_th = static_cast<float>(AttrNum(op, "score_threshold", 0.0));
-    float nms_th = static_cast<float>(AttrNum(op, "nms_threshold", 0.3));
-    int64_t nms_top_k = static_cast<int64_t>(AttrNum(op, "nms_top_k", 400));
-    int64_t keep_top_k =
-        static_cast<int64_t>(AttrNum(op, "keep_top_k", 200));
-    bool normalized = AttrBool(op, "normalized", true);
-    int64_t b = sc.shape[0], c = sc.shape[1], m = sc.shape[2];
-    int64_t k_cls = (nms_top_k > 0) ? std::min(nms_top_k, m) : m;
-    if (keep_top_k < 0) keep_top_k = c * k_cls;
-    int64_t k_eff = std::min(keep_top_k, c * k_cls);
-    float off = normalized ? 0.f : 1.f;
-    Tensor& out = Var(scope, Out(op, "Out"));
-    out.Resize({b, keep_top_k, 6});
-    std::fill(out.data.begin(), out.data.end(), -1.f);
-    Tensor* num = nullptr;
-    if (!Out(op, "NmsRoisNum").empty()) {
-      num = &Var(scope, Out(op, "NmsRoisNum"));
-      num->Resize({b});
-      num->dtype = "int64";
-      num->i64.assign(b, 0);
-    }
-    auto area = [&](const float* box) {
-      return std::max(box[2] - box[0] + off, 0.f) *
-             std::max(box[3] - box[1] + off, 0.f);
-    };
-    auto iou = [&](const float* p, const float* q) {
-      float x1 = std::max(p[0], q[0]), y1 = std::max(p[1], q[1]);
-      float x2 = std::min(p[2], q[2]), y2 = std::min(p[3], q[3]);
-      float inter = std::max(x2 - x1 + off, 0.f) *
-                    std::max(y2 - y1 + off, 0.f);
-      float uni = area(p) + area(q) - inter;
-      return uni > 0 ? inter / std::max(uni, 1e-10f) : 0.f;
-    };
-    std::vector<int64_t> ord(m);
-    for (int64_t bi = 0; bi < b; ++bi) {
-      const float* bx = &bboxes.data[bi * m * 4];
-      // per-class: top-k by score, then greedy NMS on the sorted list
-      std::vector<float> top_s(c * k_cls);
-      std::vector<int64_t> top_i(c * k_cls);
-      std::vector<char> valid(c * k_cls, 0);
-      for (int64_t ci = 0; ci < c; ++ci) {
-        const float* s = &sc.data[(bi * c + ci) * m];
-        for (int64_t j = 0; j < m; ++j) ord[j] = j;
-        std::stable_sort(ord.begin(), ord.end(),
-                         [&](int64_t a, int64_t bb) {
-          return s[a] > s[bb];
-        });
-        for (int64_t j = 0; j < k_cls; ++j) {
-          top_s[ci * k_cls + j] = s[ord[j]];
-          top_i[ci * k_cls + j] = ord[j];
-        }
-        // greedy suppression in descending-score order (nms_keep)
-        for (int64_t j = 0; j < k_cls; ++j) {
-          bool sup = false;
-          for (int64_t p = 0; p < j && !sup; ++p)
-            if (valid[ci * k_cls + p] &&
-                iou(&bx[top_i[ci * k_cls + j] * 4],
-                    &bx[top_i[ci * k_cls + p] * 4]) > nms_th)
-              sup = true;
-          bool ok = !sup && top_s[ci * k_cls + j] > score_th && ci != bg;
-          valid[ci * k_cls + j] = ok ? 1 : 0;
-        }
-      }
-      // global top-k_eff over the surviving (class, candidate) entries
-      std::vector<int64_t> flat(c * k_cls);
-      for (int64_t j = 0; j < c * k_cls; ++j) flat[j] = j;
-      std::stable_sort(flat.begin(), flat.end(),
-                       [&](int64_t a, int64_t bb) {
-        float sa = valid[a] ? top_s[a] : -std::numeric_limits<float>::infinity();
-        float sb = valid[bb] ? top_s[bb] : -std::numeric_limits<float>::infinity();
-        return sa > sb;
-      });
-      int64_t kept = 0;
-      for (int64_t j = 0; j < k_eff; ++j) {
-        int64_t fi = flat[j];
-        if (!valid[fi]) continue;   // -inf tail: stays the -1 padding
-        float* row = &out.data[(bi * keep_top_k + j) * 6];
-        row[0] = static_cast<float>(fi / k_cls);          // class id
-        row[1] = top_s[fi];
-        const float* bo = &bx[top_i[fi] * 4];
-        row[2] = bo[0]; row[3] = bo[1]; row[4] = bo[2]; row[5] = bo[3];
-        ++kept;
-      }
-      if (num) {
-        num->i64[bi] = kept;
-        num->data[bi] = static_cast<float>(kept);
-      }
-    }
-  } else {
+    MulticlassNMSCore(bboxes, sc, op, scope);
+  } else if (!RunOpWide(type, op, scope)) {
     throw std::runtime_error("demo_predictor: unsupported op '" + type +
                              "' — extend RunOp for this model");
   }

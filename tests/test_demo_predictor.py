@@ -431,3 +431,242 @@ def test_cpp_predictor_serves_causal_decoder(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def _run_native(binary, model_dir, tmp_path, feeds, out_name="out.npy"):
+    """Save feeds positionally, run the native predictor, load fetch[0]."""
+    paths = []
+    for i, arr in enumerate(feeds):
+        p = str(tmp_path / f"feed{i}.npy")
+        np.save(p, arr)
+        paths.append(p)
+    out_npy = str(tmp_path / out_name)
+    r = subprocess.run([binary, model_dir] + paths + [out_npy],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return np.load(out_npy)
+
+
+def test_cpp_predictor_serves_ssd_post_process(tmp_path):
+    """The SSD serving chain — prior_box → box decode → multiclass NMS via
+    detection_output — runs natively with parity (round-4 native-serving
+    widening; ref naive_executor.cc runs the detection registry)."""
+    model_dir = str(tmp_path / "ssd_head")
+    b, ch, h, w, cls = 2, 5, 2, 2, 4
+    p = 4                         # min_sizes=[4] × ars {1,2,.5} + max_sizes
+    m = h * w * p
+    rng = np.random.RandomState(23)
+    feat = rng.randn(b, ch, h, w).astype(np.float32)
+    img = rng.randn(b, 3, 16, 16).astype(np.float32)
+    loc = (rng.randn(b, m, 4) * 0.2).astype(np.float32)
+    conf = rng.randn(b, m, cls).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("feat", shape=[ch, h, w], dtype="float32")
+        image = layers.data("img", shape=[3, 16, 16], dtype="float32")
+        loc_v = layers.data("loc", shape=[m, 4], dtype="float32")
+        conf_v = layers.data("conf", shape=[m, cls], dtype="float32")
+        pb, pbv = layers.prior_box(
+            x, image, min_sizes=[4.0], max_sizes=[8.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        pb2 = layers.reshape(pb, shape=[-1, 4])
+        pbv2 = layers.reshape(pbv, shape=[-1, 4])
+        scores = layers.softmax(conf_v)
+        out = layers.detection_output(
+            loc_v, scores, pb2, pbv2, background_label=0,
+            nms_threshold=0.45, nms_top_k=10, keep_top_k=6,
+            score_threshold=0.01)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"feat": feat, "img": img, "loc": loc, "conf": conf},
+            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["feat", "img", "loc", "conf"], [out],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [feat, img, loc, conf])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_predictor_serves_upsampling_decoder(tmp_path):
+    """A segmentation-style decoder — conv2d_transpose ×2 upsample,
+    group_norm, prelu, bilinear + nearest resize — served natively."""
+    model_dir = str(tmp_path / "decoder")
+    rng = np.random.RandomState(29)
+    xv = rng.randn(2, 4, 5, 5).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4, 5, 5], dtype="float32")
+        up = layers.conv2d_transpose(x, num_filters=6, filter_size=3,
+                                     stride=2, padding=1)
+        gn = layers.group_norm(up, groups=2)
+        pr = layers.prelu(gn, mode="channel")
+        bi = layers.resize_bilinear(pr, out_shape=[12, 12])
+        ne = layers.resize_nearest(bi, out_shape=[15, 15])
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=5)
+        expected, = exe.run(fluid.default_main_program(), feed={"x": xv},
+                            fetch_list=[ne.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [ne],
+                                      executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_cpp_predictor_serves_crf_tagger(tmp_path):
+    """A CRF sequence tagger head (emission → Viterbi crf_decoding with a
+    learned transition matrix and per-sequence lengths) served natively
+    with exact int64 tag parity."""
+    from paddle_tpu.layers import structured
+
+    model_dir = str(tmp_path / "crf_tagger")
+    B, T, N = 3, 6, 5
+    rng = np.random.RandomState(31)
+    em = rng.randn(B, T, N).astype(np.float32)
+    lens = np.array([6, 4, 2], np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        e = layers.data("em", shape=[T, N], dtype="float32")
+        ln = layers.data("lens", shape=[], dtype="int64")
+        path = structured.crf_decoding(
+            e, param_attr=fluid.ParamAttr(name="crf_trans"), length=ln)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=7)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"em": em, "lens": lens},
+                            fetch_list=[path.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["em", "lens"], [path],
+                                      executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [em, lens])
+    expected = np.asarray(expected)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got.reshape(B, T),
+                                  expected.reshape(B, T))
+
+
+def test_cpp_predictor_serves_roi_align_head(tmp_path):
+    """roi_align over per-image ROI counts + l2_normalize, natively."""
+    model_dir = str(tmp_path / "roi_head")
+    rng = np.random.RandomState(37)
+    xv = rng.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.abs(rng.randn(4, 4)).astype(np.float32) * 6
+    rois = np.ascontiguousarray(
+        np.sort(rois.reshape(4, 2, 2), axis=1).reshape(4, 4)[
+            :, [0, 2, 1, 3]])                 # x1<x2, y1<y2
+    rois_num = np.array([3, 1], np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        r = layers.data("rois", shape=[4], dtype="float32")
+        rn = layers.data("rois_num", shape=[], dtype="int64")
+        al = layers.roi_align(x, r, pooled_height=2, pooled_width=2,
+                              spatial_scale=0.5, sampling_ratio=2,
+                              rois_num=rn)
+        out = layers.l2_normalize(al, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"x": xv, "rois": rois, "rois_num": rois_num},
+            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "rois", "rois_num"],
+                                      [out], executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [xv, rois, rois_num])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_predictor_tensor_tail_families(tmp_path):
+    """The round-4/5 tensor-tail widening: gather, one_hot, cumsum, stack,
+    pad2d, compare→logical→where, reverse, strided_slice, pow, stanh,
+    trig, sum — all in one natively-served artifact."""
+    model_dir = str(tmp_path / "tail_model")
+    rng = np.random.RandomState(41)
+    xv = rng.randn(4, 6).astype(np.float32)
+    ids = np.array([[2], [0], [3]], np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[6], dtype="float32")
+        iv = layers.data("ids", shape=[1], dtype="int64")
+        g = layers.gather(x, iv)                        # [3, 6]
+        oh = layers.one_hot(iv, depth=5)                # [3, 5]
+        cs = layers.cumsum(x, axis=1)                   # [4, 6]
+        st = layers.stack([g, g], axis=0)               # [2, 3, 6]
+        x4 = layers.reshape(x, shape=[1, 1, 4, 6])
+        pd = layers.pad2d(x4, paddings=[1, 1, 2, 0], mode="reflect")
+        cmp = layers.less_than(x, layers.fill_constant(
+            shape=[1], dtype="float32", value=0.0))
+        lg = layers.logical_not(cmp)
+        wh = layers.where(lg)                           # [24, 2] int64
+        rv = layers.reverse(x, axis=[1])
+        ss = layers.strided_slice(x, axes=[0, 1], starts=[0, 1],
+                                  ends=[4, 6], strides=[2, 2])
+        pw = layers.pow(x, factor=2.0)
+        sth = layers.stanh(x)
+        tg = layers.cos(x) + layers.sin(x)
+        sm = layers.sums([x, pw])
+        ctr = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+        inc = layers.increment(ctr, value=5.0)      # in_place: Out aliases X
+        parts = [g, oh, cs, st, pd, inc, layers.cast(lg, "float32"),
+                 layers.cast(wh, "float32"), rv, ss, pw, sth, tg, sm]
+        flat = [layers.reshape(t, shape=[1, -1]) for t in parts]
+        merged = layers.concat(flat, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv, "ids": ids},
+                            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "ids"], [merged],
+                                      executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv, ids])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_crf_label_mask(tmp_path):
+    """crf_decoding with a Label input returns the 0/1 correctness mask,
+    not the tags — native path mirrors structured_ops.py exactly."""
+    from paddle_tpu.layers import structured
+
+    model_dir = str(tmp_path / "crf_mask")
+    B, T, N = 2, 5, 4
+    rng = np.random.RandomState(43)
+    em = rng.randn(B, T, N).astype(np.float32)
+    lab = rng.randint(0, N, (B, T)).astype(np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        e = layers.data("em", shape=[T, N], dtype="float32")
+        lv = layers.data("lab", shape=[T], dtype="int64")
+        mask = structured.crf_decoding(
+            e, param_attr=fluid.ParamAttr(name="crf_trans2"), label=lv)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=11)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"em": em, "lab": lab},
+                            fetch_list=[mask.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["em", "lab"], [mask],
+                                      executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [em, lab])
+    np.testing.assert_array_equal(
+        got.reshape(B, T), np.asarray(expected).reshape(B, T))
