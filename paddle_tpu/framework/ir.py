@@ -1090,6 +1090,329 @@ class SeqpoolConcatFusePass(Pass):
         return graph
 
 
+def _sole_producer(var_node, op_type):
+    """The op producing ``var_node`` iff it is of ``op_type`` and the var
+    has no other consumer-visible role (single producer is structural)."""
+    if not var_node.inputs or not var_node.inputs[0].is_op(op_type):
+        return None
+    return var_node.inputs[0]
+
+
+def _input_node(op_node, slot, i=0):
+    names = op_node.op.input(slot)
+    if not names or i >= len(names):
+        return None
+    return next((v for v in op_node.inputs if v.name == names[i]), None)
+
+
+def _output_node(op_node, slot, i=0):
+    names = op_node.op.output(slot)
+    if not names or i >= len(names):
+        return None
+    return next((v for v in op_node.outputs if v.name == names[i]), None)
+
+
+class _FCRNNFuseBase(Pass):
+    """fc → {gru,lstm} ⇒ {fusion_gru,fusion_lstm} (ref ir/fc_gru_fuse_pass
+    .cc, ir/fc_lstm_fuse_pass.cc).  Both RNN lowerings add Bias to the x
+    pre-projection — the same pre-activation the fc bias lands on — so the
+    fc bias folds numerically into the gate bias (needs ``scope=``)."""
+
+    RNN = ""
+    FUSED = ""
+    OUTS = ()
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        import numpy as np
+        scope = self.get("scope")
+        protected = self.protected_vars()
+        count = 0
+        for g in list(graph.ops_of_type(self.RNN)):
+            if g not in graph.op_nodes:
+                continue
+            proj = _input_node(g, "Input")
+            if proj is None or proj.name in protected or \
+                    len(proj.outputs) != 1:
+                continue
+            fc = _sole_producer(proj, "fc")
+            if fc is None or fc.op.attrs.get("activation_type"):
+                continue
+            if int(fc.op.attrs.get("in_num_col_dims", 1)) != 2:
+                continue        # proj must keep [b, t, gates] layout
+            x_node = _input_node(fc, "Input")
+            w_node = _input_node(fc, "W")
+            b_fc = _input_node(fc, "Bias")
+            if x_node is None or w_node is None or not w_node.persistable:
+                continue
+            bg_node = _input_node(g, "Bias")
+            if b_fc is not None and bg_node is not None and scope is None:
+                continue        # numeric bias fold needs param values
+            # only structural outputs survive; internal batch buffers
+            # (BatchGate…) must be dead or the fuse would lose them
+            outs, extra_ok = {}, True
+            for v in g.outputs:
+                slot = next((s for s in
+                             (self.OUTS + ("BatchGate", "BatchHidden",
+                                           "BatchResetHiddenPrev",
+                                           "BatchCellPreAct", "LastH",
+                                           "LastC"))
+                             if g.op.output(s) and
+                             v.name in g.op.output(s)), None)
+                if slot in self.OUTS:
+                    outs[slot] = v
+                elif v.outputs or v.name in protected:
+                    extra_ok = False
+            if not extra_ok or set(outs) != set(self.OUTS):
+                continue
+            # fused gate bias = gru/lstm bias (+ fc bias over the gate
+            # prefix — peephole tail, if any, is untouched)
+            bias_nodes = None
+            doomed_bias = []
+            if b_fc is not None and bg_node is not None:
+                bg = np.asarray(scope.find_var(bg_node.name), np.float64)
+                bf = np.asarray(scope.find_var(b_fc.name),
+                                np.float64).reshape(-1)
+                fused = bg.copy()
+                fused.reshape(-1)[:bf.size] += bf
+                name = outs[self.OUTS[0]].name + ".fused_gate_bias"
+                node = graph.create_var_node(
+                    name, shape=tuple(bg.shape), dtype="float32",
+                    persistable=True)
+                scope.set_var(name, fused.astype(np.float32))
+                bias_nodes = [node]
+                doomed_bias = [n for n in (b_fc, bg_node)
+                               if all(c in (fc, g) for c in n.outputs)]
+                for n in doomed_bias:   # dead params must not stay
+                    scope.erase(n.name)  # device-resident in serving
+            elif b_fc is not None:
+                bias_nodes = [b_fc]
+            elif bg_node is not None:
+                bias_nodes = [bg_node]
+            inputs = {"X": [x_node], "WeightX": [w_node],
+                      "WeightH": [_input_node(g, "Weight")]}
+            if bias_nodes:
+                inputs["Bias"] = bias_nodes
+            for slot in ("H0", "C0", "SeqLen"):
+                n = _input_node(g, slot)
+                if n is not None:
+                    inputs[slot] = [n]
+            graph.create_op_node(
+                self.FUSED, inputs=inputs,
+                outputs={s: [outs[s]] for s in self.OUTS},
+                attrs=dict(g.op.attrs))
+            doomed = [fc, proj, g] + doomed_bias
+            doomed += [v for v in g.outputs
+                       if v not in outs.values() and not v.outputs and
+                       v.name not in protected]
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs[self.name.replace("_pass", "") + "_count"] = count
+        return graph
+
+
+@register_pass("fc_gru_fuse_pass")
+class FCGRUFusePass(_FCRNNFuseBase):
+    RNN, FUSED, OUTS = "gru", "fusion_gru", ("Hidden",)
+
+
+@register_pass("fc_lstm_fuse_pass")
+class FCLSTMFusePass(_FCRNNFuseBase):
+    RNN, FUSED, OUTS = "lstm", "fusion_lstm", ("Hidden", "Cell")
+
+
+@register_pass("embedding_fc_lstm_fuse_pass")
+class EmbeddingFCLSTMFusePass(Pass):
+    """lookup_table → fc → lstm ⇒ ``fused_embedding_fc_lstm`` with a
+    pre-multiplied table (ref ir/embedding_fc_lstm_fuse_pass.cc): the new
+    Embeddings value is emb·W_fc + b_fc per row, so the gate projection
+    becomes a single row gather.  Needs ``scope=``; runs before
+    fc_lstm_fuse_pass (more specific pattern first)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        import numpy as np
+        scope = self.get("scope")
+        if scope is None:
+            raise ValueError("embedding_fc_lstm_fuse_pass needs scope= "
+                             "to pre-multiply the embedding table")
+        protected = self.protected_vars()
+        count = 0
+        for g in list(graph.ops_of_type("lstm")):
+            if g not in graph.op_nodes:
+                continue
+            proj = _input_node(g, "Input")
+            if proj is None or proj.name in protected or \
+                    len(proj.outputs) != 1:
+                continue
+            fc = _sole_producer(proj, "fc")
+            if fc is None or fc.op.attrs.get("activation_type") or \
+                    int(fc.op.attrs.get("in_num_col_dims", 1)) != 2:
+                continue
+            emb_out = _input_node(fc, "Input")
+            if emb_out is None or emb_out.name in protected or \
+                    len(emb_out.outputs) != 1:
+                continue
+            lt = None
+            for t in ("lookup_table", "lookup_table_v2"):
+                lt = lt or _sole_producer(emb_out, t)
+            if lt is None:
+                continue
+            pad = lt.op.attrs.get("padding_idx", -1)
+            if pad not in (-1, None):
+                # a padding row embeds to zeros pre-projection; the
+                # pre-multiplied table would bake b_fc into it — unsound
+                continue
+            emb_w = _input_node(lt, "W")
+            ids = _input_node(lt, "Ids")
+            w_node = _input_node(fc, "W")
+            b_fc = _input_node(fc, "Bias")
+            if emb_w is None or not emb_w.persistable or w_node is None \
+                    or not w_node.persistable:
+                continue
+            if any(c is not lt for c in emb_w.outputs):
+                continue        # shared table: other consumers keep it
+            hidden = _output_node(g, "Hidden")
+            cell = _output_node(g, "Cell")
+            if hidden is None or cell is None:
+                continue
+            if any(v not in (hidden, cell) and (v.outputs or
+                                                v.name in protected)
+                   for v in g.outputs):
+                continue
+            emb = np.asarray(scope.find_var(emb_w.name), np.float64)
+            w = np.asarray(scope.find_var(w_node.name), np.float64)
+            table = emb @ w
+            if b_fc is not None:
+                table = table + np.asarray(
+                    scope.find_var(b_fc.name), np.float64).reshape(1, -1)
+            name = hidden.name + ".premul_embeddings"
+            tbl_node = graph.create_var_node(
+                name, shape=tuple(table.shape), dtype="float32",
+                persistable=True)
+            scope.set_var(name, table.astype(np.float32))
+            inputs = {"Ids": [ids], "Embeddings": [tbl_node],
+                      "WeightH": [_input_node(g, "Weight")]}
+            bg = _input_node(g, "Bias")
+            if bg is not None:
+                inputs["Bias"] = [bg]
+            for slot in ("H0", "C0", "SeqLen"):
+                n = _input_node(g, slot)
+                if n is not None:
+                    inputs[slot] = [n]
+            graph.create_op_node(
+                "fused_embedding_fc_lstm", inputs=inputs,
+                outputs={"Hidden": [hidden], "Cell": [cell]},
+                attrs=dict(g.op.attrs))
+            doomed = [lt, emb_out, fc, proj, g]
+            doomed += [v for v in g.outputs
+                       if v not in (hidden, cell) and not v.outputs]
+            for n in (emb_w, w_node, b_fc):
+                if n is not None and all(c in (lt, fc) for c in n.outputs):
+                    doomed.append(n)
+                    scope.erase(n.name)  # don't keep the dead V×D table
+            graph.safe_remove_nodes(doomed)
+            count += 1
+        graph.attrs["embedding_fc_lstm_fuse_count"] = count
+        return graph
+
+
+@register_pass("conv_elementwise_add_act_fuse_pass")
+class ConvEltwiseAddActFusePass(Pass):
+    """conv2d → elementwise_add(per-channel bias) → act ⇒ ``conv2d_fusion``
+    (ref ir/conv_elementwise_add_act_fuse_pass.cc).  Must run before
+    fuse_elewise_add_act_pass, which would otherwise consume the
+    add→act tail."""
+
+    ACTS = ("relu", "sigmoid", "tanh")
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for conv in list(graph.ops_of_type("conv2d")):
+            if conv not in graph.op_nodes:
+                continue
+            conv_out = _output_node(conv, "Output")
+            if conv_out is None or conv_out.name in protected or \
+                    len(conv_out.outputs) != 1:
+                continue
+            add = conv_out.outputs[0]
+            if not add.is_op("elementwise_add") or \
+                    int(add.op.attrs.get("axis", -1)) != 1:
+                continue
+            bias = _input_node(add, "Y")
+            if bias is None or not bias.persistable or \
+                    bias.var is None or len(bias.var.shape or ()) != 1:
+                continue
+            add_out = _output_node(add, "Out")
+            if add_out is None or add_out.name in protected or \
+                    len(add_out.outputs) != 1:
+                continue
+            act = add_out.outputs[0]
+            if not act.is_op() or act.name not in self.ACTS:
+                continue
+            out_node = act.outputs[0]
+            attrs = dict(conv.op.attrs)
+            attrs["activation"] = act.name
+            graph.create_op_node(
+                "conv2d_fusion",
+                inputs={"Input": [_input_node(conv, "Input")],
+                        "Filter": [_input_node(conv, "Filter")],
+                        "Bias": [bias]},
+                outputs={"Output": [out_node]}, attrs=attrs)
+            graph.safe_remove_nodes([conv, conv_out, add, add_out, act])
+            count += 1
+        graph.attrs["conv_elementwise_add_act_fuse_count"] = count
+        return graph
+
+
+@register_pass("seqconv_eltadd_relu_fuse_pass")
+class SeqConvEltAddReluFusePass(Pass):
+    """sequence_conv → elementwise_add(bias) → relu ⇒
+    ``fusion_seqconv_eltadd_relu`` (ref ir/seqconv_eltadd_relu_fuse_pass
+    .cc — the text-CNN serving pattern)."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        protected = self.protected_vars()
+        count = 0
+        for sc in list(graph.ops_of_type("sequence_conv")):
+            if sc not in graph.op_nodes:
+                continue
+            if int(sc.op.attrs.get("contextStride", 1)) != 1:
+                continue
+            sc_out = _output_node(sc, "Out")
+            if sc_out is None or sc_out.name in protected or \
+                    len(sc_out.outputs) != 1:
+                continue
+            add = sc_out.outputs[0]
+            if not add.is_op("elementwise_add"):
+                continue
+            bias = _input_node(add, "Y")
+            if bias is None or not bias.persistable or \
+                    bias.var is None or len(bias.var.shape or ()) != 1 or \
+                    int(add.op.attrs.get("axis", -1)) != 2:
+                continue        # only the 1-D per-filter feature bias
+            add_out = _output_node(add, "Out")
+            if add_out is None or add_out.name in protected or \
+                    len(add_out.outputs) != 1:
+                continue
+            relu = add_out.outputs[0]
+            if not relu.is_op("relu"):
+                continue
+            out_node = relu.outputs[0]
+            graph.create_op_node(
+                "fusion_seqconv_eltadd_relu",
+                inputs={"X": [_input_node(sc, "X")],
+                        "Filter": [_input_node(sc, "Filter")],
+                        "Bias": [bias]},
+                outputs={"Out": [out_node]},
+                attrs={"contextLength":
+                       sc.op.attrs.get("contextLength", 3),
+                       "contextStart": sc.op.attrs.get("contextStart", 0)})
+            graph.safe_remove_nodes([sc, sc_out, add, add_out, relu])
+            count += 1
+        graph.attrs["seqconv_eltadd_relu_fuse_count"] = count
+        return graph
+
+
 @register_pass("conv_bn_fuse_pass")
 class ConvBNFusePass(Pass):
     """conv2d + batch_norm(is_test) → conv2d + folded weights

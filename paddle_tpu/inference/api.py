@@ -120,7 +120,19 @@ class AnalysisPredictor:
             keep = frozenset(self.fetch_names)
             g = ir.Graph(self.program)
             g = ir.get_pass("conv_bn_fuse_pass", scope=self.scope).apply(g)
+            # conv+bias+act must fuse BEFORE fuse_elewise_add_act, which
+            # would otherwise consume the add→act tail
+            g = ir.get_pass("conv_elementwise_add_act_fuse_pass",
+                            protected=keep).apply(g)
             g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
+            # recurrent serving chains: most-specific first (embedding+fc+
+            # lstm), then fc+gru / fc+lstm — the bias folds need the scope
+            for name in ("embedding_fc_lstm_fuse_pass",
+                         "fc_gru_fuse_pass", "fc_lstm_fuse_pass"):
+                g = ir.get_pass(name, protected=keep,
+                                scope=self.scope).apply(g)
+            g = ir.get_pass("seqconv_eltadd_relu_fuse_pass",
+                            protected=keep).apply(g)
             g = ir.get_pass("fuse_elewise_add_act_pass",
                             protected=keep).apply(g)
             # serving-path canonicalizations (ref ir_pass_manager's ~25

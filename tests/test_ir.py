@@ -573,3 +573,110 @@ def test_serving_fusion_passes():
                        scope=pt.global_scope())
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_fc_gru_lstm_fuse_numeric():
+    """fc→gru / fc→lstm collapse onto fusion_gru / fusion_lstm with the fc
+    bias folded into the gate bias (ref ir/fc_gru_fuse_pass.cc,
+    fc_lstm_fuse_pass.cc) — loss-free rewrite checked numerically."""
+    from paddle_tpu.layers import compat as rnn_layers
+    with _fresh():
+        x = layers.data("x", shape=[5, 6], dtype="float32")
+        H = 4
+        proj_g = layers.fc(x, size=3 * H, num_flatten_dims=2)
+        hidden_g = rnn_layers.dynamic_gru(proj_g, size=H)
+        proj_l = layers.fc(x, size=4 * H, num_flatten_dims=2)
+        hidden_l, _cell = rnn_layers.dynamic_lstm(
+            proj_l, size=4 * H, use_peepholes=True)
+        out = layers.concat([hidden_g, hidden_l], axis=2)
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=3)
+        scope = fluid.global_scope()
+        xv = np.random.RandomState(5).randn(2, 5, 6).astype(np.float32)
+        r1, = exe.run(prog, feed={"x": xv}, fetch_list=[out.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("fc_fuse_pass").apply(g)
+        assert g.attrs["fc_fuse_count"] == 2
+        g = ir.get_pass("fc_gru_fuse_pass", scope=scope).apply(g)
+        g = ir.get_pass("fc_lstm_fuse_pass", scope=scope).apply(g)
+        assert g.attrs["fc_gru_fuse_count"] == 1
+        assert g.attrs["fc_lstm_fuse_count"] == 1
+        assert not g.ops_of_type("gru") and not g.ops_of_type("lstm")
+        assert not g.ops_of_type("fc")
+        r2, = exe.run(g.to_program(), feed={"x": xv},
+                      fetch_list=[out.name])
+        np.testing.assert_allclose(r1, r2, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_fc_lstm_fuse_numeric():
+    """lookup_table→fc→lstm becomes one fused_embedding_fc_lstm whose
+    table is pre-multiplied emb·W+b (ref ir/embedding_fc_lstm_fuse_pass
+    .cc); the row gather replaces the projection matmul exactly."""
+    from paddle_tpu.layers import compat as rnn_layers
+    with _fresh():
+        ids = layers.data("ids", shape=[5, 1], dtype="int64")
+        H = 3
+        emb = layers.embedding(ids, size=[11, 6])
+        proj = layers.fc(emb, size=4 * H, num_flatten_dims=2)
+        hidden, _cell = rnn_layers.dynamic_lstm(
+            proj, size=4 * H, use_peepholes=False)
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=9)
+        scope = fluid.global_scope()
+        iv = np.random.RandomState(7).randint(0, 11, (2, 5, 1)).astype(
+            np.int64)
+        r1, = exe.run(prog, feed={"ids": iv}, fetch_list=[hidden.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("fc_fuse_pass").apply(g)
+        g = ir.get_pass("embedding_fc_lstm_fuse_pass", scope=scope).apply(g)
+        assert g.attrs["embedding_fc_lstm_fuse_count"] == 1
+        assert not g.ops_of_type("lookup_table")
+        assert not g.ops_of_type("lstm") and not g.ops_of_type("fc")
+        r2, = exe.run(g.to_program(), feed={"ids": iv},
+                      fetch_list=[hidden.name])
+        np.testing.assert_allclose(r1, r2, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_eltwise_add_act_fuse_numeric():
+    """conv2d + channel bias + relu folds onto conv2d_fusion
+    (ref ir/conv_elementwise_add_act_fuse_pass.cc)."""
+    with _fresh():
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        out = layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=11)
+        xv = np.random.RandomState(13).randn(2, 3, 8, 8).astype(np.float32)
+        r1, = exe.run(prog, feed={"img": xv}, fetch_list=[out.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("conv_elementwise_add_act_fuse_pass").apply(g)
+        assert g.attrs["conv_elementwise_add_act_fuse_count"] == 1
+        assert not g.ops_of_type("conv2d")
+        assert not g.ops_of_type("relu")
+        r2, = exe.run(g.to_program(), feed={"img": xv},
+                      fetch_list=[out.name])
+        np.testing.assert_allclose(r1, r2, rtol=1e-4, atol=1e-5)
+
+
+def test_seqconv_eltadd_relu_fuse_numeric():
+    """sequence_conv + bias + relu folds onto fusion_seqconv_eltadd_relu
+    (ref ir/seqconv_eltadd_relu_fuse_pass.cc)."""
+    from paddle_tpu.layers import sequence as seq_layers
+    with _fresh():
+        x = layers.data("x", shape=[7, 5], dtype="float32")
+        out = seq_layers.sequence_conv(x, num_filters=6, filter_size=3,
+                                       act="relu")
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), seed=17)
+        xv = np.random.RandomState(19).randn(2, 7, 5).astype(np.float32)
+        r1, = exe.run(prog, feed={"x": xv}, fetch_list=[out.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("seqconv_eltadd_relu_fuse_pass").apply(g)
+        assert g.attrs["seqconv_eltadd_relu_fuse_count"] == 1
+        assert not g.ops_of_type("sequence_conv")
+        r2, = exe.run(g.to_program(), feed={"x": xv},
+                      fetch_list=[out.name])
+        np.testing.assert_allclose(r1, r2, rtol=1e-4, atol=1e-5)
