@@ -31,7 +31,12 @@
 //   group_norm, l2_normalize, prelu/pow/stanh/trig, compare + logical,
 //   where, one_hot, cumsum, gather(_nd), stack/unstack, pad/pad2d,
 //   reverse, eye, increment, strided_slice, shape/size, fill_*_like,
-//   assign, sum.  Payloads: f32 + exact int64 + bf16 (u2 view).
+//   assign, sum; the dense sequence family (pool/softmax/reverse/
+//   expand/concat/mask with SeqLen), pixel/vision ops (pixel_shuffle,
+//   space_to_depth, shuffle_channel, affine_channel, lrn, maxout), the
+//   activation tail (selu/brelu/shrinks/soft_relu/logsigmoid), and
+//   detection extras (anchor_generator, box_clip, iou_similarity).
+//   Payloads: f32 + exact int64 + bf16 (u2 view).
 
 #include <algorithm>
 #include <chrono>

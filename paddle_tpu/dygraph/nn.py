@@ -140,6 +140,38 @@ class Conv2DTranspose(Layer):
         return _act(out, self._act)
 
 
+class Conv3DTranspose(Layer):
+    """ref dygraph/nn.py:441."""
+
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, padding=0, stride=1, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        self._stride = _pair(stride, 3)
+        self._padding = _pair(padding, 3)
+        self._dilation = _pair(dilation, 3)
+        self._act = act
+        fs = _pair(filter_size, 3)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // self._groups] + fs,
+            attr=param_attr, dtype=dtype)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, dtype=dtype, is_bias=True))
+
+    def forward(self, input):
+        out = _trace("conv3d_transpose",
+                     {"Input": [input], "Filter": [self.weight]},
+                     {"strides": self._stride, "paddings": self._padding,
+                      "dilations": self._dilation,
+                      "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         {"axis": 1})["Out"][0]
+        return _act(out, self._act)
+
+
 class Pool2D(Layer):
     """ref dygraph/nn.py:662."""
 

@@ -1112,6 +1112,57 @@ def _output_node(op_node, slot, i=0):
     return next((v for v in op_node.outputs if v.name == names[i]), None)
 
 
+def _referenced_outside_block0(program, name: str) -> bool:
+    """True if any op in a control-flow sub-block (block idx > 0) touches
+    ``name`` — the block-0 Graph cannot see those consumers, so params they
+    share must survive block-0 rewrites."""
+    for blk in program.blocks[1:]:
+        for op in blk.ops:
+            if name in op.input_arg_names() or \
+                    name in op.output_arg_names():
+                return True
+    return False
+
+
+def _match_fc_proj(g, protected):
+    """Match the fc producing ``g``'s Input projection (the shared prefix
+    of the fc+rnn fusion family).  Returns (fc, proj, x, w, bias) or
+    None; fc must be act-free with in_num_col_dims=2 (keeps the
+    [b, t, gates] layout) and a persistable weight."""
+    proj = _input_node(g, "Input")
+    if proj is None or proj.name in protected or len(proj.outputs) != 1:
+        return None
+    fc = _sole_producer(proj, "fc")
+    if fc is None or fc.op.attrs.get("activation_type") or \
+            int(fc.op.attrs.get("in_num_col_dims", 1)) != 2:
+        return None
+    x_node = _input_node(fc, "Input")
+    w_node = _input_node(fc, "W")
+    b_fc = _input_node(fc, "Bias")
+    if x_node is None or w_node is None or not w_node.persistable:
+        return None
+    return fc, proj, x_node, w_node, b_fc
+
+
+def _rnn_struct_outs(g, keep_slots, protected):
+    """Split ``g``'s outputs into the structural slots to keep vs the
+    internal batch buffers, which must be dead for the fuse to be legal.
+    Returns (outs dict, doomed list) or None."""
+    outs, doomed = {}, []
+    for v in g.outputs:
+        slot = next((s for s in keep_slots
+                     if g.op.output(s) and v.name in g.op.output(s)), None)
+        if slot is not None:
+            outs[slot] = v
+        elif v.outputs or v.name in protected:
+            return None
+        else:
+            doomed.append(v)
+    if set(outs) != set(keep_slots):
+        return None
+    return outs, doomed
+
+
 class _FCRNNFuseBase(Pass):
     """fc → {gru,lstm} ⇒ {fusion_gru,fusion_lstm} (ref ir/fc_gru_fuse_pass
     .cc, ir/fc_lstm_fuse_pass.cc).  Both RNN lowerings add Bias to the x
@@ -1130,40 +1181,17 @@ class _FCRNNFuseBase(Pass):
         for g in list(graph.ops_of_type(self.RNN)):
             if g not in graph.op_nodes:
                 continue
-            proj = _input_node(g, "Input")
-            if proj is None or proj.name in protected or \
-                    len(proj.outputs) != 1:
+            m = _match_fc_proj(g, protected)
+            if m is None:
                 continue
-            fc = _sole_producer(proj, "fc")
-            if fc is None or fc.op.attrs.get("activation_type"):
-                continue
-            if int(fc.op.attrs.get("in_num_col_dims", 1)) != 2:
-                continue        # proj must keep [b, t, gates] layout
-            x_node = _input_node(fc, "Input")
-            w_node = _input_node(fc, "W")
-            b_fc = _input_node(fc, "Bias")
-            if x_node is None or w_node is None or not w_node.persistable:
-                continue
+            fc, proj, x_node, w_node, b_fc = m
             bg_node = _input_node(g, "Bias")
             if b_fc is not None and bg_node is not None and scope is None:
                 continue        # numeric bias fold needs param values
-            # only structural outputs survive; internal batch buffers
-            # (BatchGate…) must be dead or the fuse would lose them
-            outs, extra_ok = {}, True
-            for v in g.outputs:
-                slot = next((s for s in
-                             (self.OUTS + ("BatchGate", "BatchHidden",
-                                           "BatchResetHiddenPrev",
-                                           "BatchCellPreAct", "LastH",
-                                           "LastC"))
-                             if g.op.output(s) and
-                             v.name in g.op.output(s)), None)
-                if slot in self.OUTS:
-                    outs[slot] = v
-                elif v.outputs or v.name in protected:
-                    extra_ok = False
-            if not extra_ok or set(outs) != set(self.OUTS):
-                continue
+            so = _rnn_struct_outs(g, self.OUTS, protected)
+            if so is None:
+                continue        # a live internal batch buffer blocks it
+            outs, dead_outs = so
             # fused gate bias = gru/lstm bias (+ fc bias over the gate
             # prefix — peephole tail, if any, is untouched)
             bias_nodes = None
@@ -1180,8 +1208,10 @@ class _FCRNNFuseBase(Pass):
                     persistable=True)
                 scope.set_var(name, fused.astype(np.float32))
                 bias_nodes = [node]
-                doomed_bias = [n for n in (b_fc, bg_node)
-                               if all(c in (fc, g) for c in n.outputs)]
+                doomed_bias = [
+                    n for n in (b_fc, bg_node)
+                    if all(c in (fc, g) for c in n.outputs) and
+                    not _referenced_outside_block0(graph.program, n.name)]
                 for n in doomed_bias:   # dead params must not stay
                     scope.erase(n.name)  # device-resident in serving
             elif b_fc is not None:
@@ -1200,11 +1230,8 @@ class _FCRNNFuseBase(Pass):
                 self.FUSED, inputs=inputs,
                 outputs={s: [outs[s]] for s in self.OUTS},
                 attrs=dict(g.op.attrs))
-            doomed = [fc, proj, g] + doomed_bias
-            doomed += [v for v in g.outputs
-                       if v not in outs.values() and not v.outputs and
-                       v.name not in protected]
-            graph.safe_remove_nodes(doomed)
+            graph.safe_remove_nodes([fc, proj, g] + doomed_bias +
+                                    dead_outs)
             count += 1
         graph.attrs[self.name.replace("_pass", "") + "_count"] = count
         return graph
@@ -1239,17 +1266,11 @@ class EmbeddingFCLSTMFusePass(Pass):
         for g in list(graph.ops_of_type("lstm")):
             if g not in graph.op_nodes:
                 continue
-            proj = _input_node(g, "Input")
-            if proj is None or proj.name in protected or \
-                    len(proj.outputs) != 1:
+            m = _match_fc_proj(g, protected)
+            if m is None:
                 continue
-            fc = _sole_producer(proj, "fc")
-            if fc is None or fc.op.attrs.get("activation_type") or \
-                    int(fc.op.attrs.get("in_num_col_dims", 1)) != 2:
-                continue
-            emb_out = _input_node(fc, "Input")
-            if emb_out is None or emb_out.name in protected or \
-                    len(emb_out.outputs) != 1:
+            fc, proj, emb_out, w_node, b_fc = m
+            if emb_out.name in protected or len(emb_out.outputs) != 1:
                 continue
             lt = None
             for t in ("lookup_table", "lookup_table_v2"):
@@ -1263,28 +1284,21 @@ class EmbeddingFCLSTMFusePass(Pass):
                 continue
             emb_w = _input_node(lt, "W")
             ids = _input_node(lt, "Ids")
-            w_node = _input_node(fc, "W")
-            b_fc = _input_node(fc, "Bias")
-            if emb_w is None or not emb_w.persistable or w_node is None \
-                    or not w_node.persistable:
+            if emb_w is None or not emb_w.persistable:
                 continue
             if any(c is not lt for c in emb_w.outputs):
                 continue        # shared table: other consumers keep it
-            hidden = _output_node(g, "Hidden")
-            cell = _output_node(g, "Cell")
-            if hidden is None or cell is None:
+            so = _rnn_struct_outs(g, ("Hidden", "Cell"), protected)
+            if so is None:
                 continue
-            if any(v not in (hidden, cell) and (v.outputs or
-                                                v.name in protected)
-                   for v in g.outputs):
-                continue
+            outs, dead_outs = so
             emb = np.asarray(scope.find_var(emb_w.name), np.float64)
             w = np.asarray(scope.find_var(w_node.name), np.float64)
             table = emb @ w
             if b_fc is not None:
                 table = table + np.asarray(
                     scope.find_var(b_fc.name), np.float64).reshape(1, -1)
-            name = hidden.name + ".premul_embeddings"
+            name = outs["Hidden"].name + ".premul_embeddings"
             tbl_node = graph.create_var_node(
                 name, shape=tuple(table.shape), dtype="float32",
                 persistable=True)
@@ -1300,13 +1314,17 @@ class EmbeddingFCLSTMFusePass(Pass):
                     inputs[slot] = [n]
             graph.create_op_node(
                 "fused_embedding_fc_lstm", inputs=inputs,
-                outputs={"Hidden": [hidden], "Cell": [cell]},
+                outputs={"Hidden": [outs["Hidden"]],
+                         "Cell": [outs["Cell"]]},
                 attrs=dict(g.op.attrs))
-            doomed = [lt, emb_out, fc, proj, g]
-            doomed += [v for v in g.outputs
-                       if v not in (hidden, cell) and not v.outputs]
+            doomed = [lt, emb_out, fc, proj, g] + dead_outs
             for n in (emb_w, w_node, b_fc):
-                if n is not None and all(c in (lt, fc) for c in n.outputs):
+                # consumed params leave graph AND scope — unless a
+                # control-flow sub-block the Graph can't see shares them
+                if n is not None and \
+                        all(c in (lt, fc) for c in n.outputs) and \
+                        not _referenced_outside_block0(graph.program,
+                                                       n.name):
                     doomed.append(n)
                     scope.erase(n.name)  # don't keep the dead V×D table
             graph.safe_remove_nodes(doomed)

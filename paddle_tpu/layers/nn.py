@@ -296,7 +296,28 @@ def l2_normalize(x, axis, epsilon=1e-12, name=None):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
-    raise NotImplementedError("spectral_norm: planned for a later round")
+    """ref layers/nn.py spectral_norm → spectral_norm op (weight / σ_max
+    via power iteration over persistable u/v buffers)."""
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = int(np.prod(weight.shape)) // h
+    from ..param_attr import ParamAttr
+    from ..initializer import NormalInitializer
+    u = helper.create_parameter(
+        ParamAttr(initializer=NormalInitializer(0.0, 1.0),
+                  trainable=False),
+        shape=[h], dtype=weight.dtype)
+    v = helper.create_parameter(
+        ParamAttr(initializer=NormalInitializer(0.0, 1.0),
+                  trainable=False),
+        shape=[w], dtype=weight.dtype)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
 
 
 def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
@@ -982,8 +1003,11 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
     return out
 
 
-def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
-    raise NotImplementedError("sampling_id: planned for a later round")
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Shadowed by layers.structured.sampling_id (the package export);
+    kept for direct ``layers.nn`` imports."""
+    from .structured import sampling_id as _impl
+    return _impl(x, min=min, max=max, seed=seed, dtype=dtype)
 
 
 def sums(input, out=None):

@@ -670,3 +670,142 @@ def test_cpp_predictor_crf_label_mask(tmp_path):
     got = _run_native(_build_binary(), model_dir, tmp_path, [em, lab])
     np.testing.assert_array_equal(
         got.reshape(B, T), np.asarray(expected).reshape(B, T))
+
+
+def test_cpp_predictor_sequence_family(tmp_path):
+    """The dense sequence family (pool/softmax/reverse/expand/concat/mask
+    with per-row lengths) served natively — the padded [b,t,...] analog of
+    the reference's LoD sequence_ops (SURVEY §5.7)."""
+    from paddle_tpu.layers import sequence as seq
+
+    model_dir = str(tmp_path / "seq_model")
+    B, T, D = 3, 5, 4
+    rng = np.random.RandomState(47)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[T, D], dtype="float32")
+        ln = layers.data("lens", shape=[], dtype="int64")
+        pooled_avg = seq.sequence_pool(x, "average", seq_len=ln)
+        pooled_max = seq.sequence_pool(x, "max", seq_len=ln)
+        pooled_last = seq.sequence_pool(x, "last", seq_len=ln)
+        sm = seq.sequence_softmax(x, seq_len=ln)
+        rv = seq.sequence_reverse(x, seq_len=ln)
+        ex = seq.sequence_expand(pooled_avg, x)          # [B,T,D]
+        cc = seq.sequence_concat([x, rv])                # [B,2T,D]
+        mk = seq.sequence_mask(ln, maxlen=T)             # [B,T]
+        parts = [pooled_avg, pooled_max, pooled_last, sm, rv, ex, cc,
+                 layers.cast(mk, "float32")]
+        flat = [layers.reshape(t_, shape=[1, -1]) for t_ in parts]
+        merged = layers.concat(flat, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv, "lens": lens},
+                            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "lens"], [merged],
+                                      executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv, lens])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_vision_family(tmp_path):
+    """Pixel/vision ops (pixel_shuffle, space_to_depth, shuffle_channel,
+    affine_channel, lrn, maxout), the activation tail, and detection
+    extras (anchor_generator, box_clip, iou_similarity) served natively."""
+    model_dir = str(tmp_path / "vision_model")
+    rng = np.random.RandomState(53)
+    xv = rng.randn(2, 8, 4, 4).astype(np.float32)
+    boxes = (rng.rand(6, 4).astype(np.float32) * 50)
+    boxes = np.ascontiguousarray(
+        np.sort(boxes.reshape(6, 2, 2), axis=1).reshape(6, 4)[
+            :, [0, 2, 1, 3]])
+    im_info = np.array([[40.0, 40.0, 1.0]], np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8, 4, 4], dtype="float32")
+        bx = layers.data("boxes", shape=[4], dtype="float32")
+        info = layers.data("im_info", shape=[3], dtype="float32",
+                           append_batch_size=False)
+        ps = layers.pixel_shuffle(x, upscale_factor=2)    # [b,2,8,8]
+        sd = layers.space_to_depth(x, blocksize=2)        # [b,32,2,2]
+        sc = layers.shuffle_channel(x, group=4)
+        af = layers.affine_channel(
+            sc, scale=layers.create_parameter([8], "float32", name="af_s"),
+            bias=layers.create_parameter([8], "float32", name="af_b"))
+        lr = layers.lrn(x, n=3)
+        mo = layers.maxout(x, groups=2)
+        act = layers.selu(layers.brelu(x)) + \
+            layers.softshrink(x) + layers.hard_swish(x)
+        anchors, avars = layers.anchor_generator(
+            x, anchor_sizes=[16.0, 32.0], aspect_ratios=[1.0, 2.0],
+            stride=[8.0, 8.0])
+        clipped = layers.box_clip(bx, info)
+        iou = layers.iou_similarity(bx, bx)
+        parts = [ps, sd, af, lr, mo, act, anchors, avars, clipped, iou]
+        flat = [layers.reshape(t_, shape=[1, -1]) for t_ in parts]
+        merged = layers.concat(flat, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=19)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"x": xv, "boxes": boxes, "im_info": im_info},
+            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["x", "boxes", "im_info"], [merged],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [xv, boxes, im_info])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_predictor_serves_frozen_qat_artifact(tmp_path):
+    """A QAT-trained, frozen int8-ready artifact (weights baked by
+    QuantizationFreezePass, activation QDQ ops frozen to their trained
+    EMA scales) serves natively with parity — the deployment end of the
+    slim quantization pipeline (ref QuantizationFreezePass +
+    naive_executor serving)."""
+    from paddle_tpu.contrib.slim import (QuantizationFreezePass,
+                                         QuantizationTransformPass)
+
+    model_dir = str(tmp_path / "qat_model")
+    rng = np.random.RandomState(59)
+    xv = rng.rand(4, 1, 8, 8).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        c1 = layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        pred = layers.fc(layers.flatten(c1), size=3, act="softmax")
+        QuantizationTransformPass().apply()
+        prog = fluid.default_main_program()
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=23)
+        # a few passes populate the activation EMA scales
+        for _ in range(3):
+            exe.run(prog, feed={"img": xv}, fetch_list=[pred.name],
+                    scope=scope)
+        test_prog = prog.clone(for_test=True)._prune([pred])
+        frozen = QuantizationFreezePass(scope).apply(test_prog)
+        expected, = exe.run(frozen, feed={"img": xv},
+                            fetch_list=[pred.name], scope=scope)
+        # frozen program still carries the is_test QDQ activation ops
+        assert any("fake_quantize" in op.type
+                   for op in frozen.global_block().ops)
+        fluid.io.save_inference_model(model_dir, ["img"], [pred],
+                                      executor=exe, main_program=frozen,
+                                      scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)

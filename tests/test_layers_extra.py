@@ -159,3 +159,31 @@ def test_mean_iou():
     lv = np.array([[0, 1, 1, 1]], np.int32)
     got, = _run([miou], {"pred": pv, "label": lv})
     assert 0.0 <= float(got.ravel()[0]) <= 1.0
+
+
+def test_spectral_norm_layer_normalizes_top_sv():
+    """layers.spectral_norm (previously a stub) divides the weight by its
+    top singular value via power iteration (ref layers/nn.py
+    spectral_norm → spectral_norm op)."""
+    w = layers.create_parameter(shape=[4, 6], dtype="float32",
+                                name="sn_weight")
+    out = layers.spectral_norm(w, dim=0, power_iters=50)
+    exe = Executor()
+    exe.run(pt.default_startup_program(), seed=3)
+    r, = exe.run(feed={}, fetch_list=[out])
+    sv = float(np.linalg.svd(np.asarray(r), compute_uv=False)[0])
+    assert abs(sv - 1.0) < 5e-2           # σ_max ≈ 1 after normalization
+
+
+def test_dygraph_conv3d_transpose_layer():
+    """dygraph.Conv3DTranspose (the 18th ref Layer class) upsamples and
+    matches the static conv3d_transpose lowering's shape contract."""
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        net = dygraph.nn.Conv3DTranspose(
+            "c3dt", num_channels=3, num_filters=4, filter_size=2, stride=2)
+        x = dygraph.to_variable(
+            np.random.RandomState(0).randn(2, 3, 4, 4, 4).astype(
+                np.float32))
+        y = net(x)
+        assert tuple(np.asarray(y.value).shape) == (2, 4, 8, 8, 8)
