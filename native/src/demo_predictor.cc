@@ -35,8 +35,13 @@
 //   expand/concat/mask with SeqLen), pixel/vision ops (pixel_shuffle,
 //   space_to_depth, shuffle_channel, affine_channel, lrn, maxout), the
 //   activation tail (selu/brelu/shrinks/soft_relu/logsigmoid), and
-//   detection extras (anchor_generator, box_clip, iou_similarity).
-//   Payloads: f32 + exact int64 + bf16 (u2 view).
+//   detection extras (anchor_generator, box_clip, iou_similarity);
+//   control flow (while + conditional_block over serialized sub-blocks),
+//   dense tensor arrays (array_write/read/length, tensor_array_to_
+//   tensor), gru_unit/lstm_unit steps, beam_search + beam_search_decode
+//   (full While-loop NMT decode artifacts run natively), and the frozen
+//   QAT fake-quant family.  Payloads: f32 + exact int64 + bf16 (u2
+//   view).
 
 #include <algorithm>
 #include <chrono>
@@ -198,11 +203,45 @@ static int64_t ProdFrom(const std::vector<int64_t>& s, size_t a, size_t b) {
 #include "predictor_ops_wide.inc"
 
 // ---------------------------------------------------------- operators ----
+// All program blocks, for control-flow ops whose sub_block attr is a
+// {"__block__": idx} reference (set by main before running).
+static const Json* g_blocks = nullptr;
+
+static void RunOp(const Json& op, Scope* scope);
+
+static void RunSubBlock(const Json& op, Scope* scope) {
+  const Json& ref = op.at("attrs").at("sub_block");
+  int64_t idx = ref.at("__block__").as_int();
+  const Json& blk = g_blocks->arr[static_cast<size_t>(idx)];
+  for (const auto& sub : blk.at("ops").arr) RunOp(sub, scope);
+}
+
 static void RunOp(const Json& op, Scope* scope) {
   const std::string& type = op.at("type").str;
 
   if (type == "feed" || type == "fetch") {
     return;  // feeds pre-placed in the scope; fetches read afterwards
+  }
+  if (type == "while") {
+    // ref while_op.cc RunImpl: re-run the sub-block until Condition goes
+    // false; the flat scope carries the loop state across iterations
+    const std::string cond = In(op, "Condition");
+    int64_t guard = 0;
+    while (Var(scope, cond).data.at(0) != 0.f) {
+      if (++guard > 100000)
+        throw std::runtime_error("while: exceeded 100000 iterations");
+      RunSubBlock(op, scope);
+    }
+    return;
+  }
+  if (type == "conditional_block" || type == "conditional_block_infer") {
+    std::string cond = In(op, "Cond");
+    if (cond.empty()) cond = In(op, "Condition");
+    const Tensor& c = Var(scope, cond);
+    bool take = false;  // scalar pred, or any-nonzero like the reference
+    for (float v : c.data) take = take || v != 0.f;
+    if (take) RunSubBlock(op, scope);
+    return;
   }
   if (type == "mul") {
     // fluid mul: flatten X at x_num_col_dims, Y at y_num_col_dims
@@ -1157,6 +1196,7 @@ int main(int argc, char** argv) {
     for (size_t i = 0; i < feeds.size(); ++i)
       scope[feeds[i].str] = LoadNpy(argv[2 + i]);
 
+    g_blocks = &model.at("blocks");
     const Json& block = model.at("blocks").arr[0];
     for (const auto& op : block.at("ops").arr) RunOp(op, &scope);
 

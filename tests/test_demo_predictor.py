@@ -809,3 +809,100 @@ def test_cpp_predictor_serves_frozen_qat_artifact(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_serves_beam_search_decoder(tmp_path):
+    """A full While-loop beam-search decoder artifact — sub-block control
+    flow, dense tensor arrays, beam_search/beam_search_decode, state
+    reorder by parent — served natively with exact id parity (the
+    reference's NaiveExecutor runs the same saved NMT decode programs)."""
+    from paddle_tpu.contrib import decoder as D
+
+    model_dir = str(tmp_path / "beam_decoder")
+    beam, vocab, word_dim, hidden, max_len = 2, 7, 4, 6, 4
+    batch = 1
+    bb = batch * beam
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        init_ids = layers.data("init_ids", shape=[1], dtype="int64")
+        init_scores = layers.data("init_scores", shape=[1],
+                                  dtype="float32")
+        boot = layers.data("boot", shape=[hidden], dtype="float32")
+        cell = D.StateCell(inputs={"x": None},
+                           states={"h": D.InitState(init=boot,
+                                                    need_reorder=True)},
+                           out_state="h")
+
+        @cell.state_updater
+        def updater(state_cell):
+            x = state_cell.get_input("x")
+            h = state_cell.get_state("h")
+            new_h = layers.fc(layers.concat([x, h], axis=1), size=hidden,
+                              act="tanh",
+                              param_attr=fluid.ParamAttr(name="bdec_w"),
+                              bias_attr=fluid.ParamAttr(name="bdec_b"))
+            state_cell.set_state("h", new_h)
+
+        dec = D.BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=vocab,
+            word_dim=word_dim, topk_size=vocab, max_len=max_len,
+            beam_size=beam, end_id=1)
+        dec.decode()
+        trans_ids, trans_scores = dec()
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope,
+                fetch_list=[], seed=29)
+        feed = {"init_ids": np.zeros((bb, 1), np.int64),
+                "init_scores": np.array([[0.0], [-1e9]] * batch,
+                                        np.float32),
+                "boot": np.zeros((bb, hidden), np.float32)}
+        expected, = exe.run(feed=feed, fetch_list=[trans_ids.name],
+                            scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["init_ids", "init_scores", "boot"], [trans_ids],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [feed["init_ids"], feed["init_scores"],
+                       feed["boot"]])
+    expected = np.asarray(expected)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got.reshape(expected.shape), expected)
+
+
+def test_cpp_predictor_recurrence_units(tmp_path):
+    """gru_unit + lstm_unit single-step recurrences served natively (the
+    building blocks of hand-rolled While decode loops)."""
+    model_dir = str(tmp_path / "units_model")
+    B, D = 3, 4
+    rng = np.random.RandomState(61)
+    xg = rng.randn(B, 3 * D).astype(np.float32)
+    hp = rng.randn(B, D).astype(np.float32)
+    xl = rng.randn(B, 4 * D).astype(np.float32)
+    cp = rng.randn(B, D).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        g_in = layers.data("g_in", shape=[3 * D], dtype="float32")
+        h_prev = layers.data("h_prev", shape=[D], dtype="float32")
+        l_in = layers.data("l_in", shape=[4 * D], dtype="float32")
+        c_prev = layers.data("c_prev", shape=[D], dtype="float32")
+        h, _, _ = layers.gru_unit(g_in, h_prev, size=3 * D)
+        hl, _cl = layers.lstm_unit(l_in, h_prev, c_prev)
+        merged = layers.concat([h, hl], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=31)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"g_in": xg, "h_prev": hp, "l_in": xl, "c_prev": cp},
+            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["g_in", "h_prev", "l_in", "c_prev"], [merged],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [xg, hp, xl, cp])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
