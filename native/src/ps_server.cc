@@ -11,8 +11,13 @@
 //
 // Wire protocol (all little-endian):
 //   request : u8 op | u16 name_len | name | u32 rows | u64 payload_len |
-//             [rows * u32 row ids] | [payload bytes]
-//   response: u64 payload_len | payload
+//             [rows * u32 row ids] | [payload bytes] | u32 crc32
+//   response: u64 payload_len | payload | u32 crc32
+// The CRC32 (IEEE) covers rows+payload (request) / payload (response) and
+// is verified before any table mutation; frames are assembled with
+// writev so header+payload+crc reach the kernel without a concatenation
+// copy.  The error response is the bare all-ones length sentinel (no
+// crc).
 // ops: 0 PUT  1 GET  2 PUSH_DENSE  3 BARRIER  4 PUSH_SPARSE  5 GET_ROWS
 //      6 STOP 7 GET_NOBARRIER
 // typed ops (8 PUT_TYPED 9 GET_TYPED 10 PUSH_TYPED) carry one extra u8
@@ -26,6 +31,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -117,22 +123,105 @@ bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// CRC32 (IEEE, reflected 0xEDB88320) — end-to-end frame integrity over
+// the payload bytes, beyond TCP's weak 16-bit checksum (the reference's
+// bRPC transport verifies attachments the same way).  Running form so
+// multi-buffer frames fold without concatenation.
+uint32_t crc32_update(uint32_t crc, const void* buf, size_t n) {
+  // slicing-by-8: ~8 bytes per table round, keeping the check cheap on
+  // multi-GB pushes (a byte-at-a-time loop would serialize seconds of
+  // CPU on the connection thread for payloads near the 2^34 cap)
+  static uint32_t t[8][256];
+  static std::once_flag once;
+  std::call_once(once, [] {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (int j = 1; j < 8; j++)
+      for (uint32_t i = 0; i < 256; i++)
+        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
+  });
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  crc = ~crc;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = t[7][lo & 0xFF] ^ t[6][(lo >> 8) & 0xFF] ^
+          t[5][(lo >> 16) & 0xFF] ^ t[4][lo >> 24] ^
+          t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+          t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = t[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// Vectored full write: the whole frame (header + payload + crc) reaches
+// the kernel in one writev — no user-space concatenation copy, and no
+// header/payload segment split on the wire (≈ grpc_serde's zero-copy
+// bytebuffer assembly).
+bool writev_full(int fd, struct iovec* iov, int iovcnt) {
+  while (iovcnt > 0) {
+    ssize_t r = ::writev(fd, iov, iovcnt);
+    if (r <= 0) return false;
+    size_t done = static_cast<size_t>(r);
+    while (iovcnt > 0 && done >= iov[0].iov_len) {
+      done -= iov[0].iov_len;
+      ++iov;
+      --iovcnt;
+    }
+    if (iovcnt > 0 && done > 0) {
+      iov[0].iov_base = static_cast<char*>(iov[0].iov_base) + done;
+      iov[0].iov_len -= done;
+    }
+  }
+  return true;
+}
+
 bool send_payload(int fd, const float* data, size_t n_floats) {
   uint64_t len = n_floats * sizeof(float);
-  if (!write_full(fd, &len, sizeof(len))) return false;
-  return n_floats == 0 || write_full(fd, data, len);
+  uint32_t crc = crc32_update(0, data, len);
+  struct iovec iov[3] = {{&len, sizeof(len)},
+                         {const_cast<float*>(data), static_cast<size_t>(len)},
+                         {&crc, sizeof(crc)}};
+  if (n_floats == 0) {
+    iov[1] = iov[2];
+    return writev_full(fd, iov, 2);
+  }
+  return writev_full(fd, iov, 3);
 }
 
 bool send_bytes(int fd, const void* data, size_t n_bytes) {
   uint64_t len = n_bytes;
-  if (!write_full(fd, &len, sizeof(len))) return false;
-  return n_bytes == 0 || write_full(fd, data, n_bytes);
+  uint32_t crc = crc32_update(0, data, n_bytes);
+  struct iovec iov[3] = {{&len, sizeof(len)},
+                         {const_cast<void*>(data), n_bytes},
+                         {&crc, sizeof(crc)}};
+  if (n_bytes == 0) {
+    iov[1] = iov[2];
+    return writev_full(fd, iov, 2);
+  }
+  return writev_full(fd, iov, 3);
 }
 
 // Error response: payload_len sentinel of all-ones (a real payload is
 // bounded at 2^34 by the request validator, so this is unambiguous).
 bool send_error(int fd) {
   uint64_t len = ~0ull;
+  return write_full(fd, &len, sizeof(len));
+}
+
+// CRC-reject sentinel (~1): the request was verifiably NOT applied, so
+// the client may resend it even when the op is non-idempotent — unlike
+// the generic error, which means the request WAS served.
+bool send_crc_reject(int fd) {
+  uint64_t len = ~1ull;
   return write_full(fd, &len, sizeof(len));
 }
 
@@ -216,7 +305,8 @@ void handle_conn(Server* s, int fd) {
     if (payload_len % dtype_size(dtype) != 0 ||
         payload_len > (1ull << 34)) break;  // malformed request
     std::vector<uint32_t> rows(n_rows);
-    if (n_rows && !read_full(fd, rows.data(), n_rows * 4)) break;
+    if (n_rows && !read_full(fd, rows.data(),
+                         static_cast<size_t>(n_rows) * 4)) break;
     std::vector<uint8_t> raw;           // typed ops: raw element bytes
     std::vector<float> payload;
     if (typed) {
@@ -225,6 +315,21 @@ void handle_conn(Server* s, int fd) {
     } else {
       payload.resize(payload_len / sizeof(float));
       if (payload_len && !read_full(fd, payload.data(), payload_len)) break;
+    }
+    // frame integrity: CRC32 over rows + payload, verified BEFORE any
+    // table mutation — a corrupted push is rejected, never applied (so
+    // the client may safely resend it)
+    uint32_t want_crc;
+    if (!read_full(fd, &want_crc, sizeof(want_crc))) break;
+    uint32_t got_crc =
+        crc32_update(0, rows.data(), static_cast<size_t>(n_rows) * 4);
+    got_crc = typed
+                  ? crc32_update(got_crc, raw.data(), raw.size())
+                  : crc32_update(got_crc, payload.data(),
+                                 payload.size() * sizeof(float));
+    if (got_crc != want_crc) {
+      send_crc_reject(fd);
+      break;                            // desynced/corrupt stream: drop
     }
 
     if (op == kStop) {
@@ -671,27 +776,44 @@ int64_t request_once(Client* c, uint8_t op, int dtype, const char* name,
                      void* out, uint64_t out_cap_bytes, bool* sent) {
   *sent = false;
   uint16_t name_len = static_cast<uint16_t>(std::strlen(name));
-  if (!write_full(c->fd, &op, 1)) return -1;
-  if (dtype >= 0) {
-    uint8_t d = static_cast<uint8_t>(dtype);
-    if (!write_full(c->fd, &d, 1)) return -1;
-  }
-  if (!write_full(c->fd, &name_len, sizeof(name_len))) return -1;
-  if (name_len && !write_full(c->fd, name, name_len)) return -1;
-  if (!write_full(c->fd, &n_rows, sizeof(n_rows))) return -1;
-  if (!write_full(c->fd, &payload_len, sizeof(payload_len))) return -1;
-  if (n_rows && !write_full(c->fd, rows, n_rows * 4)) return -1;
-  if (payload_len && !write_full(c->fd, payload, payload_len)) return -1;
+  uint8_t d = static_cast<uint8_t>(dtype);
+  uint32_t crc =
+      crc32_update(0, rows, static_cast<size_t>(n_rows) * 4);
+  crc = crc32_update(crc, payload, payload_len);
+  // whole request in one writev: header fields + rows + payload + crc
+  struct iovec iov[8];
+  int nv = 0;
+  iov[nv++] = {&op, 1};
+  if (dtype >= 0) iov[nv++] = {&d, 1};
+  iov[nv++] = {&name_len, sizeof(name_len)};
+  if (name_len)
+    iov[nv++] = {const_cast<char*>(name), static_cast<size_t>(name_len)};
+  iov[nv++] = {&n_rows, sizeof(n_rows)};
+  iov[nv++] = {&payload_len, sizeof(payload_len)};
+  if (n_rows)
+    iov[nv++] = {const_cast<uint32_t*>(rows),
+                 static_cast<size_t>(n_rows) * 4};
+  if (payload_len)
+    iov[nv++] = {const_cast<void*>(payload),
+                 static_cast<size_t>(payload_len)};
+  // crc rides a second writev only when the iovec budget is spent
+  bool crc_inline = nv < 8;
+  if (crc_inline) iov[nv++] = {&crc, sizeof(crc)};
+  if (!writev_full(c->fd, iov, nv)) return -1;
+  if (!crc_inline && !write_full(c->fd, &crc, sizeof(crc))) return -1;
   *sent = true;
   uint64_t resp_len;
   if (!read_full(c->fd, &resp_len, sizeof(resp_len))) return -1;
   if (resp_len == ~0ull) return -2;  // server error: unknown table/dtype
+  if (resp_len == ~1ull) return -3;  // CRC reject: NOT applied — resend
   // read straight into the caller's buffer (no temp copy on the hot
   // recv path); drain any excess to keep the stream in sync
   uint64_t remaining = resp_len;
+  uint32_t rcrc = 0;
   if (out && out_cap_bytes > 0 && remaining > 0) {
     uint64_t take = std::min<uint64_t>(remaining, out_cap_bytes);
     if (!read_full(c->fd, out, take)) return -1;
+    rcrc = crc32_update(rcrc, out, take);
     remaining -= take;
   }
   char scratch[4096];
@@ -699,8 +821,12 @@ int64_t request_once(Client* c, uint8_t op, int dtype, const char* name,
     size_t chunk = static_cast<size_t>(
         std::min<uint64_t>(remaining, sizeof(scratch)));
     if (!read_full(c->fd, scratch, chunk)) return -1;
+    rcrc = crc32_update(rcrc, scratch, chunk);
     remaining -= chunk;
   }
+  uint32_t want = 0;
+  if (!read_full(c->fd, &want, sizeof(want))) return -1;
+  if (want != rcrc) return -1;  // corrupted response: retry path decides
   return static_cast<int64_t>(resp_len);
 }
 
@@ -736,8 +862,9 @@ int64_t request_bytes(Client* c, uint8_t op, int dtype, const char* name,
                              payload_len, out, out_cap_bytes, &sent);
     if (n >= 0 || n == -2) return n;
     // transport failure: after a timeout the stream is desynced —
-    // reconnect before any retry
-    bool may_have_applied = sent;
+    // reconnect before any retry.  A CRC reject (-3) was verifiably NOT
+    // applied server-side, so it is safe to resend for any op.
+    bool may_have_applied = sent && n != -3;
     if (attempt >= retries ||
         (may_have_applied && !op_idempotent(op)))
       return -1;

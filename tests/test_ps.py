@@ -697,3 +697,47 @@ def test_geo_sgd_sparse_row_pushes():
         np.testing.assert_allclose(np.asarray(srv_rows), local, rtol=1e-5)
         ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
         srv.join(timeout=5)
+
+
+def test_transport_crc_rejects_corrupt_frame():
+    """The wire protocol carries a CRC32 over rows+payload: a corrupted
+    push is rejected BEFORE any table mutation (server replies with the
+    error sentinel and drops the desynced stream), and a healthy client
+    on a fresh connection still sees the untouched value — the app-level
+    integrity the reference gets from bRPC attachment verification."""
+    import struct
+
+    server = ps_mod.PSServer(0, 1, True, [
+        {"name": "w", "size": 4, "optimizer": "sgd", "lr": 0.5}])
+    port = server.start()
+    try:
+        cli = ps_mod.PSClient(f"127.0.0.1:{port}")
+        cli.put("w", np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+
+        # hand-rolled PUSH_DENSE frame with a deliberately wrong CRC
+        payload = np.array([9.0, 9.0, 9.0, 9.0], np.float32).tobytes()
+        frame = (struct.pack("<B", 2) +          # op = kPushDense
+                 struct.pack("<H", 1) + b"w" +
+                 struct.pack("<I", 0) +          # no rows
+                 struct.pack("<Q", len(payload)) + payload +
+                 struct.pack("<I", 0xDEADBEEF))  # bad crc
+        raw = socket.create_connection(("127.0.0.1", port), timeout=10)
+        raw.sendall(frame)
+        resp = b""
+        while len(resp) < 8:            # recv may legally return short
+            chunk = raw.recv(8 - len(resp))
+            if not chunk:
+                break
+            resp += chunk
+        # CRC-reject sentinel (~1: fe ff..ff LE) and the conn is dropped
+        assert resp == b"\xfe" + b"\xff" * 7
+        assert raw.recv(1) == b""
+        raw.close()
+
+        # the corrupted push must NOT have been applied
+        got = cli.get("w", 4)
+        np.testing.assert_allclose(got, [1, 2, 3, 4])
+        cli.close()
+    finally:
+        server.stop()
+        server.destroy()
