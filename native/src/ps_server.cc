@@ -321,8 +321,16 @@ void handle_conn(Server* s, int fd) {
     // the client may safely resend it)
     uint32_t want_crc;
     if (!read_full(fd, &want_crc, sizeof(want_crc))) break;
-    uint32_t got_crc =
-        crc32_update(0, rows.data(), static_cast<size_t>(n_rows) * 4);
+    // the CRC covers the WHOLE frame — header included, so a bit-flip in
+    // the name can't mutate (or ghost-create) the wrong table
+    uint32_t got_crc = crc32_update(0, &op, 1);
+    if (typed) got_crc = crc32_update(got_crc, &dtype, 1);
+    got_crc = crc32_update(got_crc, &name_len, sizeof(name_len));
+    got_crc = crc32_update(got_crc, name.data(), name.size());
+    got_crc = crc32_update(got_crc, &n_rows, sizeof(n_rows));
+    got_crc = crc32_update(got_crc, &payload_len, sizeof(payload_len));
+    got_crc = crc32_update(got_crc, rows.data(),
+                           static_cast<size_t>(n_rows) * 4);
     got_crc = typed
                   ? crc32_update(got_crc, raw.data(), raw.size())
                   : crc32_update(got_crc, payload.data(),
@@ -777,8 +785,13 @@ int64_t request_once(Client* c, uint8_t op, int dtype, const char* name,
   *sent = false;
   uint16_t name_len = static_cast<uint16_t>(std::strlen(name));
   uint8_t d = static_cast<uint8_t>(dtype);
-  uint32_t crc =
-      crc32_update(0, rows, static_cast<size_t>(n_rows) * 4);
+  uint32_t crc = crc32_update(0, &op, 1);
+  if (dtype >= 0) crc = crc32_update(crc, &d, 1);
+  crc = crc32_update(crc, &name_len, sizeof(name_len));
+  crc = crc32_update(crc, name, name_len);
+  crc = crc32_update(crc, &n_rows, sizeof(n_rows));
+  crc = crc32_update(crc, &payload_len, sizeof(payload_len));
+  crc = crc32_update(crc, rows, static_cast<size_t>(n_rows) * 4);
   crc = crc32_update(crc, payload, payload_len);
   // whole request in one writev: header fields + rows + payload + crc
   struct iovec iov[8];

@@ -906,3 +906,47 @@ def test_cpp_predictor_recurrence_units(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_serves_video_3d_family(tmp_path):
+    """The 3-D/video serving family — conv3d, pool3d, conv3d_transpose,
+    trilinear up-sample, grid_sampler, temporal_shift — natively with
+    parity."""
+    model_dir = str(tmp_path / "video_model")
+    rng = np.random.RandomState(67)
+    xv = rng.randn(2, 3, 4, 6, 6).astype(np.float32)
+    gv = (rng.rand(2, 5, 5, 2).astype(np.float32) * 2 - 1)
+    tv = rng.randn(8, 4, 3, 3).astype(np.float32)   # n*seg=8, seg=4
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[3, 4, 6, 6], dtype="float32")
+        grid = layers.data("grid", shape=[5, 5, 2], dtype="float32")
+        ts_in = layers.data("ts_in", shape=[4, 3, 3], dtype="float32")
+        c3 = layers.conv3d(x, num_filters=4, filter_size=3, padding=1,
+                           stride=2, bias_attr=False)
+        p3 = layers.pool3d(c3, pool_size=2, pool_stride=1,
+                           pool_type="avg")
+        u3 = layers.conv3d_transpose(p3, num_filters=2, filter_size=2,
+                                     stride=2, bias_attr=False)
+        tri = layers.resize_trilinear(u3, out_shape=[4, 6, 6])
+        gs = layers.grid_sampler(
+            layers.reshape(x, shape=[2, 12, 6, 6]), grid)
+        ts = layers.temporal_shift(ts_in, seg_num=4, shift_ratio=0.25)
+        parts = [tri, gs, ts]
+        flat = [layers.reshape(t_, shape=[1, -1]) for t_ in parts]
+        merged = layers.concat(flat, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=37)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"x": xv, "grid": gv, "ts_in": tv},
+            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["x", "grid", "ts_in"], [merged],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv, gv, tv])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)

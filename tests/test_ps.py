@@ -700,7 +700,8 @@ def test_geo_sgd_sparse_row_pushes():
 
 
 def test_transport_crc_rejects_corrupt_frame():
-    """The wire protocol carries a CRC32 over rows+payload: a corrupted
+    """The wire protocol carries a CRC32 over the WHOLE frame (header
+    included): a corrupted
     push is rejected BEFORE any table mutation (server replies with the
     error sentinel and drops the desynced stream), and a healthy client
     on a fresh connection still sees the untouched value — the app-level
