@@ -181,17 +181,17 @@ struct Tensor {
 // Scope: name -> tensor (ref framework/scope.h — flat is enough here).
 using Scope = std::map<std::string, Tensor>;
 
-static Tensor& Var(Scope* scope, const std::string& name) {
+inline Tensor& Var(Scope* scope, const std::string& name) {
   return (*scope)[name];
 }
 
 // ------------------------------------------------------------ operators ----
-static std::string In(const Json& op, const std::string& slot, int i = 0) {
+inline std::string In(const Json& op, const std::string& slot, int i = 0) {
   if (!op.at("inputs").has(slot)) return "";
   const auto& arr = op.at("inputs").at(slot).arr;
   return i < static_cast<int>(arr.size()) ? arr[i].str : "";
 }
-static std::string Out(const Json& op, const std::string& slot, int i = 0) {
+inline std::string Out(const Json& op, const std::string& slot, int i = 0) {
   if (!op.at("outputs").has(slot)) return "";
   const auto& arr = op.at("outputs").at(slot).arr;
   return i < static_cast<int>(arr.size()) ? arr[i].str : "";

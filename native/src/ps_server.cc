@@ -1010,4 +1010,10 @@ void ps_client_destroy(void* h) {
   delete c;
 }
 
+// test/tooling hook: the frame CRC (native_test.cc locks it against the
+// published IEEE check value so both wire ends share one implementation)
+uint32_t ptn_crc32(uint32_t crc, const void* buf, uint64_t n) {
+  return crc32_update(crc, buf, static_cast<size_t>(n));
+}
+
 }  // extern "C"

@@ -193,3 +193,20 @@ def test_pool_auto_growth_and_retry():
     assert c is not None, "retry alloc must pick up the freed block"
     assert _time.time() - t0 < 3.0
     fixed.free(c)
+
+
+def test_native_unit_test_binary():
+    """The assert-based C++ unit-test binary (ref §4.2: per-component
+    gtest files) builds and passes: allocator pools, blocking queue,
+    MultiSlot feed, profiler, wire CRC, PS loopback, JSON reader."""
+    import os
+    import subprocess
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    r = subprocess.run(["make", "native_test"], cwd=native_dir,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([os.path.join(native_dir, "native_test")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL OK" in r.stdout

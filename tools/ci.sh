@@ -9,6 +9,10 @@ echo "== native runtime build =="
 make -C native
 make -C native demo_trainer
 
+echo "== native unit tests (ref *_test.cc gtest suite analog) =="
+make -C native native_test
+./native/native_test
+
 echo "== test suite (8-device CPU mesh) =="
 python -m pytest tests/ -q
 
