@@ -39,8 +39,11 @@
 //   control flow (while + conditional_block over serialized sub-blocks),
 //   dense tensor arrays (array_write/read/length, tensor_array_to_
 //   tensor), gru_unit/lstm_unit steps, beam_search + beam_search_decode
-//   (full While-loop NMT decode artifacts run natively), and the frozen
-//   QAT fake-quant family.  Payloads: f32 + exact int64 + bf16 (u2
+//   (full While-loop NMT decode artifacts run natively), the frozen
+//   QAT fake-quant family, the 3-D/video family (conv3d, pool3d,
+//   conv3d_transpose, trilinear, grid_sampler, temporal_shift), and the
+//   CTR serving set (hash, cvm, data_norm, shard_index,
+//   fused_embedding_seq_pool).  Payloads: f32 + exact int64 + bf16 (u2
 //   view).
 
 #include <algorithm>

@@ -950,3 +950,64 @@ def test_cpp_predictor_serves_video_3d_family(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_predictor_serves_ctr_model(tmp_path):
+    """A CTR serving graph — multi-hash id bucketing, embedding + sum
+    pool, data_norm over trained batch stats, CVM show/click transform,
+    shard_index, fused_embedding_seq_pool — natively with parity (the
+    reference's DeepFM/Wide&Deep deployment family)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    model_dir = str(tmp_path / "ctr_model")
+    B, T = 4, 3
+    rng = np.random.RandomState(71)
+    ids = rng.randint(0, 1 << 20, (B, T, 1)).astype(np.int64)
+    dense = np.abs(rng.randn(B, 6)).astype(np.float32)
+    cvm_in = np.abs(rng.randn(B, 5)).astype(np.float32) + 0.5
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        iv = layers.data("ids", shape=[T, 1], dtype="int64")
+        dv = layers.data("dense", shape=[6], dtype="float32")
+        cv = layers.data("cvm_in", shape=[5], dtype="float32")
+        hashed = layers.hash(iv, hash_size=50, num_hash=2)   # [B,2,1]
+        emb = layers.embedding(hashed, size=[50, 8],
+                               param_attr=fluid.ParamAttr(name="ctr_emb"))
+        from paddle_tpu.layers import sequence as seq
+        pooled = seq.sequence_pool(emb, "sum")               # [B,8]
+        dn = layers.data_norm(dv)
+        cvm_feat = layers.continuous_value_model(
+            cv, cvm=layers.fill_constant(shape=[1, 2], dtype="float32",
+                                         value=1.0), use_cvm=True)
+        sharded = layers.shard_index(iv, index_num=1 << 20, nshards=4,
+                                     shard_id=1)
+        # fused_embedding_seq_pool has no layer wrapper (a fusion-pass
+        # product) — append the op directly
+        helper = LayerHelper("fused_embedding_seq_pool")
+        fsp = helper.create_variable_for_type_inference("float32")
+        helper.append_op("fused_embedding_seq_pool",
+                         inputs={"W": [fluid.default_main_program()
+                                       .global_block().var("ctr_emb")],
+                                 "Ids": [hashed]},
+                         outputs={"Out": [fsp]}, attrs={})
+        feat = layers.concat([pooled, dn, cvm_feat, fsp], axis=1)
+        pred = layers.fc(feat, size=1, act="sigmoid")
+        parts = [pred, layers.cast(sharded, "float32")]
+        flat = [layers.reshape(t_, shape=[1, -1]) for t_ in parts]
+        merged = layers.concat(flat, axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=41)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"ids": ids, "dense": dense, "cvm_in": cvm_in},
+            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["ids", "dense", "cvm_in"], [merged],
+            executor=exe, scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [ids, dense, cvm_in])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
