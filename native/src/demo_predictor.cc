@@ -219,12 +219,402 @@ static void RunSubBlock(const Json& op, Scope* scope) {
   for (const auto& sub : blk.at("ops").arr) RunOp(sub, scope);
 }
 
+// ---- Json builders for rewriting fusion ops onto their base kernels ----
+static Json JStr(const std::string& v) {
+  Json j;
+  j.kind = Json::kStr;
+  j.str = v;
+  return j;
+}
+static Json JArr1(const std::string& v) {
+  Json j;
+  j.kind = Json::kArr;
+  j.arr.push_back(JStr(v));
+  return j;
+}
+
+// Serving-path fusion ops (emitted by the ir.py canonicalization passes;
+// ref operators/fused/*): each delegates to the base interpreters so a
+// POST-pass saved program serves natively too.  Returns false when the
+// type is not a fusion op.
+static bool RunFusedOp(const std::string& type, const Json& op,
+                       Scope* scope) {
+  if (type == "fusion_gru" || type == "fusion_lstm" ||
+      type == "fused_embedding_fc_lstm") {
+    bool is_gru = type == "fusion_gru";
+    // gate projection: x·Wx (or a pre-multiplied table row gather)
+    std::string pname = "__fusion_proj." + Out(op, "Hidden");
+    Tensor& proj = Var(scope, pname);
+    if (type == "fused_embedding_fc_lstm") {
+      const Tensor& tbl = Var(scope, In(op, "Embeddings"));
+      const Tensor& ids = Var(scope, In(op, "Ids"));
+      int64_t V = tbl.shape[0], gd = tbl.shape[1];
+      int64_t b = ids.shape[0], t = ids.numel() / b;
+      proj.Resize({b, t, gd});
+      for (int64_t i = 0; i < b * t; ++i) {
+        int64_t id = ids.i64.empty()
+                         ? static_cast<int64_t>(std::llround(ids.data[i]))
+                         : ids.i64[i];
+        if (id < 0 || id >= V)
+          throw std::runtime_error(
+              "fused_embedding_fc_lstm: id out of range");
+        std::copy(&tbl.data[id * gd], &tbl.data[(id + 1) * gd],
+                  &proj.data[i * gd]);
+      }
+    } else {
+      const Tensor& x = Var(scope, In(op, "X"));        // [b, t, in]
+      const Tensor& wx = Var(scope, In(op, "WeightX"));  // [in, G*d]
+      int64_t b = x.shape[0], t = x.shape[1], in = x.shape[2];
+      int64_t gd = wx.shape[1];
+      proj.Resize({b, t, gd});
+      for (int64_t r = 0; r < b * t; ++r)
+        for (int64_t j = 0; j < gd; ++j) {
+          double acc = 0;
+          for (int64_t k = 0; k < in; ++k)
+            acc += static_cast<double>(x.data[r * in + k]) *
+                   wx.data[k * gd + j];
+          proj.data[r * gd + j] = static_cast<float>(acc);
+        }
+    }
+    Json op2;
+    op2.kind = Json::kObj;
+    op2.obj["type"] = JStr(is_gru ? "gru" : "lstm");
+    Json ins;
+    ins.kind = Json::kObj;
+    ins.obj["Input"] = JArr1(pname);
+    ins.obj["Weight"] = JArr1(In(op, "WeightH"));
+    for (const char* slot : {"Bias", "H0", "C0", "SeqLen"})
+      if (!In(op, slot).empty()) ins.obj[slot] = JArr1(In(op, slot));
+    op2.obj["inputs"] = ins;
+    Json outs;
+    outs.kind = Json::kObj;
+    outs.obj["Hidden"] = JArr1(Out(op, "Hidden"));
+    if (!is_gru) outs.obj["Cell"] = JArr1(Out(op, "Cell"));
+    op2.obj["outputs"] = outs;
+    op2.obj["attrs"] = op.at("attrs");  // recurrence attrs pass through
+    RunOp(op2, scope);
+    return true;
+  }
+  if (type == "conv2d_fusion") {
+    // conv + per-channel bias + (residual) + act (compat_ops.py)
+    std::string tmp = "__fusion_conv." + Out(op, "Output");
+    Json op2;
+    op2.kind = Json::kObj;
+    op2.obj["type"] = JStr("conv2d");
+    Json ins;
+    ins.kind = Json::kObj;
+    ins.obj["Input"] = JArr1(In(op, "Input"));
+    ins.obj["Filter"] = JArr1(In(op, "Filter"));
+    op2.obj["inputs"] = ins;
+    Json outs;
+    outs.kind = Json::kObj;
+    outs.obj["Output"] = JArr1(tmp);
+    op2.obj["outputs"] = outs;
+    op2.obj["attrs"] = op.at("attrs");
+    RunOp(op2, scope);
+    const Tensor& conv = Var(scope, tmp);
+    Tensor& out = Var(scope, Out(op, "Output"));
+    out.Resize(conv.shape);
+    int64_t C = conv.shape[1];
+    int64_t inner = ProdFrom(conv.shape, 2, conv.shape.size());
+    const Tensor* bias =
+        In(op, "Bias").empty() ? nullptr : &Var(scope, In(op, "Bias"));
+    const Tensor* res = In(op, "ResidualData").empty()
+                            ? nullptr
+                            : &Var(scope, In(op, "ResidualData"));
+    std::string act = AttrStr(op, "activation", "relu");
+    enum { kRelu, kSig, kTanh, kId } ak =
+        act == "relu"      ? kRelu
+        : act == "sigmoid" ? kSig
+        : act == "tanh"    ? kTanh
+        : (act == "identity" || act.empty())
+            ? kId
+            : throw std::runtime_error(
+                  "conv2d_fusion: unsupported activation " + act);
+    for (int64_t i = 0; i < conv.numel(); ++i) {
+      float v = conv.data[i];
+      if (bias) v += bias->data[(i / inner) % C];
+      if (res) v += res->data[i];
+      v = ak == kRelu  ? std::max(v, 0.f)
+          : ak == kSig ? 1.f / (1.f + std::exp(-v))
+          : ak == kTanh ? std::tanh(v)
+                        : v;
+      out.data[i] = v;
+    }
+    return true;
+  }
+  if (type == "fused_elemwise_activation") {
+    // unary(binary(x, y)) with functor_list [binary, unary]
+    const Json& fl = op.at("attrs").at("functor_list");
+    std::string binary = fl.arr[0].str, unary = fl.arr[1].str;
+    if (binary != "elementwise_add")
+      throw std::runtime_error("fused_elemwise_activation: functor " +
+                               binary);
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    int64_t axis = static_cast<int64_t>(AttrNum(op, "axis", -1));
+    float sc = static_cast<float>(AttrNum(op, "scale", 1.0));
+    float bi = static_cast<float>(AttrNum(op, "bias", 0.0));
+    bool bas = AttrBool(op, "bias_after_scale", true);
+    enum { uScale, uRelu, uSig, uTanh, uGelu } uk =
+        unary == "scale"     ? uScale
+        : unary == "relu"    ? uRelu
+        : unary == "sigmoid" ? uSig
+        : unary == "tanh"    ? uTanh
+        : unary == "gelu"
+            ? uGelu
+            : throw std::runtime_error(
+                  "fused_elemwise_activation: unary " + unary);
+    Tensor& out = Var(scope, Out(op, "Out"));
+    BroadcastBinary(x, y, axis, &out, [&](float a, float b) -> float {
+      float v = a + b;
+      switch (uk) {
+        case uScale: return bas ? v * sc + bi : (v + bi) * sc;
+        case uRelu: return std::max(v, 0.f);
+        case uSig: return 1.f / (1.f + std::exp(-v));
+        case uTanh: return std::tanh(v);
+        default: return 0.5f * v * (1.f + std::erf(v * 0.70710678f));
+      }
+    });
+    return true;
+  }
+  if (type == "fusion_repeated_fc_relu") {
+    const Json& ws = op.at("inputs").at("W");
+    const Json& bs = op.at("inputs").at("Bias");
+    const Tensor& x0 = Var(scope, In(op, "X"));
+    int64_t b = x0.shape[0];
+    std::vector<float> cur(x0.data);
+    int64_t width = x0.numel() / b;
+    for (size_t i = 0; i < ws.arr.size(); ++i) {
+      const Tensor& w = Var(scope, ws.arr[i].str);
+      const Tensor& bias = Var(scope, bs.arr[i].str);
+      int64_t in = w.shape[0], on = w.shape[1];
+      std::vector<float> nxt(static_cast<size_t>(b * on));
+      for (int64_t r = 0; r < b; ++r)
+        for (int64_t j = 0; j < on; ++j) {
+          double acc = bias.data[j];
+          for (int64_t k = 0; k < in; ++k)
+            acc += static_cast<double>(cur[r * width + k]) *
+                   w.data[k * on + j];
+          // relu between layers AND on the final output (compat_ops.py)
+          nxt[r * on + j] = std::max(static_cast<float>(acc), 0.f);
+        }
+      cur = std::move(nxt);
+      width = on;
+    }
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({b, width});
+    out.data = std::move(cur);
+    return true;
+  }
+  if (type == "fusion_squared_mat_sub") {
+    // scalar · ((XY)² − X²Y²) over 2-D mats
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    float scalar = static_cast<float>(AttrNum(op, "scalar", 1.0));
+    int64_t m = x.shape[0], k = x.shape[1], n = y.shape[1];
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({m, n});
+    for (int64_t i = 0; i < m; ++i)
+      for (int64_t j = 0; j < n; ++j) {
+        double xy = 0, x2y2 = 0;
+        for (int64_t p = 0; p < k; ++p) {
+          double a = x.data[i * k + p], b = y.data[p * n + j];
+          xy += a * b;
+          x2y2 += a * a * b * b;
+        }
+        out.data[i * n + j] =
+            scalar * static_cast<float>(xy * xy - x2y2);
+      }
+    return true;
+  }
+  if (type == "fusion_seqpool_concat" ||
+      type == "fusion_seqpool_cvm_concat") {
+    const Json& xs = op.at("inputs").at("X");
+    std::string ptype = AttrStr(op, "pooltype", "SUM");
+    std::transform(ptype.begin(), ptype.end(), ptype.begin(), ::toupper);
+    enum { kSum, kAvg, kSqrt, kMax, kFirst, kLast } pk =
+        ptype == "AVERAGE" ? kAvg
+        : ptype == "SQRT"  ? kSqrt
+        : ptype == "MAX"   ? kMax
+        : ptype == "FIRST" ? kFirst
+        : ptype == "LAST"  ? kLast
+                           : kSum;
+    const Tensor& x0 = Var(scope, xs.arr[0].str);
+    int64_t b = x0.shape[0];
+    std::vector<std::vector<float>> pooled;
+    int64_t total = 0;
+    for (const auto& nm : xs.arr) {
+      const Tensor& x = Var(scope, nm.str);
+      int64_t t = x.shape[1], d = x.numel() / (b * x.shape[1]);
+      std::vector<float> p(static_cast<size_t>(b * d), 0.f);
+      for (int64_t r = 0; r < b; ++r)
+        for (int64_t c = 0; c < d; ++c) {
+          const float* xi = &x.data[(r * t) * d + c];
+          float v;
+          switch (pk) {
+            case kMax:
+              v = -std::numeric_limits<float>::infinity();
+              for (int64_t s = 0; s < t; ++s) v = std::max(v, xi[s * d]);
+              break;
+            case kFirst: v = xi[0]; break;
+            case kLast: v = xi[(t - 1) * d]; break;
+            default: {
+              double acc = 0;
+              for (int64_t s = 0; s < t; ++s) acc += xi[s * d];
+              v = static_cast<float>(
+                  pk == kAvg    ? acc / t
+                  : pk == kSqrt ? acc / std::sqrt(static_cast<double>(t))
+                                : acc);
+            }
+          }
+          p[r * d + c] = v;
+        }
+      total += d;
+      pooled.push_back(std::move(p));
+    }
+    Tensor cat;
+    cat.Resize({b, total});
+    int64_t col = 0;
+    for (const auto& p : pooled) {
+      int64_t d = static_cast<int64_t>(p.size()) / b;
+      for (int64_t r = 0; r < b; ++r)
+        std::copy(&p[r * d], &p[(r + 1) * d], &cat.data[r * total + col]);
+      col += d;
+    }
+    if (type == "fusion_seqpool_cvm_concat") {
+      // delegates to the cvm semantics incl. use_cvm=False stripping
+      // (compat_ops.py _fusion_seqpool_cvm_concat → _cvm)
+      bool use_cvm = AttrBool(op, "use_cvm", true);
+      Tensor& out = Var(scope, Out(op, "Out"));
+      out.Resize({b, use_cvm ? total : total - 2});
+      for (int64_t r = 0; r < b; ++r) {
+        const float* xi = &cat.data[r * total];
+        float* oi = &out.data[r * (use_cvm ? total : total - 2)];
+        if (use_cvm) {
+          float show = std::log(xi[0] + 1.f);
+          oi[0] = show;
+          oi[1] = std::log(xi[1] + 1.f) - show;
+          std::copy(xi + 2, xi + total, oi + 2);
+        } else {
+          std::copy(xi + 2, xi + total, oi);
+        }
+      }
+    } else {
+      Var(scope, Out(op, "Out")) = std::move(cat);
+    }
+    return true;
+  }
+  if (type == "fusion_transpose_flatten_concat") {
+    const Json& xs = op.at("inputs").at("X");
+    std::vector<int64_t> perm = AttrInts(op, "trans_axis");
+    if (perm.empty()) perm = {0, 2, 3, 1};
+    const Tensor& x0 = Var(scope, xs.arr[0].str);
+    int64_t b = x0.shape[0];
+    std::vector<std::vector<float>> flats;
+    int64_t total = 0;
+    for (const auto& nm : xs.arr) {
+      const Tensor& x = Var(scope, nm.str);
+      size_t r = x.shape.size();
+      std::vector<int64_t> oshape(r), xstr(r, 1), ostr(r, 1);
+      for (size_t i = 0; i < r; ++i) oshape[i] = x.shape[perm[i]];
+      for (int i = static_cast<int>(r) - 2; i >= 0; --i) {
+        xstr[i] = xstr[i + 1] * x.shape[i + 1];
+        ostr[i] = ostr[i + 1] * oshape[i + 1];
+      }
+      std::vector<float> f(static_cast<size_t>(x.numel()));
+      for (int64_t i = 0; i < x.numel(); ++i) {
+        int64_t rem = i, off = 0;
+        for (size_t dgt = 0; dgt < r; ++dgt) {
+          int64_t idx = rem / ostr[dgt];
+          rem %= ostr[dgt];
+          off += idx * xstr[perm[dgt]];
+        }
+        f[i] = x.data[off];
+      }
+      total += x.numel() / b;
+      flats.push_back(std::move(f));
+    }
+    // concat_axis 0 stacks the flattened [b, d] mats by rows; any other
+    // axis concatenates features (compat_ops.py: axis if axis < 2 else 1)
+    int64_t cax = static_cast<int64_t>(AttrNum(op, "concat_axis", 1));
+    Tensor out_t;
+    if (cax == 0) {
+      int64_t d0 = static_cast<int64_t>(flats[0].size()) / b;
+      out_t.Resize({b * static_cast<int64_t>(flats.size()), d0});
+      int64_t row = 0;
+      for (const auto& f : flats) {
+        if (static_cast<int64_t>(f.size()) != b * d0)
+          throw std::runtime_error(
+              "fusion_transpose_flatten_concat: axis-0 concat needs "
+              "equal flattened widths");
+        std::copy(f.begin(), f.end(), &out_t.data[row * d0]);
+        row += b;
+      }
+    } else {
+      out_t.Resize({b, total});
+      int64_t col = 0;
+      for (const auto& f : flats) {
+        int64_t d = static_cast<int64_t>(f.size()) / b;
+        for (int64_t r = 0; r < b; ++r)
+          std::copy(&f[r * d], &f[(r + 1) * d],
+                    &out_t.data[r * total + col]);
+        col += d;
+      }
+    }
+    Var(scope, Out(op, "Out")) = std::move(out_t);
+    return true;
+  }
+  if (type == "fused_fc_elementwise_layernorm") {
+    const Tensor& x = Var(scope, In(op, "X"));
+    const Tensor& w = Var(scope, In(op, "W"));
+    const Tensor& y = Var(scope, In(op, "Y"));
+    const Tensor* b0 =
+        In(op, "Bias0").empty() ? nullptr : &Var(scope, In(op, "Bias0"));
+    const Tensor* sc =
+        In(op, "Scale").empty() ? nullptr : &Var(scope, In(op, "Scale"));
+    const Tensor* b1 =
+        In(op, "Bias1").empty() ? nullptr : &Var(scope, In(op, "Bias1"));
+    float eps = static_cast<float>(AttrNum(op, "epsilon", 1e-5));
+    int64_t b = x.shape[0];
+    int64_t in = x.numel() / b, on = w.shape[1];
+    Tensor& out = Var(scope, Out(op, "Out"));
+    out.Resize({b, on});
+    std::vector<double> h(on);
+    for (int64_t r = 0; r < b; ++r) {
+      for (int64_t j = 0; j < on; ++j) {
+        double acc = b0 ? b0->data[j] : 0.0;
+        for (int64_t k = 0; k < in; ++k)
+          acc += static_cast<double>(x.data[r * in + k]) *
+                 w.data[k * on + j];
+        h[j] = acc + y.data[r * on + j];
+      }
+      double mu = 0;
+      for (double v : h) mu += v;
+      mu /= on;
+      double var = 0;
+      for (double v : h) var += (v - mu) * (v - mu);
+      var /= on;
+      double inv = 1.0 / std::sqrt(var + eps);
+      for (int64_t j = 0; j < on; ++j) {
+        float v = static_cast<float>((h[j] - mu) * inv);
+        if (sc) v *= sc->data[j];
+        if (b1) v += b1->data[j];
+        out.data[r * on + j] = v;
+      }
+    }
+    return true;
+  }
+  return false;
+}
+
 static void RunOp(const Json& op, Scope* scope) {
   const std::string& type = op.at("type").str;
 
   if (type == "feed" || type == "fetch") {
     return;  // feeds pre-placed in the scope; fetches read afterwards
   }
+  if (RunFusedOp(type, op, scope)) return;
   if (type == "while") {
     // ref while_op.cc RunImpl: re-run the sub-block until Condition goes
     // false; the flat scope carries the loop state across iterations
@@ -1039,19 +1429,23 @@ static void RunOp(const Json& op, Scope* scope) {
               acc += h[dd] * w.data[dd * gd + j];
             hw[j] = acc;
           }
-          std::vector<float> u(d), r(d);
+          std::vector<float> u(d), r(d), h_new(d);
           for (int64_t j = 0; j < d; ++j) {
             u[j] = sigmoid(xt[j] + hw[j]);
             r[j] = sigmoid(xt[d + j] + hw[d + j]);
           }
+          // the candidate reads the WHOLE previous h — update into a
+          // fresh buffer, not in place (h[0] must stay old while j=1's
+          // (r·h)@w_c sum runs)
           for (int64_t j = 0; j < d; ++j) {
             float acc = xt[2 * d + j];
             for (int64_t dd = 0; dd < d; ++dd)
               acc += (r[dd] * h[dd]) * w.data[dd * gd + 2 * d + j];
             float cand = std::tanh(acc);
-            h[j] = origin ? u[j] * h[j] + (1 - u[j]) * cand
-                          : (1 - u[j]) * h[j] + u[j] * cand;
+            h_new[j] = origin ? u[j] * h[j] + (1 - u[j]) * cand
+                              : (1 - u[j]) * h[j] + u[j] * cand;
           }
+          h = h_new;
         } else {
           for (int64_t j = 0; j < gd; ++j) {
             float acc = xt[j];

@@ -1011,3 +1011,57 @@ def test_cpp_predictor_serves_ctr_model(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_serves_post_pass_program(tmp_path):
+    """A program CANONICALIZED by the serving fusion passes (fc+gru →
+    fusion_gru, conv+bias+act → conv2d_fusion, add+act →
+    fused_elemwise_activation) also serves natively — the optimized form,
+    not just the raw artifact (ref naive_executor runs both)."""
+    from paddle_tpu.framework import ir
+    from paddle_tpu.layers import compat as rnn
+
+    model_dir = str(tmp_path / "fused_model")
+    rng = np.random.RandomState(73)
+    xv = rng.randn(2, 5, 6).astype(np.float32)
+    iv = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[5, 6], dtype="float32")
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        proj = layers.fc(x, size=3 * 4, num_flatten_dims=2)
+        hid = rnn.dynamic_gru(proj, size=4)
+        conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                             act="relu")
+        ga = layers.gelu(layers.reduce_mean(conv, dim=[2, 3]) +
+                         layers.reduce_mean(hid, dim=[1]))
+        merged = layers.concat(
+            [layers.reshape(hid, shape=[1, -1]),
+             layers.reshape(ga, shape=[1, -1])], axis=1)
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=43)
+        keep = frozenset([merged.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("conv_elementwise_add_act_fuse_pass",
+                        protected=keep).apply(g)
+        g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
+        g = ir.get_pass("fc_gru_fuse_pass", protected=keep,
+                        scope=scope).apply(g)
+        g = ir.get_pass("fuse_elewise_add_act_pass",
+                        protected=keep).apply(g)
+        fused = g.to_program()
+        types = [op.type for op in fused.global_block().ops]
+        assert "fusion_gru" in types and "conv2d_fusion" in types
+        assert "fused_elemwise_activation" in types
+        expected, = exe.run(fused, feed={"x": xv, "img": iv},
+                            fetch_list=[merged.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "img"], [merged],
+                                      executor=exe, main_program=fused,
+                                      scope=scope)
+
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv, iv])
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
