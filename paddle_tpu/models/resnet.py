@@ -8,7 +8,8 @@ from ..param_attr import ParamAttr
 
 
 def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
-                  act=None, name=None, is_test=False):
+                  act=None, name=None, is_test=False,
+                  use_global_stats=False):
     conv = layers.conv2d(input, num_filters=num_filters,
                          filter_size=filter_size, stride=stride,
                          padding=(filter_size - 1) // 2, groups=groups,
@@ -18,36 +19,46 @@ def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
                              param_attr=ParamAttr(name=f"{name}.bn.scale"),
                              bias_attr=ParamAttr(name=f"{name}.bn.offset"),
                              moving_mean_name=f"{name}.bn.mean",
-                             moving_variance_name=f"{name}.bn.var")
+                             moving_variance_name=f"{name}.bn.var",
+                             use_global_stats=use_global_stats)
 
 
-def shortcut(input, ch_out, stride, name, is_test=False):
+def shortcut(input, ch_out, stride, name, is_test=False,
+             use_global_stats=False):
     ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, name=name,
-                             is_test=is_test)
+                             is_test=is_test,
+                             use_global_stats=use_global_stats)
     return input
 
 
-def bottleneck_block(input, num_filters, stride, name, is_test=False):
+def bottleneck_block(input, num_filters, stride, name, is_test=False,
+                     use_global_stats=False):
+    ugs = use_global_stats
     conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
-                          name=f"{name}.b0", is_test=is_test)
+                          name=f"{name}.b0", is_test=is_test,
+                          use_global_stats=ugs)
     conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
-                          name=f"{name}.b1", is_test=is_test)
+                          name=f"{name}.b1", is_test=is_test,
+                          use_global_stats=ugs)
     conv2 = conv_bn_layer(conv1, num_filters * 4, 1, name=f"{name}.b2",
-                          is_test=is_test)
+                          is_test=is_test, use_global_stats=ugs)
     short = shortcut(input, num_filters * 4, stride, f"{name}.short",
-                     is_test=is_test)
+                     is_test=is_test, use_global_stats=ugs)
     return layers.relu(short + conv2)
 
 
-def basic_block(input, num_filters, stride, name, is_test=False):
+def basic_block(input, num_filters, stride, name, is_test=False,
+                use_global_stats=False):
+    ugs = use_global_stats
     conv0 = conv_bn_layer(input, num_filters, 3, stride=stride, act="relu",
-                          name=f"{name}.b0", is_test=is_test)
+                          name=f"{name}.b0", is_test=is_test,
+                          use_global_stats=ugs)
     conv1 = conv_bn_layer(conv0, num_filters, 3, name=f"{name}.b1",
-                          is_test=is_test)
+                          is_test=is_test, use_global_stats=ugs)
     short = shortcut(input, num_filters, stride, f"{name}.short",
-                     is_test=is_test)
+                     is_test=is_test, use_global_stats=ugs)
     return layers.relu(short + conv1)
 
 
@@ -72,25 +83,30 @@ def space_to_depth_nchw(img, block=2):
     return out.reshape(b, c * block * block, h // block, w // block)
 
 
-def resnet(input, class_dim=1000, depth=50, is_test=False, s2d_stem=False):
+def resnet(input, class_dim=1000, depth=50, is_test=False, s2d_stem=False,
+           use_global_stats=False):
     block_fn, counts = _DEPTH_CFG[depth]
+    ugs = use_global_stats
     if s2d_stem:
         # input is the space-to-depth image [12,112,112]; a 3×3/s1 conv
         # here sees a 6×6 receptive field in the original image (vs the
         # 7×7/s2 stem) and produces the same [64,112,112] output — the
         # standard TPU reparameterization of the ResNet stem
         conv = conv_bn_layer(input, 64, 3, stride=1, act="relu",
-                             name="stem", is_test=is_test)
+                             name="stem", is_test=is_test,
+                             use_global_stats=ugs)
     else:
         conv = conv_bn_layer(input, 64, 7, stride=2, act="relu",
-                             name="stem", is_test=is_test)
+                             name="stem", is_test=is_test,
+                             use_global_stats=ugs)
     pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
     filters = [64, 128, 256, 512]
     x = pool
     for stage, (nf, cnt) in enumerate(zip(filters, counts)):
         for blk in range(cnt):
             stride = 2 if blk == 0 and stage > 0 else 1
-            x = block_fn(x, nf, stride, f"res{stage}_{blk}", is_test=is_test)
+            x = block_fn(x, nf, stride, f"res{stage}_{blk}", is_test=is_test,
+                         use_global_stats=ugs)
     pool = layers.pool2d(x, global_pooling=True, pool_type="avg")
     return layers.fc(pool, size=class_dim, act="softmax",
                      param_attr=ParamAttr(name="fc_out.w"),
@@ -98,13 +114,14 @@ def resnet(input, class_dim=1000, depth=50, is_test=False, s2d_stem=False):
 
 
 def build_resnet_train(class_dim=1000, depth=50, image_shape=(3, 224, 224),
-                       is_test=False, s2d_stem=False):
+                       is_test=False, s2d_stem=False, use_global_stats=False):
     if s2d_stem:
         c, h, w = image_shape
         image_shape = (c * 4, h // 2, w // 2)
     img = layers.data("image", shape=list(image_shape), dtype="float32")
     label = layers.data("label", shape=[1], dtype="int64")
-    pred = resnet(img, class_dim, depth, is_test=is_test, s2d_stem=s2d_stem)
+    pred = resnet(img, class_dim, depth, is_test=is_test, s2d_stem=s2d_stem,
+                  use_global_stats=use_global_stats)
     cost = layers.cross_entropy(pred, label)
     avg_cost = layers.mean(cost)
     acc1 = layers.accuracy(pred, label, k=1)
