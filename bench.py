@@ -572,22 +572,24 @@ def bench_deepfm_ps():
 def main():
     dev, on_tpu, peak = _device_info()
     benches = [
-        lambda: bench_resnet50(dev, on_tpu, peak),
-        lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True),
-        lambda: bench_bert_long(dev, on_tpu, peak),
-        lambda: bench_transformer_wmt(dev, on_tpu, peak),
-        bench_deepfm_ps,
-        lambda: bench_gpt_causal(dev, on_tpu, peak),
-        lambda: bench_bert_masked(dev, on_tpu, peak),
+        ("resnet50", lambda: bench_resnet50(dev, on_tpu, peak)),
+        ("resnet50_frozen_bn",
+         lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True)),
+        ("bert_long", lambda: bench_bert_long(dev, on_tpu, peak)),
+        ("transformer_wmt", lambda: bench_transformer_wmt(dev, on_tpu, peak)),
+        ("deepfm_ps", bench_deepfm_ps),
+        ("gpt_causal", lambda: bench_gpt_causal(dev, on_tpu, peak)),
+        ("bert_masked", lambda: bench_bert_masked(dev, on_tpu, peak)),
         # flagship metric printed last among the verbose lines
-        lambda: bench_bert(dev, on_tpu, peak),
+        ("bert", lambda: bench_bert(dev, on_tpu, peak)),
     ]
-    for b in benches:
+    for name, b in benches:
         try:
             b()
         except Exception as e:  # one broken line must not kill the rest
-            emit({"metric": "bench_error", "value": 0, "unit": "error",
-                  "vs_baseline": 0, "error": repr(e)[:300]})
+            emit({"metric": f"bench_error:{name}", "value": 0,
+                  "unit": "error", "vs_baseline": 0,
+                  "error": repr(e)[:300]})
     # FINAL line: compact all-metrics summary (metric/value/vs_baseline
     # only).  The driver's tail capture lost 3 of 10 verbose lines in
     # round 4; this one line carries every measurement and survives any
