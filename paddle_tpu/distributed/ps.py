@@ -739,14 +739,38 @@ class Communicator:
 class GeoCommunicator:
     """geo-SGD (ref distribute_transpiler geo_sgd_mode + communicator_py):
     train locally; every ``push_nums`` steps push param deltas (server adds
-    them: SGD with lr=-1 on -delta ≡ +=delta) and pull the merged params."""
+    them: SGD with lr=-1 on -delta ≡ +=delta) and pull the merged params.
 
-    def __init__(self, transpiler: DistributeTranspiler, scope=None):
+    Two properties make geo the *cheapest* PS mode (its purpose — ref
+    geo_sgd_communicator.cc runs send/recv in background threads over
+    recorded sparse ids):
+
+    - **row recording**: the trainer reports the table rows each batch fed
+      via :meth:`record_rows`; at a push boundary only those rows are
+      diffed/pushed — no full-table delta scan.  Without recording, a
+      sparse table falls back to the scan (and, when a local *dense*
+      optimizer such as Adam has drifted ≥ half the rows, to one dense
+      round trip — cheaper than per-row applies at that density).
+    - **async round trips** (``async_push=True``): the TCP push/pull runs
+      in a background thread; the boundary step only gathers deltas and
+      applies the previous round's merged rows.  Local updates made while
+      a round is in flight are preserved (``new = fresh + (cur − cur@push)``)
+      and re-pushed next round — the documented geo staleness window is
+      ≤ one push interval.
+    """
+
+    def __init__(self, transpiler: DistributeTranspiler, scope=None,
+                 async_push: bool = False):
         self.t = transpiler
         self.scope = scope or global_scope()
         self.push_nums = transpiler.config.geo_sgd_need_push_nums
+        self.async_push = async_push
         self._step = 0
         self._snapshots: Dict[str, np.ndarray] = {}
+        self._touched: Dict[str, List[np.ndarray]] = {}
+        self._worker: Optional[threading.Thread] = None
+        self._worker_exc: Optional[BaseException] = None
+        self._inflight: List[dict] = []
 
     def init_snapshots(self):
         for pname, spec in self.t._param_specs.items():
@@ -755,44 +779,135 @@ class GeoCommunicator:
             # seed the server with the initial value
             get_client(self.t._param_eps[pname]).put(pname, v.ravel())
 
+    def record_rows(self, pname: str, rows) -> None:
+        """Report the rows of sparse table ``pname`` fed this step (ref
+        geo_sgd_communicator.cc sparse-id recording from the send queue).
+        Deltas are then computed only on recorded rows at the boundary."""
+        if pname not in self.t._param_specs:
+            raise KeyError(
+                f"record_rows({pname!r}): not a transpiled parameter "
+                f"(known: {sorted(self.t._param_specs)})")
+        self._touched.setdefault(pname, []).append(
+            np.asarray(rows, np.int64).ravel())
+
     def step(self):
         self._step += 1
         if self._step % self.push_nums:
             return
+        self._join_and_apply()             # previous round (async mode)
+        work = self._collect_deltas()
+        if not work:
+            return
+        if self.async_push:
+            def _run():
+                try:
+                    self._round_trip(work)
+                except BaseException as e:   # surfaced at the next join
+                    self._worker_exc = e
+            self._worker = threading.Thread(target=_run, daemon=True)
+            self._worker.start()
+        else:
+            self._round_trip(work)
+            self._join_and_apply()
+
+    def flush(self):
+        """Drain the in-flight round and push any remaining local delta
+        synchronously (call once at the end of training)."""
+        self._join_and_apply()
+        work = self._collect_deltas()
+        if work:
+            self._round_trip(work)
+            self._join_and_apply()
+
+    # -- boundary phases (all scope access happens on the caller's thread;
+    #    the worker only moves bytes) --------------------------------------
+
+    def _collect_deltas(self) -> List[dict]:
+        work = []
+        n = self.t.trainer_num
         for pname, ep in self.t._param_eps.items():
             spec = self.t._param_specs[pname]
             cur = np.asarray(self.scope.find_var(pname), np.float32)
-            delta = (cur - self._snapshots[pname]) / self.t.trainer_num
-            cli = get_client(ep)
+            snap = self._snapshots[pname]
+            recorded = self._touched.pop(pname, None)
             if spec.get("rows") and cur.ndim == 2:
-                # SPARSE geo (the reference's geo_sgd_mode proper,
-                # geo_sgd_communicator.cc): only rows this trainer touched
-                # since the last sync have nonzero deltas — push those
-                # rows and pull back just their merged values.  Untouched
-                # rows keep the local copy (and their snapshot), so their
-                # delta keeps accumulating; rows other trainers changed
-                # converge when this trainer next touches them — the
-                # documented geo approximation.  At CTR sparsity this is
-                # ~batch·slots rows instead of the whole table.  A HOT
-                # interval (≥ half the rows touched) falls through to the
-                # dense path: row ids + per-row applies + row pulls cost
-                # more than one dense round trip.
-                changed = np.flatnonzero(np.abs(delta).max(axis=1) > 0)
-                if changed.size == 0:
+                if recorded is not None:
+                    rows = np.unique(np.concatenate(recorded))
+                else:
+                    # no recording: full scan ((cur != snap).any is ~3×
+                    # cheaper than abs(delta).max and allocates no temp)
+                    rows = np.flatnonzero((cur != snap).any(axis=1))
+                if rows.size == 0:
                     continue
-                if changed.size * 2 < cur.shape[0]:
-                    cli.push_sparse(pname, changed,
-                                    (-delta[changed]).astype(np.float32))
-                    fresh = np.asarray(
-                        cli.get_rows(pname, changed, width=cur.shape[1]),
+                if rows.size * 2 < cur.shape[0]:
+                    cur_rows = cur[rows].astype(np.float32, copy=True)
+                    delta = (cur_rows - snap[rows]) / n
+                    work.append({"pname": pname, "ep": ep, "rows": rows,
+                                 "cur_at_push": cur_rows, "delta": delta,
+                                 "width": cur.shape[1]})
+                    continue
+            # dense param — or a HOT sparse interval (≥ half the rows
+            # moved: one dense round trip beats per-row applies)
+            delta = (cur - snap) / n
+            work.append({"pname": pname, "ep": ep, "rows": None,
+                         "cur_at_push": cur.copy(), "delta": delta,
+                         "spec": spec})
+        return work
+
+    def _round_trip(self, work: List[dict]) -> None:
+        # append each param as it completes (not all-at-once at the end):
+        # on a mid-list failure the already-pushed params are applied —
+        # and their snapshots advanced — at the next join, so a caller
+        # that survives the raised error cannot re-push a delta the
+        # server has already merged
+        for w in work:
+            cli = get_client(w["ep"])
+            if w["rows"] is not None:
+                cli.push_sparse(w["pname"], w["rows"],
+                                (-w["delta"]).astype(np.float32))
+                w["fresh"] = np.asarray(
+                    cli.get_rows(w["pname"], w["rows"], width=w["width"]),
+                    np.float32)
+            else:
+                cli.push_dense(w["pname"], -w["delta"].ravel())
+                fresh = cli.get(w["pname"], w["spec"]["size"], barrier=False)
+                w["fresh"] = fresh.reshape(w["spec"]["shape"]).astype(
+                    np.float32)
+            self._inflight.append(w)
+
+    def _join_and_apply(self) -> None:
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        exc, self._worker_exc = self._worker_exc, None
+        # apply whatever completed BEFORE surfacing a failure: those
+        # deltas are already merged server-side, so their snapshots must
+        # advance or a surviving caller would push them twice
+        work, self._inflight = self._inflight, []
+        for w in work:
+            pname = w["pname"]
+            cur = np.asarray(self.scope.find_var(pname), np.float32)
+            if w["rows"] is not None:
+                rows, fresh = w["rows"], w["fresh"]
+                new = np.array(cur, np.float32)       # writable copy
+                if self.async_push:
+                    # merged rows + local drift made while the round was
+                    # in flight (drift is still unpushed: snapshot :=
+                    # fresh keeps it in the next round's delta)
+                    new[rows] = fresh + (cur[rows] - w["cur_at_push"])
+                else:
+                    # synchronous boundary: no steps ran since the push,
+                    # drift is structurally zero — assign exactly
+                    new[rows] = fresh
+                self._snapshots[pname][rows] = fresh
+                self.scope.set_var(pname, new)
+            else:
+                if self.async_push:
+                    new = (w["fresh"] + (cur - w["cur_at_push"])).astype(
                         np.float32)
-                    cur = cur.copy()
-                    cur[changed] = fresh
-                    self.scope.set_var(pname, cur)
-                    self._snapshots[pname][changed] = fresh
-                    continue
-            cli.push_dense(pname, -delta.ravel())   # server lr=1 → +=delta
-            fresh = cli.get(pname, spec["size"], barrier=False)
-            fresh = fresh.reshape(spec["shape"]).astype(np.float32)
-            self.scope.set_var(pname, fresh)
-            self._snapshots[pname] = fresh.copy()
+                else:
+                    new = w["fresh"]
+                self._snapshots[pname] = w["fresh"].copy()
+                self.scope.set_var(pname, new)
+        if exc is not None:
+            raise RuntimeError("geo background push/pull failed") from exc

@@ -742,3 +742,139 @@ def test_transport_crc_rejects_corrupt_frame():
     finally:
         server.stop()
         server.destroy()
+
+
+def _geo_toy(port, push_nums=2, lr=0.1):
+    """Tiny embedding+fc geo setup shared by the round-5 communicator
+    tests; returns (exe, trainer_prog, loss, transpiler, server_thread)."""
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=[8, 4], is_sparse=True,
+                           param_attr=pt.ParamAttr(name="geo_emb"))
+    pred = layers.fc(layers.reduce_sum(emb, dim=[1]), size=1,
+                     bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(
+        pred, layers.fill_constant([1, 1], "float32", 1.0)))
+    opt.SGD(learning_rate=lr).minimize(loss)
+    cfg = DistributeTranspilerConfig(geo_sgd_mode=True,
+                                     geo_sgd_need_push_nums=push_nums,
+                                     sync_mode=False)
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers=f"127.0.0.1:{port}", trainers=1)
+    pserver_prog, pserver_startup = t.get_pserver_programs(
+        f"127.0.0.1:{port}")
+    trainer_prog = t.get_trainer_program()
+    exe = Executor()
+    exe.run(pserver_startup)
+    srv = threading.Thread(target=exe.run, args=(pserver_prog,),
+                           daemon=True)
+    srv.start()
+    time.sleep(0.2)
+    exe.run(pt.default_startup_program())
+    return exe, trainer_prog, loss, t, srv
+
+
+def test_geo_recorded_rows_push_only_those_rows():
+    """record_rows replaces the full-table delta scan: only recorded rows
+    are pushed; rows the local optimizer never touched keep their seeded
+    server value (ref geo_sgd_communicator.cc sparse-id recording)."""
+    from paddle_tpu.framework import core
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.core import program_guard
+    with scope_guard(Scope()), program_guard(core.Program(), core.Program()):
+        port = _free_port()
+        exe, trainer_prog, loss, t, srv = _geo_toy(port)
+        geo = GeoCommunicator(t)
+        geo.init_snapshots()
+        init_table = np.asarray(
+            pt.global_scope().find_var("geo_emb"), np.float32).copy()
+
+        feed_ids = np.array([[1], [3], [1], [6]], np.int64)
+        for _ in range(4):                    # 2 push intervals
+            exe.run(trainer_prog, feed={"ids": feed_ids},
+                    fetch_list=[loss])
+            geo.record_rows("geo_emb", feed_ids.ravel())
+            geo.step()
+        local = np.asarray(pt.global_scope().find_var("geo_emb"),
+                           np.float32)
+        srv_rows = np.asarray(ps_mod.get_client(
+            f"127.0.0.1:{port}").get_rows("geo_emb", list(range(8)),
+                                          width=4))
+        touched, untouched = [1, 3, 6], [0, 2, 4, 5, 7]
+        np.testing.assert_allclose(srv_rows[touched], local[touched],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(srv_rows[untouched],
+                                   init_table[untouched], rtol=1e-6)
+        assert np.abs(local[touched] - init_table[touched]).max() > 1e-5
+        ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+        srv.join(timeout=5)
+
+
+def test_geo_async_push_converges_and_flushes():
+    """async_push=True: round trips run on a background thread, local
+    drift made while a round is in flight is preserved, and flush()
+    drains the last interval so the server holds every pushed delta."""
+    from paddle_tpu.framework import core
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.core import program_guard
+    with scope_guard(Scope()), program_guard(core.Program(), core.Program()):
+        port = _free_port()
+        exe, trainer_prog, loss, t, srv = _geo_toy(port)
+        geo = GeoCommunicator(t, async_push=True)
+        geo.init_snapshots()
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(12):
+            feed_ids = rng.randint(0, 8, (4, 1)).astype(np.int64)
+            lv, = exe.run(trainer_prog, feed={"ids": feed_ids},
+                          fetch_list=[loss])
+            geo.record_rows("geo_emb", feed_ids.ravel())
+            geo.step()
+            losses.append(float(np.asarray(lv)))
+        geo.flush()
+        assert losses[-1] < losses[0]          # training converges
+        # after flush, server == local on every param (no interval left
+        # in flight, snapshots == server state)
+        for pname, spec in t._param_specs.items():
+            local = np.asarray(pt.global_scope().find_var(pname),
+                               np.float32).ravel()
+            srv_v = ps_mod.get_client(f"127.0.0.1:{port}").get(
+                pname, spec["size"], barrier=False)
+            np.testing.assert_allclose(srv_v, local, rtol=1e-5,
+                                       atol=1e-6)
+        ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+        srv.join(timeout=5)
+
+
+def test_geo_worker_error_surfaces_at_join():
+    """A failed background round trip must raise at the next boundary,
+    not vanish into the thread (silent grad loss)."""
+    from paddle_tpu.framework import core
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.core import program_guard
+    with scope_guard(Scope()), program_guard(core.Program(), core.Program()):
+        port = _free_port()
+        exe, trainer_prog, loss, t, srv = _geo_toy(port)
+        geo = GeoCommunicator(t, async_push=True)
+        geo.init_snapshots()
+        feed_ids = np.array([[1], [2]], np.int64)
+        for _ in range(2):                     # first boundary: push ok
+            exe.run(trainer_prog, feed={"ids": feed_ids},
+                    fetch_list=[loss])
+            geo.record_rows("geo_emb", feed_ids.ravel())
+            geo.step()
+        # drain the in-flight worker first: stop_server/reset_clients on
+        # a handle the worker is mid-RPC on would be a use-after-free
+        if geo._worker is not None:
+            geo._worker.join()
+        # kill the server, then force another boundary: the background
+        # push fails and the NEXT join must raise
+        ps_mod.get_client(f"127.0.0.1:{port}").stop_server()
+        srv.join(timeout=5)
+        ps_mod.reset_clients()
+        with pytest.raises(RuntimeError, match="geo background"):
+            for _ in range(4):
+                exe.run(trainer_prog, feed={"ids": feed_ids},
+                        fetch_list=[loss])
+                geo.record_rows("geo_emb", feed_ids.ravel())
+                geo.step()
+            geo.flush()
