@@ -27,27 +27,33 @@ class Scope:
             self._vars[name] = None
         return self._vars.get(name)
 
-    def find_var(self, name: str):
+    def _owning_scope(self, name: str) -> Optional["Scope"]:
+        """Nearest scope (self → ancestors) whose dict holds ``name``."""
         s: Optional[Scope] = self
         while s is not None:
             if name in s._vars:
-                return s._vars[name]
+                return s
             s = s.parent
         return None
 
+    def find_var(self, name: str):
+        s = self._owning_scope(name)
+        return s._vars[name] if s is not None else None
+
     def has_var(self, name: str) -> bool:
-        s: Optional[Scope] = self
-        while s is not None:
-            if name in s._vars:
-                return True
-            s = s.parent
-        return False
+        return self._owning_scope(name) is not None
 
     def set_var(self, name: str, value) -> None:
         self._vars[name] = value
 
     def erase(self, name: str) -> None:
-        self._vars.pop(name, None)
+        """Remove ``name`` from the scope that OWNS it (same walk as
+        ``find_var``): callers erase dead params after IR fusion, and a
+        param found through a child scope would otherwise stay resident
+        in the parent — silently defeating the erase."""
+        s = self._owning_scope(name)
+        if s is not None:
+            del s._vars[name]
 
     def new_scope(self) -> "Scope":
         kid = Scope(self)

@@ -141,3 +141,25 @@ def test_clone_for_test_prunes_training_tail():
         l1, = exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
         l2, = exe.run(main, feed=feed, fetch_list=[loss.name], scope=scope)
         assert float(l2) != float(l1)
+
+
+def test_scope_erase_walks_to_owning_scope():
+    """Scope.erase must free the var in the scope that OWNS it (like
+    find_var's parent walk): IR fuse passes erase dead params through a
+    child scope, and popping only the child's dict would leave the param
+    resident in the parent (ADVICE r4)."""
+    from paddle_tpu.framework.scope import Scope
+    parent = Scope()
+    parent.set_var("w", 1.0)
+    child = parent.new_scope()
+    assert child.find_var("w") == 1.0
+    child.erase("w")
+    assert parent.find_var("w") is None
+    assert child.find_var("w") is None
+    # erasing an unknown name stays a no-op
+    child.erase("nope")
+    # a child-local var is erased from the child, not the parent
+    parent.set_var("x", 1)
+    child.set_var("x", 2)
+    child.erase("x")
+    assert child.find_var("x") == 1      # parent's survives the child's

@@ -13,6 +13,12 @@ the same compiled program.
 Ops the sweep cannot meaningfully cover are listed in ``EXCLUDE`` with the
 reason; ``test_audit_accounts_for_every_op`` locks the accounting so a newly
 registered op must either pass the sweep or be excluded explicitly.
+
+Tolerances: the default is ``rtol=1e-2`` (round-5; matches the reference's
+typical per-op ``max_relative_error`` of 5e-3..1e-2).  Ops that genuinely
+need more carry an explicit per-op rtol in ``_configs`` with a comment
+giving the reason (kinked sampling, bf16 MXU kernels, routing flips) —
+the analog of the reference's per-op ``max_relative_error`` overrides.
 """
 
 import numpy as np
@@ -55,7 +61,7 @@ class _Cfg:
     """
 
     def __init__(self, ins, attrs=None, nodiff=(), loss_outputs=None,
-                 rtol=5e-2, atol=8e-3, max_elems=8, eps=EPS):
+                 rtol=1e-2, atol=8e-3, max_elems=8, eps=EPS):
         self.ins = ins
         self.attrs = attrs or {}
         self.nodiff = set(nodiff)
@@ -147,6 +153,9 @@ def _configs(op):
              "BatchSum": [f(3)], "BatchSquareSum": [f(3, lo=5, hi=6)]},
             nodiff={"BatchSize", "BatchSum", "BatchSquareSum"},
             loss_outputs=["Y"]),
+        # deformable convs: bilinear sampling makes the loss kinked at
+        # integer offset crossings — central differences straddle the
+        # kink (ref OpTest sets max_relative_error=0.05 for these too)
         "deformable_conv": lambda: _Cfg(
             {"Input": [f(1, 2, 4, 4)], "Offset": [f(1, 36, 4, 4, lo=-.2,
                                                     hi=.2)],
@@ -178,6 +187,8 @@ def _configs(op):
                           nodiff={"target_tensor"}),
         "fc": lambda: _Cfg({"Input": [f(2, 3)], "W": [f(3, 4)], "Bias": [f(4)]},
                    {"in_num_col_dims": 1}),
+        # Pallas kernel matmuls run bf16 on the MXU: f32 central
+        # differences sample bf16 quantization noise — widen
         "flash_attention": lambda: _Cfg(
             {"Q": [f(1, 2, 8, 4)], "K": [f(1, 2, 8, 4)],
              "V": [f(1, 2, 8, 4)]},
@@ -212,6 +223,8 @@ def _configs(op):
             eps=5e-2, rtol=1.5e-1, atol=5e-2),
         "gather": lambda: _Cfg({"X": [f(5, 3)], "Index": [i(4, n=5)]}, {"axis": 0}),
         "gather_nd": lambda: _Cfg({"X": [f(3, 4)], "Index": [i(2, 2, n=3)]}),
+        # bilinear grid sampling is kinked at cell crossings (same class
+        # as deformable_conv; ref OpTest max_relative_error=0.61 (!))
         "grid_sampler": lambda: _Cfg({"X": [f(1, 2, 4, 4)],
                               "Grid": [f(1, 3, 3, 2, lo=-.7, hi=.7)]},
                              rtol=8e-2, atol=2e-2),
@@ -411,6 +424,8 @@ def _configs(op):
         "strided_slice": lambda: _Cfg({"Input": [f(4, 5)]},
                               {"axes": [0, 1], "starts": [0, 1],
                                "ends": [4, 5], "strides": [2, 2]}),
+        # MoE top-1 routing is piecewise: a perturbed gate weight can
+        # flip token->expert assignment mid-difference
         "switch_ffn": lambda: _Cfg(
             {"X": [f(2, 2, 3)], "GateW": [f(3, 2)], "W1": [f(2, 3, 5)],
              "B1": [f(2, 5)], "W2": [f(2, 5, 3)], "B2": [f(2, 3)]},
@@ -436,6 +451,9 @@ def _configs(op):
                             {"output_channel": 2, "input_channel": 3,
                              "kernel_h": 2, "kernel_w": 2,
                              "stride_h": 1, "stride_w": 1}),
+        # CTC loss: log-sum-exp over alignment paths is steep in the
+        # small-logit regime; f32 forward noise amplifies through the
+        # 1e-2 quotient (ref OpTest relaxes CTC grads likewise)
         "warpctc": lambda: _Cfg(
             {"Logits": [f(2, 4, 5)],
              "Label": [i(2, 3, n=4) + 1],
