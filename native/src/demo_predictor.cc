@@ -41,10 +41,20 @@
 //   tensor), gru_unit/lstm_unit steps, beam_search + beam_search_decode
 //   (full While-loop NMT decode artifacts run natively), the frozen
 //   QAT fake-quant family, the 3-D/video family (conv3d, pool3d,
-//   conv3d_transpose, trilinear, grid_sampler, temporal_shift), and the
+//   conv3d_transpose, trilinear, grid_sampler, temporal_shift), the
 //   CTR serving set (hash, cvm, data_norm, shard_index,
-//   fused_embedding_seq_pool).  Payloads: f32 + exact int64 + bf16 (u2
-//   view).
+//   fused_embedding_seq_pool), and the round-5 tail
+//   (predictor_ops_tail.inc): ctc_align greedy decode + warpctc loss,
+//   roi_pool/psroi_pool/prroi_pool, the sequence tail
+//   (conv/pad/unpad/slice/scatter/erase/enumerate), row_conv, lstmp,
+//   var_conv_2d, match_matrix_tensor, hierarchical_sigmoid,
+//   deformable_conv v2/v1, fused fc, serving scorers (cross_entropy,
+//   softmax_with_cross_entropy, sigmoid CE, accuracy, mean) and tensor
+//   utilities (scatter/scatter_nd_add/multiplex/label_smooth/crop/
+//   pad_constant_like/diag/linspace/fill/assign_value).  The exact
+//   not-served boundary vs SURVEY Appendix A is machine-checked by
+//   tests/test_demo_predictor.py::test_native_serving_boundary_is_exact.
+//   Payloads: f32 + exact int64 + bf16 (u2 view).
 
 #include <algorithm>
 #include <chrono>
@@ -232,6 +242,10 @@ static Json JArr1(const std::string& v) {
   j.arr.push_back(JStr(v));
   return j;
 }
+
+// Round-5 serving tail (CTC decode/loss, roi_pool family, sequence tail,
+// lstmp, deformable conv, hsigmoid) — tried after RunOpWide.
+#include "predictor_ops_tail.inc"
 
 // Serving-path fusion ops (emitted by the ir.py canonicalization passes;
 // ref operators/fused/*): each delegates to the base interpreters so a
@@ -703,6 +717,8 @@ static void RunOp(const Json& op, Scope* scope) {
   } else if (type == "elementwise_add" || type == "elementwise_sub" ||
              type == "elementwise_mul" || type == "elementwise_div" ||
              type == "elementwise_max" || type == "elementwise_min" ||
+             type == "elementwise_mod" ||
+             type == "elementwise_floordiv" || type == "minus" ||
              type == "elementwise_pow") {
     // fluid broadcast: Y's shape aligns with X[axis : axis+Y.ndim]
     // (axis=-1 → trailing), and size-1 dims of Y broadcast (numpy
@@ -715,10 +731,15 @@ static void RunOp(const Json& op, Scope* scope) {
     BroadcastBinary(x, y, axis, &out, [&](float a, float b) -> float {
       return type == "elementwise_add"   ? a + b
              : type == "elementwise_sub" ? a - b
+             : type == "minus"          ? a - b
              : type == "elementwise_mul" ? a * b
              : type == "elementwise_div" ? a / b
              : type == "elementwise_max" ? std::max(a, b)
              : type == "elementwise_min" ? std::min(a, b)
+             // jnp.mod / floor_divide semantics (sign follows divisor)
+             : type == "elementwise_mod"
+                 ? a - b * std::floor(a / b)
+             : type == "elementwise_floordiv" ? std::floor(a / b)
                                          : std::pow(a, b);
     });
   } else if (type == "conv2d" || type == "depthwise_conv2d") {
@@ -832,7 +853,7 @@ static void RunOp(const Json& op, Scope* scope) {
             out.data[((b * C + c) * Ho + i) * Wo + j] =
                 static_cast<float>(acc);
           }
-  } else if (type == "batch_norm") {
+  } else if (type == "batch_norm" || type == "sync_batch_norm") {
     // inference form: y = (x - mean)·rsqrt(var+eps)·scale + bias
     const Tensor& x = Var(scope, In(op, "X"));
     const Tensor& scale = Var(scope, In(op, "Scale"));
@@ -1030,7 +1051,7 @@ static void RunOp(const Json& op, Scope* scope) {
       col += tax;
     }
     Var(scope, Out(op, "Out")) = std::move(out_t);
-  } else if (type == "split") {
+  } else if (type == "split" || type == "split_byref") {
     const Tensor& x = Var(scope, In(op, "X"));
     int64_t ax = static_cast<int64_t>(AttrNum(op, "axis", 0));
     if (ax < 0) ax += static_cast<int64_t>(x.shape.size());
@@ -1536,7 +1557,7 @@ static void RunOp(const Json& op, Scope* scope) {
     const Tensor& bboxes = Var(scope, In(op, "BBoxes"));   // [b, m, 4]
     const Tensor& sc = Var(scope, In(op, "Scores"));       // [b, c, m]
     MulticlassNMSCore(bboxes, sc, op, scope);
-  } else if (!RunOpWide(type, op, scope)) {
+  } else if (!RunOpWide(type, op, scope) && !RunOpTail(type, op, scope)) {
     throw std::runtime_error("demo_predictor: unsupported op '" + type +
                              "' — extend RunOp for this model");
   }

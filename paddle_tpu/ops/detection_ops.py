@@ -787,6 +787,13 @@ def _bilinear(feat, y, x):
 
 @register_op("roi_align")
 def _roi_align(ctx, ins, attrs):
+    """ref roi_align_op.cc.  Known divergence: for ``sampling_ratio<=0``
+    the reference samples adaptively (ceil(roi_size/pooled) per bin, a
+    data-dependent count) while this lowering pins s=2 — XLA requires
+    static shapes, so the adaptive count cannot be traced.  The native
+    predictor mirrors the same fixed s=2, keeping Python/native parity;
+    artifacts from reference-trained models that relied on the adaptive
+    default can differ numerically at coarse bins."""
     x = X(ins, "X")                     # [b, c, h, w]
     rois = X(ins, "ROIs")               # [n, 4]
     roi_batch = X(ins, "RoisNum")     # [n] image index (dense LoD analog)

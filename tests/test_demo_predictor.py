@@ -1065,3 +1065,541 @@ def test_cpp_predictor_serves_post_pass_program(tmp_path):
     expected = np.asarray(expected)
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_predictor_serves_ctc_speech_family(tmp_path):
+    """Speech serving tail (round-5; VERDICT r4 missing #1): sequence_conv
+    + row_conv features, lstmp (projection LSTM) encoder, CTC greedy
+    decode (ctc_align) — plus the warpctc loss as a served scorer — all
+    native, parity-locked against the Python executor."""
+    rng = np.random.RandomState(7)
+    b, t, d, nclass = 2, 6, 4, 5
+    xv = rng.randn(b, t, d).astype(np.float32)
+    xlen = np.array([6, 4], np.int64)
+    lab = rng.randint(1, nclass, (b, 3)).astype(np.int64)
+    lablen = np.array([3, 2], np.int64)
+
+    # decode artifact: features -> lstmp -> logits -> greedy ctc decode
+    model_dir = str(tmp_path / "ctc_decode")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[t, d], dtype="float32")
+        ln = layers.data("xlen", shape=[1], dtype="int64")
+        feat = layers.sequence_conv(x, num_filters=8, filter_size=3)
+        feat = layers.row_conv(feat, future_context_size=2)
+        pre = layers.fc(feat, size=4 * 6, num_flatten_dims=2)
+        proj, cell = layers.dynamic_lstmp(pre, size=4 * 6, proj_size=5,
+                                          use_peepholes=True)
+        logits = layers.fc(proj, size=nclass, num_flatten_dims=2)
+        decoded, dec_len = layers.ctc_greedy_decoder(logits, blank=0,
+                                                     input_length=ln)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=5)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv, "xlen": xlen},
+                            fetch_list=[decoded.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "xlen"], [decoded],
+                                      executor=exe, scope=scope)
+    got = _run_native(_build_binary(), model_dir, tmp_path, [xv, xlen])
+    np.testing.assert_array_equal(got.astype(np.int64),
+                                  np.asarray(expected).astype(np.int64))
+
+    # loss artifact: warpctc as a served scorer (log-domain forward algo)
+    model_dir = str(tmp_path / "ctc_loss")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        lg = layers.data("logits", shape=[t, nclass], dtype="float32")
+        label = layers.data("label", shape=[3], dtype="int64")
+        ln = layers.data("xlen", shape=[1], dtype="int64")
+        ll = layers.data("lablen", shape=[1], dtype="int64")
+        loss = layers.warpctc(lg, label, blank=0, input_length=ln,
+                              label_length=ll)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        logits_v = rng.randn(b, t, nclass).astype(np.float32)
+        expected, = exe.run(
+            fluid.default_main_program(),
+            feed={"logits": logits_v, "label": lab, "xlen": xlen,
+                  "lablen": lablen},
+            fetch_list=[loss.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["logits", "label", "xlen", "lablen"], [loss],
+            executor=exe, scope=scope)
+    got = _run_native(_build_binary(), model_dir, tmp_path,
+                      [logits_v, lab, xlen, lablen])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cpp_predictor_serves_roi_pool_family(tmp_path):
+    """roi_pool (max bins), psroi_pool (position-sensitive avg) and
+    prroi_pool (dense-sampled align) served natively (round-5 tail)."""
+    rng = np.random.RandomState(11)
+    b, c, h, w = 2, 4, 8, 8
+    ph = pw = 2
+    xv = rng.randn(b, c, h, w).astype(np.float32)
+    xps = rng.randn(b, 2 * ph * pw, h, w).astype(np.float32)
+    rois_v = np.array([[1, 1, 5, 5], [0, 2, 6, 7], [2, 0, 7, 4]],
+                      np.float32)
+    rnum = np.array([2, 1], np.int64)
+    binary = _build_binary()
+
+    for kind in ("roi_pool", "psroi_pool", "prroi_pool"):
+        model_dir = str(tmp_path / kind)
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            inp_shape = [2 * ph * pw, h, w] if kind == "psroi_pool" \
+                else [c, h, w]
+            x = layers.data("x", shape=inp_shape, dtype="float32")
+            rois = layers.data("rois", shape=[4], dtype="float32")
+            rn = layers.data("rnum", shape=[1], dtype="int64")
+            if kind == "roi_pool":
+                out = layers.roi_pool(x, rois, pooled_height=ph,
+                                      pooled_width=pw, spatial_scale=0.5,
+                                      rois_num=rn)
+            elif kind == "psroi_pool":
+                out = layers.psroi_pool(x, rois, output_channels=2,
+                                        spatial_scale=0.5,
+                                        pooled_height=ph, pooled_width=pw,
+                                        rois_num=rn)
+            else:
+                out = layers.prroi_pool(x, rois, spatial_scale=0.5,
+                                        pooled_height=ph, pooled_width=pw,
+                                        rois_num=rn)
+            exe = Executor()
+            exe.run(fluid.default_startup_program(), scope=scope)
+            feed_x = xps if kind == "psroi_pool" else xv
+            expected, = exe.run(
+                fluid.default_main_program(),
+                feed={"x": feed_x, "rois": rois_v, "rnum": rnum},
+                fetch_list=[out.name], scope=scope)
+            fluid.io.save_inference_model(
+                model_dir, ["x", "rois", "rnum"], [out], executor=exe,
+                scope=scope)
+        got = _run_native(binary, model_dir, tmp_path,
+                          [feed_x, rois_v, rnum])
+        expected = np.asarray(expected)
+        assert got.shape == expected.shape, kind
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5,
+                                   err_msg=kind)
+
+
+def test_cpp_predictor_sequence_tail_and_text_match(tmp_path):
+    """The sequence serving tail (pad/unpad/slice/scatter) and the text-
+    match family (match_matrix_tensor, var_conv_2d) native-parity."""
+    rng = np.random.RandomState(13)
+    binary = _build_binary()
+
+    # float chain: pad -> unpad(mask) -> slice + scatter
+    b, t, d = 2, 5, 3
+    xv = rng.randn(b, t, d).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    offs = np.array([1, 0], np.int64)
+    slens = np.array([3, 3], np.int64)
+    base = rng.randn(b, 6).astype(np.float32)
+    ids = np.array([[0, 2, 2], [1, 5, 3]], np.int64)
+    upd = rng.randn(b, 3).astype(np.float32)
+    model_dir = str(tmp_path / "seq_tail")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[t, d], dtype="float32")
+        ln = layers.data("len", shape=[1], dtype="int64")
+        off = layers.data("off", shape=[1], dtype="int64")
+        sl = layers.data("slen", shape=[1], dtype="int64")
+        bs = layers.data("base", shape=[6], dtype="float32")
+        idv = layers.data("ids", shape=[3], dtype="int64")
+        up = layers.data("upd", shape=[3], dtype="float32")
+        pad_v = layers.fill_constant([1], "float32", 0.0)
+        padded, plen = layers.sequence_pad(x, pad_v)
+        unp = layers.sequence_unpad(padded, ln)
+        sliced = layers.sequence_slice(unp, off, sl)
+        scat = layers.sequence_scatter(bs, idv, up)
+        flat = layers.concat([layers.reshape(sliced, shape=[b, -1]),
+                              scat], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"x": xv, "len": lens, "off": offs, "slen": slens,
+                "base": base, "ids": ids, "upd": upd}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["x", "len", "off", "slen", "base", "ids", "upd"],
+            [flat], executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [xv, lens, offs, slens, base, ids, upd])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+    # int chain: erase tokens then enumerate windows
+    iv = np.array([[3, 1, 3, 0, 2], [2, 2, 1, 4, 0]], np.int64)
+    model_dir = str(tmp_path / "seq_int")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        from paddle_tpu.layer_helper import LayerHelper
+        xi = layers.data("xi", shape=[5], dtype="int64")
+        helper = LayerHelper("sequence_erase")
+        erased = helper.create_variable_for_type_inference("int64")
+        helper.append_op("sequence_erase", inputs={"X": [xi]},
+                         outputs={"Out": [erased]},
+                         attrs={"tokens": [1, 4]})
+        enum = layers.sequence_enumerate(erased, win_size=2, pad_value=9)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(fluid.default_main_program(), feed={"xi": iv},
+                            fetch_list=[enum.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["xi"], [enum],
+                                      executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path, [iv])
+    np.testing.assert_array_equal(got.astype(np.int64),
+                                  np.asarray(expected).astype(np.int64))
+
+    # text match: match_matrix_tensor + var_conv_2d head
+    bx, tx, ty, dd = 2, 4, 3, 5
+    xv2 = rng.randn(bx, tx, dd).astype(np.float32)
+    yv2 = rng.randn(bx, ty, dd).astype(np.float32)
+    model_dir = str(tmp_path / "text_match")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[tx, dd], dtype="float32")
+        y = layers.data("y", shape=[ty, dd], dtype="float32")
+        mm, _tmp = layers.match_matrix_tensor(x, y, channel_num=3)
+        vc = layers.var_conv_2d(mm, None, None, input_channel=3,
+                                output_channel=2, filter_size=3)
+        out = layers.reduce_sum(vc, dim=[2, 3])
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=3)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv2, "y": yv2},
+                            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "y"], [out],
+                                      executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path, [xv2, yv2])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cpp_predictor_serves_deformable_and_hsigmoid(tmp_path):
+    """deformable_conv v2/v1 (learned-offset bilinear taps) and
+    hierarchical_sigmoid served natively (round-5 tail)."""
+    rng = np.random.RandomState(17)
+    binary = _build_binary()
+    n, c, h, w = 2, 3, 6, 6
+    kh = kw = 3
+    xv = rng.randn(n, c, h, w).astype(np.float32)
+    offv = (rng.randn(n, 2 * kh * kw, h, w) * 0.4).astype(np.float32)
+    maskv = rng.rand(n, kh * kw, h, w).astype(np.float32)
+
+    for modulated in (True, False):
+        model_dir = str(tmp_path / f"dcn_{modulated}")
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x = layers.data("x", shape=[c, h, w], dtype="float32")
+            off = layers.data("off", shape=[2 * kh * kw, h, w],
+                              dtype="float32")
+            mask = layers.data("mask", shape=[kh * kw, h, w],
+                               dtype="float32")
+            out = layers.deformable_conv(
+                x, off, mask if modulated else None, num_filters=4,
+                filter_size=3, padding=1, modulated=modulated)
+            exe = Executor()
+            exe.run(fluid.default_startup_program(), scope=scope, seed=9)
+            feeds = {"x": xv, "off": offv}
+            names = ["x", "off"]
+            arrs = [xv, offv]
+            if modulated:
+                feeds["mask"] = maskv
+                names.append("mask")
+                arrs.append(maskv)
+            expected, = exe.run(fluid.default_main_program(), feed=feeds,
+                                fetch_list=[out.name], scope=scope)
+            fluid.io.save_inference_model(model_dir, names, [out],
+                                          executor=exe, scope=scope)
+        got = _run_native(binary, model_dir, tmp_path, arrs)
+        np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                                   atol=1e-4)
+
+    # hierarchical sigmoid scorer
+    model_dir = str(tmp_path / "hsig")
+    bb, dd, ncls = 4, 6, 7
+    xv2 = rng.randn(bb, dd).astype(np.float32)
+    lv2 = rng.randint(0, ncls, (bb, 1)).astype(np.int64)
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[dd], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        out = layers.hsigmoid(x, lab, num_classes=ncls)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=2)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv2, "lab": lv2},
+                            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "lab"], [out],
+                                      executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path, [xv2, lv2])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cpp_predictor_serves_scorer_family(tmp_path):
+    """Served scorers/eval heads (round-5 tranche 2): a post-fc_fuse_pass
+    `fc` op, softmax_with_cross_entropy, sigmoid CE, cross_entropy,
+    accuracy and mean — native parity."""
+    from paddle_tpu.framework import ir
+    rng = np.random.RandomState(29)
+    binary = _build_binary()
+    b, d, c = 4, 6, 5
+    xv = rng.randn(b, d).astype(np.float32)
+    lv = rng.randint(0, c, (b, 1)).astype(np.int64)
+
+    model_dir = str(tmp_path / "scorer")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[d], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=c, act="relu")     # fuses to one fc op
+        loss, sm = layers.softmax_with_cross_entropy(
+            logits, lab, return_softmax=True)
+        ce = layers.cross_entropy(sm, lab)
+        bce = layers.sigmoid_cross_entropy_with_logits(
+            logits, layers.cast(layers.one_hot(lab, c), "float32"))
+        topk_v, topk_i = layers.topk(sm, k=2)
+        acc = layers.accuracy(sm, lab, k=2)
+        m = layers.mean(bce)
+        flat = layers.concat(
+            [loss, ce, layers.reshape(bce, shape=[b, c]),
+             layers.expand(layers.reshape(acc, shape=[1, 1]),
+                           expand_times=[b, 1]),
+             layers.expand(layers.reshape(m, shape=[1, 1]),
+                           expand_times=[b, 1])], axis=1)
+        prog = fluid.default_main_program().clone(for_test=True)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=31)
+        keep = frozenset([flat.name])
+        g = ir.Graph(prog)
+        g = ir.get_pass("fc_fuse_pass", protected=keep).apply(g)
+        fused = g.to_program()
+        assert "fc" in [op.type for op in fused.global_block().ops]
+        expected, = exe.run(fused, feed={"x": xv, "lab": lv},
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x", "lab"], [flat],
+                                      executor=exe, main_program=fused,
+                                      scope=scope)
+    got = _run_native(binary, model_dir, tmp_path, [xv, lv])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cpp_predictor_serves_tensor_utility_tail(tmp_path):
+    """Tensor-utility tail (round-5 tranche 2): scatter, scatter_nd_add,
+    multiplex, label_smooth, crop, pad_constant_like, diag, linspace,
+    lod_reset passthrough, sequence_reshape — native parity."""
+    rng = np.random.RandomState(37)
+    binary = _build_binary()
+    b = 4
+    xv = rng.randn(6, 3).astype(np.float32)
+    ids = np.array([1, 4, 1], np.int64)
+    upd = rng.randn(3, 3).astype(np.float32)
+    nd_idx = np.array([[0, 1], [2, 0], [0, 1]], np.int64)
+    nd_upd = rng.randn(3).astype(np.float32)
+    mxa = rng.randn(b, 3).astype(np.float32)
+    mxb = rng.randn(b, 3).astype(np.float32)
+    sel = np.array([[0], [1], [1], [0]], np.int64)
+    smooth_in = rng.rand(b, 5).astype(np.float32)
+    crop_in = rng.randn(4, 5).astype(np.float32)
+    pad_y = rng.randn(2, 3).astype(np.float32)
+
+    model_dir = str(tmp_path / "tensor_tail2")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        from paddle_tpu.layer_helper import LayerHelper
+        x = layers.data("x", shape=[6, 3], dtype="float32",
+                        append_batch_size=False)
+        idv = layers.data("ids", shape=[3], dtype="int64",
+                          append_batch_size=False)
+        up = layers.data("upd", shape=[3, 3], dtype="float32",
+                         append_batch_size=False)
+        ndi = layers.data("ndi", shape=[3, 2], dtype="int64",
+                          append_batch_size=False)
+        ndu = layers.data("ndu", shape=[3], dtype="float32",
+                          append_batch_size=False)
+        ma = layers.data("ma", shape=[3], dtype="float32")
+        mb = layers.data("mb", shape=[3], dtype="float32")
+        sl = layers.data("sel", shape=[1], dtype="int64")
+        sm_in = layers.data("smooth", shape=[5], dtype="float32")
+        cr_in = layers.data("crop", shape=[4, 5], dtype="float32",
+                            append_batch_size=False)
+        pd_y = layers.data("pady", shape=[2, 3], dtype="float32",
+                           append_batch_size=False)
+
+        sc = layers.scatter(x, idv, up, overwrite=False)
+        snd = layers.scatter_nd_add(sc, ndi, ndu)
+        mx = layers.multiplex([ma, mb], sl)
+        ls = layers.label_smooth(sm_in, epsilon=0.1)
+        cr = layers.crop_tensor(cr_in, shape=[2, 3], offsets=[1, 2])
+        pcl = layers.pad_constant_like(cr_in, pd_y, pad_value=0.5)
+        helper = LayerHelper("diag")
+        dg = helper.create_variable_for_type_inference("float32")
+        helper.append_op("diag", inputs={"Diagonal": [idv]},
+                         outputs={"Out": [dg]})
+        lr = layers.lod_reset(snd, None)
+        sr = layers.sequence_reshape(layers.reshape(mx, shape=[b, 3, 1]),
+                                     new_dim=3)
+        flat = layers.concat(
+            [layers.reshape(lr, shape=[1, -1]),
+             layers.reshape(mx, shape=[1, -1]),
+             layers.reshape(ls, shape=[1, -1]),
+             layers.reshape(cr, shape=[1, -1]),
+             layers.reshape(pcl, shape=[1, -1]),
+             layers.reshape(layers.cast(dg, "float32"), shape=[1, -1]),
+             layers.reshape(sr, shape=[1, -1])], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"x": xv, "ids": ids, "upd": upd, "ndi": nd_idx,
+                "ndu": nd_upd, "ma": mxa, "mb": mxb, "sel": sel,
+                "smooth": smooth_in, "crop": crop_in, "pady": pad_y}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir,
+            ["x", "ids", "upd", "ndi", "ndu", "ma", "mb", "sel",
+             "smooth", "crop", "pady"], [flat], executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [xv, ids, upd, nd_idx, nd_upd, mxa, mxb, sel,
+                       smooth_in, crop_in, pad_y])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Serving-boundary lock (round-5 VERDICT ask #5): the native predictor's op
+# surface is diffed against SURVEY.md Appendix A, and every Appendix-A op
+# that is NOT served must appear below with a reason — the serving analog
+# of tests/test_compat_ops.py::test_registry_covers_appendix_a.  A newly
+# registered/served op that changes the boundary fails this test until the
+# documentation here is updated (ref bar: naive_executor.cc runs the whole
+# registry; this documents exactly where the native interpreter stops).
+# --------------------------------------------------------------------------
+
+NOT_SERVED = {
+    "optimizer update (training-only; the native PS server applies these "
+    "server-side in ps_server.cc, they never appear in a saved inference "
+    "artifact)": {
+        "adadelta", "adagrad", "adam", "adamax", "decayed_adagrad", "dgc",
+        "dgc_clip_by_norm", "ftrl", "lamb", "lars_momentum", "momentum",
+        "proximal_adagrad", "proximal_gd", "rmsprop", "sgd",
+        "average_accumulates", "clip_by_norm", "coalesce_tensor",
+    },
+    "collective / distributed-plane op (trainer/pserver runtime; the "
+    "native serving path is single-process)": {
+        "allreduce", "broadcast", "c_allgather", "c_allreduce_max",
+        "c_allreduce_min", "c_allreduce_prod", "c_allreduce_sum",
+        "c_broadcast", "c_comm_init", "c_comm_init_all", "c_gen_nccl_id",
+        "c_reducescatter", "c_sync_calc_stream", "c_sync_comm_stream",
+        "gen_nccl_id", "nccl", "recv", "send", "send_barrier",
+        "fetch_barrier", "listen_and_serv", "fl_listen_and_serv",
+        "checkpoint_notify", "prefetch", "distributed_lookup_table",
+        "lookup_sparse_table", "split_ids", "merge_ids",
+        "ref_by_trainer_id", "pull_box_sparse", "push_box_sparse",
+        "fake_init",
+    },
+    "training loss / metric with no serving form (the scorer heads that DO "
+    "serve are implemented: warpctc, cross_entropy, "
+    "softmax_with_cross_entropy, sigmoid CE, accuracy, mean)": {
+        "bpr_loss", "center_loss", "cos_sim", "hinge_loss", "huber_loss",
+        "kldiv_loss", "log_loss", "margin_rank_loss",
+        "modified_huber_loss", "rank_loss", "sigmoid_focal_loss",
+        "smooth_l1_loss", "squared_l2_distance", "squared_l2_norm",
+        "teacher_student_sigmoid_loss", "l1_norm", "auc", "chunk_eval",
+        "detection_map", "mean_iou", "positive_negative_pair",
+        "precision_recall", "yolov3_loss", "linear_chain_crf", "fsp",
+        "bilinear_tensor_product", "add_position_encoding",
+    },
+    "rng-sampling op (draws from the executor's seeded rng; native "
+    "decode-time parity with a traced rng stream is not reproducible)": {
+        "gaussian_random", "gaussian_random_batch_size_like",
+        "uniform_random", "uniform_random_batch_size_like",
+        "truncated_gaussian_random", "random_crop", "sampling_id",
+        "sample_logits", "nce",
+    },
+    "detection training-side target assignment / label generation "
+    "(consumed by losses during training, not by served heads)": {
+        "bipartite_match", "generate_mask_labels",
+        "generate_proposal_labels", "rpn_target_assign",
+        "retinanet_target_assign", "target_assign", "mine_hard_examples",
+    },
+    "host / engine / io infrastructure (executor- or Python-level "
+    "plumbing, or engines the TPU stack replaces with XLA)": {
+        "anakin_engine", "tensorrt_engine", "ngraph_engine", "py_func",
+        "print", "get_places", "read", "create_custom_reader",
+        "delete_var", "load", "load_combine", "save", "save_combine",
+        "quantize", "dequantize", "requantize",
+        "fake_channel_wise_dequantize_max_abs",
+        "fake_channel_wise_quantize_abs_max",
+        "get_tensor_from_selected_rows", "merge_selected_rows",
+        "split_selected_rows", "recurrent", "rnn_memory_helper",
+        "shrink_rnn_memory", "reorder_lod_tensor_by_rank",
+        "split_lod_tensor", "merge_lod_tensor", "merge_lod_tensor_infer",
+        "lod_rank_table", "max_sequence_len",
+    },
+    "inference op not yet served (honest residual: a model containing one "
+    "fails loudly with the unsupported-op error rather than serving "
+    "garbage)": {
+        "affine_grid", "attention_lstm", "box_decoder_and_assign",
+        "collect_fpn_proposals", "conv2d_inception_fusion", "conv_shift",
+        "cudnn_lstm", "deformable_psroi_pooling", "density_prior_box",
+        "distribute_fpn_proposals", "edit_distance", "filter_by_instag",
+        "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
+        "generate_proposals", "im2sequence", "max_pool2d_with_index",
+        "max_pool3d_with_index", "polygon_box_transform",
+        "retinanet_detection_output", "roi_perspective_transform",
+        "sequence_topk_avg_pooling", "similarity_focus", "spectral_norm",
+        "spp", "tree_conv", "unfold", "unique", "unique_with_counts",
+        "unpool",
+    },
+}
+
+
+def _native_served_ops():
+    srcs = ["demo_predictor.cc", "predictor_ops_wide.inc",
+            "predictor_ops_tail.inc"]
+    text = ""
+    for f in srcs:
+        text += open(os.path.join(_NATIVE, "src", f)).read()
+    # \b keeps `x.dtype == "int64"` from leaking "int64" into the set
+    ops = set(re.findall(r'\btype == "([a-z0-9_]+)"', text))
+    return ops
+
+
+def _appendix_a_ops():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(root, "SURVEY.md")).read()
+    m = re.search(r"\*\*Full literal registration list "
+                  r"\(alphabetical\):\*\*\n\n(.*?)\n\n---", text, re.S)
+    names = set()
+    for tok in m.group(1).split():
+        base = re.sub(r"\(\+.*?\)$", "", tok.strip())
+        if base:
+            names.add(base)
+    return {n for n in names if not n.endswith("_grad")}
+
+
+def test_native_serving_boundary_is_exact():
+    served = _native_served_ops()
+    appendix = _appendix_a_ops()
+    documented = set()
+    for reason, ops in NOT_SERVED.items():
+        overlap = documented & ops
+        assert not overlap, f"op in two categories: {sorted(overlap)}"
+        documented |= ops
+    # 1. no stale entries: every documented op is a real Appendix-A op
+    #    that the native predictor really does NOT dispatch
+    ghosts = sorted(documented - appendix)
+    assert not ghosts, f"NOT_SERVED ops not in Appendix A: {ghosts}"
+    stale = sorted(documented & served)
+    assert not stale, (
+        f"ops now served but still documented as not-served: {stale}")
+    # 2. completeness: every Appendix-A op is served or documented
+    unaccounted = sorted(appendix - served - documented)
+    assert not unaccounted, (
+        f"Appendix-A ops neither served natively nor documented in "
+        f"NOT_SERVED: {unaccounted}")
