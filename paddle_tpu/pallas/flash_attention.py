@@ -55,13 +55,78 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
 # Pallas TPU kernel (forward)
 # ---------------------------------------------------------------------------
 
+def _pos_mask(iq, ik, block_q, block_k, causal, offset, tq_real, tk_real,
+              transposed=False):
+    """[bq, bk] (or [bk, bq]) validity mask for one block pair: padding
+    bounds + the causal triangle.  Shared by all four kernels."""
+    import jax.lax as lax
+
+    shape = (block_k, block_q) if transposed else (block_q, block_k)
+    q_axis, k_axis = (1, 0) if transposed else (0, 1)
+    q_pos = iq * block_q + lax.broadcasted_iota(jnp.int32, shape, q_axis)
+    k_pos = ik * block_k + lax.broadcasted_iota(jnp.int32, shape, k_axis)
+    mask = k_pos < tk_real
+    if tq_real is not None:
+        mask = mask & (q_pos < tq_real)
+    if causal:
+        mask = mask & (q_pos + offset >= k_pos)
+    return mask
+
+
+def _block_dispatch(causal, pads, iq, ik, block_q, block_k, offset,
+                    compute, on_dead=None):
+    """The shared live/full block ladder (one definition for all four
+    kernels): unpadded non-causal blocks take the mask-free path;
+    unpadded causal grids run masks only on DIAGONAL blocks (fully-live
+    blocks below the diagonal are mask-free, dead blocks above are
+    skipped); any padding falls back to masked-everywhere.  ``compute``
+    receives masked: bool; ``on_dead`` (optional) must define outputs
+    for skipped causal blocks."""
+    from jax.experimental import pallas as pl
+
+    if not causal and not pads:
+        compute(False)
+        return
+    if causal:
+        live = iq * block_q + block_q - 1 + offset >= ik * block_k
+        if not pads:
+            full = (ik + 1) * block_k - 1 <= iq * block_q + offset
+
+            @pl.when(full)
+            def _():
+                compute(False)
+
+            @pl.when(live & jnp.logical_not(full))
+            def _():
+                compute(True)
+        else:
+            @pl.when(live)
+            def _():
+                compute(True)
+        if on_dead is not None:
+            @pl.when(jnp.logical_not(live))
+            def _():
+                on_dead()
+        return
+    compute(True)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                 acc_sc, m_sc, l_sc, *, sm_scale, causal, block_q, block_k,
-                tk_real, offset):
+                tk_real, offset, pads):
     """One (bh, iq, ik) grid step of online-softmax attention.
 
     Grid iterates ik innermost (sequentially on TPU), so the VMEM scratch
     accumulators carry the running max/denominator across k-blocks.
+
+    At d=64 the per-tile VPU work rivals the MXU time (the round-5
+    skeleton microbench measured the r4 kernel at 1.76x its matmul-only
+    skeleton, tools/attn_shape_ceiling.py), so the tile-wide extras are
+    elided wherever they are statically or block-wise unnecessary:
+    sm_scale is folded into q (a [bq,d] row multiply, not [bq,bk]);
+    padding masks vanish when the sequence divides the blocks (``pads``
+    is a trace-time constant); causal masks run only on DIAGONAL blocks —
+    fully-live blocks below the diagonal take the mask-free path.
     """
     import jax.lax as lax
     from jax.experimental import pallas as pl
@@ -75,24 +140,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    q_pos = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = ik * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
+    def _compute(masked):
+        q = q_ref[0].astype(jnp.float32) * sm_scale
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32)
         if b_ref is not None:
             s = s + b_ref[0].astype(jnp.float32)
-        mask = k_pos < tk_real                       # kv padding
-        if causal:
-            mask = mask & (q_pos + offset >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
-
+        if masked:
+            s = jnp.where(_pos_mask(iq, ik, block_q, block_k, causal,
+                                    offset, None, tk_real), s, NEG_INF)
         m_prev = m_sc[:, :1]                         # (bq, 1)
         l_prev = l_sc[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -106,13 +164,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
-    if causal:
-        # skip fully-masked blocks above the diagonal
-        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
-        def _():
-            _compute()
-    else:
-        _compute()
+    _block_dispatch(causal, pads, iq, ik, block_q, block_k, offset,
+                    _compute)
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -167,7 +220,8 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
         _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                     acc, m, l, sm_scale=sm_scale, causal=causal,
                     block_q=block_q, block_k=block_k,
-                    tk_real=tk_real, offset=offset)
+                    tk_real=tk_real, offset=offset,
+                    pads=tkp != tk_real)
 
     lane = min(_LANE, block_k)
     o, lse = pl.pallas_call(
@@ -199,8 +253,9 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_sc, *, sm_scale, causal, block_q, block_k,
-                   tq_real, tk_real, offset):
-    """Grid (bh, iq, ik): accumulate dq over k-blocks in VMEM scratch."""
+                   tq_real, tk_real, offset, pads):
+    """Grid (bh, iq, ik): accumulate dq over k-blocks in VMEM scratch.
+    Mask/scale elision as in _fwd_kernel (r5 skeleton microbench)."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
@@ -211,50 +266,42 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    q_pos = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = ik * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
+    def _compute(masked):
+        q = q_ref[0].astype(jnp.float32) * sm_scale
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]                             # (bq, 1)
         delta = delta_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
-        mask = (k_pos < tk_real) & (q_pos < tq_real)
-        if causal:
-            mask = mask & (q_pos + offset >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+                            preferred_element_type=jnp.float32)
+        if masked:
+            s = jnp.where(_pos_mask(iq, ik, block_q, block_k, causal,
+                                    offset, tq_real, tk_real), s, NEG_INF)
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        else:
+            p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dq_sc[...] = dq_sc[...] + sm_scale * lax.dot_general(
+        dq_sc[...] = dq_sc[...] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
-        def _():
-            _compute()
-    else:
-        _compute()
+    _block_dispatch(causal, pads, iq, ik, block_q, block_k, offset,
+                    _compute)
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_sc, dv_sc, *, sm_scale, causal,
-                    block_q, block_k, tq_real, tk_real, offset):
+                    block_q, block_k, tq_real, tk_real, offset, pads):
     """Grid (bh, ik, iq): accumulate dk/dv over q-blocks in VMEM scratch
     (transposed tiles: everything is (bk, ·) so the MXU contractions stay
-    tall)."""
+    tall).  Mask/scale elision as in _fwd_kernel (r5 microbench)."""
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
@@ -266,41 +313,36 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    k_pos = ik * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_k, block_q), 0)
-    q_pos = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_k, block_q), 1)
-
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
+    def _compute(masked):
+        # sm_scale folds into q: s_t = k @ (q·scale) and
+        # dk = ds_t @ (q·scale) each carry exactly one scale factor
+        q = q_ref[0].astype(jnp.float32) * sm_scale
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]                             # (1, bq)
         delta = delta_ref[0]
         s_t = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32) * sm_scale
-        mask = (k_pos < tk_real) & (q_pos < tq_real)
-        if causal:
-            mask = mask & (q_pos + offset >= k_pos)
-        s_t = jnp.where(mask, s_t, NEG_INF)
-        p_t = jnp.where(s_t <= NEG_INF / 2, 0.0, jnp.exp(s_t - lse))
+                              preferred_element_type=jnp.float32)
+        if masked:
+            s_t = jnp.where(_pos_mask(iq, ik, block_q, block_k, causal,
+                                      offset, tq_real, tk_real,
+                                      transposed=True), s_t, NEG_INF)
+            p_t = jnp.where(s_t <= NEG_INF / 2, 0.0, jnp.exp(s_t - lse))
+        else:
+            p_t = jnp.exp(s_t - lse)
         dv_sc[...] = dv_sc[...] + lax.dot_general(
             p_t, do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp_t = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                                preferred_element_type=jnp.float32)
         ds_t = p_t * (dp_t - delta)
-        dk_sc[...] = dk_sc[...] + sm_scale * lax.dot_general(
+        dk_sc[...] = dk_sc[...] + lax.dot_general(
             ds_t, q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(iq * block_q + block_q - 1 + offset >= ik * block_k)
-        def _():
-            _compute()
-    else:
-        _compute()
+    _block_dispatch(causal, pads, iq, ik, block_q, block_k, offset,
+                    _compute)
 
     @pl.when(iq == nq - 1)
     def _finalize():
@@ -311,7 +353,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dkp_ref, dvp_ref, dq_sc, *, sm_scale,
                          causal, block_q, block_k, tq_real, tk_real,
-                         offset):
+                         offset, pads):
     """ONE recompute per (i, j) block pair: 5 MXU contractions instead of
     the split kernels' 9 (each pass recomputes S).  Grid (bh, iq, ik) —
     dq accumulates in VMEM scratch over the inner k axis exactly like
@@ -332,56 +374,51 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    q_pos = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = ik * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
+    def _compute(masked):
+        # sm_scale rides on q (one [bq,d] row multiply): s picks it up
+        # through the contraction, and dk = ds @ (q·scale) carries the
+        # single scale factor dk needs; dq takes its factor on the
+        # accumulated [bq,d] block at finalize — no [bq,bk] tile-wide
+        # multiplies remain (the r5 skeleton microbench showed the
+        # VPU tile work rivals the d=64 MXU time)
+        q = q_ref[0].astype(jnp.float32) * sm_scale
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]                             # (bq, 1)
         delta = delta_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
-        mask = (k_pos < tk_real) & (q_pos < tq_real)
-        if causal:
-            mask = mask & (q_pos + offset >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+                            preferred_element_type=jnp.float32)
+        if masked:
+            s = jnp.where(_pos_mask(iq, ik, block_q, block_k, causal,
+                                    offset, tq_real, tk_real), s, NEG_INF)
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        else:
+            p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dq_sc[...] = dq_sc[...] + sm_scale * lax.dot_general(
+        dq_sc[...] = dq_sc[...] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dvp_ref[0, 0] = lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
-        dkp_ref[0, 0] = (sm_scale * lax.dot_general(
+        dkp_ref[0, 0] = lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)).astype(dkp_ref.dtype)
+            preferred_element_type=jnp.float32).astype(dkp_ref.dtype)
 
-    if causal:
-        live = iq * block_q + block_q - 1 + offset >= ik * block_k
+    def _zero_partials():
+        # skipped blocks must still define their partial outputs
+        dkp_ref[0, 0] = jnp.zeros_like(dkp_ref[0, 0])
+        dvp_ref[0, 0] = jnp.zeros_like(dvp_ref[0, 0])
 
-        @pl.when(live)
-        def _():
-            _compute()
-
-        @pl.when(jnp.logical_not(live))
-        def _zero():
-            # skipped blocks must still define their partial outputs
-            dkp_ref[0, 0] = jnp.zeros_like(dkp_ref[0, 0])
-            dvp_ref[0, 0] = jnp.zeros_like(dvp_ref[0, 0])
-    else:
-        _compute()
+    _block_dispatch(causal, pads, iq, ik, block_q, block_k, offset,
+                    _compute, on_dead=_zero_partials)
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_prologue(q, k, v, o, lse, do, block_q, block_k):
@@ -429,7 +466,8 @@ def _flash_bwd_pallas_combined(q, k, v, o, lse, do, causal, sm_scale,
     dq, dkp, dvp = pl.pallas_call(
         functools.partial(_bwd_combined_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+                          tq_real=tq_real, tk_real=tk_real, offset=offset,
+                          pads=tqp != tq_real or tkp != tk_real),
         grid=(bh, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=[
@@ -497,7 +535,8 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale, block_q,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+                          tq_real=tq_real, tk_real=tk_real, offset=offset,
+                          pads=tqp != tq_real or tkp != tk_real),
         grid=(bh, nq, nk),
         in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q,
                   row_spec_q, row_spec_q],
@@ -517,7 +556,8 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          tq_real=tq_real, tk_real=tk_real, offset=offset),
+                          tq_real=tq_real, tk_real=tk_real, offset=offset,
+                          pads=tqp != tq_real or tkp != tk_real),
         grid=(bh, nk, nq),
         in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k,
                   row_spec_k, row_spec_k],

@@ -1,0 +1,99 @@
+"""Round-5 bwd-block lever probe: the combined backward's dk/dv partials
+cost 2·bh·nq·Tk·d·4 B of HBM (nq = Tq/block_q_bwd), so DOUBLING the bwd
+q-block halves the partial traffic.  The r4 sweep stopped at
+block_q_bwd=1024; this probes 2048-wide q-blocks (with narrower k-blocks
+to stay inside VMEM), standalone first, then END-TO-END with the block
+table monkeypatched (the r4 lesson: standalone optima do not transfer).
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python tools/bwd_block_probe.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from _tpu_timing import time_fn_slope  # noqa: E402
+
+
+def standalone(seq, bh, cands, d=64):
+    import jax
+    import importlib
+    FA = importlib.import_module('paddle_tpu.pallas.flash_attention')
+
+    rng = np.random.RandomState(0)
+    q = jax.device_put(rng.randn(1, bh, seq, d).astype(np.float32) * 0.1)
+    k = jax.device_put(rng.randn(1, bh, seq, d).astype(np.float32) * 0.1)
+    v = jax.device_put(rng.randn(1, bh, seq, d).astype(np.float32) * 0.1)
+    bq0, bk0 = FA._FWD_DEFAULTS.get(seq, (512, 1024))
+    out = {}
+    for bqb, bkb in cands:
+        if bqb > seq:
+            continue
+
+        def loss(a, b_, c, _bqb=bqb, _bkb=bkb):
+            return FA.flash_attention(a, b_, c, block_q=bq0, block_k=bk0,
+                                      block_q_bwd=_bqb,
+                                      block_k_bwd=_bkb).sum()
+
+        gfn = jax.grad(loss, argnums=(0, 1, 2))
+
+        def chain(n, a, b_, c):
+            import jax.numpy as jnp
+
+            def body(i, acc):
+                return acc + sum(x.sum() for x in gfn(a + acc * 0, b_, c))
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+        g = jax.jit(chain)
+        try:
+            dt = time_fn_slope(g, q, k, v, iters=(4, 16), n_arg=True)
+        except Exception as e:
+            print(f"  s{seq} bwd {bqb}x{bkb}: FAIL {str(e)[:90]}",
+                  flush=True)
+            continue
+        out[f"{bqb}x{bkb}"] = dt * 1000
+        print(f"  s{seq} bwd {bqb}x{bkb}: {dt*1000:7.2f} ms f+b",
+              flush=True)
+    return out
+
+
+def e2e_with_bwd(seq, batch, bwd):
+    import importlib
+    FA = importlib.import_module('paddle_tpu.pallas.flash_attention')
+    old = dict(FA._BWD_DEFAULTS)
+    try:
+        if bwd is not None:
+            FA._BWD_DEFAULTS[seq] = bwd
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import longctx_ablate
+        return longctx_ablate.e2e(seq, batch, steps=6)
+    finally:
+        FA._BWD_DEFAULTS.clear()
+        FA._BWD_DEFAULTS.update(old)
+
+
+def main():
+    cands = [(1024, 512), (2048, 256), (2048, 512), (2048, 1024)]
+    res = {}
+    for seq, bh in ((8192, 24), (16384, 12), (2048, 96)):
+        print(f"--- standalone f+b seq={seq} bh={bh} ---", flush=True)
+        res[f"standalone_{seq}"] = standalone(seq, bh, cands)
+    print(json.dumps(res), flush=True)
+    # e2e validation of any standalone winner happens via --e2e seq bq bk
+    if "--e2e" in sys.argv:
+        i = sys.argv.index("--e2e")
+        seq = int(sys.argv[i + 1])
+        bwd = (int(sys.argv[i + 2]), int(sys.argv[i + 3]))
+        batch = {2048: 8, 4096: 4, 8192: 2, 16384: 1}[seq]
+        base = e2e_with_bwd(seq, batch, None)
+        new = e2e_with_bwd(seq, batch, bwd)
+        print(json.dumps({"seq": seq, "bwd": bwd,
+                          "e2e_base_ms": base * 1000,
+                          "e2e_new_ms": new * 1000}))
+
+
+if __name__ == "__main__":
+    main()
