@@ -6,7 +6,7 @@ buffer assignment — tools/record_hbm.py).
 Usage (on a chip session):
     PYTHONPATH=/root/repo:$PYTHONPATH python tools/run_tpu_numerics.py
 
-Writes TPU_NUMERICS_r04.json at the repo root: per-test pass/fail, the
+Writes TPU_NUMERICS_r05.json at the repo root: per-test pass/fail, the
 error norms tests record via PADDLE_TPU_NUMERICS_OUT, device identity,
 and the allocator's peak-HBM counters.
 """
@@ -81,7 +81,7 @@ def main():
         "error_norms": norms,
         "hbm_stats": stats,
     }
-    out = os.path.join(ROOT, "TPU_NUMERICS_r04.json")
+    out = os.path.join(ROOT, "TPU_NUMERICS_r05.json")
     with open(out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact, indent=1))
