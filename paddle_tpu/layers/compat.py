@@ -331,10 +331,12 @@ def sequence_scatter(input, index, updates, name=None):
 
 
 def affine_grid(theta, out_shape=None, name=None):
+    # the op's output slot is "Output" (affine_grid_op.cc), not "Out"
     if isinstance(out_shape, Variable):
         return _simple("affine_grid", {"Theta": [theta],
-                                       "OutputShape": [out_shape]})
-    return _simple("affine_grid", {"Theta": [theta]},
+                                       "OutputShape": [out_shape]},
+                       outs=("Output",))
+    return _simple("affine_grid", {"Theta": [theta]}, outs=("Output",),
                    attrs={"output_shape": list(out_shape)})
 
 

@@ -1544,17 +1544,12 @@ NOT_SERVED = {
     "inference op not yet served (honest residual: a model containing one "
     "fails loudly with the unsupported-op error rather than serving "
     "garbage)": {
-        "affine_grid", "attention_lstm", "box_decoder_and_assign",
-        "collect_fpn_proposals", "conv2d_inception_fusion", "conv_shift",
-        "cudnn_lstm", "deformable_psroi_pooling", "density_prior_box",
-        "distribute_fpn_proposals", "edit_distance", "filter_by_instag",
+        "attention_lstm", "conv2d_inception_fusion", "cudnn_lstm",
+        "deformable_psroi_pooling", "filter_by_instag",
         "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
-        "generate_proposals", "im2sequence", "max_pool2d_with_index",
-        "max_pool3d_with_index", "polygon_box_transform",
-        "retinanet_detection_output", "roi_perspective_transform",
-        "sequence_topk_avg_pooling", "similarity_focus", "spectral_norm",
-        "spp", "tree_conv", "unfold", "unique", "unique_with_counts",
-        "unpool",
+        "max_pool3d_with_index", "roi_perspective_transform",
+        "sequence_topk_avg_pooling", "tree_conv", "unique",
+        "unique_with_counts",
     },
 }
 
@@ -1603,3 +1598,262 @@ def test_native_serving_boundary_is_exact():
     assert not unaccounted, (
         f"Appendix-A ops neither served natively nor documented in "
         f"NOT_SERVED: {unaccounted}")
+
+
+def test_cpp_predictor_serves_vision_ocr_eval_tranche(tmp_path):
+    """Round-5 tranche 3: im2sequence/unfold (im2col), max_pool2d_with_
+    index + unpool (segmentation pair), spp, affine_grid, conv_shift,
+    similarity_focus, polygon_box_transform, spectral_norm,
+    edit_distance, box_decoder_and_assign, density_prior_box — native
+    parity against the Python executor."""
+    from paddle_tpu.layer_helper import LayerHelper
+    rng = np.random.RandomState(41)
+    binary = _build_binary()
+
+    # vision stack: unfold/im2sequence + pool-with-index -> unpool + spp
+    xv = rng.randn(2, 3, 8, 8).astype(np.float32)
+    model_dir = str(tmp_path / "vision3")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        uf = layers.unfold(x, kernel_sizes=[3, 3], strides=2, paddings=1)
+        i2s = layers.im2sequence(x, filter_size=2, stride=2)
+        helper = LayerHelper("max_pool2d_with_index")
+        pool = helper.create_variable_for_type_inference("float32")
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op("max_pool2d_with_index", inputs={"X": [x]},
+                         outputs={"Out": [pool], "Mask": [mask]},
+                         attrs={"ksize": [2, 2], "strides": [2, 2],
+                                "paddings": [0, 0]})
+        helper2 = LayerHelper("unpool")
+        unp = helper2.create_variable_for_type_inference("float32")
+        helper2.append_op("unpool", inputs={"X": [pool],
+                                            "Indices": [mask]},
+                          outputs={"Out": [unp]},
+                          attrs={"unpooled_height": 8,
+                                 "unpooled_width": 8})
+        helper3 = LayerHelper("spp")
+        sp = helper3.create_variable_for_type_inference("float32")
+        helper3.append_op("spp", inputs={"X": [x]}, outputs={"Out": [sp]},
+                          attrs={"pyramid_height": 2,
+                                 "pooling_type": "max"})
+        flat = layers.concat(
+            [layers.reshape(uf, shape=[2, -1]),
+             layers.reshape(i2s, shape=[2, -1]),
+             layers.reshape(unp, shape=[2, -1]), sp], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        expected, = exe.run(fluid.default_main_program(),
+                            feed={"x": xv}, fetch_list=[flat.name],
+                            scope=scope)
+        fluid.io.save_inference_model(model_dir, ["x"], [flat],
+                                      executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path, [xv])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+    # OCR/eval: affine_grid + conv_shift + similarity_focus +
+    # polygon_box_transform + spectral_norm + edit_distance
+    theta_v = (rng.randn(2, 2, 3) * 0.3).astype(np.float32)
+    csx = rng.randn(2, 7).astype(np.float32)
+    csy = rng.randn(2, 3).astype(np.float32)
+    sf_in = rng.randn(2, 3, 4, 4).astype(np.float32)
+    pbt_in = rng.randn(1, 4, 3, 3).astype(np.float32)
+    hyp_v = rng.randint(1, 5, (3, 6)).astype(np.int64)
+    ref_v = rng.randint(1, 5, (3, 5)).astype(np.int64)
+    hl_v = np.array([6, 4, 3], np.int64)
+    rl_v = np.array([5, 5, 2], np.int64)
+    model_dir = str(tmp_path / "ocr3")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        th = layers.data("theta", shape=[2, 3], dtype="float32")
+        cx = layers.data("csx", shape=[7], dtype="float32")
+        cy = layers.data("csy", shape=[3], dtype="float32")
+        sf = layers.data("sf", shape=[3, 4, 4], dtype="float32")
+        pb = layers.data("pbt", shape=[4, 3, 3], dtype="float32",
+                         append_batch_size=False)
+        hyp = layers.data("hyp", shape=[6], dtype="int64")
+        ref = layers.data("ref", shape=[5], dtype="int64")
+        hlv = layers.data("hl", shape=[1], dtype="int64")
+        rlv = layers.data("rl", shape=[1], dtype="int64")
+        grid = layers.affine_grid(th, out_shape=[2, 1, 4, 5])
+        helper = LayerHelper("conv_shift")
+        cs = helper.create_variable_for_type_inference("float32")
+        helper.append_op("conv_shift", inputs={"X": [cx], "Y": [cy]},
+                         outputs={"Out": [cs]})
+        sfo = layers.similarity_focus(sf, axis=1, indexes=[0, 2])
+        pbo = layers.polygon_box_transform(pb)
+        w = layers.create_parameter([4, 6], "float32", name="sn_w")
+        sn = layers.spectral_norm(w, dim=0, power_iters=2)
+        ed, _seq = layers.edit_distance(hyp, ref, normalized=True,
+                                        input_length=hlv,
+                                        label_length=rlv)
+        flat = layers.concat(
+            [layers.reshape(grid, shape=[1, -1]),
+             layers.reshape(cs, shape=[1, -1]),
+             layers.reshape(sfo, shape=[1, -1]),
+             layers.reshape(pbo, shape=[1, -1]),
+             layers.reshape(sn, shape=[1, -1]),
+             layers.reshape(ed, shape=[1, -1])], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope, seed=8)
+        feed = {"theta": theta_v, "csx": csx, "csy": csy, "sf": sf_in,
+                "pbt": pbt_in, "hyp": hyp_v, "ref": ref_v, "hl": hl_v,
+                "rl": rl_v}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["theta", "csx", "csy", "sf", "pbt", "hyp",
+                        "ref", "hl", "rl"], [flat], executor=exe,
+            scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [theta_v, csx, csy, sf_in, pbt_in, hyp_v, ref_v,
+                       hl_v, rl_v])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+    # detection decode: box_decoder_and_assign + density_prior_box
+    n, c = 4, 3
+    prior_v = np.abs(rng.rand(n, 4).astype(np.float32)) * 8
+    prior_v[:, 2:] += prior_v[:, :2] + 2
+    pvar_v = np.full((n, 4), 0.1, np.float32)
+    tgt_v = (rng.randn(n, 4 * c) * 0.2).astype(np.float32)
+    sc_v = rng.rand(n, c).astype(np.float32)
+    feat_v = rng.randn(1, 2, 3, 3).astype(np.float32)
+    img_v = rng.randn(1, 3, 12, 12).astype(np.float32)
+    model_dir = str(tmp_path / "det3")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        pr = layers.data("prior", shape=[n, 4], dtype="float32",
+                         append_batch_size=False)
+        pv = layers.data("pvar", shape=[n, 4], dtype="float32",
+                         append_batch_size=False)
+        tg = layers.data("tgt", shape=[n, 4 * c], dtype="float32",
+                         append_batch_size=False)
+        sc = layers.data("sc", shape=[n, c], dtype="float32",
+                         append_batch_size=False)
+        ft = layers.data("feat", shape=[2, 3, 3], dtype="float32")
+        im = layers.data("img", shape=[3, 12, 12], dtype="float32")
+        dec, asg = layers.box_decoder_and_assign(pr, pv, tg, sc, 1)
+        dpb, dpv = layers.density_prior_box(
+            ft, im, densities=[2], fixed_sizes=[4.0],
+            fixed_ratios=[1.0, 2.0], clip=True)
+        flat = layers.concat(
+            [layers.reshape(dec, shape=[1, -1]),
+             layers.reshape(asg, shape=[1, -1]),
+             layers.reshape(dpb, shape=[1, -1]),
+             layers.reshape(dpv, shape=[1, -1])], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"prior": prior_v, "pvar": pvar_v, "tgt": tgt_v,
+                "sc": sc_v, "feat": feat_v, "img": img_v}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["prior", "pvar", "tgt", "sc", "feat", "img"],
+            [flat], executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [prior_v, pvar_v, tgt_v, sc_v, feat_v, img_v])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cpp_predictor_serves_rpn_fpn_family(tmp_path):
+    """Round-5: the two-stage detection proposal machinery — RPN
+    generate_proposals, FPN distribute/collect, retinanet decode+NMS —
+    served natively with parity (the last large detection family)."""
+    rng = np.random.RandomState(53)
+    binary = _build_binary()
+
+    # RPN + FPN chain (an must equal anchor_generator's per-cell count:
+    # 2 sizes x 1 ratio)
+    b, an, h, w = 2, 2, 4, 4
+    sc_v = rng.rand(b, an, h, w).astype(np.float32)
+    dl_v = (rng.randn(b, an * 4, h, w) * 0.2).astype(np.float32)
+    info_v = np.array([[32, 32, 1.0], [32, 32, 1.0]], np.float32)
+    feat_v = rng.randn(b, 2, h, w).astype(np.float32)
+    img_v = rng.randn(b, 3, 32, 32).astype(np.float32)
+    model_dir = str(tmp_path / "rpn_fpn")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        sc = layers.data("sc", shape=[an, h, w], dtype="float32")
+        dl = layers.data("dl", shape=[an * 4, h, w], dtype="float32")
+        info = layers.data("info", shape=[3], dtype="float32")
+        ft = layers.data("feat", shape=[2, h, w], dtype="float32")
+        im = layers.data("img", shape=[3, 32, 32], dtype="float32")
+        anc, var = layers.anchor_generator(
+            ft, anchor_sizes=[8.0, 16.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        rois, probs, rnum = layers.generate_proposals(
+            sc, dl, info, anc, var, pre_nms_top_n=20, post_nms_top_n=8,
+            nms_thresh=0.7, min_size=2.0, return_rois_num=True)
+        r0 = layers.reshape(rois, shape=[-1, 4])     # [b*8, 4]
+        multi, restore = layers.distribute_fpn_proposals(
+            r0, min_level=2, max_level=4, refer_level=3, refer_scale=8)
+        collected = layers.collect_fpn_proposals(
+            multi, [layers.reduce_sum(m, dim=[1], keep_dim=True)
+                    for m in multi],
+            2, 4, post_nms_top_n=10)
+        flat = layers.concat(
+            [layers.reshape(rois, shape=[1, -1]),
+             layers.reshape(probs, shape=[1, -1]),
+             layers.reshape(layers.cast(rnum, "float32"),
+                            shape=[1, -1]),
+             layers.reshape(multi[0] + multi[1] + multi[2],
+                            shape=[1, -1]),
+             layers.reshape(layers.cast(restore, "float32"),
+                            shape=[1, -1]),
+             layers.reshape(collected, shape=[1, -1])], axis=1)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"sc": sc_v, "dl": dl_v, "info": info_v, "feat": feat_v,
+                "img": img_v}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[flat.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["sc", "dl", "info", "feat", "img"], [flat],
+            executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [sc_v, dl_v, info_v, feat_v, img_v])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-4)
+
+    # retinanet decode + NMS
+    C = 3
+    anc1 = (rng.rand(6, 4) * 16).astype(np.float32)
+    anc1[:, 2:] += anc1[:, :2] + 4
+    anc2 = (rng.rand(4, 4) * 16).astype(np.float32)
+    anc2[:, 2:] += anc2[:, :2] + 6
+    d1 = (rng.randn(b, 6, 4) * 0.2).astype(np.float32)
+    d2 = (rng.randn(b, 4, 4) * 0.2).astype(np.float32)
+    s1 = rng.rand(b, 6, C).astype(np.float32)
+    s2 = rng.rand(b, 4, C).astype(np.float32)
+    model_dir = str(tmp_path / "retina")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        a1 = layers.data("a1", shape=[6, 4], dtype="float32",
+                         append_batch_size=False)
+        a2 = layers.data("a2", shape=[4, 4], dtype="float32",
+                         append_batch_size=False)
+        dd1 = layers.data("d1", shape=[6, 4], dtype="float32")
+        dd2 = layers.data("d2", shape=[4, 4], dtype="float32")
+        ss1 = layers.data("s1", shape=[6, C], dtype="float32")
+        ss2 = layers.data("s2", shape=[4, C], dtype="float32")
+        info = layers.data("info", shape=[3], dtype="float32")
+        out = layers.retinanet_detection_output(
+            [dd1, dd2], [ss1, ss2], [a1, a2], info,
+            score_threshold=0.2, nms_top_k=10, keep_top_k=6,
+            nms_threshold=0.4)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"a1": anc1, "a2": anc2, "d1": d1, "d2": d2,
+                "s1": s1, "s2": s2, "info": info_v}
+        expected, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[out.name], scope=scope)
+        fluid.io.save_inference_model(
+            model_dir, ["a1", "a2", "d1", "d2", "s1", "s2", "info"],
+            [out], executor=exe, scope=scope)
+    got = _run_native(binary, model_dir, tmp_path,
+                      [anc1, anc2, d1, d2, s1, s2, info_v])
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-4)
