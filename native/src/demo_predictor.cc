@@ -51,8 +51,15 @@
 //   deformable_conv v2/v1, fused fc, serving scorers (cross_entropy,
 //   softmax_with_cross_entropy, sigmoid CE, accuracy, mean) and tensor
 //   utilities (scatter/scatter_nd_add/multiplex/label_smooth/crop/
-//   pad_constant_like/diag/linspace/fill/assign_value).  The exact
-//   not-served boundary vs SURVEY Appendix A is machine-checked by
+//   pad_constant_like/diag/linspace/fill/assign_value), the RPN/FPN
+//   proposal machinery (generate_proposals, distribute/collect_fpn,
+//   retinanet_detection_output), and the final residual (attention_lstm,
+//   cudnn_lstm, conv2d_inception_fusion, tree_conv,
+//   deformable_psroi_pooling, roi_perspective_transform, unique,
+//   filter_by_instag, sequence_topk_avg_pooling, max_pool3d_with_index,
+//   fusion_seqconv/seqexpand).  EVERY Appendix-A inference op is
+//   dispatched; the remaining not-served categories are training /
+//   collective / rng / host ops, machine-checked by
 //   tests/test_demo_predictor.py::test_native_serving_boundary_is_exact.
 //   Payloads: f32 + exact int64 + bf16 (u2 view).
 
