@@ -342,6 +342,9 @@ def bench_gpt_causal(dev, on_tpu, peak):
             "device": str(dev), "batch": batch, "seq_len": seq_len,
             "attn": "pallas flash causal (auto)",
             "loss_first_last": [round(l0, 3), round(lN, 3)],
+            "note": ("residual vs 35% is the measured dh=64 shape "
+                     "ceiling: softmax VPU tile cost scales as 1/d "
+                     "(skeleton microbench, LONGCTX_ABLATION.md r5)"),
         })
 
 
@@ -453,6 +456,9 @@ def bench_bert_long(dev, on_tpu, peak):
             "tokens_per_s": round(tokens / dt, 1),
             "device": str(dev), "batch": batch, "seq_len": seq_len,
             "attn": "pallas flash (auto)",
+            "note": ("kernel measured within ~1.2-1.8x of its matmul-"
+                     "only skeleton; residual = mandatory softmax VPU "
+                     "work at dh=64 (LONGCTX_ABLATION.md r5)"),
         })
 
 
