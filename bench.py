@@ -575,9 +575,73 @@ def bench_deepfm_ps():
                           "vs_baseline": 0, "error": str(e)[:300]})
 
 
+def bench_dispatch_overhead(dev, on_tpu, peak):
+    """Dispatch-overhead line (host framework tax per steady-state step):
+    50 lazy-fetch steps of a small MLP train step, measured by the
+    executor's OWN dispatch counters (`dispatch_stats()`), so the number
+    is host time inside `Executor.run` up to async-dispatch return —
+    device compute and tunnel RTT excluded by construction.  Runs on CPU
+    and TPU alike; tracked from this PR onward so hot-path regressions
+    show in the BENCH trajectory."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[64], dtype="float32")
+        h = layers.fc(x, size=64, act="relu")
+        loss = layers.mean(layers.fc(h, size=64))
+        pt.optimizer.SGD(0.01).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        # feed staged once, like every other line: per-step H2D would
+        # measure the tunnel, and a real input pipeline prefetches anyway
+        feed = {"x": jax.device_put(np.ones((32, 64), np.float32))}
+        lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+        float(np.asarray(lv))              # warmup: trace + compile
+
+        steps = 50
+        s0 = exe.dispatch_stats()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            h_, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                          return_numpy=False)
+        h_.numpy()                         # ONE sync bounds the pipeline
+        wall_us = (time.perf_counter() - t0) * 1e6 / steps
+        s1 = exe.dispatch_stats()
+
+        d = {k: s1[k] - s0[k] for k in
+             ("time_to_dispatch_us", "host_block_us", "cache_hits",
+              "traces", "steps_dispatched", "fetch_materializations")}
+        emit({
+            "metric": "dispatch_overhead_us_per_step",
+            "value": round(d["time_to_dispatch_us"] / steps, 1),
+            "unit": "us/step (lower is better)",
+            "vs_baseline": 0,              # no BASELINE target: trajectory metric
+            "wall_us_per_step": round(wall_us, 1),
+            "host_block_us_per_step": round(d["host_block_us"] / steps, 1),
+            "cache_hits": d["cache_hits"],
+            "retraces": d["traces"],
+            "fetch_materializations": d["fetch_materializations"],
+            "steps": d["steps_dispatched"],
+            "device": str(dev),
+            "note": ("host time in Executor.run to async-dispatch return, "
+                     "from executor dispatch counters; lazy fetches, "
+                     "in-flight throttle=2; materializations happen only "
+                     "at the final sync"),
+        })
+
+
 def main():
     dev, on_tpu, peak = _device_info()
     benches = [
+        # cheap + always first: the hot-path trajectory line must never be
+        # starved by a slow hardware bench ahead of it
+        ("dispatch_overhead",
+         lambda: bench_dispatch_overhead(dev, on_tpu, peak)),
         ("resnet50", lambda: bench_resnet50(dev, on_tpu, peak)),
         ("resnet50_frozen_bn",
          lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True)),

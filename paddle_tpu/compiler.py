@@ -15,12 +15,20 @@ unnecessary because the mean over the global batch already spans devices.
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .framework.core import Program
+
+#: monotonic CompiledProgram identity — the executor's compiled-block
+#: cache keys on this serial: structurally-equal meshes from two
+#: differently-configured CompiledPrograms (different in_shardings /
+#: zero stage / input specs) must NOT share a compiled entry, and raw
+#: id() can be reused after GC
+_cp_serials = itertools.count()
 
 
 class BuildStrategy:
@@ -95,6 +103,7 @@ class CompiledProgram:
         self._loss_name = None
         self._share_vars_from = None
         self._is_data_parallel = False
+        self._serial = next(_cp_serials)
 
     def _optimized(self, fetch_names=()) -> Program:
         """Apply the BuildStrategy's graph passes (ref BuildStrategy::Apply,
@@ -139,6 +148,10 @@ class CompiledProgram:
         if devices is None:
             devices = jax.devices()
         self._mesh = make_mesh({"dp": len(devices)}, devices)
+        # reconfiguration changes what the executor must lower (mesh,
+        # shardings) without touching the program fingerprint — a new
+        # serial invalidates any compiled block cached for the old config
+        self._serial = next(_cp_serials)
         return self
 
     def with_distributed(self, mesh=None, axes=None, input_specs=None,
@@ -166,6 +179,9 @@ class CompiledProgram:
             raise ValueError("zero_stage must be 0 or 1 (ZeRO-1: "
                              "optimizer-state sharding)")
         self._zero_stage = int(zero_stage)
+        # see with_data_parallel: a reconfigured mesh/specs/zero stage
+        # must not hit blocks compiled for the previous configuration
+        self._serial = next(_cp_serials)
         return self
 
     def _build_in_shardings(self, feed_names, ro, rw):

@@ -12,12 +12,31 @@ does in ``ParallelExecutor::FeedTensorsIntoLocalScopes``).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from typing import Callable, Dict, Iterable, Optional
 
 import jax
 import numpy as np
+
+#: per-prefetch-source identity for the staging-side int64 wrap check:
+#: each loader/reader iteration gets its own token namespace, so one
+#: run's in-range first batch can never suppress a later run's warning
+#: (and no Executor.close() interplay is needed to re-arm it)
+_stage_serials = itertools.count()
+
+
+def _drop_stage_tokens(src):
+    """Retire a finished pipeline's int64-check dedup tokens: each
+    iteration mints a fresh serial, so a long-running process re-iterating
+    a loader per epoch would otherwise grow the module-global token set
+    forever (Executor.close() only retires program-id tokens)."""
+    from ..framework.executor import (_checked_int64_feeds,
+                                      _checked_int64_lock)
+    with _checked_int64_lock:
+        _checked_int64_feeds.difference_update(
+            [t for t in _checked_int64_feeds if t[0] == src])
 
 
 class DataLoader:
@@ -61,39 +80,95 @@ class DataLoader:
         yield from _prefetch_to_device(self._batch_fn, self.capacity)
 
 
-def _prefetch_to_device(batch_fn, capacity, sharding=None):
-    """Double-buffer: stage next batch to device while current one computes."""
-    class _End:
-        pass
+def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
+    """Double-buffer: stage next batch to device while current one computes.
 
+    ``stage=False`` keeps batches as host arrays (the producer thread still
+    overlaps file parsing with device compute): a mesh spanning processes
+    needs host-local numpy for ``host_local_array_to_global_array`` — a
+    pre-staged single-device ``jax.Array`` would be pulled BACK to host
+    (a D2H sync on the dispatch thread) every step.
+
+    The producer thread is shutdown-safe: a consumer that stops iterating
+    early (break / exception / generator close) sets a stop flag and drains
+    the queue, so a producer parked on a full-queue ``put`` wakes, skips the
+    rest of its input, and exits — instead of blocking forever and leaking
+    the thread (and whatever file handles its ``batch_fn`` holds)."""
     q: queue.Queue = queue.Queue(maxsize=capacity)
+    stop = threading.Event()
     err = []
+    _End = object()
+    src = ("stage", next(_stage_serials))
+
+    def _put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for batch in batch_fn():
-                if isinstance(batch, dict):
-                    staged = {k: _put(v, sharding) for k, v in batch.items()}
+                if stop.is_set():
+                    return
+                if not stage:
+                    staged = batch
+                elif isinstance(batch, dict):
+                    staged = {k: _put(v, sharding, name=k, src=src)
+                              for k, v in batch.items()}
                 else:
-                    staged = [_put(v, sharding) for v in batch]
-                q.put(staged)
+                    # positional slots need distinct check tokens, or only
+                    # the first int64 column of the source is ever scanned
+                    staged = [_put(v, sharding, name=f"@{j}", src=src)
+                              for j, v in enumerate(batch)]
+                if not _put_or_stop(staged):
+                    return
         except Exception as e:   # surfaced on next consumer get
             err.append(e)
         finally:
-            q.put(_End)
+            _put_or_stop(_End)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _End:
-            if err:
-                raise err[0]
-            break
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _End:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        try:                     # unblock a producer waiting on a full queue
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5)
+        _drop_stage_tokens(src)
 
 
-def _put(x, sharding=None):
+def _put(x, sharding=None, name=None, src=None):
+    if isinstance(x, jax.Array):
+        if sharding is not None:
+            # an already-staged array still honors a requested placement
+            # (it may be committed to one device; the mesh needs it laid
+            # out per the sharding)
+            return jax.device_put(x, sharding)
+        return x                 # already staged — device_put would re-copy
+    a = np.asarray(x)
+    if a.dtype in (np.int64, np.uint64) and not jax.config.jax_enable_x64:
+        # the silent int32-narrowing wrap check must see the original host
+        # values, and staging happens before the executor ever would — so
+        # run it HERE, in the producer thread (a first-batch-per-source
+        # min/max scan, off the dispatch path), then stage as usual so
+        # the H2D copy still overlaps compute
+        from ..framework.executor import _check_int64_range
+        _check_int64_range(a, name, src)
     if sharding is not None:
-        return jax.device_put(x, sharding)
-    return jax.device_put(np.asarray(x))
+        return jax.device_put(a, sharding)
+    return jax.device_put(a)

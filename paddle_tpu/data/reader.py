@@ -154,30 +154,44 @@ def prefetch_to_device(reader, depth=2):
     current step computes hides host→device latency entirely.  Works on
     feed dicts (name → numpy) or bare arrays/tuples.
     """
-    import jax
     from collections import deque
 
-    def put(item):
+    from .dataloader import _put as _stage, _stage_serials
+
+    def put(item, src):
+        # shared staging helper: int64 feeds get their first-batch wrap
+        # check on the original host values before the H2D copy
         if isinstance(item, dict):
-            return {k: jax.device_put(np.asarray(v))
+            return {k: _stage(v, name=k, src=src)
                     for k, v in item.items()}
         if isinstance(item, (list, tuple)):
-            return type(item)(jax.device_put(np.asarray(v)) for v in item)
-        return jax.device_put(np.asarray(item))
+            return type(item)(_stage(v, name=f"@{j}", src=src)
+                              for j, v in enumerate(item))
+        return _stage(item, name="@", src=src)
 
     def prefetching_reader():
         pending = deque()
         it = iter(reader())
+        # per-iteration check-token namespace (see dataloader._put): one
+        # reader's in-range first batch must never suppress the wrap
+        # warning for a different reader reusing the feed name
+        src = ("stage", next(_stage_serials))
+        from .dataloader import _drop_stage_tokens
         try:
-            for _ in range(depth):
-                pending.append(put(next(it)))
-        except StopIteration:
-            pass
-        while pending:
-            out = pending.popleft()
             try:
-                pending.append(put(next(it)))
+                for _ in range(depth):
+                    pending.append(put(next(it), src))
             except StopIteration:
                 pass
-            yield out
+            while pending:
+                out = pending.popleft()
+                try:
+                    pending.append(put(next(it), src))
+                except StopIteration:
+                    pass
+                yield out
+        finally:
+            # retire this iteration's int64-check tokens (see
+            # dataloader._drop_stage_tokens: the set is process-global)
+            _drop_stage_tokens(src)
     return prefetching_reader

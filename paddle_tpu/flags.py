@@ -57,6 +57,14 @@ _DEFAULTS: Dict[str, Any] = {
     # kernels ship precompiled; here first-compile is the analogous cost,
     # 20-40 s for a big train step, and the cache removes it on re-runs)
     "FLAGS_xla_compile_cache_dir": "",
+    # async dispatch throttle: max run() calls in flight before the
+    # executor blocks on the oldest step's output.  2 ≈ classic double
+    # buffering — enough to hide host work behind device compute without
+    # letting lazy-fetch loops queue unbounded live buffers in HBM.
+    # 0 disables the throttle (unbounded run-ahead).  FLAGS_benchmark's
+    # per-step sync takes precedence: with it set the throttle never
+    # engages.
+    "FLAGS_executor_max_inflight_steps": 2,
 }
 
 _values: Dict[str, Any] = dict(_DEFAULTS)

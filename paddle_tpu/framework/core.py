@@ -400,7 +400,15 @@ class Program:
             yield from b.vars.values()
 
     def fingerprint(self) -> Tuple[int, int]:
-        return (self.id, self._version)
+        """(program id, version) — the executor hashes this EVERY step
+        (twice on the fast path), so the tuple is cached and only rebuilt
+        after a version bump; ``getattr`` keeps ``Program.__new__``-style
+        construction paths (clone/prune/ir) safe without each one having
+        to initialize the cache slot."""
+        fp = getattr(self, "_fp_cache", None)
+        if fp is None or fp[1] != self._version:
+            fp = self._fp_cache = (self.id, self._version)
+        return fp
 
     # -- cloning / pruning ---------------------------------------------------
     def clone(self, for_test: bool = False) -> "Program":

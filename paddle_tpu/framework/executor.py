@@ -22,8 +22,11 @@ assignment subsumes it).
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
+import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +36,208 @@ import numpy as np
 from . import registry
 from .core import Block, Operator, Program, Variable, default_main_program
 from .scope import Scope, global_scope
+
+
+class _DispatchStats:
+    """Per-executor dispatch counters — the per-step 'framework tax' ledger.
+
+    Everything the host does per ``run()`` that is NOT the XLA step itself
+    shows up here: cache lookups (hit/miss), re-lowerings (``traces``), the
+    host time from ``run()`` entry to async dispatch return
+    (``time_to_dispatch_us``), and every point where the host BLOCKS on the
+    device (``host_block_us``, split by cause: fetch materialization,
+    in-flight throttle, FLAGS_benchmark per-step sync).  A healthy
+    steady-state loop with lazy fetches shows hits ≥ steps, zero traces,
+    and host-block time concentrated at materialization boundaries.
+    """
+
+    _INT_FIELDS = ("cache_hits", "cache_misses", "traces",
+                   "steps_dispatched", "lazy_fetch_steps",
+                   "eager_fetch_steps", "fetch_materializations",
+                   "throttle_waits")
+    _US_FIELDS = ("time_to_dispatch_us", "host_block_us",
+                  "materialize_block_us", "throttle_block_us",
+                  "benchmark_sync_us")
+
+    def __init__(self):
+        # counters are bumped from concurrent run() threads AND from
+        # FetchHandle.numpy() in arbitrary consumer threads; a bare `+=`
+        # is load/add/store and loses updates under contention, which
+        # would silently undercount the bench/test assertions
+        self._mu = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._mu:
+            for f in self._INT_FIELDS:
+                setattr(self, f, 0)
+            for f in self._US_FIELDS:
+                setattr(self, f, 0.0)
+
+    def incr(self, field: str, n=1):
+        with self._mu:
+            setattr(self, field, getattr(self, field) + n)
+
+    def block(self, cause_field: str, dt_us: float):
+        """Record ``dt_us`` of host-blocked time attributed to a cause."""
+        with self._mu:
+            setattr(self, cause_field, getattr(self, cause_field) + dt_us)
+            self.host_block_us += dt_us
+
+    def merge(self, other: "_DispatchStats"):
+        snap = other.snapshot()
+        with self._mu:
+            for f in self._INT_FIELDS + self._US_FIELDS:
+                setattr(self, f, getattr(self, f) + snap[f])
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            return {f: getattr(self, f)
+                    for f in self._INT_FIELDS + self._US_FIELDS}
+
+
+#: live executors, for profiler-level aggregation (weak: an executor's
+#: stats die with it, matching the reference's per-executor profiler state)
+_EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _scope_evict_cb(exe_ref, scope_tok):
+    exe = exe_ref()
+    if exe is not None:
+        exe._evict_scope(scope_tok)
+
+
+def aggregate_dispatch_stats() -> Dict[str, Any]:
+    """Sum dispatch counters over every live Executor (profiler API)."""
+    agg = _DispatchStats()
+    n = 0
+    for exe in list(_EXECUTORS):
+        agg.merge(exe._stats)
+        n += 1
+    out = agg.snapshot()
+    out["executors"] = n
+    return out
+
+
+class FetchHandle:
+    """A lazy fetch: wraps the still-in-flight ``jax.Array`` of a fetched
+    value and defers the device→host sync to first materialization.
+
+    ``Executor.run(..., return_numpy=False)`` returns these, so back-to-back
+    ``run()`` calls pipeline on device — the host never waits for step *i*
+    before dispatching step *i+1* (the ~115 ms tunnel RTT per sync is the
+    whole point).  ``.numpy()`` / ``np.asarray(handle)`` materialize (and
+    cache) the host value; attribute access (``.shape``, ``.dtype``,
+    ``.sharding``, ``.block_until_ready``) forwards to the wrapped array
+    without syncing.  Fetch buffers are never donated, so a handle stays
+    valid across later steps that donate and overwrite the parameter state.
+    """
+
+    __slots__ = ("_value", "_np", "_stats")
+
+    def __init__(self, value, stats: Optional[_DispatchStats] = None):
+        self._value = value
+        self._np = None
+        self._stats = stats
+
+    @property
+    def value(self):
+        """The wrapped (possibly still in-flight) device array."""
+        return self._value
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._np is not None
+
+    def numpy(self) -> np.ndarray:
+        if self._np is None:
+            t0 = time.perf_counter()
+            self._np = _fetch_to_numpy(self._value)
+            if self._stats is not None:
+                self._stats.incr("fetch_materializations")
+                self._stats.block(
+                    "materialize_block_us",
+                    (time.perf_counter() - t0) * 1e6)
+        return self._np
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.numpy()
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            return a.astype(dtype)
+        if copy:
+            return np.array(a)
+        return a
+
+    def __getattr__(self, name):
+        # everything else (shape/dtype/sharding/block_until_ready/...)
+        # forwards to the device array WITHOUT forcing a sync.  Dunder
+        # and slot names never forward: an unset _value slot (e.g. a
+        # pickle-protocol probe on a bare __slots__ instance) would
+        # otherwise re-enter __getattr__ forever
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._value, name)
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        # implicit dunders bypass __getattr__ (type-level lookup), so
+        # without this a zero-valued scalar handle would be truthy
+        return bool(self.numpy())
+
+    def __len__(self):
+        return len(self._value)
+
+    def __repr__(self):
+        state = "materialized" if self._np is not None else "in-flight"
+        return (f"FetchHandle({state}, shape="
+                f"{getattr(self._value, 'shape', None)}, dtype="
+                f"{getattr(self._value, 'dtype', None)})")
+
+
+def _fetch_handle_binop(name):
+    # comparisons and arithmetic are implicit dunders — resolved on the
+    # type, never via __getattr__ — so they must be forwarded explicitly
+    # or `h == x` falls back to identity and `h + x` raises.  Forwarding
+    # to the wrapped jax.Array keeps the result lazy on device.
+    def op(self, other):
+        if isinstance(other, FetchHandle):
+            other = other._value
+        return getattr(self._value, name)(other)
+    op.__name__ = name
+    return op
+
+
+for _n in ("__eq__", "__ne__", "__lt__", "__le__", "__gt__", "__ge__",
+           "__add__", "__radd__", "__sub__", "__rsub__",
+           "__mul__", "__rmul__", "__truediv__", "__rtruediv__",
+           "__floordiv__", "__rfloordiv__", "__mod__", "__rmod__",
+           "__pow__", "__rpow__", "__matmul__", "__rmatmul__"):
+    setattr(FetchHandle, _n, _fetch_handle_binop(_n))
+del _n
+
+
+class _DispatchPlan:
+    """Memoized steady-state dispatch: everything ``run()`` derives from
+    (program fingerprint, feed-name tuple, fetch set, scope, flags) that
+    does not change step to step — the compiled block, the full cache key,
+    and the expected feed signatures.  A plan hit skips the listen_and_serv
+    scan, feed-name sorting, persistable classification, and the lock."""
+
+    __slots__ = ("cb", "key", "feed_names", "feed_sigs")
+
+    def __init__(self, cb, key, feed_names, feed_sigs):
+        self.cb = cb
+        self.key = key
+        self.feed_names = feed_names       # insertion order, not sorted
+        self.feed_sigs = feed_sigs
 
 
 class LowerCtx:
@@ -277,6 +482,16 @@ class _CompiledBlock:
             run_block(ctx, block, state)
             fetches = [state.values[n] for n in fetch_names]
             new_rw = [state.values[n] for n in persist_rw]
+            if donate and persist_rw:
+                # a fetch that IS an rw persistable (monitoring a weight,
+                # dumping a state var) traces to the identical value in
+                # both outputs; XLA gives both one buffer, and the NEXT
+                # step's donation of the rw input would kill it while a
+                # lazy FetchHandle still points at it.  An explicit copy
+                # forces the fetch into its own (never-donated) buffer.
+                rw_ids = {id(v) for v in new_rw}
+                fetches = [jnp.copy(f) if id(f) in rw_ids else f
+                           for f in fetches]
             return fetches, new_rw
 
         if collective:
@@ -454,11 +669,71 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, _CompiledBlock] = {}
-        self._lock = threading.Lock()
+        self._plans: Dict[Any, _DispatchPlan] = {}
+        # RLock, not Lock: the scope-eviction weakref.finalize callback
+        # takes this lock, and cyclic GC (Scope's parent<->kids cycle
+        # makes the gc module the collector) can fire it at an allocation
+        # point INSIDE a critical section on the same thread — a
+        # non-reentrant lock would self-deadlock there
+        self._lock = threading.RLock()
         self._step_seed = 0
+        self._stats = _DispatchStats()
+        # async dispatch throttle: representative output arrays of the last
+        # N dispatched steps; run() blocks on the oldest once more than
+        # FLAGS_executor_max_inflight_steps are in flight, so lazy-fetch
+        # loops cannot run arbitrarily ahead of HBM
+        self._inflight: collections.deque = collections.deque()
+        self._run_prog_ids: set = set()
+        self._evict_reg: set = set()
+        _EXECUTORS.add(self)
 
     def close(self):
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
+            self._plans.clear()
+            self._inflight.clear()
+        # feed-range warnings re-arm for the programs THIS executor ran: a
+        # new executor run of the same feed names must get its own
+        # first-batch int64 check — but another live executor's dedup
+        # tokens (different programs) must survive our close
+        with _checked_int64_lock:
+            _checked_int64_feeds.difference_update(
+                [t for t in _checked_int64_feeds
+                 if t[0] in self._run_prog_ids])
+        self._run_prog_ids.clear()
+        # _evict_reg is NOT cleared: its finalizers live until their scope
+        # dies, so clearing would stack a duplicate finalize on a
+        # long-lived scope every close()/run() cycle — dead scopes already
+        # remove their own token in _evict_scope
+
+    def _evict_scope(self, scope_tok):
+        """Drop every compiled block and dispatch plan keyed to a dead
+        scope.  Serial keys never collide (unlike id()), which also means
+        entries for dead scopes would otherwise accumulate FOREVER — a
+        fresh-scope-per-request loop would leak one compiled executable
+        per request; a ``weakref.finalize`` on the scope calls this."""
+        with self._lock:
+            for k in [k for k in self._cache if k[4] == scope_tok]:
+                del self._cache[k]
+            for k in [k for k in self._plans if k[3] == scope_tok]:
+                del self._plans[k]
+        self._evict_reg.discard(scope_tok)
+
+    # -- dispatch telemetry --------------------------------------------------
+    def dispatch_stats(self) -> Dict[str, Any]:
+        """Snapshot of this executor's dispatch counters (see
+        ``_DispatchStats``).  Adds the current in-flight depth and the
+        configured throttle so callers can reason about pipelining."""
+        from ..flags import get_flags
+        out = self._stats.snapshot()
+        out["steps_in_flight"] = len(self._inflight)
+        out["max_in_flight"] = int(get_flags(
+            "FLAGS_executor_max_inflight_steps")
+            ["FLAGS_executor_max_inflight_steps"])
+        return out
+
+    def reset_dispatch_stats(self):
+        self._stats.reset()
 
     # -- main entry ----------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -467,21 +742,60 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
             seed: Optional[int] = None):
+        t0 = time.perf_counter()
         from ..compiler import CompiledProgram
+        from ..flags import get_flags
         mesh = None
         in_shardings = None
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else f
+            for f in (fetch_list or []))
+        cp_tok = None
         if isinstance(program, CompiledProgram):
             compiled = program
-            program = compiled._optimized(
-                tuple(f.name if isinstance(f, Variable) else f
-                      for f in (fetch_list or [])))
+            program = compiled._optimized(fetch_names)
             mesh = compiled._mesh
             in_shardings = compiled._build_in_shardings
+            # the serial, not the mesh: two CompiledPrograms with
+            # structurally-equal meshes but different sharding configs
+            # (zero stage, input specs) must not share a compiled block
+            cp_tok = getattr(compiled, "_serial", None)
+            if cp_tok is None:
+                cp_tok = id(compiled)
         if program is None:
             program = default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
+        check_nan = bool(
+            get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"])
+        scope_tok = getattr(scope, "_serial", None)
+        if scope_tok is None:           # foreign scope-like object
+            scope_tok = id(scope)
 
+        # ---- steady-state fast path: one dict probe + a feed-sig check.
+        # The plan memoizes every per-run derivation (sorted feed names,
+        # persistable classification, pserver scan, full cache key), so a
+        # repeat step does no re-sorting or re-classification — only the
+        # unavoidable shape/dtype check (feeds CAN change shape, e.g. a
+        # last partial batch, and must fall back to the slow path).
+        # mesh and collective must be part of the key: neither is covered
+        # by the program fingerprint (a CompiledProgram can share its
+        # fingerprint with the raw Program, and the transpiler sets
+        # _attrs["collective"] without a version bump), and a plan hit
+        # running the wrong sharding would be silent.
+        collective = program._attrs.get("collective")
+        coll_tok = (tuple(sorted(collective.items()))
+                    if collective else None)
+        fast_key = (program.fingerprint(), tuple(feed), fetch_names,
+                    scope_tok, check_nan, cp_tok, coll_tok)
+        plan = self._plans.get(fast_key)
+        if plan is not None and plan.feed_sigs == tuple(
+                _feed_sig(feed[n]) for n in plan.feed_names):
+            self._stats.incr("cache_hits")
+            return self._dispatch(plan.cb, plan.key, feed, scope, program,
+                                  return_numpy, seed, t0)
+
+        # ---- slow path: full classification + (maybe) lowering -------------
         # a pserver program is a blocking host loop, not a jittable block
         # (ref listen_and_serv_op.cc RunImpl blocking in Executor::Run)
         lsv = next((op for op in program.global_block().ops
@@ -489,24 +803,23 @@ class Executor:
         if lsv is not None:
             from ..distributed import ps as _ps
             return _ps.run_pserver(lsv, scope)
-        fetch_names = tuple(
-            f.name if isinstance(f, Variable) else f for f in (fetch_list or []))
         feed_names = tuple(sorted(feed))
 
         block = program.global_block()
-        collective = program._attrs.get("collective")
-        from ..flags import get_flags
-        check_nan = bool(
-            get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"])
         # the flag is read at trace time (_run_op_inner) — it must be part
-        # of the cache key, or toggling it after a first run is a no-op
+        # of the cache key, or toggling it after a first run is a no-op.
+        # Scope identity is its monotonic serial (NOT id(): after GC a new
+        # scope can reuse a dead scope's id and silently hit a compiled
+        # entry classified for the dead scope's persistables); the
+        # CompiledProgram keys by its own serial for the same reason.
         key = (program.fingerprint(), feed_names,
                tuple(_feed_sig(feed[n]) for n in feed_names),
-               fetch_names, id(scope), id(mesh), check_nan,
-               tuple(sorted(collective.items())) if collective else None)
+               fetch_names, scope_tok, cp_tok, check_nan, coll_tok)
         with self._lock:
             cb = self._cache.get(key)
             if cb is None:
+                self._stats.incr("cache_misses")
+                self._stats.incr("traces")
                 ro, rw, read_set = _collect_persistables(
                     program, block, scope, feed_names)
                 shardings = None
@@ -520,17 +833,42 @@ class Executor:
                                      for n in feed_names))
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
                 self._cache[key] = cb
+            else:
+                self._stats.incr("cache_hits")
+            plan_names = tuple(feed)
+            self._plans[fast_key] = _DispatchPlan(
+                cb, key, plan_names,
+                tuple(_feed_sig(feed[n]) for n in plan_names))
+        if scope_tok not in self._evict_reg:
+            # serial keys never get overwritten by a reused id, so dead
+            # scopes' entries must be evicted explicitly or they leak one
+            # compiled executable per scope.  weakref: the finalizer must
+            # not keep either the scope or this executor alive.
+            self._evict_reg.add(scope_tok)
+            try:
+                weakref.finalize(scope, _scope_evict_cb,
+                                 weakref.ref(self), scope_tok)
+            except TypeError:      # non-weakrefable foreign scope-like
+                pass
+        return self._dispatch(cb, key, feed, scope, program,
+                              return_numpy, seed, t0)
 
+    def _dispatch(self, cb, key, feed, scope, program, return_numpy, seed,
+                  t0):
         import contextlib
         from .. import profiler as _prof
         ctx = (_prof.RecordEvent("executor.run")
                if _prof.is_profiler_enabled() else contextlib.nullcontext())
         with ctx:
             return self._finish_run(cb, key, feed, scope, program,
-                                    return_numpy, seed)
+                                    return_numpy, seed, t0)
 
-    def _finish_run(self, cb, key, feed, scope, program, return_numpy, seed):
-        feeds = [_to_device(feed[n], n) for n in cb.feed_names]
+    def _finish_run(self, cb, key, feed, scope, program, return_numpy, seed,
+                    t0):
+        stats = self._stats
+        prog_id = program.fingerprint()[0]
+        self._run_prog_ids.add(prog_id)
+        feeds = [_to_device(feed[n], n, prog_id) for n in cb.feed_names]
         ro_vals = [_scope_fetch(scope, n) for n in cb.persist_ro]
         # read-write persistables that are READ must be initialized (optimizer
         # accumulators, BN stats, step counters) — a silent zero would corrupt
@@ -571,9 +909,12 @@ class Executor:
             fetches, new_rw = cb(feeds, ro_vals, rw_vals, seed_arr)
         except Exception as e:
             # never cache a block whose trace failed (a later run with a
-            # fixed scope/feed must re-lower)
+            # fixed scope/feed must re-lower); drop plans pointing at it too
             with self._lock:
                 self._cache.pop(key, None)
+                for fk in [k for k, p in self._plans.items()
+                           if p.key == key]:
+                    self._plans.pop(fk, None)
             from .. import memory as _memory
             if _memory._is_oom_error(e):
                 # an on-chip OOM is a raw XLA error; attach what was
@@ -590,18 +931,99 @@ class Executor:
                     wrapped = RuntimeError(f"{e}\n\n{report}")
                 raise wrapped from e
             raise
+        stats.incr("steps_dispatched")
+        stats.incr("time_to_dispatch_us",
+                   (time.perf_counter() - t0) * 1e6)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
         from ..flags import get_flags
-        if get_flags("FLAGS_benchmark")["FLAGS_benchmark"]:
+        fl = get_flags(["FLAGS_benchmark",
+                        "FLAGS_executor_max_inflight_steps"])
+        if fl["FLAGS_benchmark"]:
             # ref FLAGS_benchmark: per-step device sync so wall timing is
-            # attributable (normally steps pipeline asynchronously)
+            # attributable (normally steps pipeline asynchronously) — this
+            # wins over async dispatch, so the throttle never engages
+            tb = time.perf_counter()
             for v in list(new_rw) + list(fetches):
                 if hasattr(v, "block_until_ready"):
                     v.block_until_ready()
+            stats.block("benchmark_sync_us",
+                        (time.perf_counter() - tb) * 1e6)
+            # everything queued before the flag flipped is now complete;
+            # keeping the probes would only pin their buffers in HBM.
+            # All _inflight mutations hold the lock: an unlocked clear()
+            # can land between a concurrent _throttle's len-check and
+            # popleft and crash it on an emptied deque
+            with self._lock:
+                self._inflight.clear()
+        elif not (return_numpy and fetches):
+            # an eager step with fetches fully syncs at materialization
+            # below — probing it would only pin its fetch buffers in
+            # _inflight after the caller is done with them.  Lazy steps
+            # and fetch-less eager loops (which never sync otherwise) do
+            # feed the throttle.
+            self._throttle(fetches, new_rw,
+                           int(fl["FLAGS_executor_max_inflight_steps"]))
         if return_numpy:
-            return [_fetch_to_numpy(f) for f in fetches]
-        return list(fetches)
+            stats.incr("eager_fetch_steps")
+            tm = time.perf_counter()
+            out = [_fetch_to_numpy(f) for f in fetches]
+            if fetches:
+                stats.incr("fetch_materializations", len(fetches))
+                stats.block("materialize_block_us",
+                            (time.perf_counter() - tm) * 1e6)
+                # this step's fetches are on host, and per-device
+                # execution is in-order, so every earlier step's probe is
+                # complete — retaining them after a lazy→eager switch
+                # would pin the lazy phase's fetch buffers in HBM
+                with self._lock:
+                    self._inflight.clear()
+            return out
+        stats.incr("lazy_fetch_steps")
+        return [FetchHandle(f, stats) for f in fetches]
+
+    def _throttle(self, fetches, new_rw, limit):
+        """Bound async run-ahead: remember one output array per dispatched
+        step and block on the oldest once more than ``limit`` are in
+        flight.  Fetch buffers are preferred as the probe — they are never
+        donated, so they stay waitable; a donated rw probe that a later
+        step already consumed is skipped (per-device execution is in-order,
+        so its step is at least as old as the one that consumed it)."""
+        probe = next((v for v in list(fetches) + list(new_rw)
+                      if hasattr(v, "block_until_ready")), None)
+        with self._lock:
+            if probe is not None:
+                self._inflight.append(probe)
+            if limit <= 0:                  # throttle disabled
+                self._inflight.clear()
+                return
+        stats = self._stats
+        while True:
+            # pop under the lock: concurrent run() threads racing the
+            # len-check against each other's popleft would land one of
+            # them on an emptied deque (block_until_ready below releases
+            # the GIL, so the stale-check window is wide)
+            with self._lock:
+                if len(self._inflight) <= limit:
+                    return
+                arr = self._inflight.popleft()
+            try:
+                if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
+                    tb = time.perf_counter()
+                    arr.block_until_ready()
+                    stats.incr("throttle_waits")
+                    stats.block("throttle_block_us",
+                                (time.perf_counter() - tb) * 1e6)
+            except Exception:
+                # a probe whose buffer a later step donated is legitimately
+                # dead (is_deleted above can race the donation) — anything
+                # else is a real async device failure first surfacing here,
+                # and swallowing it would let the loop keep dispatching
+                # steps that depend on a poisoned state.  The buffer's own
+                # post-hoc deleted state is the discriminator, not the
+                # error text (XLA failure messages can mention donation)
+                if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
+                    raise
 
     def infer_from_program(self, *a, **k):
         return self.run(*a, **k)
@@ -612,11 +1034,15 @@ class Executor:
                            trainer_desc=None):
         """ref ``framework/executor.cc:143`` RunFromDataset + MultiTrainer:
         drain the dataset's slot batches through the training program.
-        Threaded file parsing happens in the native data feed; the device
-        step itself is one XLA computation, so the reference's
-        thread-per-device Hogwild loop maps to a single sequential feed
-        loop here.  A ``TrainerDesc`` (trainer_factory API) supplies
-        fetch/print config when passed."""
+
+        The steady-state loop is fully asynchronous: batches flow through
+        the dataloader's ``_prefetch_to_device`` double buffer (host
+        parsing + H2D staging of batch *i+1* overlaps device compute of
+        batch *i* — ref ``buffered_reader.cc``'s double-buffer reader),
+        steps dispatch with lazy fetches, and fetch/dump values only
+        materialize (device→host sync) at ``print_period``/dump-flush
+        boundaries instead of every step.  A ``TrainerDesc``
+        (trainer_factory API) supplies fetch/print config when passed."""
         if dataset is None:
             raise ValueError("dataset is required")
         dump_fields, dump_file = [], None
@@ -635,21 +1061,49 @@ class Executor:
                 dump_file = open(os.path.join(
                     trainer_desc._dump_fields_path, f"worker_{wid}"), "w")
         fetch_list = fetch_list or []
+        n_fetch = len(fetch_list)
+        from ..data.dataloader import _prefetch_to_device
+        pending_dump = []       # (batch idx, in-flight handles) to flush
+
+        def _flush_dump():
+            # one device→host sync per flush window, not per step
+            for bi, vals in pending_dump:
+                for name, val in zip(dump_fields, vals):
+                    flat = " ".join(
+                        str(x) for x in np.asarray(val).ravel())
+                    dump_file.write(f"{bi}\t{name}\t{flat}\n")
+            pending_dump.clear()
+
+        # flush at print_period boundaries, but never hold more than a few
+        # batches of un-materialized dump buffers: each pending batch pins
+        # len(dump_fields) live fetch arrays in HBM (the in-flight
+        # throttle bounds pipelined COMPUTE, not retained buffers), so an
+        # uncapped window of print_period=100 large activations would OOM
+        # where the old per-step writer streamed them out
+        flush_every = max(1, min(int(print_period), 8))
+        # a mesh spanning processes assembles global arrays from HOST
+        # numpy (_to_global_arrays) — pre-staging would force a D2H pull
+        # per feed per step; the prefetch thread then only overlaps
+        # parsing, not the H2D copy
+        from ..compiler import CompiledProgram
+        cp_mesh = (program._mesh
+                   if isinstance(program, CompiledProgram) else None)
+        stage = not (cp_mesh is not None
+                     and _mesh_is_multiprocess(cp_mesh))
         results = None
         try:
-            for i, feed in enumerate(dataset):
+            for i, feed in enumerate(_prefetch_to_device(
+                    lambda: iter(dataset), capacity=2, stage=stage)):
                 results = self.run(
                     program, feed=feed,
                     fetch_list=list(fetch_list) +
                     (list(dump_fields) if dump_file else []),
-                    scope=scope)
+                    scope=scope, return_numpy=False)
                 if dump_file:
-                    results, dumped = (results[:len(fetch_list)],
-                                       results[len(fetch_list):])
-                    for name, val in zip(dump_fields, dumped):
-                        flat = " ".join(
-                            str(x) for x in np.asarray(val).ravel())
-                        dump_file.write(f"{i}\t{name}\t{flat}\n")
+                    results, dumped = results[:n_fetch], results[n_fetch:]
+                    pending_dump.append((i, dumped))
+                    if len(pending_dump) >= flush_every:
+                        _flush_dump()
                 if debug and fetch_list and i % print_period == 0:
                     info = fetch_info or [
                         f.name if hasattr(f, "name") else str(f)
@@ -659,7 +1113,18 @@ class Executor:
                     print(f"[train_from_dataset] batch {i}: {msg}")
         finally:
             if dump_file is not None:
-                dump_file.close()
+                try:
+                    _flush_dump()
+                finally:
+                    dump_file.close()   # even if flush materialization fails
+        if results is not None:
+            # materialize the final step's fetches: the return contract is
+            # numpy, and this is the loop's ONE mandatory host sync
+            results = [np.asarray(r) for r in results]
+        # the loop is over — retained throttle probes would pin the last
+        # steps' fetch buffers (possibly large dump activations) in HBM
+        with self._lock:
+            self._inflight.clear()
         return results
 
     def infer_from_dataset(self, *a, **k):
@@ -737,18 +1202,30 @@ def _to_global_arrays(cb, mesh, feeds, ro_vals, rw_vals, seed_arr):
                 np.asarray(seed_arr), mesh, P()))
 
 
+#: (program id, feed name) pairs already spot-checked.  Keyed per program —
+#: a bare feed name would let one program's check suppress the int64-wrap
+#: warning for a DIFFERENT program reusing the name; Executor.close()
+#: clears it so a fresh executor re-arms the checks.  Guarded by
+#: _checked_int64_lock: dataloader/reader PRODUCER threads add tokens
+#: while close()/_drop_stage_tokens iterate — an unguarded set raises
+#: 'Set changed size during iteration'.
 _checked_int64_feeds = set()
+_checked_int64_lock = threading.Lock()
 
 
-def _check_int64_range(x, name):
+def _check_int64_range(x, name, prog_id=None):
     """With x64 off, int64 feeds land in int32 (uint64 in uint32); values
     outside the narrow range would wrap SILENTLY (ops/common.py
-    canon_dtype).  Spot-check the FIRST batch per feed name — a one-time
-    host min/max scan, keeping the steady-state dispatch path clean."""
+    canon_dtype).  Spot-check the FIRST batch per (program, feed name) — a
+    one-time host min/max scan, keeping the steady-state dispatch path
+    clean."""
+    tok = (prog_id, name)
     if (x.dtype in (np.int64, np.uint64) and x.size
-            and name not in _checked_int64_feeds
             and not jax.config.jax_enable_x64):
-        _checked_int64_feeds.add(name)
+        with _checked_int64_lock:
+            if tok in _checked_int64_feeds:
+                return
+            _checked_int64_feeds.add(tok)
         lo, hi = int(x.min()), int(x.max())
         bad = (hi >= 2**32) if x.dtype == np.uint64 else \
             (lo < -2**31 or hi >= 2**31)
@@ -761,12 +1238,16 @@ def _check_int64_range(x, name):
                 f"set JAX_ENABLE_X64=1 for true 64-bit semantics")
 
 
-def _to_device(x, name=None):
+def _to_device(x, name=None, prog_id=None):
+    if isinstance(x, FetchHandle):
+        # a lazy fetch fed back as an input: hand XLA the wrapped device
+        # array directly — no host sync, the dependency stays on device
+        return x._value
     if isinstance(x, (int, float)):
         return jnp.asarray(x)
     if isinstance(x, np.ndarray):
         if name is not None:
-            _check_int64_range(x, name)
+            _check_int64_range(x, name, prog_id)
         return jnp.asarray(x)
     return x
 

@@ -12,7 +12,14 @@ liveness analysis does that job during compilation.
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Any, Dict, Iterator, Optional
+
+#: monotonic scope identity tokens — the executor's compiled-block cache
+#: keys on ``scope._serial`` rather than ``id(scope)``: after GC, a new
+#: scope can reuse a dead scope's id and silently hit an entry whose
+#: persistable classification was computed against the dead scope.
+_scope_serials = itertools.count()
 
 
 class Scope:
@@ -20,6 +27,7 @@ class Scope:
         self.parent = parent
         self._vars: Dict[str, Any] = {}
         self.kids = []
+        self._serial = next(_scope_serials)
 
     def var(self, name: str):
         """Create-or-get, like ref Scope::Var."""

@@ -164,6 +164,19 @@ def profiler(state: str = "All", sorted_key: str = "total",
         stop_profiler(sorted_key, profile_path)
 
 
+def dispatch_stats() -> dict:
+    """Aggregate executor dispatch counters across all live executors —
+    the steady-state 'framework tax' ledger: compiled-block cache
+    hits/misses, re-lowerings (``traces``), steps dispatched, host
+    time-to-dispatch, and host-block time split by cause (fetch
+    materialization / in-flight throttle / FLAGS_benchmark sync).  The
+    per-executor view is ``Executor.dispatch_stats()``; this one sums
+    them plus an ``executors`` count, so a training script can report
+    dispatch overhead without holding executor references."""
+    from .framework import executor as _executor
+    return _executor.aggregate_dispatch_stats()
+
+
 @contextlib.contextmanager
 def device_profiler(logdir: str):
     """XLA/TPU device profile via jax.profiler (≈ CUPTI device tracer);

@@ -1,0 +1,411 @@
+"""Async step pipeline: lazy FetchHandle semantics, retrace counters,
+scope-identity cache keying, dataloader producer shutdown, and per-program
+int64 feed checks."""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import Executor
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.executor import FetchHandle
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _const_train_step(scope):
+    """Deterministic train step (constant init, no RNG ops) so lazy and
+    eager runs of two FRESH setups produce bit-identical values."""
+    w = fluid.ParamAttr(initializer=fluid.initializer.Constant(0.05))
+    x = layers.data("x", shape=[6], dtype="float32")
+    h = layers.fc(x, size=8, act="relu", param_attr=w, bias_attr=w)
+    loss = layers.mean(layers.fc(h, size=3, param_attr=w, bias_attr=w))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = Executor()
+    exe.run(fluid.default_startup_program(), scope=scope)
+    return exe, loss
+
+
+FEED = {"x": np.arange(12, dtype=np.float32).reshape(2, 6) / 10.0}
+
+
+def test_second_run_performs_zero_relowering():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _const_train_step(scope)
+        exe.run(feed=FEED, fetch_list=[loss.name], scope=scope)
+        s1 = exe.dispatch_stats()
+        exe.run(feed=FEED, fetch_list=[loss.name], scope=scope)
+        s2 = exe.dispatch_stats()
+        assert s2["traces"] == s1["traces"]
+        assert s2["cache_hits"] == s1["cache_hits"] + 1
+
+
+def test_lazy_fetch_equals_eager_and_survives_donation():
+    # eager reference trajectory
+    scope_a = Scope()
+    with scope_guard(scope_a), program_guard(Program(), Program()):
+        exe_a, loss_a = _const_train_step(scope_a)
+        ref1, = exe_a.run(feed=FEED, fetch_list=[loss_a.name],
+                          scope=scope_a, seed=1)
+        ref2, = exe_a.run(feed=FEED, fetch_list=[loss_a.name],
+                          scope=scope_a, seed=2)
+    assert float(ref1) != float(ref2)      # SGD actually moved the params
+
+    # lazy trajectory on a fresh identical setup
+    scope_b = Scope()
+    with scope_guard(scope_b), program_guard(Program(), Program()):
+        exe_b, loss_b = _const_train_step(scope_b)
+        h1, = exe_b.run(feed=FEED, fetch_list=[loss_b.name],
+                        scope=scope_b, seed=1, return_numpy=False)
+        assert isinstance(h1, FetchHandle) and not h1.is_materialized
+        # step 2 donates step 1's parameter buffers to XLA — the fetch
+        # handle must still materialize (fetch outputs are never donated)
+        h2, = exe_b.run(feed=FEED, fetch_list=[loss_b.name],
+                        scope=scope_b, seed=2, return_numpy=False)
+        np.testing.assert_allclose(h1.numpy(), ref1, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(h2), ref2, rtol=1e-6)
+        assert h1.is_materialized and h2.is_materialized
+        # cached: a second access is the same host array, no extra sync
+        assert h1.numpy() is h1.numpy()
+
+
+def test_fetch_handle_forwards_without_sync():
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _const_train_step(scope)
+        h, = exe.run(feed=FEED, fetch_list=[loss.name], scope=scope,
+                     return_numpy=False)
+        # metadata forwards to the in-flight array without materializing
+        assert h.shape == ()
+        assert str(h.dtype) == "float32"
+        assert not h.is_materialized
+        h.block_until_ready()              # forwarded jax.Array method
+        assert not h.is_materialized       # ready != materialized
+        assert np.isfinite(float(h))       # __float__ materializes
+        assert h.is_materialized
+        assert "materialized" in repr(h)
+
+
+def test_scope_identity_is_serial_not_id():
+    # serials are monotonic: no two scopes ever share one (unlike id(),
+    # which the allocator reuses after GC)
+    assert Scope()._serial != Scope()._serial
+
+    with program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+        exe = Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+
+        def run_once():
+            sc = Scope()
+            exe.run(fluid.default_startup_program(), scope=sc)
+            out, = exe.run(feed=feed, fetch_list=[y.name], scope=sc)
+            return out
+
+        s0 = exe.dispatch_stats()
+        run_once()
+        gc.collect()                       # free the dead scope; id() reuse
+        run_once()                         # possible from here on
+        s1 = exe.dispatch_stats()
+        # each scope gets its own compiled entries (startup + main): a
+        # stale-id hit would show fewer than 4 traces
+        assert s1["traces"] - s0["traces"] == 4
+
+
+def test_prefetch_early_break_stops_producer():
+    from paddle_tpu.data.dataloader import _prefetch_to_device
+
+    produced = []
+
+    def gen():
+        for i in range(10000):
+            produced.append(i)
+            yield {"x": np.zeros((2, 2), np.float32)}
+
+    before = set(threading.enumerate())
+    it = _prefetch_to_device(gen, capacity=2)
+    next(it)                               # consume ONE batch, then bail
+    it.close()                             # GeneratorExit → stop + drain
+
+    leaked = True
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.01)
+    assert not leaked, "producer thread still alive after consumer close"
+    assert len(produced) < 10000           # it stopped mid-input
+
+
+def test_prefetch_error_propagates():
+    from paddle_tpu.data.dataloader import _prefetch_to_device
+
+    def gen():
+        yield {"x": np.zeros((2,), np.float32)}
+        raise RuntimeError("reader exploded")
+
+    it = _prefetch_to_device(gen, capacity=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        for _ in it:
+            pass
+
+
+def test_int64_wrap_warning_rearms_per_program():
+    """The first-batch int64 range check is keyed per (program, feed name):
+    program A consuming feed 'ids' must not suppress the warning for a
+    DIFFERENT program B reusing the name."""
+    import warnings
+    big = (np.ones((1, 2), dtype=np.int64) << 40)
+
+    def build_and_run():
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            ids = layers.data("ids", shape=[2], dtype="int64")
+            out = layers.mean(layers.cast(ids, "float32"))
+            exe = Executor()
+            exe.run(fluid.default_startup_program(), scope=scope)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                exe.run(feed={"ids": big}, fetch_list=[out.name],
+                        scope=scope)
+            return [x for x in w if "WRAP" in str(x.message)]
+
+    assert len(build_and_run()) == 1
+    assert len(build_and_run()) == 1       # re-armed for the new program
+
+
+def test_train_from_dataset_async_pipeline():
+    """The reworked loop (prefetch + lazy fetch + boundary materialization)
+    preserves the per-batch dump contract and the numpy return value."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _const_train_step(scope)
+        batches = [{"x": np.full((2, 6), i, np.float32)} for i in range(7)]
+        base = exe.dispatch_stats()
+        res = exe.train_from_dataset(fluid.default_main_program(),
+                                     dataset=iter(batches), scope=scope,
+                                     fetch_list=[loss])
+        s = exe.dispatch_stats()
+        assert s["steps_dispatched"] - base["steps_dispatched"] == 7
+        assert s["lazy_fetch_steps"] - base["lazy_fetch_steps"] == 7
+        assert isinstance(res[0], np.ndarray)
+        assert np.isfinite(res[0]).all()
+
+
+def test_fetch_handle_feeds_back_without_sync():
+    """A lazy fetch result used as a feed must hand XLA the wrapped device
+    array (no host sync, no 'not a valid JAX type' error)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.scale(x, scale=2.0)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        h, = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                     fetch_list=[y.name], scope=scope, return_numpy=False)
+        h2, = exe.run(feed={"x": h}, fetch_list=[y.name], scope=scope,
+                      return_numpy=False)
+        assert not h.is_materialized       # feeding back stayed on device
+        np.testing.assert_allclose(h2.numpy(), np.full((2, 4), 4.0))
+
+
+def test_fetch_handle_implicit_dunders():
+    """Implicit dunders bypass __getattr__; bool/==/+ must behave like the
+    wrapped array, and a bare instance must not recurse on attribute
+    probes (pickle-protocol lookups on unset __slots__)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[1], dtype="float32")
+        y = layers.scale(x, scale=0.0)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        h, = exe.run(feed={"x": np.ones((1, 1), np.float32)},
+                     fetch_list=[y.name], scope=scope, return_numpy=False)
+        assert bool(h) is False            # zero scalar is falsy
+        assert bool(np.all(np.asarray(h == 0.0)))
+        assert float(np.asarray(h + 1.0).ravel()[0]) == 1.0
+    bare = object.__new__(FetchHandle)
+    with pytest.raises(AttributeError):
+        bare.__setstate__                  # must not RecursionError
+
+
+def test_fast_path_plan_keys_on_mesh():
+    """A CompiledProgram can share its fingerprint with the raw Program —
+    the dispatch-plan key must include the mesh, or the mesh'd run would
+    silently reuse the single-device plan."""
+    from paddle_tpu.parallel import make_mesh  # noqa: F401 (mesh backend)
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"x": np.ones((8, 8), np.float32)}
+        exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+        cp = fluid.CompiledProgram(
+            fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+        s0 = exe.dispatch_stats()
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        s1 = exe.dispatch_stats()
+        assert s1["traces"] == s0["traces"] + 1   # not the plain plan
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        s2 = exe.dispatch_stats()
+        assert s2["traces"] == s1["traces"]       # mesh'd plan reused
+
+
+def test_dead_scope_evicts_compiled_entries():
+    """Serial cache keys never collide — which also means dead scopes'
+    entries would accumulate forever without explicit eviction.  A
+    fresh-scope-per-request loop must not leak compiled executables."""
+    with program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+        exe = Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+
+        def run_once():
+            sc = Scope()
+            exe.run(fluid.default_startup_program(), scope=sc)
+            exe.run(feed=feed, fetch_list=[y.name], scope=sc)
+
+        for _ in range(3):
+            run_once()
+        gc.collect()                       # scopes dead → finalizers fire
+        assert len(exe._cache) == 0
+        assert len(exe._plans) == 0
+
+
+def test_eager_fetch_step_drains_stale_probes():
+    """After a lazy→eager switch, the eager step's host sync proves every
+    earlier step completed — retained throttle probes must be dropped, not
+    pin the lazy phase's fetch buffers for the executor's lifetime."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _const_train_step(scope)
+        for _ in range(4):
+            exe.run(feed=FEED, fetch_list=[loss.name], scope=scope,
+                    return_numpy=False)
+        assert exe.dispatch_stats()["steps_in_flight"] > 0
+        exe.run(feed=FEED, fetch_list=[loss.name], scope=scope)
+        assert exe.dispatch_stats()["steps_in_flight"] == 0
+
+
+def test_concurrent_lazy_runs_one_executor():
+    """The in-flight deque is shared mutable state: concurrent run()
+    threads must not race the throttle's len-check/popleft into an
+    IndexError.  Inference program — concurrent TRAINING on one scope is
+    unsupported (step *i+1* donates the rw state step *i* still reads);
+    here nothing is donated, so only the throttle's shared state races."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.mean(layers.fc(x, size=3))
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        exe.run(feed=FEED, fetch_list=[y.name], scope=scope)
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(50):
+                    exe.run(feed=FEED, fetch_list=[y.name], scope=scope,
+                            return_numpy=False)
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+
+
+def test_compiled_program_reconfiguration_invalidates_cache():
+    """with_data_parallel/with_distributed mutate the mesh in place after
+    __init__ — each reconfiguration must bump the CompiledProgram serial so
+    a block compiled for the previous configuration can never be reused."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"x": np.ones((8, 8), np.float32)}
+        cp = fluid.CompiledProgram(fluid.default_main_program())
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        t0 = exe.dispatch_stats()["traces"]
+        cp.with_data_parallel(loss_name=loss.name)
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        assert exe.dispatch_stats()["traces"] == t0 + 1
+
+
+def test_reader_prefetch_int64_check_per_pipeline():
+    """prefetch_to_device mints a per-iteration check-token namespace: one
+    reader's in-range first batch must not suppress the int64-wrap warning
+    for a later reader reusing the feed name."""
+    import warnings
+    from paddle_tpu.data.reader import prefetch_to_device
+    big = (np.ones((2,), dtype=np.int64) << 40)
+
+    def mk():
+        def r():
+            yield {"label": big}
+        return r
+
+    for _ in range(2):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            list(prefetch_to_device(mk())())
+        assert len([x for x in w if "WRAP" in str(x.message)]) == 1
+
+
+def test_executor_close_rearms_only_own_programs():
+    """close() re-arms the int64 first-batch check for the programs THIS
+    executor ran — another executor's dedup tokens must survive."""
+    from paddle_tpu.framework import executor as ex_mod
+    foreign = (-12345, "ids")
+    ex_mod._checked_int64_feeds.add(foreign)
+    try:
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x = layers.data("x", shape=[2], dtype="float32")
+            y = layers.scale(x, scale=1.0)
+            exe = Executor()
+            exe.run(fluid.default_startup_program(), scope=scope)
+            exe.run(feed={"x": np.ones((1, 2), np.float32)},
+                    fetch_list=[y.name], scope=scope)
+            exe.close()
+        assert foreign in ex_mod._checked_int64_feeds
+    finally:
+        ex_mod._checked_int64_feeds.discard(foreign)
+
+
+def test_lazy_persistable_fetch_survives_donation():
+    """Fetching an rw persistable (the weight itself) lazily must not
+    alias the donated state buffer: step i+1's donation would kill the
+    handle before materialization (the lowered step copies aliased
+    fetches into their own buffers)."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _const_train_step(scope)
+        wname = next(n for n in ("fc_0.w_0", "fc_0.b_0")
+                     if scope.find_var(n) is not None)
+        h1, = exe.run(feed=FEED, fetch_list=[wname], scope=scope,
+                      return_numpy=False)
+        h2, = exe.run(feed=FEED, fetch_list=[wname], scope=scope,
+                      return_numpy=False)
+        w1, w2 = h1.numpy(), h2.numpy()   # must not raise 'Array deleted'
+        assert not np.allclose(w1, w2)    # SGD moved the weights
