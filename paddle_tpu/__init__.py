@@ -31,6 +31,7 @@ from . import dygraph  # noqa
 from . import io  # noqa
 from . import memory  # noqa
 from . import native  # noqa
+from . import monitor  # noqa  (metrics registry + step tracer)
 from . import profiler  # noqa
 from . import data  # noqa
 from .data import DataFeeder, DataLoader, PyReader  # noqa

@@ -21,7 +21,16 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import monitor as _monitor
 from .framework.core import Program
+
+_OPT_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_compiler_optimize_total",
+    "CompiledProgram graph-pass applications by program-cache outcome",
+    ("cache",))
+#: bound once: the hit side runs on every steady-state dispatch
+_OPT_HIT = _OPT_CTR.labels(cache="hit")
+_OPT_MISS = _OPT_CTR.labels(cache="miss")
 
 #: monotonic CompiledProgram identity — the executor's compiled-block
 #: cache keys on this serial: structurally-equal meshes from two
@@ -118,15 +127,21 @@ class CompiledProgram:
             cache = self._optimized_cache = {}
         prog = cache.get(key)
         if prog is None:
-            prog = self._program
-            if self._build_strategy.fuse_elewise_add_act_ops:
-                from .framework import ir
-                g = ir.Graph(prog)
-                g = ir.get_pass("fuse_elewise_add_act_pass",
-                                protected=frozenset(fetch_names)).apply(g)
-                if g.attrs.get("fuse_elewise_add_act_count"):
-                    prog = g.to_program()
+            _OPT_MISS.inc()
+            with _monitor.TRACER.span("compiler.optimize", "compile",
+                                      fetches=len(fetch_names)):
+                prog = self._program
+                if self._build_strategy.fuse_elewise_add_act_ops:
+                    from .framework import ir
+                    g = ir.Graph(prog)
+                    g = ir.get_pass(
+                        "fuse_elewise_add_act_pass",
+                        protected=frozenset(fetch_names)).apply(g)
+                    if g.attrs.get("fuse_elewise_add_act_count"):
+                        prog = g.to_program()
             cache[key] = prog
+        else:
+            _OPT_HIT.inc()
         return prog
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,

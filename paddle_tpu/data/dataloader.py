@@ -15,10 +15,51 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterable, Optional
 
 import jax
 import numpy as np
+
+from .. import monitor as _monitor
+
+#: dataloader telemetry: queue depth (gauge = live value, histogram =
+#: occupancy distribution sampled at consumer gets) and staged-batch
+#: counts.  A queue that is usually EMPTY at get time means the device is
+#: starved and the host pipeline is the bottleneck; usually FULL means
+#: compute-bound — the occupancy histogram makes that one glance.
+#: Labeled per pipeline (the staging serial) so two concurrent loaders —
+#: a saturated eval queue next to a starved train queue — never blend
+#: into one misleading series; finished pipelines fold into
+#: pipeline="retired" (totals preserved, registry growth bounded).
+_QUEUE_DEPTH = _monitor.REGISTRY.gauge(
+    "paddle_tpu_dataloader_queue_depth",
+    "current prefetch-queue depth (staged batches waiting)",
+    ("pipeline",))
+_QUEUE_OCC = _monitor.REGISTRY.histogram(
+    "paddle_tpu_dataloader_queue_occupancy",
+    "prefetch-queue depth sampled at each consumer get",
+    ("pipeline",),
+    buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+_BATCHES_STAGED = _monitor.REGISTRY.counter(
+    "paddle_tpu_dataloader_batches_staged",
+    "batches parsed + staged to device by producer threads",
+    ("pipeline",))
+
+
+def _retire_producer_series(pipe: str):
+    """Registry hygiene for the series the PRODUCER thread writes, called
+    from its own finally — the consumer's join has a timeout, so retiring
+    these from the consumer could pop cells a still-running producer then
+    bumps into the void, losing counts from the process totals.  A dead
+    pipeline's live depth is meaningless, so the gauge is just dropped."""
+    _BATCHES_STAGED.fold({"pipeline": pipe}, {"pipeline": "retired"})
+    _QUEUE_DEPTH.fold({"pipeline": pipe}, None)
+
+
+def _retire_consumer_series(pipe: str):
+    """Registry hygiene for the consumer-side occupancy histogram."""
+    _QUEUE_OCC.fold({"pipeline": pipe}, {"pipeline": "retired"})
 
 #: per-prefetch-source identity for the staging-side int64 wrap check:
 #: each loader/reader iteration gets its own token namespace, so one
@@ -99,6 +140,10 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
     err = []
     _End = object()
     src = ("stage", next(_stage_serials))
+    pipe = str(src[1])
+    depth_cell = _QUEUE_DEPTH.labels(pipeline=pipe)
+    occ_cell = _QUEUE_OCC.labels(pipeline=pipe)
+    staged_cell = _BATCHES_STAGED.labels(pipeline=pipe)
 
     def _put_or_stop(item) -> bool:
         while not stop.is_set():
@@ -114,6 +159,7 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
             for batch in batch_fn():
                 if stop.is_set():
                     return
+                tb0 = time.perf_counter()
                 if not stage:
                     staged = batch
                 elif isinstance(batch, dict):
@@ -124,18 +170,40 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
                     # the first int64 column of the source is ever scanned
                     staged = [_put(v, sharding, name=f"@{j}", src=src)
                               for j, v in enumerate(batch)]
+                staged_cell.inc()
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.add_complete(
+                        "dataloader.stage_batch", "dataloader", tb0,
+                        time.perf_counter())
                 if not _put_or_stop(staged):
                     return
+                depth = q.qsize()
+                depth_cell.set(depth)
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.counter(
+                        "dataloader.queue_depth", depth)
         except Exception as e:   # surfaced on next consumer get
             err.append(e)
         finally:
             _put_or_stop(_End)
+            _retire_producer_series(pipe)
 
-    t = threading.Thread(target=producer, daemon=True)
+    t = threading.Thread(target=producer, daemon=True,
+                         name="pt-prefetch")
     t.start()
     try:
         while True:
+            # occupancy sampled BEFORE the blocking get: 0 here means the
+            # consumer will now stall on the producer (host-bound input)
+            depth = q.qsize()
+            occ_cell.observe(depth)
+            tw0 = time.perf_counter()
             item = q.get()
+            tw1 = time.perf_counter()
+            depth_cell.set(q.qsize())
+            if _monitor.TRACER.enabled and depth == 0:
+                _monitor.TRACER.add_complete(
+                    "dataloader.wait", "dataloader", tw0, tw1)
             if item is _End:
                 if err:
                     raise err[0]
@@ -150,6 +218,7 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
             pass
         t.join(timeout=5)
         _drop_stage_tokens(src)
+        _retire_consumer_series(pipe)
 
 
 def _put(x, sharding=None, name=None, src=None):

@@ -154,20 +154,29 @@ def prefetch_to_device(reader, depth=2):
     current step computes hides host→device latency entirely.  Works on
     feed dicts (name → numpy) or bare arrays/tuples.
     """
+    import time as _time
     from collections import deque
 
+    from .. import monitor as _monitor
     from .dataloader import _put as _stage, _stage_serials
 
     def put(item, src):
         # shared staging helper: int64 feeds get their first-batch wrap
         # check on the original host values before the H2D copy
+        t0 = _time.perf_counter()
         if isinstance(item, dict):
-            return {k: _stage(v, name=k, src=src)
-                    for k, v in item.items()}
-        if isinstance(item, (list, tuple)):
-            return type(item)(_stage(v, name=f"@{j}", src=src)
-                              for j, v in enumerate(item))
-        return _stage(item, name="@", src=src)
+            out = {k: _stage(v, name=k, src=src)
+                   for k, v in item.items()}
+        elif isinstance(item, (list, tuple)):
+            out = type(item)(_stage(v, name=f"@{j}", src=src)
+                             for j, v in enumerate(item))
+        else:
+            out = _stage(item, name="@", src=src)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                "reader.stage_batch", "dataloader", t0,
+                _time.perf_counter())
+        return out
 
     def prefetching_reader():
         pending = deque()

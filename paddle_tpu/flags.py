@@ -57,6 +57,17 @@ _DEFAULTS: Dict[str, Any] = {
     # kernels ship precompiled; here first-compile is the analogous cost,
     # 20-40 s for a big train step, and the cache removes it on re-runs)
     "FLAGS_xla_compile_cache_dir": "",
+    # unified runtime telemetry (paddle_tpu.monitor): span recording for
+    # the step tracer.  The metrics REGISTRY is always live (it backs the
+    # executor dispatch counters); this flag gates only the chrome-trace
+    # span ring, which is cheap enough to default on.
+    "FLAGS_telemetry": True,
+    # when set, monitor.export() runs at process exit into this directory
+    # (metrics.json + metrics.prom + trace.json)
+    "FLAGS_telemetry_export_path": "",
+    # span ring capacity: the tracer keeps the most recent N events so a
+    # week-long training loop cannot grow host memory unbounded
+    "FLAGS_telemetry_max_events": 200000,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -89,7 +100,19 @@ def _apply_side_effects(name: str, value):
     # producing FLUID op by name (executor.py _sanitize_outputs) — more
     # actionable than jax_debug_nans, which names XLA ops and aborts the
     # step before any framework-side reporting can run.
-    if name == "FLAGS_xla_compile_cache_dir":
+    if name == "FLAGS_telemetry":
+        from . import monitor
+        monitor.TRACER.enabled = bool(value)
+    elif name == "FLAGS_telemetry_max_events":
+        from . import monitor
+        monitor.TRACER.set_capacity(int(value))
+    elif name == "FLAGS_telemetry_export_path":
+        from . import monitor
+        if value:
+            monitor.enable_export_on_exit(str(value))
+        else:
+            monitor.disable_export_on_exit()
+    elif name == "FLAGS_xla_compile_cache_dir":
         import jax
         jax.config.update("jax_compilation_cache_dir",
                           str(value) if value else None)

@@ -23,6 +23,7 @@ assignment subsumes it).
 from __future__ import annotations
 
 import collections
+import itertools
 import os
 import threading
 import time
@@ -34,8 +35,52 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import registry
+from .. import monitor as _monitor
 from .core import Block, Operator, Program, Variable, default_main_program
 from .scope import Scope, global_scope
+
+#: executor-wide telemetry families (paddle_tpu.monitor.REGISTRY): the
+#: dispatch counters below are per-executor label series of these same
+#: families, so `Executor.dispatch_stats()`, the profiler aggregate, and
+#: the JSON/Prometheus exporters read ONE store
+_THROTTLE_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_executor_throttle_wait_us",
+    "in-flight throttle: host wait per blocking probe pop (us)")
+_COMPILE_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_compile_ms",
+    "trace + lower + XLA compile wall time per fresh compiled block (ms)",
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0, 5000.0, 10000.0, 30000.0, 60000.0, 120000.0))
+_COMPILE_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_compile_total",
+    "fresh compiled blocks by persistent-cache outcome: 'write' = new "
+    "disk-cache entry persisted, 'hit' = cache dir set and no write "
+    "(disk hit, or compile under the persist threshold), 'off' = "
+    "FLAGS_xla_compile_cache_dir unset", ("persist",))
+_COLLECTIVE_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_collective_launches_total",
+    "host-launched collectives by kind (in-graph c_* ops are compiled "
+    "into the step and do not count here)", ("kind",))
+
+_HELP = {
+    "cache_hits": "dispatches served by the compiled-block cache",
+    "cache_misses": "dispatches that missed the compiled-block cache",
+    "traces": "full block re-lowerings (trace + jit)",
+    "steps_dispatched": "steps handed to the device",
+    "lazy_fetch_steps": "steps returning in-flight FetchHandles",
+    "eager_fetch_steps": "steps materializing fetches before returning",
+    "fetch_materializations": "device->host fetch syncs",
+    "throttle_waits": "blocking pops of the in-flight throttle",
+    "time_to_dispatch_us": "host us from run() entry to async-dispatch "
+                           "return",
+    "host_block_us": "total host-blocked-on-device us (all causes)",
+    "materialize_block_us": "host-blocked us in fetch materialization",
+    "throttle_block_us": "host-blocked us in the in-flight throttle",
+    "benchmark_sync_us": "host-blocked us in FLAGS_benchmark per-step "
+                         "syncs",
+}
+
+_stats_serials = itertools.count()
 
 
 class _DispatchStats:
@@ -49,6 +94,14 @@ class _DispatchStats:
     in-flight throttle, FLAGS_benchmark per-step sync).  A healthy
     steady-state loop with lazy fetches shows hits ≥ steps, zero traces,
     and host-block time concentrated at materialization boundaries.
+
+    Storage is the monitor metrics registry: each field is the
+    ``executor=<serial>`` label series of a process-wide counter family,
+    bound once here so a bump stays one lock + add (counters are hit from
+    concurrent run() threads AND FetchHandle.numpy() consumer threads —
+    a bare ``+=`` would lose updates under contention).  Because the
+    registry is the single store, a metrics export matches
+    ``dispatch_stats()`` by construction.
     """
 
     _INT_FIELDS = ("cache_hits", "cache_misses", "traces",
@@ -60,40 +113,66 @@ class _DispatchStats:
                   "benchmark_sync_us")
 
     def __init__(self):
-        # counters are bumped from concurrent run() threads AND from
-        # FetchHandle.numpy() in arbitrary consumer threads; a bare `+=`
-        # is load/add/store and loses updates under contention, which
-        # would silently undercount the bench/test assertions
-        self._mu = threading.Lock()
-        self.reset()
+        self.serial = next(_stats_serials)
+        lbl = {"executor": str(self.serial)}
+        self._fams = {
+            f: _monitor.REGISTRY.counter(
+                "paddle_tpu_executor_" + f, _HELP[f], ("executor",))
+            for f in self._INT_FIELDS + self._US_FIELDS}
+        self._cells = {f: fam.labels(**lbl)
+                       for f, fam in self._fams.items()}
+
+    def retire(self):
+        """Fold this executor's label series into ``executor="retired"``
+        and drop them: a fresh-executor-per-request loop must not grow
+        the registry one series set per executor, while process-lifetime
+        totals (``monitor.counter_totals()``) stay exact.  Called from a
+        GC finalizer on the owning executor.  The live cells are then
+        REBOUND to the retired series: a FetchHandle outliving its
+        executor still bumps fetch_materializations through this stats
+        object, and a detached cell would silently drop those counts."""
+        src = {"executor": str(self.serial)}
+        dst = {"executor": "retired"}
+        retired = {f: fam.labels(**dst) for f, fam in self._fams.items()}
+        for fam in self._fams.values():
+            fam.fold(src, dst)
+        self._cells = retired
 
     def reset(self):
-        with self._mu:
-            for f in self._INT_FIELDS:
-                setattr(self, f, 0)
-            for f in self._US_FIELDS:
-                setattr(self, f, 0.0)
+        for c in self._cells.values():
+            c.reset()
 
     def incr(self, field: str, n=1):
-        with self._mu:
-            setattr(self, field, getattr(self, field) + n)
+        self._cells[field].inc(n)
 
     def block(self, cause_field: str, dt_us: float):
         """Record ``dt_us`` of host-blocked time attributed to a cause."""
-        with self._mu:
-            setattr(self, cause_field, getattr(self, cause_field) + dt_us)
-            self.host_block_us += dt_us
-
-    def merge(self, other: "_DispatchStats"):
-        snap = other.snapshot()
-        with self._mu:
-            for f in self._INT_FIELDS + self._US_FIELDS:
-                setattr(self, f, getattr(self, f) + snap[f])
+        self._cells[cause_field].inc(dt_us)
+        self._cells["host_block_us"].inc(dt_us)
 
     def snapshot(self) -> Dict[str, Any]:
-        with self._mu:
-            return {f: getattr(self, f)
-                    for f in self._INT_FIELDS + self._US_FIELDS}
+        out = {f: int(self._cells[f].get()) for f in self._INT_FIELDS}
+        out.update({f: float(self._cells[f].get())
+                    for f in self._US_FIELDS})
+        return out
+
+
+#: host-launched collective kinds, bound once (hot-path bumps are then a
+#: lock + add, no label resolution)
+_COLL_STEP = _COLLECTIVE_CTR.labels(kind="shard_map_step")
+_COLL_ALLGATHER = _COLLECTIVE_CTR.labels(kind="process_allgather")
+_COLL_H2G = _COLLECTIVE_CTR.labels(kind="host_to_global")
+
+
+def _compile_cache_entries(cache_dir: str) -> int:
+    """File count under the persistent XLA compile cache dir (hit/miss
+    heuristic for compile telemetry; '' → cache off → -1)."""
+    if not cache_dir:
+        return -1
+    try:
+        return sum(len(files) for _, _, files in os.walk(cache_dir))
+    except OSError:
+        return -1
 
 
 #: live executors, for profiler-level aggregation (weak: an executor's
@@ -108,13 +187,20 @@ def _scope_evict_cb(exe_ref, scope_tok):
 
 
 def aggregate_dispatch_stats() -> Dict[str, Any]:
-    """Sum dispatch counters over every live Executor (profiler API)."""
-    agg = _DispatchStats()
+    """Sum dispatch counters over every live Executor (profiler API).
+
+    Live-executor semantics on purpose: an executor's series dies with it
+    here (matching the reference's per-executor profiler state), while the
+    monitor registry keeps every series for export — use
+    ``monitor.counter_totals()`` for process-lifetime totals."""
+    fields = _DispatchStats._INT_FIELDS + _DispatchStats._US_FIELDS
+    out: Dict[str, Any] = dict.fromkeys(fields, 0)
     n = 0
     for exe in list(_EXECUTORS):
-        agg.merge(exe._stats)
+        snap = exe._stats.snapshot()
+        for f in fields:
+            out[f] += snap[f]
         n += 1
-    out = agg.snapshot()
     out["executors"] = n
     return out
 
@@ -131,6 +217,12 @@ class FetchHandle:
     ``.sharding``, ``.block_until_ready``) forwards to the wrapped array
     without syncing.  Fetch buffers are never donated, so a handle stays
     valid across later steps that donate and overwrite the parameter state.
+
+    Multi-process note: on an array spanning processes, ``.numpy()`` is a
+    COLLECTIVE (``process_allgather``) — every rank must materialize
+    cross-rank fetches in the SAME order, or ranks deadlock waiting on
+    each other.  ``.local_numpy()`` materializes only this process's
+    shards with no communication and may be called rank-locally.
     """
 
     __slots__ = ("_value", "_np", "_stats")
@@ -153,12 +245,37 @@ class FetchHandle:
         if self._np is None:
             t0 = time.perf_counter()
             self._np = _fetch_to_numpy(self._value)
+            t1 = time.perf_counter()
             if self._stats is not None:
                 self._stats.incr("fetch_materializations")
-                self._stats.block(
-                    "materialize_block_us",
-                    (time.perf_counter() - t0) * 1e6)
+                self._stats.block("materialize_block_us", (t1 - t0) * 1e6)
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.add_complete(
+                    "fetch.materialize", "fetch", t0, t1)
         return self._np
+
+    def local_numpy(self) -> np.ndarray:
+        """Per-rank materialization: sync only THIS process's addressable
+        shards, concatenated along the sharded axis (batch order follows
+        the shard index order).  Unlike ``.numpy()`` — which allgathers a
+        cross-process array and is therefore a COLLECTIVE every rank must
+        enter in the same order — this never communicates, so ranks may
+        call it independently (e.g. rank-local logging/dumping).  On a
+        single process (or a fully-addressable array) it is ``.numpy()``.
+        """
+        v = self._value
+        if not isinstance(v, jax.Array) or v.is_fully_addressable:
+            return self.numpy()
+        t0 = time.perf_counter()
+        out = _assemble_local_shards(v)
+        t1 = time.perf_counter()
+        if self._stats is not None:
+            self._stats.incr("fetch_materializations")
+            self._stats.block("materialize_block_us", (t1 - t0) * 1e6)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                "fetch.materialize_local", "fetch", t0, t1)
+        return out
 
     def __array__(self, dtype=None, copy=None):
         a = self.numpy()
@@ -200,6 +317,50 @@ class FetchHandle:
         return (f"FetchHandle({state}, shape="
                 f"{getattr(self._value, 'shape', None)}, dtype="
                 f"{getattr(self._value, 'dtype', None)})")
+
+
+def _assemble_local_shards(v) -> np.ndarray:
+    """Assemble this process's addressable shards of a global array into
+    one host array, pasting each shard into the bounding box of the local
+    index set — correct for any rectangular tiling, including meshes
+    sharding two or more axes at once (a single-axis concatenate would
+    silently mis-stack those).  Replicated copies (identical index) are
+    deduped.  Slice objects are normalized to (start, stop) int tuples:
+    they are position keys, and raw slices are unhashable before
+    Python 3.12."""
+    shape = v.shape
+    parts = {}
+    for s in v.addressable_shards:
+        key = tuple((sl.start or 0,
+                     sl.stop if sl.stop is not None else dim)
+                    for sl, dim in zip(s.index, shape))
+        if key not in parts:             # replicated shard: one copy
+            parts[key] = np.asarray(s.data)
+    if len(parts) == 1:
+        return next(iter(parts.values()))
+    ndim = len(shape)
+    lo = [min(k[d][0] for k in parts) for d in range(ndim)]
+    hi = [max(k[d][1] for k in parts) for d in range(ndim)]
+    bbox_size = 1
+    for l, h in zip(lo, hi):
+        bbox_size *= h - l
+    pasted = sum(int(np.prod(a.shape)) if a.shape else 1
+                 for a in parts.values())
+    if pasted != bbox_size:
+        # shards are disjoint rectangles, so covering the bbox means the
+        # pasted volume equals it exactly; anything less would leave
+        # np.empty garbage in the gaps (e.g. a device layout interleaving
+        # processes along an axis) — refuse rather than return junk
+        raise ValueError(
+            "this process's shards do not contiguously tile their "
+            f"bounding box ({pasted} of {bbox_size} elements); no dense "
+            "local array exists — use .numpy() (collective) instead")
+    first = next(iter(parts.values()))
+    out = np.empty([h - l for l, h in zip(lo, hi)], dtype=first.dtype)
+    for key, arr in parts.items():
+        out[tuple(slice(k0 - l, k1 - l)
+                  for (k0, k1), l in zip(key, lo))] = arr
+    return out
 
 
 def _fetch_handle_binop(name):
@@ -492,7 +653,16 @@ class _CompiledBlock:
                 rw_ids = {id(v) for v in new_rw}
                 fetches = [jnp.copy(f) if id(f) in rw_ids else f
                            for f in fetches]
-            return fetches, new_rw
+            # dedicated throttle probe: a tiny COMPUTED output (a bare
+            # pass-through would alias the seed input buffer and read as
+            # ready instantly).  Its buffer becomes ready only when the
+            # step's execution completes, it is never donated, and later
+            # steps never consume it — so the in-flight throttle always
+            # has a waitable array even on fetch-less train_from_dataset
+            # loops whose rw state the next step donates.  seed is always
+            # a uint32 scalar here (_finish_run mints it).
+            probe = seed + jnp.uint32(1)
+            return fetches, new_rw, probe
 
         if collective:
             # Collective (multi-process DP) mode — ref §3.3: the whole block
@@ -530,9 +700,9 @@ class _CompiledBlock:
             def sharded_step(feeds, ro, rw, seed):
                 # per-rank RNG stream (reference multi-process trainers have
                 # independent seeds) — fold in the rank
-                seed = seed + lax.axis_index("dp").astype(
+                rank_seed = seed + lax.axis_index("dp").astype(
                     jnp.uint32) * jnp.uint32(1000003)
-                fetches, new_rw = step(feeds, ro, rw, seed)
+                fetches, new_rw, _ = step(feeds, ro, rw, rank_seed)
                 synced_rw = []
                 for v, is_p in zip(new_rw, rw_is_param):
                     if is_p:
@@ -541,7 +711,11 @@ class _CompiledBlock:
                         synced_rw.append(lax.pmean(v, "dp"))
                     else:
                         synced_rw.append(lax.pmax(v, "dp"))
-                return [f[None] for f in fetches], synced_rw
+                # probe from the PRE-fold seed: replicated by construction
+                # (its per-rank counterpart diverges and would need a
+                # collective to satisfy the replicated out_spec)
+                return [f[None] for f in fetches], synced_rw, \
+                    seed + jnp.uint32(1)
 
             # scalar feeds replicate; batched feeds shard on dim 0
             fspecs = [P("dp") if nd >= 1 else P()
@@ -551,7 +725,7 @@ class _CompiledBlock:
                 in_specs=(fspecs, [P()] * len(persist_ro),
                           [P()] * len(persist_rw), P()),
                 out_specs=([P("dp")] * len(fetch_names),
-                           [P()] * len(persist_rw)))
+                           [P()] * len(persist_rw), P()))
             try:
                 inner = shard_map(sharded_step, check_vma=False, **sm_kwargs)
             except TypeError:  # older jax: the kwarg is check_rep
@@ -570,8 +744,9 @@ class _CompiledBlock:
         if in_shardings is not None:
             kwargs["in_shardings"] = in_shardings
             # updated state must come back in its declared layout, or the
-            # next call's arg shardings mismatch the jit signature
-            kwargs["out_shardings"] = (None, list(in_shardings[2]))
+            # next call's arg shardings mismatch the jit signature; the
+            # probe output is a replicated scalar (None = let GSPMD pick)
+            kwargs["out_shardings"] = (None, list(in_shardings[2]), None)
         if program._attrs.get("is_distributed") and \
                 jax.default_backend() != "cpu":
             # PS trainer programs embed host-RPC send/recv io_callbacks,
@@ -686,6 +861,10 @@ class Executor:
         self._run_prog_ids: set = set()
         self._evict_reg: set = set()
         _EXECUTORS.add(self)
+        # registry hygiene: when this executor dies, its 13 label series
+        # fold into executor="retired" (the callback must not hold a ref
+        # to the executor — it holds only the stats object)
+        weakref.finalize(self, _DispatchStats.retire, self._stats)
 
     def close(self):
         with self._lock:
@@ -832,6 +1011,9 @@ class Executor:
                     feed_ndims=tuple(len(_feed_sig(feed[n])[0])
                                      for n in feed_names))
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
+                # first call pays trace+compile: _finish_run times it and
+                # records the persistent-cache outcome (compile telemetry)
+                cb.pending_compile = True
                 self._cache[key] = cb
             else:
                 self._stats.incr("cache_hits")
@@ -868,7 +1050,12 @@ class Executor:
         stats = self._stats
         prog_id = program.fingerprint()[0]
         self._run_prog_ids.add(prog_id)
+        ts0 = time.perf_counter()
         feeds = [_to_device(feed[n], n, prog_id) for n in cb.feed_names]
+        if _monitor.TRACER.enabled and feeds:
+            _monitor.TRACER.add_complete(
+                "executor.stage_feeds", "dataloader", ts0,
+                time.perf_counter())
         ro_vals = [_scope_fetch(scope, n) for n in cb.persist_ro]
         # read-write persistables that are READ must be initialized (optimizer
         # accumulators, BN stats, step counters) — a silent zero would corrupt
@@ -903,10 +1090,37 @@ class Executor:
             # arrays before the pjit call (the reference reaches multi-
             # host through NCCL ranks — here through jax.distributed +
             # GSPMD, SURVEY §7's comm-backend design)
+            tg0 = time.perf_counter()
             feeds, ro_vals, rw_vals, seed_arr = _to_global_arrays(
                 cb, mesh, feeds, ro_vals, rw_vals, seed_arr)
+            _COLL_H2G.inc()
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.add_complete(
+                    "collective.host_to_global", "collective", tg0,
+                    time.perf_counter())
+        # compile telemetry: a freshly-lowered block pays trace + lower +
+        # XLA compile inside its first call (the jit call blocks until the
+        # executable exists; only the execution is async).  Record the
+        # wall time and whether the persistent disk cache absorbed it —
+        # heuristically, by whether the cache dir gained an entry ('hit'
+        # also covers compiles under jax's persist threshold).
+        pending_compile = getattr(cb, "pending_compile", False)
+        if pending_compile:
+            # read-and-clear under the lock: a second thread cache-hitting
+            # this cb while the first is still inside the compiling call
+            # must not record a duplicate compile (its wall time would be
+            # time spent blocked behind the real one)
+            with self._lock:
+                pending_compile = getattr(cb, "pending_compile", False)
+                cb.pending_compile = False
+        if pending_compile:
+            from ..flags import get_flags as _gf
+            cache_dir = _gf("FLAGS_xla_compile_cache_dir")[
+                "FLAGS_xla_compile_cache_dir"]
+            n_before = _compile_cache_entries(cache_dir)
+            tc0 = time.perf_counter()
         try:
-            fetches, new_rw = cb(feeds, ro_vals, rw_vals, seed_arr)
+            fetches, new_rw, probe = cb(feeds, ro_vals, rw_vals, seed_arr)
         except Exception as e:
             # never cache a block whose trace failed (a later run with a
             # fixed scope/feed must re-lower); drop plans pointing at it too
@@ -931,9 +1145,25 @@ class Executor:
                     wrapped = RuntimeError(f"{e}\n\n{report}")
                 raise wrapped from e
             raise
+        tdisp = time.perf_counter()
+        if pending_compile:
+            outcome = ("off" if not cache_dir else
+                       "write" if _compile_cache_entries(cache_dir)
+                       > n_before else "hit")
+            _COMPILE_CTR.inc(1, persist=outcome)
+            _COMPILE_HIST.observe((tdisp - tc0) * 1e3)
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.add_complete(
+                    "xla.compile", "compile", tc0, tdisp,
+                    {"persist_cache": outcome,
+                     "fetches": list(cb.fetch_names)})
+        if cb.collective_nranks:
+            _COLL_STEP.inc()
         stats.incr("steps_dispatched")
-        stats.incr("time_to_dispatch_us",
-                   (time.perf_counter() - t0) * 1e6)
+        stats.incr("time_to_dispatch_us", (tdisp - t0) * 1e6)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete("executor.dispatch", "dispatch",
+                                         t0, tdisp)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
         from ..flags import get_flags
@@ -947,8 +1177,11 @@ class Executor:
             for v in list(new_rw) + list(fetches):
                 if hasattr(v, "block_until_ready"):
                     v.block_until_ready()
-            stats.block("benchmark_sync_us",
-                        (time.perf_counter() - tb) * 1e6)
+            tb1 = time.perf_counter()
+            stats.block("benchmark_sync_us", (tb1 - tb) * 1e6)
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.add_complete(
+                    "executor.benchmark_sync", "dispatch", tb, tb1)
             # everything queued before the flag flipped is now complete;
             # keeping the probes would only pin their buffers in HBM.
             # All _inflight mutations hold the lock: an unlocked clear()
@@ -962,16 +1195,20 @@ class Executor:
             # _inflight after the caller is done with them.  Lazy steps
             # and fetch-less eager loops (which never sync otherwise) do
             # feed the throttle.
-            self._throttle(fetches, new_rw,
+            self._throttle(probe, fetches, new_rw,
                            int(fl["FLAGS_executor_max_inflight_steps"]))
         if return_numpy:
             stats.incr("eager_fetch_steps")
             tm = time.perf_counter()
             out = [_fetch_to_numpy(f) for f in fetches]
             if fetches:
+                tm1 = time.perf_counter()
                 stats.incr("fetch_materializations", len(fetches))
-                stats.block("materialize_block_us",
-                            (time.perf_counter() - tm) * 1e6)
+                stats.block("materialize_block_us", (tm1 - tm) * 1e6)
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.add_complete(
+                        "fetch.materialize", "fetch", tm, tm1,
+                        {"n": len(fetches)})
                 # this step's fetches are on host, and per-device
                 # execution is in-order, so every earlier step's probe is
                 # complete — retaining them after a lazy→eager switch
@@ -982,15 +1219,19 @@ class Executor:
         stats.incr("lazy_fetch_steps")
         return [FetchHandle(f, stats) for f in fetches]
 
-    def _throttle(self, fetches, new_rw, limit):
+    def _throttle(self, probe, fetches, new_rw, limit):
         """Bound async run-ahead: remember one output array per dispatched
         step and block on the oldest once more than ``limit`` are in
-        flight.  Fetch buffers are preferred as the probe — they are never
-        donated, so they stay waitable; a donated rw probe that a later
-        step already consumed is skipped (per-device execution is in-order,
-        so its step is at least as old as the one that consumed it)."""
-        probe = next((v for v in list(fetches) + list(new_rw)
-                      if hasattr(v, "block_until_ready")), None)
+        flight.  The lowered step emits a dedicated tiny probe output
+        (never donated, never consumed by later steps, ready only when
+        the step's execution completes), so even a fetch-less
+        ``train_from_dataset`` loop — whose rw state the next step
+        donates — always hands the throttle a waitable array; fetch
+        buffers and rw state remain the fallback for foreign compiled
+        blocks without one."""
+        if not hasattr(probe, "block_until_ready"):
+            probe = next((v for v in list(fetches) + list(new_rw)
+                          if hasattr(v, "block_until_ready")), None)
         with self._lock:
             if probe is not None:
                 self._inflight.append(probe)
@@ -1011,9 +1252,13 @@ class Executor:
                 if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
                     tb = time.perf_counter()
                     arr.block_until_ready()
+                    tb1 = time.perf_counter()
                     stats.incr("throttle_waits")
-                    stats.block("throttle_block_us",
-                                (time.perf_counter() - tb) * 1e6)
+                    stats.block("throttle_block_us", (tb1 - tb) * 1e6)
+                    _THROTTLE_HIST.observe((tb1 - tb) * 1e6)
+                    if _monitor.TRACER.enabled:
+                        _monitor.TRACER.add_complete(
+                            "executor.throttle_wait", "dispatch", tb, tb1)
             except Exception:
                 # a probe whose buffer a later step donated is legitimately
                 # dead (is_deleted above can race the donation) — anything
@@ -1138,8 +1383,14 @@ def _fetch_to_numpy(f):
     ranks see the global stack, which is strictly more informative)."""
     if isinstance(f, jax.Array) and not f.is_fully_addressable:
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            f, tiled=True))
+        t0 = time.perf_counter()
+        out = np.asarray(multihost_utils.process_allgather(f, tiled=True))
+        _COLL_ALLGATHER.inc()
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                "collective.process_allgather", "collective", t0,
+                time.perf_counter(), {"shape": list(f.shape)})
+        return out
     return np.asarray(f)
 
 
@@ -1226,7 +1477,12 @@ def _check_int64_range(x, name, prog_id=None):
             if tok in _checked_int64_feeds:
                 return
             _checked_int64_feeds.add(tok)
+        t0 = time.perf_counter()
         lo, hi = int(x.min()), int(x.max())
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                "feed.int64_check", "dataloader", t0, time.perf_counter(),
+                {"feed": str(name)})
         bad = (hi >= 2**32) if x.dtype == np.uint64 else \
             (lo < -2**31 or hi >= 2**31)
         if bad:

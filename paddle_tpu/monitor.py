@@ -1,0 +1,598 @@
+"""Unified runtime telemetry: metrics registry + step tracer.
+
+The async step pipeline (PR 1) made the interesting time invisible — host
+work, feed staging, throttle waits, compile stalls, and fetch
+materializations all overlap device compute, so no single tool shows where
+a slow step went.  This module is the ledger the ROADMAP's "as fast as the
+hardware allows" goal needs before the next optimisation:
+
+- **Metrics registry** (``REGISTRY``): counters, gauges, and fixed-bucket
+  histograms with labels, exportable as JSON and Prometheus text format.
+  Cheap enough to stay on by default: one lock + float add per bump, no
+  allocation on the hot path (label series are resolved once and bound).
+  The executor's dispatch counters (``Executor.dispatch_stats()``) are
+  BACKED by this registry, so the per-executor view, the profiler-level
+  aggregate, and the exporters are one source of truth by construction.
+
+- **Step tracer** (``TRACER``): structured spans for the whole async
+  pipeline — dataloader staging, int64 feed checks, XLA trace+compile,
+  dispatch, in-flight throttle waits, fetch/``FetchHandle``
+  materialization, and host-launched collectives — buffered in a bounded
+  ring and exported as chrome://tracing JSON.  ``profiler.chrome_trace``
+  merges these spans with the classic ``RecordEvent`` profiler events, so
+  ``tools/timeline.py`` renders one stacked multi-rank timeline.
+
+Gating: ``FLAGS_telemetry`` (default on) enables span recording;
+``FLAGS_telemetry_export_path`` exports metrics + trace at process exit;
+metrics counters are always live (they are the dispatch-stats storage).
+
+The reference stack ships a profiler + timeline pipeline as a first-class
+subsystem (``platform/profiler.h``, ``tools/timeline.py``; SURVEY §5.1) —
+this is its registry-backed, async-pipeline-aware rebuild.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "StepTracer", "TRACER", "span", "export", "telemetry_snapshot",
+    "counter_totals",
+]
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+#: default microsecond buckets: host-side events span ~50 us (a dict probe
+#: plus dispatch) to seconds (a cold XLA compile)
+DEFAULT_BUCKETS_US = (50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 25000.0, 50000.0, 100000.0, 250000.0,
+                      500000.0, 1e6, 5e6, 30e6)
+
+
+class _Cell:
+    """One labeled series of a counter/gauge: a lock + a float.
+
+    Bound cells (via ``.labels()``) are the hot-path interface: the label
+    tuple is resolved ONCE, after which a bump is a lock acquire + add —
+    the same cost as the pre-registry dispatch counters."""
+
+    __slots__ = ("_mu", "_v")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._v = 0
+
+    def inc(self, n=1):
+        with self._mu:
+            self._v += n
+
+    def set(self, v):
+        with self._mu:
+            self._v = v
+
+    def get(self):
+        with self._mu:
+            return self._v
+
+    def reset(self):
+        with self._mu:
+            self._v = 0
+
+
+class _HistCell:
+    """One labeled series of a fixed-bucket histogram."""
+
+    __slots__ = ("_mu", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self._mu = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)   # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float):
+        with self._mu:
+            i = 0
+            for i, b in enumerate(self.buckets):       # noqa: B007
+                if v <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def snapshot(self):
+        with self._mu:
+            return list(self.counts), self.sum, self.count
+
+    def reset(self):
+        with self._mu:
+            self.counts = [0] * (len(self.buckets) + 1)
+            self.sum = 0.0
+            self.count = 0
+
+
+class _Metric:
+    """Base: a named family of labeled series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._mu = threading.Lock()
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _new_cell(self):
+        return _Cell()
+
+    def labels(self, **kv):
+        """Resolve (and memoize) the cell for a label-value combination.
+        Hot paths call this once and keep the bound cell."""
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} expects labels {self.labelnames}, "
+                f"got {tuple(kv)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._mu:
+            cell = self._series.get(key)
+            if cell is None:
+                cell = self._series[key] = self._new_cell()
+            return cell
+
+    def _default_cell(self):
+        return self.labels()
+
+    # convenience: unlabeled metrics act on their single default series
+    def reset(self):
+        with self._mu:
+            cells = list(self._series.values())
+        for c in cells:
+            c.reset()
+
+    def series(self) -> List[Tuple[Dict[str, str], Any]]:
+        with self._mu:
+            items = list(self._series.items())
+        return [(dict(zip(self.labelnames, key)), cell)
+                for key, cell in items]
+
+    def fold(self, src: Dict[str, str], dst: Optional[Dict[str, str]]):
+        """Retire the ``src`` label series: merge its value into ``dst``
+        (created on demand) and drop ``src``.  Bounds per-instance label
+        growth — a fresh-executor-per-request or loader-per-epoch loop
+        must not grow the registry forever — while preserving
+        process-lifetime totals (``counter_totals()`` still sums the
+        retired aggregate).  ``dst=None`` just drops the series (gauges:
+        a dead instance's last value is meaningless)."""
+        skey = tuple(str(src[n]) for n in self.labelnames)
+        with self._mu:
+            cell = self._series.pop(skey, None)
+        if cell is None or dst is None:
+            return
+        dcell = self.labels(**dst)
+        if isinstance(cell, _HistCell):
+            counts, s, c = cell.snapshot()
+            with dcell._mu:
+                for i, n in enumerate(counts):
+                    dcell.counts[i] += n
+                dcell.sum += s
+                dcell.count += c
+        else:
+            dcell.inc(cell.get())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n=1, **labels):
+        (self.labels(**labels) if labels or self.labelnames
+         else self._default_cell()).inc(n)
+
+    def value(self, **labels) -> float:
+        return (self.labels(**labels) if labels or self.labelnames
+                else self._default_cell()).get()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v, **labels):
+        (self.labels(**labels) if labels or self.labelnames
+         else self._default_cell()).set(v)
+
+    def inc(self, n=1, **labels):
+        (self.labels(**labels) if labels or self.labelnames
+         else self._default_cell()).inc(n)
+
+    def value(self, **labels) -> float:
+        return (self.labels(**labels) if labels or self.labelnames
+                else self._default_cell()).get()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS_US):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _new_cell(self):
+        return _HistCell(self.buckets)
+
+    def observe(self, v: float, **labels):
+        (self.labels(**labels) if labels or self.labelnames
+         else self._default_cell()).observe(v)
+
+
+class MetricsRegistry:
+    """Get-or-create metric families; collect/export them all."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: "collections.OrderedDict[str, _Metric]" = \
+            collections.OrderedDict()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, labelnames, **kw)
+                return m
+        if not isinstance(m, cls) or tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind} with "
+                f"labels {m.labelnames}")
+        if "buckets" in kw and tuple(
+                sorted(float(b) for b in kw["buckets"])) != m.buckets:
+            # a silent bucket mismatch would bin the second caller's
+            # observations into limits it never asked for
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{m.buckets}")
+        return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS_US) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name) -> Optional[_Metric]:
+        with self._mu:
+            return self._metrics.get(name)
+
+    def collect(self) -> List[Dict[str, Any]]:
+        """Snapshot every metric family as a JSON-able dict."""
+        with self._mu:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in metrics:
+            series = []
+            for labels, cell in m.series():
+                if isinstance(cell, _HistCell):
+                    counts, s, c = cell.snapshot()
+                    series.append({"labels": labels,
+                                   "buckets": list(m.buckets),
+                                   "counts": counts, "sum": s, "count": c})
+                else:
+                    series.append({"labels": labels, "value": cell.get()})
+            out.append({"name": m.name, "type": m.kind, "help": m.help,
+                        "series": series})
+        return out
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps({"metrics": self.collect()}, indent=indent)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        for m in self.collect():
+            if m["help"]:
+                lines.append(f"# HELP {m['name']} "
+                             f"{_escape_help(m['help'])}")
+            lines.append(f"# TYPE {m['name']} {m['type']}")
+            for s in m["series"]:
+                lbl = _fmt_labels(s["labels"])
+                if m["type"] == "histogram":
+                    cum = 0
+                    for b, c in zip(s["buckets"], s["counts"]):
+                        cum += c
+                        lines.append(
+                            f"{m['name']}_bucket"
+                            f"{_fmt_labels(s['labels'], le=_fmt_float(b))} "
+                            f"{cum}")
+                    cum += s["counts"][-1]
+                    lines.append(f"{m['name']}_bucket"
+                                 f"{_fmt_labels(s['labels'], le='+Inf')} "
+                                 f"{cum}")
+                    lines.append(f"{m['name']}_sum{lbl} "
+                                 f"{_fmt_float(s['sum'])}")
+                    lines.append(f"{m['name']}_count{lbl} {s['count']}")
+                else:
+                    lines.append(f"{m['name']}{lbl} "
+                                 f"{_fmt_float(s['value'])}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self):
+        """Zero every series (testing/bench isolation; keeps families)."""
+        with self._mu:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()
+
+
+def _fmt_float(v) -> str:
+    if isinstance(v, str):
+        return v
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_labels(labels: Dict[str, str], **extra) -> str:
+    items = list(labels.items()) + list(extra.items())
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in items)
+    return "{" + body + "}"
+
+
+#: the process-wide default registry — the executor's dispatch counters,
+#: the dataloader gauges, and the compile/collective telemetry all live
+#: here, so one export covers the whole runtime
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# step tracer
+# ---------------------------------------------------------------------------
+
+class StepTracer:
+    """Bounded ring of chrome-trace events for the async step pipeline.
+
+    Events are stored as tuples (ph, name, cat, tid, t_start, dur, args)
+    with perf_counter timestamps; chrome dicts are built only at export.
+    ``enabled`` is a plain bool so hot paths can guard with one attribute
+    load; recording itself is a deque append (thread-safe under the GIL,
+    auto-capped so a long training run cannot grow host memory unbounded —
+    the ring keeps the most recent events).
+    """
+
+    def __init__(self, max_events: int = 200_000):
+        self._events: collections.deque = collections.deque(
+            maxlen=max_events)
+        # guards the ring against export/resize racing producer-thread
+        # appends (a deque append alone is GIL-atomic, but a capacity
+        # swap or snapshot concurrent with appends is not)
+        self._emu = threading.Lock()
+        # epoch-aligned timebase: perf_counter gives monotonic durations,
+        # the wall anchor lets multi-rank traces stack on one axis after
+        # tools/timeline.py merges them
+        self._perf0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._tnames: Dict[int, str] = {}
+        self.enabled = True
+
+    # -- recording ----------------------------------------------------------
+    def _tid(self) -> int:
+        tid = threading.get_ident() & 0xffffff
+        if tid not in self._tnames:
+            self._tnames[tid] = threading.current_thread().name
+        return tid
+
+    def add_complete(self, name: str, cat: str, t_start: float,
+                     t_end: float, args: Optional[dict] = None):
+        """Record a complete span [t_start, t_end] (perf_counter seconds).
+        The raw API for hot paths that already hold both timestamps."""
+        if not self.enabled:
+            return
+        with self._emu:
+            self._events.append(("X", name, cat, self._tid(), t_start,
+                                 t_end - t_start, args))
+
+    def instant(self, name: str, cat: str = "",
+                args: Optional[dict] = None):
+        if not self.enabled:
+            return
+        with self._emu:
+            self._events.append(("i", name, cat, self._tid(),
+                                 time.perf_counter(), 0.0, args))
+
+    def counter(self, name: str, value: float):
+        """Chrome counter track (e.g. dataloader queue depth over time)."""
+        if not self.enabled:
+            return
+        with self._emu:
+            self._events.append(("C", name, "", self._tid(),
+                                 time.perf_counter(), 0.0,
+                                 {"value": value}))
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "", **args):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_complete(name, cat, t0, time.perf_counter(),
+                              args or None)
+
+    # -- export -------------------------------------------------------------
+    def set_capacity(self, max_events: int):
+        with self._emu:
+            self._events = collections.deque(self._events,
+                                             maxlen=int(max_events))
+
+    def clear(self):
+        with self._emu:
+            self._events.clear()
+
+    def __len__(self):
+        with self._emu:
+            return len(self._events)
+
+    def _ts_us(self, t_perf: float) -> float:
+        return (self._wall0 + (t_perf - self._perf0)) * 1e6
+
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        """Build chrome://tracing event dicts (plus thread/process name
+        metadata rows so the timeline is labeled)."""
+        pid = os.getpid()
+        out: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"paddle_tpu:{pid}"}}]
+        for tid, tname in sorted(self._tnames.items()):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+        for ph, name, cat, tid, t0, dur, args in list(self._events):
+            ev: Dict[str, Any] = {"name": name, "ph": ph, "pid": pid,
+                                  "tid": tid,
+                                  "ts": round(self._ts_us(t0), 3)}
+            if cat:
+                ev["cat"] = cat
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 3)
+            if ph == "i":
+                ev["s"] = "t"
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+
+TRACER = StepTracer()
+
+
+def span(name: str, cat: str = "", **args):
+    """``with monitor.span("executor.dispatch", "dispatch"): ...``"""
+    return TRACER.span(name, cat, **args)
+
+
+# ---------------------------------------------------------------------------
+# snapshots + export
+# ---------------------------------------------------------------------------
+
+def telemetry_snapshot() -> Dict[str, float]:
+    """Flatten the registry into {series_key: value} for easy diffing
+    (bench.py computes per-workload deltas this way).  Histograms
+    contribute ``<name>_sum`` and ``<name>_count`` per series."""
+    flat: Dict[str, float] = {}
+    for m in REGISTRY.collect():
+        for s in m["series"]:
+            key = m["name"] + _fmt_labels(s["labels"])
+            if m["type"] == "histogram":
+                flat[key + "_sum"] = s["sum"]
+                flat[key + "_count"] = s["count"]
+            else:
+                flat[key] = s["value"]
+    return flat
+
+
+def counter_totals() -> Dict[str, float]:
+    """Per-family totals summed across label series — the registry-level
+    aggregate that survives executor garbage collection (the live-executor
+    aggregate in ``profiler.dispatch_stats()`` drops executors when they
+    die; these totals do not)."""
+    out: Dict[str, float] = {}
+    for m in REGISTRY.collect():
+        if m["type"] == "histogram":
+            out[m["name"] + "_sum"] = sum(s["sum"] for s in m["series"])
+            out[m["name"] + "_count"] = sum(
+                s["count"] for s in m["series"])
+        else:
+            out[m["name"]] = sum(s["value"] for s in m["series"])
+    return out
+
+
+def export(dirpath: str, trace: bool = True) -> Dict[str, str]:
+    """Write ``metrics.json``, ``metrics.prom``, and (when ``trace``)
+    ``trace.json`` under ``dirpath``; returns {kind: path}.  The trace file
+    goes through ``profiler.chrome_trace`` so classic RecordEvent profiler
+    events and tracer spans land in ONE timeline — feed per-rank files to
+    ``tools/timeline.py`` to stack ranks."""
+    os.makedirs(dirpath, exist_ok=True)
+    paths = {}
+    p = os.path.join(dirpath, "metrics.json")
+    with open(p, "w") as f:
+        f.write(REGISTRY.to_json(indent=1))
+    paths["json"] = p
+    p = os.path.join(dirpath, "metrics.prom")
+    with open(p, "w") as f:
+        f.write(REGISTRY.to_prometheus())
+    paths["prom"] = p
+    if trace:
+        from . import profiler
+        p = os.path.join(dirpath, "trace.json")
+        profiler.chrome_trace(p)
+        paths["trace"] = p
+    return paths
+
+
+_export_at_exit: List[str] = []
+
+
+def enable_export_on_exit(dirpath: str):
+    """FLAGS_telemetry_export_path hook: export once at process exit."""
+    if not _export_at_exit:
+        import atexit
+        atexit.register(_exit_export)
+    _export_at_exit[:] = [dirpath]
+
+
+def disable_export_on_exit():
+    """Disarm a previously-enabled at-exit export (flag set back to '')."""
+    _export_at_exit[:] = []
+
+
+def _exit_export():
+    if _export_at_exit:
+        try:
+            export(_export_at_exit[0])
+        except Exception:       # never let telemetry break interpreter exit
+            pass
+
+
+def _sync_from_flags():
+    try:
+        from .flags import get_flags
+        fl = get_flags(["FLAGS_telemetry", "FLAGS_telemetry_max_events",
+                        "FLAGS_telemetry_export_path"])
+    except Exception:           # flags mid-bootstrap: side effects re-sync
+        return
+    TRACER.enabled = bool(fl["FLAGS_telemetry"])
+    if int(fl["FLAGS_telemetry_max_events"]) != TRACER._events.maxlen:
+        TRACER.set_capacity(int(fl["FLAGS_telemetry_max_events"]))
+    if fl["FLAGS_telemetry_export_path"]:
+        enable_export_on_exit(str(fl["FLAGS_telemetry_export_path"]))
+
+
+_sync_from_flags()

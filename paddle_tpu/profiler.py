@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -22,6 +23,20 @@ _py_events = []          # fallback store: (name, tid, start_ns, end_ns)
 _py_open = threading.local()
 _py_enabled = False
 _use_native = None
+
+#: wall↔monotonic anchor pair so RecordEvent timestamps (monotonic) land
+#: on the step tracer's epoch-aligned axis in one merged chrome trace —
+#: without it the two sources sit decades apart on the timeline
+_WALL0 = time.time()
+_MONO0 = time.monotonic_ns()
+#: wall time at the last native-profiler enable/reset: the native trace
+#: stamps steady-clock ns relative to its enable epoch, so adding this
+#: anchor converts it to the same epoch axis
+_native_epoch_wall = _WALL0
+
+
+def _mono_ns_to_epoch_us(t_ns: int) -> float:
+    return (_WALL0 + (t_ns - _MONO0) / 1e9) * 1e6
 
 
 def _native_ok() -> bool:
@@ -40,8 +55,9 @@ def is_profiler_enabled() -> bool:
 def start_profiler(state: str = "All", tracer_option: str = "Default"):
     """ref profiler.py start_profiler — state/tracer args accepted for
     parity; host events always recorded, device via jax.profiler."""
-    global _py_enabled
+    global _py_enabled, _native_epoch_wall
     if _native_ok():
+        _native_epoch_wall = time.time()
         native.NativeProfiler.enable()
     else:
         _py_enabled = True
@@ -64,6 +80,8 @@ def stop_profiler(sorted_key: Optional[str] = None,
 
 
 def reset_profiler():
+    # NB: the native epoch anchor is only re-stamped on enable (the C++
+    # side stores g_epoch_ns in ptn_profiler_enable, not reset)
     global _py_events
     if _native_ok():
         native.NativeProfiler.reset()
@@ -87,12 +105,43 @@ def profiler_report() -> dict:
 
 
 def chrome_trace(path: str) -> bool:
-    """Write chrome://tracing JSON (ref tools/timeline.py output)."""
+    """Write chrome://tracing JSON (ref tools/timeline.py output).
+
+    Merges BOTH event sources into one timeline: classic RecordEvent
+    profiler events (native or py fallback) and the step tracer's async-
+    pipeline spans (``monitor.TRACER`` — dataloader staging, compile,
+    dispatch/throttle, fetch materialization, collectives).  Per-rank
+    files then stack via ``tools/timeline.py``."""
+    from . import monitor as _monitor
+    tracer_events = _monitor.TRACER.chrome_events()
     if _native_ok():
-        return native.NativeProfiler.chrome_trace(path)
-    events = [{"name": n, "ph": "X", "pid": 0, "tid": t,
-               "ts": s / 1000.0, "dur": (e - s) / 1000.0}
-              for n, t, s, e in _py_events]
+        ok = native.NativeProfiler.chrome_trace(path)
+        if ok:
+            if tracer_events:
+                with open(path) as f:
+                    data = json.load(f)
+                evs = data if isinstance(data, list) else \
+                    data.setdefault("traceEvents", [])
+                # native timestamps are steady-clock us since the last
+                # enable; shift onto the tracer's epoch axis so both
+                # sources share one timeline
+                shift = _native_epoch_wall * 1e6
+                for ev in evs:
+                    if "ts" in ev:
+                        ev["ts"] = ev["ts"] + shift
+                evs.extend(tracer_events)
+                with open(path, "w") as f:
+                    json.dump(data if isinstance(data, dict)
+                              else {"traceEvents": evs}, f)
+            return True
+        events = []
+    else:
+        # epoch-aligned (same axis as the tracer spans)
+        events = [{"name": n, "ph": "X", "pid": os.getpid(), "tid": t,
+                   "ts": _mono_ns_to_epoch_us(s),
+                   "dur": (e - s) / 1000.0}
+                  for n, t, s, e in _py_events]
+    events.extend(tracer_events)
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return True

@@ -5,6 +5,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# persistent XLA compile cache (ROADMAP open item): workspace-local so
+# repeated CI rounds skip the first-compile cost; the compile-span
+# telemetry labels hits vs. writes so the effect is measurable
+export FLAGS_xla_compile_cache_dir="${FLAGS_xla_compile_cache_dir:-$PWD/.cache/xla_compile}"
+mkdir -p "$FLAGS_xla_compile_cache_dir"
+
 echo "== native runtime build =="
 make -C native
 make -C native demo_trainer
@@ -38,6 +44,9 @@ python -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])' > "$sitedi
 (cd "$venvdir" && JAX_PLATFORMS=cpu "$venvdir/bin/python" -c \
     "import paddle_tpu; paddle_tpu.install_check.run_check()")
 rm -rf "$wheeldir" "$venvdir"
+
+echo "== telemetry smoke (chrome trace + metrics export validation) =="
+JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py

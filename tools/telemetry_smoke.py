@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Telemetry smoke: run a tiny training loop with telemetry on, export
+metrics (JSON + Prometheus) and a chrome trace, and validate all three —
+the CI gate for the unified telemetry layer (paddle_tpu/monitor.py).
+
+Checks, each fatal on failure:
+  1. the chrome trace parses and is structurally valid (timeline.validate)
+  2. it contains spans from all four pipeline layers in ONE timeline:
+     dataloader staging, XLA compile, dispatch/throttle, fetch
+     materialization
+  3. the Prometheus text parses line-by-line
+  4. the JSON metrics parse, and the exported dispatch counters match
+     ``Executor.dispatch_stats()`` EXACTLY (one source of truth)
+
+Usage: JAX_PLATFORMS=cpu python tools/telemetry_smoke.py [outdir]
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fail(msg):
+    print(f"TELEMETRY SMOKE FAILED: {msg}")
+    sys.exit(1)
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="pt_telemetry_")
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers, monitor
+    from paddle_tpu.data.dataloader import _prefetch_to_device
+    from paddle_tpu.framework import (Program, Scope, program_guard,
+                                      scope_guard)
+
+    pt.set_flags({"FLAGS_telemetry": True})
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        loss = layers.mean(layers.fc(h, size=4))
+        pt.optimizer.SGD(0.01).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+
+        def batches():
+            for i in range(8):
+                yield {"x": np.full((4, 8), 0.1 * i, np.float32)}
+
+        handle = None
+        for feed in _prefetch_to_device(batches, capacity=2):
+            handle, = exe.run(feed=feed, fetch_list=[loss.name],
+                              scope=scope, return_numpy=False)
+        final = float(handle.numpy())
+        if not np.isfinite(final):
+            fail(f"training produced non-finite loss {final}")
+        stats = exe.dispatch_stats()
+        serial = exe._stats.serial
+
+    paths = monitor.export(outdir)
+    print(f"exported: {paths}")
+
+    # 1+2: chrome trace valid + all four layers in one timeline
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import timeline
+    try:
+        tstats = timeline.validate(paths["trace"])
+    except ValueError as e:
+        fail(f"chrome trace invalid: {e}")
+    required = {"dataloader", "compile", "dispatch", "fetch"}
+    missing = required - tstats["cats"]
+    if missing:
+        fail(f"trace missing layer spans: {sorted(missing)} "
+             f"(got {sorted(tstats['cats'])})")
+    for name in ("dataloader.stage_batch", "xla.compile",
+                 "executor.dispatch", "executor.throttle_wait",
+                 "fetch.materialize"):
+        if name not in tstats["names"]:
+            fail(f"trace missing span {name!r}")
+
+    # multi-rank merge path: the per-rank file must survive timeline.py
+    merged = os.path.join(outdir, "timeline_merged.json")
+    timeline.merge(f"0={paths['trace']},1={paths['trace']}", merged,
+                   align=True)
+    mstats = timeline.validate(merged)
+    if mstats["events"] != 2 * tstats["events"]:
+        fail("rank merge dropped events")
+
+    # 3: prometheus text parses
+    with open(paths["prom"]) as f:
+        prom = f.read()
+    try:
+        n_samples = timeline.validate_prometheus(prom)
+    except ValueError as e:
+        fail(f"prometheus text invalid: {e}")
+    if n_samples < 10:
+        fail(f"prometheus export suspiciously small ({n_samples} samples)")
+
+    # 4: JSON metrics parse and dispatch counters match the executor
+    with open(paths["json"]) as f:
+        metrics = {m["name"]: m for m in json.load(f)["metrics"]}
+    for field in ("steps_dispatched", "cache_hits", "cache_misses",
+                  "traces", "lazy_fetch_steps", "fetch_materializations",
+                  "throttle_waits"):
+        fam = metrics.get(f"paddle_tpu_executor_{field}")
+        if fam is None:
+            fail(f"metrics.json missing executor family {field}")
+        series = [s for s in fam["series"]
+                  if s["labels"].get("executor") == str(serial)]
+        if len(series) != 1:
+            fail(f"expected one series for executor={serial} of {field}")
+        if series[0]["value"] != stats[field]:
+            fail(f"{field}: export={series[0]['value']} != "
+                 f"dispatch_stats()={stats[field]}")
+
+    print(f"telemetry smoke OK: {tstats['events']} trace events, "
+          f"{n_samples} prom samples, dispatch counters consistent "
+          f"({stats['steps_dispatched']} steps, final loss {final:.4f})")
+
+
+if __name__ == "__main__":
+    main()

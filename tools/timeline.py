@@ -1,11 +1,17 @@
-"""Merge per-rank profiler dumps into one chrome://tracing file (ref
-``tools/timeline.py``: profile-proto → chrome trace; here the profiler
-already emits chrome JSON, so this tool merges multiple ranks' files and
-prefixes their pid/tid so they stack in one timeline).
+"""Merge per-rank profiler/telemetry dumps into one chrome://tracing file
+(ref ``tools/timeline.py``: profile-proto → chrome trace; here the
+profiler + step tracer already emit chrome JSON, so this tool merges
+multiple ranks' files and prefixes their pid so they stack in one
+timeline — one row group per rank, thread rows inside it).
 
 Usage:
     python tools/timeline.py --profile_path 0=r0.json,1=r1.json \
         --timeline_path out.json
+
+``--align`` shifts all timestamps so the earliest event across every rank
+is t=0 (the step tracer stamps epoch-aligned microseconds so ranks line
+up; aligning keeps chrome's axis readable).  ``validate()`` is the
+malformed-output check the CI telemetry smoke step runs.
 """
 
 from __future__ import annotations
@@ -13,8 +19,13 @@ from __future__ import annotations
 import argparse
 import json
 
+#: chrome trace event phases this pipeline emits; anything else in an
+#: input file is passed through untouched
+_KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s",
+                 "t", "f"}
 
-def merge(profile_paths, out_path):
+
+def merge(profile_paths, out_path, align=False):
     events = []
     for spec in profile_paths.split(","):
         if "=" in spec:
@@ -29,9 +40,79 @@ def merge(profile_paths, out_path):
             ev = dict(ev)
             ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
             events.append(ev)
+    if align:
+        t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
+        for ev in events:
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] - t0
+    # metadata rows (process/thread names) first, then by timestamp, so
+    # chrome labels every row before its first span lands
+    events.sort(key=lambda ev: (ev.get("ph") != "M", ev.get("ts", 0)))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return len(events)
+
+
+def validate(path, strict=True) -> dict:
+    """Structural check of a chrome trace file; raises ValueError on
+    malformed output.  Returns {"events": n, "cats": set, "names": set}
+    so callers can assert on coverage (the CI smoke step requires spans
+    from every pipeline layer).  ``strict=True`` additionally enforces
+    the phase/field contract THIS pipeline emits; use ``strict=False``
+    for merged traces that may contain foreign profilers' events (object
+    dumps, samples, clock sync) — those pass through unchecked."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data if isinstance(data, list) else data.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents list")
+    cats, names = set(), set()
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: event {i} is not an object")
+        ph = ev.get("ph")
+        if strict:
+            if ph not in _KNOWN_PHASES:
+                raise ValueError(f"{path}: event {i} has bad phase {ph!r}")
+            if "name" not in ev or "pid" not in ev or "tid" not in ev:
+                raise ValueError(
+                    f"{path}: event {i} missing name/pid/tid: {ev!r}")
+            if ph != "M":
+                ts = ev.get("ts")
+                if not isinstance(ts, (int, float)):
+                    raise ValueError(
+                        f"{path}: event {i} has bad ts {ts!r}")
+                if ph == "X" and not isinstance(ev.get("dur"),
+                                                (int, float)):
+                    raise ValueError(
+                        f"{path}: complete event {i} missing dur")
+        if "name" in ev:
+            names.add(ev["name"])
+        if ev.get("cat"):
+            cats.add(ev["cat"])
+    return {"events": len(events), "cats": cats, "names": names}
+
+
+def validate_prometheus(text: str) -> int:
+    """Line-level check of Prometheus text exposition format; raises
+    ValueError on a malformed line, returns the number of samples."""
+    import re
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"               # metric name
+        r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\""
+        r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\")*\})?"
+        r" ([0-9eE.+-]+|[+-]Inf|NaN)$")
+    n = 0
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("# HELP ") or \
+                line.startswith("# TYPE "):
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {ln}: bad comment {line!r}")
+        if not sample_re.match(line):
+            raise ValueError(f"line {ln}: bad sample {line!r}")
+        n += 1
+    return n
 
 
 def main(argv=None):
@@ -39,9 +120,14 @@ def main(argv=None):
     p.add_argument("--profile_path", required=True,
                    help="comma-separated [rank=]file.json entries")
     p.add_argument("--timeline_path", default="timeline.json")
+    p.add_argument("--align", action="store_true",
+                   help="shift timestamps so the earliest event is t=0")
     args = p.parse_args(argv)
-    n = merge(args.profile_path, args.timeline_path)
-    print(f"wrote {n} events to {args.timeline_path}")
+    n = merge(args.profile_path, args.timeline_path, align=args.align)
+    # lenient: merged inputs may include foreign profilers' event phases
+    stats = validate(args.timeline_path, strict=False)
+    print(f"wrote {n} events to {args.timeline_path} "
+          f"(cats: {sorted(stats['cats'])})")
 
 
 if __name__ == "__main__":
