@@ -69,10 +69,38 @@ class CheckpointManager:
         if not force and step % self._interval != 0:
             return False
         import orbax.checkpoint as ocp
-        # async write: orbax serializes with the previous save itself, so
-        # training overlaps checkpoint I/O; the rename is atomic, a
-        # preemption mid-save never corrupts the latest complete ckpt
-        return bool(self._mgr.save(step, args=ocp.args.StandardSave(state)))
+        from . import resilience as _resil
+
+        def _once() -> bool:
+            # 'checkpoint.write' injection site + retry for transient
+            # write failures (injected flakes, filesystem hiccups):
+            # orbax's own temp-dir + atomic-rename protocol makes a
+            # failed attempt safe to retry — a partial write never
+            # becomes the step's directory
+            _resil.maybe_inject("checkpoint.write")
+            try:
+                # async write: orbax serializes with the previous save
+                # itself, so training overlaps checkpoint I/O; the rename
+                # is atomic, a preemption mid-save never corrupts the
+                # latest complete ckpt
+                return bool(self._mgr.save(
+                    step, args=ocp.args.StandardSave(state)))
+            except Exception:
+                # an error raised here can belong to the PREVIOUS step's
+                # background commit (orbax surfaces async failures on the
+                # next save).  Drain the manager so the retry is a clean
+                # re-attempt of THIS step rather than re-tripping the same
+                # backlog; the drained error itself is what we re-raise.
+                try:
+                    self._mgr.wait_until_finished()
+                except Exception:
+                    pass
+                raise
+
+        return _resil.retry_call(
+            "checkpoint.write", _once,
+            retryable=lambda e: _resil.is_transient(e)
+            or isinstance(e, (OSError, TimeoutError)))
 
     # -- API (shape of orbax, semantics of fluid.io.save_persistables) ------
     def save(self, step: int, program=None, scope: Optional[Scope] = None,

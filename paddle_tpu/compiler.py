@@ -127,18 +127,31 @@ class CompiledProgram:
             cache = self._optimized_cache = {}
         prog = cache.get(key)
         if prog is None:
+            from . import resilience as _resil
             _OPT_MISS.inc()
-            with _monitor.TRACER.span("compiler.optimize", "compile",
-                                      fetches=len(fetch_names)):
-                prog = self._program
-                if self._build_strategy.fuse_elewise_add_act_ops:
-                    from .framework import ir
-                    g = ir.Graph(prog)
-                    g = ir.get_pass(
-                        "fuse_elewise_add_act_pass",
-                        protected=frozenset(fetch_names)).apply(g)
-                    if g.attrs.get("fuse_elewise_add_act_count"):
-                        prog = g.to_program()
+
+            def _build():
+                # 'compile' injection site + transient-failure retries:
+                # only faults marked transient (injected flakes, infra
+                # hiccups tagged via mark_transient) earn a retry — a
+                # real lowering error is deterministic, and re-running it
+                # would just triple the time to the same diagnosis
+                _resil.maybe_inject("compile")
+                with _monitor.TRACER.span("compiler.optimize", "compile",
+                                          fetches=len(fetch_names)):
+                    prog = self._program
+                    if self._build_strategy.fuse_elewise_add_act_ops:
+                        from .framework import ir
+                        g = ir.Graph(prog)
+                        g = ir.get_pass(
+                            "fuse_elewise_add_act_pass",
+                            protected=frozenset(fetch_names)).apply(g)
+                        if g.attrs.get("fuse_elewise_add_act_count"):
+                            prog = g.to_program()
+                    return prog
+
+            prog = _resil.retry_call("compile", _build,
+                                     retryable=_resil.is_transient)
             cache[key] = prog
         else:
             _OPT_HIT.inc()

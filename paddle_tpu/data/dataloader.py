@@ -45,6 +45,14 @@ _BATCHES_STAGED = _monitor.REGISTRY.counter(
     "paddle_tpu_dataloader_batches_staged",
     "batches parsed + staged to device by producer threads",
     ("pipeline",))
+_PRODUCER_ERRORS = _monitor.REGISTRY.counter(
+    "paddle_tpu_dataloader_producer_errors_total",
+    "producer-thread failures surfaced to the consumer (the re-raise "
+    "chains the producer traceback)")
+_PRODUCER_RESTARTS = _monitor.REGISTRY.counter(
+    "paddle_tpu_dataloader_producer_restarts_total",
+    "bounded producer restarts after an injected/transient fault "
+    "(at most one per pipeline, with backoff)")
 
 
 def _retire_producer_series(pipe: str):
@@ -155,9 +163,36 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
         return False
 
     def producer():
+        from .. import resilience as _resil
+        restarts = 0
         try:
-            for batch in batch_fn():
-                if stop.is_set():
+            it = iter(batch_fn())
+            while not stop.is_set():
+                try:
+                    # bounded restart: ONE injected-transient fault gets a
+                    # backed-off second chance.  The hook fires BEFORE the
+                    # user iterator is touched, so the restart provably
+                    # skips or duplicates no batch.  A fault raised inside
+                    # the source itself is NOT restartable this way — a
+                    # generator that raised is closed by PEP 342, and
+                    # re-calling next() would silently truncate the epoch
+                    # — so source errors always surface to the consumer.
+                    _resil.maybe_inject("dataloader.produce")
+                except Exception as e:
+                    if _resil.is_transient(e) and restarts < 1:
+                        restarts += 1
+                        _PRODUCER_RESTARTS.inc()
+                        delay = _resil.backoff_schedule(
+                            2, base_delay_s=0.05, seed=0)[0]
+                        with _monitor.TRACER.span(
+                                "retry.backoff", "resilience",
+                                site="dataloader.produce"):
+                            stop.wait(delay)
+                        continue
+                    raise
+                try:
+                    batch = next(it)
+                except StopIteration:
                     return
                 tb0 = time.perf_counter()
                 if not stage:
@@ -184,6 +219,7 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
                         "dataloader.queue_depth", depth)
         except Exception as e:   # surfaced on next consumer get
             err.append(e)
+            _PRODUCER_ERRORS.inc()
         finally:
             _put_or_stop(_End)
             _retire_producer_series(pipe)
@@ -206,7 +242,13 @@ def _prefetch_to_device(batch_fn, capacity, sharding=None, stage=True):
                     "dataloader.wait", "dataloader", tw0, tw1)
             if item is _End:
                 if err:
-                    raise err[0]
+                    # chain, don't re-raise bare: the consumer-side error
+                    # carries BOTH stacks — where the loop consumed and
+                    # (via __cause__) where the producer thread actually
+                    # failed inside the user's generator
+                    raise RuntimeError(
+                        "dataloader producer thread failed: "
+                        f"{err[0]}") from err[0]
                 return
             yield item
     finally:

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import native
+from .. import resilience as _resil
 from ..framework import core
 from ..framework.core import Program
 from ..framework.registry import register_op
@@ -51,8 +52,50 @@ _clients: Dict[str, "PSClient"] = {}
 _clients_lock = threading.Lock()
 
 
+#: per-site retry policy cache: (retry_times, deadline_ms) -> policy, so
+#: the no-failure hot path (every push/pull of every step) pays one flag
+#: read + dict probe, not a RetryPolicy allocation per RPC
+_policy_cache: Dict[tuple, "_resil.RetryPolicy"] = {}
+
+
+def _rpc(site: str, fn):
+    """Run one RPC attempt-function under the INJECTED-fault retry policy.
+
+    Layering (deliberate — see native/src/ps_server.cc request_bytes):
+    the NATIVE client owns transport retries.  It already implements the
+    ``FLAGS_rpc_retry_times`` loop with exponential backoff + reconnect,
+    and it alone can retry safely — it knows whether the request reached
+    the wire (``sent``) and refuses to replay a possibly-applied
+    non-idempotent push (``op_idempotent``), because re-sending an
+    accumulate-op that WAS applied would double-count the gradient.  A
+    Python-level retry of a native transport failure would both stack a
+    second retry loop on top of that one (quadratic attempts) and replay
+    exactly the pushes the native layer refused to.  So this wrapper
+    retries ONLY transient faults raised ABOVE the transport — the
+    ``FLAGS_fault_inject`` plane — while native errors (rc != 0) surface
+    after the native budget is spent."""
+    from ..flags import get_flags
+    fl = get_flags(["FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"])
+    key = (int(fl["FLAGS_rpc_retry_times"]), int(fl["FLAGS_rpc_deadline"]))
+    policy = _policy_cache.get(key)
+    if policy is None:
+        # one derivation of the flag->policy mapping, shared with direct
+        # retry_call('ps.*') users
+        policy = _policy_cache[key] = _resil.RetryPolicy.from_flags(site)
+    return _resil.retry_call(site, fn, policy=policy,
+                             retryable=_resil.is_transient)
+
+
 class PSClient:
-    """ctypes wrapper over the native client (ref grpc_client.h RPCClient)."""
+    """ctypes wrapper over the native client (ref grpc_client.h RPCClient).
+
+    Retry story: ``FLAGS_rpc_retry_times``/``FLAGS_rpc_deadline`` govern
+    the NATIVE transport retry loop (connect-time/env-synced — see
+    ``__init__`` and the flag side effects), which backs off, reconnects,
+    and knows which ops are safe to replay.  On top of that, every RPC
+    runs under ``_rpc`` so ``FLAGS_fault_inject`` sites (``ps.put``,
+    ``ps.get``, ...) fire inside the attempt and injected-transient
+    faults are absorbed by the same flag-sized budget."""
 
     def __init__(self, endpoint: str):
         lib = native._load()
@@ -63,12 +106,18 @@ class PSClient:
         if host in ("localhost", ""):
             host = "127.0.0.1"
         self._lib = lib
-        # plumb the registered flag to the native client (it reads the env
-        # at connect time) so paddle_tpu.set_flags governs the deadline
+        # plumb the registered flags to the native client: it reads
+        # FLAGS_rpc_deadline from the env at connect time and
+        # FLAGS_rpc_retry_times on EVERY request, so paddle_tpu.set_flags
+        # governs the native transport retry loop (the flags' side
+        # effects keep the env in sync after connect, too)
         import os
         from ..flags import get_flags
+        fl = get_flags(["FLAGS_rpc_deadline", "FLAGS_rpc_retry_times"])
         os.environ["FLAGS_rpc_deadline"] = str(int(
-            get_flags("FLAGS_rpc_deadline")["FLAGS_rpc_deadline"]))
+            fl["FLAGS_rpc_deadline"]))
+        os.environ["FLAGS_rpc_retry_times"] = str(int(
+            fl["FLAGS_rpc_retry_times"]))
         self._h = lib.ps_client_connect(host.encode(), int(port))
         if not self._h:
             raise ConnectionError(f"cannot connect to pserver {endpoint}")
@@ -94,64 +143,87 @@ class PSClient:
         return a, a.ctypes.data_as(ctypes.c_void_p)
 
     def put(self, name: str, value, dtype=None) -> None:
-        a, p = self._buf(value, dtype)
-        rc = self._lib.ps_client_put(self._h, name.encode(), p, a.size)
-        if rc != 0:
-            raise RuntimeError(
-                f"ps put({name}) failed (server down or FLAGS_rpc_deadline "
-                "exceeded?)")
+        a, p = self._buf(value, dtype)    # dtype errors must NOT retry
+
+        def _once():
+            _resil.maybe_inject("ps.put")
+            rc = self._lib.ps_client_put(self._h, name.encode(), p, a.size)
+            if rc != 0:
+                raise RuntimeError(
+                    f"ps put({name}) failed (server down or "
+                    "FLAGS_rpc_deadline exceeded?)")
+        _rpc("ps.put", _once)
 
     def get(self, name: str, size: int, barrier: bool = True, dtype=None):
         import ctypes
+        self._check_dtype(dtype)
         out = np.empty(size, np.float32)
         fn = self._lib.ps_client_get if barrier else \
             self._lib.ps_client_get_nobarrier
-        n = fn(self._h, name.encode(),
-               out.ctypes.data_as(ctypes.c_void_p), size)
-        if n != size:
-            raise RuntimeError(
-                f"ps get({name}): expected {size} floats, got {n} "
-                "(unknown table)" if n == -2 else
-                f"ps get({name}): expected {size} floats, got {n} "
-                "(mis-sized table, server down, or FLAGS_rpc_deadline "
-                "exceeded?)")
-        self._check_dtype(dtype)
+
+        def _once():
+            _resil.maybe_inject("ps.get")
+            n = fn(self._h, name.encode(),
+                   out.ctypes.data_as(ctypes.c_void_p), size)
+            if n == -2:
+                # deterministic server verdict — _rpc never retries
+                # native errors, so this fails fast by construction
+                raise RuntimeError(f"ps get({name}): expected {size} "
+                                   f"floats, got {n} (unknown table)")
+            if n != size:
+                raise RuntimeError(
+                    f"ps get({name}): expected {size} floats, got {n} "
+                    "(mis-sized table, server down, or FLAGS_rpc_deadline "
+                    "exceeded?)")
+        _rpc("ps.get", _once)
         if dtype is not None:
             return out.view(dtype)
         return out
 
     def push_dense(self, name: str, grad) -> None:
         a, p = self._buf(grad)
-        rc = self._lib.ps_client_push_dense(self._h, name.encode(), p,
-                                            a.size)
-        if rc != 0:
-            raise RuntimeError(
-                f"ps push_dense({name}) failed — gradient would be "
-                "silently dropped (unknown table or server down)")
+
+        def _once():
+            _resil.maybe_inject("ps.push_dense")
+            rc = self._lib.ps_client_push_dense(self._h, name.encode(), p,
+                                                a.size)
+            if rc != 0:
+                raise RuntimeError(
+                    f"ps push_dense({name}) failed — gradient would be "
+                    "silently dropped (unknown table or server down)")
+        _rpc("ps.push_dense", _once)
 
     def push_sparse(self, name: str, rows, grad) -> None:
         import ctypes
         r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
         a, p = self._buf(grad)
-        rc = self._lib.ps_client_push_sparse(
-            self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
-            len(r), p, a.size)
-        if rc != 0:
-            raise RuntimeError(
-                f"ps push_sparse({name}) failed — gradient would be "
-                "silently dropped (unknown table or server down)")
+
+        def _once():
+            _resil.maybe_inject("ps.push_sparse")
+            rc = self._lib.ps_client_push_sparse(
+                self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
+                len(r), p, a.size)
+            if rc != 0:
+                raise RuntimeError(
+                    f"ps push_sparse({name}) failed — gradient would be "
+                    "silently dropped (unknown table or server down)")
+        _rpc("ps.push_sparse", _once)
 
     def get_rows(self, name: str, rows, width: int):
         import ctypes
         r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
         out = np.empty(len(r) * width, np.float32)
-        n = self._lib.ps_client_get_rows(
-            self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
-            len(r), out.ctypes.data_as(ctypes.c_void_p), out.size)
-        if n != out.size:
-            raise RuntimeError(
-                f"ps get_rows({name}): expected {out.size} floats, got {n} "
-                "(unknown table or wrong width?)")
+
+        def _once():
+            _resil.maybe_inject("ps.get_rows")
+            n = self._lib.ps_client_get_rows(
+                self._h, name.encode(), r.ctypes.data_as(ctypes.c_void_p),
+                len(r), out.ctypes.data_as(ctypes.c_void_p), out.size)
+            if n != out.size:
+                raise RuntimeError(
+                    f"ps get_rows({name}): expected {out.size} floats, got "
+                    f"{n} (unknown table or wrong width?)")
+        _rpc("ps.get_rows", _once)
         return out.reshape(len(r), width)
 
     def barrier(self) -> None:
@@ -182,24 +254,34 @@ class PSClient:
         import ctypes
         code, d = self._typed_code(dtype)
         a = np.ascontiguousarray(np.asarray(value).ravel(), d)
-        rc = self._lib.ps_client_put_typed(
-            self._h, name.encode(), a.ctypes.data_as(ctypes.c_void_p),
-            a.size, code)
-        if rc != 0:
-            raise RuntimeError(f"ps put_typed({name}) failed")
+
+        def _once():
+            _resil.maybe_inject("ps.put_typed")
+            rc = self._lib.ps_client_put_typed(
+                self._h, name.encode(), a.ctypes.data_as(ctypes.c_void_p),
+                a.size, code)
+            if rc != 0:
+                raise RuntimeError(f"ps put_typed({name}) failed")
+        _rpc("ps.put_typed", _once)
 
     def get_typed(self, name: str, size: int, dtype):
         import ctypes
         code, d = self._typed_code(dtype)
         out = np.empty(size, d)
-        n = self._lib.ps_client_get_typed(
-            self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
-            size, code)
-        if n != size:
-            raise RuntimeError(
-                f"ps get_typed({name}): expected {size} elems, got {n} "
-                "(unknown table or dtype mismatch)" if n == -2 else
-                f"ps get_typed({name}): expected {size} elems, got {n}")
+
+        def _once():
+            _resil.maybe_inject("ps.get_typed")
+            n = self._lib.ps_client_get_typed(
+                self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
+                size, code)
+            if n == -2:
+                raise RuntimeError(
+                    f"ps get_typed({name}): expected {size} elems, got "
+                    f"{n} (unknown table or dtype mismatch)")
+            if n != size:
+                raise RuntimeError(
+                    f"ps get_typed({name}): expected {size} elems, got {n}")
+        _rpc("ps.get_typed", _once)
         return out
 
     def push_typed(self, name: str, grad, dtype, rows=None) -> None:
@@ -214,11 +296,15 @@ class PSClient:
         else:
             r = np.ascontiguousarray(np.asarray(rows).ravel(), np.uint32)
             rp, nr = r.ctypes.data_as(ctypes.c_void_p), len(r)
-        rc = self._lib.ps_client_push_typed(
-            self._h, name.encode(), rp, nr,
-            a.ctypes.data_as(ctypes.c_void_p), a.size, code)
-        if rc != 0:
-            raise RuntimeError(f"ps push_typed({name}) failed")
+
+        def _once():
+            _resil.maybe_inject("ps.push_typed")
+            rc = self._lib.ps_client_push_typed(
+                self._h, name.encode(), rp, nr,
+                a.ctypes.data_as(ctypes.c_void_p), a.size, code)
+            if rc != 0:
+                raise RuntimeError(f"ps push_typed({name}) failed")
+        _rpc("ps.push_typed", _once)
 
     def close(self) -> None:
         if self._h:

@@ -68,6 +68,17 @@ _DEFAULTS: Dict[str, Any] = {
     # span ring capacity: the tracer keeps the most recent N events so a
     # week-long training loop cannot grow host memory unbounded
     "FLAGS_telemetry_max_events": 200000,
+    # fault-tolerance layer (paddle_tpu.resilience): deterministic fault
+    # injection ("site:spec[;site:spec]", e.g. "ps.put:every=3;
+    # dataloader.produce:p=0.1,seed=7") — empty disables every hook
+    "FLAGS_fault_inject": "",
+    # hung-step watchdog: a watched dispatch/materialize exceeding this
+    # many seconds dumps all thread stacks + the telemetry ring and
+    # raises HungStepError in the hung thread.  0 disables (default —
+    # first compiles can legitimately take tens of seconds).
+    "FLAGS_watchdog_timeout_s": 0.0,
+    # where watchdog dumps land ("" = the system temp dir)
+    "FLAGS_watchdog_dump_dir": "",
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -112,6 +123,17 @@ def _apply_side_effects(name: str, value):
             monitor.enable_export_on_exit(str(value))
         else:
             monitor.disable_export_on_exit()
+    elif name == "FLAGS_fault_inject":
+        from . import resilience
+        resilience.configure(str(value))   # already validated in set_flags
+    elif name == "FLAGS_watchdog_timeout_s":
+        from . import resilience
+        resilience.WATCHDOG.set_timeout(float(value))
+    elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
+        # the NATIVE ps client reads these via getenv (retry_times per
+        # request, deadline at connect) — mirror flag changes into the
+        # env so set_flags governs the transport retry loop
+        os.environ[name] = str(int(value))
     elif name == "FLAGS_xla_compile_cache_dir":
         import jax
         jax.config.update("jax_compilation_cache_dir",
@@ -134,6 +156,12 @@ def set_flags(flags: Dict[str, Any]):
         if name not in _DEFAULTS:
             raise ValueError(f"unknown flag {name!r}")
         coerced[name] = _coerce(name, value)
+        if name == "FLAGS_fault_inject":
+            # parse HERE, in the validate-before-apply phase: a typo'd
+            # spec must neither half-apply this set_flags call nor be
+            # stored while silently never injecting
+            from . import resilience
+            resilience.parse_fault_inject(coerced[name])
     for name, value in coerced.items():
         _values[name] = value
         _apply_side_effects(name, value)

@@ -36,6 +36,7 @@ import numpy as np
 
 from . import registry
 from .. import monitor as _monitor
+from .. import resilience as _resil
 from .core import Block, Operator, Program, Variable, default_main_program
 from .scope import Scope, global_scope
 
@@ -244,7 +245,9 @@ class FetchHandle:
     def numpy(self) -> np.ndarray:
         if self._np is None:
             t0 = time.perf_counter()
-            self._np = _fetch_to_numpy(self._value)
+            with _resil.WATCHDOG.watch("fetch.materialize"):
+                _resil.maybe_inject("fetch.materialize")
+                self._np = _fetch_to_numpy(self._value)
             t1 = time.perf_counter()
             if self._stats is not None:
                 self._stats.incr("fetch_materializations")
@@ -1120,15 +1123,30 @@ class Executor:
             n_before = _compile_cache_entries(cache_dir)
             tc0 = time.perf_counter()
         try:
-            fetches, new_rw, probe = cb(feeds, ro_vals, rw_vals, seed_arr)
+            # watchdog: a dispatch (incl. a first-call compile) exceeding
+            # FLAGS_watchdog_timeout_s becomes a HungStepError with a
+            # stack+telemetry dump instead of an indefinite hang; the
+            # injection hook fires INSIDE the watched region so a
+            # 'hang'-mode fault exercises exactly that path
+            with _resil.WATCHDOG.watch("executor.dispatch"):
+                _resil.maybe_inject("executor.dispatch")
+                fetches, new_rw, probe = cb(feeds, ro_vals, rw_vals,
+                                            seed_arr)
         except Exception as e:
             # never cache a block whose trace failed (a later run with a
-            # fixed scope/feed must re-lower); drop plans pointing at it too
-            with self._lock:
-                self._cache.pop(key, None)
-                for fk in [k for k, p in self._plans.items()
-                           if p.key == key]:
-                    self._plans.pop(fk, None)
+            # fixed scope/feed must re-lower); drop plans pointing at it
+            # too.  Injected faults and watchdog expirations are raised
+            # AROUND the call, not by a failed trace — evicting on those
+            # would make every recovered fault pay a full re-lower, so
+            # resilience drills would measure recompile cost, not
+            # recovery cost.
+            if not isinstance(e, (_resil.InjectedFault,
+                                  _resil.HungStepError)):
+                with self._lock:
+                    self._cache.pop(key, None)
+                    for fk in [k for k, p in self._plans.items()
+                               if p.key == key]:
+                        self._plans.pop(fk, None)
             from .. import memory as _memory
             if _memory._is_oom_error(e):
                 # an on-chip OOM is a raw XLA error; attach what was
@@ -1200,7 +1218,9 @@ class Executor:
         if return_numpy:
             stats.incr("eager_fetch_steps")
             tm = time.perf_counter()
-            out = [_fetch_to_numpy(f) for f in fetches]
+            with _resil.WATCHDOG.watch("fetch.materialize"):
+                _resil.maybe_inject("fetch.materialize")
+                out = [_fetch_to_numpy(f) for f in fetches]
             if fetches:
                 tm1 = time.perf_counter()
                 stats.incr("fetch_materializations", len(fetches))
@@ -1269,6 +1289,28 @@ class Executor:
                 # error text (XLA failure messages can mention donation)
                 if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
                     raise
+
+    def drain(self) -> int:
+        """Block until every in-flight dispatched step has retired, leaving
+        the scope's persistable state fully computed — the preemption
+        guard's pre-checkpoint barrier (``PreemptionGuard.drain``), also
+        useful before forking or snapshotting externally.  Returns the
+        number of steps waited on.  Deleted probes (their buffer donated
+        to a later step) are skipped, same as ``_throttle``; a real async
+        device failure surfacing here re-raises."""
+        with self._lock:
+            pending = list(self._inflight)
+            self._inflight.clear()
+        waited = 0
+        for arr in pending:
+            try:
+                if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
+                    arr.block_until_ready()
+                    waited += 1
+            except Exception:
+                if not (hasattr(arr, "is_deleted") and arr.is_deleted()):
+                    raise
+        return waited
 
     def infer_from_program(self, *a, **k):
         return self.run(*a, **k)

@@ -42,6 +42,96 @@ def get_program_persistable_vars(program: Program) -> List[Variable]:
     return [v for v in program.list_vars() if _is_persistable(v)]
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory entry so renames inside it survive a crash (a
+    file's own fsync does not persist its directory entry)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return                   # platform without dir-open (best effort)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_publish_dir(tmpdir: str, dst: str,
+                        preserve_existing: bool = True) -> None:
+    """Publish a fully-written staging dir at ``dst`` near-atomically.
+
+    A crash during the (long) blob-writing phase touches only ``tmpdir``
+    — the previously-good ``dst`` stays intact, which is the whole point:
+    the old in-place writer corrupted a good param dir the moment it
+    started overwriting.  When ``dst`` already exists, files it holds that
+    the staging dir does not (e.g. ``__model__`` written by
+    ``save_inference_model``, or a user's ``assets/`` subdir) are first
+    copied in, then the dirs swap via two renames (POSIX rename cannot
+    replace a non-empty dir in one shot; the window between the renames
+    is two syscalls wide, vs. the entire serialization before).  A
+    process dying INSIDE that window leaves the good data parked at
+    ``<dst>.old.<pid>`` — :func:`_recover_interrupted_swap` (run by
+    ``load_vars``) renames it back, so even that crash is recoverable."""
+    import shutil
+    dst = os.path.abspath(dst)
+    if os.path.isdir(dst):
+        if preserve_existing:
+            for entry in os.listdir(dst):
+                s = os.path.join(dst, entry)
+                d = os.path.join(tmpdir, entry)
+                if os.path.exists(d):
+                    continue     # the fresh save wins
+                # hard-link, not copy: tmpdir is a sibling on the same
+                # filesystem by construction, so preserving a large
+                # foreign assets/ tree costs directory entries, not a
+                # re-read/re-write of its bytes (copy2 fallback covers
+                # filesystems without link support)
+                try:
+                    if os.path.isdir(s):
+                        shutil.copytree(s, d, copy_function=os.link)
+                    elif os.path.isfile(s):
+                        os.link(s, d)
+                except OSError:
+                    if os.path.isdir(s):
+                        shutil.rmtree(d, ignore_errors=True)
+                        shutil.copytree(s, d)
+                    elif os.path.isfile(s):
+                        shutil.copy2(s, d)
+        old = dst + f".old.{os.getpid()}"
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(dst, old)
+        try:
+            os.rename(tmpdir, dst)
+        except BaseException:
+            os.rename(old, dst)      # roll the good dir back into place
+            raise
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmpdir, dst)
+    _fsync_dir(os.path.dirname(dst) or ".")
+
+
+def _recover_interrupted_swap(dirname: str) -> None:
+    """If ``dirname`` is missing but a ``<dirname>.old.<pid>`` sibling
+    exists, a saver died inside the two-rename publish window — the
+    sibling IS the last complete save, so rename it back into place
+    (newest first when several crashed savers left debris)."""
+    import glob
+    import warnings
+    dst = os.path.abspath(dirname)
+    if os.path.isdir(dst):
+        return
+    leftovers = sorted(glob.glob(dst + ".old.*"), key=os.path.getmtime)
+    if not leftovers:
+        return
+    warnings.warn(
+        f"param dir {dirname!r} missing but {leftovers[-1]!r} exists — a "
+        "save died mid-publish; recovering the last complete state")
+    os.rename(leftovers[-1], dst)
+    _fsync_dir(os.path.dirname(dst) or ".")
+
+
 def _scope_value(scope: Scope, name: str) -> np.ndarray:
     v = scope.find_var(name)
     if v is None:
@@ -59,41 +149,73 @@ def _scope_value(scope: Scope, name: str) -> np.ndarray:
 
 def save_vars(executor=None, dirname=None, main_program=None, vars=None,
               predicate=None, filename=None, scope=None):
-    """ref io.py save_vars — writes each var (or a combined file)."""
+    """ref io.py save_vars — writes each var (or a combined file).
+
+    Atomic: blobs + ``__meta__.json`` are staged into a temp sibling dir,
+    fsynced, and swapped into place — a crash mid-save leaves the
+    previously-good param dir untouched instead of half-overwritten.
+
+    Single-writer contract: concurrent saves of the SAME dirname from two
+    processes now fail loudly at the swap (one rank's rename finds the dir
+    gone) — multi-rank jobs must save from one rank, as they always had
+    to for a coherent snapshot (the old in-place writer interleaved both
+    ranks' blobs into one silently torn directory)."""
+    import shutil
     program = main_program or default_main_program()
     scope = scope or global_scope()
     if vars is None:
         vars = [v for v in program.list_vars()
                 if (predicate or _is_persistable)(v)]
-    os.makedirs(dirname, exist_ok=True)
-    # canonical C-order blobs: device fetches can come back
-    # Fortran-contiguous, which non-numpy consumers (demo_predictor.cc)
-    # would reject
-    arrays = {v.name: np.ascontiguousarray(_scope_value(scope, v.name))
-              for v in vars}
-    # bf16 params travel as a uint16 bit view ('<u2' npy): numpy can't
-    # round-trip the ml_dtypes descr, and the native predictor widens the
-    # u2 payload back to f32 (demo_predictor.cc LoadNpy); the true dtype
-    # is recorded in the meta so load_vars can view it back
-    dtypes = {name: str(arr.dtype) for name, arr in arrays.items()}
-    arrays = {name: (arr.view(np.uint16)
-                     if str(arr.dtype) == "bfloat16" else arr)
-              for name, arr in arrays.items()}
-    if filename is not None:
-        np.savez(os.path.join(dirname, filename), **arrays)
-    else:
-        for name, arr in arrays.items():
-            np.save(os.path.join(dirname, name.replace("/", "__")), arr)
-    meta = {name: {"shape": list(arr.shape), "dtype": dtypes[name]}
-            for name, arr in arrays.items()}
-    from .framework.core import PROGRAM_FORMAT_VERSION
-    from . import __version__
-    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
-        json.dump({"filename": filename, "vars": meta,
-                   # ref framework/version.h kCurTensorVersion: stamp the
-                   # parameter blobs so cross-version loads are detectable
-                   "version": PROGRAM_FORMAT_VERSION,
-                   "framework_version": __version__}, f)
+    dst = os.path.abspath(dirname)
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    tmpdir = dst.rstrip(os.sep) + f".tmp.{os.getpid()}"
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    os.makedirs(tmpdir)
+    try:
+        # canonical C-order blobs: device fetches can come back
+        # Fortran-contiguous, which non-numpy consumers (demo_predictor.cc)
+        # would reject
+        arrays = {v.name: np.ascontiguousarray(_scope_value(scope, v.name))
+                  for v in vars}
+        # bf16 params travel as a uint16 bit view ('<u2' npy): numpy can't
+        # round-trip the ml_dtypes descr, and the native predictor widens
+        # the u2 payload back to f32 (demo_predictor.cc LoadNpy); the true
+        # dtype is recorded in the meta so load_vars can view it back
+        dtypes = {name: str(arr.dtype) for name, arr in arrays.items()}
+        arrays = {name: (arr.view(np.uint16)
+                         if str(arr.dtype) == "bfloat16" else arr)
+                  for name, arr in arrays.items()}
+        if filename is not None:
+            np.savez(os.path.join(tmpdir, filename), **arrays)
+        else:
+            for name, arr in arrays.items():
+                np.save(os.path.join(tmpdir, name.replace("/", "__")), arr)
+        meta = {name: {"shape": list(arr.shape), "dtype": dtypes[name]}
+                for name, arr in arrays.items()}
+        from .framework.core import PROGRAM_FORMAT_VERSION
+        from . import __version__
+        with open(os.path.join(tmpdir, "__meta__.json"), "w") as f:
+            json.dump({"filename": filename, "vars": meta,
+                       # ref framework/version.h kCurTensorVersion: stamp
+                       # the parameter blobs so cross-version loads are
+                       # detectable
+                       "version": PROGRAM_FORMAT_VERSION,
+                       "framework_version": __version__}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        for entry in os.listdir(tmpdir):
+            if entry == "__meta__.json":
+                continue         # already synced above
+            fd = os.open(os.path.join(tmpdir, entry), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        _fsync_dir(tmpdir)
+        _atomic_publish_dir(tmpdir, dst)
+    except BaseException:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        raise
 
 
 def save_params(executor=None, dirname=None, main_program=None, filename=None,
@@ -115,6 +237,7 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
     """ref io.py load_vars."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
+    _recover_interrupted_swap(dirname)
     meta_path = os.path.join(dirname, "__meta__.json")
     if os.path.exists(meta_path):
         from .framework.core import PROGRAM_FORMAT_VERSION
@@ -211,6 +334,7 @@ def load_inference_model(dirname, executor=None, model_filename=None,
                          params_filename=None, scope=None):
     """ref io.py:1113 → (program, feed_names, fetch_vars-as-names)."""
     scope = scope or global_scope()
+    _recover_interrupted_swap(dirname)
     model_path = os.path.join(dirname, model_filename or "__model__")
     with open(model_path, "rb") as f:
         payload = json.loads(f.read().decode("utf-8"))

@@ -1,0 +1,806 @@
+"""Fault-tolerant training runtime: fault injection, retry/backoff,
+preemption drain, and a hung-step watchdog.
+
+The reference Fluid stack shipped a real failure story — ``FLAGS_rpc_retry_
+times``/``FLAGS_rpc_deadline`` on the PS RPC plane (``grpc_client.cc``
+retries) and ``checkpoint_notify`` snapshots — but until this layer the
+rebuild only carried the flags.  On TPU the dominant failure mode is
+preemption and transient infra flake, so every layer that talks to the
+outside world (PS RPCs, the dataloader producer thread, XLA compiles,
+checkpoint writes, executor dispatch) gets a supervision story here:
+
+- **Fault injection** (``FLAGS_fault_inject="site:spec[;site:spec...]"``):
+  deterministic, flag-driven fault hooks compiled into the dataloader
+  producer, the compiler's graph-pass path, executor dispatch, checkpoint
+  writes, and every ``PSClient`` RPC.  Spec grammar (comma-joined keys)::
+
+      ps.put:every=3              # every 3rd call raises
+      compile:once@2              # exactly the 2nd call (also once@step2)
+      dataloader.produce:p=0.1,seed=7   # Bernoulli, deterministic stream
+      checkpoint.write:times=2    # the first 2 calls
+      executor.dispatch:once,hang=30    # 2nd form: hang instead of raise
+
+  Injected faults raise :class:`InjectedFault` (transient by contract) and
+  bump ``paddle_tpu_fault_injected_total{site=...}`` — so a test can assert
+  the exact number of faults the spec implies.
+
+- **Retry engine**: :func:`retry_call` runs a callable under a
+  :class:`RetryPolicy` (exponential backoff + deterministic jitter, capped
+  by an optional deadline).  Checkpoint writes, transient compile
+  failures, and the PS injection plane ride it; PS *transport* retries
+  belong to the native client (which already implements the
+  ``FLAGS_rpc_retry_times`` loop and alone knows which ops are safe to
+  replay) — the flags' side effects mirror
+  ``FLAGS_rpc_retry_times``/``FLAGS_rpc_deadline`` into the env so
+  ``set_flags`` finally governs that loop.  Every retry bumps
+  ``paddle_tpu_retry_attempts_total{site=...}`` and records a
+  ``retry.backoff`` tracer span; exhausted budgets bump
+  ``paddle_tpu_retry_giveups_total{site=...}``.
+
+- **Preemption drain** (:class:`PreemptionGuard`): a context manager that
+  installs SIGTERM/SIGINT handlers; the training loop polls
+  ``guard.preempted`` at step boundaries (the handler only sets a flag —
+  never checkpoints mid-step), and guard exit drains the executor's
+  in-flight throttle queue, writes an emergency ``CheckpointManager``
+  checkpoint at the last *complete* step, exports telemetry, and exits
+  cleanly.  :func:`resume_or_init` restarts a ``train_from_dataset``-style
+  loop from the last complete step.
+
+- **Hung-step watchdog** (``FLAGS_watchdog_timeout_s``): executor dispatch
+  and fetch materialization run under ``WATCHDOG.watch(site)``; a step
+  exceeding the deadline gets all thread stacks + the metrics registry +
+  the telemetry ring dumped to ``FLAGS_watchdog_dump_dir`` and a
+  :class:`HungStepError` naming the dump file raised in the hung thread —
+  a diagnosable failure instead of a silent CI timeout.  (The async raise
+  lands at the next Python bytecode boundary; a thread hung inside a C
+  call still gets the dump immediately and the error on return.)
+
+Every recovery action is observable through the PR 2 registry/tracer, so
+the layer is testable end to end: inject faults, assert on exported
+counters (``tools/resilience_smoke.py`` is the CI gate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import itertools
+import json
+import os
+import random
+import re
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from . import monitor as _monitor
+
+__all__ = [
+    "InjectedFault", "HungStepError", "is_transient", "mark_transient",
+    "FaultSpec", "parse_fault_inject", "configure", "maybe_inject",
+    "backoff_schedule", "RetryPolicy", "retry_call",
+    "PreemptionGuard", "resume_or_init",
+    "Watchdog", "WATCHDOG", "dump_state",
+]
+
+# ---------------------------------------------------------------------------
+# metrics (one family per recovery action; per-site label series)
+# ---------------------------------------------------------------------------
+
+_FAULT_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_fault_injected_total",
+    "faults fired by the FLAGS_fault_inject framework", ("site",))
+_RETRY_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_retry_attempts_total",
+    "retries performed after a transient failure (first attempts do not "
+    "count — a clean run exports 0)", ("site",))
+_GIVEUP_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_retry_giveups_total",
+    "operations abandoned after exhausting their retry/deadline budget",
+    ("site",))
+_WATCHDOG_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_watchdog_fired_total",
+    "hung-step watchdog expirations (each writes a stack+telemetry dump)",
+    ("site",))
+_PREEMPT_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_preemption_signals_total",
+    "SIGTERM/SIGINT deliveries observed by a PreemptionGuard", ("signal",))
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """A deterministic fault fired by ``FLAGS_fault_inject`` — transient by
+    contract, so the retry engine absorbs it wherever a retry policy is
+    installed (that asymmetry IS the test: sites with retries complete,
+    sites without surface the fault)."""
+
+    pt_transient = True
+
+    def __init__(self, site: str, call_n: int, spec: str):
+        super().__init__(
+            f"injected fault at {site!r} (call #{call_n}, spec {spec!r})")
+        self.site = site
+        self.call_n = call_n
+
+
+class HungStepError(RuntimeError):
+    """Raised by the watchdog when a watched step exceeds
+    ``FLAGS_watchdog_timeout_s``.  Never retryable: the hang already
+    consumed the deadline, and the dump file is the diagnosis."""
+
+
+def mark_transient(e: BaseException) -> BaseException:
+    """Tag an exception as transient so :func:`is_transient` callers
+    (compile retries, user-level ``retry_call`` policies) treat it as
+    retryable."""
+    e.pt_transient = True
+    return e
+
+
+def is_transient(e: BaseException) -> bool:
+    return bool(getattr(e, "pt_transient", False))
+
+
+# ---------------------------------------------------------------------------
+# fault-injection framework
+# ---------------------------------------------------------------------------
+
+#: the sites the runtime has hooks at (documented contract; parsing warns
+#: on unknown sites rather than failing — forward-compat with user hooks)
+KNOWN_SITES = (
+    "ps.put", "ps.get", "ps.push_dense", "ps.push_sparse", "ps.get_rows",
+    "ps.put_typed", "ps.get_typed", "ps.push_typed",
+    "dataloader.produce", "compile", "executor.dispatch",
+    "fetch.materialize", "checkpoint.write",
+)
+
+_ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")
+
+
+class FaultSpec:
+    """One site's parsed injection spec + its thread-safe call counter."""
+
+    def __init__(self, site: str, raw: str, every: int = 0, at: int = 0,
+                 times: int = 0, p: float = 0.0, seed: int = 0,
+                 mode: str = "raise", hang_s: float = 3600.0):
+        self.site = site
+        self.raw = raw
+        self.every = every
+        self.at = at
+        self.times = times
+        self.p = p
+        self.seed = seed
+        self.mode = mode
+        self.hang_s = hang_s
+        self._mu = threading.Lock()
+        self._count = 0
+        self._rng = random.Random(seed) if p > 0 else None
+
+    def fire(self):
+        """Advance the call counter; -> (should_fire, call_number)."""
+        with self._mu:
+            self._count += 1
+            n = self._count
+            hit = ((self.every and n % self.every == 0)
+                   or (self.at and n == self.at)
+                   or (self.times and n <= self.times)
+                   or (self._rng is not None
+                       and self._rng.random() < self.p))
+        return bool(hit), n
+
+    def __repr__(self):
+        return f"FaultSpec({self.site}:{self.raw})"
+
+
+def parse_fault_inject(value: str) -> Dict[str, FaultSpec]:
+    """Parse ``FLAGS_fault_inject`` into {site: FaultSpec}.  Raises
+    ``ValueError`` on malformed entries so ``set_flags`` rejects a typo'd
+    spec up front instead of silently never injecting."""
+    specs: Dict[str, FaultSpec] = {}
+    for entry in (value or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"fault-inject entry {entry!r} is not 'site:spec'")
+        site, _, body = entry.partition(":")
+        site = site.strip()
+        if site not in KNOWN_SITES:
+            # warn, don't fail: user code may install its own
+            # maybe_inject sites — but a TYPO'd runtime site silently
+            # never firing is exactly the confusion worth flagging
+            import warnings
+            warnings.warn(
+                f"fault-inject site {site!r} is not a built-in hook "
+                f"(known: {', '.join(KNOWN_SITES)}); it will only fire "
+                "if something calls maybe_inject() with that name")
+        kw: Dict[str, Any] = {}
+        for tok in body.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            m = _ONCE_RE.match(tok)
+            if m:
+                kw["at"] = int(m.group(1)) if m.group(1) else 1
+                continue
+            if tok == "hang":
+                kw["mode"] = "hang"
+                continue
+            if "=" not in tok:
+                raise ValueError(
+                    f"fault-inject token {tok!r} in {entry!r} not understood"
+                    " (expected every=N, once[@N], times=N, p=F, seed=N,"
+                    " or hang[=SECS])")
+            k, _, v = tok.partition("=")
+            k = k.strip()
+            if k == "every":
+                kw["every"] = int(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            elif k == "p":
+                kw["p"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            elif k == "hang":
+                kw["mode"] = "hang"
+                kw["hang_s"] = float(v)
+            else:
+                raise ValueError(
+                    f"unknown fault-inject key {k!r} in {entry!r}")
+        if not (kw.get("every") or kw.get("at") or kw.get("times")
+                or kw.get("p")):
+            raise ValueError(
+                f"fault-inject entry {entry!r} has no trigger "
+                "(every=/once/times=/p=)")
+        if kw.get("every", 0) < 0 or kw.get("times", 0) < 0 or \
+                not (0.0 <= kw.get("p", 0.0) <= 1.0):
+            raise ValueError(f"fault-inject entry {entry!r} out of range")
+        specs[site] = FaultSpec(site, body, **kw)
+    return specs
+
+
+#: live spec table — replaced wholesale by configure(); maybe_inject's
+#: fast path is one dict probe against an (almost always) empty dict
+_SPECS: Dict[str, FaultSpec] = {}
+
+#: test hook: releasing this event wakes any in-progress injected hang
+_HANG_RELEASE = threading.Event()
+
+
+def configure(value: str) -> None:
+    """(Re)load the injection table from a ``FLAGS_fault_inject`` string —
+    the flag's side effect calls this, so ``set_flags`` validates eagerly."""
+    global _SPECS
+    _SPECS = parse_fault_inject(value)
+    _HANG_RELEASE.clear()
+
+
+def release_hangs() -> None:
+    """Wake every in-progress injected hang (test teardown hook)."""
+    _HANG_RELEASE.set()
+
+
+def _hang(secs: float) -> None:
+    # sleep in small Python-level increments: the watchdog's async raise
+    # is delivered at a bytecode boundary, so a hung "step" built from
+    # this loop is interruptible the way a C-level hang is not
+    end = time.monotonic() + secs
+    while time.monotonic() < end and not _HANG_RELEASE.is_set():
+        time.sleep(0.02)
+
+
+def maybe_inject(site: str) -> None:
+    """Injection hook: no-op unless ``FLAGS_fault_inject`` names ``site``.
+    Fires either an :class:`InjectedFault` or (``hang`` mode) a Python-
+    level busy-sleep the watchdog can interrupt."""
+    spec = _SPECS.get(site)
+    if spec is None:
+        return
+    hit, n = spec.fire()
+    if not hit:
+        return
+    _FAULT_CTR.inc(1, site=site)
+    if _monitor.TRACER.enabled:
+        _monitor.TRACER.instant("fault.injected", "resilience",
+                                {"site": site, "call": n,
+                                 "mode": spec.mode})
+    if spec.mode == "hang":
+        _hang(spec.hang_s)
+        return
+    raise InjectedFault(site, n, spec.raw)
+
+
+# ---------------------------------------------------------------------------
+# retry engine
+# ---------------------------------------------------------------------------
+
+def backoff_schedule(attempts: int, base_delay_s: float = 0.05,
+                     multiplier: float = 2.0, max_delay_s: float = 2.0,
+                     jitter: float = 0.1, seed: int = 0) -> List[float]:
+    """The (attempts-1) sleep delays between tries: exponential growth
+    capped at ``max_delay_s``, then multiplied by a deterministic jitter in
+    ``[1-jitter, 1+jitter]`` drawn from ``random.Random(seed)``.  Pure and
+    reproducible — same arguments, same schedule — so tests can assert the
+    exact backoff a site will use."""
+    if attempts <= 1:
+        return []
+    rng = random.Random(seed)
+    out = []
+    d = float(base_delay_s)
+    for _ in range(attempts - 1):
+        j = 1.0 + jitter * (2.0 * rng.random() - 1.0)
+        out.append(min(d, max_delay_s) * j)
+        d *= multiplier
+    return out
+
+
+class RetryPolicy:
+    """Backoff + budget for one call site.
+
+    ``max_attempts`` counts total tries (1 = no retry); ``deadline_s``
+    caps the whole operation — a retry whose backoff sleep would cross the
+    deadline is abandoned instead (the ``FLAGS_rpc_deadline`` contract).
+    ``seed=None`` derives a stable per-site seed from the site name, so
+    two runs of the same workload back off identically."""
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.05,
+                 multiplier: float = 2.0, max_delay_s: float = 2.0,
+                 jitter: float = 0.1, deadline_s: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base_delay_s = base_delay_s
+        self.multiplier = multiplier
+        self.max_delay_s = max_delay_s
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+        self.seed = seed
+
+    def schedule(self, site: str = "") -> List[float]:
+        seed = self.seed if self.seed is not None else \
+            zlib.crc32(site.encode())
+        return backoff_schedule(self.max_attempts, self.base_delay_s,
+                                self.multiplier, self.max_delay_s,
+                                self.jitter, seed)
+
+    @classmethod
+    def from_flags(cls, site: str) -> "RetryPolicy":
+        """The policy the runtime installs at ``site``: PS RPC sites honor
+        ``FLAGS_rpc_retry_times`` (retries AFTER the first attempt, the
+        gflags meaning) and ``FLAGS_rpc_deadline`` (ms); other sites get a
+        conservative 3-attempt default."""
+        from .flags import get_flags
+        if site.startswith("ps."):
+            fl = get_flags(["FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"])
+            return cls(max_attempts=1 + int(fl["FLAGS_rpc_retry_times"]),
+                       deadline_s=float(fl["FLAGS_rpc_deadline"]) / 1000.0)
+        return cls(max_attempts=3)
+
+
+def retry_call(site: str, fn: Callable, *args,
+               policy: Optional[RetryPolicy] = None,
+               retryable: Optional[Callable[[BaseException], bool]] = None,
+               **kwargs):
+    """Run ``fn(*args, **kwargs)`` under ``policy`` (default:
+    ``RetryPolicy.from_flags(site)``).  ``retryable`` filters which
+    exceptions earn a retry (default: :func:`is_transient`);
+    :class:`HungStepError` and ``KeyboardInterrupt``/``SystemExit`` never
+    do.  Counters: each performed retry bumps
+    ``paddle_tpu_retry_attempts_total{site}``, an exhausted budget bumps
+    ``paddle_tpu_retry_giveups_total{site}``; each backoff sleep is a
+    ``retry.backoff`` tracer span."""
+    policy = policy or RetryPolicy.from_flags(site)
+    check = retryable or is_transient
+    delays = None                # built on FIRST failure: the no-failure
+    deadline = (time.monotonic() + policy.deadline_s  # hot path pays no
+                if policy.deadline_s else None)       # schedule/rng cost
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except HungStepError:
+            raise
+        except Exception as e:
+            attempt += 1
+            if not check(e):
+                raise
+            if attempt >= policy.max_attempts:
+                _GIVEUP_CTR.inc(1, site=site)
+                raise
+            if delays is None:
+                delays = policy.schedule(site)
+            delay = delays[attempt - 1]
+            if deadline is not None and \
+                    time.monotonic() + delay > deadline:
+                _GIVEUP_CTR.inc(1, site=site)
+                raise RuntimeError(
+                    f"{site}: retry deadline exceeded after {attempt} "
+                    f"attempt(s) (policy deadline "
+                    f"{policy.deadline_s}s): {e}") from e
+            _RETRY_CTR.inc(1, site=site)
+            with _monitor.TRACER.span("retry.backoff", "resilience",
+                                      site=site, attempt=attempt,
+                                      delay_s=round(delay, 4)):
+                time.sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# hung-step watchdog
+# ---------------------------------------------------------------------------
+
+def dump_state(reason: str, site: str = "") -> str:
+    """Write a watchdog dump — every thread's Python stack, the metrics
+    registry totals, and the most recent telemetry-ring spans — to
+    ``FLAGS_watchdog_dump_dir`` (default: the system temp dir).  Returns
+    the file path (named ``paddle_tpu_watchdog_<pid>_<ms>.txt``).
+
+    Format: a ``=== watchdog dump ===`` header (reason, site, pid, time),
+    one ``--- thread <name> (<ident>) ---`` stack section per live
+    thread, a ``--- metrics ---`` JSON object of counter totals, and a
+    ``--- trace (last 200 events) ---`` JSON array of chrome-trace
+    events."""
+    from .flags import get_flags
+    d = get_flags("FLAGS_watchdog_dump_dir")["FLAGS_watchdog_dump_dir"] \
+        or tempfile.gettempdir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(
+        d, f"paddle_tpu_watchdog_{os.getpid()}_{int(time.time()*1e3)}.txt")
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = ["=== watchdog dump ===",
+             f"reason: {reason}",
+             f"site: {site or '<unknown>'}",
+             f"pid: {os.getpid()}",
+             f"time: {time.strftime('%Y-%m-%dT%H:%M:%S')}",
+             ""]
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        lines.extend(l.rstrip("\n") for l in traceback.format_stack(frame))
+        lines.append("")
+    lines.append("--- metrics ---")
+    try:
+        lines.append(json.dumps(_monitor.counter_totals(), indent=1,
+                                sort_keys=True))
+    except Exception as e:        # the dump must never fail the dumper
+        lines.append(f"<metrics unavailable: {e}>")
+    lines.append("")
+    lines.append("--- trace (last 200 events) ---")
+    try:
+        lines.append(json.dumps(_monitor.TRACER.chrome_events()[-200:]))
+    except Exception as e:
+        lines.append(f"<trace unavailable: {e}>")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def _async_raise(tid: int, exc_type) -> None:
+    """Deliver (or, with ``exc_type=None``, cancel) an async exception in
+    the thread with ident ``tid`` — lands at its next bytecode boundary."""
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid),
+        ctypes.py_object(exc_type) if exc_type is not None else None)
+
+
+class Watchdog:
+    """Deadline supervisor for watched sections (executor dispatch, fetch
+    materialization).  One daemon monitor thread tracks every active
+    ``watch()``; on expiry it writes a :func:`dump_state` file, bumps
+    ``paddle_tpu_watchdog_fired_total{site}``, and async-raises
+    :class:`HungStepError` in the hung thread.  ``timeout_s <= 0``
+    (the default) disables everything — ``watch()`` is then one float
+    compare."""
+
+    def __init__(self):
+        self._cv = threading.Condition(threading.Lock())
+        self._watches: Dict[int, dict] = {}
+        self._ids = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self.timeout_s = 0.0
+
+    def set_timeout(self, secs: float) -> None:
+        self.timeout_s = float(secs)
+        with self._cv:
+            self._cv.notify()
+
+    @contextlib.contextmanager
+    def watch(self, site: str):
+        t = self.timeout_s
+        if t <= 0:
+            yield
+            return
+        entry = {"tid": threading.get_ident(), "site": site,
+                 "deadline": time.monotonic() + t, "timeout": t,
+                 "fired": False, "dump": None}
+        with self._cv:
+            wid = next(self._ids)
+            self._watches[wid] = entry
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="pt-watchdog")
+                self._thread.start()
+            self._cv.notify()
+        delivered = False
+        try:
+            yield
+        except HungStepError as he:
+            delivered = True
+            if entry["fired"]:
+                # enrich the bare async-raised error with the diagnosis
+                raise HungStepError(self._msg(entry)) from he
+            raise
+        finally:
+            with self._cv:
+                self._watches.pop(wid, None)
+            if entry["fired"] and not delivered:
+                # the watched call ended (returned, or raised its OWN
+                # error) after the deadline fired but before the async
+                # exception landed — withdraw it on EVERY exit path, or
+                # the stale HungStepError detonates at some arbitrary
+                # later bytecode in this thread, masking the real outcome
+                # (best effort: delivery racing this cancel still raises
+                # HungStepError, just possibly a frame later)
+                _async_raise(entry["tid"], None)
+        if entry["fired"]:
+            raise HungStepError(self._msg(entry))
+
+    @staticmethod
+    def _msg(entry: dict) -> str:
+        where = entry["dump"] or \
+            "<dump still writing — check FLAGS_watchdog_dump_dir>"
+        return (f"step hung: {entry['site']!r} exceeded "
+                f"FLAGS_watchdog_timeout_s={entry['timeout']}s; thread "
+                f"stacks + telemetry dumped to {where}")
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                now = time.monotonic()
+                pending = [(w, e) for w, e in self._watches.items()
+                           if not e["fired"]]
+                expired = [(w, e) for w, e in pending
+                           if e["deadline"] <= now]
+                for _, e in expired:
+                    e["fired"] = True
+                if not expired:
+                    nxt = min((e["deadline"] for _, e in pending),
+                              default=now + 5.0)
+                    self._cv.wait(timeout=max(nxt - now, 0.02))
+                    continue
+            for wid, e in expired:    # I/O outside the lock
+                try:
+                    e["dump"] = dump_state(
+                        f"watched section exceeded {e['timeout']}s",
+                        site=e["site"])
+                except Exception:
+                    e["dump"] = "<dump failed>"
+                _WATCHDOG_CTR.inc(1, site=e["site"])
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "watchdog.fired", "resilience",
+                        {"site": e["site"], "dump": e["dump"]})
+                with self._cv:
+                    # only async-raise while the watch is still
+                    # registered: if the "hung" call returned during the
+                    # dump, the exiting watch() raises directly — an
+                    # unconditional raise here could detonate at an
+                    # arbitrary later bytecode in that thread
+                    if wid in self._watches:
+                        _async_raise(e["tid"], HungStepError)
+
+
+WATCHDOG = Watchdog()
+
+
+# ---------------------------------------------------------------------------
+# preemption guard + resume
+# ---------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Graceful SIGTERM/SIGINT drain for a training loop.
+
+    ::
+
+        ckpt = CheckpointManager(ckpt_dir)
+        start = resume_or_init(ckpt, exe, startup_program=startup,
+                               main_program=main)
+        with PreemptionGuard(ckpt, executor=exe, program=main) as guard:
+            for step in range(start, total_steps):
+                exe.run(main, feed=batch(step), fetch_list=[loss])
+                guard.completed_step(step + 1)
+                if guard.preempted:
+                    break
+        # guard exit (preempted): drain in-flight steps, force an
+        # emergency checkpoint at the last complete step, export
+        # telemetry, SystemExit(exit_code)
+
+    The signal handler only sets a flag — checkpointing from inside a
+    handler could snapshot a half-dispatched step.  The loop polls
+    ``guard.preempted`` at step boundaries (where the scope is a complete,
+    consistent state) and breaks; everything irreversible happens on the
+    normal exit path.  Handlers are restored on exit.  Signal installation
+    requires the main thread; elsewhere the guard still works via
+    :meth:`trigger` (and warns once).
+    """
+
+    def __init__(self, checkpoint=None, executor=None, program=None,
+                 scope=None, signals=(signal.SIGTERM, signal.SIGINT),
+                 export_dir: Optional[str] = None,
+                 exit_on_preempt: bool = True, exit_code: int = 0):
+        self.checkpoint = checkpoint
+        self.executor = executor
+        self.program = program
+        self.scope = scope
+        self.signals = tuple(signals)
+        self.export_dir = export_dir
+        self.exit_on_preempt = exit_on_preempt
+        self.exit_code = exit_code
+        self._preempted = threading.Event()
+        self._signum = signal.SIGTERM
+        self._noted = False
+        self._last_step: Optional[int] = None
+        self._old: Dict[int, Any] = {}
+
+    # -- signal plumbing -----------------------------------------------------
+    def _handler(self, signum, frame):
+        self.trigger(signum)
+
+    def trigger(self, signum: int = signal.SIGTERM) -> None:
+        """Record a preemption request (the signal handler body; callable
+        directly from tests or cluster-notification hooks).
+
+        LOCK-FREE on purpose: this runs on the main thread *interrupting
+        its own frame*, which may be inside a tracer/metric critical
+        section — taking any of those non-reentrant locks here would
+        self-deadlock the process at the exact moment it must drain.
+        Event.set() alone is safe; the counter/tracer bumps happen later,
+        on the drain/exit path (:meth:`_note_signal`)."""
+        self._signum = signum
+        self._preempted.set()
+
+    def _note_signal(self) -> None:
+        """Deferred observability for the signal: runs on the normal exit
+        path, where taking the metric/tracer locks is safe."""
+        if self._noted or not self._preempted.is_set():
+            return
+        self._noted = True
+        signum = self._signum
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        _PREEMPT_CTR.inc(1, signal=name)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant("preemption.signal", "resilience",
+                                    {"signal": int(signum)})
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    def completed_step(self, step: int) -> None:
+        """Mark ``step`` steps as fully complete (scope state consistent
+        through that step) — the emergency checkpoint saves at this index."""
+        self._last_step = int(step)
+
+    # -- drain + emergency checkpoint ---------------------------------------
+    def drain(self) -> None:
+        """Block until every in-flight dispatched step has retired (the
+        executor's throttle queue) — after this the scope holds fully
+        computed values."""
+        if self.executor is not None and hasattr(self.executor, "drain"):
+            with _monitor.TRACER.span("preemption.drain", "resilience"):
+                self.executor.drain()
+
+    def emergency_checkpoint(self) -> Optional[int]:
+        """Drain, then force-save the last complete step; returns the step
+        saved (None when no checkpoint manager / no completed step)."""
+        self.drain()
+        if self.checkpoint is None or self._last_step is None:
+            return None
+        with _monitor.TRACER.span("preemption.checkpoint", "resilience",
+                                  step=self._last_step):
+            self.checkpoint.save(self._last_step, program=self.program,
+                                 scope=self.scope, force=True)
+            # the save may be async (orbax): the process is about to exit,
+            # so it must land on disk NOW
+            wait = getattr(self.checkpoint, "_mgr", None)
+            if wait is not None and hasattr(wait, "wait_until_finished"):
+                wait.wait_until_finished()
+        return self._last_step
+
+    # -- context manager -----------------------------------------------------
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._old[s] = signal.signal(s, self._handler)
+            except ValueError:      # not the main thread
+                import warnings
+                warnings.warn(
+                    "PreemptionGuard: cannot install signal handlers "
+                    "outside the main thread; use guard.trigger()")
+                break
+        return self
+
+    def __exit__(self, et, ev, tb):
+        try:
+            # the emergency path runs with OUR handlers still installed:
+            # a scheduler's follow-up SIGTERM (or a second Ctrl-C) during
+            # the drain/save just re-sets the already-set flag instead of
+            # killing the process mid-emergency-checkpoint
+            if et is None and self.preempted:
+                self.emergency_checkpoint()
+                if self.export_dir:
+                    try:
+                        _monitor.export(self.export_dir)
+                    except Exception:   # telemetry must not block the exit
+                        pass
+        finally:
+            for s, old in self._old.items():
+                try:
+                    signal.signal(s, old)
+                except ValueError:
+                    pass
+            self._old.clear()
+            self._note_signal()
+        if et is None and self.preempted and self.exit_on_preempt:
+            raise SystemExit(self.exit_code)
+        return False
+
+
+def resume_or_init(checkpoint, executor, startup_program=None,
+                   main_program=None, scope=None) -> int:
+    """Restart a training loop from the last complete checkpoint.
+
+    Runs the startup program (vars must exist before a restore can fill
+    them — and a cold start needs its initializers anyway), then restores
+    the latest checkpoint when one exists.  Returns the number of COMPLETE
+    steps — the loop resumes at that index, so an interrupted run's loss
+    trajectory continues exactly where the emergency save left it::
+
+        start = resume_or_init(ckpt, exe, startup_program=startup,
+                               main_program=main)
+        for step in range(start, total_steps):
+            ...
+    """
+    from .framework.core import default_startup_program
+    startup = startup_program or default_startup_program()
+    executor.run(startup, scope=scope)
+    step = checkpoint.latest_step()
+    if step is None:
+        return 0
+    checkpoint.restore(step, program=main_program, scope=scope)
+    if _monitor.TRACER.enabled:
+        _monitor.TRACER.instant("preemption.resume", "resilience",
+                                {"step": int(step)})
+    return int(step)
+
+
+# ---------------------------------------------------------------------------
+# flag sync (mirrors monitor._sync_from_flags: whichever of the two
+# modules imports second sees the other's already-bootstrapped values)
+# ---------------------------------------------------------------------------
+
+def _sync_from_flags():
+    try:
+        from .flags import get_flags
+        fl = get_flags(["FLAGS_fault_inject", "FLAGS_watchdog_timeout_s"])
+    except Exception:           # flags mid-bootstrap: side effects re-sync
+        return
+    if fl["FLAGS_fault_inject"]:
+        configure(str(fl["FLAGS_fault_inject"]))
+    WATCHDOG.set_timeout(float(fl["FLAGS_watchdog_timeout_s"]))
+
+
+_sync_from_flags()

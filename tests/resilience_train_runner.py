@@ -1,0 +1,85 @@
+"""Subprocess runner for the preemption-recovery test.
+
+Trains a deterministic linear-regression loop under a PreemptionGuard,
+printing ``STEP <i> LOSS <repr(float)>`` per step (repr round-trips the
+float32 exactly, so the parent can compare trajectories bit-for-bit) and
+appending each completed step index to a progress file the parent polls.
+
+Usage::
+
+    python resilience_train_runner.py CKPT_DIR TOTAL_STEPS PROGRESS_FILE \
+        [SLEEP_PER_STEP]
+
+On SIGTERM the guard drains in-flight steps, force-saves an emergency
+checkpoint at the last complete step, and exits 0; a rerun with the same
+CKPT_DIR resumes from that step via ``resume_or_init`` and finishes the
+remaining steps.  Data is keyed by step index (a fresh RandomState per
+step), so the resumed trajectory is the uninterrupted one.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
+from paddle_tpu.framework import Executor  # noqa: E402
+from paddle_tpu.resilience import PreemptionGuard, resume_or_init  # noqa: E402
+
+
+def batch(step):
+    rng = np.random.RandomState(1234 + step)
+    x = rng.rand(8, 4).astype(np.float32)
+    return x, x.sum(1, keepdims=True).astype(np.float32)
+
+
+def main():
+    ckpt_dir, total, progress = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    pause = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+
+    pt.default_startup_program().random_seed = 7
+    pt.default_main_program().random_seed = 7
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="rt_w"),
+                     bias_attr=pt.ParamAttr(name="rt_b"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(0.05).minimize(loss)
+
+    exe = Executor()
+    ckpt = CheckpointManager(ckpt_dir, max_to_keep=2)
+    start = resume_or_init(ckpt, exe,
+                           startup_program=pt.default_startup_program(),
+                           main_program=pt.default_main_program())
+    print(f"RESUMED_AT {start}", flush=True)
+
+    with PreemptionGuard(ckpt, executor=exe,
+                         program=pt.default_main_program(),
+                         exit_code=0) as guard:
+        for step in range(start, total):
+            xv, yv = batch(step)
+            out, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+            print(f"STEP {step} LOSS {float(np.asarray(out).ravel()[0])!r}",
+                  flush=True)
+            guard.completed_step(step + 1)
+            with open(progress, "a") as f:
+                f.write(f"{step}\n")
+                f.flush()
+                os.fsync(f.fileno())
+            if pause:
+                time.sleep(pause)
+            if guard.preempted:
+                break
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,9 @@ rm -rf "$wheeldir" "$venvdir"
 echo "== telemetry smoke (chrome trace + metrics export validation) =="
 JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
+echo "== resilience smoke (fault injection + retries + ckpt integrity) =="
+JAX_PLATFORMS=cpu python tools/resilience_smoke.py
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 

@@ -23,6 +23,7 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.nets",
     "paddle_tpu.io",
+    "paddle_tpu.resilience",
     "paddle_tpu.initializer",
     "paddle_tpu.regularizer",
     "paddle_tpu.clip",
