@@ -22,15 +22,49 @@ PS plane snapshots itself through the same manager via ``save_server``.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from . import monitor as _monitor
 from .framework import core
 from .framework.scope import Scope, global_scope
-from .io import get_program_persistable_vars
+from .io import _fsync_dir, get_program_persistable_vars
 
 __all__ = ["CheckpointManager"]
+
+# ---------------------------------------------------------------------------
+# checkpoint telemetry: one family per phase of a checkpoint's life —
+# write scheduled (saves), bytes serialized, durable on disk (commits),
+# rejected at resume because the gang never agreed on it (torn_rejects).
+# The save-latency histogram is in ms: an async schedule is sub-ms, a
+# synchronous emergency commit of a big model is seconds.
+# ---------------------------------------------------------------------------
+
+SAVE_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_checkpoint_saves_total",
+    "checkpoint writes handed to the (async) writer, by kind "
+    "('interval' = train-loop cadence, 'daemon' = background daemon, "
+    "'emergency' = preemption-time force-save)", ("kind",))
+BYTES_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_checkpoint_bytes_total",
+    "host bytes handed to the checkpoint writer")
+COMMIT_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_checkpoint_commits_total",
+    "checkpoints made durable, by kind ('rank' = this rank's write "
+    "finished + fsync'd, 'gang' = the leader published a COMMITTED "
+    "manifest the whole gang agreed on)", ("kind",))
+TORN_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_checkpoint_torn_rejects_total",
+    "checkpoints refused at resume: newer than (or missing) the gang's "
+    "COMMITTED manifest — a torn multi-rank save is never restored")
+SAVE_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_checkpoint_save_ms",
+    "wall ms per checkpoint save call (async: schedule + serialize "
+    "handoff; the durable commit is the daemon/exit path's wait)",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+             1000.0, 2500.0, 5000.0, 15000.0, 60000.0))
 
 
 class CheckpointManager:
@@ -65,7 +99,7 @@ class CheckpointManager:
         return state
 
     def _write(self, step: int, state: Dict[str, np.ndarray],
-               force: bool) -> bool:
+               force: bool, kind: str = "interval") -> bool:
         if not force and step % self._interval != 0:
             return False
         import orbax.checkpoint as ocp
@@ -97,26 +131,75 @@ class CheckpointManager:
                     pass
                 raise
 
-        return _resil.retry_call(
-            "checkpoint.write", _once,
-            retryable=lambda e: _resil.is_transient(e)
-            or isinstance(e, (OSError, TimeoutError)))
+        t0 = time.perf_counter()
+        with _monitor.TRACER.span("checkpoint.save", "checkpoint",
+                                  step=int(step), kind=kind):
+            accepted = _resil.retry_call(
+                "checkpoint.write", _once,
+                retryable=lambda e: _resil.is_transient(e)
+                or isinstance(e, (OSError, TimeoutError)))
+        SAVE_HIST.observe((time.perf_counter() - t0) * 1e3)
+        if accepted:
+            SAVE_CTR.inc(1, kind=kind)
+            BYTES_CTR.inc(sum(int(a.nbytes) for a in state.values()))
+        return accepted
 
     # -- API (shape of orbax, semantics of fluid.io.save_persistables) ------
     def save(self, step: int, program=None, scope: Optional[Scope] = None,
-             force: bool = False) -> bool:
+             force: bool = False, kind: str = "interval") -> bool:
         """Write persistables at ``step``; returns True iff orbax accepted
         the write (False when off-interval or step ≤ latest saved).
         Respects ``save_interval_steps`` unless ``force``."""
         if not force and step % self._interval != 0:
             return False
-        return self._write(step, self._gather(program, scope), force=True)
+        return self._write(step, self._gather(program, scope), force=True,
+                           kind=kind)
+
+    def save_arrays(self, step: int, state: Dict[str, np.ndarray],
+                    force: bool = True, kind: str = "daemon") -> bool:
+        """Write an already-gathered ``{name: host array}`` snapshot — the
+        background daemon's entry point: the training thread captured the
+        state at a step boundary, so no scope access happens here."""
+        return self._write(step, dict(state), force=force, kind=kind)
+
+    def wait_until_finished(self) -> None:
+        """Block until every scheduled async save is durably written (the
+        orbax backlog is drained).  An error from a background commit
+        surfaces here — exactly where a caller about to trust the
+        checkpoint needs it."""
+        self._mgr.wait_until_finished()
+
+    def commit(self, kind: str = "rank") -> Optional[int]:
+        """Drain the async writer AND fsync the checkpoint root, so the
+        step directories' renames survive a crash — the durable point a
+        rank may safely announce to the gang.  Returns the latest step
+        now guaranteed on disk."""
+        self.wait_until_finished()
+        _fsync_dir(self._dir)
+        step = self.latest_step()
+        if step is not None:
+            COMMIT_CTR.inc(1, kind=kind)
+        return step
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
     def all_steps(self):
         return list(self._mgr.all_steps())
+
+    def prune_after(self, step: int) -> list:
+        """Delete every checkpoint NEWER than ``step`` (the torn-save
+        refusal: steps past the gang's COMMITTED manifest must not linger
+        — orbax rejects saves at indices ≤ its latest step, so a resumed
+        run could never checkpoint again until it re-passed the torn
+        step).  Returns the deleted steps."""
+        self.wait_until_finished()
+        doomed = [s for s in self.all_steps() if s > int(step)]
+        for s in doomed:
+            self._mgr.delete(s)
+        if doomed:
+            _fsync_dir(self._dir)
+        return doomed
 
     def restore(self, step: Optional[int] = None, program=None,
                 scope: Optional[Scope] = None) -> int:

@@ -9,12 +9,23 @@ PADDLE_TRAINER_ENDPOINTS) and the NCCL-id RPC exchange
 TPU mapping: the same env contract, with the ncclUniqueId exchange
 replaced by ``jax.distributed.initialize`` — the coordination service at
 the rank-0 endpoint hands every process the global device topology.
+
+This module also hosts :class:`GangRendezvous`, the file-based rank
+rendezvous behind gang-level checkpoint commits: every rank announces
+the steps it has durably checkpointed, and the rank-0 leader publishes a
+``COMMITTED <step>`` manifest only when the whole gang agrees — the unit
+of recovery is the gang, never a single rank (a torn multi-host save is
+refused at resume).  The launcher exports ``PADDLE_GANG_DIR`` so all
+ranks of one job rendezvous in the same directory (it must be on a
+filesystem every rank can reach — shared FS on multi-host pods).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
 
 class Env:
@@ -72,3 +83,211 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
                                process_id=process_id)
     _initialized = True
     return env
+
+
+# ---------------------------------------------------------------------------
+# gang-commit rendezvous (file-based; see module docstring)
+# ---------------------------------------------------------------------------
+
+def format_manifest(step: int, world_size: int) -> str:
+    """The ``COMMITTED <step>`` manifest body: a strict first line the
+    parser keys on, plus a JSON metadata line for humans and tooling."""
+    meta = {"world_size": int(world_size),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    return f"COMMITTED {int(step)}\n{json.dumps(meta, sort_keys=True)}\n"
+
+
+def parse_manifest(text: str) -> int:
+    """Parse a manifest body back to its committed step.  Strict: anything
+    that is not a well-formed ``COMMITTED <step>`` first line raises
+    ``ValueError`` — a truncated or corrupted manifest must read as "no
+    commit", never as a guessed step."""
+    lines = (text or "").splitlines()
+    if not lines:
+        raise ValueError("empty gang manifest")
+    parts = lines[0].split()
+    if len(parts) != 2 or parts[0] != "COMMITTED":
+        raise ValueError(
+            f"malformed gang manifest first line: {lines[0]!r} "
+            "(expected 'COMMITTED <step>')")
+    try:
+        step = int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"malformed gang manifest step: {parts[1]!r}") from None
+    if step < 0:
+        raise ValueError(f"gang manifest step {step} is negative")
+    return step
+
+
+def _atomic_write(path: str, body: str) -> None:
+    """fsync'd atomic publish: stage to a temp sibling, fsync the file,
+    rename over the target, fsync the directory — a reader never sees a
+    half-written file and the rename survives a crash."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    from ..io import _fsync_dir
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+class GangRendezvous:
+    """File-based gang checkpoint-commit barrier.
+
+    Layout under ``base_dir``::
+
+        rank_0, rank_1, ...   per-rank announcements (JSON: the rank's
+                              latest durably-committed step + the full
+                              list of steps it still holds)
+        MANIFEST              'COMMITTED <step>' — published by rank 0
+                              only when every rank holds that step
+
+    All writes are fsync'd atomic renames, so a reader (the resume path,
+    the leader's poll) observes either the previous or the new content,
+    never a torn file.  The protocol is crash-safe by construction: a
+    rank dying mid-save simply never announces, and the manifest stays at
+    the last step the whole gang agreed on.
+    """
+
+    MANIFEST_NAME = "MANIFEST"
+
+    def __init__(self, base_dir: str, rank: Optional[int] = None,
+                 world_size: Optional[int] = None):
+        env = Env()
+        self.base_dir = os.path.abspath(base_dir)
+        self.rank = env.rank if rank is None else int(rank)
+        self.world_size = env.world_size if world_size is None \
+            else int(world_size)
+        os.makedirs(self.base_dir, exist_ok=True)
+
+    @classmethod
+    def from_env(cls) -> Optional["GangRendezvous"]:
+        """The launcher's contract: ``PADDLE_GANG_DIR`` + a multi-rank
+        env make a rendezvous; single-rank runs get ``None`` (no gang —
+        per-rank checkpoint semantics are already safe)."""
+        base = os.getenv("PADDLE_GANG_DIR", "")
+        if not base or Env().world_size <= 1:
+            return None
+        return cls(base)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.base_dir, self.MANIFEST_NAME)
+
+    def _rank_path(self, rank: int) -> str:
+        return os.path.join(self.base_dir, f"rank_{int(rank)}")
+
+    # -- announcements -------------------------------------------------------
+    def announce(self, step: int, steps=None) -> None:
+        """Publish this rank's latest durably-committed checkpoint step
+        (and the full set of steps it still holds, so the leader can pick
+        a commit point every rank can actually restore)."""
+        body = json.dumps({
+            "rank": self.rank,
+            "step": int(step),
+            "steps": sorted(int(s) for s in (steps or [step])),
+            "pid": os.getpid(),
+        }, sort_keys=True)
+        _atomic_write(self._rank_path(self.rank), body + "\n")
+
+    def peer_announcements(self) -> Dict[int, dict]:
+        """Parse every rank's announcement; malformed or missing files are
+        simply absent (a rank mid-write or dead has not announced)."""
+        out: Dict[int, dict] = {}
+        for r in range(self.world_size):
+            try:
+                with open(self._rank_path(r)) as f:
+                    d = json.loads(f.read())
+                out[int(d["rank"])] = {
+                    "step": int(d["step"]),
+                    "steps": [int(s) for s in d.get("steps", [d["step"]])],
+                }
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return out
+
+    # -- manifest ------------------------------------------------------------
+    def committed_step(self) -> Optional[int]:
+        """The gang's last committed step, or None when there is no (or a
+        corrupt) manifest — corruption must read as 'nothing committed'."""
+        try:
+            with open(self.manifest_path) as f:
+                return parse_manifest(f.read())
+        except OSError:
+            return None
+        except ValueError:
+            import warnings
+            warnings.warn(
+                f"gang manifest {self.manifest_path} is corrupt; treating "
+                "as no committed checkpoint")
+            return None
+
+    def publish(self, step: int) -> None:
+        """Leader-only: atomically publish ``COMMITTED <step>``."""
+        if not self.is_leader:
+            raise RuntimeError(
+                f"rank {self.rank} tried to publish the gang manifest; "
+                "only rank 0 commits")
+        _atomic_write(self.manifest_path,
+                      format_manifest(step, self.world_size))
+
+    # -- commit protocols ----------------------------------------------------
+    def commit_latest(self) -> Optional[int]:
+        """Leader, non-blocking (steady-state cadence): publish the newest
+        step EVERY rank has durably committed and still holds, if it
+        advances the manifest.  Returns the published step or None."""
+        if not self.is_leader:
+            return None
+        anns = self.peer_announcements()
+        if len(anns) < self.world_size:
+            return None
+        common = set(anns[0]["steps"]) if 0 in anns else set()
+        for d in anns.values():
+            common &= set(d["steps"])
+        if not common:
+            return None
+        best = max(common)
+        cur = self.committed_step()
+        if cur is not None and best <= cur:
+            return None
+        self.publish(best)
+        return best
+
+    def wait_commit(self, step: int, timeout_s: float,
+                    poll_s: float = 0.05) -> bool:
+        """Leader, blocking (emergency barrier): wait until every rank's
+        LATEST announced step equals ``step``, then publish it.  Strict
+        equality — ranks disagreeing on the emergency step means the gang
+        tore, and the manifest must stay at the previous agreed step."""
+        if not self.is_leader:
+            raise RuntimeError("wait_commit is leader-only; other ranks "
+                               "just announce and exit")
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            anns = self.peer_announcements()
+            if len(anns) == self.world_size and \
+                    all(d["step"] == int(step) for d in anns.values()):
+                self.publish(step)
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def wait_manifest(self, step: int, timeout_s: float,
+                      poll_s: float = 0.05) -> bool:
+        """Any rank: wait until the manifest commits ``step`` (or newer)."""
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            cur = self.committed_step()
+            if cur is not None and cur >= int(step):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)

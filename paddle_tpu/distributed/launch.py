@@ -10,6 +10,15 @@ TPU note: on TPU pods the natural unit is one process per *host* (each
 process owns all local chips; jax.distributed federates hosts), so
 ``--nproc_per_node`` defaults to 1.  The rank-0 endpoint doubles as the
 jax.distributed coordinator address.
+
+Gang preemption: the launcher exports ``PADDLE_GANG_DIR`` (one shared
+rendezvous directory per job — see ``env.GangRendezvous``), and a
+SIGTERM/SIGINT to the launcher forwards SIGTERM to every rank, then
+WAITS up to ``--grace_secs`` for the gang to drain: each rank's
+``PreemptionGuard`` finishes its emergency checkpoint, announces it,
+and the rank-0 leader publishes the ``COMMITTED`` manifest only when
+all ranks saved the same step.  Killing the ranks immediately (the old
+behavior) is exactly how multi-host emergency saves tear.
 """
 
 from __future__ import annotations
@@ -19,6 +28,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -34,6 +44,14 @@ def _parse_args(argv=None):
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per node (1 per TPU host)")
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--gang_dir", default=None,
+                   help="shared rendezvous dir for gang checkpoint "
+                        "commits (exported as PADDLE_GANG_DIR; default: "
+                        "<log_dir>/gang, or a fresh temp dir)")
+    p.add_argument("--grace_secs", type=float, default=60.0,
+                   help="how long a SIGTERM'd launcher waits for ranks "
+                        "to finish their gang-coordinated emergency "
+                        "checkpoint before SIGKILLing stragglers")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -48,6 +66,20 @@ def get_cluster_env(args):
     endpoints = [f"{ip}:{args.started_port + i}"
                  for ip in node_ips for i in range(nproc)]
     node_idx = node_ips.index(args.node_ip)
+    gang_dir = args.gang_dir or (
+        os.path.join(args.log_dir, "gang") if args.log_dir
+        else tempfile.mkdtemp(prefix="pt_gang_"))
+    if nnodes > 1 and not args.gang_dir:
+        # every launcher invents its own default dir, so on a multi-NODE
+        # job the ranks would rendezvous in per-node directories the
+        # leader never reads — the gang could then never commit, and
+        # every resume would cold-start
+        import warnings
+        warnings.warn(
+            "multi-node launch without --gang_dir: gang checkpoint "
+            f"commits need ONE directory visible to every node, but "
+            f"{gang_dir!r} is node-local; pass --gang_dir on shared "
+            "storage or gang commits will never publish")
     envs = []
     for local in range(nproc):
         rank = node_idx * nproc + local
@@ -56,6 +88,7 @@ def get_cluster_env(args):
             "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_GANG_DIR": gang_dir,
             "FLAGS_selected_tpus": str(local),
             "TRAINING_ROLE": "TRAINER",
         }
@@ -84,38 +117,79 @@ def start_procs(args, envs):
     return procs, logs
 
 
-def wait_procs(procs):
-    """Wait for all ranks; kill the gang if any rank fails (ref :256)."""
+def drain_gang(procs, grace_secs: float = 60.0):
+    """Forward SIGTERM to every live rank, then WAIT for the gang to
+    drain: ranks run their PreemptionGuard emergency save + gang
+    announce, the leader publishes the COMMITTED manifest, and only
+    stragglers still alive after ``grace_secs`` are SIGKILLed.  Returns
+    True iff every rank exited cleanly (exit 0) within the grace window —
+    i.e. the gang checkpoint is trustworthy."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + float(grace_secs)
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.2)
+    clean = True
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            clean = False
+    for p in procs:
+        p.wait()
+        clean = clean and p.returncode == 0
+    return clean
+
+
+def wait_procs(procs, grace_secs: float = 60.0, stop=None):
+    """Wait for all ranks; kill the gang if any rank fails (ref :256).
+
+    A SIGTERM to the launcher (``stop`` flag set by the signal handler)
+    or a Ctrl-C drains the gang gracefully — every rank gets SIGTERM and
+    ``grace_secs`` to finish its coordinated emergency checkpoint —
+    instead of orphaning ranks mid-save."""
     try:
         while True:
+            if stop is not None and stop.get("signum") is not None:
+                ok = drain_gang(procs, grace_secs)
+                raise SystemExit(0 if ok else 1)
             alive = False
             for p in procs:
                 ret = p.poll()
                 if ret is None:
                     alive = True
                 elif ret != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
+                    drain_gang(procs, grace_secs)
                     raise SystemExit(
                         f"rank process {p.pid} exited with {ret}")
             if not alive:
                 return
             time.sleep(0.5)
     except KeyboardInterrupt:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        raise
+        ok = drain_gang(procs, grace_secs)
+        raise SystemExit(0 if ok else 1) from None
 
 
 def launch(argv=None):
     args = _parse_args(argv)
     envs = get_cluster_env(args)
     procs, logs = start_procs(args, envs)
+    # a scheduler preempts the LAUNCHER: forward + drain, don't die and
+    # leave ranks checkpointing into a gang that can never commit
+    stop = {"signum": None}
+    old = None
     try:
-        wait_procs(procs)
+        old = signal.signal(signal.SIGTERM,
+                            lambda s, f: stop.__setitem__("signum", s))
+    except ValueError:          # not the main thread (embedded use)
+        pass
+    try:
+        wait_procs(procs, grace_secs=args.grace_secs, stop=stop)
     finally:
+        if old is not None:
+            signal.signal(signal.SIGTERM, old)
         for f in logs:
             f.close()
 

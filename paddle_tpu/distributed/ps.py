@@ -58,7 +58,7 @@ _clients_lock = threading.Lock()
 _policy_cache: Dict[tuple, "_resil.RetryPolicy"] = {}
 
 
-def _rpc(site: str, fn):
+def _rpc(site: str, fn, breaker: "Optional[_resil.CircuitBreaker]" = None):
     """Run one RPC attempt-function under the INJECTED-fault retry policy.
 
     Layering (deliberate — see native/src/ps_server.cc request_bytes):
@@ -73,8 +73,19 @@ def _rpc(site: str, fn):
     exactly the pushes the native layer refused to.  So this wrapper
     retries ONLY transient faults raised ABOVE the transport — the
     ``FLAGS_fault_inject`` plane — while native errors (rc != 0) surface
-    after the native budget is spent."""
+    after the native budget is spent.
+
+    ``breaker`` (the client's per-endpoint circuit breaker,
+    ``FLAGS_rpc_circuit_break_secs``): once a call exhausts its whole
+    retry budget on TRANSIENT failures, subsequent calls fail fast with
+    ``CircuitOpenError`` for the cool-down instead of each re-paying the
+    full backoff schedule against a dead endpoint; the half-open probe
+    re-closes it.  Deterministic failures (server verdicts like an
+    unknown table) close the breaker rather than trip it — the endpoint
+    answered, it is not down."""
     from ..flags import get_flags
+    if breaker is not None:
+        breaker.check(site)
     fl = get_flags(["FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"])
     key = (int(fl["FLAGS_rpc_retry_times"]), int(fl["FLAGS_rpc_deadline"]))
     policy = _policy_cache.get(key)
@@ -82,8 +93,24 @@ def _rpc(site: str, fn):
         # one derivation of the flag->policy mapping, shared with direct
         # retry_call('ps.*') users
         policy = _policy_cache[key] = _resil.RetryPolicy.from_flags(site)
-    return _resil.retry_call(site, fn, policy=policy,
-                             retryable=_resil.is_transient)
+    try:
+        out = _resil.retry_call(site, fn, policy=policy,
+                                retryable=_resil.is_transient)
+    except Exception as e:
+        if breaker is not None:
+            # a transient failure escaping retry_call IS a give-up (the
+            # deadline wrapper chains the transient cause); anything
+            # else is a verdict from a live endpoint
+            if _resil.is_transient(e) or \
+                    _resil.is_transient(getattr(e, "__cause__", None)
+                                        or e):
+                breaker.record_giveup()
+            else:
+                breaker.record_success()
+        raise
+    if breaker is not None:
+        breaker.record_success()
+    return out
 
 
 class PSClient:
@@ -121,6 +148,10 @@ class PSClient:
         self._h = lib.ps_client_connect(host.encode(), int(port))
         if not self._h:
             raise ConnectionError(f"cannot connect to pserver {endpoint}")
+        # per-ENDPOINT circuit breaker (FLAGS_rpc_circuit_break_secs):
+        # one dead pserver must not make every call to it re-pay the
+        # full retry backoff — and must not poison calls to its peers
+        self._breaker = _resil.CircuitBreaker(name=endpoint)
 
     @staticmethod
     def _check_dtype(dtype):
@@ -152,7 +183,7 @@ class PSClient:
                 raise RuntimeError(
                     f"ps put({name}) failed (server down or "
                     "FLAGS_rpc_deadline exceeded?)")
-        _rpc("ps.put", _once)
+        _rpc("ps.put", _once, breaker=self._breaker)
 
     def get(self, name: str, size: int, barrier: bool = True, dtype=None):
         import ctypes
@@ -175,7 +206,7 @@ class PSClient:
                     f"ps get({name}): expected {size} floats, got {n} "
                     "(mis-sized table, server down, or FLAGS_rpc_deadline "
                     "exceeded?)")
-        _rpc("ps.get", _once)
+        _rpc("ps.get", _once, breaker=self._breaker)
         if dtype is not None:
             return out.view(dtype)
         return out
@@ -191,7 +222,7 @@ class PSClient:
                 raise RuntimeError(
                     f"ps push_dense({name}) failed — gradient would be "
                     "silently dropped (unknown table or server down)")
-        _rpc("ps.push_dense", _once)
+        _rpc("ps.push_dense", _once, breaker=self._breaker)
 
     def push_sparse(self, name: str, rows, grad) -> None:
         import ctypes
@@ -207,7 +238,7 @@ class PSClient:
                 raise RuntimeError(
                     f"ps push_sparse({name}) failed — gradient would be "
                     "silently dropped (unknown table or server down)")
-        _rpc("ps.push_sparse", _once)
+        _rpc("ps.push_sparse", _once, breaker=self._breaker)
 
     def get_rows(self, name: str, rows, width: int):
         import ctypes
@@ -223,7 +254,7 @@ class PSClient:
                 raise RuntimeError(
                     f"ps get_rows({name}): expected {out.size} floats, got "
                     f"{n} (unknown table or wrong width?)")
-        _rpc("ps.get_rows", _once)
+        _rpc("ps.get_rows", _once, breaker=self._breaker)
         return out.reshape(len(r), width)
 
     def barrier(self) -> None:
@@ -262,7 +293,7 @@ class PSClient:
                 a.size, code)
             if rc != 0:
                 raise RuntimeError(f"ps put_typed({name}) failed")
-        _rpc("ps.put_typed", _once)
+        _rpc("ps.put_typed", _once, breaker=self._breaker)
 
     def get_typed(self, name: str, size: int, dtype):
         import ctypes
@@ -281,7 +312,7 @@ class PSClient:
             if n != size:
                 raise RuntimeError(
                     f"ps get_typed({name}): expected {size} elems, got {n}")
-        _rpc("ps.get_typed", _once)
+        _rpc("ps.get_typed", _once, breaker=self._breaker)
         return out
 
     def push_typed(self, name: str, grad, dtype, rows=None) -> None:
@@ -304,7 +335,7 @@ class PSClient:
                 a.ctypes.data_as(ctypes.c_void_p), a.size, code)
             if rc != 0:
                 raise RuntimeError(f"ps push_typed({name}) failed")
-        _rpc("ps.push_typed", _once)
+        _rpc("ps.push_typed", _once, breaker=self._breaker)
 
     def close(self) -> None:
         if self._h:

@@ -79,6 +79,29 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_watchdog_timeout_s": 0.0,
     # where watchdog dumps land ("" = the system temp dir)
     "FLAGS_watchdog_dump_dir": "",
+    # watchdog escalation tier for C-level hangs: the async HungStepError
+    # only lands at a Python bytecode boundary, so a thread stuck inside
+    # an XLA execute gets the dump but not the error.  "abort" SIGABRTs
+    # the process (after a grace window past the deadline) when the hung
+    # call still hasn't returned — faulthandler writes every thread's
+    # stack on the way down.  "" (default) disables the tier.
+    "FLAGS_watchdog_escalate": "",
+    # background checkpoint daemon (resilience.CheckpointDaemon) cadence:
+    # snapshot persistables every N completed steps and/or every S
+    # seconds (whichever fires first); 0 disables that trigger.  The
+    # capture runs on the training thread as cheap device-side copies;
+    # serialization + the durable commit run on the daemon thread.
+    "FLAGS_checkpoint_interval_steps": 0,
+    "FLAGS_checkpoint_interval_secs": 0.0,
+    # per-endpoint PS circuit breaker: after a retry budget is exhausted
+    # at an endpoint, fail calls fast for this many seconds instead of
+    # re-paying the full backoff per call; a half-open probe then
+    # re-closes it.  0 disables the breaker.
+    "FLAGS_rpc_circuit_break_secs": 0.0,
+    # gang-commit barrier: how long the rank-0 leader waits for every
+    # rank to announce the same emergency-checkpoint step before giving
+    # up on publishing the COMMITTED manifest for it
+    "FLAGS_gang_commit_timeout_s": 30.0,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -129,6 +152,9 @@ def _apply_side_effects(name: str, value):
     elif name == "FLAGS_watchdog_timeout_s":
         from . import resilience
         resilience.WATCHDOG.set_timeout(float(value))
+    elif name == "FLAGS_watchdog_escalate":
+        from . import resilience
+        resilience.WATCHDOG.escalate = str(value)
     elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
         # the NATIVE ps client reads these via getenv (retry_times per
         # request, deadline at connect) — mirror flag changes into the
@@ -162,6 +188,11 @@ def set_flags(flags: Dict[str, Any]):
             # stored while silently never injecting
             from . import resilience
             resilience.parse_fault_inject(coerced[name])
+        if name == "FLAGS_watchdog_escalate" and \
+                coerced[name] not in ("", "abort"):
+            raise ValueError(
+                f"FLAGS_watchdog_escalate must be '' or 'abort', got "
+                f"{coerced[name]!r}")
     for name, value in coerced.items():
         _values[name] = value
         _apply_side_effects(name, value)

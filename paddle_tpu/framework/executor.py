@@ -392,16 +392,22 @@ class _DispatchPlan:
     """Memoized steady-state dispatch: everything ``run()`` derives from
     (program fingerprint, feed-name tuple, fetch set, scope, flags) that
     does not change step to step — the compiled block, the full cache key,
-    and the expected feed signatures.  A plan hit skips the listen_and_serv
-    scan, feed-name sorting, persistable classification, and the lock."""
+    the resolved (graph-pass-optimized) program, and the expected feed
+    signatures.  A plan hit skips the listen_and_serv scan, feed-name
+    sorting, persistable classification, the lock, AND — for a
+    CompiledProgram — the per-call ``_optimized`` re-resolution (its dict
+    probe + attr chase): the plan is keyed directly on the
+    CompiledProgram's serial + source-program fingerprint, and carries
+    the optimized program it resolved once."""
 
-    __slots__ = ("cb", "key", "feed_names", "feed_sigs")
+    __slots__ = ("cb", "key", "feed_names", "feed_sigs", "program")
 
-    def __init__(self, cb, key, feed_names, feed_sigs):
+    def __init__(self, cb, key, feed_names, feed_sigs, program):
         self.cb = cb
         self.key = key
         self.feed_names = feed_names       # insertion order, not sorted
         self.feed_sigs = feed_sigs
+        self.program = program             # post-_optimized program
 
 
 class LowerCtx:
@@ -863,6 +869,10 @@ class Executor:
         self._inflight: collections.deque = collections.deque()
         self._run_prog_ids: set = set()
         self._evict_reg: set = set()
+        # step-boundary hooks: called after every completed dispatch,
+        # once the scope holds the step's (possibly in-flight) outputs —
+        # the checkpoint daemon's capture point (resilience.py)
+        self._step_hooks: List[Any] = []
         _EXECUTORS.add(self)
         # registry hygiene: when this executor dies, its 13 label series
         # fold into executor="retired" (the callback must not hold a ref
@@ -901,6 +911,25 @@ class Executor:
                 del self._plans[k]
         self._evict_reg.discard(scope_tok)
 
+    # -- step-boundary hooks -------------------------------------------------
+    def add_step_hook(self, fn) -> None:
+        """Register ``fn(executor, scope)`` to run after every completed
+        dispatch, at the step boundary where the scope holds the step's
+        full (possibly still in-flight on device) output state — the
+        safe point to snapshot persistables without tearing a step.
+        Note EVERY ``run()`` counts, including startup programs: attach
+        cadence-counting hooks (``CheckpointDaemon.attach``) after
+        startup.  Hooks run on the dispatching thread and must be cheap;
+        a hook exception fails the step."""
+        with self._lock:
+            if fn not in self._step_hooks:
+                self._step_hooks.append(fn)
+
+    def remove_step_hook(self, fn) -> None:
+        with self._lock:
+            if fn in self._step_hooks:
+                self._step_hooks.remove(fn)
+
     # -- dispatch telemetry --------------------------------------------------
     def dispatch_stats(self) -> Dict[str, Any]:
         """Snapshot of this executor's dispatch counters (see
@@ -933,14 +962,18 @@ class Executor:
             f.name if isinstance(f, Variable) else f
             for f in (fetch_list or []))
         cp_tok = None
+        compiled = None
         if isinstance(program, CompiledProgram):
             compiled = program
-            program = compiled._optimized(fetch_names)
-            mesh = compiled._mesh
-            in_shardings = compiled._build_in_shardings
-            # the serial, not the mesh: two CompiledPrograms with
-            # structurally-equal meshes but different sharding configs
-            # (zero stage, input specs) must not share a compiled block
+            # fast path keys on the SOURCE program + the CompiledProgram
+            # serial and resolves _optimized only on a plan miss: the
+            # memoized plan carries the optimized program, so a
+            # steady-state step skips the per-call re-resolution (dict
+            # probe + attr chase) entirely.  The serial, not the mesh:
+            # two CompiledPrograms with structurally-equal meshes but
+            # different sharding configs (zero stage, input specs) must
+            # not share a compiled block — and reconfiguration bumps it.
+            program = compiled._program
             cp_tok = getattr(compiled, "_serial", None)
             if cp_tok is None:
                 cp_tok = id(compiled)
@@ -956,15 +989,19 @@ class Executor:
 
         # ---- steady-state fast path: one dict probe + a feed-sig check.
         # The plan memoizes every per-run derivation (sorted feed names,
-        # persistable classification, pserver scan, full cache key), so a
-        # repeat step does no re-sorting or re-classification — only the
-        # unavoidable shape/dtype check (feeds CAN change shape, e.g. a
-        # last partial batch, and must fall back to the slow path).
+        # persistable classification, pserver scan, full cache key,
+        # _optimized resolution), so a repeat step does no re-sorting or
+        # re-classification — only the unavoidable shape/dtype check
+        # (feeds CAN change shape, e.g. a last partial batch, and must
+        # fall back to the slow path).
         # mesh and collective must be part of the key: neither is covered
         # by the program fingerprint (a CompiledProgram can share its
         # fingerprint with the raw Program, and the transpiler sets
         # _attrs["collective"] without a version bump), and a plan hit
-        # running the wrong sharding would be silent.
+        # running the wrong sharding would be silent.  The collective
+        # token derives from the SOURCE program's attrs — _optimized
+        # clones them, and keying on the source keeps hit and miss paths
+        # consistent.
         collective = program._attrs.get("collective")
         coll_tok = (tuple(sorted(collective.items()))
                     if collective else None)
@@ -974,10 +1011,15 @@ class Executor:
         if plan is not None and plan.feed_sigs == tuple(
                 _feed_sig(feed[n]) for n in plan.feed_names):
             self._stats.incr("cache_hits")
-            return self._dispatch(plan.cb, plan.key, feed, scope, program,
-                                  return_numpy, seed, t0)
+            return self._dispatch(plan.cb, plan.key, feed, scope,
+                                  plan.program, return_numpy, seed, t0)
 
         # ---- slow path: full classification + (maybe) lowering -------------
+        if compiled is not None:
+            program = compiled._optimized(fetch_names)
+            mesh = compiled._mesh
+            in_shardings = compiled._build_in_shardings
+            collective = program._attrs.get("collective")
         # a pserver program is a blocking host loop, not a jittable block
         # (ref listen_and_serv_op.cc RunImpl blocking in Executor::Run)
         lsv = next((op for op in program.global_block().ops
@@ -1023,7 +1065,7 @@ class Executor:
             plan_names = tuple(feed)
             self._plans[fast_key] = _DispatchPlan(
                 cb, key, plan_names,
-                tuple(_feed_sig(feed[n]) for n in plan_names))
+                tuple(_feed_sig(feed[n]) for n in plan_names), program)
         if scope_tok not in self._evict_reg:
             # serial keys never get overwritten by a reused id, so dead
             # scopes' entries must be evicted explicitly or they leak one
@@ -1184,6 +1226,13 @@ class Executor:
                                          t0, tdisp)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
+        if self._step_hooks:
+            # step boundary: scope state is complete for this step (the
+            # arrays may still be in flight on device — hooks that need
+            # host values must copy device-side and sync elsewhere, the
+            # checkpoint daemon's contract)
+            for h in list(self._step_hooks):
+                h(self, scope)
         from ..flags import get_flags
         fl = get_flags(["FLAGS_benchmark",
                         "FLAGS_executor_max_inflight_steps"])

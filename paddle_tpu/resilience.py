@@ -78,13 +78,16 @@ import traceback
 import zlib
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from . import monitor as _monitor
 
 __all__ = [
-    "InjectedFault", "HungStepError", "is_transient", "mark_transient",
+    "InjectedFault", "HungStepError", "CircuitOpenError",
+    "is_transient", "mark_transient",
     "FaultSpec", "parse_fault_inject", "configure", "maybe_inject",
-    "backoff_schedule", "RetryPolicy", "retry_call",
-    "PreemptionGuard", "resume_or_init",
+    "backoff_schedule", "RetryPolicy", "retry_call", "CircuitBreaker",
+    "CheckpointDaemon", "PreemptionGuard", "resume_or_init",
     "Watchdog", "WATCHDOG", "dump_state",
 ]
 
@@ -110,6 +113,10 @@ _WATCHDOG_CTR = _monitor.REGISTRY.counter(
 _PREEMPT_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_preemption_signals_total",
     "SIGTERM/SIGINT deliveries observed by a PreemptionGuard", ("signal",))
+_CIRCUIT_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_retry_circuit_open_total",
+    "calls failed fast by an open circuit breaker (no RPC attempted, no "
+    "backoff paid)", ("site",))
 
 
 # ---------------------------------------------------------------------------
@@ -135,6 +142,22 @@ class HungStepError(RuntimeError):
     """Raised by the watchdog when a watched step exceeds
     ``FLAGS_watchdog_timeout_s``.  Never retryable: the hang already
     consumed the deadline, and the dump file is the diagnosis."""
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised (fail-fast, no RPC attempted) while a circuit breaker is
+    open: the endpoint already burned a full retry budget, so re-paying
+    the backoff per call would only stall the training loop.  Not
+    transient — retrying the rejection is the exact behavior the breaker
+    exists to stop; the half-open probe re-tests the endpoint instead."""
+
+    def __init__(self, name: str, remaining_s: float):
+        super().__init__(
+            f"circuit breaker for {name!r} is open "
+            f"({remaining_s:.2f}s of FLAGS_rpc_circuit_break_secs "
+            "cool-down remaining); failing fast")
+        self.name = name
+        self.remaining_s = remaining_s
 
 
 def mark_transient(e: BaseException) -> BaseException:
@@ -435,6 +458,96 @@ def retry_call(site: str, fn: Callable, *args,
 
 
 # ---------------------------------------------------------------------------
+# circuit breaker (per-endpoint fail-fast after retry give-up)
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Classic closed → open → half-open breaker around a flaky endpoint.
+
+    A retry GIVE-UP (budget exhausted on transient failures — never a
+    deterministic server verdict) opens the breaker; while open, callers
+    fail fast with :class:`CircuitOpenError` instead of re-paying the full
+    backoff schedule per call.  After ``FLAGS_rpc_circuit_break_secs`` of
+    cool-down, exactly ONE call is let through as the half-open probe: its
+    success re-closes the breaker, its give-up re-opens it (concurrent
+    calls during the probe keep failing fast).  ``cooldown_s=None`` reads
+    the flag per check, so ``set_flags`` retunes live breakers; a cool-down
+    of 0 disables the breaker entirely.
+
+    Every fail-fast rejection bumps
+    ``paddle_tpu_retry_circuit_open_total{site}`` and records a
+    ``retry.circuit_open`` tracer instant — a storm of rejections in the
+    metrics IS the outage report.
+    """
+
+    def __init__(self, name: str = "", cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._cooldown = cooldown_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    def cooldown_s(self) -> float:
+        if self._cooldown is not None:
+            return float(self._cooldown)
+        from .flags import get_flags
+        return float(get_flags("FLAGS_rpc_circuit_break_secs")
+                     ["FLAGS_rpc_circuit_break_secs"])
+
+    @property
+    def state(self) -> str:
+        """``closed`` / ``open`` / ``half_open`` (cool-down elapsed: the
+        next check claims the probe)."""
+        cd = self.cooldown_s()
+        with self._mu:
+            if self._opened_at is None or cd <= 0:
+                return "closed"
+            if self._probing or \
+                    self._clock() - self._opened_at >= cd:
+                return "half_open"
+            return "open"
+
+    def check(self, site: str = "") -> None:
+        """Gate one call: no-op when closed (or disabled); claims the
+        half-open probe when cooled down; otherwise raises
+        :class:`CircuitOpenError` without touching the endpoint."""
+        cd = self.cooldown_s()
+        if cd <= 0:
+            return
+        with self._mu:
+            if self._opened_at is None:
+                return
+            elapsed = self._clock() - self._opened_at
+            if not self._probing and elapsed >= cd:
+                self._probing = True        # this caller IS the probe
+                return
+            remaining = max(cd - elapsed, 0.0)
+        label = site or self.name or "<unnamed>"
+        _CIRCUIT_CTR.inc(1, site=label)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "retry.circuit_open", "resilience",
+                {"site": label, "breaker": self.name,
+                 "remaining_s": round(remaining, 3)})
+        raise CircuitOpenError(self.name or label, remaining)
+
+    def record_success(self) -> None:
+        """A call (probe or normal) completed: close the breaker."""
+        with self._mu:
+            self._opened_at = None
+            self._probing = False
+
+    def record_giveup(self) -> None:
+        """A retry budget was exhausted: (re)open the breaker and restart
+        the cool-down clock."""
+        with self._mu:
+            self._opened_at = self._clock()
+            self._probing = False
+
+
+# ---------------------------------------------------------------------------
 # hung-step watchdog
 # ---------------------------------------------------------------------------
 
@@ -500,7 +613,18 @@ class Watchdog:
     ``paddle_tpu_watchdog_fired_total{site}``, and async-raises
     :class:`HungStepError` in the hung thread.  ``timeout_s <= 0``
     (the default) disables everything — ``watch()`` is then one float
-    compare."""
+    compare.
+
+    C-level hangs: the async raise only lands at a Python bytecode
+    boundary, so a thread stuck inside a C call (an XLA execute that
+    never returns) gets the dump but not the error.  Two extra tiers
+    cover it: every armed watch also schedules
+    ``faulthandler.dump_traceback_later`` (its C-level watchdog thread
+    dumps every stack even when the GIL never comes back), and with
+    ``FLAGS_watchdog_escalate=abort`` a watch still registered a grace
+    window past its deadline SIGABRTs the process — a dead rank a
+    supervisor restarts beats a silent forever-hang holding the gang's
+    preemption barrier."""
 
     def __init__(self):
         self._cv = threading.Condition(threading.Lock())
@@ -508,11 +632,36 @@ class Watchdog:
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self.timeout_s = 0.0
+        #: "" or "abort" (FLAGS_watchdog_escalate)
+        self.escalate = ""
 
     def set_timeout(self, secs: float) -> None:
         self.timeout_s = float(secs)
         with self._cv:
             self._cv.notify()
+
+    def _abort_grace(self) -> float:
+        """How long past the deadline a fired-but-still-registered watch
+        gets for the async raise to land before the SIGABRT tier."""
+        return max(1.0, min(self.timeout_s, 10.0))
+
+    def _fh_rearm_locked(self) -> None:
+        """(Re)arm the process-wide faulthandler timer to the earliest
+        un-fired deadline (cancel when none): unlike :func:`dump_state`,
+        faulthandler dumps from its own C-level thread, so the stacks
+        land even when a hung C call holds the GIL forever."""
+        import faulthandler
+        deadlines = [e["deadline"] for e in self._watches.values()
+                     if not e["fired"]]
+        try:
+            if not deadlines:
+                faulthandler.cancel_dump_traceback_later()
+            else:
+                faulthandler.dump_traceback_later(
+                    max(min(deadlines) - time.monotonic(), 0.05),
+                    exit=False)
+        except Exception:     # faulthandler disabled/unavailable: the
+            pass              # python-level dump path still works
 
     @contextlib.contextmanager
     def watch(self, site: str):
@@ -522,6 +671,7 @@ class Watchdog:
             return
         entry = {"tid": threading.get_ident(), "site": site,
                  "deadline": time.monotonic() + t, "timeout": t,
+                 "abort_at": time.monotonic() + t + self._abort_grace(),
                  "fired": False, "dump": None}
         with self._cv:
             wid = next(self._ids)
@@ -530,6 +680,7 @@ class Watchdog:
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True, name="pt-watchdog")
                 self._thread.start()
+            self._fh_rearm_locked()
             self._cv.notify()
         delivered = False
         try:
@@ -543,6 +694,7 @@ class Watchdog:
         finally:
             with self._cv:
                 self._watches.pop(wid, None)
+                self._fh_rearm_locked()
             if entry["fired"] and not delivered:
                 # the watched call ended (returned, or raised its OWN
                 # error) after the deadline fired but before the async
@@ -563,8 +715,30 @@ class Watchdog:
                 f"FLAGS_watchdog_timeout_s={entry['timeout']}s; thread "
                 f"stacks + telemetry dumped to {where}")
 
+    def _abort(self, entry: dict) -> None:
+        """SIGABRT escalation: the async HungStepError never landed — the
+        watched thread is stuck inside C.  Dump every stack through
+        faulthandler (signal-safe, GIL-independent) and abort; the exit
+        is the diagnosis a supervisor can act on."""
+        import faulthandler
+        sys.stderr.write(
+            f"paddle_tpu watchdog: {entry['site']!r} still hung "
+            f"{self._abort_grace():.1f}s past its "
+            f"{entry['timeout']}s deadline (async raise never landed — "
+            "C-level hang); FLAGS_watchdog_escalate=abort -> SIGABRT\n")
+        sys.stderr.flush()
+        try:
+            faulthandler.dump_traceback(all_threads=True)
+        except Exception:
+            pass
+        # if SIGABRT is blocked/handled the loop must not spin on this
+        # entry forever
+        entry["abort_at"] = float("inf")
+        os.kill(os.getpid(), signal.SIGABRT)
+
     def _loop(self):
         while True:
+            abort_entry = None
             with self._cv:
                 now = time.monotonic()
                 pending = [(w, e) for w, e in self._watches.items()
@@ -574,10 +748,22 @@ class Watchdog:
                 for _, e in expired:
                     e["fired"] = True
                 if not expired:
-                    nxt = min((e["deadline"] for _, e in pending),
-                              default=now + 5.0)
-                    self._cv.wait(timeout=max(nxt - now, 0.02))
-                    continue
+                    fired = [e for e in self._watches.values()
+                             if e["fired"]]
+                    if self.escalate == "abort":
+                        abort_entry = next(
+                            (e for e in fired if e["abort_at"] <= now),
+                            None)
+                    if abort_entry is None:
+                        deadlines = [e["deadline"] for _, e in pending]
+                        if self.escalate == "abort":
+                            deadlines += [e["abort_at"] for e in fired]
+                        nxt = min(deadlines, default=now + 5.0)
+                        self._cv.wait(timeout=max(nxt - now, 0.02))
+                        continue
+            if abort_entry is not None:
+                self._abort(abort_entry)
+                continue
             for wid, e in expired:    # I/O outside the lock
                 try:
                     e["dump"] = dump_state(
@@ -601,6 +787,291 @@ class Watchdog:
 
 
 WATCHDOG = Watchdog()
+
+
+# ---------------------------------------------------------------------------
+# background checkpoint daemon
+# ---------------------------------------------------------------------------
+
+class CheckpointDaemon:
+    """Gang-aware background checkpointing off the training thread.
+
+    Split of labor, chosen so the hot path never serializes:
+
+    - **capture** (training thread, at a step boundary): each persistable
+      gets a device-side ``jnp.copy`` — an async dispatch, no host sync.
+      The copy is essential, not an optimization: the executor DONATES
+      read-write persistables to the next step, so a bare reference
+      captured now is exactly the buffer step *n+1* deletes.
+    - **serialize + commit** (daemon thread): materialize the copies
+      (device→host sync lands HERE), hand them to orbax's async writer,
+      drain it, fsync the checkpoint root, and only then count the step
+      as committed and announce it to the gang (``GangRendezvous``) —
+      the rank-0 leader publishes the ``COMMITTED`` manifest once every
+      rank holds the step.
+
+    Cadence comes from ``FLAGS_checkpoint_interval_steps`` and/or
+    ``FLAGS_checkpoint_interval_secs`` (constructor args override; the
+    seconds trigger is still evaluated at step boundaries — a mid-step
+    snapshot would capture half-updated state).  Only the LATEST pending
+    snapshot is kept when the writer falls behind: checkpoints are a
+    recovery floor, not a log.
+
+    Wiring options::
+
+        daemon = CheckpointDaemon(ckpt, interval_steps=100).start()
+        with PreemptionGuard(ckpt, executor=exe, daemon=daemon) as g:
+            for step in range(start, total):
+                exe.run(...)
+                g.completed_step(step + 1)   # forwards to the daemon
+        # guard exit: the emergency save degrades to "commit the
+        # in-flight async save" instead of a synchronous full write
+
+    or, for loops that do not track step indices,
+    ``daemon.attach(exe)`` drives it from the executor's step-boundary
+    hook (the daemon then counts completed runs itself — attach AFTER
+    the startup program so step 0 is the first training step).
+    """
+
+    def __init__(self, checkpoint, program=None, scope=None,
+                 interval_steps: Optional[int] = None,
+                 interval_secs: Optional[float] = None,
+                 gang=None):
+        from .flags import get_flags
+        fl = get_flags(["FLAGS_checkpoint_interval_steps",
+                        "FLAGS_checkpoint_interval_secs"])
+        self.checkpoint = checkpoint
+        self.program = program
+        self.scope = scope
+        self.interval_steps = (
+            int(fl["FLAGS_checkpoint_interval_steps"])
+            if interval_steps is None else int(interval_steps))
+        self.interval_secs = (
+            float(fl["FLAGS_checkpoint_interval_secs"])
+            if interval_secs is None else float(interval_secs))
+        if gang is None:
+            try:
+                from .distributed.env import GangRendezvous
+                gang = GangRendezvous.from_env()
+            except Exception:
+                gang = None
+        self.gang = gang
+        self._mu = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._pending: Optional[tuple] = None   # (step, state, kind)
+        self._last_capture_step = 0
+        self._last_capture_t = time.monotonic()
+        self._last_committed: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+        self._hooked: list = []
+        self._auto_step = 0
+        self.error: Optional[BaseException] = None
+
+    # -- wiring --------------------------------------------------------------
+    def start(self) -> "CheckpointDaemon":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="pt-ckpt-daemon")
+            self._thread.start()
+        return self
+
+    def attach(self, executor) -> "CheckpointDaemon":
+        """Drive the cadence from ``executor``'s step-boundary hook: every
+        completed ``run()`` counts as one step."""
+        executor.add_step_hook(self._executor_hook)
+        self._hooked.append(executor)
+        return self
+
+    def detach(self) -> None:
+        for exe in self._hooked:
+            exe.remove_step_hook(self._executor_hook)
+        self._hooked.clear()
+
+    def _executor_hook(self, executor, scope) -> None:
+        self._auto_step += 1
+        self.step_completed(self._auto_step, scope=scope)
+
+    # -- training-thread side ------------------------------------------------
+    def due(self, step: int) -> bool:
+        if self.interval_steps and \
+                step - self._last_capture_step >= self.interval_steps:
+            return True
+        if self.interval_secs and \
+                time.monotonic() - self._last_capture_t \
+                >= self.interval_secs:
+            return True
+        return False
+
+    def step_completed(self, step: int, scope=None) -> bool:
+        """Step-boundary notification (training thread).  One int compare
+        off-cadence; on-cadence it snapshots persistables as device-side
+        copies and wakes the daemon.  Returns True iff a snapshot was
+        taken.  Also re-raises a failure the daemon hit in the
+        background — silent checkpoint loss is not an option."""
+        self.check()
+        step = int(step)
+        if not self.due(step):
+            return False
+        self.capture(step, scope=scope)
+        return True
+
+    def capture(self, step: int, scope=None, kind: str = "daemon") -> None:
+        """Snapshot every persistable at a (consistent) step boundary —
+        device arrays via async on-device copies, host arrays via host
+        copies.  No device→host sync happens on this thread."""
+        from .framework.core import default_main_program
+        from .framework.scope import global_scope
+        from .io import get_program_persistable_vars
+        import jax
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        program = self.program or default_main_program()
+        scope = scope or self.scope or global_scope()
+        state: Dict[str, Any] = {}
+        for v in get_program_persistable_vars(program):
+            val = scope.find_var(v.name)
+            if val is None:
+                raise RuntimeError(
+                    f"persistable var {v.name!r} has no value in the "
+                    "scope; did you run the startup program before "
+                    "enabling the checkpoint daemon?")
+            if isinstance(val, jax.Array):
+                state[v.name] = jnp.copy(val)
+            else:
+                state[v.name] = np.array(val, copy=True)
+        with self._mu:
+            self._pending = (int(step), state, kind)
+            self._last_capture_step = int(step)
+            self._last_capture_t = time.monotonic()
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                "checkpoint.capture", "checkpoint", t0,
+                time.perf_counter(), {"step": int(step), "kind": kind})
+        self._wake.set()
+
+    # -- daemon-thread side --------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            while True:
+                with self._mu:
+                    pending, self._pending = self._pending, None
+                if pending is None:
+                    break
+                try:
+                    self._save(*pending)
+                except BaseException as e:  # surfaced at the next
+                    self.error = e          # step_completed()/stop()
+            if self._stop.is_set():
+                return
+
+    def _save(self, step: int, state: Dict[str, Any], kind: str) -> None:
+        # materialize the device-side copies: THIS is where the
+        # device→host sync lands, a thread the training loop never waits
+        # on.  checkpoint.save_arrays then rides orbax's async writer
+        # (plus the checkpoint.write retry/injection plane).
+        host = {name: np.asarray(v) for name, v in state.items()}
+        if not self.checkpoint.save_arrays(step, host, force=True,
+                                           kind=kind):
+            return
+        # durable commit before announcing: the gang protocol's whole
+        # point is that an announced step survives a SIGKILL
+        if hasattr(self.checkpoint, "commit"):
+            self.checkpoint.commit(kind="rank")
+        elif hasattr(self.checkpoint, "wait_until_finished"):
+            self.checkpoint.wait_until_finished()
+        with self._mu:
+            self._last_committed = int(step)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "checkpoint.committed", "checkpoint",
+                {"step": int(step), "kind": kind})
+        self._announce(step)
+
+    def _announce(self, step: int) -> None:
+        gang = self.gang
+        if gang is None:
+            return
+        steps = [int(step)]
+        if hasattr(self.checkpoint, "all_steps"):
+            steps = self.checkpoint.all_steps()
+        gang.announce(step, steps=steps)
+        if gang.is_leader:
+            from . import checkpoint as _ckpt
+            published = gang.commit_latest()
+            if published is not None:
+                _ckpt.COMMIT_CTR.inc(1, kind="gang")
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "checkpoint.gang_commit", "checkpoint",
+                        {"step": int(published)})
+
+    # -- teardown ------------------------------------------------------------
+    @property
+    def last_committed(self) -> Optional[int]:
+        with self._mu:
+            return self._last_committed
+
+    def wait_committed(self, step: int, timeout_s: float = 60.0,
+                       poll_s: float = 0.005) -> bool:
+        """Block until ``step`` is the daemon's durably committed step (a
+        synchronous commit point for callers that need one — tests, or a
+        loop about to externalize state).  Re-raises a background save
+        failure; returns False on timeout."""
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            self.check()
+            if self.last_committed == int(step):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def check(self) -> None:
+        """Re-raise a background save failure on the caller."""
+        if self.error is not None:
+            e, self.error = self.error, None
+            raise RuntimeError(
+                "checkpoint daemon failed in the background") from e
+
+    def stop(self, final_step: Optional[int] = None,
+             scope=None) -> Optional[int]:
+        """Stop the daemon; with ``final_step``, run the emergency
+        protocol: if that step is already committed or its snapshot is
+        already in flight, this just COMMITS the in-flight async save —
+        the preemption-deadline win over a full synchronous write.
+        Otherwise the state is captured now (we are on the exit path; the
+        capture itself is still just device copies) and the daemon thread
+        flushes it.  Returns the last durably committed step."""
+        if final_step is not None:
+            final_step = int(final_step)
+            with self._mu:
+                pending_step = (self._pending[0]
+                                if self._pending is not None else None)
+                committed = self._last_committed
+            if committed != final_step and pending_step != final_step:
+                self.capture(final_step, scope=scope, kind="emergency")
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join()
+        else:
+            # never started (or already stopped): drain inline
+            while True:
+                with self._mu:
+                    pending, self._pending = self._pending, None
+                if pending is None:
+                    break
+                try:
+                    self._save(*pending)
+                except BaseException as e:
+                    self.error = e
+        self.detach()
+        self.check()
+        return self.last_committed
 
 
 # ---------------------------------------------------------------------------
@@ -637,7 +1108,9 @@ class PreemptionGuard:
     def __init__(self, checkpoint=None, executor=None, program=None,
                  scope=None, signals=(signal.SIGTERM, signal.SIGINT),
                  export_dir: Optional[str] = None,
-                 exit_on_preempt: bool = True, exit_code: int = 0):
+                 exit_on_preempt: bool = True, exit_code: int = 0,
+                 daemon: Optional["CheckpointDaemon"] = None,
+                 gang=None):
         self.checkpoint = checkpoint
         self.executor = executor
         self.program = program
@@ -646,6 +1119,20 @@ class PreemptionGuard:
         self.export_dir = export_dir
         self.exit_on_preempt = exit_on_preempt
         self.exit_code = exit_code
+        # background daemon: completed_step() feeds its cadence, and the
+        # emergency save degrades to committing its in-flight async write
+        self.daemon = daemon
+        if daemon is not None and checkpoint is None:
+            self.checkpoint = daemon.checkpoint
+        if gang is None and daemon is not None:
+            gang = daemon.gang
+        if gang is None:
+            try:
+                from .distributed.env import GangRendezvous
+                gang = GangRendezvous.from_env()
+            except Exception:
+                gang = None
+        self.gang = gang
         self._preempted = threading.Event()
         self._signum = signal.SIGTERM
         self._noted = False
@@ -691,8 +1178,12 @@ class PreemptionGuard:
 
     def completed_step(self, step: int) -> None:
         """Mark ``step`` steps as fully complete (scope state consistent
-        through that step) — the emergency checkpoint saves at this index."""
+        through that step) — the emergency checkpoint saves at this
+        index, and an attached :class:`CheckpointDaemon` gets its
+        step-boundary notification."""
         self._last_step = int(step)
+        if self.daemon is not None:
+            self.daemon.step_completed(step, scope=self.scope)
 
     # -- drain + emergency checkpoint ---------------------------------------
     def drain(self) -> None:
@@ -704,21 +1195,100 @@ class PreemptionGuard:
                 self.executor.drain()
 
     def emergency_checkpoint(self) -> Optional[int]:
-        """Drain, then force-save the last complete step; returns the step
-        saved (None when no checkpoint manager / no completed step)."""
+        """Drain, then make the last complete step durable; returns the
+        step saved (None when no checkpoint manager / no completed step).
+
+        With a :class:`CheckpointDaemon` attached this degrades to
+        "commit the in-flight async save" — under a preemption deadline
+        the synchronous cost is a drain, not a full serialize+write.
+        Either way the step is fsync-durable before the gang announce:
+        a rank must never advertise a checkpoint a crash could lose."""
         self.drain()
-        if self.checkpoint is None or self._last_step is None:
+        if self._last_step is None or \
+                (self.checkpoint is None and self.daemon is None):
             return None
+        step = self._last_step
+        durable = None
         with _monitor.TRACER.span("preemption.checkpoint", "resilience",
-                                  step=self._last_step):
-            self.checkpoint.save(self._last_step, program=self.program,
-                                 scope=self.scope, force=True)
-            # the save may be async (orbax): the process is about to exit,
-            # so it must land on disk NOW
-            wait = getattr(self.checkpoint, "_mgr", None)
-            if wait is not None and hasattr(wait, "wait_until_finished"):
-                wait.wait_until_finished()
-        return self._last_step
+                                  step=step):
+            if self.daemon is not None:
+                durable = self.daemon.stop(final_step=step,
+                                           scope=self.scope)
+            else:
+                try:
+                    self.checkpoint.save(step, program=self.program,
+                                         scope=self.scope, force=True,
+                                         kind="emergency")
+                except TypeError:   # foreign manager without kind=
+                    self.checkpoint.save(step, program=self.program,
+                                         scope=self.scope, force=True)
+                # the save may be async (orbax): the process is about to
+                # exit, so it must land on disk NOW
+                if hasattr(self.checkpoint, "commit"):
+                    durable = self.checkpoint.commit(kind="rank")
+                else:
+                    wait = getattr(self.checkpoint, "_mgr", None)
+                    if wait is not None and \
+                            hasattr(wait, "wait_until_finished"):
+                        wait.wait_until_finished()
+                    if hasattr(self.checkpoint, "latest_step"):
+                        durable = self.checkpoint.latest_step()
+                    else:
+                        durable = step      # no way to ask; trust it
+        if durable == step:
+            self._gang_commit(step)
+        else:
+            # never advertise a step that is not actually on disk (an
+            # orbax write can be silently refused when a stale NEWER
+            # step lingers): a unanimous-but-wrong announce would let
+            # the leader publish a manifest no rank can restore
+            import warnings
+            warnings.warn(
+                f"emergency checkpoint at step {step} is not the durable "
+                f"latest ({durable}); skipping the gang announce — the "
+                "manifest stays at the last committed step")
+        return step
+
+    def _gang_commit(self, step: int) -> None:
+        """Gang barrier for the emergency save: announce this rank's
+        durable step; the rank-0 leader publishes ``COMMITTED <step>``
+        only when EVERY rank announced the same step within
+        ``FLAGS_gang_commit_timeout_s`` — otherwise the manifest stays at
+        the last step the whole gang agreed on, and ``resume_or_init``
+        refuses the torn newer saves."""
+        if self.gang is None:
+            return
+        from .flags import get_flags
+        timeout = float(get_flags("FLAGS_gang_commit_timeout_s")
+                        ["FLAGS_gang_commit_timeout_s"])
+        ckpt = self.checkpoint
+        steps = ckpt.all_steps() if hasattr(ckpt, "all_steps") else [step]
+        try:
+            self.gang.announce(step, steps=steps)
+            if not self.gang.is_leader:
+                return
+            from . import checkpoint as _ckpt
+            with _monitor.TRACER.span("checkpoint.gang_barrier",
+                                      "checkpoint", step=int(step)):
+                ok = self.gang.wait_commit(step, timeout)
+            if ok:
+                _ckpt.COMMIT_CTR.inc(1, kind="gang")
+            else:
+                import warnings
+                warnings.warn(
+                    f"gang commit of emergency step {step} timed out "
+                    f"after {timeout}s (a rank died or saved a different "
+                    "step); the manifest stays at "
+                    f"{self.gang.committed_step()} and the torn save "
+                    "will be refused at resume")
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "checkpoint.gang_commit_timeout", "checkpoint",
+                        {"step": int(step)})
+        except Exception:
+            import warnings
+            warnings.warn("gang rendezvous failed during the emergency "
+                          "drain; exiting with the rank-local checkpoint")
 
     # -- context manager -----------------------------------------------------
     def __enter__(self):
@@ -760,7 +1330,7 @@ class PreemptionGuard:
 
 
 def resume_or_init(checkpoint, executor, startup_program=None,
-                   main_program=None, scope=None) -> int:
+                   main_program=None, scope=None, gang=None) -> int:
     """Restart a training loop from the last complete checkpoint.
 
     Runs the startup program (vars must exist before a restore can fill
@@ -773,10 +1343,27 @@ def resume_or_init(checkpoint, executor, startup_program=None,
                                main_program=main)
         for step in range(start, total_steps):
             ...
+
+    In a gang (``gang`` passed, or launched with ``PADDLE_GANG_DIR`` and
+    >1 ranks) the unit of recovery is the GANG, not the rank: only the
+    step named by the leader's ``COMMITTED`` manifest is restorable.  A
+    rank-local checkpoint newer than the manifest is a torn save (some
+    other rank never finished it) — it is pruned and the gang-committed
+    step restored instead; with no manifest at all, every checkpoint is
+    refused and the run cold-starts.  Each refusal bumps
+    ``paddle_tpu_checkpoint_torn_rejects_total``.
     """
     from .framework.core import default_startup_program
+    if gang is None:
+        try:
+            from .distributed.env import GangRendezvous
+            gang = GangRendezvous.from_env()
+        except Exception:
+            gang = None
     startup = startup_program or default_startup_program()
     executor.run(startup, scope=scope)
+    if gang is not None:
+        return _resume_gang(checkpoint, gang, main_program, scope)
     step = checkpoint.latest_step()
     if step is None:
         return 0
@@ -787,6 +1374,54 @@ def resume_or_init(checkpoint, executor, startup_program=None,
     return int(step)
 
 
+def _resume_gang(checkpoint, gang, main_program, scope) -> int:
+    """Gang-manifest resume: restore exactly the committed step, refuse
+    (and prune) anything newer — see :func:`resume_or_init`."""
+    import warnings
+    from . import checkpoint as _ckpt
+    committed = gang.committed_step()
+    latest = checkpoint.latest_step()
+    if committed is None:
+        if latest is not None:
+            _ckpt.TORN_CTR.inc()
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.instant(
+                    "checkpoint.torn_reject", "checkpoint",
+                    {"latest": int(latest), "committed": None})
+            warnings.warn(
+                f"rank {gang.rank}: refusing checkpoint step {latest} — "
+                "no gang COMMITTED manifest exists (the save tore before "
+                "every rank finished); cold-starting")
+            if hasattr(checkpoint, "prune_after"):
+                # the refused steps must also GO: orbax silently rejects
+                # saves at indices ≤ its latest step, so leaving them
+                # would suppress the cold-started run's checkpoints (and
+                # a later emergency could even gang-commit the previous
+                # run's stale weights)
+                checkpoint.prune_after(-1)
+        return 0
+    if latest is not None and latest != committed:
+        _ckpt.TORN_CTR.inc()
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "checkpoint.torn_reject", "checkpoint",
+                {"latest": int(latest), "committed": int(committed)})
+        warnings.warn(
+            f"rank {gang.rank}: checkpoint step {latest} is not the "
+            f"gang-committed step {committed} (torn multi-rank save); "
+            "restoring the committed step")
+    if hasattr(checkpoint, "prune_after"):
+        # torn steps past the manifest must go: orbax refuses saves at
+        # indices ≤ its latest step, so a resumed run could otherwise
+        # never checkpoint again until it re-passed the torn step
+        checkpoint.prune_after(committed)
+    checkpoint.restore(committed, program=main_program, scope=scope)
+    if _monitor.TRACER.enabled:
+        _monitor.TRACER.instant("preemption.resume", "resilience",
+                                {"step": int(committed), "gang": True})
+    return int(committed)
+
+
 # ---------------------------------------------------------------------------
 # flag sync (mirrors monitor._sync_from_flags: whichever of the two
 # modules imports second sees the other's already-bootstrapped values)
@@ -795,12 +1430,14 @@ def resume_or_init(checkpoint, executor, startup_program=None,
 def _sync_from_flags():
     try:
         from .flags import get_flags
-        fl = get_flags(["FLAGS_fault_inject", "FLAGS_watchdog_timeout_s"])
+        fl = get_flags(["FLAGS_fault_inject", "FLAGS_watchdog_timeout_s",
+                        "FLAGS_watchdog_escalate"])
     except Exception:           # flags mid-bootstrap: side effects re-sync
         return
     if fl["FLAGS_fault_inject"]:
         configure(str(fl["FLAGS_fault_inject"]))
     WATCHDOG.set_timeout(float(fl["FLAGS_watchdog_timeout_s"]))
+    WATCHDOG.escalate = str(fl["FLAGS_watchdog_escalate"])
 
 
 _sync_from_flags()

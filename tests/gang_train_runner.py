@@ -1,0 +1,135 @@
+"""Subprocess runner for the gang-coordinated checkpoint tests.
+
+One rank of a (file-rendezvous) gang: trains the same deterministic
+linear-regression loop as ``resilience_train_runner.py`` with a
+background :class:`CheckpointDaemon` committing every
+``GANG_CKPT_INTERVAL`` steps and announcing to the gang; rank 0 publishes
+the ``COMMITTED`` manifest.  Prints per step ``STEP <i> LOSS <repr>``
+(repr round-trips float32 exactly) and appends completed step indices to
+a progress file the parent polls.
+
+Usage::
+
+    python gang_train_runner.py CKPT_ROOT TOTAL_STEPS PROGRESS_FILE \
+        [SLEEP_PER_STEP]
+
+Env contract (set by the parent test):
+
+- ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` / ``PADDLE_GANG_DIR``
+  — the launcher's gang contract; each rank checkpoints into
+  ``CKPT_ROOT/rank_<id>``.
+- ``GANG_CKPT_INTERVAL`` — daemon cadence in steps (default 2).
+- ``GANG_EMERGENCY_HANG=1`` — on preemption, make the emergency
+  checkpoint write hang (fault-inject ``checkpoint.write`` in hang
+  mode) so the parent can SIGKILL this rank mid-emergency-save: the
+  torn-save scenario.
+- ``GANG_AVOID_MULTIPLE=N`` — keep looping past a preemption until the
+  completed-step count is NOT a multiple of N (makes the emergency step
+  provably un-announceable by a rank whose cadence is N — the parent
+  uses it to force a deterministic torn reject).
+
+On SIGTERM the guard drains, commits the last complete step, announces
+it, and (rank 0) runs the gang barrier; exit 0.  A rerun with the same
+CKPT_ROOT resumes every rank from the manifest step via
+``resume_or_init`` (printing ``RESUMED_AT <step>`` and
+``TORN_REJECTS <n>``) and finishes the remaining steps.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers, monitor  # noqa: E402
+from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
+from paddle_tpu.distributed.env import GangRendezvous  # noqa: E402
+from paddle_tpu.framework import Executor  # noqa: E402
+from paddle_tpu.resilience import (CheckpointDaemon,  # noqa: E402
+                                   PreemptionGuard, resume_or_init)
+
+
+def batch(step):
+    rng = np.random.RandomState(1234 + step)
+    x = rng.rand(8, 4).astype(np.float32)
+    return x, x.sum(1, keepdims=True).astype(np.float32)
+
+
+def main():
+    root, total, progress = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    pause = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    interval = int(os.environ.get("GANG_CKPT_INTERVAL", "2"))
+    avoid = int(os.environ.get("GANG_AVOID_MULTIPLE", "0"))
+
+    pt.default_startup_program().random_seed = 7
+    pt.default_main_program().random_seed = 7
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="gt_w"),
+                     bias_attr=pt.ParamAttr(name="gt_b"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(0.05).minimize(loss)
+
+    exe = Executor()
+    gang = GangRendezvous.from_env()
+    ckpt = CheckpointManager(os.path.join(root, f"rank_{rank}"),
+                             max_to_keep=50)
+    before = monitor.counter_totals()
+    start = resume_or_init(ckpt, exe,
+                           startup_program=pt.default_startup_program(),
+                           main_program=pt.default_main_program(),
+                           gang=gang)
+    after = monitor.counter_totals()
+    torn = int(after.get("paddle_tpu_checkpoint_torn_rejects_total", 0)
+               - before.get("paddle_tpu_checkpoint_torn_rejects_total", 0))
+    print(f"RESUMED_AT {start}", flush=True)
+    print(f"TORN_REJECTS {torn}", flush=True)
+
+    daemon = CheckpointDaemon(ckpt, program=pt.default_main_program(),
+                              interval_steps=interval, interval_secs=0,
+                              gang=gang).start()
+    with PreemptionGuard(ckpt, executor=exe,
+                         program=pt.default_main_program(),
+                         daemon=daemon, gang=gang, exit_code=0) as guard:
+        for step in range(start, total):
+            xv, yv = batch(step)
+            out, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+            print(f"STEP {step} LOSS {float(np.asarray(out).ravel()[0])!r}",
+                  flush=True)
+            guard.completed_step(step + 1)
+            if os.environ.get("GANG_SYNC_COMMITS") and \
+                    daemon._last_capture_step == step + 1:
+                # test mode: make every cadence commit deterministic so
+                # the parent can reason about exactly which steps each
+                # rank announced (coalescing under load would make the
+                # committed set timing-dependent)
+                daemon.wait_committed(step + 1)
+            with open(progress, "a") as f:
+                f.write(f"{step}\n")
+                f.flush()
+                os.fsync(f.fileno())
+            if pause:
+                time.sleep(pause)
+            if guard.preempted:
+                if avoid and (step + 1) % avoid == 0:
+                    continue     # force an un-announceable emergency step
+                if os.environ.get("GANG_EMERGENCY_HANG"):
+                    # the emergency save's checkpoint.write now hangs —
+                    # the parent SIGKILLs this rank mid-emergency-save
+                    pt.set_flags({"FLAGS_fault_inject":
+                                  "checkpoint.write:every=1,hang=120"})
+                break
+    # clean completion (no preemption): flush a final committed step
+    daemon.stop(final_step=total)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

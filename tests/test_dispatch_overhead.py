@@ -101,6 +101,45 @@ def test_profiler_level_aggregation():
         assert exe.dispatch_stats()["steps_dispatched"] == 0
 
 
+def test_compiled_program_plan_skips_optimized_reresolution():
+    """The dispatch plan is keyed directly on the CompiledProgram (serial
+    + source fingerprint) and carries the optimized program it resolved
+    once: steady-state runs must not re-enter ``_optimized`` (its dict
+    probe + attr chase) at all, while a program mutation still falls back
+    and re-resolves."""
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        exe, loss = _build_train_step(scope)
+        compiled = fluid.CompiledProgram(
+            fluid.default_main_program()).with_data_parallel(
+                loss_name=loss.name)
+        # batch divisible by the virtual 8-device mesh
+        feed = {"x": np.ones((8, 8), np.float32)}
+        exe.run(compiled, feed=feed, fetch_list=[loss.name], scope=scope)
+
+        calls = []
+        orig = compiled._optimized
+        compiled._optimized = lambda *a, **k: (calls.append(1),
+                                               orig(*a, **k))[1]
+        base = exe.dispatch_stats()
+        out = None
+        for _ in range(20):
+            out, = exe.run(compiled, feed=feed, fetch_list=[loss.name],
+                           scope=scope, return_numpy=False)
+        s = exe.dispatch_stats()
+        assert calls == [], \
+            "steady-state dispatch re-resolved CompiledProgram._optimized"
+        assert s["cache_hits"] - base["cache_hits"] == 20
+        assert s["traces"] == base["traces"]
+        assert np.isfinite(np.asarray(out)).all()
+
+        # a mutated program must miss the plan and re-resolve: the fast
+        # key includes the source program's fingerprint (version bump)
+        fluid.default_main_program()._bump_version()
+        exe.run(compiled, feed=feed, fetch_list=[loss.name], scope=scope)
+        assert calls, "mutation did not re-enter _optimized"
+
+
 def test_benchmark_flag_syncs_per_step_over_async():
     """FLAGS_benchmark wins over async dispatch: every step syncs, the
     throttle never engages, and the sync time is attributed."""

@@ -34,8 +34,12 @@ def _clean_flags():
     pt.set_flags({"FLAGS_fault_inject": "",
                   "FLAGS_watchdog_timeout_s": 0.0,
                   "FLAGS_watchdog_dump_dir": "",
+                  "FLAGS_watchdog_escalate": "",
                   "FLAGS_rpc_retry_times": 3,
-                  "FLAGS_rpc_deadline": 180000})
+                  "FLAGS_rpc_deadline": 180000,
+                  "FLAGS_rpc_circuit_break_secs": 0.0,
+                  "FLAGS_checkpoint_interval_steps": 0,
+                  "FLAGS_checkpoint_interval_secs": 0.0})
 
 
 def _totals():
@@ -543,6 +547,546 @@ def test_executor_drain_retires_inflight_steps():
                     fetch_list=[loss], return_numpy=False)
         exe.drain()
         assert exe.dispatch_stats()["steps_in_flight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (satellite: PSClient fail-fast after give-up)
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_state_machine():
+    clock = {"t": 100.0}
+    br = res.CircuitBreaker(name="ep:1", cooldown_s=10.0,
+                            clock=lambda: clock["t"])
+    assert br.state == "closed"
+    br.check("s")                              # closed: no-op
+    br.record_giveup()
+    assert br.state == "open"
+    before = monitor.counter_totals()
+    with pytest.raises(res.CircuitOpenError):
+        br.check("s")
+    after = monitor.counter_totals()
+    assert _delta(before, after,
+                  "paddle_tpu_retry_circuit_open_total") == 1
+    # cool-down elapses -> half-open; the FIRST check claims the probe,
+    # a concurrent second check still fails fast
+    clock["t"] += 10.0
+    assert br.state == "half_open"
+    br.check("s")
+    with pytest.raises(res.CircuitOpenError):
+        br.check("s")
+    # probe failure re-opens (fresh cool-down clock)
+    br.record_giveup()
+    assert br.state == "open"
+    with pytest.raises(res.CircuitOpenError):
+        br.check("s")
+    clock["t"] += 10.0
+    br.check("s")                              # new probe
+    br.record_success()
+    assert br.state == "closed"
+    br.check("s")
+
+
+def test_circuit_breaker_disabled_by_zero_cooldown():
+    br = res.CircuitBreaker(name="ep:2", cooldown_s=0.0)
+    br.record_giveup()
+    assert br.state == "closed"
+    br.check("s")                              # never trips
+
+
+def test_ps_circuit_breaker_fails_fast_and_recovers():
+    from paddle_tpu import native
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    import socket
+    from paddle_tpu.distributed import ps as ps_mod
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = ps_mod.PSServer(port, num_trainers=1, sync_mode=False,
+                             param_specs=[{"name": "w", "size": 8,
+                                           "optimizer": "sgd", "lr": 0.1}])
+    port = server.start()
+    try:
+        cli = ps_mod.get_client(f"127.0.0.1:{port}")
+        cli.put("w", np.zeros(8, np.float32))   # breaker starts closed
+        pt.set_flags({"FLAGS_rpc_circuit_break_secs": 30.0,
+                      "FLAGS_rpc_retry_times": 0,
+                      "FLAGS_fault_inject": "ps.put:every=1"})
+        before = _totals()
+        with pytest.raises(res.InjectedFault):
+            cli.put("w", np.zeros(8, np.float32))   # give-up opens it
+        assert cli._breaker.state == "open"
+        with pytest.raises(res.CircuitOpenError):
+            cli.put("w", np.zeros(8, np.float32))   # fail fast, no RPC
+        after = _totals()
+        # the rejected call never reached the injection site
+        assert _delta(before, after, "paddle_tpu_fault_injected_total") == 1
+        assert _delta(before, after,
+                      "paddle_tpu_retry_circuit_open_total") == 1
+        # cool-down elapses -> half-open probe; with the fault cleared
+        # the probe succeeds and re-closes the breaker
+        pt.set_flags({"FLAGS_rpc_circuit_break_secs": 0.05,
+                      "FLAGS_fault_inject": ""})
+        time.sleep(0.06)
+        cli.put("w", np.ones(8, np.float32))
+        assert cli._breaker.state == "closed"
+        out = cli.get("w", 8, barrier=False)
+        assert out[0] == 1.0
+        # deterministic server verdicts do NOT trip the breaker
+        pt.set_flags({"FLAGS_rpc_circuit_break_secs": 30.0})
+        with pytest.raises(RuntimeError, match="unknown table"):
+            cli.get("no_such_table", 8, barrier=False)
+        assert cli._breaker.state == "closed"
+    finally:
+        pt.set_flags({"FLAGS_fault_inject": "",
+                      "FLAGS_rpc_retry_times": 3,
+                      "FLAGS_rpc_circuit_break_secs": 0.0})
+        ps_mod.reset_clients()
+        server.stop()
+        server.destroy()
+
+
+# ---------------------------------------------------------------------------
+# watchdog escalation (satellite: C-level hang coverage)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_arms_faulthandler_alongside_watch(monkeypatch):
+    calls = []
+    import faulthandler
+    monkeypatch.setattr(faulthandler, "dump_traceback_later",
+                        lambda *a, **k: calls.append(("arm", a, k)))
+    monkeypatch.setattr(faulthandler, "cancel_dump_traceback_later",
+                        lambda: calls.append(("cancel",)))
+    pt.set_flags({"FLAGS_watchdog_timeout_s": 5.0})
+    with res.WATCHDOG.watch("unit.fh"):
+        assert calls and calls[-1][0] == "arm"
+        assert calls[-1][2].get("exit") is False
+    assert calls[-1] == ("cancel",)
+
+
+def test_watchdog_escalate_flag_validates():
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_watchdog_escalate": "bogus"})
+    pt.set_flags({"FLAGS_watchdog_escalate": "abort"})
+    assert res.WATCHDOG.escalate == "abort"
+    pt.set_flags({"FLAGS_watchdog_escalate": ""})
+    assert res.WATCHDOG.escalate == ""
+
+
+def test_watchdog_abort_tier_kills_c_level_hang():
+    """A thread stuck in a C call (time.sleep never hits a bytecode
+    boundary) ignores the async raise; FLAGS_watchdog_escalate=abort must
+    SIGABRT the process after the grace window."""
+    script = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import resilience as res\n"
+        "pt.set_flags({'FLAGS_watchdog_timeout_s': 0.3,\n"
+        "              'FLAGS_watchdog_escalate': 'abort'})\n"
+        "with res.WATCHDOG.watch('c.hang'):\n"
+        "    time.sleep(60)\n"   # one C call: the async raise never lands
+        "print('UNREACHABLE')\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FLAGS_fault_inject", None)
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == -signal.SIGABRT, (r.returncode, r.stdout,
+                                             r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert "FLAGS_watchdog_escalate=abort" in r.stderr
+    assert time.monotonic() - t0 < 60
+
+
+# ---------------------------------------------------------------------------
+# background checkpoint daemon (tentpole)
+# ---------------------------------------------------------------------------
+
+def _training_thread_spans(name):
+    import threading
+    tid = threading.get_ident() & 0xffffff
+    return [e for e in monitor.TRACER.chrome_events()
+            if e.get("name") == name and e.get("ph") == "X"
+            and e.get("tid") == tid]
+
+
+def _wait_committed(daemon, step, timeout=60.0):
+    assert daemon.wait_committed(step, timeout_s=timeout)
+
+
+def test_checkpoint_daemon_cadence_and_off_thread_saves(tmp_path):
+    before = _totals()
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="cd_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+        daemon = res.CheckpointDaemon(ckpt, interval_steps=3).start()
+        base_saves = len(_training_thread_spans("checkpoint.save"))
+        rng = np.random.RandomState(0)
+        for step in range(8):
+            xv = rng.rand(4, 4).astype(np.float32)
+            exe.run(feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+            took = daemon.step_completed(step + 1)
+            assert took == ((step + 1) % 3 == 0)
+            if took:
+                # wait out the async write so the NEXT capture cannot
+                # coalesce over it (the daemon keeps only the latest
+                # pending snapshot by design)
+                _wait_committed(daemon, step + 1)
+        last = daemon.stop(final_step=8)
+        assert last == 8
+        # cadence: captures at 3 and 6, plus the final forced step
+        assert ckpt.all_steps() == [3, 6, 8]
+        # the training thread never serialized a checkpoint: every
+        # checkpoint.save span lives on the daemon thread
+        assert len(_training_thread_spans("checkpoint.save")) == base_saves
+        # restored state equals the live scope bit-for-bit
+        live = np.asarray(pt.global_scope().find_var("cd_w")).copy()
+        fresh = Scope()
+        assert ckpt.restore(scope=fresh) == 8
+        np.testing.assert_array_equal(
+            np.asarray(fresh.find_var("cd_w")), live)
+        ckpt.close()
+    after = _totals()
+    assert _delta(before, after,
+                  "paddle_tpu_checkpoint_saves_total") == 3
+    assert _delta(before, after,
+                  "paddle_tpu_checkpoint_commits_total") == 3
+    assert _delta(before, after, "paddle_tpu_checkpoint_bytes_total") > 0
+    assert _delta(before, after,
+                  "paddle_tpu_checkpoint_save_ms_count") == 3
+
+
+def test_checkpoint_daemon_executor_hook_cadence(tmp_path):
+    """daemon.attach(exe): the executor's step-boundary hook drives the
+    cadence with no explicit step_completed calls."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=2,
+                                     param_attr=pt.ParamAttr(name="eh_w")))
+        exe = Executor()
+        exe.run(pt.default_startup_program())       # before attach
+        ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+        daemon = res.CheckpointDaemon(ckpt, interval_steps=2).start()
+        daemon.attach(exe)
+        feed = {"x": np.zeros((2, 4), np.float32)}
+        for i in range(5):
+            exe.run(feed=feed, fetch_list=[loss])
+            if (i + 1) % 2 == 0:
+                _wait_committed(daemon, i + 1)
+        daemon.stop()
+        assert ckpt.all_steps() == [2, 4]
+        # detached: further runs no longer count
+        exe.run(feed=feed, fetch_list=[loss])
+        assert daemon._auto_step == 5
+        ckpt.close()
+
+
+def test_checkpoint_daemon_time_cadence_checked_at_boundaries(tmp_path):
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=2))
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        ckpt = CheckpointManager(str(tmp_path / "run"))
+        daemon = res.CheckpointDaemon(ckpt, interval_steps=0,
+                                      interval_secs=0.05).start()
+        feed = {"x": np.zeros((2, 4), np.float32)}
+        exe.run(feed=feed, fetch_list=[loss])    # pays the compile
+        daemon._last_capture_t = time.monotonic()
+        assert not daemon.step_completed(1)      # too soon
+        time.sleep(0.06)
+        assert daemon.step_completed(2)          # seconds trigger fired
+        daemon.stop()
+        assert ckpt.all_steps() == [2]
+        ckpt.close()
+
+
+def test_checkpoint_daemon_background_error_surfaces(tmp_path):
+    """A save failing in the background must re-raise on the training
+    thread at the next boundary, not rot silently."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=2))
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+
+        class Doomed:
+            def save_arrays(self, *a, **k):
+                raise OSError("disk gone")
+
+        daemon = res.CheckpointDaemon(Doomed(), interval_steps=1).start()
+        feed = {"x": np.zeros((2, 4), np.float32)}
+        exe.run(feed=feed, fetch_list=[loss])
+        daemon.step_completed(1)
+        deadline = time.monotonic() + 10
+        while daemon.error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(RuntimeError, match="daemon failed"):
+            daemon.step_completed(2)
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# gang rendezvous + manifest (tentpole: gang-level preemption)
+# ---------------------------------------------------------------------------
+
+def test_manifest_format_round_trip_and_rejects_garbage():
+    from paddle_tpu.distributed.env import format_manifest, parse_manifest
+    assert parse_manifest(format_manifest(17, 4)) == 17
+    assert parse_manifest("COMMITTED 0\n") == 0
+    for bad in ("", "COMMITTED", "COMMITTED x", "COMITTED 3",
+                "COMMITTED 3 4", "COMMITTED -1", "step 3"):
+        with pytest.raises(ValueError):
+            parse_manifest(bad)
+
+
+def test_gang_rendezvous_announce_and_commit(tmp_path):
+    from paddle_tpu.distributed.env import GangRendezvous
+    g0 = GangRendezvous(str(tmp_path), rank=0, world_size=2)
+    g1 = GangRendezvous(str(tmp_path), rank=1, world_size=2)
+    assert g0.is_leader and not g1.is_leader
+    assert g0.committed_step() is None
+    # non-blocking commit needs EVERY rank announced + a common step
+    g0.announce(4, steps=[2, 4])
+    assert g0.commit_latest() is None
+    g1.announce(4, steps=[4])
+    assert g0.commit_latest() == 4
+    assert g1.committed_step() == 4
+    # no advance -> no re-publish; advance only on a NEW common step
+    assert g0.commit_latest() is None
+    g0.announce(6, steps=[2, 4, 6])
+    assert g0.commit_latest() is None            # rank1 lacks 6
+    g1.announce(6, steps=[4, 6])
+    assert g0.commit_latest() == 6
+    # blocking emergency barrier: strict equality on the latest step
+    g1.announce(8, steps=[4, 6, 8])
+    assert not g0.wait_commit(8, timeout_s=0.2)  # rank0 itself is at 6
+    g0.announce(8, steps=[6, 8])
+    assert g0.wait_commit(8, timeout_s=0.2)
+    assert g1.committed_step() == 8
+    with pytest.raises(RuntimeError):
+        g1.publish(9)
+    # a corrupt manifest reads as "nothing committed", with a warning
+    with open(g0.manifest_path, "w") as f:
+        f.write("garbage\n")
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert g0.committed_step() is None
+
+
+def test_resume_or_init_refuses_torn_checkpoint(tmp_path):
+    """Checkpoints newer than the gang manifest are pruned and the
+    committed step restored bit-identically; with no manifest at all the
+    run cold-starts."""
+    from paddle_tpu.distributed.env import GangRendezvous
+    before = _totals()
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="tr_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+        rng = np.random.RandomState(0)
+        committed_w = None
+        for step in range(1, 5):
+            xv = rng.rand(4, 4).astype(np.float32)
+            exe.run(feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+            exe.drain()
+            ckpt.save(step, force=True)
+            if step == 2:
+                ckpt.wait_until_finished()
+                committed_w = np.asarray(
+                    pt.global_scope().find_var("tr_w")).copy()
+        ckpt.commit()
+        gang = GangRendezvous(str(tmp_path / "gang"), rank=0,
+                              world_size=2)
+        # manifest at step 2: steps 3,4 are torn -> pruned + refused,
+        # step 2 restored bit-identically
+        gang.publish(2)
+        with pytest.warns(UserWarning, match="torn"):
+            start = res.resume_or_init(
+                ckpt, exe, main_program=pt.default_main_program(),
+                gang=gang)
+        assert start == 2
+        assert ckpt.all_steps() == [1, 2]
+        np.testing.assert_array_equal(
+            np.asarray(pt.global_scope().find_var("tr_w")), committed_w)
+        # and the resumed run can checkpoint again right away
+        assert ckpt.save(3, force=True)
+        ckpt.commit()
+        # no manifest at all (whole gang died before the first publish):
+        # every checkpoint is refused AND pruned -> a true cold start
+        # whose step-1 save is not silently rejected by a stale latest
+        gang2 = GangRendezvous(str(tmp_path / "gang2"), rank=0,
+                               world_size=2)
+        with pytest.warns(UserWarning, match="no gang COMMITTED"):
+            assert res.resume_or_init(
+                ckpt, exe, main_program=pt.default_main_program(),
+                gang=gang2) == 0
+        assert ckpt.all_steps() == []
+        assert ckpt.save(1, force=True)
+        ckpt.close()
+    after = _totals()
+    assert _delta(before, after,
+                  "paddle_tpu_checkpoint_torn_rejects_total") == 2
+
+
+def test_gang_kill_one_rank_mid_emergency_save_rejects_torn_step(
+        tmp_path):
+    """The multi-rank torn-save contract end to end: two ranks train
+    under gang-coordinated daemons; both get SIGTERM, rank 1 is
+    SIGKILLed mid-emergency-save.  The manifest must stay at the last
+    step the WHOLE gang committed; a rerun resumes both ranks there and
+    reproduces the uninterrupted loss trajectory exactly."""
+    runner = os.path.join(os.path.dirname(__file__),
+                          "gang_train_runner.py")
+    total = 30
+    gang_dir = tmp_path / "gang"
+    base_env = dict(os.environ)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    for k in ("XLA_FLAGS", "FLAGS_fault_inject", "PADDLE_GANG_DIR"):
+        base_env.pop(k, None)
+
+    def losses(out):
+        vals = {}
+        for line in out.splitlines():
+            if line.startswith("STEP "):
+                _, i, _, v = line.split()
+                vals[int(i)] = float(v)
+        return vals
+
+    def rank_env(rank, **extra):
+        env = dict(base_env)
+        env.update({"PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": "2",
+                    "PADDLE_GANG_DIR": str(gang_dir),
+                    "GANG_CKPT_INTERVAL": "2" if rank == 0 else "4",
+                    "GANG_SYNC_COMMITS": "1",
+                    # both ranks break only at steps ≢ 0 (mod 4): the
+                    # emergency step is then provably uncommitted (rank 1
+                    # really enters its hanging emergency save) and
+                    # un-announceable by rank 1's cadence
+                    "GANG_AVOID_MULTIPLE": "4",
+                    "FLAGS_gang_commit_timeout_s": "3"})
+        env.update(extra)
+        return env
+
+    # 1. uninterrupted baseline (single rank, no gang)
+    r = subprocess.run(
+        [sys.executable, runner, str(tmp_path / "base_ckpt"), str(total),
+         str(tmp_path / "pb")],
+        env=dict(base_env, PADDLE_TRAINERS_NUM="1"),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    base = losses(r.stdout)
+    assert sorted(base) == list(range(total))
+
+    # 2. chaos run: two ranks; rank 0 avoids multiples of rank 1's
+    # cadence so its emergency step is provably un-announceable by
+    # rank 1; rank 1's emergency save hangs and is SIGKILLed mid-save
+    ckpt_root = tmp_path / "ckpt"
+    progress = [tmp_path / "p0", tmp_path / "p1"]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, runner, str(ckpt_root), str(total),
+             str(progress[0]), "0.12"],
+            env=rank_env(0),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True),
+        subprocess.Popen(
+            [sys.executable, runner, str(ckpt_root), str(total),
+             str(progress[1]), "0.12"],
+            env=rank_env(1, GANG_EMERGENCY_HANG="1"),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True),
+    ]
+    from paddle_tpu.distributed.env import GangRendezvous
+    gang = GangRendezvous(str(gang_dir), rank=0, world_size=2)
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        done = [len(p.read_text().splitlines()) if p.exists() else 0
+                for p in progress]
+        if min(done) >= 8 and gang.committed_step() is not None:
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    assert all(p.poll() is None for p in procs), \
+        "a rank finished before it could be preempted:\n" + \
+        "\n".join((p.communicate()[0] or "") for p in procs)
+    for p in procs:
+        p.send_signal(signal.SIGTERM)
+    # rank 1 is now hanging inside its emergency checkpoint write —
+    # SIGKILL it mid-save (the torn-save scenario)
+    time.sleep(1.5)
+    procs[1].kill()
+    out0 = procs[0].communicate(timeout=180)[0]
+    out1 = procs[1].communicate(timeout=60)[0]
+    assert procs[0].returncode == 0, out0    # leader drained + exited 0
+    assert procs[1].returncode == -signal.SIGKILL
+    part0 = losses(out0)
+    k0 = len(part0)
+    assert 0 < k0 < total
+
+    # 3. the manifest must NOT name rank 0's emergency step (rank 1
+    # never confirmed it): it stays at a step both ranks committed
+    committed = gang.committed_step()
+    assert committed is not None and committed % 4 == 0
+    assert committed < k0
+
+    # 4. resume: each rank must land exactly on the manifest step as it
+    # stood when that rank restarted (a resumed leader's own daemon may
+    # legitimately advance the manifest to another gang-common step),
+    # refusing rank 0's newer (torn) emergency checkpoint
+    import re
+    resumed, resumed_at = [], []
+    for rank in range(2):
+        expect = gang.committed_step()
+        assert expect is not None and expect % 4 == 0
+        r = subprocess.run(
+            [sys.executable, runner, str(ckpt_root), str(total),
+             str(tmp_path / f"pr{rank}")],
+            env=rank_env(rank), capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        at = int(re.search(r"RESUMED_AT (\d+)", r.stdout).group(1))
+        assert at == expect, \
+            f"rank {rank} resumed at {at}, manifest said {expect}"
+        resumed.append(r.stdout)
+        resumed_at.append(at)
+    # rank 0 held a NEWER rank-local checkpoint (its ≢0 mod 4 emergency
+    # save) — the resume must have explicitly refused it
+    assert resumed_at[0] == committed
+    assert int(re.search(r"TORN_REJECTS (\d+)",
+                         resumed[0]).group(1)) == 1
+
+    # 5. loss-trajectory parity: chaos prefix + resumed suffix == the
+    # uninterrupted run, step for step, bit for bit
+    combined = dict(part0)
+    combined.update(losses(resumed[0]))
+    assert sorted(combined) == list(range(total))
+    np.testing.assert_array_equal(
+        np.array([combined[i] for i in range(total)], np.float32),
+        np.array([base[i] for i in range(total)], np.float32))
+    # and rank 1's resumed suffix matches too (same data/seed)
+    np.testing.assert_array_equal(
+        np.array([losses(resumed[1])[i]
+                  for i in range(resumed_at[1], total)], np.float32),
+        np.array([base[i] for i in range(resumed_at[1], total)],
+                 np.float32))
 
 
 def test_preemption_sigterm_kill_then_resume_matches_uninterrupted(
