@@ -1,0 +1,25 @@
+"""Static program analysis (no direct reference counterpart — the
+reference validates ProgramDesc graphs ad hoc at kernel launch; here the
+whole class of launch-time defects is caught at ``compiler.optimize``
+time, before anything is lowered).
+
+- :mod:`paddle_tpu.analysis.verifier` — the program verifier: def-before-
+  use, dangling feed/fetch targets, shape/dtype re-inference consistency,
+  dead-op liveness, use-after-donate hazards on rw persistables, static
+  int64 feed-wrap classification, and the per-rank collective-ordering
+  fingerprint.  Runs on the ``framework.ir`` Graph, behind
+  ``FLAGS_program_verify`` (default on), cached on the source-program
+  fingerprint so steady-state dispatch never re-verifies.
+"""
+
+from .verifier import (  # noqa: F401
+    CHECKS, Diagnostic, ProgramVerificationError, VerifyResult,
+    clear_cache, collective_fingerprint, dynamic_int64_feeds,
+    verify_or_raise, verify_program,
+)
+
+__all__ = [
+    "CHECKS", "Diagnostic", "ProgramVerificationError", "VerifyResult",
+    "clear_cache", "collective_fingerprint", "dynamic_int64_feeds",
+    "verify_or_raise", "verify_program",
+]

@@ -1,0 +1,625 @@
+"""Program verifier: static checks over the ``framework.ir`` Graph.
+
+The reference validates ProgramDesc graphs ad hoc at kernel launch
+(``framework/operator.cc`` enforce macros firing mid-run); this verifier
+moves that whole defect class to ``compiler.optimize`` time, where a bad
+program costs one diagnostic instead of a dispatch-time crash — or, for
+the cross-rank ordering defects, a silent multi-process hang.
+
+Checks (one ``verifier.*`` counter series per check in the telemetry
+registry; see README "Static analysis" for the table):
+
+==================  =========  ==============================================
+check               severity   flags
+==================  =========  ==============================================
+def_before_use      error      op input var not declared anywhere in the
+                               block (would KeyError mid-trace)
+uninitialized_read  warning    declared non-persistable, non-data var read
+                               before any op writes it (must be fed or
+                               pre-seeded in the scope at run time)
+dangling_fetch      error      fetch target never produced: not a block
+                               var, or declared but neither written nor
+                               persistable
+dangling_feed       warning    declared data var consumed by no op in any
+                               block (its fed value is dropped)
+shape_consistency   warning    a var's recorded shape/dtype disagrees with
+                               re-running build-time inference over the
+                               block (a mutation bypassed ``append_op``)
+dead_op             warning    op unreachable from the fetch + persistable
+                               + side-effect liveness roots (the
+                               ``dead_op_eliminate`` pass removes these)
+use_after_donate    warning    fetch target is a read-write persistable:
+                               the executor donates rw buffers to the next
+                               step and must defensively copy the fetch out
+                               of the donated buffer every step
+int64_feed          (none)     classification, not a diagnostic: its
+                               counter tracks feeds that KEPT the runtime
+                               wrap check (verifier-dynamic)
+collective_order    error/     collective ops not totally ordered by data
+                    warning    dependencies: error when an unordered pair
+                               has the SAME signature (cross-rank pairing
+                               is ambiguous — the documented ``.numpy()``
+                               ordering deadlock class), warning otherwise
+==================  =========  ==============================================
+
+``verify_program`` is cached on the source-program fingerprint
+(``Program.fingerprint()`` — the PR-4 dispatch-plan key), so a program is
+verified once per mutation and steady-state dispatch never re-enters the
+verifier.  Results additionally stamp ``program._attrs["verify"]`` (which
+rides ``Program.clone``) with the machine-readable artifacts other layers
+consume: the int64 feed classification (the executor keeps its runtime
+feed-wrap check only for feeds marked dynamic) and the collective
+fingerprint (ranks can compare it out of band before entering a gang).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from .. import monitor as _monitor
+from ..framework.core import Block, Program
+
+__all__ = [
+    "CHECKS", "Diagnostic", "ProgramVerificationError", "VerifyResult",
+    "clear_cache", "collective_fingerprint", "dynamic_int64_feeds",
+    "verify_or_raise", "verify_program",
+]
+
+#: every check name, in report order (one counter series per entry)
+CHECKS = (
+    "def_before_use", "uninitialized_read", "dangling_fetch",
+    "dangling_feed", "shape_consistency", "dead_op", "use_after_donate",
+    "int64_feed", "collective_order",
+)
+
+_FINDINGS = _monitor.REGISTRY.counter(
+    "paddle_tpu_verifier_findings_total",
+    "program-verifier findings by check", ("check",))
+#: bound once per check: a verify pass bumps these, never resolves labels
+_FINDING_CELLS = {c: _FINDINGS.labels(check=c) for c in CHECKS}
+_RUNS = _monitor.REGISTRY.counter(
+    "paddle_tpu_verifier_runs_total",
+    "verify_program calls by fingerprint-cache outcome", ("cache",))
+_RUNS_HIT = _RUNS.labels(cache="hit")
+_RUNS_MISS = _RUNS.labels(cache="miss")
+
+#: int64 feeds whose every consumer bounds VALID values below this are
+#: static-safe: with the bound under 2**31, every valid index fits int32,
+#: so the int64->int32 feed conversion can only alter values that were
+#: already out of range — and those the consumer already mishandles
+#: identically with or without the wrap (XLA gather clamps out-of-bounds
+#: ids silently; the runtime wrap check never diagnosed table-bounds
+#: violations inside the int32 range either).  The wrap check therefore
+#: adds no protection for these feeds that the bound itself doesn't.
+_INT32_BOUND = 2 ** 31
+
+#: collective ops whose cross-rank launch order must match on every rank
+#: (init/sync shims are host no-ops and carry no ordering constraint)
+_COLLECTIVE_OPS = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_broadcast", "c_allgather", "c_reducescatter",
+    "c_split",
+})
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by :func:`verify_or_raise` when any error-severity
+    diagnostic is present.  ``.result`` carries the full
+    :class:`VerifyResult`."""
+
+    def __init__(self, msg: str, result: "VerifyResult"):
+        super().__init__(msg)
+        self.result = result
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding: which check, how bad, where, and what to do
+    about it (ref platform/enforce.h — the reference enriches launch-time
+    errors with op context; here the context is attached pre-launch)."""
+
+    check: str                 # one of CHECKS
+    severity: str              # "error" | "warning"
+    message: str
+    op_type: Optional[str] = None
+    op_index: Optional[int] = None   # block-0 program-order index
+    var: Optional[str] = None
+    fix_hint: Optional[str] = None
+
+
+@dataclass
+class VerifyResult:
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: int64/uint64 data feeds that still need the runtime wrap check
+    int64_dynamic: FrozenSet[str] = frozenset()
+    #: int64/uint64 data feeds proven bounded by every consumer
+    int64_static: FrozenSet[str] = frozenset()
+    #: sha1 over the dependency-ordered collective sequence + fetch list
+    #: (None when the program launches no collectives)
+    collective_fingerprint: Optional[str] = None
+    dead_ops: Tuple[int, ...] = ()   # block-0 indices of dead ops
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def by_check(self, check: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.check == check]
+
+
+# ---------------------------------------------------------------------------
+# fingerprint cache
+# ---------------------------------------------------------------------------
+
+#: (program fingerprint, fetch TUPLE) -> VerifyResult.  The fetch list is
+#: keyed in ORDER, not as a set: the collective fingerprint hashes the
+#: materialization order, so a reordered fetch list is a different verify.
+#: Bounded FIFO: every program MUTATION mints a new fingerprint, so an
+#: unbounded dict would grow per version in a build-mutate-verify loop.
+#: Guarded: concurrent first compiles of different programs verify in
+#: parallel, and an unguarded evict could pop a key another thread just
+#: took from next(iter(...)).
+_CACHE: Dict[tuple, VerifyResult] = {}  # guarded-by: _CACHE_LOCK
+_CACHE_CAP = 256
+_CACHE_LOCK = threading.Lock()
+
+
+def clear_cache() -> None:
+    with _CACHE_LOCK:
+        _CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# individual checks (each takes the block-0 graph + context, appends diags)
+# ---------------------------------------------------------------------------
+
+def _is_data(v) -> bool:
+    return bool(getattr(v, "is_data", False))
+
+
+def _check_def_before_use(program: Program, diags: List[Diagnostic]):
+    """Program-order def-before-use over block 0.  Feed/fetch shim ops
+    participate as writers only (the executor skips them at trace time)."""
+    block = program.global_block()
+    written = set()
+    for idx, op in enumerate(block.ops):
+        if op.type not in ("feed", "fetch"):
+            for slot, names in op.inputs.items():
+                # OG$ (output-grad) slots may legally be absent: an
+                # output unused downstream has no grad, and the lowering
+                # reads them with .get() and treats None as zero
+                if slot.startswith("OG$"):
+                    continue
+                for name in names:
+                    if not name or name in written:
+                        continue
+                    if not block.has_var(name):
+                        diags.append(Diagnostic(
+                            "def_before_use", "error",
+                            f"op input var {name!r} is not declared in "
+                            "the block and no preceding op produces it",
+                            op_type=op.type, op_index=idx, var=name,
+                            fix_hint="declare the var (block.create_var "
+                                     "/ layers.data) or fix the producing"
+                                     " op's output name"))
+                        continue
+                    v = block.var(name)
+                    if v.persistable or _is_data(v) or \
+                            v.initializer is not None:
+                        continue
+                    diags.append(Diagnostic(
+                        "uninitialized_read", "warning",
+                        f"var {name!r} is read before any op writes it "
+                        "and is neither persistable nor a declared data "
+                        "var — it must be fed (or pre-seeded in the "
+                        "scope) at every run",
+                        op_type=op.type, op_index=idx, var=name,
+                        fix_hint="declare it via layers.data if it is "
+                                 "fed, or mark it persistable if it "
+                                 "lives in the scope"))
+        for name in op.output_arg_names():
+            if name:
+                written.add(name)
+
+
+def _check_feed_fetch(program: Program, fetch_names, diags):
+    block = program.global_block()
+    written = {n for op in block.ops
+               for n in op.output_arg_names() if n}
+    for name in fetch_names:
+        if name in written:
+            continue
+        if not block.has_var(name):
+            diags.append(Diagnostic(
+                "dangling_fetch", "error",
+                f"fetch target {name!r} is not a var of the program",
+                var=name,
+                fix_hint="fetch an existing var (typo?) or rebuild the "
+                         "program that defines it"))
+        elif not block.var(name).persistable and \
+                not _is_data(block.var(name)):
+            # data vars are legal passthrough fetches: the lowered step
+            # materializes fetches from the value environment, which
+            # includes the feeds (dangling_feed below blesses exactly
+            # this echo/debug pattern)
+            diags.append(Diagnostic(
+                "dangling_fetch", "error",
+                f"fetch target {name!r} is declared but no op produces it "
+                "and it is not persistable — materialization would fail "
+                "at dispatch",
+                var=name,
+                fix_hint="fetch the op output you meant, or mark the var "
+                         "persistable if its value lives in the scope"))
+    consumed = {n for b in program.blocks for op in b.ops
+                for n in op.input_arg_names() if n}
+    for name, v in block.vars.items():
+        if _is_data(v) and name not in consumed and name not in fetch_names:
+            diags.append(Diagnostic(
+                "dangling_feed", "warning",
+                f"data var {name!r} is consumed by no op in any block — "
+                "its fed value is dropped every step",
+                var=name,
+                fix_hint="remove the layers.data declaration (and the "
+                         "feed) or wire it into the model"))
+
+
+def _check_shape_consistency(program: Program, diags):
+    """Re-run build-time inference over a clone of block 0 and diff the
+    recorded Variable shape/dtype metadata.  Catches mutations that
+    bypassed ``append_op`` (whose inline InferShape keeps metadata live —
+    the invariant ``tests/test_shape_inference.py`` pins).  Only concrete
+    dims are compared: -1/None stay symbolic on both sides."""
+    from ..framework import registry
+    try:
+        clone = program.clone()
+    except Exception:
+        return
+    src = program.global_block()
+    blk = clone.global_block()
+    for idx, op in enumerate(blk.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        try:
+            registry.infer_op(op, blk)
+        except Exception:
+            continue             # not re-inferable out of build context
+        for name in op.output_arg_names():
+            if not name or name not in blk.vars or name not in src.vars:
+                continue
+            iv, sv = blk.vars[name], src.vars[name]
+            ishape, sshape = iv.shape, sv.shape
+            if ishape is not None and sshape is not None:
+                if len(ishape) != len(sshape) or any(
+                        a != b for a, b in zip(ishape, sshape)
+                        if a not in (-1, None) and b not in (-1, None)):
+                    diags.append(Diagnostic(
+                        "shape_consistency", "warning",
+                        f"var {name!r} records shape {list(sshape)} but "
+                        f"inference over op {op.type!r} derives "
+                        f"{list(ishape)}",
+                        op_type=op.type, op_index=idx, var=name,
+                        fix_hint="the shape was mutated after build; "
+                                 "rebuild the op (append_op re-infers) "
+                                 "instead of patching Variable.shape"))
+            if iv.dtype and sv.dtype and iv.dtype != sv.dtype:
+                diags.append(Diagnostic(
+                    "shape_consistency", "warning",
+                    f"var {name!r} records dtype {sv.dtype!r} but "
+                    f"inference over op {op.type!r} derives {iv.dtype!r}",
+                    op_type=op.type, op_index=idx, var=name,
+                    fix_hint="rebuild the op instead of patching "
+                             "Variable.dtype"))
+
+
+def _check_dead_ops(graph, fetch_names, diags):
+    from ..framework import ir
+    dead = ir.dead_op_analysis(graph, protected=frozenset(fetch_names))
+    dead_ids = {n.id for n in dead}
+    indices = tuple(i for i, n in enumerate(graph.op_nodes)
+                    if n.id in dead_ids)
+    for i in indices:
+        op = graph.op_nodes[i]
+        # auto-generated backward leftovers (grads of non-parameter
+        # inputs append_backward materializes and nothing consumes) are
+        # framework-made, not a user defect: the dead_op_eliminate pass
+        # still removes them, but only user-authored dead FORWARD compute
+        # earns a diagnostic
+        if op.name.endswith("_grad") or \
+                op.op.attrs.get("op_role") == "backward":
+            continue
+        diags.append(Diagnostic(
+            "dead_op", "warning",
+            f"op {op.name!r} reaches no fetch target, persistable write, "
+            "or side-effecting op — its outputs are computed and dropped",
+            op_type=op.name, op_index=i,
+            fix_hint="fetch its output if you need it; the "
+                     "dead_op_eliminate pass removes it otherwise"))
+    return indices
+
+
+def _rw_persistables(program: Program) -> set:
+    block = program.global_block()
+    written = set()
+    for b in program.blocks:
+        for op in b.ops:
+            written.update(n for n in op.output_arg_names() if n)
+    return {n for n in written
+            if block.has_var(n) and block.var(n).persistable}
+
+
+def _check_use_after_donate(program: Program, fetch_names, diags):
+    rw = _rw_persistables(program)
+    for name in fetch_names:
+        if name in rw:
+            diags.append(Diagnostic(
+                "use_after_donate", "warning",
+                f"fetch target {name!r} is a read-write persistable: the "
+                "executor donates rw buffers to the next step, so every "
+                "step must defensively copy this fetch out of the donated "
+                "buffer",
+                var=name,
+                fix_hint="fetch a non-persistable snapshot (e.g. "
+                         "layers.assign the value) or read it from the "
+                         "scope at a step boundary instead"))
+
+
+def _classify_int64_feeds(program: Program):
+    """Static feed-wrap classification: an int64/uint64 data feed whose
+    EVERY consumer bounds its VALID values below 2**31 (embedding row
+    count, one_hot depth) is ``static``: every in-range id fits int32, so
+    the feed conversion only alters ids that were already invalid — and
+    the consumer treats those identically with or without the wrap (see
+    the _INT32_BOUND note; XLA gather clamps silently either way).
+    Everything else stays ``dynamic`` and keeps the executor's
+    first-batch runtime min/max check."""
+    block = program.global_block()
+    feeds = [v for v in block.vars.values()
+             if _is_data(v) and v.dtype in ("int64", "uint64")]
+    if not feeds:
+        return frozenset(), frozenset()
+
+    def _dim0(name):
+        if not block.has_var(name):
+            return None
+        shape = block.var(name).shape
+        return shape[0] if shape else None
+
+    def consumer_safe(op, name) -> bool:
+        typ = op.type
+        if typ.endswith("_grad"):
+            # a grad op replays the forward's reads of the SAME fed
+            # values (make_grad_ops forwards them under "X$<slot>"), so
+            # it is exactly as safe as its forward op
+            typ = typ[: -len("_grad")]
+
+            def slot(s, _op=op):
+                return _op.input("X$" + s) or _op.input(s)
+        else:
+            def slot(s, _op=op):
+                return _op.input(s)
+        if typ in ("lookup_table", "lookup_table_v2") and \
+                name in slot("Ids"):
+            w = slot("W")
+            rows = _dim0(w[0]) if w else None
+            return rows is not None and 0 < rows < _INT32_BOUND
+        if typ == "one_hot" and name in slot("X"):
+            depth = op.attrs.get("depth")
+            return bool(depth) and int(depth) < _INT32_BOUND
+        return False
+
+    consumers: Dict[str, list] = {v.name: [] for v in feeds}
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            for name in op.input_arg_names():
+                if name in consumers:
+                    consumers[name].append(op)
+    static, dynamic = set(), set()
+    for v in feeds:
+        ops = consumers[v.name]
+        if ops and all(consumer_safe(op, v.name) for op in ops):
+            static.add(v.name)
+        else:
+            dynamic.add(v.name)
+    return frozenset(static), frozenset(dynamic)
+
+
+def _collective_signature(op_node, block: Block):
+    op = op_node.op
+    x = op.input("X")
+    shape = dtype = None
+    if x and block.has_var(x[0]):
+        v = block.var(x[0])
+        shape, dtype = v.shape, v.dtype
+    return (op.type, op.attrs.get("ring_id", 0), dtype,
+            tuple(shape) if shape else None)
+
+
+def _check_collective_order(program: Program, graph, fetch_names, diags):
+    """Dependency-order the block's collective ops.  Pairs with no path
+    between them can launch in different orders on different ranks (the
+    compiler is free to schedule independent collectives for latency);
+    when the unordered pair has the SAME signature the cross-rank pairing
+    itself is ambiguous — the static form of the documented cross-rank
+    ``.numpy()`` materialization deadlock.  Returns the fingerprint of
+    the dependency-ordered sequence (ties broken by program order), which
+    every rank of a gang can compare out of band."""
+    block = program.global_block()
+    nodes = [n for n in graph.op_nodes if n.name in _COLLECTIVE_OPS]
+    if not nodes and not program._attrs.get("collective"):
+        return None
+    # forward-reachable op-id sets, by BFS from each collective node
+    reach: Dict[int, set] = {}
+    for n in nodes:
+        seen = set()
+        stack = [n]
+        while stack:
+            cur = stack.pop()
+            for v in cur.outputs:
+                for consumer in v.outputs:
+                    if consumer.id not in seen:
+                        seen.add(consumer.id)
+                        stack.append(consumer)
+        reach[n.id] = seen
+    unordered, ambiguous = [], []
+    for i in range(len(nodes)):
+        for j in range(i + 1, len(nodes)):
+            a, b = nodes[i], nodes[j]
+            if b.id in reach[a.id] or a.id in reach[b.id]:
+                continue
+            sig_a = _collective_signature(a, block)
+            sig_b = _collective_signature(b, block)
+            (ambiguous if sig_a == sig_b else unordered).append(
+                (a.name, b.name, sig_a))
+    if ambiguous:
+        a, b, sig = ambiguous[0]
+        diags.append(Diagnostic(
+            "collective_order", "error",
+            f"{len(ambiguous)} pair(s) of collective ops share a "
+            f"signature {sig!r} but have no dependency path between them "
+            f"(first pair: {a!r}/{b!r}) — ranks can launch them in "
+            "different orders and mispair, deadlocking the gang",
+            op_type=a,
+            fix_hint="chain them (feed one's output into the other's "
+                     "input chain) or give each a distinct ring_id"))
+    elif unordered:
+        diags.append(Diagnostic(
+            "collective_order", "warning",
+            f"{len(unordered)} pair(s) of collective ops have no "
+            "dependency path between them; their launch order is "
+            "compiler-chosen — verify the collective fingerprint matches "
+            "across ranks before entering the gang",
+            op_type=unordered[0][0],
+            fix_hint="compare program._attrs['verify']"
+                     "['collective_fingerprint'] across ranks"))
+    # fingerprint: collectives in dependency order (stable program-order
+    # tie-break — graph.topology_sort is deterministic for a fixed
+    # program), then the fetch list (each cross-rank fetch materializes
+    # as a collective allgather, in fetch order)
+    order = {n.id: i for i, n in enumerate(graph.topology_sort())}
+    seq = sorted(nodes, key=lambda n: (order.get(n.id, 0), n.id))
+    h = hashlib.sha1()
+    for n in seq:
+        h.update(repr(_collective_signature(n, block)).encode())
+    h.update(repr(tuple(fetch_names)).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _verify_cached(program: Program, fetch_names) -> \
+        Tuple[VerifyResult, bool]:
+    """(result, fresh): ``fresh`` is True for exactly ONE caller per
+    cache key — the thread whose result entered the cache — so warning
+    emission can be deduped without re-deriving the key outside."""
+    fetch_names = tuple(
+        f.name if hasattr(f, "name") else f for f in (fetch_names or ()))
+    # keyed on the fetch TUPLE: order matters — the collective
+    # fingerprint hashes the materialization (fetch) order, so a
+    # reordered fetch list must re-verify, not hit a stale result
+    key = (program.fingerprint(), fetch_names)
+    with _CACHE_LOCK:
+        cached = _CACHE.get(key)
+    if cached is not None:
+        _RUNS_HIT.inc()
+        return cached, False
+    _RUNS_MISS.inc()
+    with _monitor.TRACER.span("verifier.verify", "compile",
+                              fetches=len(fetch_names)):
+        from ..framework import ir
+        result = VerifyResult()
+        diags = result.diagnostics
+        # one read-only Graph shared by the graph-walking checks
+        graph = ir.Graph(program)
+        _check_def_before_use(program, diags)
+        _check_feed_fetch(program, fetch_names, diags)
+        try:
+            _check_shape_consistency(program, diags)
+        except Exception:            # re-inference must never block verify
+            pass
+        result.dead_ops = _check_dead_ops(graph, fetch_names, diags)
+        _check_use_after_donate(program, fetch_names, diags)
+        result.int64_static, result.int64_dynamic = \
+            _classify_int64_feeds(program)
+        result.collective_fingerprint = _check_collective_order(
+            program, graph, fetch_names, diags)
+    for d in diags:
+        _FINDING_CELLS[d.check].inc()
+    # int64_feed "findings" are classifications, not diagnostics: the
+    # counter tracks how many feeds KEPT the runtime wrap check
+    if result.int64_dynamic:
+        _FINDING_CELLS["int64_feed"].inc(len(result.int64_dynamic))
+    program._attrs["verify"] = {
+        "int64_dynamic": sorted(result.int64_dynamic),
+        "int64_static": sorted(result.int64_static),
+        "collective_fingerprint": result.collective_fingerprint,
+    }
+    with _CACHE_LOCK:
+        fresh = key not in _CACHE
+        if fresh:
+            if len(_CACHE) >= _CACHE_CAP:   # FIFO bound, see _CACHE note
+                _CACHE.pop(next(iter(_CACHE)))
+            _CACHE[key] = result
+        result = _CACHE[key]   # concurrent misses converge on one object
+    return result, fresh
+
+
+def verify_program(program: Program, fetch_names=()) -> VerifyResult:
+    """Run every check; cached on (program fingerprint, fetch tuple).
+
+    Also stamps ``program._attrs["verify"]`` with the machine-readable
+    artifacts (int64 classification, collective fingerprint) — the attrs
+    ride ``Program.clone``, so the optimized program the executor caches
+    in its dispatch plan carries them too."""
+    return _verify_cached(program, fetch_names)[0]
+
+
+def verify_or_raise(program: Program, fetch_names=()) -> VerifyResult:
+    """``verify_program`` + enforcement: error-severity findings raise
+    :class:`ProgramVerificationError` (with the full debugger-formatted
+    report), warning-severity findings emit one ``warnings.warn`` per
+    fresh verify (the fingerprint cache dedupes steady-state repeats,
+    and ``_verify_cached`` marks exactly one caller fresh per key)."""
+    result, fresh = _verify_cached(program, fetch_names)
+    from .. import debugger
+    if not result.ok:
+        raise ProgramVerificationError(
+            "program verification failed:\n"
+            + debugger.format_diagnostics(result.diagnostics), result)
+    if fresh and result.warnings():
+        import warnings
+        warnings.warn(
+            "program verifier warnings:\n"
+            + debugger.format_diagnostics(result.warnings()),
+            stacklevel=2)
+    return result
+
+
+def dynamic_int64_feeds(program: Program) -> Optional[FrozenSet[str]]:
+    """The int64/uint64 feed names still needing the runtime wrap check,
+    or None when the program was never verified (caller falls back to
+    checking every int64 feed — the legacy behavior)."""
+    va = program._attrs.get("verify")
+    if va is None or va.get("int64_dynamic") is None:
+        return None
+    return frozenset(va["int64_dynamic"])
+
+
+def collective_fingerprint(program: Program) -> Optional[str]:
+    va = program._attrs.get("verify")
+    if va is not None and va.get("collective_fingerprint"):
+        return va["collective_fingerprint"]
+    result = verify_program(program)
+    return result.collective_fingerprint

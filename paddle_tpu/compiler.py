@@ -139,15 +139,39 @@ class CompiledProgram:
                 _resil.maybe_inject("compile")
                 with _monitor.TRACER.span("compiler.optimize", "compile",
                                           fetches=len(fetch_names)):
+                    from .flags import get_flags
                     prog = self._program
+                    if get_flags("FLAGS_program_verify")[
+                            "FLAGS_program_verify"]:
+                        # static analysis BEFORE any pass touches the
+                        # graph: defects report against the program the
+                        # user built, errors raise here instead of
+                        # surfacing mid-trace (or as a cross-rank hang).
+                        # ProgramVerificationError is deterministic, so
+                        # the transient-only retry policy never re-runs
+                        # it.  Also stamps prog._attrs["verify"] (int64
+                        # feed classification, collective fingerprint),
+                        # which clone() carries onto the optimized
+                        # program below.
+                        from .analysis import verifier as _verifier
+                        _verifier.verify_or_raise(prog, fetch_names)
+                    from .framework import ir
+                    g = ir.Graph(prog)
+                    changed = False
+                    # dead-op elimination before lowering: never trace a
+                    # subgraph nothing observes (fetches are protected)
+                    g = ir.get_pass(
+                        "dead_op_eliminate",
+                        protected=frozenset(fetch_names)).apply(g)
+                    changed |= bool(g.attrs.get("dead_op_eliminate_count"))
                     if self._build_strategy.fuse_elewise_add_act_ops:
-                        from .framework import ir
-                        g = ir.Graph(prog)
                         g = ir.get_pass(
                             "fuse_elewise_add_act_pass",
                             protected=frozenset(fetch_names)).apply(g)
-                        if g.attrs.get("fuse_elewise_add_act_count"):
-                            prog = g.to_program()
+                        changed |= bool(
+                            g.attrs.get("fuse_elewise_add_act_count"))
+                    if changed:
+                        prog = g.to_program()
                     return prog
 
             prog = _resil.retry_call("compile", _build,

@@ -80,7 +80,10 @@ def _drop_stage_tokens(src):
     """Retire a finished pipeline's int64-check dedup tokens: each
     iteration mints a fresh serial, so a long-running process re-iterating
     a loader per epoch would otherwise grow the module-global token set
-    forever (Executor.close() only retires program-id tokens)."""
+    forever.  This is the ONLY retirement path — program-id tokens are
+    process-lifetime (Executor.close() no longer re-arms them; the
+    verifier's static classification subsumes the check for verified
+    programs)."""
     from ..framework.executor import (_checked_int64_feeds,
                                       _checked_int64_lock)
     with _checked_int64_lock:

@@ -7,7 +7,28 @@ from .framework import ir
 from .framework.core import Program
 
 __all__ = ["pprint_program_codes", "pprint_block_codes",
-           "draw_block_graphviz"]
+           "draw_block_graphviz", "format_diagnostics"]
+
+
+def format_diagnostics(diagnostics) -> str:
+    """Render verifier :class:`~paddle_tpu.analysis.Diagnostic` records as
+    a readable report: one ``[severity] check`` line with op/var context,
+    plus an indented fix hint (the same enforce-style context the
+    executor attaches to trace-time failures, but pre-launch)."""
+    lines = []
+    for d in diagnostics:
+        loc = []
+        if d.op_type is not None:
+            loc.append(f"op {d.op_type!r}"
+                       + (f" (#{d.op_index})" if d.op_index is not None
+                          else ""))
+        if d.var is not None:
+            loc.append(f"var {d.var!r}")
+        where = f" @ {', '.join(loc)}" if loc else ""
+        lines.append(f"[{d.severity}] {d.check}{where}: {d.message}")
+        if d.fix_hint:
+            lines.append(f"    fix: {d.fix_hint}")
+    return "\n".join(lines)
 
 
 def pprint_block_codes(block, show_backward: bool = False) -> str:

@@ -102,6 +102,14 @@ _DEFAULTS: Dict[str, Any] = {
     # rank to announce the same emergency-checkpoint step before giving
     # up on publishing the COMMITTED manifest for it
     "FLAGS_gang_commit_timeout_s": 30.0,
+    # program verifier (paddle_tpu.analysis.verifier): static checks
+    # (def-before-use, dangling feed/fetch, shape consistency, dead ops,
+    # use-after-donate, int64 feed-wrap classification, collective
+    # ordering) run inside compiler.optimize before lowering.  Results
+    # are cached on the source-program fingerprint, so steady-state
+    # dispatch never re-verifies; error-severity findings raise
+    # ProgramVerificationError at optimize time.
+    "FLAGS_program_verify": True,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without

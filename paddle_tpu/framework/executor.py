@@ -852,8 +852,8 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place
-        self._cache: Dict[Any, _CompiledBlock] = {}
-        self._plans: Dict[Any, _DispatchPlan] = {}
+        self._cache: Dict[Any, _CompiledBlock] = {}  # guarded-by: _lock
+        self._plans: Dict[Any, _DispatchPlan] = {}  # guarded-by: _lock
         # RLock, not Lock: the scope-eviction weakref.finalize callback
         # takes this lock, and cyclic GC (Scope's parent<->kids cycle
         # makes the gc module the collector) can fire it at an allocation
@@ -866,13 +866,13 @@ class Executor:
         # N dispatched steps; run() blocks on the oldest once more than
         # FLAGS_executor_max_inflight_steps are in flight, so lazy-fetch
         # loops cannot run arbitrarily ahead of HBM
-        self._inflight: collections.deque = collections.deque()
-        self._run_prog_ids: set = set()
+        self._inflight: collections.deque = \
+            collections.deque()  # guarded-by: _lock
         self._evict_reg: set = set()
         # step-boundary hooks: called after every completed dispatch,
         # once the scope holds the step's (possibly in-flight) outputs —
         # the checkpoint daemon's capture point (resilience.py)
-        self._step_hooks: List[Any] = []
+        self._step_hooks: List[Any] = []  # guarded-by: _lock
         _EXECUTORS.add(self)
         # registry hygiene: when this executor dies, its 13 label series
         # fold into executor="retired" (the callback must not hold a ref
@@ -884,15 +884,12 @@ class Executor:
             self._cache.clear()
             self._plans.clear()
             self._inflight.clear()
-        # feed-range warnings re-arm for the programs THIS executor ran: a
-        # new executor run of the same feed names must get its own
-        # first-batch int64 check — but another live executor's dedup
-        # tokens (different programs) must survive our close
-        with _checked_int64_lock:
-            _checked_int64_feeds.difference_update(
-                [t for t in _checked_int64_feeds
-                 if t[0] in self._run_prog_ids])
-        self._run_prog_ids.clear()
+        # int64 feed-wrap dedup tokens are NOT re-armed here: the verifier
+        # classifies feeds statically (program._attrs["verify"]), so
+        # verified programs skip the runtime check wholesale and the
+        # legacy spot-check for unverified programs is once per
+        # (program, feed) per process — the value range is a property of
+        # the data source, not of which executor ran it
         # _evict_reg is NOT cleared: its finalizers live until their scope
         # dies, so clearing would stack a duplicate finalize on a
         # long-lived scope every close()/run() cycle — dead scopes already
@@ -1094,9 +1091,22 @@ class Executor:
                     t0):
         stats = self._stats
         prog_id = program.fingerprint()[0]
-        self._run_prog_ids.add(prog_id)
         ts0 = time.perf_counter()
-        feeds = [_to_device(feed[n], n, prog_id) for n in cb.feed_names]
+        # verifier-classified programs carry the feeds PROVEN bounded
+        # (skip the runtime wrap check for exactly those); every other
+        # feed keeps the legacy actual-dtype check — including feeds
+        # declared int32/float but fed an int64 array, which the
+        # declared-dtype classification cannot see.  None = never
+        # verified.  Resolved once per compiled block.
+        skip = getattr(cb, "int64_static", _UNSET)
+        if skip is _UNSET:
+            va = program._attrs.get("verify")
+            skip = cb.int64_static = (
+                frozenset(va["int64_static"])
+                if va is not None and va.get("int64_static") is not None
+                else None)
+        feeds = [_to_device(feed[n], n, prog_id, skip)
+                 for n in cb.feed_names]
         if _monitor.TRACER.enabled and feeds:
             _monitor.TRACER.add_complete(
                 "executor.stage_feeds", "dataloader", ts0,
@@ -1544,14 +1554,20 @@ def _to_global_arrays(cb, mesh, feeds, ro_vals, rw_vals, seed_arr):
                 np.asarray(seed_arr), mesh, P()))
 
 
+#: sentinel: "cb.int64_dynamic not resolved yet" (None is a real value —
+#: it means the program was never verified)
+_UNSET = object()
+
 #: (program id, feed name) pairs already spot-checked.  Keyed per program —
 #: a bare feed name would let one program's check suppress the int64-wrap
-#: warning for a DIFFERENT program reusing the name; Executor.close()
-#: clears it so a fresh executor re-arms the checks.  Guarded by
-#: _checked_int64_lock: dataloader/reader PRODUCER threads add tokens
-#: while close()/_drop_stage_tokens iterate — an unguarded set raises
-#: 'Set changed size during iteration'.
-_checked_int64_feeds = set()
+#: warning for a DIFFERENT program reusing the name.  Verified programs
+#: bypass this path for feeds the verifier proved bounded (see
+#: analysis.verifier._classify_int64_feeds); only verifier-dynamic and
+#: never-verified feeds reach the spot-check, once per (program, feed)
+#: per process.  Guarded by _checked_int64_lock: dataloader/reader
+#: PRODUCER threads add tokens while _drop_stage_tokens iterates — an
+#: unguarded set raises 'Set changed size during iteration'.
+_checked_int64_feeds = set()  # guarded-by: _checked_int64_lock
 _checked_int64_lock = threading.Lock()
 
 
@@ -1585,7 +1601,13 @@ def _check_int64_range(x, name, prog_id=None):
                 f"set JAX_ENABLE_X64=1 for true 64-bit semantics")
 
 
-def _to_device(x, name=None, prog_id=None):
+def _to_device(x, name=None, prog_id=None, int64_static=None):
+    """``int64_static`` is the verifier's static feed classification: the
+    feeds PROVEN bounded by every consumer skip the host min/max scan
+    entirely; everything else — verifier-dynamic feeds, feeds the
+    classification never saw (e.g. declared int32 but fed an int64
+    array), and all feeds of never-verified programs (None) — keeps the
+    legacy actual-dtype spot check."""
     if isinstance(x, FetchHandle):
         # a lazy fetch fed back as an input: hand XLA the wrapped device
         # array directly — no host sync, the dependency stays on device
@@ -1593,7 +1615,8 @@ def _to_device(x, name=None, prog_id=None):
     if isinstance(x, (int, float)):
         return jnp.asarray(x)
     if isinstance(x, np.ndarray):
-        if name is not None:
+        if name is not None and (int64_static is None
+                                 or name not in int64_static):
             _check_int64_range(x, name, prog_id)
         return jnp.asarray(x)
     return x

@@ -1562,6 +1562,90 @@ class BufferSharedInplacePass(Pass):
         return graph
 
 
+#: op types executed for their effect, not their outputs: always liveness
+#: roots (ref the reference's GC whitelist in eager_deletion_pass.cc —
+#: ops a liveness sweep must never collect)
+SIDE_EFFECT_OPS = frozenset({
+    "feed", "fetch", "listen_and_serv", "send", "recv", "print", "assert",
+    "save", "load", "py_func", "gen_nccl_id",
+})
+
+
+def dead_op_analysis(graph: Graph, protected=frozenset()) -> List[Node]:
+    """Liveness from fetch + persistable + side-effect roots: the op nodes
+    whose outputs reach none of them (the verifier's ``dead_op`` check and
+    the ``dead_op_eliminate`` pass share this sweep).
+
+    Roots (deliberately conservative — a falsely-dead op silently corrupts
+    results, a falsely-live op only wastes XLA's own DCE a few ns):
+    - ops writing a ``protected`` (fetched) var or any persistable,
+    - ops writing a var any control-flow SUB-block references (the block-0
+      graph cannot see those consumers),
+    - side-effecting op types (:data:`SIDE_EFFECT_OPS`, every ``c_*``
+      collective, and any op carrying a Block-valued attr — its sub-block
+      may write persistables),
+    - ops with no outputs at all.
+    Everything reaching a root through data dependencies is live; the rest
+    is dead."""
+    from .core import Block as _Block
+    program = graph.program
+    block = program.blocks[graph.block_idx]
+    sub_refs = set()
+    for blk in program.blocks:
+        if blk.idx == graph.block_idx:
+            continue
+        for op in blk.ops:
+            sub_refs.update(op.input_arg_names())
+            sub_refs.update(op.output_arg_names())
+
+    def persistable(name):
+        return block.has_var(name) and block.var(name).persistable
+
+    def is_root(op_node: Node) -> bool:
+        op = op_node.op
+        if op.type in SIDE_EFFECT_OPS or op.type.startswith("c_"):
+            return True
+        if any(isinstance(v, _Block) for v in op.attrs.values()):
+            return True
+        outs = [n for n in op.output_arg_names() if n]
+        if not outs:
+            return True
+        return any(n in protected or n in sub_refs or persistable(n)
+                   for n in outs)
+
+    live = {n.id for n in graph.op_nodes if is_root(n)}
+    stack = [n for n in graph.op_nodes if n.id in live]
+    while stack:
+        op_node = stack.pop()
+        for v in op_node.inputs:
+            for producer in v.inputs:
+                if producer.id not in live:
+                    live.add(producer.id)
+                    stack.append(producer)
+    return [n for n in graph.op_nodes if n.id not in live]
+
+
+@register_pass("dead_op_eliminate")
+class DeadOpEliminatePass(Pass):
+    """Remove ops unreachable from the fetch/persistable/side-effect
+    liveness roots (:func:`dead_op_analysis`).  Under XLA the compiler
+    DCEs the lowered computation anyway — the win is never TRACING the
+    dead subgraph (a dead attention head still costs its full trace +
+    shape inference time) and keeping donation/liveness analyses honest.
+    ``protected`` names the fetch targets, same contract as the fusion
+    passes; removal count lands in
+    ``graph.attrs['dead_op_eliminate_count']``."""
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        dead = dead_op_analysis(graph, self.protected_vars())
+        # every consumer of a dead op's output is itself dead (liveness is
+        # a backward closure), so the output var nodes go with their ops
+        doomed_vars = [v for n in dead for v in n.outputs]
+        graph.safe_remove_nodes(list(dead) + doomed_vars)
+        graph.attrs["dead_op_eliminate_count"] = len(dead)
+        return graph
+
+
 # ---------------------------------------------------------------------------
 # Graph viz / round-trip passes
 # ---------------------------------------------------------------------------

@@ -69,7 +69,7 @@ class _Cell:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._v = 0
+        self._v = 0  # guarded-by: _mu
 
     def inc(self, n=1):
         with self._mu:
@@ -96,9 +96,9 @@ class _HistCell:
     def __init__(self, buckets: Tuple[float, ...]):
         self._mu = threading.Lock()
         self.buckets = buckets
-        self.counts = [0] * (len(buckets) + 1)   # +1: the +Inf bucket
-        self.sum = 0.0
-        self.count = 0
+        self.counts = [0] * (len(buckets) + 1)  # guarded-by: _mu  (+Inf bucket at the end)
+        self.sum = 0.0  # guarded-by: _mu
+        self.count = 0  # guarded-by: _mu
 
     def observe(self, v: float):
         with self._mu:
@@ -134,7 +134,7 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._mu = threading.Lock()
-        self._series: Dict[Tuple[str, ...], Any] = {}
+        self._series: Dict[Tuple[str, ...], Any] = {}  # guarded-by: _mu
 
     def _new_cell(self):
         return _Cell()
@@ -244,7 +244,7 @@ class MetricsRegistry:
     def __init__(self):
         self._mu = threading.Lock()
         self._metrics: "collections.OrderedDict[str, _Metric]" = \
-            collections.OrderedDict()
+            collections.OrderedDict()  # guarded-by: _mu
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._mu:
@@ -387,7 +387,7 @@ class StepTracer:
 
     def __init__(self, max_events: int = 200_000):
         self._events: collections.deque = collections.deque(
-            maxlen=max_events)
+            maxlen=max_events)  # guarded-by: _emu
         # guards the ring against export/resize racing producer-thread
         # appends (a deque append alone is GIL-atomic, but a capacity
         # swap or snapshot concurrent with appends is not)

@@ -628,7 +628,7 @@ class Watchdog:
 
     def __init__(self):
         self._cv = threading.Condition(threading.Lock())
-        self._watches: Dict[int, dict] = {}
+        self._watches: Dict[int, dict] = {}  # guarded-by: _cv
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self.timeout_s = 0.0
@@ -859,7 +859,8 @@ class CheckpointDaemon:
         self._mu = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
-        self._pending: Optional[tuple] = None   # (step, state, kind)
+        self._pending: Optional[tuple] = \
+            None  # guarded-by: _mu  ((step, state, kind))
         self._last_capture_step = 0
         self._last_capture_t = time.monotonic()
         self._last_committed: Optional[int] = None

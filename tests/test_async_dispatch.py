@@ -372,25 +372,35 @@ def test_reader_prefetch_int64_check_per_pipeline():
         assert len([x for x in w if "WRAP" in str(x.message)]) == 1
 
 
-def test_executor_close_rearms_only_own_programs():
-    """close() re-arms the int64 first-batch check for the programs THIS
-    executor ran — another executor's dedup tokens must survive."""
+def test_executor_close_leaves_int64_tokens():
+    """close() no longer re-arms the int64 first-batch check: the
+    verifier's static classification subsumes it for verified programs,
+    and the legacy spot-check for unverified programs is once per
+    (program, feed) per PROCESS — a feed's value range is a property of
+    the data source, not of which executor ran it.  Both this executor's
+    own tokens and foreign tokens must survive close()."""
     from paddle_tpu.framework import executor as ex_mod
     foreign = (-12345, "ids")
     ex_mod._checked_int64_feeds.add(foreign)
     try:
         scope = Scope()
         with scope_guard(scope), program_guard(Program(), Program()):
-            x = layers.data("x", shape=[2], dtype="float32")
-            y = layers.scale(x, scale=1.0)
+            ids = layers.data("close_ids", shape=[2], dtype="int64")
+            y = layers.mean(layers.cast(ids, "float32"))
             exe = Executor()
             exe.run(fluid.default_startup_program(), scope=scope)
-            exe.run(feed={"x": np.ones((1, 2), np.float32)},
+            exe.run(feed={"close_ids": np.ones((1, 2), np.int64)},
                     fetch_list=[y.name], scope=scope)
+            own = next(t for t in ex_mod._checked_int64_feeds
+                       if t[1] == "close_ids")
             exe.close()
-        assert foreign in ex_mod._checked_int64_feeds
+            assert foreign in ex_mod._checked_int64_feeds
+            assert own in ex_mod._checked_int64_feeds
     finally:
-        ex_mod._checked_int64_feeds.discard(foreign)
+        with ex_mod._checked_int64_lock:
+            ex_mod._checked_int64_feeds.difference_update(
+                [t for t in ex_mod._checked_int64_feeds
+                 if t == foreign or t[1] == "close_ids"])
 
 
 def test_lazy_persistable_fetch_survives_donation():

@@ -1,0 +1,282 @@
+"""Concurrency-lint self-test: known-bad snippet fixtures must trip each
+rule, near-miss snippets must stay clean, suppression must demote, and
+the shipped ``paddle_tpu/`` tree must lint clean (the CI gate)."""
+
+import importlib.util
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TOOL = REPO / "tools" / "lint_concurrency.py"
+
+
+def _lint_module():
+    spec = importlib.util.spec_from_file_location("lint_concurrency", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LC = _lint_module()
+
+
+def _lint_snippet(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return LC.lint_paths([p])
+
+
+def _rules(violations, live_only=True):
+    return sorted({v.rule for v in violations
+                   if not (live_only and v.suppressed)})
+
+
+# ---------------------------------------------------------------------------
+# guarded-field
+# ---------------------------------------------------------------------------
+
+def test_guarded_field_trips_on_unlocked_mutation(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def push(self, x):
+                self._items.append(x)
+
+            def reset(self):
+                self._items = []
+
+            def drop(self, i):
+                del self._items[i]
+    """)
+    assert _rules(vs) == ["guarded-field"]
+    assert len(vs) == 3
+    assert all("_items" in v.message and "_lock" in v.message for v in vs)
+
+
+def test_guarded_field_near_miss_locked_mutation_clean(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                return len(self._items)      # reads need no lock
+    """)
+    assert vs == []
+
+
+def test_guarded_field_module_level_crosses_files(tmp_path):
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        import threading
+        _tokens = set()  # guarded-by: _tokens_lock
+        _tokens_lock = threading.Lock()
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from a import _tokens, _tokens_lock
+
+        def good(t):
+            with _tokens_lock:
+                _tokens.add(t)
+
+        def bad(t):
+            _tokens.discard(t)
+    """))
+    vs = LC.lint_paths([tmp_path])
+    assert len(vs) == 1 and vs[0].rule == "guarded-field"
+    assert vs[0].path.endswith("b.py")
+
+
+def test_guarded_field_init_exempt(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+                self._items.append(0)        # construction: not shared yet
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# signal-handler
+# ---------------------------------------------------------------------------
+
+def test_signal_handler_trips_on_lock_and_telemetry(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import signal
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ctr = None
+
+            def _handler(self, signum, frame):
+                self._note()
+
+            def _note(self):
+                with self._lock:
+                    pass
+                self._ctr.inc()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._handler)
+    """)
+    assert _rules(vs) == ["signal-handler"]
+    msgs = " ".join(v.message for v in vs)
+    assert "acquires lock" in msgs and "telemetry" in msgs
+
+
+def test_signal_handler_near_miss_event_set_clean(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import signal
+        import threading
+
+        class G:
+            def __init__(self):
+                self._flag = threading.Event()
+
+            def _handler(self, signum, frame):
+                self._signum = signum
+                self._flag.set()             # Event.set alone is safe
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._handler)
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifetime
+# ---------------------------------------------------------------------------
+
+def test_thread_trips_without_daemon_or_join(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """)
+    assert _rules(vs) == ["thread-lifetime"]
+
+
+def test_thread_near_miss_daemon_or_joined_clean(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        def spawn_daemon(fn):
+            threading.Thread(target=fn, daemon=True).start()
+
+        def spawn_joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+        class W:
+            def start(self, fn):
+                self._t = threading.Thread(target=fn)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# finalize-lock
+# ---------------------------------------------------------------------------
+
+def test_finalize_trips_on_plain_lock(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+        import weakref
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                weakref.finalize(self, C._evict, self)
+
+            def _evict(self):
+                with self._mu:
+                    pass
+    """)
+    assert _rules(vs) == ["finalize-lock"]
+    assert "RLock" in vs[0].message
+
+
+def test_finalize_near_miss_rlock_clean(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+        import weakref
+
+        class C:
+            def __init__(self):
+                self._mu = threading.RLock()
+                weakref.finalize(self, C._evict, self)
+
+            def _evict(self):
+                with self._mu:
+                    pass
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + the shipped tree
+# ---------------------------------------------------------------------------
+
+def test_lint_ok_suppression_demotes_but_reports(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def push(self, x):
+                self._items.append(x)  # lint-ok: test-only helper
+    """)
+    assert len(vs) == 1 and vs[0].suppressed == "test-only helper"
+    assert _rules(vs) == []              # no LIVE violations
+
+
+def test_paddle_tpu_tree_lints_clean():
+    vs = LC.lint_paths([REPO / "paddle_tpu"])
+    live = [v for v in vs if not v.suppressed]
+    assert live == [], "\n".join(str(v) for v in live)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn).start()
+    """))
+    r = subprocess.run([sys.executable, str(TOOL), str(bad)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "thread-lifetime" in r.stdout
+    r = subprocess.run([sys.executable, str(TOOL), str(REPO / "paddle_tpu")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr

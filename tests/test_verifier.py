@@ -1,0 +1,512 @@
+"""Program verifier: for every check, one seeded-defect program that must
+trip it with the exact diagnostic and one near-miss that must stay clean;
+plus the compiler.optimize wiring (errors raise / warnings warn at
+optimize time, NOT at dispatch), the fingerprint cache, the telemetry
+counters, and the executor-side int64 static classification."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, monitor
+from paddle_tpu.analysis import (ProgramVerificationError, verify_or_raise,
+                                 verify_program)
+from paddle_tpu.framework import Executor, ir
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _fresh():
+    return program_guard(Program(), Program())
+
+
+def _findings(prog, check, fetch=()):
+    return verify_program(prog, fetch).by_check(check)
+
+
+def _counter(check):
+    fam = monitor.REGISTRY.get("paddle_tpu_verifier_findings_total")
+    return fam.value(check=check) if fam else 0.0
+
+
+# ---------------------------------------------------------------------------
+# def_before_use / uninitialized_read
+# ---------------------------------------------------------------------------
+
+def test_def_before_use_trips_on_undeclared_input():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        prog = fluid.default_main_program()
+        op = next(o for o in prog.global_block().ops if o.type == "relu")
+        op.inputs["X"] = ["ghost_var"]          # seeded defect
+        prog._bump_version()
+        before = _counter("def_before_use")
+        d, = _findings(prog, "def_before_use", fetch=(y.name,))
+        assert d.severity == "error" and d.var == "ghost_var"
+        assert d.op_type == "relu" and "not declared" in d.message
+        assert _counter("def_before_use") == before + 1
+
+
+def test_def_before_use_near_miss_fed_data_var_is_clean():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (y.name,))
+        assert r.by_check("def_before_use") == []
+        assert r.by_check("uninitialized_read") == []
+        assert r.ok
+
+
+def test_uninitialized_read_trips_on_unfed_plain_var():
+    with _fresh():
+        prog = fluid.default_main_program()
+        blk = prog.global_block()
+        ux = blk.create_var(name="ux", shape=(4,), dtype="float32")
+        y = layers.relu(ux)                     # read, never written/fed
+        d, = _findings(prog, "uninitialized_read", fetch=(y.name,))
+        assert d.severity == "warning" and d.var == "ux"
+        assert "read before any op writes it" in d.message
+
+
+def test_uninitialized_read_near_miss_persistable_is_clean():
+    with _fresh():
+        w = layers.create_parameter([4], "float32", name="uw")
+        y = layers.relu(w)                      # persistable: scope-backed
+        prog = fluid.default_main_program()
+        assert _findings(prog, "uninitialized_read", fetch=(y.name,)) == []
+
+
+# ---------------------------------------------------------------------------
+# dangling fetch / feed
+# ---------------------------------------------------------------------------
+
+def test_dangling_fetch_trips_on_unknown_target():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.relu(x)
+        prog = fluid.default_main_program()
+        d, = _findings(prog, "dangling_fetch", fetch=("nope",))
+        assert d.severity == "error" and d.var == "nope"
+        assert "not a var of the program" in d.message
+        with pytest.raises(ProgramVerificationError) as ei:
+            verify_or_raise(prog, ("nope",))
+        assert "dangling_fetch" in str(ei.value)
+
+
+def test_dangling_fetch_trips_on_never_produced_var():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.relu(x)
+        prog = fluid.default_main_program()
+        prog.global_block().create_var(
+            name="declared_only", shape=(4,), dtype="float32")
+        d, = _findings(prog, "dangling_fetch", fetch=("declared_only",))
+        assert "no op produces it" in d.message
+
+
+def test_dangling_fetch_near_miss_produced_and_persistable_clean():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        w = layers.create_parameter([4], "float32", name="dw")
+        prog = fluid.default_main_program()
+        assert _findings(prog, "dangling_fetch",
+                         fetch=(y.name, w.name)) == []
+
+
+def test_dangling_feed_trips_on_unconsumed_data_var():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.data("unused", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        prog = fluid.default_main_program()
+        d, = _findings(prog, "dangling_feed", fetch=(y.name,))
+        assert d.severity == "warning" and d.var == "unused"
+
+
+def test_dangling_feed_near_miss_fetched_data_var_clean():
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[4], dtype="float32")
+        layers.relu(y)
+        # x is consumed by nothing but explicitly fetched: a passthrough
+        # (echo/debug) feed — legal at dispatch, so BOTH feed-side and
+        # fetch-side checks must stay clean
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (x.name,))
+        assert r.by_check("dangling_feed") == []
+        assert r.by_check("dangling_fetch") == []
+        assert r.ok
+        # and it really does run through compiler.optimize + dispatch
+        cp = fluid.CompiledProgram(prog)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xv = np.random.randn(2, 4).astype(np.float32)
+        out, = exe.run(cp, feed={"x": xv, "y": xv}, fetch_list=[x.name],
+                       scope=scope)
+        np.testing.assert_allclose(out, xv)
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype consistency
+# ---------------------------------------------------------------------------
+
+def test_shape_consistency_trips_on_patched_shape():
+    with _fresh():
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.fc(x, size=4)
+        prog = fluid.default_main_program()
+        prog.global_block().vars[y.name].shape = (-1, 99)   # bypassed infer
+        prog._bump_version()
+        ds = _findings(prog, "shape_consistency", fetch=(y.name,))
+        assert ds and ds[0].severity == "warning"
+        assert any(d.var == y.name and "[-1, 99]" in d.message
+                   for d in ds)
+
+
+def test_shape_consistency_near_miss_clean_build():
+    with _fresh():
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.fc(x, size=4)
+        prog = fluid.default_main_program()
+        assert _findings(prog, "shape_consistency", fetch=(y.name,)) == []
+
+
+# ---------------------------------------------------------------------------
+# dead ops + dead_op_eliminate pass
+# ---------------------------------------------------------------------------
+
+def _two_branch_prog():
+    x = layers.data("x", shape=[4], dtype="float32")
+    live = layers.relu(x)
+    dead = layers.sigmoid(layers.scale(x, scale=3.0))   # never observed
+    return fluid.default_main_program(), live, dead
+
+
+def test_dead_op_trips_on_unobserved_branch():
+    with _fresh():
+        prog, live, dead = _two_branch_prog()
+        ds = _findings(prog, "dead_op", fetch=(live.name,))
+        assert {d.op_type for d in ds} == {"scale", "sigmoid"}
+        assert all(d.severity == "warning" for d in ds)
+        r = verify_program(prog, (live.name,))
+        assert len(r.dead_ops) == 2
+
+
+def test_dead_op_near_miss_fetched_branch_clean():
+    with _fresh():
+        prog, live, dead = _two_branch_prog()
+        assert _findings(prog, "dead_op",
+                         fetch=(live.name, dead.name)) == []
+
+
+def test_dead_op_eliminate_pass_registered_and_removes():
+    assert "dead_op_eliminate" in ir.registered_passes()
+    with _fresh():
+        prog, live, dead = _two_branch_prog()
+        g = ir.Graph(prog)
+        g = ir.get_pass("dead_op_eliminate",
+                        protected=frozenset([live.name])).apply(g)
+        assert g.attrs["dead_op_eliminate_count"] == 2
+        out = g.to_program()
+        assert [op.type for op in out.global_block().ops] == ["relu"]
+
+
+def test_dead_op_eliminate_keeps_persistable_writers_and_collectives():
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        prog = fluid.default_main_program()
+        n = len(prog.global_block().ops)
+        g = ir.Graph(prog)
+        g = ir.get_pass("dead_op_eliminate",
+                        protected=frozenset([loss.name])).apply(g)
+        # optimizer writes persistables -> whole train graph stays live
+        assert g.attrs["dead_op_eliminate_count"] == 0
+        assert len(g.to_program().global_block().ops) == n
+
+
+def test_compiler_applies_dead_op_eliminate_before_lowering():
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        prog, live, dead = _two_branch_prog()
+        cp = fluid.CompiledProgram(prog)
+        opt = cp._optimized((live.name,))
+        assert [op.type for op in opt.global_block().ops] == ["relu"]
+        # and the pruned program still runs correctly
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        xv = np.random.randn(2, 4).astype(np.float32)
+        out, = exe.run(cp, feed={"x": xv}, fetch_list=[live.name],
+                       scope=scope)
+        np.testing.assert_allclose(out, np.maximum(xv, 0), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+
+def _train_prog():
+    x = layers.data("x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=4))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    param = prog.all_parameters()[0].name
+    return prog, loss, param
+
+
+def test_use_after_donate_trips_on_fetched_rw_persistable():
+    with _fresh():
+        prog, loss, param = _train_prog()
+        d, = _findings(prog, "use_after_donate", fetch=(param,))
+        assert d.severity == "warning" and d.var == param
+        assert "donates rw buffers" in d.message
+
+
+def test_use_after_donate_near_miss_loss_fetch_clean():
+    with _fresh():
+        prog, loss, param = _train_prog()
+        assert _findings(prog, "use_after_donate",
+                         fetch=(loss.name,)) == []
+
+
+def test_use_after_donate_caught_at_optimize_time_not_dispatch():
+    """Acceptance: the seeded hazard surfaces from compiler.optimize —
+    no executor, no dispatch."""
+    with _fresh():
+        prog, loss, param = _train_prog()
+        cp = fluid.CompiledProgram(prog)
+        with pytest.warns(UserWarning, match="use_after_donate"):
+            cp._optimized((param,))
+
+
+# ---------------------------------------------------------------------------
+# int64 feed classification
+# ---------------------------------------------------------------------------
+
+def test_int64_classification_static_vs_dynamic():
+    with _fresh():
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        raw = layers.data("raw", shape=[2], dtype="int64")
+        emb = layers.embedding(ids, size=[50, 8])
+        out = layers.mean(emb) + layers.mean(layers.cast(raw, "float32"))
+        # a TRAINING program: lookup_table_grad re-reads ids (X$Ids) and
+        # must inherit the forward rule, not demote the feed to dynamic
+        fluid.optimizer.SGD(0.1).minimize(out)
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        # every consumer of 'ids' bounds it by the 50-row table: static
+        assert r.int64_static == frozenset({"ids"})
+        # 'raw' is cast/summed -- values are data, wrap would corrupt
+        assert r.int64_dynamic == frozenset({"raw"})
+        va = prog._attrs["verify"]
+        assert va["int64_dynamic"] == ["raw"]
+        assert va["int64_static"] == ["ids"]
+
+
+def test_int64_classification_huge_table_stays_dynamic():
+    with _fresh():
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[2 ** 31 + 7, 4])
+        out = layers.mean(emb)
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        assert "ids" in r.int64_dynamic      # table itself exceeds int32
+
+
+def test_executor_skips_runtime_check_for_static_int64_feeds():
+    from paddle_tpu.framework import executor as ex_mod
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        raw = layers.data("raw", shape=[2], dtype="int64")
+        emb = layers.embedding(ids, size=[50, 8])
+        out = layers.mean(emb) + layers.mean(layers.cast(raw, "float32"))
+        fluid.optimizer.SGD(0.1).minimize(out)   # grads must stay static
+        cp = fluid.CompiledProgram(fluid.default_main_program())
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"ids": np.array([[1], [2]], np.int64),
+                "raw": np.ones((2, 2), np.int64)}
+        with ex_mod._checked_int64_lock:
+            before = set(ex_mod._checked_int64_feeds)
+        exe.run(cp, feed=feed, fetch_list=[out.name], scope=scope)
+        with ex_mod._checked_int64_lock:
+            added = {t[1] for t in ex_mod._checked_int64_feeds - before}
+        assert "raw" in added        # verifier-dynamic: check kept
+        assert "ids" not in added    # verifier-static: check skipped
+
+
+def test_verified_program_still_checks_mismatched_dtype_feed():
+    """A feed DECLARED int32 but fed an int64 array (numpy's default for
+    Python ints) is invisible to the declared-dtype classification — the
+    legacy actual-dtype wrap check must survive verification for it."""
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        mm = layers.data("mm_ids", shape=[2], dtype="int32")
+        out = layers.mean(layers.cast(mm, "float32"))
+        cp = fluid.CompiledProgram(fluid.default_main_program())
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        big = np.ones((1, 2), np.int64) << 40
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(cp, feed={"mm_ids": big}, fetch_list=[out.name],
+                    scope=scope)
+        assert any("WRAP" in str(x.message) for x in w)
+
+
+def test_verify_cache_keys_on_fetch_order():
+    """The collective fingerprint hashes the materialization (fetch)
+    order, so a reordered fetch list must re-verify — not hit the cache
+    and return a stale fingerprint."""
+    prog = _collective_prog(chained=True)
+    r_ab = verify_program(prog, ("ca_out", "cb_out"))
+    r_ba = verify_program(prog, ("cb_out", "ca_out"))
+    assert r_ba is not r_ab
+    assert r_ba.collective_fingerprint != r_ab.collective_fingerprint
+
+
+def test_unverified_program_keeps_legacy_int64_check():
+    from paddle_tpu.framework import executor as ex_mod
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        ids = layers.data("leg_ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[50, 8])
+        out = layers.mean(emb)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        # raw Program: no compiler.optimize, no verification -> legacy
+        exe.run(feed={"leg_ids": np.array([[1], [2]], np.int64)},
+                fetch_list=[out.name], scope=scope)
+        assert "leg_ids" in {t[1] for t in ex_mod._checked_int64_feeds}
+
+
+# ---------------------------------------------------------------------------
+# collective ordering
+# ---------------------------------------------------------------------------
+
+def _collective_prog(chained: bool):
+    prog = Program()
+    blk = prog.global_block()
+    a = blk.create_var(name="ca", shape=(4,), dtype="float32")
+    b = blk.create_var(name="cb", shape=(4,), dtype="float32")
+    a.is_data = b.is_data = True
+    a_out = blk.create_var(name="ca_out", shape=(4,), dtype="float32")
+    b_out = blk.create_var(name="cb_out", shape=(4,), dtype="float32")
+    blk.append_op("c_allreduce_sum", inputs={"X": [a]},
+                  outputs={"Out": [a_out]}, attrs={"ring_id": 0})
+    blk.append_op("c_allreduce_sum",
+                  inputs={"X": [a_out if chained else b]},
+                  outputs={"Out": [b_out]}, attrs={"ring_id": 0})
+    return prog
+
+
+def test_collective_order_trips_on_unordered_identical_pair():
+    prog = _collective_prog(chained=False)
+    d, = _findings(prog, "collective_order", fetch=("cb_out",))
+    assert d.severity == "error"
+    assert "no dependency path" in d.message and "mispair" in d.message
+
+
+def test_collective_order_near_miss_chained_clean_with_fingerprint():
+    prog = _collective_prog(chained=True)
+    r = verify_program(prog, ("cb_out",))
+    assert r.by_check("collective_order") == []
+    assert r.collective_fingerprint
+    # fingerprint is stable for an identical rebuild (rank parity check)
+    assert verify_program(_collective_prog(chained=True),
+                          ("cb_out",)).collective_fingerprint == \
+        r.collective_fingerprint
+    # ...and differs when the fetch (materialization) order differs
+    assert verify_program(_collective_prog(chained=True),
+                          ()).collective_fingerprint != \
+        r.collective_fingerprint
+
+
+def test_collective_divergence_caught_at_optimize_time_not_dispatch():
+    """Acceptance: the seeded divergence raises from compiler.optimize."""
+    prog = _collective_prog(chained=False)
+    cp = fluid.CompiledProgram(prog)
+    with pytest.raises(ProgramVerificationError) as ei:
+        cp._optimized(("cb_out",))
+    assert "collective_order" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# wiring: flag gate, cache, diagnostics formatting
+# ---------------------------------------------------------------------------
+
+def test_flag_off_skips_verification():
+    prog = _collective_prog(chained=False)
+    fluid.set_flags({"FLAGS_program_verify": False})
+    try:
+        cp = fluid.CompiledProgram(prog)
+        cp._optimized(("cb_out",))          # bad program sails through
+    finally:
+        fluid.set_flags({"FLAGS_program_verify": True})
+
+
+def test_verify_cached_on_fingerprint():
+    fam = monitor.REGISTRY.get("paddle_tpu_verifier_runs_total")
+    with _fresh():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        prog = fluid.default_main_program()
+        r1 = verify_program(prog, (y.name,))
+        hits = fam.value(cache="hit")
+        r2 = verify_program(prog, (y.name,))
+        assert r2 is r1                      # cache hit: same object
+        assert fam.value(cache="hit") == hits + 1
+        # a mutation re-verifies
+        layers.relu(y)
+        misses = fam.value(cache="miss")
+        verify_program(prog, (y.name,))
+        assert fam.value(cache="miss") == misses + 1
+
+
+def test_warning_emitted_once_per_fingerprint():
+    with _fresh():
+        prog, loss, param = _train_prog()
+        with pytest.warns(UserWarning, match="use_after_donate"):
+            verify_or_raise(prog, (param,))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            verify_or_raise(prog, (param,))  # cached: no repeat warning
+        assert not [x for x in w if "use_after_donate" in str(x.message)]
+
+
+def test_format_diagnostics_renders_context_and_hint():
+    from paddle_tpu import debugger
+    with _fresh():
+        prog, loss, param = _train_prog()
+        r = verify_program(prog, (param,))
+        txt = debugger.format_diagnostics(r.diagnostics)
+        assert f"[warning] use_after_donate @ var {param!r}" in txt
+        assert "fix:" in txt
+
+
+def test_steady_state_dispatch_never_reverifies():
+    """The verifier runs on the optimize miss only: 50 steady-state steps
+    add zero verifier runs (bench dispatch overhead unchanged)."""
+    fam = monitor.REGISTRY.get("paddle_tpu_verifier_runs_total")
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        prog, loss, param = _train_prog()
+        cp = fluid.CompiledProgram(prog)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        runs = (fam.value(cache="hit"), fam.value(cache="miss"))
+        for _ in range(50):
+            exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope,
+                    return_numpy=False)
+        exe.drain()
+        assert (fam.value(cache="hit"), fam.value(cache="miss")) == runs

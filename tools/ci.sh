@@ -51,6 +51,12 @@ JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 echo "== resilience smoke (fault injection + retries + ckpt integrity) =="
 JAX_PLATFORMS=cpu python tools/resilience_smoke.py
 
+echo "== concurrency lint (guarded fields, signal handlers, threads, finalizers) =="
+python tools/lint_concurrency.py
+
+echo "== verifier smoke (known-bad programs caught at optimize time) =="
+JAX_PLATFORMS=cpu python tools/verifier_smoke.py
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 

@@ -1,0 +1,469 @@
+#!/usr/bin/env python
+"""Concurrency lint: AST checks encoding the locking invariants five
+review passes kept re-finding by hand (CHANGES.md PR 1-4: unguarded
+``_inflight`` mutations, counter bumps outside the stats lock, signal
+handlers taking locks, finalize callbacks under non-reentrant locks).
+
+Rules
+-----
+``guarded-field``
+    A field declared with a trailing ``# guarded-by: <lock>`` comment on
+    its defining assignment may only be MUTATED (assignment, augmented
+    assignment, ``del``, or a mutating method call — ``append``/``pop``/
+    ``clear``/``add``/``update``/...) inside a ``with <lock>:`` block
+    whose context expression ends in the declared lock name.  Instance
+    fields (``self.X = ...``) bind module-wide by attribute name;
+    module-level names bind across every linted file (so a set guarded in
+    one module stays checked where a sibling module imports and mutates
+    it).  ``__init__`` bodies are exempt (the object is not shared yet),
+    as is the declaring statement itself.
+
+``signal-handler``
+    A function installed via ``signal.signal(...)`` (followed through
+    same-module calls, depth 3) must not acquire locks (``with`` on a
+    lock-like expression, ``.acquire()``) or bump telemetry
+    (``TRACER``/``REGISTRY`` access, ``.inc``/``.observe``/
+    ``.add_complete``/``.instant`` calls): a handler interrupts its own
+    thread mid-critical-section, so taking any non-reentrant lock there
+    can self-deadlock at the exact moment the process must drain.
+
+``thread-lifetime``
+    Every ``threading.Thread(...)`` must be created ``daemon=True`` or be
+    provably joined (``<target>.daemon = True`` before start, or a
+    ``.join()`` on the same name/attribute somewhere in the module) — a
+    forgotten non-daemon thread wedges interpreter shutdown.
+
+``finalize-lock``
+    A ``weakref.finalize`` callback (followed through same-module calls,
+    depth 3) must not acquire a lock known to be created as
+    ``threading.Lock()``: cyclic GC can run the finalizer at an
+    allocation point INSIDE a critical section on the same thread, where
+    a non-reentrant lock self-deadlocks — use ``threading.RLock()``
+    (executor.py's ``_lock`` is the precedent).
+
+Suppression: append ``# lint-ok: <justification>`` to the flagged line to
+mark a reviewed true negative; suppressed findings are reported in the
+summary but do not fail the run.
+
+Usage::
+
+    python tools/lint_concurrency.py [path ...]     # default: paddle_tpu/
+
+Exit status: 0 when clean, 1 when violations remain, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: container-mutating method names (rule ``guarded-field``)
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "add", "discard", "update",
+    "difference_update", "intersection_update",
+    "symmetric_difference_update", "setdefault", "sort", "reverse",
+})
+
+#: telemetry bump entry points a signal handler must never reach
+TELEMETRY_CALLS = frozenset({"inc", "observe", "add_complete", "instant"})
+TELEMETRY_NAMES = ("TRACER", "REGISTRY")
+
+#: names that look like locks even without a visible construction site
+_LOCKISH = re.compile(r"(^|_)(lock|locks|mu|mutex|cv|emu)$", re.I)
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_OK_RE = re.compile(r"#\s*lint-ok:\s*(.+)")
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+    suppressed: Optional[str] = None   # justification when lint-ok'd
+
+    def __str__(self):
+        tag = f" (suppressed: {self.suppressed})" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+def _terminal_name(node) -> Optional[str]:
+    """Last dotted component of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _comments_by_line(source: str) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+class _FileInfo:
+    """Per-file parse + per-run shared annotation registries."""
+
+    def __init__(self, path: Path):
+        self.path = str(path)
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=self.path)
+        self.comments = _comments_by_line(self.source)
+        # attr name -> lock name, for fields declared `self.X = ...`
+        self.attr_guards: Dict[str, str] = {}
+        # lock attr/name -> "lock" | "rlock" | "condition"
+        self.lock_kinds: Dict[str, str] = {}
+
+
+def _lock_kind_of_call(call: ast.Call) -> Optional[str]:
+    name = _terminal_name(call.func)
+    return {"Lock": "lock", "RLock": "rlock",
+            "Condition": "condition"}.get(name)
+
+
+def _collect_annotations(files: List[_FileInfo],
+                         name_guards: Dict[str, str]):
+    """Pass 1: guarded-field declarations + lock construction kinds."""
+    for fi in files:
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            guard = None
+            for ln in range(node.lineno, end + 1):
+                m = _GUARD_RE.search(fi.comments.get(ln, ""))
+                if m:
+                    guard = m.group(1).rsplit(".", 1)[-1]
+                    break
+            targets = [node.target] if isinstance(node, ast.AnnAssign) \
+                else list(node.targets)
+            for t in targets:
+                tn = _terminal_name(t)
+                if tn is None:
+                    continue
+                if guard:
+                    if isinstance(t, ast.Attribute):
+                        fi.attr_guards[tn] = guard
+                    else:
+                        name_guards[tn] = guard
+                # lock kinds come from Assign AND AnnAssign — a lock
+                # declared `self._mu: threading.Lock = threading.Lock()`
+                # must not escape the finalize-lock rule
+                if isinstance(node.value, ast.Call):
+                    kind = _lock_kind_of_call(node.value)
+                    if kind:
+                        fi.lock_kinds[tn] = kind
+
+
+# ---------------------------------------------------------------------------
+# rule: guarded-field
+# ---------------------------------------------------------------------------
+
+class _GuardChecker(ast.NodeVisitor):
+    def __init__(self, fi: _FileInfo, name_guards, report):
+        self.fi = fi
+        self.name_guards = name_guards
+        self.report = report
+        self.with_locks: List[str] = []    # terminal names of live withs
+        self.func_stack: List[str] = []
+
+    # -- scope tracking ------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        outer = self.with_locks
+        self.with_locks = []               # withs do not cross functions
+        self.generic_visit(node)
+        self.with_locks = outer
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        names = [_terminal_name(item.context_expr)
+                 for item in node.items]
+        # `with self._cv:` on a Condition acquires its underlying lock
+        self.with_locks.extend(n for n in names if n)
+        for stmt in node.body:
+            self.visit(stmt)
+        for item in node.items:            # context exprs themselves
+            self.visit(item.context_expr)
+        del self.with_locks[len(self.with_locks) - len(
+            [n for n in names if n]):]
+
+    # -- mutation sites ------------------------------------------------------
+    def _guard_for(self, target) -> Optional[Tuple[str, str]]:
+        """(field name, lock name) when ``target`` is a guarded field (or
+        a subscript of one)."""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        tn = _terminal_name(target)
+        if tn is None:
+            return None
+        if isinstance(target, ast.Attribute):
+            lock = self.fi.attr_guards.get(tn)
+        else:
+            lock = self.name_guards.get(tn)
+        return (tn, lock) if lock else None
+
+    def _check(self, target, lineno):
+        if "__init__" in self.func_stack or not self.func_stack:
+            return                         # construction / module level
+        g = self._guard_for(target)
+        if g is None:
+            return
+        field, lock = g
+        if lock in self.with_locks:
+            return
+        self.report(
+            lineno, "guarded-field",
+            f"mutation of {field!r} outside `with {lock}:` "
+            f"(declared `# guarded-by: {lock}`)")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._check(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            self._check(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            self._check(f.value, node.lineno)
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# call-graph helpers (signal handlers, finalize callbacks)
+# ---------------------------------------------------------------------------
+
+def _functions_by_name(tree) -> Dict[str, ast.AST]:
+    """Every function/method in the module, by bare name (methods shadow
+    nothing in practice; a duplicate keeps the first definition)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _resolve_callback(fi: _FileInfo, node) -> Optional[ast.AST]:
+    if isinstance(node, ast.Lambda):
+        return node
+    name = _terminal_name(node)
+    if name is None:
+        return None
+    return _functions_by_name(fi.tree).get(name)
+
+
+def _walk_callbacks(fi: _FileInfo, fn, visit, depth=3, seen=None):
+    """Apply ``visit(node)`` over ``fn``'s body and same-module callees."""
+    if fn is None or depth < 0:
+        return
+    seen = seen if seen is not None else set()
+    if id(fn) in seen:
+        return
+    seen.add(id(fn))
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    table = _functions_by_name(fi.tree)
+    for stmt in body:
+        for node in ast.walk(stmt):
+            visit(node)
+            if isinstance(node, ast.Call):
+                callee = _terminal_name(node.func)
+                if callee in table:
+                    _walk_callbacks(fi, table[callee], visit,
+                                    depth - 1, seen)
+
+
+def _is_lockish(fi: _FileInfo, expr) -> bool:
+    name = _terminal_name(expr)
+    if name is None:
+        return False
+    return name in fi.lock_kinds or bool(_LOCKISH.search(name))
+
+
+def _check_signal_handlers(fi: _FileInfo, report):
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "signal"
+                and len(node.args) >= 2):
+            continue
+        handler = _resolve_callback(fi, node.args[1])
+        if handler is None:
+            continue
+
+        def visit(n, _install_line=node.lineno):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    if _is_lockish(fi, item.context_expr):
+                        report(item.context_expr.lineno, "signal-handler",
+                               "signal handler acquires lock "
+                               f"{_terminal_name(item.context_expr)!r} — "
+                               "a handler interrupting its own critical "
+                               "section self-deadlocks")
+            elif isinstance(n, ast.Call):
+                callee = _terminal_name(n.func)
+                if callee == "acquire" and isinstance(n.func,
+                                                     ast.Attribute):
+                    report(n.lineno, "signal-handler",
+                           "signal handler calls .acquire() — handlers "
+                           "must stay lock-free")
+                elif callee in TELEMETRY_CALLS or (
+                        isinstance(n.func, ast.Attribute) and any(
+                            t in ast.dump(n.func)
+                            for t in TELEMETRY_NAMES)):
+                    report(n.lineno, "signal-handler",
+                           f"signal handler bumps telemetry ({callee}) — "
+                           "the tracer/registry locks are not reentrant; "
+                           "defer the bump to the drain/exit path")
+
+        _walk_callbacks(fi, handler, visit)
+
+
+def _check_finalize_callbacks(fi: _FileInfo, report):
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "finalize"
+                and len(node.args) >= 2):
+            continue
+        cb = _resolve_callback(fi, node.args[1])
+        if cb is None:
+            continue
+
+        def visit(n):
+            locks = []
+            if isinstance(n, ast.With):
+                locks = [item.context_expr for item in n.items]
+            elif isinstance(n, ast.Call) and \
+                    _terminal_name(n.func) == "acquire" and \
+                    isinstance(n.func, ast.Attribute):
+                locks = [n.func.value]
+            for expr in locks:
+                name = _terminal_name(expr)
+                if name and fi.lock_kinds.get(name) == "lock":
+                    report(expr.lineno, "finalize-lock",
+                           f"finalize callback acquires {name!r}, a "
+                           "non-reentrant threading.Lock — cyclic GC can "
+                           "fire the finalizer inside a critical section "
+                           "on the same thread; use threading.RLock")
+
+        _walk_callbacks(fi, cb, visit)
+
+
+# ---------------------------------------------------------------------------
+# rule: thread-lifetime
+# ---------------------------------------------------------------------------
+
+def _check_threads(fi: _FileInfo, report):
+    src = fi.source
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "Thread"):
+            continue
+        daemon = next((kw for kw in node.keywords
+                       if kw.arg == "daemon"), None)
+        if daemon is not None and isinstance(daemon.value, ast.Constant) \
+                and daemon.value.value is True:
+            continue
+        # not daemon at construction: accept `<t>.daemon = True` or a
+        # `.join()` on the assignment target anywhere in the module
+        target = None
+        for parent in ast.walk(fi.tree):
+            if isinstance(parent, ast.Assign) and parent.value is node:
+                target = _terminal_name(parent.targets[0])
+        joined = target is not None and (
+            re.search(rf"\b{re.escape(target)}\s*\.\s*join\s*\(", src)
+            or re.search(rf"\.{re.escape(target)}\s*\.\s*join\s*\(", src)
+            or re.search(rf"\b{re.escape(target)}\s*\.\s*daemon\s*=\s*True",
+                         src)
+            or re.search(rf"\.{re.escape(target)}\s*\.\s*daemon\s*=\s*True",
+                         src))
+        if not joined:
+            report(node.lineno, "thread-lifetime",
+                   "threading.Thread created without daemon=True and "
+                   "never provably joined — a forgotten non-daemon "
+                   "thread wedges interpreter shutdown")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_paths(paths) -> List[Violation]:
+    files: List[_FileInfo] = []
+    for p in paths:
+        p = Path(p)
+        candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in candidates:
+            if "__pycache__" in f.parts:
+                continue
+            try:
+                files.append(_FileInfo(f))
+            except SyntaxError as e:
+                raise SystemExit(f"lint_concurrency: cannot parse {f}: {e}")
+    name_guards: Dict[str, str] = {}
+    _collect_annotations(files, name_guards)
+    violations: List[Violation] = []
+    for fi in files:
+        def report(lineno, rule, message, _fi=fi):
+            ok = _OK_RE.search(_fi.comments.get(lineno, ""))
+            violations.append(Violation(
+                _fi.path, lineno, rule, message,
+                suppressed=ok.group(1).strip() if ok else None))
+        _GuardChecker(fi, name_guards, report).visit(fi.tree)
+        _check_signal_handlers(fi, report)
+        _check_finalize_callbacks(fi, report)
+        _check_threads(fi, report)
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0
+    if not argv:
+        argv = [str(Path(__file__).resolve().parent.parent / "paddle_tpu")]
+    for a in argv:
+        if not Path(a).exists():
+            print(f"lint_concurrency: no such path: {a}", file=sys.stderr)
+            return 2
+    violations = lint_paths(argv)
+    live = [v for v in violations if not v.suppressed]
+    suppressed = [v for v in violations if v.suppressed]
+    for v in violations:
+        print(v)
+    print(f"lint_concurrency: {len(live)} violation(s), "
+          f"{len(suppressed)} suppressed, "
+          f"{len(argv)} path(s)")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.nets",
     "paddle_tpu.io",
     "paddle_tpu.resilience",
+    "paddle_tpu.analysis",
     "paddle_tpu.initializer",
     "paddle_tpu.regularizer",
     "paddle_tpu.clip",
