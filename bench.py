@@ -695,7 +695,103 @@ def _telemetry_block(name, tel0, wall_s):
           "unit": "steps/s", "vs_baseline": 0, "telemetry": block})
 
 
-def main():
+def _retry_in_subprocess(name, timeout_s=1800):
+    """Re-run one failed workload in a FRESH subprocess (``--only``):
+    the r05 gpt_causal death was a remote-compile transport error, and a
+    wedged compile channel or poisoned in-process cache does not survive
+    a process boundary.  Returns (ok, records, error) — records are the
+    child's emitted metric lines, each re-tagged ``"retry": 1``."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--only", name],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ))
+    except subprocess.TimeoutExpired:
+        return False, [], f"retry subprocess timed out after {timeout_s}s"
+    recs = []
+    for line in (r.stdout or "").splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        # the child's FINAL line is the compact summary ARRAY — skip it
+        if isinstance(rec, dict) and rec.get("metric"):
+            recs.append(rec)
+    failed = [rec for rec in recs
+              if str(rec.get("metric", "")).startswith("bench_error:")]
+    # infra lines (compile-cache banner) are emitted even when no
+    # workload ran — success requires an actual workload record
+    workload_recs = [rec for rec in recs
+                     if rec.get("metric") != "xla_compile_cache"]
+    if r.returncode != 0:
+        return False, workload_recs, (
+            f"retry subprocess exited {r.returncode}: "
+            f"{(r.stderr or r.stdout or '')[-300:]}")
+    if failed:
+        return False, workload_recs, failed[0].get("error", "bench_error")
+    if not workload_recs:
+        return False, [], "retry subprocess emitted no workload lines"
+    return True, workload_recs, None
+
+
+def _run_one(name, b, monitor, retry_on_error=True):
+    """Run one workload; on failure retry ONCE in a fresh subprocess
+    before conceding a ``bench_error`` line (ROADMAP: the flaky r05
+    gpt_causal remote-compile transport death should cost a retry, not
+    a bench round)."""
+    tel0 = monitor.counter_totals()
+    t0 = time.perf_counter()
+    n0 = len(RESULTS)
+    err = None
+    try:
+        b()
+    except Exception as e:  # one broken line must not kill the rest
+        err = repr(e)[:300]
+    if err is not None and retry_on_error:
+        # the failed attempt may have emitted partial metric lines
+        # before dying — drop them from the authoritative summary (they
+        # stay in the stdout stream as a record of the attempt) so the
+        # child's retry-tagged lines are the only ones per metric
+        del RESULTS[n0:]
+        emit({"metric": f"bench_retry:{name}", "value": 1,
+              "unit": "attempt", "vs_baseline": 0, "error": err})
+        ok, recs, retry_err = _retry_in_subprocess(name)
+        for rec in recs:
+            # the child's own bench_error is folded into the parent's
+            # combined line below — re-emitting it too would make one
+            # failure count as two error records in the summary
+            if str(rec.get("metric", "")).startswith("bench_error:"):
+                continue
+            rec = dict(rec)
+            rec["retry"] = 1
+            emit(rec)
+        if ok:
+            return          # child already produced the workload's lines
+        err = f"first: {err}; retry: {retry_err}"
+    if err is not None:
+        emit({"metric": f"bench_error:{name}", "value": 0,
+              "unit": "error", "vs_baseline": 0,
+              "retried": int(bool(retry_on_error)), "error": err[:600]})
+    try:
+        _telemetry_block(name, tel0, time.perf_counter() - t0)
+    except Exception as e:  # telemetry must never break the bench
+        try:
+            emit({"metric": f"telemetry:{name}", "value": 0,
+                  "unit": "error", "vs_baseline": 0,
+                  "error": repr(e)[:200]})
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    only = None
+    if "--only" in argv:
+        idx = argv.index("--only")
+        if idx + 1 >= len(argv):
+            sys.exit("usage: bench.py [--only WORKLOAD]")
+        only = argv[idx + 1]
     dev, on_tpu, peak = _device_info()
     cache_dir = _setup_compile_cache()
     if cache_dir:
@@ -719,23 +815,10 @@ def main():
         ("bert", lambda: bench_bert(dev, on_tpu, peak)),
     ]
     for name, b in benches:
-        tel0 = monitor.counter_totals()
-        t0 = time.perf_counter()
-        try:
-            b()
-        except Exception as e:  # one broken line must not kill the rest
-            emit({"metric": f"bench_error:{name}", "value": 0,
-                  "unit": "error", "vs_baseline": 0,
-                  "error": repr(e)[:300]})
-        try:
-            _telemetry_block(name, tel0, time.perf_counter() - t0)
-        except Exception as e:  # telemetry must never break the bench
-            try:
-                emit({"metric": f"telemetry:{name}", "value": 0,
-                      "unit": "error", "vs_baseline": 0,
-                      "error": repr(e)[:200]})
-            except Exception:
-                pass
+        if only is not None and name != only:
+            continue
+        # a --only child IS the retry: never recurse into a third process
+        _run_one(name, b, monitor, retry_on_error=only is None)
     # FINAL line: compact all-metrics summary (metric/value/vs_baseline
     # only).  The driver's tail capture lost 3 of 10 verbose lines in
     # round 4; this one line carries every measurement and survives any

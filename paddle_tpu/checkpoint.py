@@ -59,6 +59,11 @@ TORN_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_checkpoint_torn_rejects_total",
     "checkpoints refused at resume: newer than (or missing) the gang's "
     "COMMITTED manifest — a torn multi-rank save is never restored")
+STRETCH_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_checkpoint_cadence_stretched_total",
+    "checkpoint-daemon capture windows stretched past the configured "
+    "cadence because the last observed save exceeded "
+    "FLAGS_checkpoint_cadence_stretch_frac of the interval")
 SAVE_HIST = _monitor.REGISTRY.histogram(
     "paddle_tpu_checkpoint_save_ms",
     "wall ms per checkpoint save call (async: schedule + serialize "
@@ -80,6 +85,12 @@ class CheckpointManager:
             self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
+        #: wall ms of the most recent accepted save call (schedule +
+        #: serialize handoff) — observability mirror of the save-ms
+        #: histogram.  NOTE: the adaptive-cadence daemon does NOT read
+        #: this; it times its own end-to-end _save (materialize + write
+        #: + durable commit), which is the latency that matters there.
+        self.last_save_ms: Optional[float] = None
 
     # -- state gathering -----------------------------------------------------
     def _gather(self, program, scope) -> Dict[str, np.ndarray]:
@@ -138,8 +149,10 @@ class CheckpointManager:
                 "checkpoint.write", _once,
                 retryable=lambda e: _resil.is_transient(e)
                 or isinstance(e, (OSError, TimeoutError)))
-        SAVE_HIST.observe((time.perf_counter() - t0) * 1e3)
+        save_ms = (time.perf_counter() - t0) * 1e3
+        SAVE_HIST.observe(save_ms)
         if accepted:
+            self.last_save_ms = save_ms
             SAVE_CTR.inc(1, kind=kind)
             BYTES_CTR.inc(sum(int(a.nbytes) for a in state.values()))
         return accepted

@@ -16,8 +16,10 @@ from . import collective_ops  # noqa  (registers c_* lowerings)
 from . import ps  # noqa  (registers send/recv/listen_and_serv lowerings)
 from .ps import (Communicator, DistributeTranspiler,  # noqa
                  DistributeTranspilerConfig, GeoCommunicator)
-from .env import (Env, get_rank, get_world_size,  # noqa
-                  init_parallel_env)
+from .coordinator import (GangClient, GangCoordinator,  # noqa
+                          GangDegradedError, GangFingerprintError)
+from .env import (Env, GangRendezvous, get_rank,  # noqa
+                  get_world_size, init_parallel_env)
 from .fleet import (CollectiveOptimizer, DistributedStrategy,  # noqa
                     PaddleCloudRoleMaker, PSFleet, TranspilerOptimizer,
                     UserDefinedRoleMaker, fleet, ps_fleet)

@@ -1,0 +1,1021 @@
+"""Socket-based gang coordination: liveness plane + elastic rank recovery.
+
+The file-based :class:`~paddle_tpu.distributed.env.GangRendezvous` (PR 4)
+made gang checkpoint commits crash-safe, but it assumes a shared
+filesystem, cannot tell a slow rank from a dead one, and gives surviving
+ranks no signal at all when a peer is SIGKILLed — they hang inside the
+next collective until something external reaps the job.  This module is
+the live half of the coordination plane, modeled on the Fluid fleet/PS
+endpoint design (every distributed mode there runs through a rank-0
+endpoint + heartbeat model, not a shared directory):
+
+- :class:`GangCoordinator` — a TCP server (stdlib sockets only, hosted by
+  the launcher or any rank-0 side process) holding the gang's state:
+  per-rank heartbeat tables, the committed-step manifest, step barriers,
+  and the collective-fingerprint registry.
+- :class:`GangClient` — one per rank.  A background thread heartbeats
+  ``(rank, committed-step list, current step, collective fingerprint)``
+  every ``FLAGS_gang_heartbeat_interval_s``; the same object implements
+  the full ``GangRendezvous`` protocol (``announce`` / ``commit_latest``
+  / ``wait_commit`` / ``committed_step`` / ``wait_manifest``) over the
+  socket, so ``CheckpointDaemon``, ``PreemptionGuard`` and
+  ``resume_or_init`` run unchanged on either backend.
+
+Wire protocol
+-------------
+Length-prefixed JSON frames: a 4-byte big-endian unsigned length followed
+by that many bytes of UTF-8 JSON (one object per frame, 16 MiB cap).
+Every request carries ``op`` and (usually) ``rank``; every response
+carries ``ok``.  Cheap ops ride one persistent connection per client
+(serialized by a lock); blocking ops (``wait_commit``, ``wait_ready``,
+``step_barrier``, ``wait_manifest``) each open a one-shot connection so a
+parked rank's heartbeats and daemon announces never queue behind them.
+
+Liveness
+--------
+A rank missing heartbeats for ``FLAGS_gang_heartbeat_timeout_s`` is
+declared dead: the coordinator marks the gang ``degraded``, wakes every
+barrier waiter (they get a ``degraded`` refusal instead of hanging inside
+a collective), and reports the dead ranks in every heartbeat response —
+survivors observe ``client.degraded``, drain in-flight steps through the
+existing ``PreemptionGuard``/``Executor.drain`` machinery, and park in
+``client.wait_ready()``.  When the launcher (``--max_restarts``) respawns
+the rank, its ``hello`` re-admits it, the gang returns to ``ok``, and the
+parked survivors resume.  The manifest protocol is unchanged — the gang
+never commits a step past the last all-rank-durable one, so the rejoining
+rank's ``resume_or_init`` lands exactly where the survivors' trajectory
+is still consistent with it.
+
+Fingerprints
+------------
+The PR-5 verifier's collective fingerprint rides every heartbeat and
+every ``step_barrier`` arrival.  Two ranks disagreeing turn the silent
+cross-rank divergence hang into an immediate
+:class:`GangFingerprintError` naming both ranks and both fingerprints:
+the barrier is refused for everyone, and the passive heartbeat check
+latches the mismatch into ``client.check()``.
+
+Durability note: the coordinator keeps gang state in memory (it outlives
+any rank when hosted by the launcher).  Pass ``manifest_dir`` to also
+persist the ``COMMITTED`` manifest through the same fsync'd-atomic file
+the file backend uses, so a full job restart still refuses torn saves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import monitor as _monitor
+from .env import Env, format_manifest, parse_manifest, _atomic_write
+
+__all__ = [
+    "GangCoordinator", "GangClient", "GangDegradedError",
+    "GangFingerprintError", "send_frame", "recv_frame",
+]
+
+#: one JSON frame may not exceed this (a gang control message is tiny;
+#: anything bigger is a protocol error, not a bigger buffer)
+MAX_FRAME_BYTES = 16 << 20
+
+
+class GangDegradedError(RuntimeError):
+    """A gang operation was refused because a rank is dead (missed
+    ``FLAGS_gang_heartbeat_timeout_s`` of heartbeats).  Survivors should
+    drain and park in ``wait_ready()`` until the launcher respawns the
+    rank — not retry the refused collective."""
+
+    def __init__(self, msg: str, dead=()):
+        super().__init__(msg)
+        self.dead = sorted(int(r) for r in dead)
+
+
+class GangFingerprintError(RuntimeError):
+    """Two ranks entered the gang with different collective fingerprints
+    (the PR-5 verifier signature over the dependency-ordered collective
+    sequence + fetch list).  Without this check the mismatch manifests as
+    a cross-rank hang inside the first unpaired collective; with it, the
+    step barrier fails immediately, naming both ranks."""
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    """Serialize ``obj`` as one length-prefixed JSON frame."""
+    body = json.dumps(obj, sort_keys=True).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"gang frame of {len(body)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte cap")
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("gang peer closed the connection")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    """Read one length-prefixed JSON frame (raises ``ConnectionError`` on
+    a closed peer, ``ValueError`` on an oversized or malformed frame)."""
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"gang frame announces {n} bytes, over the "
+            f"{MAX_FRAME_BYTES}-byte cap (corrupt stream?)")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# coordinator (server)
+# ---------------------------------------------------------------------------
+
+class GangCoordinator:
+    """Rank-0 gang coordinator: heartbeat tables + manifest + barriers.
+
+    Hosted by the launcher (which survives any rank's death — the natural
+    place for elastic recovery) or embedded in a rank-0 side thread.  All
+    state lives under one condition variable; blocking requests wait on
+    it, so a rank death or a barrier release wakes every waiter at once.
+    """
+
+    def __init__(self, world_size: int, host: str = "127.0.0.1",
+                 port: int = 0,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 manifest_dir: Optional[str] = None):
+        from ..flags import get_flags
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = float(
+                get_flags("FLAGS_gang_heartbeat_timeout_s")
+                ["FLAGS_gang_heartbeat_timeout_s"])
+        self.world_size = int(world_size)
+        self.host = host
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.manifest_dir = manifest_dir
+        self._requested_port = int(port)
+        #: the actually-bound port, set by start() (an ephemeral request
+        #: gets a fresh port on every (re)start)
+        self.port: Optional[int] = None
+        self._cv = threading.Condition(threading.Lock())
+        self._ranks: Dict[int, dict] = {}       # guarded-by: _cv
+        self._manifest: Optional[int] = None    # guarded-by: _cv
+        self._barriers: Dict[int, dict] = {}    # guarded-by: _cv
+        self._mismatch: Optional[dict] = None   # guarded-by: _cv
+        self._stopping = False                  # guarded-by: _cv
+        self._conns: List[socket.socket] = []   # guarded-by: _cv
+        self._mirror_mu = threading.Lock()      # manifest-file writes
+        self._lsock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        if manifest_dir:
+            self._manifest = self._load_manifest(manifest_dir)
+
+    @staticmethod
+    def _load_manifest(manifest_dir: str) -> Optional[int]:
+        try:
+            with open(os.path.join(manifest_dir, "MANIFEST")) as f:
+                return parse_manifest(f.read())
+        except (OSError, ValueError):
+            return None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "GangCoordinator":
+        if self._lsock is not None:
+            return self
+        with self._cv:
+            self._stopping = False      # restartable after stop()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self._requested_port))
+        s.listen(128)
+        self._lsock = s
+        self.port = s.getsockname()[1]
+        for target, name in ((self._accept_loop, "pt-gang-accept"),
+                             (self._liveness_loop, "pt-gang-liveness")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    @property
+    def address(self) -> str:
+        if self.port is None:
+            raise RuntimeError("coordinator not started")
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            conns, self._conns = self._conns, []
+            self._cv.notify_all()
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+            self._lsock = None
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- accept / serve ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._lsock.accept()
+            except (OSError, AttributeError):
+                return                     # listener closed: shutting down
+            with self._cv:
+                if self._stopping:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True, name="pt-gang-conn")
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = recv_frame(conn)
+                try:
+                    resp = self._handle(req)
+                except Exception as e:   # a bad request must not kill the
+                    resp = {"ok": False,  # coordinator
+                            "error": "internal",
+                            "detail": repr(e)[:300]}
+                send_frame(conn, resp)
+        except (ConnectionError, OSError, ValueError):
+            pass                           # client went away / bad frame
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._cv:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    # -- state helpers (all hold _cv) ---------------------------------------
+    def _entry_locked(self, rank: int) -> dict:
+        e = self._ranks.get(rank)
+        if e is None:
+            # 'step'/'steps' are the DURABLE record — written only by
+            # announce (after the rank's checkpoint is fsync-durable),
+            # read by commit_latest/wait_commit/peers.  'cur_step' is
+            # heartbeat-borne training progress, observability only: a
+            # manifest must never commit on the strength of a heartbeat
+            # (the step a rank is TRAINING is exactly the step it has
+            # not durably saved).
+            e = {"alive": True, "finished": False,
+                 "last_hb": time.monotonic(),
+                 "step": None, "steps": [], "cur_step": None,
+                 "hb_steps": [], "fingerprint": None,
+                 "pid": None, "deaths": 0, "joins": 0}
+            self._ranks[rank] = e  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+        return e
+
+    def _touch_locked(self, rank: int, pid: Optional[int] = None,
+                      hello: bool = False) -> dict:
+        """A frame from a live rank refreshes its liveness; a frame from
+        a rank previously declared dead is a REJOIN (the elastic path).
+        A rank that said goodbye is DEPARTED: only an explicit ``hello``
+        (a respawn introducing itself) re-admits it — its trailing
+        frames (a final announce, a heartbeat racing the goodbye) must
+        not resurrect it into a death sentence at process exit."""
+        e = self._entry_locked(rank)
+        if e["finished"] and not hello:
+            return e
+        rejoined = not e["alive"] and not e["finished"]
+        e["alive"] = True
+        e["finished"] = False
+        e["last_hb"] = time.monotonic()
+        if pid is not None:
+            e["pid"] = int(pid)
+        if rejoined:
+            # the respawn prunes torn steps before it re-announces, so
+            # the pre-death durable record may overstate what is on
+            # disk NOW — a manifest committed from it could name a
+            # pruned step.  Clear it; the rank re-announces its real
+            # post-prune holdings from _resume_gang.
+            e["step"] = None
+            e["steps"] = []
+            e["joins"] += 1
+            _monitor.GANG_REJOIN_CTR.inc()
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.instant(
+                    "gang.rejoin", "gang", {"rank": int(rank)})
+            if not self._dead_locked():
+                _monitor.GANG_DEGRADED_GAUGE.set(0)
+            self._cv.notify_all()
+        return e
+
+    def _dead_locked(self) -> List[int]:
+        """Ranks that went silent WITHOUT an orderly goodbye — a rank
+        that finished its work and said goodbye is done, not dead (its
+        peers must keep training, not park for a respawn that will
+        never come)."""
+        return sorted(r for r, e in self._ranks.items()
+                      if not e["alive"] and not e["finished"])
+
+    def _status_locked(self) -> str:
+        if self._dead_locked():
+            return "degraded"
+        present = sum(1 for e in self._ranks.values()
+                      if e["alive"] or e["finished"])
+        return "ok" if present >= self.world_size else "forming"
+
+    def _publish_locked(self, step: int) -> None:
+        """In-memory commit + waiter wakeup.  The durable file mirror is
+        the CALLER's job after releasing ``_cv`` (:meth:`_mirror_manifest`)
+        — an fsync inside the one coordinator lock would stall every
+        heartbeat, announce, and the liveness scan behind disk I/O."""
+        self._manifest = int(step)  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+        self._cv.notify_all()
+
+    def _mirror_manifest(self) -> None:
+        """Persist the CURRENT manifest to ``manifest_dir`` (same
+        fsync'd-atomic file the file backend writes).  Called outside
+        the lock; re-reads the step under it, so a racing later publish
+        just makes this write the newer step."""
+        if not self.manifest_dir:
+            return
+        with self._cv:
+            step = self._manifest
+        if step is None:
+            return
+        # serialize mirror writes: _atomic_write stages to a PER-PROCESS
+        # temp name, and two serve threads mirroring concurrently (e.g.
+        # a zombie wait_commit waiter racing a fresh commit_latest)
+        # would truncate each other's staging file mid-fsync
+        with self._mirror_mu:
+            os.makedirs(self.manifest_dir, exist_ok=True)
+            _atomic_write(os.path.join(self.manifest_dir, "MANIFEST"),
+                          format_manifest(step, self.world_size))
+
+    @staticmethod
+    def _find_mismatch(named, where: str) -> Optional[dict]:
+        """First disagreeing (rank, fingerprint) pair in a sorted list
+        of non-None fingerprints, as a diagnostic record naming both
+        ranks — None when all agree.  Shared by the passive heartbeat
+        check and the step-barrier refusal; counts the mismatch."""
+        if len({f for _, f in named}) <= 1:
+            return None
+        (r1, f1) = named[0]
+        (r2, f2) = next((r, f) for r, f in named[1:] if f != f1)
+        mm = {"ranks": [int(r1), int(r2)],
+              "fingerprints": [f1, f2],
+              "detail": (f"collective fingerprint mismatch{where}: "
+                         f"rank {r1} reports {f1!r} but rank {r2} "
+                         f"reports {f2!r} — divergent programs would "
+                         "deadlock inside the first unpaired "
+                         "collective")}
+        _monitor.GANG_FP_CTR.inc()
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant("gang.fingerprint_mismatch", "gang",
+                                    dict(mm))
+        return mm
+
+    def _check_fingerprints_locked(self) -> None:
+        """Passive cross-rank fingerprint exchange: latch the first pair
+        of live ranks whose heartbeat fingerprints disagree.  The barrier
+        path enforces; this path makes the mismatch visible in every
+        heartbeat response (``client.check()``)."""
+        named = sorted((r, e["fingerprint"])
+                       for r, e in self._ranks.items()
+                       if e["alive"] and e["fingerprint"] is not None)
+        if len({f for _, f in named}) <= 1:
+            self._mismatch = None  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+            return
+        if self._mismatch is not None:
+            return
+        self._mismatch = self._find_mismatch(named, "")  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+        self._cv.notify_all()
+
+    def _gang_view_locked(self) -> dict:
+        return {"status": self._status_locked(),
+                "dead": self._dead_locked(),
+                "manifest": self._manifest,
+                "mismatch": self._mismatch}
+
+    # -- liveness scan -------------------------------------------------------
+    def _liveness_loop(self) -> None:
+        poll = max(min(self.heartbeat_timeout_s / 4.0, 0.5), 0.02)
+        while True:
+            newly_dead: List[int] = []
+            with self._cv:
+                if self._stopping:
+                    return
+                now = time.monotonic()
+                for r, e in self._ranks.items():
+                    if e["alive"] and not e["finished"] and \
+                            now - e["last_hb"] > self.heartbeat_timeout_s:
+                        e["alive"] = False
+                        e["deaths"] += 1
+                        newly_dead.append(r)
+                if newly_dead:
+                    # wake barrier/ready waiters: survivors must get the
+                    # degraded refusal NOW, not at their next timeout
+                    self._cv.notify_all()
+                self._cv.wait(timeout=poll)
+            for r in newly_dead:
+                _monitor.GANG_DEATH_CTR.inc()
+                _monitor.GANG_DEGRADED_GAUGE.set(1)
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "gang.rank_dead", "gang",
+                        {"rank": int(r),
+                         "timeout_s": self.heartbeat_timeout_s})
+
+    # -- request dispatch ----------------------------------------------------
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            return {"ok": False, "error": "unknown_op", "detail": str(op)}
+        return fn(req)
+
+    def _op_hello(self, req: dict) -> dict:
+        with self._cv:
+            e = self._touch_locked(int(req["rank"]), pid=req.get("pid"),
+                                   hello=True)
+            if e["joins"] == 0:
+                e["joins"] = 1
+            return {"ok": True, "world_size": self.world_size,
+                    **self._gang_view_locked()}
+
+    def _op_heartbeat(self, req: dict) -> dict:
+        rank = int(req["rank"])
+        with self._cv:
+            e = self._touch_locked(rank)
+            # heartbeat progress is observability + fingerprint
+            # exchange ONLY — the durable step/steps record is
+            # announce's to write (see _entry_locked)
+            if req.get("step") is not None:
+                e["cur_step"] = int(req["step"])
+            if req.get("steps") is not None:
+                # observability echo of the rank's committed list (the
+                # DURABLE record stays announce-only — see _entry_locked)
+                e["hb_steps"] = sorted(int(s) for s in req["steps"])
+            if req.get("fingerprint") is not None:
+                # never let a fingerprint-less beat (another client in
+                # the same process, a rank before its first verify)
+                # erase a known fingerprint — that would un-latch a
+                # genuine mismatch between beats
+                e["fingerprint"] = req["fingerprint"]
+            self._check_fingerprints_locked()
+            view = self._gang_view_locked()
+        _monitor.GANG_HB_CTR.inc(1, role="coordinator")
+        return {"ok": True, **view}
+
+    def _op_announce(self, req: dict) -> dict:
+        rank = int(req["rank"])
+        with self._cv:
+            e = self._touch_locked(rank)
+            e["step"] = int(req["step"])
+            e["steps"] = sorted(int(s) for s in
+                                (req.get("steps") or [req["step"]]))
+            # announcements move the wait_commit barrier
+            self._cv.notify_all()
+        return {"ok": True}
+
+    def _op_goodbye(self, req: dict) -> dict:
+        """Orderly departure (clean exit / preemption drain finished):
+        the rank stops heartbeating ON PURPOSE.  It is excluded from the
+        liveness scan and never degrades the gang — the opposite of a
+        SIGKILL, which says nothing and IS a death."""
+        with self._cv:
+            e = self._entry_locked(int(req["rank"]))
+            e["alive"] = False
+            e["finished"] = True
+            if not self._dead_locked():
+                # a rank declared dead that then departs cleanly must
+                # not leave the degraded gauge latched on a healthy,
+                # completed gang (the runbook keys on it)
+                _monitor.GANG_DEGRADED_GAUGE.set(0)
+            self._cv.notify_all()
+        return {"ok": True}
+
+    def _op_peers(self, req: dict) -> dict:
+        with self._cv:
+            peers = {int(r): {"step": e["step"], "steps": list(e["steps"])}
+                     for r, e in self._ranks.items()
+                     if e["step"] is not None}
+        return {"ok": True, "peers": {str(r): d for r, d in peers.items()}}
+
+    def _op_manifest(self, req: dict) -> dict:
+        with self._cv:
+            return {"ok": True, "step": self._manifest}
+
+    def _op_publish(self, req: dict) -> dict:
+        if int(req["rank"]) != 0:
+            return {"ok": False, "error": "not_leader",
+                    "detail": f"rank {req['rank']} tried to publish the "
+                              "gang manifest; only rank 0 commits"}
+        with self._cv:
+            self._publish_locked(int(req["step"]))
+        self._mirror_manifest()
+        return {"ok": True}
+
+    def _op_commit_latest(self, req: dict) -> dict:
+        """Non-blocking steady-state commit: publish the newest step every
+        rank has durably announced (dead ranks count with their LAST
+        announcement — what they durably hold on disk is exactly what
+        they last announced), if it advances the manifest."""
+        if int(req["rank"]) != 0:
+            return {"ok": True, "published": None}
+        published = None
+        with self._cv:
+            if len([e for e in self._ranks.values() if e["steps"]]) \
+                    >= self.world_size:
+                common = None
+                for e in self._ranks.values():
+                    s = set(e["steps"])
+                    common = s if common is None else (common & s)
+                if common:
+                    best = max(common)
+                    if self._manifest is None or best > self._manifest:
+                        self._publish_locked(best)
+                        published = best
+        if published is not None:
+            self._mirror_manifest()
+        return {"ok": True, "published": published}
+
+    def _op_wait_commit(self, req: dict) -> dict:
+        """Blocking emergency barrier: wait until every rank's LATEST
+        announced step equals ``step``, then publish (strict equality —
+        the file backend's contract)."""
+        if int(req["rank"]) != 0:
+            return {"ok": False, "error": "not_leader",
+                    "detail": "wait_commit is leader-only"}
+        step = int(req["step"])
+        deadline = time.monotonic() + float(req.get("timeout_s", 30.0))
+        committed = False
+        with self._cv:
+            while True:
+                anns = [e for e in self._ranks.values()
+                        if e["step"] is not None]
+                if len(anns) >= self.world_size and \
+                        all(e["step"] == step for e in anns):
+                    self._publish_locked(step)
+                    committed = True
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(timeout=min(left, 0.25))
+        if committed:
+            self._mirror_manifest()
+        return {"ok": True, "committed": committed}
+
+    def _op_wait_manifest(self, req: dict) -> dict:
+        step = int(req["step"])
+        deadline = time.monotonic() + float(req.get("timeout_s", 30.0))
+        with self._cv:
+            while True:
+                if self._manifest is not None and self._manifest >= step:
+                    return {"ok": True, "reached": True}
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return {"ok": True, "reached": False}
+                self._cv.wait(timeout=min(left, 0.25))
+
+    def _op_wait_ready(self, req: dict) -> dict:
+        """Park until the whole gang is alive (the elastic rejoin
+        barrier) — or report the still-dead ranks at the deadline."""
+        deadline = time.monotonic() + float(req.get("timeout_s", 300.0))
+        with self._cv:
+            while True:
+                if self._status_locked() == "ok":
+                    return {"ok": True, "ready": True}
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return {"ok": True, "ready": False,
+                            "dead": self._dead_locked()}
+                self._cv.wait(timeout=min(left, 0.25))
+
+    def _op_step_barrier(self, req: dict) -> dict:
+        """Per-step gang barrier with fingerprint enforcement: released
+        only when every rank arrived with the SAME collective
+        fingerprint.  A mismatch refuses the barrier for everyone,
+        naming both ranks; a dead rank refuses it with ``degraded``
+        (survivors park instead of hanging inside a collective)."""
+        rank = int(req["rank"])
+        step = int(req["step"])
+        fp = req.get("fingerprint")
+        deadline = time.monotonic() + float(req.get("timeout_s", 60.0))
+        with self._cv:
+            self._touch_locked(rank)
+            b = self._barriers.setdefault(
+                step, {"fps": {}, "error": None})
+            b["fps"][rank] = fp
+            if b["error"] is None:
+                named = sorted((r, f) for r, f in b["fps"].items()
+                               if f is not None)
+                mm = self._find_mismatch(
+                    named, f" at the step-{step} barrier")
+                if mm is not None:
+                    b["error"] = f"step {step} barrier refused: " \
+                                 + mm["detail"]
+            self._cv.notify_all()
+            while True:
+                if b["error"] is not None:
+                    return {"ok": False, "error": "fingerprint",
+                            "detail": b["error"]}
+                dead = self._dead_locked()
+                if dead:
+                    return {"ok": False, "error": "degraded",
+                            "dead": dead,
+                            "detail": f"rank(s) {dead} died while the "
+                                      f"gang was at the step-{step} "
+                                      "barrier"}
+                gone = sorted(r for r, e in self._ranks.items()
+                              if e["finished"] and r not in b["fps"])
+                if gone:
+                    # an orderly departed rank can never arrive: refuse
+                    # NOW with the real reason instead of stalling the
+                    # full timeout and mis-diagnosing a slow rank
+                    return {"ok": False, "error": "degraded",
+                            "dead": gone,
+                            "detail": f"rank(s) {gone} departed "
+                                      "(finished their run) before the "
+                                      f"step-{step} barrier; it can "
+                                      "never release"}
+                if len(b["fps"]) >= self.world_size:
+                    for s in [s for s in self._barriers
+                              if s < step - 8]:   # bounded history
+                        del self._barriers[s]
+                    return {"ok": True, "released": True}
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return {"ok": False, "error": "timeout",
+                            "detail": f"step {step} barrier timed out "
+                                      f"with {len(b['fps'])}/"
+                                      f"{self.world_size} ranks arrived"}
+                self._cv.wait(timeout=min(left, 0.25))
+
+    def _op_status(self, req: dict) -> dict:
+        with self._cv:
+            ranks = {str(r): {"alive": e["alive"],
+                              "finished": e["finished"],
+                              "step": e["step"],
+                              "steps": list(e["steps"]),
+                              "cur_step": e["cur_step"],
+                              "hb_steps": list(e["hb_steps"]),
+                              "fingerprint": e["fingerprint"],
+                              "pid": e["pid"], "deaths": e["deaths"],
+                              "joins": e["joins"],
+                              "age_s": round(
+                                  time.monotonic() - e["last_hb"], 3)}
+                     for r, e in self._ranks.items()}
+            return {"ok": True, "ranks": ranks,
+                    **self._gang_view_locked()}
+
+
+# ---------------------------------------------------------------------------
+# client (one per rank; GangRendezvous-compatible)
+# ---------------------------------------------------------------------------
+
+class GangClient:
+    """A rank's connection to the :class:`GangCoordinator`.
+
+    Implements the same protocol surface as the file-based
+    :class:`~paddle_tpu.distributed.env.GangRendezvous` (so the
+    checkpoint daemon, the preemption guard, and ``resume_or_init`` are
+    backend-agnostic) plus the liveness plane: a heartbeat thread, the
+    ``degraded``/``dead_ranks`` view, ``wait_ready`` parking, and the
+    fingerprint-enforcing ``step_barrier``.
+    """
+
+    backend = "socket"
+
+    def __init__(self, address: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 heartbeat_interval_s: Optional[float] = None):
+        from ..flags import get_flags
+        env = Env()
+        address = address or os.getenv("PADDLE_GANG_COORD", "")
+        if not address or ":" not in address:
+            raise ValueError(
+                f"gang coordinator address {address!r} is not host:port "
+                "(set PADDLE_GANG_COORD or pass address=)")
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self._host, self._port = host, int(port)
+        self.rank = env.rank if rank is None else int(rank)
+        self.world_size = env.world_size if world_size is None \
+            else int(world_size)
+        if heartbeat_interval_s is None:
+            heartbeat_interval_s = float(
+                get_flags("FLAGS_gang_heartbeat_interval_s")
+                ["FLAGS_gang_heartbeat_interval_s"])
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._mu = threading.Lock()
+        self._sock: Optional[socket.socket] = None  # guarded-by: _mu
+        self._state_mu = threading.Lock()
+        self._progress: Dict[str, Any] = {          # guarded-by: _state_mu
+            "step": None, "steps": [], "fingerprint": None}
+        self._view: Dict[str, Any] = {              # guarded-by: _state_mu
+            "status": "forming", "dead": [], "manifest": None,
+            "mismatch": None}
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._degraded_noted = False
+
+    # -- connection plumbing -------------------------------------------------
+    def _dial(self, timeout_s: float = 10.0) -> socket.socket:
+        s = socket.create_connection((self._host, self._port),
+                                     timeout=timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _rpc(self, req: dict, timeout_s: float = 30.0,
+             oneshot: bool = False) -> dict:
+        """One request/response.  Cheap ops share the persistent
+        connection (lock-serialized); blocking ops (``oneshot=True``)
+        dial their own so a parked ``wait_ready`` never queues the
+        daemon's announces or the heartbeat behind it."""
+        req = dict(req)
+        req.setdefault("rank", self.rank)
+        if oneshot:
+            s = self._dial()
+            try:
+                s.settimeout(timeout_s)
+                send_frame(s, req)
+                return self._checked(recv_frame(s))
+            finally:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        with self._mu:
+            last: Optional[BaseException] = None
+            for attempt in (0, 1):        # one transparent reconnect
+                try:
+                    if self._sock is None:
+                        self._sock = self._dial()
+                    self._sock.settimeout(timeout_s)
+                    send_frame(self._sock, req)
+                    return self._checked(recv_frame(self._sock))
+                except (OSError, ConnectionError, ValueError) as e:
+                    last = e
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                    self._sock = None
+            raise ConnectionError(
+                f"gang coordinator at {self.address} unreachable: "
+                f"{last}") from last
+
+    @staticmethod
+    def _checked(resp: dict) -> dict:
+        if resp.get("ok"):
+            return resp
+        err = resp.get("error")
+        detail = resp.get("detail", "")
+        if err == "fingerprint":
+            raise GangFingerprintError(detail)
+        if err == "degraded":
+            raise GangDegradedError(detail, dead=resp.get("dead", ()))
+        if err == "timeout":
+            raise TimeoutError(detail)
+        raise RuntimeError(f"gang coordinator refused request: "
+                           f"{err}: {detail}")
+
+    def connect(self) -> "GangClient":
+        resp = self._rpc({"op": "hello", "pid": os.getpid()})
+        self._absorb_view(resp)
+        return self
+
+    def goodbye(self) -> None:
+        """Tell the coordinator this rank is departing ON PURPOSE (work
+        done / preemption drain complete).  Without it, the rank's
+        silence reads as a death and degrades the gang — a crashed or
+        SIGKILLed rank never says this, which is exactly how the
+        coordinator tells a departure from a death (the PreemptionGuard
+        sends it only on a CLEAN exit of the guarded block).  Stops the
+        heartbeat thread first so no trailing beat races the departure.
+        Best-effort: a dead coordinator at shutdown is not an error."""
+        self._hb_stop.set()
+        try:
+            self._rpc({"op": "goodbye"}, timeout_s=5.0, oneshot=True)
+        except (OSError, ConnectionError, RuntimeError):
+            pass
+
+    def close(self, goodbye: bool = True) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        if goodbye:
+            self.goodbye()
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            self._sock = None
+
+    # -- liveness plane ------------------------------------------------------
+    def start_heartbeat(self) -> "GangClient":
+        if self._hb_thread is None or not self._hb_thread.is_alive():
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name=f"pt-gang-hb-r{self.rank}")
+            self._hb_thread.start()
+        return self
+
+    def _absorb_view(self, resp: dict) -> None:
+        view = {"status": resp.get("status", "forming"),
+                "dead": list(resp.get("dead") or []),
+                "manifest": resp.get("manifest"),
+                "mismatch": resp.get("mismatch")}
+        with self._state_mu:
+            self._view = view
+        if view["status"] == "degraded" and not self._degraded_noted:
+            self._degraded_noted = True
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.instant(
+                    "gang.degraded", "gang",
+                    {"rank": self.rank, "dead": view["dead"]})
+        elif view["status"] == "ok":
+            self._degraded_noted = False
+
+    def _hb_loop(self) -> None:
+        sock: Optional[socket.socket] = None
+        while not self._hb_stop.is_set():
+            try:
+                if sock is None:
+                    sock = self._dial()
+                    sock.settimeout(
+                        max(4.0 * self.heartbeat_interval_s, 5.0))
+                with self._state_mu:
+                    payload = {"op": "heartbeat", "rank": self.rank,
+                               **self._progress}
+                send_frame(sock, payload)
+                resp = recv_frame(sock)
+                _monitor.GANG_HB_CTR.inc(1, role="client")
+                if resp.get("ok"):
+                    self._absorb_view(resp)
+            except (OSError, ConnectionError, ValueError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                sock = None               # reconnect on the next beat
+            self._hb_stop.wait(self.heartbeat_interval_s)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def set_progress(self, step: Optional[int] = None,
+                     steps=None, fingerprint: Optional[str] = None) -> None:
+        """Update what the next heartbeat carries: the rank's current
+        step, its durably-committed step list, and its collective
+        fingerprint.  ``None`` leaves a field unchanged."""
+        with self._state_mu:
+            if step is not None:
+                self._progress["step"] = int(step)
+            if steps is not None:
+                self._progress["steps"] = sorted(int(s) for s in steps)
+            if fingerprint is not None:
+                self._progress["fingerprint"] = str(fingerprint)
+
+    @property
+    def degraded(self) -> bool:
+        with self._state_mu:
+            return self._view["status"] == "degraded"
+
+    @property
+    def dead_ranks(self) -> List[int]:
+        with self._state_mu:
+            return list(self._view["dead"])
+
+    def check(self) -> None:
+        """Raise the latched cross-rank fingerprint mismatch, if any —
+        the passive (heartbeat-borne) form of the barrier refusal."""
+        with self._state_mu:
+            mm = self._view.get("mismatch")
+        if mm:
+            raise GangFingerprintError(mm["detail"])
+
+    def wait_ready(self, timeout_s: Optional[float] = None) -> bool:
+        """Park until every rank of the gang is alive again (the elastic
+        rejoin barrier).  Returns False if the deadline passes with ranks
+        still dead."""
+        if timeout_s is None:
+            from ..flags import get_flags
+            timeout_s = float(get_flags("FLAGS_gang_rejoin_timeout_s")
+                              ["FLAGS_gang_rejoin_timeout_s"])
+        with _monitor.TRACER.span("gang.wait_ready", "gang",
+                                  rank=self.rank):
+            resp = self._rpc({"op": "wait_ready", "timeout_s": timeout_s},
+                             timeout_s=timeout_s + 10.0, oneshot=True)
+        return bool(resp.get("ready"))
+
+    def step_barrier(self, step: int, fingerprint: Optional[str] = None,
+                     timeout_s: float = 60.0) -> None:
+        """Gang step barrier with collective-fingerprint enforcement.
+        Raises :class:`GangFingerprintError` (mismatch, naming both
+        ranks), :class:`GangDegradedError` (a rank died — drain and
+        ``wait_ready`` instead of entering the collective), or
+        ``TimeoutError``."""
+        if fingerprint is None:
+            with self._state_mu:
+                fingerprint = self._progress["fingerprint"]
+        with _monitor.TRACER.span("gang.step_barrier", "gang",
+                                  rank=self.rank, step=int(step)):
+            self._rpc({"op": "step_barrier", "step": int(step),
+                       "fingerprint": fingerprint,
+                       "timeout_s": timeout_s},
+                      timeout_s=timeout_s + 10.0, oneshot=True)
+
+    # -- GangRendezvous protocol (socket transport) --------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+    def announce(self, step: int, steps=None) -> None:
+        steps = sorted(int(s) for s in (steps or [step]))
+        # the heartbeat echoes this list as OBSERVABILITY (the
+        # coordinator stores it as hb_steps; the durable record the
+        # manifest commits on is this announce alone).  The heartbeat's
+        # 'step' field stays the CURRENT training step —
+        # set_progress(step=...) is the training loop's to call.
+        self.set_progress(steps=steps)
+        self._rpc({"op": "announce", "step": int(step), "steps": steps})
+
+    def peer_announcements(self) -> Dict[int, dict]:
+        resp = self._rpc({"op": "peers"})
+        return {int(r): {"step": int(d["step"]),
+                         "steps": [int(s) for s in d["steps"]]}
+                for r, d in resp["peers"].items()}
+
+    def committed_step(self) -> Optional[int]:
+        step = self._rpc({"op": "manifest"})["step"]
+        return None if step is None else int(step)
+
+    def publish(self, step: int) -> None:
+        if not self.is_leader:
+            raise RuntimeError(
+                f"rank {self.rank} tried to publish the gang manifest; "
+                "only rank 0 commits")
+        self._rpc({"op": "publish", "step": int(step)})
+
+    def commit_latest(self) -> Optional[int]:
+        if not self.is_leader:
+            return None
+        pub = self._rpc({"op": "commit_latest"}).get("published")
+        return None if pub is None else int(pub)
+
+    def wait_commit(self, step: int, timeout_s: float,
+                    poll_s: float = 0.05) -> bool:
+        if not self.is_leader:
+            raise RuntimeError("wait_commit is leader-only; other ranks "
+                               "just announce and exit")
+        resp = self._rpc({"op": "wait_commit", "step": int(step),
+                          "timeout_s": float(timeout_s)},
+                         timeout_s=float(timeout_s) + 10.0, oneshot=True)
+        return bool(resp.get("committed"))
+
+    def wait_manifest(self, step: int, timeout_s: float,
+                      poll_s: float = 0.05) -> bool:
+        resp = self._rpc({"op": "wait_manifest", "step": int(step),
+                          "timeout_s": float(timeout_s)},
+                         timeout_s=float(timeout_s) + 10.0, oneshot=True)
+        return bool(resp.get("reached"))
+
+    def status(self) -> dict:
+        """Full coordinator-side gang view (debugging / tests)."""
+        return self._rpc({"op": "status"})

@@ -89,6 +89,9 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
 # gang-commit rendezvous (file-based; see module docstring)
 # ---------------------------------------------------------------------------
 
+#: from_env's socket-backend client cache: {(address, rank): GangClient}
+_SOCKET_CLIENTS: Dict[tuple, object] = {}
+
 def format_manifest(step: int, world_size: int) -> str:
     """The ``COMMITTED <step>`` manifest body: a strict first line the
     parser keys on, plus a JSON metadata line for humans and tooling."""
@@ -153,6 +156,7 @@ class GangRendezvous:
     """
 
     MANIFEST_NAME = "MANIFEST"
+    backend = "file"
 
     def __init__(self, base_dir: str, rank: Optional[int] = None,
                  world_size: Optional[int] = None):
@@ -165,11 +169,54 @@ class GangRendezvous:
 
     @classmethod
     def from_env(cls) -> Optional["GangRendezvous"]:
-        """The launcher's contract: ``PADDLE_GANG_DIR`` + a multi-rank
-        env make a rendezvous; single-rank runs get ``None`` (no gang —
-        per-rank checkpoint semantics are already safe)."""
+        """The launcher's contract, now a backend factory: with
+        ``PADDLE_GANG_COORD`` (host:port) set, rendezvous goes through
+        the socket coordinator (``coordinator.GangClient`` — same API,
+        no shared-FS requirement, plus the liveness plane); otherwise
+        ``PADDLE_GANG_DIR`` selects the file backend.  Single-rank runs
+        get ``None`` (no gang — per-rank checkpoint semantics are
+        already safe).
+
+        An unreachable coordinator is an ERROR (after a short connect
+        retry), not a fallback: PADDLE_GANG_COORD is exported by a
+        launcher for the WHOLE gang, and one rank quietly switching to
+        the file backend (or no gang) while its peers heartbeat splits
+        the coordination plane — the silent rank reads as dead, every
+        survivor parks for a respawn that never comes, and two writers
+        race the manifest file.  A rank that dies loudly instead is
+        respawned by ``--max_restarts`` and connects on its next try."""
+        if Env().world_size <= 1:
+            return None
+        coord = os.getenv("PADDLE_GANG_COORD", "")
         base = os.getenv("PADDLE_GANG_DIR", "")
-        if not base or Env().world_size <= 1:
+        if coord:
+            from .coordinator import GangClient
+            # ONE client (= one heartbeat plane) per coordinator+rank in
+            # this process: the daemon, the guard, and resume_or_init
+            # all default to from_env(), and a second progress-less
+            # client's beats would interleave with (and overwrite) the
+            # first one's fingerprint/progress at the coordinator
+            key = (coord, Env().rank)
+            cached = _SOCKET_CLIENTS.get(key)
+            if cached is not None and not cached._hb_stop.is_set():
+                return cached
+            last: Optional[BaseException] = None
+            for delay in (0.0, 0.5, 1.5):    # brief connect retry
+                if delay:
+                    time.sleep(delay)
+                try:
+                    client = GangClient(coord).connect().start_heartbeat()
+                    _SOCKET_CLIENTS[key] = client
+                    return client
+                except (OSError, ConnectionError) as e:
+                    last = e
+            raise ConnectionError(
+                f"gang coordinator at {coord} unreachable after "
+                f"retries: {last} (PADDLE_GANG_COORD was exported for "
+                "the whole gang — refusing to silently split the "
+                "coordination plane; unset it to use the file "
+                "rendezvous)") from last
+        if not base:
             return None
         return cls(base)
 

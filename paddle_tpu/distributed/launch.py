@@ -11,14 +11,29 @@ process owns all local chips; jax.distributed federates hosts), so
 ``--nproc_per_node`` defaults to 1.  The rank-0 endpoint doubles as the
 jax.distributed coordinator address.
 
-Gang preemption: the launcher exports ``PADDLE_GANG_DIR`` (one shared
-rendezvous directory per job — see ``env.GangRendezvous``), and a
-SIGTERM/SIGINT to the launcher forwards SIGTERM to every rank, then
-WAITS up to ``--grace_secs`` for the gang to drain: each rank's
-``PreemptionGuard`` finishes its emergency checkpoint, announces it,
-and the rank-0 leader publishes the ``COMMITTED`` manifest only when
-all ranks saved the same step.  Killing the ranks immediately (the old
-behavior) is exactly how multi-host emergency saves tear.
+Gang coordination: by default (``--gang_backend socket``) the node-0
+launcher hosts a :class:`~paddle_tpu.distributed.coordinator.
+GangCoordinator` on ``started_port + world_size`` and exports
+``PADDLE_GANG_COORD`` so every rank's heartbeats, checkpoint commits,
+and barriers ride sockets — no shared filesystem needed (the manifest is
+still mirrored into ``PADDLE_GANG_DIR`` so a full job restart refuses
+torn saves).  ``--gang_backend file`` keeps the PR-4 shared-directory
+rendezvous.
+
+Elastic recovery: ``--max_restarts N`` lets the launcher respawn a rank
+that died abnormally (SIGKILL, OOM, crash) instead of tearing the job
+down.  The coordinator has already declared the rank dead (survivors
+drained and parked at the rejoin barrier); the respawned process resumes
+from the gang manifest step via ``resume_or_init``, re-admits itself
+with its ``hello``, and training continues — the gang never committed a
+step past the last all-rank-durable one, so the combined loss trajectory
+is exactly the uninterrupted one.
+
+Gang preemption (PR 4, unchanged): a SIGTERM/SIGINT to the launcher
+forwards SIGTERM to every rank, then WAITS up to ``--grace_secs`` for
+the gang to drain: each rank's ``PreemptionGuard`` finishes its
+emergency checkpoint, announces it, and the rank-0 leader publishes the
+``COMMITTED`` manifest only when all ranks saved the same step.
 """
 
 from __future__ import annotations
@@ -48,6 +63,20 @@ def _parse_args(argv=None):
                    help="shared rendezvous dir for gang checkpoint "
                         "commits (exported as PADDLE_GANG_DIR; default: "
                         "<log_dir>/gang, or a fresh temp dir)")
+    p.add_argument("--gang_backend", choices=("socket", "file"),
+                   default="socket",
+                   help="gang coordination transport: 'socket' (default) "
+                        "hosts a rank-0 TCP coordinator on the node-0 "
+                        "launcher at started_port + world_size and "
+                        "exports PADDLE_GANG_COORD (liveness plane + "
+                        "elastic recovery, no shared FS needed); 'file' "
+                        "keeps the shared-directory rendezvous")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="how many abnormal rank exits the launcher may "
+                        "absorb by respawning the rank (elastic "
+                        "recovery; the respawned rank resumes from the "
+                        "gang manifest step).  0 = any abnormal exit "
+                        "tears the job down (the old behavior)")
     p.add_argument("--grace_secs", type=float, default=60.0,
                    help="how long a SIGTERM'd launcher waits for ranks "
                         "to finish their gang-coordinated emergency "
@@ -57,23 +86,51 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _cluster_shape(args):
+    """(node_ips, world_size) — the one derivation every launch helper
+    shares, so the coordinator port, the rank envs, and the hosting
+    gate can never disagree."""
+    node_ips = args.cluster_node_ips.split(",")
+    return node_ips, len(node_ips) * args.nproc_per_node
+
+
+def gang_coord_address(args) -> str:
+    """The (derivable, launcher-independent) coordinator endpoint: node-0
+    at ``started_port + world_size`` — every node's launcher computes the
+    same address without any cross-node exchange."""
+    node_ips, world = _cluster_shape(args)
+    return f"{node_ips[0]}:{args.started_port + world}"
+
+
+def _resolve_gang_dir(args) -> str:
+    """One gang dir per launcher invocation — memoized on the args
+    namespace so the ranks' PADDLE_GANG_DIR and the coordinator's
+    manifest mirror are the SAME directory (a mkdtemp fallback resolved
+    twice would give the coordinator a manifest path no rank reads)."""
+    cached = getattr(args, "_resolved_gang_dir", None)
+    if cached is None:
+        cached = args.gang_dir or (
+            os.path.join(args.log_dir, "gang") if args.log_dir
+            else tempfile.mkdtemp(prefix="pt_gang_"))
+        args._resolved_gang_dir = cached
+    return cached
+
+
 def get_cluster_env(args):
     """Build the per-rank env dicts (ref launch.py start_procs :147)."""
-    node_ips = args.cluster_node_ips.split(",")
+    node_ips, world = _cluster_shape(args)
     nnodes = len(node_ips)
     nproc = args.nproc_per_node
-    world = nnodes * nproc
     endpoints = [f"{ip}:{args.started_port + i}"
                  for ip in node_ips for i in range(nproc)]
     node_idx = node_ips.index(args.node_ip)
-    gang_dir = args.gang_dir or (
-        os.path.join(args.log_dir, "gang") if args.log_dir
-        else tempfile.mkdtemp(prefix="pt_gang_"))
-    if nnodes > 1 and not args.gang_dir:
+    gang_dir = _resolve_gang_dir(args)
+    if nnodes > 1 and not args.gang_dir and args.gang_backend == "file":
         # every launcher invents its own default dir, so on a multi-NODE
         # job the ranks would rendezvous in per-node directories the
         # leader never reads — the gang could then never commit, and
-        # every resume would cold-start
+        # every resume would cold-start.  (The socket backend has no
+        # shared-FS requirement: ranks talk to the node-0 coordinator.)
         import warnings
         warnings.warn(
             "multi-node launch without --gang_dir: gang checkpoint "
@@ -92,8 +149,43 @@ def get_cluster_env(args):
             "FLAGS_selected_tpus": str(local),
             "TRAINING_ROLE": "TRAINER",
         }
+        if args.gang_backend == "socket" and world > 1:
+            env["PADDLE_GANG_COORD"] = gang_coord_address(args)
         envs.append(env)
     return envs
+
+
+def start_coordinator(args):
+    """Host the gang coordinator on the node-0 launcher (socket backend,
+    multi-rank jobs only).  Returns the started coordinator or None.
+    The launcher is the natural host: it outlives every rank, so rank
+    death, respawn, and the rejoin barrier all survive any trainer
+    process dying."""
+    node_ips, world = _cluster_shape(args)
+    if args.gang_backend != "socket" or world <= 1 \
+            or node_ips.index(args.node_ip) != 0:
+        return None
+    from .coordinator import GangCoordinator
+    host, _, port = gang_coord_address(args).rpartition(":")
+    return GangCoordinator(world, host=host, port=int(port),
+                           manifest_dir=_resolve_gang_dir(args)).start()
+
+
+def _spawn(args, env, log_mode="w"):
+    """Start one rank process (``log_mode='a'`` on a respawn, so the
+    restarted rank's output lands after its first life's)."""
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    full_env = dict(os.environ, **env)
+    out = None
+    if args.log_dir:
+        log_name = env.get("PADDLE_LOG_NAME",
+                           f"worker.{env['PADDLE_TRAINER_ID']}")
+        out = open(os.path.join(args.log_dir, f"{log_name}.log"),
+                   log_mode)
+    proc = subprocess.Popen(cmd, env=full_env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None)
+    return proc, out
 
 
 def start_procs(args, envs):
@@ -101,19 +193,11 @@ def start_procs(args, envs):
     procs, logs = [], []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-    for local, env in enumerate(envs):
-        cmd = [sys.executable, "-u", args.training_script] + \
-            args.training_script_args
-        full_env = dict(os.environ, **env)
-        out = None
-        if args.log_dir:
-            log_name = env.get("PADDLE_LOG_NAME",
-                               f"worker.{env['PADDLE_TRAINER_ID']}")
-            out = open(os.path.join(args.log_dir, f"{log_name}.log"), "w")
+    for env in envs:
+        proc, out = _spawn(args, env)
+        procs.append(proc)
+        if out is not None:
             logs.append(out)
-        procs.append(subprocess.Popen(cmd, env=full_env, stdout=out,
-                                      stderr=subprocess.STDOUT if out
-                                      else None))
     return procs, logs
 
 
@@ -143,27 +227,48 @@ def drain_gang(procs, grace_secs: float = 60.0):
     return clean
 
 
-def wait_procs(procs, grace_secs: float = 60.0, stop=None):
-    """Wait for all ranks; kill the gang if any rank fails (ref :256).
+def wait_procs(procs, grace_secs: float = 60.0, stop=None, args=None,
+               envs=None, max_restarts: int = 0, logs=None):
+    """Wait for all ranks; on an abnormal rank exit, either respawn it
+    (elastic: ``max_restarts`` budget left and ``args``/``envs`` given —
+    the rank resumes from the gang manifest and the coordinator re-admits
+    it at the rejoin barrier) or kill the gang (ref :256).
 
     A SIGTERM to the launcher (``stop`` flag set by the signal handler)
     or a Ctrl-C drains the gang gracefully — every rank gets SIGTERM and
     ``grace_secs`` to finish its coordinated emergency checkpoint —
     instead of orphaning ranks mid-save."""
+    restarts_left = int(max_restarts)
     try:
         while True:
             if stop is not None and stop.get("signum") is not None:
                 ok = drain_gang(procs, grace_secs)
                 raise SystemExit(0 if ok else 1)
             alive = False
-            for p in procs:
+            for i, p in enumerate(procs):
                 ret = p.poll()
                 if ret is None:
                     alive = True
                 elif ret != 0:
-                    drain_gang(procs, grace_secs)
-                    raise SystemExit(
-                        f"rank process {p.pid} exited with {ret}")
+                    if restarts_left > 0 and args is not None \
+                            and envs is not None:
+                        restarts_left -= 1
+                        sys.stderr.write(
+                            f"paddle_tpu launch: rank "
+                            f"{envs[i]['PADDLE_TRAINER_ID']} (pid "
+                            f"{p.pid}) exited {ret}; respawning "
+                            f"({restarts_left} restart(s) left) — it "
+                            "will resume from the gang manifest step\n")
+                        sys.stderr.flush()
+                        newp, out = _spawn(args, envs[i], log_mode="a")
+                        procs[i] = newp
+                        if out is not None and logs is not None:
+                            logs.append(out)
+                        alive = True
+                    else:
+                        drain_gang(procs, grace_secs)
+                        raise SystemExit(
+                            f"rank process {p.pid} exited with {ret}")
             if not alive:
                 return
             time.sleep(0.5)
@@ -175,6 +280,7 @@ def wait_procs(procs, grace_secs: float = 60.0, stop=None):
 def launch(argv=None):
     args = _parse_args(argv)
     envs = get_cluster_env(args)
+    coord = start_coordinator(args)
     procs, logs = start_procs(args, envs)
     # a scheduler preempts the LAUNCHER: forward + drain, don't die and
     # leave ranks checkpointing into a gang that can never commit
@@ -186,10 +292,14 @@ def launch(argv=None):
     except ValueError:          # not the main thread (embedded use)
         pass
     try:
-        wait_procs(procs, grace_secs=args.grace_secs, stop=stop)
+        wait_procs(procs, grace_secs=args.grace_secs, stop=stop,
+                   args=args, envs=envs,
+                   max_restarts=args.max_restarts, logs=logs)
     finally:
         if old is not None:
             signal.signal(signal.SIGTERM, old)
+        if coord is not None:
+            coord.stop()
         for f in logs:
             f.close()
 

@@ -102,6 +102,34 @@ _DEFAULTS: Dict[str, Any] = {
     # rank to announce the same emergency-checkpoint step before giving
     # up on publishing the COMMITTED manifest for it
     "FLAGS_gang_commit_timeout_s": 30.0,
+    # socket gang coordinator (distributed/coordinator.py): heartbeat
+    # cadence of every rank's GangClient, and how long a rank may miss
+    # heartbeats before the coordinator declares it dead and degrades
+    # the gang (survivors drain and park instead of hanging inside a
+    # collective).  The timeout should comfortably exceed the longest
+    # legitimate heartbeat gap — a cold XLA compile does NOT block the
+    # heartbeat thread, so a few seconds of slack is plenty.
+    "FLAGS_gang_heartbeat_interval_s": 0.5,
+    "FLAGS_gang_heartbeat_timeout_s": 10.0,
+    # elastic rejoin barrier: how long a surviving rank parks in
+    # GangClient.wait_ready() for the launcher (--max_restarts) to
+    # respawn a dead rank before giving up
+    "FLAGS_gang_rejoin_timeout_s": 300.0,
+    # chunked snapshot capture (resilience.CheckpointDaemon): snapshot
+    # persistables in groups of at most this many MiB, materializing
+    # each group to host before copying the next — bounds the extra HBM
+    # of the capture window at the chunk size instead of doubling the
+    # model.  Tradeoff: the device→host sync of each chunk lands on the
+    # training thread.  0 (default) = single-pass device-side copies
+    # (fastest capture, transient 2x HBM).
+    "FLAGS_checkpoint_capture_chunk_mb": 0,
+    # adaptive daemon cadence: when > 0, a checkpoint capture is
+    # deferred until the last observed save time is at most this
+    # fraction of the gap since the previous capture — a writer slower
+    # than the cadence stretches the effective interval instead of
+    # queueing (and dropping) snapshots.  Each stretched window bumps
+    # paddle_tpu_checkpoint_cadence_stretched_total.  0 disables.
+    "FLAGS_checkpoint_cadence_stretch_frac": 0.0,
     # program verifier (paddle_tpu.analysis.verifier): static checks
     # (def-before-use, dangling feed/fetch, shape consistency, dead ops,
     # use-after-donate, int64 feed-wrap classification, collective

@@ -371,6 +371,37 @@ REGISTRY = MetricsRegistry()
 
 
 # ---------------------------------------------------------------------------
+# gang liveness plane (distributed/coordinator.py).  Declared HERE rather
+# than in the coordinator module because both sides of the socket bump the
+# same families — the coordinator server (hosted by the launcher or a
+# rank-0 side thread) and every rank's GangClient — and the launcher
+# process imports monitor anyway for its export path.
+# ---------------------------------------------------------------------------
+
+GANG_HB_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_heartbeats_total",
+    "gang heartbeats, by role ('client' = a rank's GangClient sent one, "
+    "'coordinator' = the coordinator served one)", ("role",))
+GANG_DEATH_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_rank_deaths_total",
+    "ranks declared dead by the coordinator's liveness scan (missed "
+    "FLAGS_gang_heartbeat_timeout_s of heartbeats)")
+GANG_REJOIN_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_rejoins_total",
+    "previously-dead ranks re-admitted to the gang (the elastic "
+    "--max_restarts respawn path)")
+GANG_DEGRADED_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_gang_degraded",
+    "1 while at least one rank of the gang is dead (coordinator-side "
+    "view; survivors should be draining/parked, not training)")
+GANG_FP_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_fingerprint_mismatch_total",
+    "cross-rank collective-fingerprint mismatches detected (heartbeat "
+    "exchange or step-barrier refusal) — each one is a divergence that "
+    "would otherwise hang inside a collective")
+
+
+# ---------------------------------------------------------------------------
 # step tracer
 # ---------------------------------------------------------------------------
 

@@ -815,7 +815,14 @@ class CheckpointDaemon:
     seconds trigger is still evaluated at step boundaries — a mid-step
     snapshot would capture half-updated state).  Only the LATEST pending
     snapshot is kept when the writer falls behind: checkpoints are a
-    recovery floor, not a log.
+    recovery floor, not a log.  Two tuning knobs ride along:
+    ``FLAGS_checkpoint_cadence_stretch_frac`` adapts the cadence to the
+    observed save latency (a save slower than that fraction of the
+    interval stretches the effective interval, bumping
+    ``paddle_tpu_checkpoint_cadence_stretched_total``), and
+    ``FLAGS_checkpoint_capture_chunk_mb`` bounds the capture window's
+    extra HBM by materializing the snapshot in chunks (see
+    :meth:`capture`).
 
     Wiring options::
 
@@ -836,10 +843,13 @@ class CheckpointDaemon:
     def __init__(self, checkpoint, program=None, scope=None,
                  interval_steps: Optional[int] = None,
                  interval_secs: Optional[float] = None,
-                 gang=None):
+                 gang=None, capture_chunk_mb: Optional[int] = None,
+                 cadence_stretch_frac: Optional[float] = None):
         from .flags import get_flags
         fl = get_flags(["FLAGS_checkpoint_interval_steps",
-                        "FLAGS_checkpoint_interval_secs"])
+                        "FLAGS_checkpoint_interval_secs",
+                        "FLAGS_checkpoint_capture_chunk_mb",
+                        "FLAGS_checkpoint_cadence_stretch_frac"])
         self.checkpoint = checkpoint
         self.program = program
         self.scope = scope
@@ -849,10 +859,22 @@ class CheckpointDaemon:
         self.interval_secs = (
             float(fl["FLAGS_checkpoint_interval_secs"])
             if interval_secs is None else float(interval_secs))
+        self.capture_chunk_mb = (
+            int(fl["FLAGS_checkpoint_capture_chunk_mb"])
+            if capture_chunk_mb is None else int(capture_chunk_mb))
+        self.cadence_stretch_frac = (
+            float(fl["FLAGS_checkpoint_cadence_stretch_frac"])
+            if cadence_stretch_frac is None
+            else float(cadence_stretch_frac))
         if gang is None:
             try:
                 from .distributed.env import GangRendezvous
                 gang = GangRendezvous.from_env()
+            except ConnectionError:
+                # PADDLE_GANG_COORD exported but unreachable: raising is
+                # the contract (a silent gang-less rank splits the
+                # coordination plane — see from_env)
+                raise
             except Exception:
                 gang = None
         self.gang = gang
@@ -864,6 +886,8 @@ class CheckpointDaemon:
         self._last_capture_step = 0
         self._last_capture_t = time.monotonic()
         self._last_committed: Optional[int] = None
+        self._last_save_s = 0.0  # guarded-by: _mu  (daemon writes, due() reads)
+        self._stretch_noted = False     # training thread only
         self._thread: Optional[threading.Thread] = None
         self._hooked: list = []
         self._auto_step = 0
@@ -896,14 +920,37 @@ class CheckpointDaemon:
 
     # -- training-thread side ------------------------------------------------
     def due(self, step: int) -> bool:
-        if self.interval_steps and \
-                step - self._last_capture_step >= self.interval_steps:
-            return True
-        if self.interval_secs and \
-                time.monotonic() - self._last_capture_t \
-                >= self.interval_secs:
-            return True
-        return False
+        base = bool(
+            (self.interval_steps
+             and step - self._last_capture_step >= self.interval_steps)
+            or (self.interval_secs
+                and time.monotonic() - self._last_capture_t
+                >= self.interval_secs))
+        if not base:
+            return False
+        # adaptive cadence: a writer slower than the configured interval
+        # stretches the effective interval instead of queueing snapshots
+        # the daemon will drop anyway — the last observed save must be at
+        # most FLAGS_checkpoint_cadence_stretch_frac of the capture gap
+        if self.cadence_stretch_frac > 0:
+            with self._mu:
+                last_save_s = self._last_save_s
+            if last_save_s > 0:
+                need = last_save_s / self.cadence_stretch_frac
+                if time.monotonic() - self._last_capture_t < need:
+                    if not self._stretch_noted:
+                        self._stretch_noted = True
+                        from . import checkpoint as _ckpt
+                        _ckpt.STRETCH_CTR.inc()
+                        if _monitor.TRACER.enabled:
+                            _monitor.TRACER.instant(
+                                "checkpoint.cadence_stretched",
+                                "checkpoint",
+                                {"step": int(step),
+                                 "save_s": round(last_save_s, 3),
+                                 "stretched_to_s": round(need, 3)})
+                    return False
+        return True
 
     def step_completed(self, step: int, scope=None) -> bool:
         """Step-boundary notification (training thread).  One int compare
@@ -921,7 +968,13 @@ class CheckpointDaemon:
     def capture(self, step: int, scope=None, kind: str = "daemon") -> None:
         """Snapshot every persistable at a (consistent) step boundary —
         device arrays via async on-device copies, host arrays via host
-        copies.  No device→host sync happens on this thread."""
+        copies.  Default mode keeps every copy device-side (no sync on
+        this thread) at the cost of transiently doubling the model's
+        HBM during the capture window; with
+        ``FLAGS_checkpoint_capture_chunk_mb`` > 0, copies are taken in
+        bounded-size groups and each group is materialized to host
+        before the next is copied, so the extra HBM is capped at the
+        chunk size (the per-chunk device→host sync lands here)."""
         from .framework.core import default_main_program
         from .framework.scope import global_scope
         from .io import get_program_persistable_vars
@@ -930,7 +983,23 @@ class CheckpointDaemon:
         t0 = time.perf_counter()
         program = self.program or default_main_program()
         scope = scope or self.scope or global_scope()
+        chunk_bytes = int(self.capture_chunk_mb) << 20
         state: Dict[str, Any] = {}
+        group: List[tuple] = []
+        group_bytes = 0
+        chunks = 0
+
+        def _flush_group():
+            nonlocal group, group_bytes, chunks
+            for name, arr in group:
+                # materializing frees the device copy before the next
+                # chunk is taken — THIS is what bounds the HBM doubling
+                state[name] = np.asarray(arr)
+            if group:
+                chunks += 1
+            group = []
+            group_bytes = 0
+
         for v in get_program_persistable_vars(program):
             val = scope.find_var(v.name)
             if val is None:
@@ -939,17 +1008,30 @@ class CheckpointDaemon:
                     "scope; did you run the startup program before "
                     "enabling the checkpoint daemon?")
             if isinstance(val, jax.Array):
-                state[v.name] = jnp.copy(val)
+                if not chunk_bytes:
+                    state[v.name] = jnp.copy(val)
+                    continue
+                nbytes = int(getattr(val, "nbytes", 0) or 0)
+                if group and group_bytes + nbytes > chunk_bytes:
+                    _flush_group()
+                group.append((v.name, jnp.copy(val)))
+                group_bytes += nbytes
             else:
                 state[v.name] = np.array(val, copy=True)
+        _flush_group()
         with self._mu:
             self._pending = (int(step), state, kind)
             self._last_capture_step = int(step)
             self._last_capture_t = time.monotonic()
+        self._stretch_noted = False
         if _monitor.TRACER.enabled:
+            args = {"step": int(step), "kind": kind}
+            if chunk_bytes:
+                args["chunks"] = chunks
+                args["chunk_mb"] = int(self.capture_chunk_mb)
             _monitor.TRACER.add_complete(
                 "checkpoint.capture", "checkpoint", t0,
-                time.perf_counter(), {"step": int(step), "kind": kind})
+                time.perf_counter(), args)
         self._wake.set()
 
     # -- daemon-thread side --------------------------------------------------
@@ -972,8 +1054,10 @@ class CheckpointDaemon:
     def _save(self, step: int, state: Dict[str, Any], kind: str) -> None:
         # materialize the device-side copies: THIS is where the
         # device→host sync lands, a thread the training loop never waits
-        # on.  checkpoint.save_arrays then rides orbax's async writer
+        # on (already host arrays in chunked-capture mode).
+        # checkpoint.save_arrays then rides orbax's async writer
         # (plus the checkpoint.write retry/injection plane).
+        t_save0 = time.monotonic()
         host = {name: np.asarray(v) for name, v in state.items()}
         if not self.checkpoint.save_arrays(step, host, force=True,
                                            kind=kind):
@@ -986,6 +1070,9 @@ class CheckpointDaemon:
             self.checkpoint.wait_until_finished()
         with self._mu:
             self._last_committed = int(step)
+            # observed end-to-end save time (materialize + write +
+            # durable commit) feeds the adaptive cadence in due()
+            self._last_save_s = time.monotonic() - t_save0
         if _monitor.TRACER.enabled:
             _monitor.TRACER.instant(
                 "checkpoint.committed", "checkpoint",
@@ -1131,6 +1218,11 @@ class PreemptionGuard:
             try:
                 from .distributed.env import GangRendezvous
                 gang = GangRendezvous.from_env()
+            except ConnectionError:
+                # PADDLE_GANG_COORD exported but unreachable: raising is
+                # the contract (a silent gang-less rank splits the
+                # coordination plane — see from_env)
+                raise
             except Exception:
                 gang = None
         self.gang = gang
@@ -1325,6 +1417,15 @@ class PreemptionGuard:
                     pass
             self._old.clear()
             self._note_signal()
+        if et is None and hasattr(self.gang, "goodbye"):
+            # socket gang: a CLEAN exit of the guarded block (finished,
+            # or preemption fully drained) is an orderly DEPARTURE —
+            # without it the rank's silence reads as a death and parks
+            # every peer at the rejoin barrier for a respawn that never
+            # comes.  An exception propagating through the guard
+            # deliberately does NOT say goodbye: a crashed rank IS dead
+            # (the launcher respawns it; survivors should drain).
+            self.gang.goodbye()
         if et is None and self.preempted and self.exit_on_preempt:
             raise SystemExit(self.exit_code)
         return False
@@ -1359,6 +1460,8 @@ def resume_or_init(checkpoint, executor, startup_program=None,
         try:
             from .distributed.env import GangRendezvous
             gang = GangRendezvous.from_env()
+        except ConnectionError:
+            raise
         except Exception:
             gang = None
     startup = startup_program or default_startup_program()
@@ -1416,6 +1519,17 @@ def _resume_gang(checkpoint, gang, main_program, scope) -> int:
         # indices ≤ its latest step, so a resumed run could otherwise
         # never checkpoint again until it re-passed the torn step
         checkpoint.prune_after(committed)
+    try:
+        # re-announce the POST-prune holdings: the rank's pre-death
+        # announcement may still list the just-pruned steps, and a
+        # leader intersecting against it could commit a manifest step
+        # this rank no longer has on disk
+        steps = checkpoint.all_steps() \
+            if hasattr(checkpoint, "all_steps") else [committed]
+        gang.announce(committed, steps=steps or [committed])
+    except Exception:
+        warnings.warn("gang re-announce after torn-step prune failed; "
+                      "the next daemon commit will refresh it")
     checkpoint.restore(committed, program=main_program, scope=scope)
     if _monitor.TRACER.enabled:
         _monitor.TRACER.instant("preemption.resume", "resilience",

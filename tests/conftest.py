@@ -43,6 +43,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu_hw: runs on the real TPU chip (needs "
         "PADDLE_TPU_TEST_HW=1)")
+    config.addinivalue_line(
+        "markers", "slow: multi-minute subprocess scenarios excluded "
+        "from the quick tier (-m 'not slow'); tools/ci.sh runs them")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,23 +1,24 @@
 """Subprocess runner for the gang-coordinated checkpoint tests.
 
-One rank of a (file-rendezvous) gang: trains the same deterministic
+One rank of a gang (file rendezvous or socket coordinator — selected by
+the env, see ``GangRendezvous.from_env``): trains the same deterministic
 linear-regression loop as ``resilience_train_runner.py`` with a
 background :class:`CheckpointDaemon` committing every
 ``GANG_CKPT_INTERVAL`` steps and announcing to the gang; rank 0 publishes
 the ``COMMITTED`` manifest.  Prints per step ``STEP <i> LOSS <repr>``
 (repr round-trips float32 exactly) and appends completed step indices to
-a progress file the parent polls.
+a per-rank progress file (``<PROGRESS_FILE>.r<rank>``) the parent polls.
 
 Usage::
 
     python gang_train_runner.py CKPT_ROOT TOTAL_STEPS PROGRESS_FILE \
         [SLEEP_PER_STEP]
 
-Env contract (set by the parent test):
+Env contract (set by the parent test or the launcher):
 
 - ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` / ``PADDLE_GANG_DIR``
-  — the launcher's gang contract; each rank checkpoints into
-  ``CKPT_ROOT/rank_<id>``.
+  / ``PADDLE_GANG_COORD`` — the launcher's gang contract; each rank
+  checkpoints into ``CKPT_ROOT/rank_<id>``.
 - ``GANG_CKPT_INTERVAL`` — daemon cadence in steps (default 2).
 - ``GANG_EMERGENCY_HANG=1`` — on preemption, make the emergency
   checkpoint write hang (fault-inject ``checkpoint.write`` in hang
@@ -27,6 +28,21 @@ Env contract (set by the parent test):
   completed-step count is NOT a multiple of N (makes the emergency step
   provably un-announceable by a rank whose cadence is N — the parent
   uses it to force a deterministic torn reject).
+- ``GANG_SELF_KILL=RANK:STEP`` — rank RANK SIGKILLs itself at the top
+  of step STEP, exactly once per CKPT_ROOT (a marker file arms it):
+  the elastic-recovery scenario, run under ``launch.py
+  --max_restarts`` which respawns the rank.
+- ``GANG_FP_OVERRIDE`` — report this string as the rank's collective
+  fingerprint on the socket liveness plane (tests force a cross-rank
+  mismatch with it).
+
+Under the socket backend the loop also exercises the liveness plane:
+every step updates the heartbeat payload (current step, committed list,
+collective fingerprint), and when the coordinator reports the gang
+degraded (a peer died) the rank drains its in-flight steps through the
+guard and PARKS in ``wait_ready`` until the launcher respawns the peer —
+printing ``GANG_DEGRADED dead=[...]`` / ``GANG_READY 1`` around the
+park, so the parent can assert the survivor actually took that path.
 
 On SIGTERM the guard drains, commits the last complete step, announces
 it, and (rank 0) runs the gang barrier; exit 0.  A rerun with the same
@@ -36,6 +52,7 @@ CKPT_ROOT resumes every rank from the manifest step via
 """
 
 import os
+import signal
 import sys
 import time
 
@@ -67,6 +84,12 @@ def main():
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     interval = int(os.environ.get("GANG_CKPT_INTERVAL", "2"))
     avoid = int(os.environ.get("GANG_AVOID_MULTIPLE", "0"))
+    progress = f"{progress}.r{rank}"
+    kill_rank, kill_step = -1, -1
+    if os.environ.get("GANG_SELF_KILL"):
+        kr, _, ks = os.environ["GANG_SELF_KILL"].partition(":")
+        kill_rank, kill_step = int(kr), int(ks)
+    kill_marker = os.path.join(root, f"killed_rank_{rank}")
 
     pt.default_startup_program().random_seed = 7
     pt.default_main_program().random_seed = 7
@@ -79,6 +102,20 @@ def main():
 
     exe = Executor()
     gang = GangRendezvous.from_env()
+    socket_gang = gang is not None and \
+        getattr(gang, "backend", "file") == "socket"
+    if socket_gang:
+        print(f"GANG_BACKEND socket {gang.address}", flush=True)
+        fp = os.environ.get("GANG_FP_OVERRIDE")
+        if not fp:
+            try:
+                from paddle_tpu.analysis.verifier import \
+                    collective_fingerprint
+                fp = collective_fingerprint(pt.default_main_program())
+            except Exception:
+                fp = None
+        if fp:
+            gang.set_progress(fingerprint=fp)
     ckpt = CheckpointManager(os.path.join(root, f"rank_{rank}"),
                              max_to_keep=50)
     before = monitor.counter_totals()
@@ -99,11 +136,23 @@ def main():
                          program=pt.default_main_program(),
                          daemon=daemon, gang=gang, exit_code=0) as guard:
         for step in range(start, total):
+            if rank == kill_rank and step == kill_step and \
+                    not os.path.exists(kill_marker):
+                # arm-once marker BEFORE the kill: the respawned rank
+                # must not re-kill itself when it re-reaches this step
+                with open(kill_marker, "w") as f:
+                    f.write(str(step))
+                    f.flush()
+                    os.fsync(f.fileno())
+                print(f"SELF_KILL {step}", flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
             xv, yv = batch(step)
             out, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
             print(f"STEP {step} LOSS {float(np.asarray(out).ravel()[0])!r}",
                   flush=True)
             guard.completed_step(step + 1)
+            if socket_gang:
+                gang.set_progress(step=step + 1)
             if os.environ.get("GANG_SYNC_COMMITS") and \
                     daemon._last_capture_step == step + 1:
                 # test mode: make every cadence commit deterministic so
@@ -117,6 +166,17 @@ def main():
                 os.fsync(f.fileno())
             if pause:
                 time.sleep(pause)
+            if socket_gang and gang.degraded:
+                # a peer died: drain in-flight steps (never park inside
+                # a collective) and wait at the rejoin barrier for the
+                # launcher to respawn it
+                print(f"GANG_DEGRADED dead={gang.dead_ranks}", flush=True)
+                guard.drain()
+                ok = gang.wait_ready()
+                print(f"GANG_READY {int(bool(ok))}", flush=True)
+                if not ok:
+                    raise SystemExit(
+                        "gang never reconverged; aborting rank")
             if guard.preempted:
                 if avoid and (step + 1) % avoid == 0:
                     continue     # force an un-announceable emergency step

@@ -1,0 +1,609 @@
+"""Socket gang coordinator: framing, liveness, fingerprints, backend
+parity with the file rendezvous, and the elastic kill-9 contract.
+
+The end-to-end test drives the REAL launcher (``launch.py
+--max_restarts``): rank 1 SIGKILLs itself mid-training, the coordinator
+declares it dead, the surviving rank drains and parks at the rejoin
+barrier, the launcher respawns rank 1, it resumes from the gang manifest
+step, and the combined per-step loss trajectory exactly equals an
+uninterrupted baseline.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.distributed.coordinator import (GangClient,
+                                                GangCoordinator,
+                                                GangDegradedError,
+                                                GangFingerprintError,
+                                                recv_frame, send_frame)
+from paddle_tpu.distributed.env import GangRendezvous
+
+_RUNNER = os.path.join(os.path.dirname(__file__), "gang_train_runner.py")
+
+
+def _totals():
+    return monitor.counter_totals()
+
+
+def _delta(before, after, name):
+    return after.get(name, 0) - before.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def test_frame_round_trip_and_caps():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "hello", "rank": 3, "blob": "x" * 4096,
+               "nested": {"steps": [1, 2, 3]}}
+        send_frame(a, msg)
+        assert recv_frame(b) == msg
+        # an oversized length prefix is a protocol error, not a 2 GiB
+        # allocation
+        b.sendall((1 << 30).to_bytes(4, "big"))
+        with pytest.raises(ValueError, match="cap"):
+            recv_frame(a)
+        # a closed peer reads as ConnectionError (not a hang / garbage)
+        a.close()
+        with pytest.raises(ConnectionError):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_oversized_send_refused():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(ValueError, match="cap"):
+            send_frame(a, {"blob": "x" * (17 << 20)})
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# liveness plane
+# ---------------------------------------------------------------------------
+
+def _gang(world=2, timeout=0.6, hb=0.1):
+    coord = GangCoordinator(world_size=world,
+                            heartbeat_timeout_s=timeout).start()
+    clients = [GangClient(coord.address, rank=r, world_size=world,
+                          heartbeat_interval_s=hb)
+               .connect().start_heartbeat() for r in range(world)]
+    return coord, clients
+
+
+def test_heartbeat_timeout_declares_dead_then_rejoin(monkeypatch):
+    before = _totals()
+    coord, (c0, c1) = _gang()
+    try:
+        deadline = time.monotonic() + 5
+        while coord.address and time.monotonic() < deadline:
+            if c0.status()["status"] == "ok":
+                break
+            time.sleep(0.02)
+        assert c0.status()["status"] == "ok"
+        assert not c0.degraded
+        # stop rank 1's heartbeats WITHOUT a goodbye (a SIGKILL says
+        # nothing): after the timeout the coordinator must declare it
+        # dead and degrade the gang
+        c1.close(goodbye=False)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not c0.degraded:
+            time.sleep(0.02)
+        assert c0.degraded
+        assert c0.dead_ranks == [1]
+        # parking with the rank still dead times out honestly
+        assert c0.wait_ready(timeout_s=0.3) is False
+        # a new process for rank 1 (the launcher's respawn) re-admits it
+        c1b = GangClient(coord.address, rank=1, world_size=2,
+                         heartbeat_interval_s=0.1)
+        c1b.connect().start_heartbeat()
+        try:
+            assert c0.wait_ready(timeout_s=5) is True
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and c0.degraded:
+                time.sleep(0.02)
+            assert not c0.degraded
+            st = c0.status()
+            assert st["ranks"]["1"]["deaths"] == 1
+            assert st["ranks"]["1"]["joins"] == 2
+        finally:
+            c1b.close()
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+    after = _totals()
+    assert _delta(before, after, "paddle_tpu_gang_rank_deaths_total") == 1
+    assert _delta(before, after, "paddle_tpu_gang_rejoins_total") == 1
+    assert _delta(before, after, "paddle_tpu_gang_heartbeats_total") > 0
+
+
+def test_barrier_refuses_on_dead_rank_instead_of_hanging():
+    coord, (c0, c1) = _gang()
+    try:
+        c1.close(goodbye=False)         # rank 1 goes silent (SIGKILL)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not c0.degraded:
+            time.sleep(0.02)
+        # the survivor's barrier is REFUSED with the dead ranks named —
+        # the alternative is the silent collective hang this PR removes
+        with pytest.raises(GangDegradedError) as ei:
+            c0.step_barrier(7, "fp", timeout_s=5)
+        assert ei.value.dead == [1]
+    finally:
+        c0.close()
+        coord.stop()
+
+
+def test_clean_goodbye_is_a_departure_not_a_death():
+    """A rank that finishes its steps and exits cleanly says goodbye:
+    the gang must NOT degrade (its peers keep training; parking for a
+    respawn that will never come is the bug this op exists to avoid)."""
+    before = _totals()
+    coord, (c0, c1) = _gang()
+    try:
+        c1.close()                      # orderly departure (goodbye)
+        time.sleep(0.8)                 # > the 0.6 s heartbeat timeout
+        assert not c0.degraded
+        assert c0.dead_ranks == []
+        st = c0.status()
+        assert st["status"] == "ok"
+        assert st["ranks"]["1"]["finished"] is True
+        # the departed rank's peers never park: wait_ready is immediate
+        assert c0.wait_ready(timeout_s=1.0) is True
+    finally:
+        c0.close()
+        coord.stop()
+    after = _totals()
+    assert _delta(before, after, "paddle_tpu_gang_rank_deaths_total") == 0
+
+
+def test_heartbeat_progress_never_satisfies_commit_barriers():
+    """The manifest must commit only on DURABLE announcements: a rank's
+    heartbeat carries the step it is TRAINING — exactly the step it has
+    not saved — so letting it satisfy wait_commit/commit_latest would
+    re-introduce the torn-save the gang protocol exists to refuse."""
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_progress(step=8)
+        c1.set_progress(step=8)
+        deadline = time.monotonic() + 5     # heartbeats delivered
+        while time.monotonic() < deadline:
+            st = c0.status()["ranks"]
+            if all(st.get(str(r), {}).get("cur_step") == 8
+                   for r in (0, 1)):
+                break
+            time.sleep(0.02)
+        # both ranks' hearts say 8, but only step 4 is durably announced
+        c0.announce(4)
+        c1.announce(4)
+        assert c0.wait_commit(8, timeout_s=0.4) is False
+        assert c0.committed_step() is None
+        assert c0.commit_latest() == 4
+        assert c0.wait_commit(4, timeout_s=1.0) is True
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_guard_goodbye_on_clean_exit_only():
+    """The PreemptionGuard says goodbye on a CLEAN exit of the guarded
+    block; an exception propagating through it must NOT — a crashed
+    rank is a death the liveness plane should see (the launcher
+    respawns it), not an orderly departure."""
+    from paddle_tpu.resilience import PreemptionGuard
+
+    class FakeGang:
+        goodbyes = 0
+
+        def goodbye(self):
+            self.goodbyes += 1
+
+    g = FakeGang()
+    with PreemptionGuard(gang=g, exit_on_preempt=False):
+        pass
+    assert g.goodbyes == 1
+    g2 = FakeGang()
+    with pytest.raises(ValueError):
+        with PreemptionGuard(gang=g2, exit_on_preempt=False):
+            raise ValueError("rank crashed")
+    assert g2.goodbyes == 0
+
+
+def test_announce_does_not_resurrect_a_departed_rank():
+    """A departed rank's trailing announce (the daemon's final commit
+    lands after the guard's goodbye) must update the durable record
+    WITHOUT re-admitting the rank — only a hello does that."""
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c1.announce(2)
+        c1.goodbye()
+        c1.announce(4)                   # trailing durable record
+        st = c0.status()
+        assert st["ranks"]["1"]["finished"] is True
+        assert st["ranks"]["1"]["steps"] == [4]
+        assert not c0.degraded
+        c0.announce(4)
+        assert c0.commit_latest() == 4   # the record still counts
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_rejoin_clears_stale_durable_record():
+    """A respawned rank prunes its torn steps BEFORE re-announcing, so
+    the coordinator must drop its pre-death announcement at the rejoin
+    hello — a leader intersecting against the stale list could commit a
+    manifest step the rank no longer holds on disk."""
+    coord, (c0, c1) = _gang()
+    try:
+        c1.announce(6, steps=[2, 4, 6])
+        c1.close(goodbye=False)             # SIGKILL
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not c0.degraded:
+            time.sleep(0.02)
+        # respawn: hello clears the stale record...
+        c1b = GangClient(coord.address, rank=1, world_size=2,
+                         heartbeat_interval_s=0.1)
+        c1b.connect().start_heartbeat()
+        try:
+            c0.announce(6, steps=[2, 4, 6])
+            # ...so the leader CANNOT commit 6 off the dead rank's list
+            assert c0.commit_latest() is None
+            # the respawned rank's post-prune re-announce re-enables it
+            c1b.announce(4, steps=[2, 4])
+            assert c0.commit_latest() == 4
+        finally:
+            c1b.close()
+    finally:
+        c0.close()
+        coord.stop()
+
+
+def test_barrier_refuses_immediately_on_departed_rank():
+    """A peer that said goodbye can never arrive: the barrier must
+    refuse NOW with the real reason, not stall the full timeout and
+    mis-diagnose a slow rank."""
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c1.close()                       # orderly departure
+        t0 = time.monotonic()
+        with pytest.raises(GangDegradedError, match="departed"):
+            c0.step_barrier(3, "fp", timeout_s=30)
+        assert time.monotonic() - t0 < 5
+    finally:
+        c0.close()
+        coord.stop()
+
+
+def test_coordinator_restart_same_object():
+    coord = GangCoordinator(world_size=1, heartbeat_timeout_s=30).start()
+    c = GangClient(coord.address, rank=0, world_size=1).connect()
+    c.publish(3)
+    c.close(goodbye=False)
+    coord.stop()
+    coord.start()                        # same object, same port
+    c2 = GangClient(coord.address, rank=0, world_size=1).connect()
+    try:
+        assert c2.status()["ok"]
+    finally:
+        c2.close()
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# collective-fingerprint exchange
+# ---------------------------------------------------------------------------
+
+def test_step_barrier_fingerprint_mismatch_names_both_ranks():
+    before = _totals()
+    coord, (c0, c1) = _gang()
+    errs = {}
+
+    def arrive(c, fp):
+        try:
+            c.step_barrier(3, fp, timeout_s=10)
+        except Exception as e:       # noqa: BLE001 — recorded for assert
+            errs[c.rank] = e
+    try:
+        t0 = threading.Thread(target=arrive, args=(c0, "sha1:aaaa"),
+                              daemon=True)
+        t0.start()
+        time.sleep(0.15)             # rank 0 is parked at the barrier
+        arrive(c1, "sha1:bbbb")
+        t0.join(5)
+        assert set(errs) == {0, 1}
+        for e in errs.values():
+            assert isinstance(e, GangFingerprintError)
+            msg = str(e)
+            assert "rank 0" in msg and "rank 1" in msg
+            assert "sha1:aaaa" in msg and "sha1:bbbb" in msg
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+    after = _totals()
+    assert _delta(before, after,
+                  "paddle_tpu_gang_fingerprint_mismatch_total") >= 1
+
+
+def test_step_barrier_releases_on_matching_fingerprints():
+    coord, (c0, c1) = _gang()
+    try:
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(c0.step_barrier(5, "sha1:same")),
+            daemon=True)
+        t.start()
+        c1.step_barrier(5, "sha1:same", timeout_s=5)
+        t.join(5)
+        assert not t.is_alive()
+        # a missing fingerprint (rank without collectives verified yet)
+        # does not poison the comparison
+        t = threading.Thread(
+            target=lambda: done.append(c0.step_barrier(6, None)),
+            daemon=True)
+        t.start()
+        c1.step_barrier(6, "sha1:same", timeout_s=5)
+        t.join(5)
+        assert not t.is_alive()
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_heartbeat_fingerprint_mismatch_latches_into_check():
+    coord, (c0, c1) = _gang()
+    try:
+        c0.set_progress(step=1, fingerprint="sha1:aaaa")
+        c1.set_progress(step=1, fingerprint="sha1:bbbb")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                c0.check()
+            except GangFingerprintError:
+                break
+            time.sleep(0.02)
+        with pytest.raises(GangFingerprintError, match="rank 0.*rank 1"):
+            c0.check()
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# GangRendezvous protocol parity: file backend vs socket backend
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["file", "socket"])
+def rendezvous_pair(request, tmp_path):
+    """(g0, g1, cleanup) — the same two-rank rendezvous over either
+    backend, so one test body asserts protocol parity."""
+    if request.param == "file":
+        g0 = GangRendezvous(str(tmp_path), rank=0, world_size=2)
+        g1 = GangRendezvous(str(tmp_path), rank=1, world_size=2)
+        yield g0, g1
+        return
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    g0 = GangClient(coord.address, rank=0, world_size=2).connect()
+    g1 = GangClient(coord.address, rank=1, world_size=2).connect()
+    yield g0, g1
+    g0.close()
+    g1.close()
+    coord.stop()
+
+
+def test_rendezvous_protocol_parity(rendezvous_pair):
+    """The exact sequence test_gang_rendezvous_announce_and_commit runs
+    on the file backend must behave identically over the socket."""
+    g0, g1 = rendezvous_pair
+    assert g0.is_leader and not g1.is_leader
+    assert g0.committed_step() is None
+    g0.announce(4, steps=[2, 4])
+    assert g0.commit_latest() is None            # rank 1 not announced
+    g1.announce(4, steps=[4])
+    assert g0.commit_latest() == 4
+    assert g1.committed_step() == 4
+    assert g0.commit_latest() is None            # no advance, no re-publish
+    g0.announce(6, steps=[2, 4, 6])
+    assert g0.commit_latest() is None            # rank 1 lacks 6
+    g1.announce(6, steps=[4, 6])
+    assert g0.commit_latest() == 6
+    # blocking emergency barrier: strict equality on the latest step
+    g1.announce(8, steps=[4, 6, 8])
+    assert not g0.wait_commit(8, timeout_s=0.2)  # rank 0 itself is at 6
+    g0.announce(8, steps=[6, 8])
+    assert g0.wait_commit(8, timeout_s=2.0)
+    assert g1.committed_step() == 8
+    assert g1.wait_manifest(8, timeout_s=1.0)
+    assert not g1.wait_manifest(9, timeout_s=0.2)
+    anns = g0.peer_announcements()
+    assert set(anns) == {0, 1}
+    assert anns[1]["steps"] == [4, 6, 8]
+    with pytest.raises(RuntimeError, match="only rank 0"):
+        g1.publish(9)
+    with pytest.raises(RuntimeError, match="leader"):
+        g1.wait_commit(9, timeout_s=0.1)
+
+
+def test_manifest_persists_across_coordinator_restart(tmp_path):
+    """With manifest_dir set, a committed step survives a full
+    coordinator (= launcher) restart — the same torn-save refusal a
+    shared-FS manifest gives, without ranks needing the FS."""
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30,
+                            manifest_dir=str(tmp_path)).start()
+    g0 = GangClient(coord.address, rank=0, world_size=2).connect()
+    g0.publish(12)
+    assert g0.committed_step() == 12
+    g0.close()
+    coord.stop()
+    coord2 = GangCoordinator(world_size=2, heartbeat_timeout_s=30,
+                             manifest_dir=str(tmp_path)).start()
+    g0b = GangClient(coord2.address, rank=0, world_size=2).connect()
+    try:
+        assert g0b.committed_step() == 12
+        # and the file is the SAME manifest the file backend writes
+        file_gang = GangRendezvous(str(tmp_path), rank=0, world_size=2)
+        assert file_gang.committed_step() == 12
+    finally:
+        g0b.close()
+        coord2.stop()
+
+
+def test_from_env_selects_backend(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    # no coord, no dir -> no gang
+    monkeypatch.delenv("PADDLE_GANG_COORD", raising=False)
+    monkeypatch.delenv("PADDLE_GANG_DIR", raising=False)
+    assert GangRendezvous.from_env() is None
+    # dir only -> file backend
+    monkeypatch.setenv("PADDLE_GANG_DIR", str(tmp_path / "gang"))
+    g = GangRendezvous.from_env()
+    assert isinstance(g, GangRendezvous) and g.backend == "file"
+    # coord env -> socket backend (heartbeat running)
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    monkeypatch.setenv("PADDLE_GANG_COORD", coord.address)
+    try:
+        g = GangRendezvous.from_env()
+        assert isinstance(g, GangClient) and g.backend == "socket"
+        assert g._hb_thread is not None and g._hb_thread.is_alive()
+        g.close()
+    finally:
+        coord.stop()
+    # unreachable coordinator -> ERROR, never a silent per-rank
+    # fallback (one rank on the file plane while peers heartbeat reads
+    # as a death and parks the whole gang)
+    monkeypatch.setenv("PADDLE_GANG_COORD", "127.0.0.1:1")
+    with pytest.raises(ConnectionError, match="refusing to silently"):
+        GangRendezvous.from_env()
+    # single-rank -> no gang regardless
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    assert GangRendezvous.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery end to end: SIGKILL a rank under the real launcher
+# ---------------------------------------------------------------------------
+
+def _losses(text):
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("STEP "):
+            _, i, _, v = line.split()
+            vals[int(i)] = float(v)
+    return vals
+
+
+def _free_port_base():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_elastic_rank_kill9_respawn_exact_loss_parity(tmp_path):
+    """The elastic contract end to end, through the REAL launcher:
+    2 socket-backend ranks train; rank 1 SIGKILLs itself mid-step;
+    the coordinator (hosted by the launcher) declares it dead; rank 0
+    drains and parks at the rejoin barrier (printing GANG_DEGRADED /
+    GANG_READY); ``--max_restarts`` respawns rank 1, which resumes from
+    the gang manifest step; the launcher exits 0 and the combined
+    per-step loss trajectory of EVERY rank exactly equals the
+    uninterrupted baseline."""
+    total, kill_step = 16, 6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("XLA_FLAGS", "FLAGS_fault_inject", "PADDLE_GANG_DIR",
+              "PADDLE_GANG_COORD"):
+        env.pop(k, None)
+    env.update({"GANG_CKPT_INTERVAL": "2", "GANG_SYNC_COMMITS": "1",
+                "FLAGS_gang_heartbeat_interval_s": "0.15",
+                "FLAGS_gang_heartbeat_timeout_s": "1.2",
+                "FLAGS_gang_rejoin_timeout_s": "120"})
+
+    # 1. uninterrupted single-rank baseline (no gang, same seed/data)
+    r = subprocess.run(
+        [sys.executable, _RUNNER, str(tmp_path / "base_ckpt"),
+         str(total), str(tmp_path / "pb")],
+        env=dict(env, PADDLE_TRAINERS_NUM="1"),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    base = _losses(r.stdout)
+    assert sorted(base) == list(range(total))
+
+    # 2. elastic chaos run under the launcher: rank 1 kill -9s itself
+    log_dir = tmp_path / "logs"
+    ckpt_root = tmp_path / "ckpt"
+    ckpt_root.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         "--started_port", str(_free_port_base()),
+         "--log_dir", str(log_dir),
+         "--max_restarts", "2",
+         "--grace_secs", "60",
+         _RUNNER, str(ckpt_root), str(total), str(tmp_path / "p"),
+         "0.1"],
+        env=dict(env, GANG_SELF_KILL=f"1:{kill_step}"),
+        capture_output=True, text=True, timeout=420)
+    out0 = (log_dir / "worker.0.log").read_text()
+    out1 = (log_dir / "worker.1.log").read_text()
+    dbg = f"launcher:\n{r.stdout}\n{r.stderr}\n" \
+          f"rank0:\n{out0}\nrank1:\n{out1}"
+    assert r.returncode == 0, dbg
+
+    # the launcher respawned (stderr log line) and rank 1 really died
+    assert "respawning" in r.stderr, dbg
+    assert f"SELF_KILL {kill_step}" in out1, dbg
+    assert "GANG_BACKEND socket" in out0, dbg
+
+    # 3. the survivor took the degraded->drain->park->resume path
+    assert "GANG_DEGRADED dead=[1]" in out0, dbg
+    assert "GANG_READY 1" in out0, dbg
+
+    # 4. rank 1's second life resumed from the gang manifest (never past
+    # the last all-rank-durable step, i.e. <= the kill step)
+    resumes = [int(x.split()[1]) for x in out1.splitlines()
+               if x.startswith("RESUMED_AT ")]
+    assert len(resumes) == 2, dbg            # first life (0) + respawn
+    assert resumes[0] == 0
+    assert 0 < resumes[1] <= kill_step, dbg
+
+    # 5. EXACT loss parity: rank 0 ran uninterrupted; rank 1's combined
+    # prefix+resumed trajectory must equal the baseline step for step
+    # (overlapping re-run steps recompute identical losses from the
+    # restored state)
+    l0 = _losses(out0)
+    assert sorted(l0) == list(range(total)), dbg
+    np.testing.assert_array_equal(
+        np.array([l0[i] for i in range(total)], np.float32),
+        np.array([base[i] for i in range(total)], np.float32))
+    l1 = _losses(out1)
+    assert sorted(l1) == list(range(total)), dbg
+    np.testing.assert_array_equal(
+        np.array([l1[i] for i in range(total)], np.float32),
+        np.array([base[i] for i in range(total)], np.float32))
+    # both lives finished cleanly: the respawned rank printed DONE
+    assert "DONE" in out1, dbg

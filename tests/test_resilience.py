@@ -813,6 +813,88 @@ def test_checkpoint_daemon_time_cadence_checked_at_boundaries(tmp_path):
         ckpt.close()
 
 
+def test_checkpoint_daemon_chunked_capture_bit_identical(tmp_path):
+    """FLAGS_checkpoint_capture_chunk_mb: the capture materializes
+    device copies in bounded groups ON the training thread (host
+    arrays reach the daemon — nothing left to double HBM), and the
+    committed checkpoint restores bit-identically to the unchunked
+    one."""
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=8,
+                                     param_attr=pt.ParamAttr(name="ch_w")))
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        feed = {"x": np.zeros((2, 4), np.float32)}
+        exe.run(feed=feed, fetch_list=[loss])
+        exe.drain()
+        ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+        # chunk budget smaller than any var -> one chunk per var
+        daemon = res.CheckpointDaemon(ckpt, interval_steps=1,
+                                      capture_chunk_mb=1)
+        daemon.capture(1)
+        step, state, kind = daemon._pending
+        assert step == 1 and state
+        # chunked capture hands HOST arrays to the daemon thread: no
+        # device-side copy survives the capture window
+        assert all(isinstance(v, np.ndarray) for v in state.values()), \
+            {k: type(v) for k, v in state.items()}
+        daemon.start()
+        daemon._wake.set()
+        _wait_committed(daemon, 1)
+        daemon.stop()
+        live = np.asarray(pt.global_scope().find_var("ch_w")).copy()
+        fresh = Scope()
+        assert ckpt.restore(scope=fresh) == 1
+        np.testing.assert_array_equal(
+            np.asarray(fresh.find_var("ch_w")), live)
+        ckpt.close()
+
+
+def test_checkpoint_daemon_adaptive_cadence_stretches(tmp_path):
+    """FLAGS_checkpoint_cadence_stretch_frac: a writer slower than the
+    cadence stretches the effective interval (far fewer captures than
+    the base cadence implies) and counts each stretched window."""
+
+    class SlowCkpt:
+        saves = 0
+
+        def save_arrays(self, step, state, force=True, kind="daemon"):
+            self.saves += 1
+            time.sleep(0.15)
+            return True
+
+    with program_guard(Program(), Program()), scope_guard(Scope()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=2))
+        exe = Executor()
+        exe.run(pt.default_startup_program())
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                fetch_list=[loss])
+        exe.drain()
+        before = _totals()
+        daemon = res.CheckpointDaemon(
+            SlowCkpt(), interval_steps=0, interval_secs=0.02,
+            cadence_stretch_frac=0.5).start()
+        captures = 0
+        t0 = time.monotonic()
+        step = 0
+        while time.monotonic() - t0 < 1.0:
+            step += 1
+            if daemon.step_completed(step):
+                captures += 1
+            time.sleep(0.005)
+        daemon.stop()
+        after = _totals()
+        # base cadence alone would capture ~50 times in 1 s; with
+        # save=0.15 s and frac=0.5 the effective interval is >= 0.3 s
+        # once the first save time is observed
+        assert captures <= 12, captures
+        assert _delta(
+            before, after,
+            "paddle_tpu_checkpoint_cadence_stretched_total") >= 1
+
+
 def test_checkpoint_daemon_background_error_surfaces(tmp_path):
     """A save failing in the background must re-raise on the training
     thread at the next boundary, not rot silently."""
@@ -959,7 +1041,8 @@ def test_gang_kill_one_rank_mid_emergency_save_rejects_torn_step(
     gang_dir = tmp_path / "gang"
     base_env = dict(os.environ)
     base_env["JAX_PLATFORMS"] = "cpu"
-    for k in ("XLA_FLAGS", "FLAGS_fault_inject", "PADDLE_GANG_DIR"):
+    for k in ("XLA_FLAGS", "FLAGS_fault_inject", "PADDLE_GANG_DIR",
+              "PADDLE_GANG_COORD"):
         base_env.pop(k, None)
 
     def losses(out):
@@ -1000,16 +1083,18 @@ def test_gang_kill_one_rank_mid_emergency_save_rejects_torn_step(
     # cadence so its emergency step is provably un-announceable by
     # rank 1; rank 1's emergency save hangs and is SIGKILLed mid-save
     ckpt_root = tmp_path / "ckpt"
-    progress = [tmp_path / "p0", tmp_path / "p1"]
+    # the runner writes per-rank progress to <arg>.r<rank>
+    progress_args = [tmp_path / "p0", tmp_path / "p1"]
+    progress = [tmp_path / "p0.r0", tmp_path / "p1.r1"]
     procs = [
         subprocess.Popen(
             [sys.executable, runner, str(ckpt_root), str(total),
-             str(progress[0]), "0.12"],
+             str(progress_args[0]), "0.12"],
             env=rank_env(0),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True),
         subprocess.Popen(
             [sys.executable, runner, str(ckpt_root), str(total),
-             str(progress[1]), "0.12"],
+             str(progress_args[1]), "0.12"],
             env=rank_env(1, GANG_EMERGENCY_HANG="1"),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True),
     ]

@@ -51,6 +51,9 @@ JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 echo "== resilience smoke (fault injection + retries + ckpt integrity) =="
 JAX_PLATFORMS=cpu python tools/resilience_smoke.py
 
+echo "== gang smoke (socket liveness plane: kill -9 a rank, launcher respawns, gang reconverges) =="
+JAX_PLATFORMS=cpu python tools/gang_smoke.py
+
 echo "== concurrency lint (guarded fields, signal handlers, threads, finalizers) =="
 python tools/lint_concurrency.py
 
