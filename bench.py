@@ -635,6 +635,86 @@ def bench_dispatch_overhead(dev, on_tpu, peak):
         })
 
 
+def bench_memory(dev, on_tpu, peak):
+    """Static HBM planner vs reality: for two workloads, run a few real
+    steps, then pair the planner's step-boundary live-byte estimate
+    (``analysis.plan_memory(...).steady_bytes`` at the true batch)
+    against the measured live device bytes (``memory.live_bytes`` delta
+    over the workload).  One ``memory:<workload>`` line each; `value` is
+    estimate/measured (1.0 = exact).  The planner's transient peak
+    (``peak_bytes``, includes mid-step temporaries XLA frees before the
+    boundary) rides along for the trajectory."""
+    import gc
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers, memory as mem
+    from paddle_tpu.analysis import plan_memory
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+
+    def mlp_adam():
+        x = layers.data("x", shape=[256], dtype="float32")
+        h = layers.fc(x, size=1024, act="relu")
+        h = layers.fc(h, size=1024, act="relu")
+        loss = layers.mean(layers.fc(h, size=256))
+        pt.optimizer.Adam(1e-3).minimize(loss)
+        rng = np.random.RandomState(0)
+        return {"x": rng.rand(64, 256).astype(np.float32)}, loss
+
+    def wide_embedding():
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[20000, 128])
+        loss = layers.mean(layers.fc(emb, size=1))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        rng = np.random.RandomState(0)
+        return {"ids": rng.randint(0, 20000, (64, 1)).astype(np.int64)}, \
+            loss
+
+    for name, build in (("mlp_adam", mlp_adam),
+                        ("wide_embedding", wide_embedding)):
+        gc.collect()
+        base = mem.live_bytes()
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            feed_np, loss = build()
+            prog = pt.default_main_program()
+            cp = pt.CompiledProgram(prog)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            feed = {k: jax.device_put(v) for k, v in feed_np.items()}
+            lv = None
+            for _ in range(3):
+                lv, = exe.run(cp, feed=feed, fetch_list=[loss.name],
+                              scope=scope, return_numpy=False)
+            lv.numpy()                       # sync the pipeline
+            exe.drain()
+            batch = next(iter(feed_np.values())).shape[0]
+            plan = plan_memory(prog, (loss.name,), batch_size=batch)
+            gc.collect()
+            measured = mem.live_bytes() - base
+            est = plan.steady_bytes
+            emit({
+                "metric": f"memory:{name}",
+                "value": round(est / measured, 3) if measured else 0,
+                "unit": "estimate/measured",
+                "vs_baseline": 0,
+                "estimate_bytes": int(est),
+                "measured_bytes": int(measured),
+                "static_peak_bytes": int(plan.peak_bytes),
+                "resident_bytes": int(plan.resident_bytes),
+                "peak_op": plan.peak_op,
+                "batch": int(batch),
+                "device": str(dev),
+                "note": ("estimate = planner steady (step-boundary live "
+                         "set: persistables counted once under donation "
+                         "+ staged feeds + pinned fetches); measured = "
+                         "live device bytes delta over the workload"),
+            })
+        del scope
+        gc.collect()
+
+
 def _setup_compile_cache():
     """Persistent XLA compile cache (ROADMAP open item): first-compile of
     a big train step is 20-40 s; a workspace-local disk cache removes it
@@ -803,6 +883,9 @@ def main(argv=None):
         # starved by a slow hardware bench ahead of it
         ("dispatch_overhead",
          lambda: bench_dispatch_overhead(dev, on_tpu, peak)),
+        # cheap static-analysis trajectory line: planner estimate vs
+        # measured live bytes (runs on CPU and TPU alike)
+        ("memory", lambda: bench_memory(dev, on_tpu, peak)),
         ("resnet50", lambda: bench_resnet50(dev, on_tpu, peak)),
         ("resnet50_frozen_bn",
          lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True)),

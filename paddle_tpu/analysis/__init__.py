@@ -7,11 +7,21 @@ time, before anything is lowered).
   use, dangling feed/fetch targets, shape/dtype re-inference consistency,
   dead-op liveness, use-after-donate hazards on rw persistables, static
   int64 feed-wrap classification, and the per-rank collective-ordering
-  fingerprint.  Runs on the ``framework.ir`` Graph, behind
-  ``FLAGS_program_verify`` (default on), cached on the source-program
-  fingerprint so steady-state dispatch never re-verifies.
+  fingerprint.  Whole-program: ``while``/``cond`` sub-blocks verify
+  recursively in their enclosing scope context, and loop-body
+  collectives fold into the fingerprint stamped with their block path.
+  Runs on the ``framework.ir`` Graph, behind ``FLAGS_program_verify``
+  (default on), cached on the source-program fingerprint so steady-state
+  dispatch never re-verifies.
+- :mod:`paddle_tpu.analysis.memory` — the static HBM peak-memory
+  planner: interval liveness over the dependency-ordered Graph,
+  donation- and alias-aware, producing per-program estimated peak bytes
+  with a top-K per-op attribution table.  Feeds the verifier's
+  ``memory_budget`` check, ``bench.py``'s ``memory:<workload>``
+  estimate-vs-measured lines, and ``tools/analyze.py``.
 """
 
+from .memory import MemoryPlan, plan_memory  # noqa: F401
 from .verifier import (  # noqa: F401
     CHECKS, Diagnostic, ProgramVerificationError, VerifyResult,
     clear_cache, collective_fingerprint, dynamic_int64_feeds,
@@ -19,7 +29,8 @@ from .verifier import (  # noqa: F401
 )
 
 __all__ = [
-    "CHECKS", "Diagnostic", "ProgramVerificationError", "VerifyResult",
-    "clear_cache", "collective_fingerprint", "dynamic_int64_feeds",
-    "verify_or_raise", "verify_program",
+    "CHECKS", "Diagnostic", "MemoryPlan", "ProgramVerificationError",
+    "VerifyResult", "clear_cache", "collective_fingerprint",
+    "dynamic_int64_feeds", "plan_memory", "verify_or_raise",
+    "verify_program",
 ]

@@ -40,7 +40,17 @@ collective_order    error/     collective ops not totally ordered by data
                                has the SAME signature (cross-rank pairing
                                is ambiguous — the documented ``.numpy()``
                                ordering deadlock class), warning otherwise
+memory_budget       warning    the static HBM peak-memory estimate
+                               (analysis.memory, batch=1 lower bound)
+                               exceeds FLAGS_memory_budget_mb
 ==================  =========  ==============================================
+
+The graph-walking checks are INTERPROCEDURAL: ``while``/``cond`` bodies
+verify recursively in their enclosing scope context (outer defs visible,
+inner defs scoped, loop-carried body writes never read as
+uninitialized), sub-block collectives fold into the fingerprint stamped
+with their block path, and dead body compute is flagged/pruned without
+touching live loop-carried vars.
 
 ``verify_program`` is cached on the source-program fingerprint
 (``Program.fingerprint()`` — the PR-4 dispatch-plan key), so a program is
@@ -72,7 +82,7 @@ __all__ = [
 CHECKS = (
     "def_before_use", "uninitialized_read", "dangling_fetch",
     "dangling_feed", "shape_consistency", "dead_op", "use_after_donate",
-    "int64_feed", "collective_order",
+    "int64_feed", "collective_order", "memory_budget",
 )
 
 _FINDINGS = _monitor.REGISTRY.counter(
@@ -125,9 +135,13 @@ class Diagnostic:
     severity: str              # "error" | "warning"
     message: str
     op_type: Optional[str] = None
-    op_index: Optional[int] = None   # block-0 program-order index
+    op_index: Optional[int] = None   # program-order index in its block
     var: Optional[str] = None
     fix_hint: Optional[str] = None
+    #: block path for sub-block findings ("0" is the top block; a loop
+    #: body reads e.g. "0/while@5/1": the while op at block-0 index 5,
+    #: sub-block 1).  None means block 0 (back-compat).
+    block: Optional[str] = None
 
 
 @dataclass
@@ -137,10 +151,15 @@ class VerifyResult:
     int64_dynamic: FrozenSet[str] = frozenset()
     #: int64/uint64 data feeds proven bounded by every consumer
     int64_static: FrozenSet[str] = frozenset()
-    #: sha1 over the dependency-ordered collective sequence + fetch list
-    #: (None when the program launches no collectives)
+    #: sha1 over the dependency-ordered, block-path-stamped collective
+    #: sequence + fetch list (None when no block launches a collective)
     collective_fingerprint: Optional[str] = None
     dead_ops: Tuple[int, ...] = ()   # block-0 indices of dead ops
+    #: {sub-block idx: (op indices...)} of dead body compute
+    dead_subblock_ops: Dict[int, tuple] = field(default_factory=dict)
+    #: static HBM plan (analysis.memory.MemoryPlan; None if planning
+    #: failed — the plan must never block verification)
+    memory_plan: Optional[object] = None
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == "error"]
@@ -186,49 +205,83 @@ def _is_data(v) -> bool:
     return bool(getattr(v, "is_data", False))
 
 
+def sub_blocks_of(op) -> List[Tuple[str, Block]]:
+    """The Block-valued attrs of one op, sorted by attr name (while/cond
+    bodies and any future multi-block control flow)."""
+    return sorted(((k, v) for k, v in op.attrs.items()
+                   if isinstance(v, Block)), key=lambda kv: kv[0])
+
+
 def _check_def_before_use(program: Program, diags: List[Diagnostic]):
-    """Program-order def-before-use over block 0.  Feed/fetch shim ops
-    participate as writers only (the executor skips them at trace time)."""
-    block = program.global_block()
-    written = set()
-    for idx, op in enumerate(block.ops):
-        if op.type not in ("feed", "fetch"):
-            for slot, names in op.inputs.items():
-                # OG$ (output-grad) slots may legally be absent: an
-                # output unused downstream has no grad, and the lowering
-                # reads them with .get() and treats None as zero
-                if slot.startswith("OG$"):
-                    continue
-                for name in names:
-                    if not name or name in written:
+    """Interprocedural program-order def-before-use: block 0 first, then
+    every ``while``/``cond`` sub-block recursively IN ITS ENCLOSING SCOPE
+    CONTEXT — outer defs written before the control-flow op are visible
+    inside the body, inner defs stay scoped to it.  Feed/fetch shim ops
+    participate as writers only (the executor skips them at trace time).
+
+    Loop-body semantics: a body read of a var some body op writes LATER
+    is a loop-carried use (iteration *n* reads iteration *n-1*'s write,
+    and the carry's initial value comes from the parent scope), so only
+    block-0 order violations earn ``uninitialized_read`` — sub-blocks
+    suppress it for names written anywhere in the same body."""
+
+    def walk(block: Block, written: set, path: str):
+        local = set(written)
+        body_writes = {n for op in block.ops
+                       for n in op.output_arg_names() if n}
+        for idx, op in enumerate(block.ops):
+            if op.type not in ("feed", "fetch"):
+                for slot, names in op.inputs.items():
+                    # OG$ (output-grad) slots may legally be absent: an
+                    # output unused downstream has no grad, and the
+                    # lowering reads them with .get(), treating None as
+                    # zero
+                    if slot.startswith("OG$"):
                         continue
-                    if not block.has_var(name):
+                    for name in names:
+                        if not name or name in local:
+                            continue
+                        if not block.has_var(name):
+                            diags.append(Diagnostic(
+                                "def_before_use", "error",
+                                f"op input var {name!r} is not declared "
+                                "in the block (or an enclosing block) "
+                                "and no preceding op produces it",
+                                op_type=op.type, op_index=idx, var=name,
+                                block=path,
+                                fix_hint="declare the var "
+                                         "(block.create_var / "
+                                         "layers.data) or fix the "
+                                         "producing op's output name"))
+                            continue
+                        v = block.var(name)
+                        if v.persistable or _is_data(v) or \
+                                v.initializer is not None:
+                            continue
+                        if block.idx != 0 and name in body_writes:
+                            continue       # loop-carried body write
                         diags.append(Diagnostic(
-                            "def_before_use", "error",
-                            f"op input var {name!r} is not declared in "
-                            "the block and no preceding op produces it",
+                            "uninitialized_read", "warning",
+                            f"var {name!r} is read before any op writes "
+                            "it and is neither persistable nor a "
+                            "declared data var — it must be fed (or "
+                            "pre-seeded in the scope) at every run",
                             op_type=op.type, op_index=idx, var=name,
-                            fix_hint="declare the var (block.create_var "
-                                     "/ layers.data) or fix the producing"
-                                     " op's output name"))
-                        continue
-                    v = block.var(name)
-                    if v.persistable or _is_data(v) or \
-                            v.initializer is not None:
-                        continue
-                    diags.append(Diagnostic(
-                        "uninitialized_read", "warning",
-                        f"var {name!r} is read before any op writes it "
-                        "and is neither persistable nor a declared data "
-                        "var — it must be fed (or pre-seeded in the "
-                        "scope) at every run",
-                        op_type=op.type, op_index=idx, var=name,
-                        fix_hint="declare it via layers.data if it is "
-                                 "fed, or mark it persistable if it "
-                                 "lives in the scope"))
-        for name in op.output_arg_names():
-            if name:
-                written.add(name)
+                            block=path,
+                            fix_hint="declare it via layers.data if it "
+                                     "is fed, or mark it persistable if "
+                                     "it lives in the scope"))
+            # recurse into sub-block bodies with the defs visible HERE
+            # (outer writes up to and including earlier ops); the body's
+            # own writes never leak back out — the enclosing op's
+            # declared outputs carry them
+            for _, sub in sub_blocks_of(op):
+                walk(sub, local, f"{path}/{op.type}@{idx}/{sub.idx}")
+            for name in op.output_arg_names():
+                if name:
+                    local.add(name)
+
+    walk(program.global_block(), set(), "0")
 
 
 def _check_feed_fetch(program: Program, fetch_names, diags):
@@ -343,7 +396,29 @@ def _check_dead_ops(graph, fetch_names, diags):
             op_type=op.name, op_index=i,
             fix_hint="fetch its output if you need it; the "
                      "dead_op_eliminate pass removes it otherwise"))
-    return indices
+    # sub-block bodies: dead body compute re-runs EVERY iteration — the
+    # liveness keeps carried vars (their writers root through the
+    # enclosing op's var lists) and flags only compute no carry, fetch,
+    # or persistable observes
+    sub_dead = ir.dead_subblock_op_analysis(
+        graph.program, protected=frozenset(fetch_names))
+    for blk_idx, sub_indices in sub_dead.items():
+        block = graph.program.blocks[blk_idx]
+        for i in sub_indices:
+            op = block.ops[i]
+            if op.type.endswith("_grad") or \
+                    op.attrs.get("op_role") == "backward":
+                continue
+            diags.append(Diagnostic(
+                "dead_op", "warning",
+                f"op {op.type!r} inside sub-block {blk_idx} reaches no "
+                "loop-carried var, fetch target, persistable write, or "
+                "side-effecting op — it recomputes a dropped value EVERY "
+                "iteration",
+                op_type=op.type, op_index=i, block=str(blk_idx),
+                fix_hint="carry or fetch its output if you need it; the "
+                         "dead_op_eliminate pass prunes it otherwise"))
+    return indices, sub_dead
 
 
 def _rw_persistables(program: Program) -> set:
@@ -372,13 +447,42 @@ def _check_use_after_donate(program: Program, fetch_names, diags):
                          "scope at a step boundary instead"))
 
 
-def _classify_int64_feeds(program: Program):
-    """Static feed-wrap classification: an int64/uint64 data feed whose
-    EVERY consumer bounds its VALID values below 2**31 (embedding row
-    count, one_hot depth) is ``static``: every in-range id fits int32, so
-    the feed conversion only alters ids that were already invalid — and
-    the consumer treats those identically with or without the wrap (see
-    the _INT32_BOUND note; XLA gather clamps silently either way).
+#: value-preserving ops the int64 classification propagates THROUGH: the
+#: output carries the same fed values (reshaped/selected/concatenated),
+#: so safety is decided by the OUTPUT's consumers.  concat is included
+#: because the fed values survive verbatim into the merged var — a
+#: bounded downstream index consumer bounds them exactly as it bounds a
+#: direct feed.
+_INT64_PASS_OPS = frozenset({
+    "reshape", "reshape2", "squeeze", "squeeze2", "unsqueeze",
+    "unsqueeze2", "flatten", "flatten2", "slice", "strided_slice",
+    "split", "concat", "assign", "transpose", "transpose2",
+})
+
+
+def _classify_int64_feeds(program: Program, fetch_names=()):
+    """Static feed-wrap classification v2: an int64/uint64 data feed
+    whose every (transitively reached) consumer bounds its VALID values
+    below 2**31 is ``static``: every in-range id fits int32, so the
+    feed conversion only alters ids that were already invalid — and the
+    consumer treats those identically with or without the wrap (see the
+    _INT32_BOUND note; XLA gather clamps silently either way).
+
+    v2 over the PR-5 classifier:
+
+    - **bounded index consumers** now include the gather/scatter family
+      (``gather``/``gather_nd``/``scatter``/``scatter_nd_add``) — the
+      indexed operand's static dims are the bound, exactly as the
+      embedding row count bounds ``lookup_table`` ids;
+    - **dataflow propagation** through value-preserving chains
+      (:data:`_INT64_PASS_OPS`: reshape/squeeze/flatten/slice/split/
+      concat/transpose/assign) and integer-to-integer ``cast``: the
+      chain's OUTPUT consumers decide, so ``reshape(ids) -> gather``
+      classifies like a direct gather;
+    - grad-op inheritance preserved: a grad op replays the forward's
+      reads of the SAME fed values (``X$<slot>``), so it classifies
+      exactly as its forward op.
+
     Everything else stays ``dynamic`` and keeps the executor's
     first-batch runtime min/max check."""
     block = program.global_block()
@@ -387,15 +491,40 @@ def _classify_int64_feeds(program: Program):
     if not feeds:
         return frozenset(), frozenset()
 
-    def _dim0(name):
-        if not block.has_var(name):
+    def _shape(name, blk):
+        if not blk.has_var(name):
             return None
-        shape = block.var(name).shape
-        return shape[0] if shape else None
+        return blk.var(name).shape
 
-    def consumer_safe(op, name) -> bool:
+    def _dim_bounded(name, blk, axis=None):
+        """True when the indexed extent of var ``name`` is statically
+        known and addressable by int32: the consumer clamps/ignores
+        anything outside it, wrapped or not."""
+        shape = _shape(name, blk)
+        if not shape:
+            return False
+        if axis is None:
+            dims = shape
+        else:
+            # normalize negative axes — a raw shape[-1:0] slice would
+            # be EMPTY and all(...) vacuously true (unbounded extents
+            # would classify static)
+            axis = axis % len(shape) if -len(shape) <= axis < len(shape) \
+                else None
+            if axis is None:
+                return False
+            dims = shape[axis:axis + 1]
+        return bool(dims) and all(
+            d is not None and 0 < d < _INT32_BOUND for d in dims)
+
+    def consumer_verdict(op, blk, name) -> str:
+        """'safe' (bounded index consumer) | 'pass' (value-preserving,
+        judge the outputs' consumers) | 'ignore' (harmless read that
+        neither bounds nor propagates the values — a pass-through op's
+        grad reads shape metadata only) | 'unsafe'."""
         typ = op.type
-        if typ.endswith("_grad"):
+        is_grad = typ.endswith("_grad")
+        if is_grad:
             # a grad op replays the forward's reads of the SAME fed
             # values (make_grad_ops forwards them under "X$<slot>"), so
             # it is exactly as safe as its forward op
@@ -409,28 +538,94 @@ def _classify_int64_feeds(program: Program):
         if typ in ("lookup_table", "lookup_table_v2") and \
                 name in slot("Ids"):
             w = slot("W")
-            rows = _dim0(w[0]) if w else None
-            return rows is not None and 0 < rows < _INT32_BOUND
-        if typ == "one_hot" and name in slot("X"):
+            return "safe" if w and _dim_bounded(w[0], blk, axis=0) \
+                else "unsafe"
+        if typ in ("one_hot", "one_hot_v2") and name in slot("X"):
             depth = op.attrs.get("depth")
-            return bool(depth) and int(depth) < _INT32_BOUND
-        return False
+            return "safe" if depth and int(depth) < _INT32_BOUND \
+                else "unsafe"
+        if typ == "gather" and name in slot("Index"):
+            x = slot("X")
+            axis = int(op.attrs.get("axis", 0))
+            return "safe" if x and _dim_bounded(x[0], blk, axis=axis) \
+                else "unsafe"
+        if typ == "gather_nd" and name in slot("Index"):
+            # the trailing index dim addresses the leading dims of X:
+            # every statically-known dim under int32 bounds the tuple
+            x = slot("X")
+            return "safe" if x and _dim_bounded(x[0], blk) else "unsafe"
+        if typ == "scatter" and name in slot("Ids"):
+            x = slot("X")
+            return "safe" if x and _dim_bounded(x[0], blk, axis=0) \
+                else "unsafe"
+        if typ == "scatter_nd_add" and name in slot("Index"):
+            x = slot("X")
+            return "safe" if x and _dim_bounded(x[0], blk) else "unsafe"
+        if typ == "cast" and name in slot("X"):
+            # int->int cast preserves in-range values; a float target
+            # means the VALUES are data and a wrap would corrupt them
+            outs = op.output_arg_names()
+            out_dt = (blk.var(outs[0]).dtype
+                      if outs and outs[0] and blk.has_var(outs[0])
+                      else None)
+            if not (out_dt and "int" in str(out_dt)):
+                return "unsafe"
+            return "ignore" if is_grad else "pass"
+        if typ in _INT64_PASS_OPS:
+            # the GRAD of a value-preserving op reads the fed values for
+            # shape metadata only (reshape_grad reshapes the cotangent,
+            # concat_grad splits it) — its outputs are float gradients,
+            # not the fed values, so there is nothing to propagate to;
+            # but neither does it BOUND the values, so it must not make
+            # a chain static by itself ('ignore', not 'safe')
+            return "ignore" if is_grad else "pass"
+        return "unsafe"
 
-    consumers: Dict[str, list] = {v.name: [] for v in feeds}
+    # consumer index over EVERY block (loop/cond bodies consume feeds
+    # too — sub-block consumers classify exactly like top-level ones)
+    consumers: Dict[str, list] = {}
     for b in program.blocks:
         for op in b.ops:
             if op.type in ("feed", "fetch"):
                 continue
             for name in op.input_arg_names():
-                if name in consumers:
-                    consumers[name].append(op)
+                if name:
+                    consumers.setdefault(name, []).append((op, b))
+
+    fetched = frozenset(fetch_names)
+
+    def feed_static(feed_name: str) -> bool:
+        # static requires a BOUNDED terminal consumer, not merely any
+        # consumer: a chain of pure pass-through ops (reshape -> fetch)
+        # re-exposes the raw values with nothing to clamp them, so it
+        # must keep the runtime wrap check exactly as v1 did.  The same
+        # exposure applies to ANY fetched name in the pass-through
+        # closure (including the feed itself): the fetch materializes
+        # the post-wrap device values even when a bounded SIBLING
+        # consumer exists, so a fetched alias forces dynamic.
+        seen = {feed_name}
+        frontier = [feed_name]
+        any_bounded = False
+        while frontier:
+            name = frontier.pop()
+            if name in fetched:
+                return False
+            for op, blk in consumers.get(name, ()):
+                verdict = consumer_verdict(op, blk, name)
+                if verdict == "unsafe":
+                    return False
+                if verdict == "safe":
+                    any_bounded = True
+                if verdict == "pass":
+                    for out in op.output_arg_names():
+                        if out and out not in seen:
+                            seen.add(out)
+                            frontier.append(out)
+        return any_bounded
+
     static, dynamic = set(), set()
     for v in feeds:
-        ops = consumers[v.name]
-        if ops and all(consumer_safe(op, v.name) for op in ops):
-            static.add(v.name)
-        else:
-            dynamic.add(v.name)
+        (static if feed_static(v.name) else dynamic).add(v.name)
     return frozenset(static), frozenset(dynamic)
 
 
@@ -446,73 +641,135 @@ def _collective_signature(op_node, block: Block):
 
 
 def _check_collective_order(program: Program, graph, fetch_names, diags):
-    """Dependency-order the block's collective ops.  Pairs with no path
-    between them can launch in different orders on different ranks (the
-    compiler is free to schedule independent collectives for latency);
-    when the unordered pair has the SAME signature the cross-rank pairing
-    itself is ambiguous — the static form of the documented cross-rank
-    ``.numpy()`` materialization deadlock.  Returns the fingerprint of
-    the dependency-ordered sequence (ties broken by program order), which
-    every rank of a gang can compare out of band."""
-    block = program.global_block()
-    nodes = [n for n in graph.op_nodes if n.name in _COLLECTIVE_OPS]
-    if not nodes and not program._attrs.get("collective"):
+    """Dependency-order the collective ops of the WHOLE program, block 0
+    and every ``while``/``cond`` sub-block recursively.  Pairs with no
+    path between them can launch in different orders on different ranks
+    (the compiler is free to schedule independent collectives for
+    latency); when an unordered pair has the SAME signature the
+    cross-rank pairing itself is ambiguous — the static form of the
+    documented cross-rank ``.numpy()`` materialization deadlock — and
+    the check applies per block: two identical unordered allreduces
+    INSIDE a loop body mispair exactly like top-level ones.
+
+    Returns the fingerprint of the dependency-ordered collective
+    sequence, which every rank of a gang compares over the coordinator
+    heartbeat and at ``step_barrier``.  Sub-block collectives fold in at
+    their enclosing op's position, stamped with the block path
+    (``0/while@5/1``): a loop-body collective is part of the rank's
+    launch sequence even though the top-level graph never sees it, so a
+    rank whose peer runs a different body refuses before the hang."""
+    from ..framework import ir
+    entries: List[tuple] = []   # (block path, signature), execution order
+
+    def gather(block_graph, path: str):
+        block = program.blocks[block_graph.block_idx]
+        nodes = [n for n in block_graph.op_nodes
+                 if n.name in _COLLECTIVE_OPS]
+        if nodes:
+            # forward-reachable op-id sets, by BFS from each collective
+            reach: Dict[int, set] = {}
+            for n in nodes:
+                seen = set()
+                stack = [n]
+                while stack:
+                    cur = stack.pop()
+                    for v in cur.outputs:
+                        for consumer in v.outputs:
+                            if consumer.id not in seen:
+                                seen.add(consumer.id)
+                                stack.append(consumer)
+                reach[n.id] = seen
+            unordered, ambiguous = [], []
+            for i in range(len(nodes)):
+                for j in range(i + 1, len(nodes)):
+                    a, b = nodes[i], nodes[j]
+                    if b.id in reach[a.id] or a.id in reach[b.id]:
+                        continue
+                    sig_a = _collective_signature(a, block)
+                    sig_b = _collective_signature(b, block)
+                    (ambiguous if sig_a == sig_b else unordered).append(
+                        (a.name, b.name, sig_a))
+            where = "" if path == "0" else f" in sub-block {path!r}"
+            if ambiguous:
+                a, b, sig = ambiguous[0]
+                diags.append(Diagnostic(
+                    "collective_order", "error",
+                    f"{len(ambiguous)} pair(s) of collective ops share "
+                    f"a signature {sig!r} but have no dependency path "
+                    f"between them{where} (first pair: {a!r}/{b!r}) — "
+                    "ranks can launch them in different orders and "
+                    "mispair, deadlocking the gang",
+                    op_type=a, block=path,
+                    fix_hint="chain them (feed one's output into the "
+                             "other's input chain) or give each a "
+                             "distinct ring_id"))
+            elif unordered:
+                diags.append(Diagnostic(
+                    "collective_order", "warning",
+                    f"{len(unordered)} pair(s) of collective ops have "
+                    f"no dependency path between them{where}; their "
+                    "launch order is compiler-chosen — verify the "
+                    "collective fingerprint matches across ranks before "
+                    "entering the gang",
+                    op_type=unordered[0][0], block=path,
+                    fix_hint="compare program._attrs['verify']"
+                             "['collective_fingerprint'] across ranks"))
+        # dependency order with a stable program-order tie-break
+        # (topology_sort is deterministic for a fixed program); fold
+        # sub-block collectives at the enclosing op's position
+        order = {n.id: i for i, n in enumerate(
+            block_graph.topology_sort())}
+        pos = {id(op): i for i, op in enumerate(block.ops)}
+        for n in sorted(block_graph.op_nodes,
+                        key=lambda n: (order.get(n.id, 0), n.id)):
+            if n.name in _COLLECTIVE_OPS:
+                entries.append((path, _collective_signature(n, block)))
+            subs = sub_blocks_of(n.op)
+            if subs:
+                idx = pos.get(id(n.op), order.get(n.id, 0))
+                for _, sub in subs:
+                    gather(ir.Graph(program, sub.idx),
+                           f"{path}/{n.name}@{idx}/{sub.idx}")
+
+    gather(graph, "0")
+    if not entries and not program._attrs.get("collective"):
         return None
-    # forward-reachable op-id sets, by BFS from each collective node
-    reach: Dict[int, set] = {}
-    for n in nodes:
-        seen = set()
-        stack = [n]
-        while stack:
-            cur = stack.pop()
-            for v in cur.outputs:
-                for consumer in v.outputs:
-                    if consumer.id not in seen:
-                        seen.add(consumer.id)
-                        stack.append(consumer)
-        reach[n.id] = seen
-    unordered, ambiguous = [], []
-    for i in range(len(nodes)):
-        for j in range(i + 1, len(nodes)):
-            a, b = nodes[i], nodes[j]
-            if b.id in reach[a.id] or a.id in reach[b.id]:
-                continue
-            sig_a = _collective_signature(a, block)
-            sig_b = _collective_signature(b, block)
-            (ambiguous if sig_a == sig_b else unordered).append(
-                (a.name, b.name, sig_a))
-    if ambiguous:
-        a, b, sig = ambiguous[0]
-        diags.append(Diagnostic(
-            "collective_order", "error",
-            f"{len(ambiguous)} pair(s) of collective ops share a "
-            f"signature {sig!r} but have no dependency path between them "
-            f"(first pair: {a!r}/{b!r}) — ranks can launch them in "
-            "different orders and mispair, deadlocking the gang",
-            op_type=a,
-            fix_hint="chain them (feed one's output into the other's "
-                     "input chain) or give each a distinct ring_id"))
-    elif unordered:
-        diags.append(Diagnostic(
-            "collective_order", "warning",
-            f"{len(unordered)} pair(s) of collective ops have no "
-            "dependency path between them; their launch order is "
-            "compiler-chosen — verify the collective fingerprint matches "
-            "across ranks before entering the gang",
-            op_type=unordered[0][0],
-            fix_hint="compare program._attrs['verify']"
-                     "['collective_fingerprint'] across ranks"))
-    # fingerprint: collectives in dependency order (stable program-order
-    # tie-break — graph.topology_sort is deterministic for a fixed
-    # program), then the fetch list (each cross-rank fetch materializes
-    # as a collective allgather, in fetch order)
-    order = {n.id: i for i, n in enumerate(graph.topology_sort())}
-    seq = sorted(nodes, key=lambda n: (order.get(n.id, 0), n.id))
     h = hashlib.sha1()
-    for n in seq:
-        h.update(repr(_collective_signature(n, block)).encode())
+    for path, sig in entries:
+        h.update(repr((path, sig)).encode())
     h.update(repr(tuple(fetch_names)).encode())
     return h.hexdigest()
+
+
+def _check_memory(program: Program, fetch_names, diags):
+    """Static HBM plan (analysis.memory): batch=1 per-example lower
+    bound, cached on the fingerprint alongside this verify result.  A
+    ``memory_budget`` warning fires when FLAGS_memory_budget_mb is set
+    and even the lower bound exceeds it.  Planning failures never block
+    verification."""
+    from . import memory as _memory
+    try:
+        plan = _memory.plan_memory(program, fetch_names, batch_size=1)
+    except Exception:
+        return None
+    from ..flags import get_flags
+    try:
+        budget_mb = int(get_flags("FLAGS_memory_budget_mb")
+                        ["FLAGS_memory_budget_mb"])
+    except Exception:
+        budget_mb = 0
+    if budget_mb > 0 and plan.peak_bytes > budget_mb << 20:
+        top = ", ".join(f"{t} #{p}" for p, t, _, _ in plan.top_ops(3))
+        diags.append(Diagnostic(
+            "memory_budget", "warning",
+            f"static peak-memory estimate {plan.peak_bytes >> 20} MiB "
+            f"(batch=1 lower bound) exceeds FLAGS_memory_budget_mb="
+            f"{budget_mb}; heaviest ops: {top}",
+            op_type=plan.peak_op, op_index=plan.peak_pos,
+            fix_hint="shrink the model/batch, enable sharding, or raise "
+                     "the budget; see analysis.memory.plan_memory("
+                     "...).report() for the full attribution table"))
+    return plan
 
 
 # ---------------------------------------------------------------------------
@@ -549,22 +806,35 @@ def _verify_cached(program: Program, fetch_names) -> \
             _check_shape_consistency(program, diags)
         except Exception:            # re-inference must never block verify
             pass
-        result.dead_ops = _check_dead_ops(graph, fetch_names, diags)
+        result.dead_ops, result.dead_subblock_ops = \
+            _check_dead_ops(graph, fetch_names, diags)
         _check_use_after_donate(program, fetch_names, diags)
         result.int64_static, result.int64_dynamic = \
-            _classify_int64_feeds(program)
+            _classify_int64_feeds(program, fetch_names)
         result.collective_fingerprint = _check_collective_order(
             program, graph, fetch_names, diags)
+        result.memory_plan = _check_memory(program, fetch_names, diags)
     for d in diags:
         _FINDING_CELLS[d.check].inc()
     # int64_feed "findings" are classifications, not diagnostics: the
     # counter tracks how many feeds KEPT the runtime wrap check
     if result.int64_dynamic:
         _FINDING_CELLS["int64_feed"].inc(len(result.int64_dynamic))
+    plan = result.memory_plan
     program._attrs["verify"] = {
         "int64_dynamic": sorted(result.int64_dynamic),
         "int64_static": sorted(result.int64_static),
         "collective_fingerprint": result.collective_fingerprint,
+        # static HBM model (batch=1 lower bound): the numbers other
+        # layers read without re-planning — tools/analyze.py, the OOM
+        # report, the GSPMD/fusion arc's placement heuristics
+        "memory": None if plan is None else {
+            "peak_bytes": plan.peak_bytes,
+            "resident_bytes": plan.resident_bytes,
+            "steady_bytes": plan.steady_bytes,
+            "peak_op": plan.peak_op,
+            "top_ops": [(p, t, b) for p, t, b, _ in plan.top_ops(5)],
+        },
     }
     with _CACHE_LOCK:
         fresh = key not in _CACHE

@@ -24,6 +24,9 @@ def format_diagnostics(diagnostics) -> str:
                           else ""))
         if d.var is not None:
             loc.append(f"var {d.var!r}")
+        blk = getattr(d, "block", None)
+        if blk is not None and blk != "0":
+            loc.append(f"block {blk}")
         where = f" @ {', '.join(loc)}" if loc else ""
         lines.append(f"[{d.severity}] {d.check}{where}: {d.message}")
         if d.fix_hint:

@@ -279,8 +279,10 @@ class GangCoordinator:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
-    # -- state helpers (all hold _cv) ---------------------------------------
-    def _entry_locked(self, rank: int) -> dict:
+    # -- state helpers (all hold _cv; the `# guarded-by-caller: _cv`
+    # annotations make the lint VERIFY that every same-module call site
+    # actually holds it, instead of per-line lint-ok suppressions) -----------
+    def _entry_locked(self, rank: int) -> dict:  # guarded-by-caller: _cv
         e = self._ranks.get(rank)
         if e is None:
             # 'step'/'steps' are the DURABLE record — written only by
@@ -294,12 +296,16 @@ class GangCoordinator:
                  "last_hb": time.monotonic(),
                  "step": None, "steps": [], "cur_step": None,
                  "hb_steps": [], "fingerprint": None,
-                 "pid": None, "deaths": 0, "joins": 0}
-            self._ranks[rank] = e  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+                 "pid": None, "deaths": 0, "joins": 0,
+                 # server-side barrier sequence: the k-th step_barrier
+                 # arrival of every rank pairs with the k-th of its
+                 # peers (see _op_step_barrier)
+                 "bseq": 0}
+            self._ranks[rank] = e
         return e
 
     def _touch_locked(self, rank: int, pid: Optional[int] = None,
-                      hello: bool = False) -> dict:
+                      hello: bool = False) -> dict:  # guarded-by-caller: _cv
         """A frame from a live rank refreshes its liveness; a frame from
         a rank previously declared dead is a REJOIN (the elastic path).
         A rank that said goodbye is DEPARTED: only an explicit ``hello``
@@ -324,6 +330,16 @@ class GangCoordinator:
             e["step"] = None
             e["steps"] = []
             e["joins"] += 1
+            # barrier resync: the respawn's executor restarts its local
+            # barrier count while survivors kept counting — reset EVERY
+            # rank's server-side sequence (and drop stale barriers) so
+            # post-rejoin arrivals pair from zero on both sides.  Safe:
+            # any pre-death waiter was already refused with `degraded`
+            # (survivors drain and park, they never sit in a barrier
+            # across a rejoin).
+            for other in self._ranks.values():
+                other["bseq"] = 0
+            self._barriers.clear()
             _monitor.GANG_REJOIN_CTR.inc()
             if _monitor.TRACER.enabled:
                 _monitor.TRACER.instant(
@@ -348,12 +364,12 @@ class GangCoordinator:
                       if e["alive"] or e["finished"])
         return "ok" if present >= self.world_size else "forming"
 
-    def _publish_locked(self, step: int) -> None:
+    def _publish_locked(self, step: int) -> None:  # guarded-by-caller: _cv
         """In-memory commit + waiter wakeup.  The durable file mirror is
         the CALLER's job after releasing ``_cv`` (:meth:`_mirror_manifest`)
         — an fsync inside the one coordinator lock would stall every
         heartbeat, announce, and the liveness scan behind disk I/O."""
-        self._manifest = int(step)  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+        self._manifest = int(step)
         self._cv.notify_all()
 
     def _mirror_manifest(self) -> None:
@@ -399,7 +415,7 @@ class GangCoordinator:
                                     dict(mm))
         return mm
 
-    def _check_fingerprints_locked(self) -> None:
+    def _check_fingerprints_locked(self) -> None:  # guarded-by-caller: _cv
         """Passive cross-rank fingerprint exchange: latch the first pair
         of live ranks whose heartbeat fingerprints disagree.  The barrier
         path enforces; this path makes the mismatch visible in every
@@ -408,11 +424,11 @@ class GangCoordinator:
                        for r, e in self._ranks.items()
                        if e["alive"] and e["fingerprint"] is not None)
         if len({f for _, f in named}) <= 1:
-            self._mismatch = None  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+            self._mismatch = None
             return
         if self._mismatch is not None:
             return
-        self._mismatch = self._find_mismatch(named, "")  # lint-ok: every caller holds _cv (the _locked suffix is the contract)
+        self._mismatch = self._find_mismatch(named, "")
         self._cv.notify_all()
 
     def _gang_view_locked(self) -> dict:
@@ -622,15 +638,25 @@ class GangCoordinator:
         only when every rank arrived with the SAME collective
         fingerprint.  A mismatch refuses the barrier for everyone,
         naming both ranks; a dead rank refuses it with ``degraded``
-        (survivors park instead of hanging inside a collective)."""
+        (survivors park instead of hanging inside a collective).
+
+        Pairing is by SERVER-SIDE arrival order, not the client's step
+        value: each rank's k-th arrival pairs with every peer's k-th.
+        A client-supplied key would desynchronize after an elastic
+        respawn (the fresh process's executor restarts its local count
+        while survivors kept counting — every barrier would then time
+        out); the rejoin path resets all sequences to re-pair from
+        zero, and the client's ``step`` stays in the diagnostics."""
         rank = int(req["rank"])
         step = int(req["step"])
         fp = req.get("fingerprint")
         deadline = time.monotonic() + float(req.get("timeout_s", 60.0))
         with self._cv:
-            self._touch_locked(rank)
+            e = self._touch_locked(rank)
+            seq = e["bseq"]
+            e["bseq"] = seq + 1
             b = self._barriers.setdefault(
-                step, {"fps": {}, "error": None})
+                seq, {"fps": {}, "error": None})
             b["fps"][rank] = fp
             if b["error"] is None:
                 named = sorted((r, f) for r, f in b["fps"].items()
@@ -666,11 +692,19 @@ class GangCoordinator:
                                       "never release"}
                 if len(b["fps"]) >= self.world_size:
                     for s in [s for s in self._barriers
-                              if s < step - 8]:   # bounded history
+                              if s < seq - 8]:    # bounded history
                         del self._barriers[s]
                     return {"ok": True, "released": True}
                 left = deadline - time.monotonic()
                 if left <= 0:
+                    # withdraw the un-released arrival so a RETRY pairs
+                    # at the same sequence the late peers will reach
+                    # (consuming it would leave the gang permanently
+                    # off by one); only when this rank hasn't already
+                    # arrived at a later barrier concurrently
+                    if e["bseq"] == seq + 1:
+                        e["bseq"] = seq
+                        b["fps"].pop(rank, None)
                     return {"ok": False, "error": "timeout",
                             "detail": f"step {step} barrier timed out "
                                       f"with {len(b['fps'])}/"

@@ -138,6 +138,21 @@ _DEFAULTS: Dict[str, Any] = {
     # dispatch never re-verifies; error-severity findings raise
     # ProgramVerificationError at optimize time.
     "FLAGS_program_verify": True,
+    # static HBM budget (paddle_tpu.analysis.memory): when > 0, the
+    # verifier's static peak-memory plan exceeding this many MiB adds a
+    # "memory_budget" warning diagnostic to the verify report (symbolic
+    # -1 dims count as 1, so the estimate is a per-example lower bound).
+    # 0 disables the check.
+    "FLAGS_memory_budget_mb": 0,
+    # automatic per-step gang barrier for the executor's collective
+    # shard_map mode: each dispatched collective step first runs the
+    # coordinator's fingerprint-enforcing step_barrier (socket gang
+    # backend only), so divergent programs refuse BEFORE entering the
+    # collective instead of deadlocking inside it.  Off by default: the
+    # barrier costs one coordinator round trip per step.
+    "FLAGS_gang_step_barrier": False,
+    # step_barrier timeout for the automatic executor barrier above
+    "FLAGS_gang_step_barrier_timeout_s": 60.0,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without

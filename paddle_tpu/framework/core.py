@@ -152,6 +152,10 @@ class Variable:
             "dtype": self.dtype, "type": self.type,
             "persistable": self.persistable, "stop_gradient": self.stop_gradient,
             "is_parameter": self.is_parameter, "trainable": self.trainable,
+            # the feed marker (layers.data sets it post-construction) must
+            # survive serialization: the verifier and the static memory
+            # planner classify feeds by it (tools/analyze.py runs offline)
+            "is_data": bool(getattr(self, "is_data", False)),
         }
 
 
@@ -440,6 +444,8 @@ class Program:
                               regularizer=v.regularizer,
                               need_clip=v.need_clip)
                 nv.seq_len_var = v.seq_len_var
+                if getattr(v, "is_data", False):
+                    nv.is_data = True
                 nb.vars[name] = nv
             for op in b.ops:
                 if for_test and op.attrs.get("op_role") in (
@@ -525,6 +531,8 @@ class Program:
                     stop_gradient=vd["stop_gradient"],
                     is_parameter=vd.get("is_parameter", False),
                     trainable=vd.get("trainable", True))
+                if vd.get("is_data"):
+                    b.vars[name].is_data = True
             for od in bd["ops"]:
                 attrs = {}
                 for k, v in od["attrs"].items():

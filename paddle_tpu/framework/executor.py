@@ -163,6 +163,7 @@ class _DispatchStats:
 _COLL_STEP = _COLLECTIVE_CTR.labels(kind="shard_map_step")
 _COLL_ALLGATHER = _COLLECTIVE_CTR.labels(kind="process_allgather")
 _COLL_H2G = _COLLECTIVE_CTR.labels(kind="host_to_global")
+_COLL_BARRIER = _COLLECTIVE_CTR.labels(kind="step_barrier")
 
 
 def _compile_cache_entries(cache_dir: str) -> int:
@@ -861,6 +862,10 @@ class Executor:
         # non-reentrant lock would self-deadlock there
         self._lock = threading.RLock()
         self._step_seed = 0
+        # FLAGS_gang_step_barrier: monotonic barrier index + memoized
+        # gang client (resolved once; _UNSET = not yet resolved)
+        self._barrier_step = 0
+        self._gang = _UNSET
         self._stats = _DispatchStats()
         # async dispatch throttle: representative output arrays of the last
         # N dispatched steps; run() blocks on the oldest once more than
@@ -1134,6 +1139,12 @@ class Executor:
                         "buffers, so aliased scope entries are invalid — "
                         "np.copy() the value when duplicating it")
 
+        if cb.collective_nranks:
+            # FLAGS_gang_step_barrier: fingerprint-checked gang barrier
+            # BEFORE the dispatch — divergent programs refuse here
+            # (GangFingerprintError naming both ranks) instead of
+            # deadlocking inside the first unpaired collective
+            self._maybe_step_barrier(cb, program)
         self._step_seed += 1
         seed_val = seed if seed is not None else (
             program.random_seed * 1000003 + self._step_seed)
@@ -1297,6 +1308,52 @@ class Executor:
             return out
         stats.incr("lazy_fetch_steps")
         return [FetchHandle(f, stats) for f in fetches]
+
+    def _maybe_step_barrier(self, cb, program):
+        """Automatic per-step gang barrier for collective shard_map
+        dispatches, behind ``FLAGS_gang_step_barrier``: every step first
+        clears the coordinator's fingerprint-enforcing ``step_barrier``
+        (socket gang backend), so a rank whose program diverged — a
+        different collective sequence, including loop-body collectives
+        the block-path-stamped fingerprint now covers — refuses with
+        :class:`GangFingerprintError` BEFORE entering the collective.
+        Without the flag (default) the runner/tests own the barrier
+        cadence, as before PR 7."""
+        from ..flags import get_flags
+        fl = get_flags(["FLAGS_gang_step_barrier",
+                        "FLAGS_gang_step_barrier_timeout_s"])
+        if not fl["FLAGS_gang_step_barrier"]:
+            return
+        gang = self._gang
+        if gang is _UNSET:
+            try:
+                from ..distributed.env import GangRendezvous
+                gang = GangRendezvous.from_env()
+            except ConnectionError:
+                raise      # split coordination plane: fail loud (PR 6)
+            except Exception:
+                gang = None
+            if gang is not None and not hasattr(gang, "step_barrier"):
+                gang = None    # file backend has no liveness plane
+            self._gang = gang
+        if gang is None:
+            return
+        fp = getattr(cb, "gang_fingerprint", _UNSET)
+        if fp is _UNSET:
+            # the optimized program carries the verifier's block-path-
+            # stamped fingerprint in _attrs["verify"] (clone rides it);
+            # fall back to a fresh verify for foreign programs
+            try:
+                from ..analysis.verifier import collective_fingerprint
+                fp = collective_fingerprint(program)
+            except Exception:
+                fp = None
+            cb.gang_fingerprint = fp
+        self._barrier_step += 1
+        gang.step_barrier(
+            self._barrier_step, fingerprint=fp,
+            timeout_s=float(fl["FLAGS_gang_step_barrier_timeout_s"]))
+        _COLL_BARRIER.inc()
 
     def _throttle(self, probe, fetches, new_rw, limit):
         """Bound async run-ahead: remember one output array per dispatched

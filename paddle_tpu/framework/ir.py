@@ -225,6 +225,12 @@ class Graph:
             nop.inputs = {k: list(v) for k, v in op.inputs.items()}
             nop.outputs = {k: list(v) for k, v in op.outputs.items()}
             blk.ops.append(nop)
+        # sub-block rewrites recorded by passes (the Graph itself models
+        # only one block): dead_op_eliminate stores the per-sub-block
+        # dead op indices here and materialization applies them
+        sub_dead = self.attrs.get("dead_subblock_ops")
+        if sub_dead:
+            prune_subblock_ops(out, sub_dead)
         out._bump_version()
         return out
 
@@ -268,6 +274,10 @@ class Graph:
             if name not in referenced and not v.persistable and \
                     not getattr(v, "is_parameter", False):
                 del blk.vars[name]
+        # sub-block rewrites (see to_program) apply to the source too
+        sub_dead = self.attrs.get("dead_subblock_ops")
+        if sub_dead:
+            prune_subblock_ops(src, sub_dead)
         src._bump_version()
         return src
 
@@ -1625,6 +1635,102 @@ def dead_op_analysis(graph: Graph, protected=frozenset()) -> List[Node]:
     return [n for n in graph.op_nodes if n.id not in live]
 
 
+def dead_subblock_op_analysis(program: Program,
+                              protected=frozenset()) -> Dict[int, tuple]:
+    """Per-sub-block liveness: for every block idx > 0, the program-order
+    op indices whose outputs reach none of the block's liveness roots —
+    the sub-block counterpart of :func:`dead_op_analysis`, with the roots
+    adjusted for loop semantics (live loop-carried vars must survive):
+
+    - ops writing a name ANY other block references (carried vars and
+      the condition appear in the enclosing ``while``/``cond`` op's
+      input/output lists, so their writers are roots; so are writers of
+      vars a nested body reads),
+    - ops writing a ``protected`` (fetched) name or any persistable,
+    - side-effecting op types, every ``c_*`` collective, ops carrying a
+      nested Block attr, and ops with no outputs.
+
+    Everything reaching a root through the block's own def-use chains is
+    live; the rest is dead body compute nothing observes (its outputs
+    feed no carry, no fetch, no persistable — it burns trace time and
+    loop FLOPs every iteration).  Returns {block_idx: (op indices...)}
+    for blocks with at least one dead op."""
+    from .core import Block as _Block
+    out: Dict[int, tuple] = {}
+    for block in program.blocks[1:]:
+        # names referenced by ANY op outside this block (enclosing
+        # control-flow ops list carried vars / Condition / Out there)
+        ext_refs = set()
+        for other in program.blocks:
+            if other.idx == block.idx:
+                continue
+            for op in other.ops:
+                ext_refs.update(op.input_arg_names())
+                ext_refs.update(op.output_arg_names())
+                for v in op.attrs.values():
+                    if isinstance(v, _Block) and v.idx == block.idx:
+                        # the enclosing op's attr lists (carried_vars,
+                        # cond_var, state_vars...) reference body names
+                        # without appearing in its input/output slots
+                        for av in op.attrs.values():
+                            if isinstance(av, (list, tuple)):
+                                ext_refs.update(
+                                    x for x in av if isinstance(x, str))
+                            elif isinstance(av, str):
+                                ext_refs.add(av)
+
+        def persistable(name, _b=block):
+            return _b.has_var(name) and _b.var(name).persistable
+
+        def is_root(op) -> bool:
+            if op.type in SIDE_EFFECT_OPS or op.type.startswith("c_"):
+                return True
+            if any(isinstance(v, _Block) for v in op.attrs.values()):
+                return True
+            outs = [n for n in op.output_arg_names() if n]
+            if not outs:
+                return True
+            return any(n in protected or n in ext_refs or persistable(n)
+                       for n in outs)
+
+        live = {i for i, op in enumerate(block.ops) if is_root(op)}
+        # backward closure over the block's own def-use: any op writing
+        # a name a live op reads is live (conservative on rewrites)
+        changed = True
+        while changed:
+            changed = False
+            needed = {n for i in live
+                      for n in block.ops[i].input_arg_names() if n}
+            for i, op in enumerate(block.ops):
+                if i in live:
+                    continue
+                if needed & {n for n in op.output_arg_names() if n}:
+                    live.add(i)
+                    changed = True
+        dead = tuple(i for i in range(len(block.ops)) if i not in live)
+        if dead:
+            out[block.idx] = dead
+    return out
+
+
+def prune_subblock_ops(program: Program,
+                       dead_map: Dict[int, tuple]) -> int:
+    """Drop the ops named by :func:`dead_subblock_op_analysis` from
+    ``program``'s sub-blocks (in place).  Returns the removal count."""
+    removed = 0
+    for idx, indices in (dead_map or {}).items():
+        if idx <= 0 or idx >= len(program.blocks):
+            continue
+        block = program.blocks[idx]
+        doomed = set(indices)
+        kept = [op for i, op in enumerate(block.ops) if i not in doomed]
+        removed += len(block.ops) - len(kept)
+        block.ops = kept
+    if removed:
+        program._bump_version()
+    return removed
+
+
 @register_pass("dead_op_eliminate")
 class DeadOpEliminatePass(Pass):
     """Remove ops unreachable from the fetch/persistable/side-effect
@@ -1634,7 +1740,14 @@ class DeadOpEliminatePass(Pass):
     shape inference time) and keeping donation/liveness analyses honest.
     ``protected`` names the fetch targets, same contract as the fusion
     passes; removal count lands in
-    ``graph.attrs['dead_op_eliminate_count']``."""
+    ``graph.attrs['dead_op_eliminate_count']``.
+
+    Sub-blocks too: dead compute inside ``while``/``cond`` bodies
+    (:func:`dead_subblock_op_analysis` — live loop-carried vars always
+    survive) is recorded in ``graph.attrs['dead_subblock_ops']`` and
+    pruned when the graph materializes via :meth:`Graph.to_program` /
+    :meth:`Graph.apply_to_program`; the count adds into
+    ``dead_op_eliminate_count``."""
 
     def apply_impl(self, graph: Graph) -> Graph:
         dead = dead_op_analysis(graph, self.protected_vars())
@@ -1642,7 +1755,11 @@ class DeadOpEliminatePass(Pass):
         # a backward closure), so the output var nodes go with their ops
         doomed_vars = [v for n in dead for v in n.outputs]
         graph.safe_remove_nodes(list(dead) + doomed_vars)
-        graph.attrs["dead_op_eliminate_count"] = len(dead)
+        sub_dead = dead_subblock_op_analysis(graph.program,
+                                             self.protected_vars())
+        graph.attrs["dead_subblock_ops"] = sub_dead
+        graph.attrs["dead_op_eliminate_count"] = \
+            len(dead) + sum(len(v) for v in sub_dead.values())
         return graph
 
 

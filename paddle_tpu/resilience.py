@@ -636,8 +636,11 @@ class Watchdog:
         self.escalate = ""
 
     def set_timeout(self, secs: float) -> None:
-        self.timeout_s = float(secs)
         with self._cv:
+            # the predicate the monitor loop wakes on changes UNDER the
+            # lock (the concurrency lint's Condition contract): a notify
+            # with no state change wakes waiters to an unchanged world
+            self.timeout_s = float(secs)
             self._cv.notify()
 
     def _abort_grace(self) -> float:
@@ -883,14 +886,38 @@ class CheckpointDaemon:
         self._stop = threading.Event()
         self._pending: Optional[tuple] = \
             None  # guarded-by: _mu  ((step, state, kind))
-        self._last_capture_step = 0
+        # phase alignment: a FRESH daemon on a respawned rank must
+        # continue the gang's ORIGINAL step cadence, not restart it at
+        # the resume step — a zero anchor would make its first capture
+        # land at resume+1 (then resume+1+interval, ...) while its peers
+        # keep capturing at interval multiples, so committed step sets
+        # drift uneven across ranks and commit_latest's intersection
+        # stops advancing.  Anchor to the restored checkpoint step (the
+        # gang-manifest step after _resume_gang's prune), which is
+        # exactly the step every peer last captured.  Cold starts see no
+        # checkpoint -> anchor 0, the pre-PR-7 behavior.  Corollary: a
+        # run REUSING a non-empty checkpoint dir without resuming from
+        # it inherits the stale anchor — but that configuration never
+        # worked (orbax refuses saves at indices <= its latest step, so
+        # low-step captures were silently dropped before too); start
+        # fresh dirs fresh, or resume via resume_or_init.
+        anchor = 0
+        try:
+            if checkpoint is not None and \
+                    hasattr(checkpoint, "latest_step"):
+                anchor = int(checkpoint.latest_step() or 0)
+        except Exception:
+            anchor = 0
+        self._last_capture_step = anchor
         self._last_capture_t = time.monotonic()
         self._last_committed: Optional[int] = None
         self._last_save_s = 0.0  # guarded-by: _mu  (daemon writes, due() reads)
         self._stretch_noted = False     # training thread only
         self._thread: Optional[threading.Thread] = None
         self._hooked: list = []
-        self._auto_step = 0
+        # attach()-mode steps continue the global numbering from the
+        # anchor too, so a respawned attach-driven rank stays on phase
+        self._auto_step = anchor
         self.error: Optional[BaseException] = None
 
     # -- wiring --------------------------------------------------------------

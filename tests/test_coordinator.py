@@ -607,3 +607,231 @@ def test_elastic_rank_kill9_respawn_exact_loss_parity(tmp_path):
         np.array([base[i] for i in range(total)], np.float32))
     # both lives finished cleanly: the respawned rank printed DONE
     assert "DONE" in out1, dbg
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_gang_step_barrier: automatic per-step enforcement in the
+# executor's collective shard_map mode (PR 7)
+# ---------------------------------------------------------------------------
+
+def _collective_barrier_prog():
+    from paddle_tpu.framework.core import Program
+    prog = Program()
+    blk = prog.global_block()
+    x = blk.create_var(name="gsb_x", shape=(-1, 4), dtype="float32")
+    x.is_data = True
+    blk.create_var(name="gsb_out", shape=(-1, 4), dtype="float32")
+    blk.append_op("c_allreduce_sum", inputs={"X": ["gsb_x"]},
+                  outputs={"Out": ["gsb_out"]}, attrs={"ring_id": 0})
+    # single-device collective shard_map mode (psum over a 1-wide dp
+    # axis is the identity — the barrier plumbing is what's under test)
+    prog._attrs["collective"] = {"nranks": 1, "rank": 0}
+    return prog
+
+
+def _barrier_env(monkeypatch, coord):
+    import paddle_tpu as pt
+    monkeypatch.setenv("PADDLE_GANG_COORD", coord.address)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.delenv("PADDLE_GANG_DIR", raising=False)
+    pt.set_flags({"FLAGS_gang_step_barrier": True,
+                  "FLAGS_gang_step_barrier_timeout_s": 30.0})
+
+
+def test_executor_step_barrier_refuses_mismatch_before_dispatch(
+        monkeypatch):
+    """Acceptance: with FLAGS_gang_step_barrier on, a rank whose peer
+    reports a different collective fingerprint refuses the step with
+    GangFingerprintError BEFORE dispatching it (zero dispatches)."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import Executor
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=60).start()
+    try:
+        _barrier_env(monkeypatch, coord)
+        prog = _collective_barrier_prog()
+        exe = Executor()
+        peer = GangClient(coord.address, rank=1, world_size=2).connect()
+        peer_err = []
+
+        def rank1():
+            try:
+                peer.step_barrier(1, "sha1:divergent-peer", timeout_s=30)
+            except GangFingerprintError as e:
+                peer_err.append(e)
+
+        t = threading.Thread(target=rank1, daemon=True)
+        t.start()
+        before = _totals().get("paddle_tpu_executor_steps_dispatched", 0)
+        with pytest.raises(GangFingerprintError) as ei:
+            exe.run(prog, feed={"gsb_x": np.ones((2, 4), np.float32)},
+                    fetch_list=["gsb_out"])
+        t.join(timeout=30)
+        assert "rank 0" in str(ei.value) and "rank 1" in str(ei.value)
+        after = _totals().get("paddle_tpu_executor_steps_dispatched", 0)
+        assert after == before            # refused BEFORE the dispatch
+        assert peer_err                   # ...on both sides
+    finally:
+        pt.set_flags({"FLAGS_gang_step_barrier": False})
+        coord.stop()
+
+
+def test_executor_step_barrier_releases_on_matching_fingerprints(
+        monkeypatch):
+    import paddle_tpu as pt
+    from paddle_tpu.analysis.verifier import collective_fingerprint
+    from paddle_tpu.framework import Executor
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=60).start()
+    try:
+        _barrier_env(monkeypatch, coord)
+        prog = _collective_barrier_prog()
+        fp = collective_fingerprint(prog)
+        assert fp
+        exe = Executor()
+        peer = GangClient(coord.address, rank=1, world_size=2).connect()
+        done = []
+
+        def rank1():
+            for step in (1, 2):
+                peer.step_barrier(step, fp, timeout_s=30)
+                done.append(step)
+
+        t = threading.Thread(target=rank1, daemon=True)
+        t.start()
+        before = _totals().get(
+            "paddle_tpu_collective_launches_total", 0)
+        feed = {"gsb_x": np.ones((2, 4), np.float32)}
+        for _ in range(2):
+            out, = exe.run(prog, feed=feed, fetch_list=["gsb_out"])
+            # nranks=1 psum = identity; fetches come back rank-stacked
+            np.testing.assert_allclose(
+                np.asarray(out).reshape(2, 4), feed["gsb_x"])
+        t.join(timeout=30)
+        assert done == [1, 2]
+        after = _totals().get("paddle_tpu_collective_launches_total", 0)
+        assert after - before >= 4        # 2 steps + 2 barriers
+    finally:
+        pt.set_flags({"FLAGS_gang_step_barrier": False})
+        coord.stop()
+
+
+def test_step_barrier_flag_off_no_coordinator_roundtrip(monkeypatch):
+    """Default-off: collective dispatches never touch the gang plane
+    (no coordinator configured, no error, no barrier counter bump)."""
+    import paddle_tpu as pt
+    from paddle_tpu import monitor as _m
+    from paddle_tpu.framework import Executor
+    monkeypatch.delenv("PADDLE_GANG_COORD", raising=False)
+    monkeypatch.delenv("PADDLE_GANG_DIR", raising=False)
+    prog = _collective_barrier_prog()
+    exe = Executor()
+    fam = _m.REGISTRY.get("paddle_tpu_collective_launches_total")
+    before = fam.value(kind="step_barrier") if fam else 0
+    out, = exe.run(prog, feed={"gsb_x": np.ones((2, 4), np.float32)},
+                   fetch_list=["gsb_out"])
+    after = fam.value(kind="step_barrier") if fam else 0
+    assert after == before
+
+
+def test_subblock_fingerprint_round_trips_through_heartbeat():
+    """Acceptance: a while-body collective's block-path-stamped
+    fingerprint rides the heartbeat exchange — the coordinator stores
+    it per rank, and two ranks diverging ONLY inside the loop body
+    latch a mismatch every client can see via check()."""
+    from paddle_tpu.framework.core import Program
+
+    def body_prog(chained):
+        prog = Program()
+        blk = prog.global_block()
+        acc = blk.create_var(name="hb_acc", shape=(4,), dtype="float32")
+        cond = blk.create_var(name="hb_c", shape=(1,), dtype="bool")
+        blk.append_op("fill_constant", outputs={"Out": [acc]},
+                      attrs={"shape": [4], "dtype": "float32",
+                             "value": 0.0})
+        blk.append_op("fill_constant", outputs={"Out": [cond]},
+                      attrs={"shape": [1], "dtype": "bool", "value": 1.0})
+        sub = prog._create_block()
+        sub.create_var(name="hb_a", shape=(4,), dtype="float32")
+        sub.append_op("c_allreduce_sum", inputs={"X": ["hb_acc"]},
+                      outputs={"Out": ["hb_a"]}, attrs={"ring_id": 0})
+        if chained:
+            sub.append_op("c_allreduce_max", inputs={"X": ["hb_a"]},
+                          outputs={"Out": ["hb_acc"]},
+                          attrs={"ring_id": 0})
+        else:
+            sub.append_op("assign", inputs={"X": ["hb_a"]},
+                          outputs={"Out": ["hb_acc"]})
+        prog._rollback()
+        blk.append_op("while",
+                      inputs={"Condition": ["hb_c"], "X": ["hb_acc"]},
+                      outputs={"Out": ["hb_acc"]},
+                      attrs={"sub_block": sub,
+                             "carried_vars": ["hb_acc", "hb_c"],
+                             "cond_var": "hb_c"})
+        return prog
+
+    from paddle_tpu.analysis.verifier import collective_fingerprint
+    fp0 = collective_fingerprint(body_prog(True))
+    fp1 = collective_fingerprint(body_prog(False))
+    assert fp0 and fp1 and fp0 != fp1     # body-only divergence visible
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=60).start()
+    try:
+        c0 = GangClient(coord.address, rank=0, world_size=2).connect()
+        c1 = GangClient(coord.address, rank=1, world_size=2).connect()
+        c0._rpc({"op": "heartbeat", "fingerprint": fp0})
+        # round trip: the coordinator's status echoes the exact value
+        st = c0.status()
+        assert st["ranks"]["0"]["fingerprint"] == fp0
+        c0.check()                        # single report: no mismatch
+        c1._rpc({"op": "heartbeat", "fingerprint": fp1})
+        resp = c0._rpc({"op": "heartbeat", "fingerprint": fp0})
+        c0._absorb_view(resp)
+        with pytest.raises(GangFingerprintError) as ei:
+            c0.check()
+        assert fp0[:8] in str(ei.value) or "rank 0" in str(ei.value)
+        c0.close(goodbye=False)
+        c1.close(goodbye=False)
+    finally:
+        coord.stop()
+
+
+def test_step_barrier_repairs_after_elastic_respawn():
+    """Review regression: barriers pair by server-side arrival order,
+    and a rejoin resets every rank's sequence — a respawned rank whose
+    local barrier count restarted must still pair with a survivor that
+    kept counting (client step values are diagnostics only)."""
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=60).start()
+    try:
+        c0 = GangClient(coord.address, rank=0, world_size=2).connect()
+        c1 = GangClient(coord.address, rank=1, world_size=2).connect()
+        # a few pre-death barriers advance rank 0's server sequence
+        for step in (1, 2):
+            t = threading.Thread(
+                target=lambda s=step: c1.step_barrier(s, "fp"),
+                daemon=True)
+            t.start()
+            c0.step_barrier(step, "fp", timeout_s=10)
+            t.join(timeout=10)
+        # rank 1 dies (declared dead) and respawns with a FRESH local
+        # barrier count
+        with coord._cv:
+            coord._ranks[1]["alive"] = False
+            coord._ranks[1]["deaths"] += 1
+        c1b = GangClient(coord.address, rank=1, world_size=2).connect()
+        # survivor arrives with its CONTINUED count (step 3), respawn
+        # with its restarted count (step 1): they must still pair
+        done = []
+
+        def respawned():
+            c1b.step_barrier(1, "fp", timeout_s=15)
+            done.append(True)
+
+        t = threading.Thread(target=respawned, daemon=True)
+        t.start()
+        c0.step_barrier(3, "fp", timeout_s=15)   # would deadlock before
+        t.join(timeout=15)
+        assert done == [True]
+        c0.close(goodbye=False)
+        c1b.close(goodbye=False)
+    finally:
+        coord.stop()

@@ -280,3 +280,188 @@ def test_cli_exit_codes(tmp_path):
     r = subprocess.run([sys.executable, str(TOOL), str(REPO / "paddle_tpu")],
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# guarded-by-caller
+# ---------------------------------------------------------------------------
+
+def test_guarded_by_caller_trips_on_unlocked_call_site(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def _push_locked(self, x):  # guarded-by-caller: _lock
+                self._items.append(x)
+
+            def good(self, x):
+                with self._lock:
+                    self._push_locked(x)
+
+            def bad(self, x):
+                self._push_locked(x)
+    """)
+    assert _rules(vs) == ["guarded-by-caller"]
+    assert len(vs) == 1 and "without holding '_lock'" in vs[0].message
+
+
+def test_guarded_by_caller_near_miss_all_callers_locked_clean(tmp_path):
+    """The mutation inside the annotated helper needs NO per-line
+    suppression, and locked callers satisfy the contract."""
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def _push_locked(self, x):  # guarded-by-caller: _lock
+                self._items.append(x)
+
+            def one(self, x):
+                with self._lock:
+                    self._push_locked(x)
+
+            def two(self, x):
+                with self._lock:
+                    self._push_locked(x + 1)
+    """)
+    assert vs == []
+
+
+def test_guarded_by_caller_propagates_through_annotated_helpers(tmp_path):
+    """A *_locked helper calling another *_locked helper is clean when
+    both assert the same lock (the coordinator pattern)."""
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def _push_locked(self, x):  # guarded-by-caller: _lock
+                self._items.append(x)
+
+            def _push_two_locked(self, x):  # guarded-by-caller: _lock
+                self._push_locked(x)
+                self._push_locked(x + 1)
+
+            def entry(self, x):
+                with self._lock:
+                    self._push_two_locked(x)
+    """)
+    assert vs == []
+
+
+def test_guarded_by_caller_trips_when_unverifiable(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def _push_locked(self, x):  # guarded-by-caller: _lock
+                self._items.append(x)
+    """)
+    assert _rules(vs) == ["guarded-by-caller"]
+    assert "no same-module caller" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# cond-misuse (Condition-vs-Lock)
+# ---------------------------------------------------------------------------
+
+def test_cond_wait_notify_outside_with_trips(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition(threading.Lock())
+                self.ready = False
+
+            def bad_wait(self):
+                self._cv.wait(timeout=1)
+
+            def bad_notify(self):
+                self.ready = True
+                self._cv.notify_all()
+    """)
+    assert _rules(vs) == ["cond-misuse"]
+    assert len(vs) == 2
+    msgs = " ".join(v.message for v in vs)
+    assert "outside `with _cv:`" in msgs
+
+
+def test_cond_near_miss_locked_wait_and_event_clean(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition(threading.Lock())
+                self._stop = threading.Event()
+                self.ready = False
+
+            def wake(self):
+                with self._cv:
+                    self.ready = True
+                    self._cv.notify_all()
+
+            def wait(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait(timeout=0.1)
+
+            def sleepy(self):
+                self._stop.wait(1.0)      # Event.wait needs no lock
+    """)
+    assert vs == []
+
+
+def test_cond_notify_without_state_change_trips(tmp_path):
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition(threading.Lock())
+                self.ready = False
+
+            def wake(self):
+                self.ready = True          # predicate changed OUTSIDE
+                with self._cv:
+                    self._cv.notify_all()
+    """)
+    assert _rules(vs) == ["cond-misuse"]
+    assert "no state change under the lock" in vs[0].message
+
+
+def test_cond_notify_in_caller_guarded_helper_clean(tmp_path):
+    """The coordinator pattern: a guarded-by-caller helper that changes
+    state and notifies is clean, callers hold the condition."""
+    vs = _lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition(threading.Lock())
+                self.step = None  # guarded-by: _cv
+
+            def _publish_locked(self, step):  # guarded-by-caller: _cv
+                self.step = step
+                self._cv.notify_all()
+
+            def publish(self, step):
+                with self._cv:
+                    self._publish_locked(step)
+    """)
+    assert vs == []

@@ -1,0 +1,215 @@
+"""Static HBM peak-memory planner (``paddle_tpu.analysis.memory``):
+hand-computable liveness intervals, donation/alias awareness, sub-block
+transients, fingerprint caching, the verifier's ``memory_budget`` wiring,
+and the ``_attrs["verify"]["memory"]`` stamp."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, monitor
+from paddle_tpu.analysis import plan_memory, verify_program
+from paddle_tpu.analysis import memory as amem
+from paddle_tpu.framework.core import Operator, Program, program_guard
+
+
+def _fresh():
+    return program_guard(Program(), Program())
+
+
+def _raw_op(block, typ, inputs, outputs, attrs=None):
+    """Append without build-time inference — shapes are hand-declared."""
+    op = Operator(block, typ, None, None, attrs or {})
+    op.inputs = {k: list(v) for k, v in inputs.items()}
+    op.outputs = {k: list(v) for k, v in outputs.items()}
+    block.ops.append(op)
+    block.program._bump_version()
+    return op
+
+
+def _chain_prog():
+    """x(feed,[B,4]f32) -> sigmoid -> a -> sigmoid -> b.  sigmoid is NOT
+    an inplace op, so every interval is plain and hand-computable."""
+    prog = Program()
+    blk = prog.global_block()
+    x = blk.create_var(name="mp_x", shape=(-1, 4), dtype="float32")
+    x.is_data = True
+    blk.create_var(name="mp_a", shape=(-1, 4), dtype="float32")
+    blk.create_var(name="mp_b", shape=(-1, 4), dtype="float32")
+    _raw_op(blk, "sigmoid", {"X": ["mp_x"]}, {"Out": ["mp_a"]})
+    _raw_op(blk, "sigmoid", {"X": ["mp_a"]}, {"Out": ["mp_b"]})
+    return prog
+
+
+def test_hand_computed_intervals_and_peak():
+    # batch=2: every var is 2*4*4 = 32 B.
+    # resident: feed x (32) all step.  a: def op0, last use op1.
+    # b: def op1, fetched -> pinned to end (pos 2).
+    # live: op0 = x+a = 64; op1 = x+a+b = 96; end = x+b = 64.
+    plan = plan_memory(_chain_prog(), ("mp_b",), batch_size=2)
+    assert plan.resident_bytes == 32
+    assert plan.peak_bytes == 96 and plan.peak_pos == 1
+    assert plan.peak_op == "sigmoid"
+    assert plan.steady_bytes == 64            # x + pinned fetch b
+    assert plan.intervals["mp_a"] == (0, 1, 32)
+    assert plan.intervals["mp_b"][0] == 1
+    assert plan.intervals["mp_b"][1] == 2     # pinned past the last op
+    # per-op table in dependency order with the hand numbers
+    assert [(p, b) for p, _, b, _ in plan.per_op] == [(0, 64), (1, 96)]
+
+
+def test_unfetched_tail_dies_at_last_use():
+    # b unfetched: its interval ends at its producer -> end-of-step live
+    # set is the feed alone
+    plan = plan_memory(_chain_prog(), (), batch_size=2)
+    assert plan.steady_bytes == 32
+    assert plan.intervals["mp_b"][1] == 1
+
+
+def test_symbolic_dims_resolve_through_batch_size():
+    p1 = plan_memory(_chain_prog(), ("mp_b",), batch_size=1)
+    p8 = plan_memory(_chain_prog(), ("mp_b",), batch_size=8)
+    assert p8.peak_bytes == 8 * p1.peak_bytes
+
+
+def test_donated_rw_persistable_counts_once():
+    """A param read AND written (sgd) is one buffer under donation: the
+    plan charges it once, not input+output."""
+    with _fresh():
+        x = layers.data("dp_x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4, name="dp_fc"))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        prog = fluid.default_main_program()
+        blk = prog.global_block()
+        w = blk.var("dp_fc.w_0")
+        w_bytes = 4 * 4 * 4
+        plan = plan_memory(prog, (loss.name,), batch_size=1)
+        persist = [(n, b) for n, b, kind in plan.peak_live
+                   if kind == "persist" and n == "dp_fc.w_0"]
+        assert persist == [("dp_fc.w_0", w_bytes)]
+        # resident = every persistable once + the feed
+        expect = sum(
+            amem._var_bytes(v, 1) for v in blk.vars.values()
+            if v.persistable) + amem._var_bytes(blk.var("dp_x"), 1)
+        assert plan.resident_bytes == expect
+
+
+def test_fetched_rw_persistable_adds_defensive_copy():
+    """Fetching a donated rw persistable costs ONE extra buffer (the
+    executor's donation-aliasing jnp.copy) at the step boundary."""
+    with _fresh():
+        x = layers.data("fc_x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4, name="fcp"))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        prog = fluid.default_main_program()
+        base = plan_memory(prog, (loss.name,), batch_size=1)
+        both = plan_memory(prog, (loss.name, "fcp.w_0"), batch_size=1)
+        w_bytes = 4 * 4 * 4
+        assert both.steady_bytes == base.steady_bytes + w_bytes
+
+
+def test_inplace_alias_not_double_counted():
+    """relu is an inplace op: its output shares the dying input's buffer
+    (buffer_shared_inplace_pass), so the chain's peak never counts both."""
+    prog = Program()
+    blk = prog.global_block()
+    x = blk.create_var(name="al_x", shape=(-1, 4), dtype="float32")
+    x.is_data = True
+    blk.create_var(name="al_y", shape=(-1, 4), dtype="float32")
+    _raw_op(blk, "relu", {"X": ["al_x"]}, {"Out": ["al_y"]})
+    plan = plan_memory(prog, ("al_y",), batch_size=2)
+    # y aliases the feed's buffer: peak is the feed alone
+    assert plan.peak_bytes == 32
+
+
+def test_subblock_local_temps_count_at_enclosing_op():
+    """A while body's local temporaries add their peak at the while op's
+    position; carried (parent) vars are not double counted."""
+    prog = Program()
+    blk = prog.global_block()
+    acc = blk.create_var(name="sb_acc", shape=(4,), dtype="float32")
+    cond = blk.create_var(name="sb_c", shape=(1,), dtype="bool")
+    _raw_op(blk, "fill_constant", {}, {"Out": ["sb_acc"]},
+            {"shape": [4], "dtype": "float32", "value": 0.0})
+    _raw_op(blk, "fill_constant", {}, {"Out": ["sb_c"]},
+            {"shape": [1], "dtype": "bool", "value": 1.0})
+    sub = prog._create_block()
+    sub.create_var(name="sb_tmp", shape=(8, 8), dtype="float32")  # 256 B
+    _raw_op(sub, "sigmoid", {"X": ["sb_acc"]}, {"Out": ["sb_tmp"]})
+    _raw_op(sub, "reduce_mean_shim", {"X": ["sb_tmp"]},
+            {"Out": ["sb_acc"]})
+    prog._rollback()
+    _raw_op(blk, "while", {"Condition": ["sb_c"], "X": ["sb_acc"]},
+            {"Out": ["sb_acc"]},
+            {"sub_block": sub, "carried_vars": ["sb_acc", "sb_c"],
+             "cond_var": "sb_c"})
+    plan = plan_memory(prog, ("sb_acc",), batch_size=1)
+    while_rows = [r for r in plan.per_op if r[1] == "while"]
+    assert while_rows and while_rows[0][3] == 256   # body-local transient
+    assert plan.peak_bytes >= 256
+
+
+def test_plan_cached_on_fingerprint():
+    fam = monitor.REGISTRY.get("paddle_tpu_memory_plans_total")
+    prog = _chain_prog()
+    p1 = plan_memory(prog, ("mp_b",), batch_size=2)
+    hits = fam.value(cache="hit")
+    p2 = plan_memory(prog, ("mp_b",), batch_size=2)
+    assert p2 is p1 and fam.value(cache="hit") == hits + 1
+    # a mutation re-plans
+    blk = prog.global_block()
+    blk.create_var(name="mp_c", shape=(-1, 4), dtype="float32")
+    _raw_op(blk, "sigmoid", {"X": ["mp_b"]}, {"Out": ["mp_c"]})
+    misses = fam.value(cache="miss")
+    plan_memory(prog, ("mp_b",), batch_size=2)
+    assert fam.value(cache="miss") == misses + 1
+
+
+def test_verifier_stamps_memory_into_attrs():
+    prog = _chain_prog()
+    verify_program(prog, ("mp_b",))
+    va = prog._attrs["verify"]["memory"]
+    # verifier plans at batch=1: half the batch=2 hand numbers
+    assert va["peak_bytes"] == 48 and va["resident_bytes"] == 16
+    assert va["steady_bytes"] == 32
+    assert va["top_ops"] and va["peak_op"] == "sigmoid"
+
+
+def test_memory_budget_warning_fires_and_clears():
+    with _fresh():
+        x = layers.data("mb_x", shape=[1024], dtype="float32")
+        # 1024x1024 f32 param = 4 MiB > the 1 MiB budget below
+        loss = layers.mean(layers.fc(x, size=1024, name="mb_fc"))
+        prog = fluid.default_main_program()
+        fluid.set_flags({"FLAGS_memory_budget_mb": 1})
+        try:
+            r = verify_program(prog, (loss.name,))
+            d, = r.by_check("memory_budget")
+            assert d.severity == "warning"
+            assert "FLAGS_memory_budget_mb=1" in d.message
+        finally:
+            fluid.set_flags({"FLAGS_memory_budget_mb": 0})
+        # near-miss: budget off (0) -> no finding on a fresh verify
+        prog._bump_version()
+        assert verify_program(prog,
+                              (loss.name,)).by_check("memory_budget") \
+            == []
+
+
+def test_report_renders_attribution_table():
+    plan = plan_memory(_chain_prog(), ("mp_b",), batch_size=2)
+    txt = plan.report(5)
+    assert "static HBM plan (batch=2)" in txt
+    assert "hbm_peak" in txt and "live while this op runs" in txt
+    assert "96.00 B" in txt
+
+
+def test_report_smoke_on_real_training_program():
+    with _fresh():
+        x = layers.data("rt_x", shape=[16], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=8))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        prog = fluid.default_main_program()
+        plan = plan_memory(prog, (loss.name,), batch_size=4)
+        assert plan.peak_bytes >= plan.resident_bytes > 0
+        assert len(plan.per_op) == len(plan.top_ops(1000))
+        assert plan.report()

@@ -1251,3 +1251,30 @@ def test_preemption_sigterm_kill_then_resume_matches_uninterrupted(
     np.testing.assert_array_equal(
         np.array([combined[i] for i in range(total)], np.float32),
         np.array([base[i] for i in range(total)], np.float32))
+
+
+def test_checkpoint_daemon_phase_aligns_to_manifest_step(tmp_path):
+    """PR-6 respawn bug: a FRESH daemon restarted its cadence from zero,
+    so a respawned rank's first capture landed at resume+1 (then
+    resume+1+interval, ...) while its peers kept capturing at interval
+    multiples — committed step sets drifted uneven across ranks.  The
+    daemon now anchors its cadence to the restored (manifest) step."""
+    ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+    assert ckpt.save_arrays(4, {"pw": np.zeros(2, np.float32)})
+    ckpt.commit(kind="rank")
+    # respawned-rank daemon: fresh object, checkpoint holds step 4
+    daemon = res.CheckpointDaemon(ckpt, interval_steps=2, interval_secs=0)
+    assert daemon._last_capture_step == 4
+    assert daemon._auto_step == 4            # attach-mode numbering too
+    assert not daemon.due(5)                 # off-phase: would drift
+    assert daemon.due(6)                     # on the original cadence
+    ckpt.close()
+
+
+def test_checkpoint_daemon_cold_start_cadence_unchanged(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "run"), max_to_keep=10)
+    daemon = res.CheckpointDaemon(ckpt, interval_steps=3, interval_secs=0)
+    assert daemon._last_capture_step == 0 and daemon._auto_step == 0
+    assert not daemon.due(2)
+    assert daemon.due(3)
+    ckpt.close()

@@ -510,3 +510,356 @@ def test_steady_state_dispatch_never_reverifies():
                     return_numpy=False)
         exe.drain()
         assert (fam.value(cache="hit"), fam.value(cache="miss")) == runs
+
+
+# ---------------------------------------------------------------------------
+# sub-block (while/cond body) verification — PR 7 tentpole
+# ---------------------------------------------------------------------------
+
+def _while_body_prog(chained=True, with_collectives=True):
+    """A hand-built while program: block 0 declares the carry, the body
+    launches collectives (chained when ``chained``) and re-writes the
+    carry.  Returns (prog, sub_block)."""
+    prog = Program()
+    blk = prog.global_block()
+    acc = blk.create_var(name="wb_acc", shape=(4,), dtype="float32")
+    cond = blk.create_var(name="wb_cond", shape=(1,), dtype="bool")
+    blk.append_op("fill_constant", outputs={"Out": [acc]},
+                  attrs={"shape": [4], "dtype": "float32", "value": 0.0})
+    blk.append_op("fill_constant", outputs={"Out": [cond]},
+                  attrs={"shape": [1], "dtype": "bool", "value": 1.0})
+    sub = prog._create_block()
+    if with_collectives:
+        a_out = sub.create_var(name="wb_a", shape=(4,), dtype="float32")
+        b_out = sub.create_var(name="wb_b", shape=(4,), dtype="float32")
+        sub.append_op("c_allreduce_sum", inputs={"X": ["wb_acc"]},
+                      outputs={"Out": ["wb_a"]}, attrs={"ring_id": 0})
+        sub.append_op("c_allreduce_sum",
+                      inputs={"X": ["wb_a" if chained else "wb_acc"]},
+                      outputs={"Out": ["wb_b"]}, attrs={"ring_id": 0})
+        sub.append_op("assign", inputs={"X": ["wb_b"]},
+                      outputs={"Out": ["wb_acc"]})
+    else:
+        sub.append_op("scale", inputs={"X": ["wb_acc"]},
+                      outputs={"Out": ["wb_acc"]}, attrs={"scale": 2.0})
+    prog._rollback()
+    blk.append_op("while",
+                  inputs={"Condition": ["wb_cond"], "X": ["wb_acc"]},
+                  outputs={"Out": ["wb_acc"]},
+                  attrs={"sub_block": sub,
+                         "carried_vars": ["wb_acc", "wb_cond"],
+                         "cond_var": "wb_cond"})
+    return prog, sub
+
+
+def test_subblock_def_before_use_trips_with_block_path():
+    prog, sub = _while_body_prog(with_collectives=False)
+    sub.ops[0].inputs["X"] = ["wb_ghost"]       # seeded body defect
+    prog._bump_version()
+    d, = _findings(prog, "def_before_use", fetch=("wb_acc",))
+    assert d.severity == "error" and d.var == "wb_ghost"
+    assert d.block and d.block.startswith("0/while@") and \
+        d.block.endswith(f"/{sub.idx}")
+    # ...and the block path renders in the formatted report
+    from paddle_tpu import debugger
+    assert f"block {d.block}" in debugger.format_diagnostics([d])
+
+
+def test_subblock_outer_defs_visible_inner_defs_scoped():
+    # near-miss: the body reads wb_acc, defined in block 0 BEFORE the
+    # while — outer defs are visible, no finding
+    prog, sub = _while_body_prog(with_collectives=False)
+    r = verify_program(prog, ("wb_acc",))
+    assert r.by_check("def_before_use") == []
+    assert r.by_check("uninitialized_read") == []
+    # trip: a block-0 op reading a BODY-LOCAL name — inner defs are
+    # scoped to the body and must not leak out
+    prog2, sub2 = _while_body_prog(with_collectives=True)
+    blk = prog2.global_block()
+    out = blk.create_var(name="wb_leak", shape=(4,), dtype="float32")
+    op = blk.ops[-1]
+    leak = fluid.framework.core.Operator(
+        blk, "relu", None, None, {})
+    leak.inputs = {"X": ["wb_a"]}               # body-local temp
+    leak.outputs = {"Out": ["wb_leak"]}
+    blk.ops.append(leak)
+    prog2._bump_version()
+    ds = _findings(prog2, "def_before_use", fetch=("wb_leak",))
+    assert any(d.var == "wb_a" and (d.block in (None, "0"))
+               for d in ds)
+
+
+def test_subblock_loop_carried_read_is_not_uninitialized():
+    """A body read of a var some body op writes LATER is the loop carry
+    (iteration n reads n-1's write) — never uninitialized_read."""
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var(name="lc_x", shape=(4,), dtype="float32")
+    cond = blk.create_var(name="lc_cond", shape=(1,), dtype="bool")
+    blk.append_op("fill_constant", outputs={"Out": ["lc_x"]},
+                  attrs={"shape": [4], "dtype": "float32", "value": 0.0})
+    blk.append_op("fill_constant", outputs={"Out": [cond]},
+                  attrs={"shape": [1], "dtype": "bool", "value": 1.0})
+    sub = prog._create_block()
+    sub.create_var(name="lc_tmp", shape=(4,), dtype="float32")
+    # reads lc_tmp BEFORE the body writes it: legal loop carry
+    sub.append_op("scale", inputs={"X": ["lc_tmp"]},
+                  outputs={"Out": ["lc_x"]}, attrs={"scale": 1.0})
+    sub.append_op("scale", inputs={"X": ["lc_x"]},
+                  outputs={"Out": ["lc_tmp"]}, attrs={"scale": 1.0})
+    prog._rollback()
+    blk.append_op("while",
+                  inputs={"Condition": ["lc_cond"], "X": ["lc_x"]},
+                  outputs={"Out": ["lc_x"]},
+                  attrs={"sub_block": sub,
+                         "carried_vars": ["lc_x", "lc_cond"],
+                         "cond_var": "lc_cond"})
+    r = verify_program(prog, ("lc_x",))
+    assert r.by_check("uninitialized_read") == []
+    assert r.by_check("def_before_use") == []
+
+
+def test_loop_body_collective_folds_into_fingerprint():
+    prog, _ = _while_body_prog(chained=True)
+    r = verify_program(prog, ("wb_acc",))
+    assert r.by_check("collective_order") == []
+    assert r.collective_fingerprint            # body collectives count
+    # identical rebuild -> identical fingerprint (rank parity)
+    assert verify_program(_while_body_prog(chained=True)[0],
+                          ("wb_acc",)).collective_fingerprint == \
+        r.collective_fingerprint
+    # a body WITHOUT collectives fingerprints to None
+    nc, _ = _while_body_prog(with_collectives=False)
+    assert verify_program(nc, ("wb_acc",)).collective_fingerprint is None
+    # block-path stamping: the SAME collective sequence at top level
+    # hashes differently (divergence in nesting is divergence)
+    prog_top = Program()
+    blk = prog_top.global_block()
+    acc = blk.create_var(name="wb_acc", shape=(4,), dtype="float32")
+    blk.append_op("fill_constant", outputs={"Out": [acc]},
+                  attrs={"shape": [4], "dtype": "float32", "value": 0.0})
+    a = blk.create_var(name="wb_a", shape=(4,), dtype="float32")
+    b = blk.create_var(name="wb_b", shape=(4,), dtype="float32")
+    blk.append_op("c_allreduce_sum", inputs={"X": ["wb_acc"]},
+                  outputs={"Out": ["wb_a"]}, attrs={"ring_id": 0})
+    blk.append_op("c_allreduce_sum", inputs={"X": ["wb_a"]},
+                  outputs={"Out": ["wb_b"]}, attrs={"ring_id": 0})
+    blk.append_op("assign", inputs={"X": ["wb_b"]},
+                  outputs={"Out": ["wb_acc"]})
+    assert verify_program(prog_top, ("wb_acc",)).collective_fingerprint \
+        != r.collective_fingerprint
+
+
+def test_loop_body_collective_divergence_raises_at_optimize_time():
+    """Acceptance: divergent (unordered, same-signature) collectives
+    INSIDE a while body raise ProgramVerificationError at optimize time
+    with zero dispatches."""
+    before = monitor.counter_totals().get(
+        "paddle_tpu_executor_steps_dispatched", 0)
+    prog, sub = _while_body_prog(chained=False)
+    cp = fluid.CompiledProgram(prog)
+    with pytest.raises(ProgramVerificationError) as ei:
+        cp._optimized(("wb_acc",))
+    msg = str(ei.value)
+    assert "collective_order" in msg and "block 0/while@" in msg
+    d = next(d for d in ei.value.result.by_check("collective_order"))
+    assert d.severity == "error" and d.block.startswith("0/while@")
+    after = monitor.counter_totals().get(
+        "paddle_tpu_executor_steps_dispatched", 0)
+    assert after == before                     # zero dispatches
+
+
+def test_loop_body_collective_near_miss_chained_is_clean():
+    prog, _ = _while_body_prog(chained=True)
+    cp = fluid.CompiledProgram(prog)
+    cp._optimized(("wb_acc",))                 # no raise
+
+
+def test_dead_subblock_op_flagged_and_pruned_carried_vars_kept():
+    """Dead body compute (a temp nothing carries, fetches, or persists)
+    is flagged with its block index and pruned by dead_op_eliminate;
+    live loop-carried computation survives and the loop still runs to
+    the same answer."""
+    import warnings as _w
+    scope = Scope()
+    with scope_guard(scope), _fresh():
+        i = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", 3)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            acc2 = layers.elementwise_add(
+                acc, layers.fill_constant([1], "float32", 1.0))
+            layers.assign(acc2, acc)
+            layers.scale(acc, scale=3.0)       # dead body compute
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+        prog = fluid.default_main_program()
+        sub_idx = next(b.idx for b in prog.blocks if b.idx > 0)
+        ds = _findings(prog, "dead_op", fetch=(acc.name,))
+        body_ds = [d for d in ds if d.block == str(sub_idx)]
+        assert body_ds and body_ds[0].op_type == "scale"
+        r = verify_program(prog, (acc.name,))
+        assert sub_idx in r.dead_subblock_ops
+        # the pass prunes the body op (to_program applies the map)...
+        cp = fluid.CompiledProgram(prog)
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            opt = cp._optimized((acc.name,))
+        body_ops = [op.type for op in opt.blocks[sub_idx].ops]
+        assert "scale" not in body_ops
+        # ...keeps the live carried chain...
+        assert "elementwise_add" in body_ops and "assign" in body_ops
+        # ...and the loop still computes the same answer end to end
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            got, = exe.run(cp, fetch_list=[acc.name], scope=scope)
+        assert float(np.asarray(got).ravel()[0]) == 3.0
+
+
+def test_dead_subblock_near_miss_carried_writer_is_live():
+    prog, sub = _while_body_prog(chained=True)
+    r = verify_program(prog, ("wb_acc",))
+    assert sub.idx not in r.dead_subblock_ops
+    assert [d for d in r.by_check("dead_op")
+            if d.block == str(sub.idx)] == []
+
+
+# ---------------------------------------------------------------------------
+# int64 dataflow classification v2 (gather/scatter + chains)
+# ---------------------------------------------------------------------------
+
+def test_int64_gather_index_feed_is_static():
+    with _fresh():
+        ids = layers.data("g_ids", shape=[1], dtype="int64")
+        table = layers.create_parameter([50, 8], "float32", name="g_tab")
+        out = layers.mean(layers.gather(table, ids))
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        assert r.int64_static == frozenset({"g_ids"})
+
+
+def test_int64_gather_unknown_extent_stays_dynamic():
+    prog = Program()
+    blk = prog.global_block()
+    ids = blk.create_var(name="gu_ids", shape=(-1, 1), dtype="int64")
+    ids.is_data = True
+    x = blk.create_var(name="gu_x", shape=(-1, 8), dtype="float32")
+    out = blk.create_var(name="gu_out", shape=(-1, 8), dtype="float32")
+    op = fluid.framework.core.Operator(blk, "gather", None, None, {})
+    op.inputs = {"X": ["gu_x"], "Index": ["gu_ids"]}
+    op.outputs = {"Out": ["gu_out"]}
+    blk.ops.append(op)
+    prog._bump_version()
+    r = verify_program(prog, ("gu_out",))
+    assert "gu_ids" in r.int64_dynamic      # indexed extent unknown
+
+
+def test_int64_scatter_ids_feed_is_static():
+    with _fresh():
+        ids = layers.data("s_ids", shape=[1], dtype="int64")
+        ref = layers.create_parameter([30, 4], "float32", name="s_ref")
+        upd = layers.data("s_upd", shape=[4], dtype="float32")
+        out = layers.mean(layers.scatter(ref, ids, upd))
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        assert "s_ids" in r.int64_static
+
+
+def test_int64_reshape_chain_to_gather_is_static():
+    """v2 propagation: reshape(ids) -> gather classifies like a direct
+    gather (the PR-5 classifier demoted any non-lookup consumer)."""
+    with _fresh():
+        ids = layers.data("rc_ids", shape=[4], dtype="int64")
+        flat = layers.reshape(ids, [-1])
+        table = layers.create_parameter([64, 8], "float32", name="rc_t")
+        out = layers.mean(layers.gather(table, flat))
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        assert "rc_ids" in r.int64_static
+
+
+def test_int64_cast_to_float_chain_stays_dynamic():
+    with _fresh():
+        raw = layers.data("cf_ids", shape=[4], dtype="int64")
+        flat = layers.reshape(raw, [-1])
+        out = layers.mean(layers.cast(flat, "float32"))
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (out.name,))
+        assert "cf_ids" in r.int64_dynamic     # values are data
+
+
+def test_int64_int_cast_chain_to_lookup_is_static_with_grads():
+    """int->int cast propagates; grad-op inheritance preserved through
+    the chain (training program)."""
+    with _fresh():
+        ids = layers.data("ic_ids", shape=[1], dtype="int64")
+        ids32 = layers.cast(ids, "int32")
+        emb = layers.embedding(ids32, size=[40, 8])
+        loss = layers.mean(emb)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (loss.name,))
+        assert "ic_ids" in r.int64_static
+
+
+def test_int64_passthrough_only_chain_stays_dynamic():
+    """Review regression: a chain of pure pass-through ops with NO
+    bounded terminal consumer (reshape -> fetch) re-exposes the raw
+    values — it must keep the runtime wrap check, as v1 did."""
+    with _fresh():
+        ids = layers.data("pt_ids", shape=[4], dtype="int64")
+        flat = layers.reshape(ids, [-1])
+        prog = fluid.default_main_program()
+        r = verify_program(prog, (flat.name,))
+        assert "pt_ids" in r.int64_dynamic
+
+
+def test_int64_gather_negative_axis_bounded_vs_symbolic():
+    """Review regression: axis=-1 must normalize (a raw shape[-1:0]
+    slice is empty and all() vacuously true)."""
+    def prog_with_axis_shape(shape):
+        prog = Program()
+        blk = prog.global_block()
+        ids = blk.create_var(name="na_ids", shape=(-1, 1), dtype="int64")
+        ids.is_data = True
+        blk.create_var(name="na_x", shape=shape, dtype="float32")
+        blk.create_var(name="na_out", shape=shape, dtype="float32")
+        op = fluid.framework.core.Operator(blk, "gather", None, None,
+                                           {"axis": -1})
+        op.inputs = {"X": ["na_x"], "Index": ["na_ids"]}
+        op.outputs = {"Out": ["na_out"]}
+        blk.ops.append(op)
+        prog._bump_version()
+        return prog
+    # symbolic last extent: MUST stay dynamic
+    r = verify_program(prog_with_axis_shape((8, -1)), ("na_out",))
+    assert "na_ids" in r.int64_dynamic
+    # bounded last extent: static
+    r = verify_program(prog_with_axis_shape((-1, 8)), ("na_out",))
+    assert "na_ids" in r.int64_static
+
+
+def test_int64_fetched_passthrough_alias_forces_dynamic():
+    """Review regression: a bounded sibling consumer must not mask a
+    FETCHED pass-through output — the fetch materializes the post-wrap
+    values, so the feed keeps the runtime wrap check."""
+    with _fresh():
+        ids = layers.data("fx_ids", shape=[4], dtype="int64")
+        flat = layers.reshape(ids, [-1])
+        table = layers.create_parameter([64, 8], "float32", name="fx_t")
+        out = layers.mean(layers.gather(table, flat))
+        prog = fluid.default_main_program()
+        # bounded consumer only: static
+        r = verify_program(prog, (out.name,))
+        assert "fx_ids" in r.int64_static
+        # the SAME program with the reshape output also fetched: the raw
+        # values escape -> dynamic (distinct cache key: fetch tuple)
+        r2 = verify_program(prog, (out.name, flat.name))
+        assert "fx_ids" in r2.int64_dynamic
+        # fetching the feed itself exposes it too
+        r3 = verify_program(prog, (out.name, "fx_ids"))
+        assert "fx_ids" in r3.int64_dynamic

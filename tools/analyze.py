@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Whole-program static analysis over a SAVED program, no dispatch:
+the verifier's full diagnostic report (``--verify``) and/or the static
+HBM peak-memory plan (``--memory``) — the offline entry point to the
+same ``paddle_tpu.analysis`` suite ``compiler.optimize`` runs inline.
+
+Usage::
+
+    python tools/analyze.py [--verify] [--memory] [--json]
+        [--fetch name[,name...]] [--batch N] PROGRAM
+
+``PROGRAM`` is either a serialized program blob
+(``Program.serialize_to_string`` — e.g. ``main_program`` from
+``tools/export_demo_program.py``) or an inference-model directory
+(``io.save_inference_model`` — its ``__model__``'s saved fetch list is
+the default ``--fetch``).  With neither ``--verify`` nor ``--memory``,
+both run.  ``--batch`` resolves symbolic (-1) dims in the memory plan
+(default 1: a per-example lower bound).
+
+Exit status: 0 clean, 1 when ``--verify`` finds error-severity
+diagnostics, 2 on usage errors.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load(path: str):
+    """(program, default_fetch_names) from a blob file or a
+    save_inference_model directory."""
+    from paddle_tpu.framework.core import Program
+    p = Path(path)
+    if p.is_dir():
+        model = p / "__model__"
+        if not model.exists():
+            raise SystemExit(
+                f"analyze: {path!r} is a directory without __model__ "
+                "(not a save_inference_model dir)")
+        payload = json.loads(model.read_bytes().decode("utf-8"))
+        prog = Program.parse_from_string(
+            json.dumps(payload).encode("utf-8"))
+        return prog, tuple(payload.get("fetch_names", ()))
+    return Program.parse_from_string(p.read_bytes()), ()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0 if argv else 2
+    want_verify = "--verify" in argv
+    want_memory = "--memory" in argv
+    as_json = "--json" in argv
+    fetch = ()
+    batch = 1
+    paths = []
+    skip = set()
+    for i, a in enumerate(argv):
+        if i in skip:
+            continue
+        if a == "--fetch":
+            if i + 1 >= len(argv):
+                print("analyze: --fetch needs a name list",
+                      file=sys.stderr)
+                return 2
+            fetch = tuple(x for x in argv[i + 1].split(",") if x)
+            skip.add(i + 1)
+        elif a == "--batch":
+            if i + 1 >= len(argv):
+                print("analyze: --batch needs an int", file=sys.stderr)
+                return 2
+            batch = int(argv[i + 1])
+            skip.add(i + 1)
+        elif a.startswith("--"):
+            if a not in ("--verify", "--memory", "--json"):
+                print(f"analyze: unknown flag {a!r}", file=sys.stderr)
+                return 2
+        else:
+            paths.append(a)
+    if len(paths) != 1:
+        print("analyze: exactly one PROGRAM path required",
+              file=sys.stderr)
+        return 2
+    if not want_verify and not want_memory:
+        want_verify = want_memory = True
+
+    try:
+        program, saved_fetch = _load(paths[0])
+    except (OSError, ValueError) as e:
+        print(f"analyze: cannot load {paths[0]!r}: {e}", file=sys.stderr)
+        return 2
+    fetch = fetch or saved_fetch
+
+    from paddle_tpu import debugger
+    from paddle_tpu.analysis import plan_memory, verify_program
+
+    out = {"program": paths[0], "fetch": list(fetch)}
+    rc = 0
+    result = None
+    plan = None
+    if want_verify:
+        result = verify_program(program, fetch)
+        if result.errors():
+            rc = 1
+        out["verify"] = {
+            "ok": result.ok,
+            "errors": len(result.errors()),
+            "warnings": len(result.warnings()),
+            "diagnostics": [
+                {"check": d.check, "severity": d.severity,
+                 "message": d.message, "op_type": d.op_type,
+                 "op_index": d.op_index, "var": d.var, "block": d.block}
+                for d in result.diagnostics],
+            "collective_fingerprint": result.collective_fingerprint,
+            "int64_static": sorted(result.int64_static),
+            "int64_dynamic": sorted(result.int64_dynamic),
+            "dead_ops": list(result.dead_ops),
+            "dead_subblock_ops": {
+                str(k): list(v)
+                for k, v in result.dead_subblock_ops.items()},
+        }
+    if want_memory:
+        plan = plan_memory(program, fetch, batch_size=batch)
+        out["memory"] = {
+            "batch": batch,
+            "peak_bytes": plan.peak_bytes,
+            "peak_op": plan.peak_op,
+            "peak_pos": plan.peak_pos,
+            "resident_bytes": plan.resident_bytes,
+            "steady_bytes": plan.steady_bytes,
+            "top_ops": [
+                {"pos": p, "op": t, "live_bytes": b,
+                 "transient_bytes": tr}
+                for p, t, b, tr in plan.top_ops(10)],
+        }
+    if as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return rc
+    if want_verify:
+        r = out["verify"]
+        print(f"== verify: {'OK' if r['ok'] else 'FAILED'} "
+              f"({r['errors']} error(s), {r['warnings']} warning(s)) ==")
+        if result.diagnostics:
+            print(debugger.format_diagnostics(result.diagnostics))
+        if r["collective_fingerprint"]:
+            print(f"collective fingerprint: "
+                  f"{r['collective_fingerprint']}")
+        if r["int64_static"] or r["int64_dynamic"]:
+            print(f"int64 feeds: static={r['int64_static']} "
+                  f"dynamic={r['int64_dynamic']}")
+    if want_memory and plan is not None:
+        print("== memory ==")
+        print(plan.report())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,20 @@ python tools/lint_concurrency.py
 echo "== verifier smoke (known-bad programs caught at optimize time) =="
 JAX_PLATFORMS=cpu python tools/verifier_smoke.py
 
+echo "== memory-planner smoke (static analysis over the saved demo program) =="
+an_tmp=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/export_demo_program.py "$an_tmp" > /dev/null
+JAX_PLATFORMS=cpu python tools/analyze.py --memory --verify --json \
+    "$an_tmp/main_program" | python -c '
+import json, sys
+out = json.load(sys.stdin)
+mem = out["memory"]
+assert mem["peak_bytes"] > 0 and mem["top_ops"], mem
+assert mem["peak_bytes"] >= mem["resident_bytes"], mem
+assert out["verify"]["errors"] == 0, out["verify"]
+print(f"memory plan OK: peak {mem[\"peak_bytes\"]} B at {mem[\"peak_op\"]}")'
+rm -rf "$an_tmp"
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 

@@ -41,6 +41,29 @@ Rules
     a non-reentrant lock self-deadlocks — use ``threading.RLock()``
     (executor.py's ``_lock`` is the precedent).
 
+``guarded-by-caller``
+    A function annotated ``# guarded-by-caller: <lock>`` on its ``def``
+    line asserts its CALLERS hold the lock (the coordinator's
+    ``*_locked`` helpers are the shipped precedent).  The lint then (a)
+    treats the lock as held throughout the body — mutations of
+    ``# guarded-by: <lock>`` fields inside lint clean without per-line
+    suppressions — and (b) verifies the assertion: every same-module
+    call site must sit inside ``with <lock>:`` or inside another
+    function carrying the same annotation (propagation).  A call site
+    without the lock, or a function with no same-module caller at all
+    (the contract is unverifiable), is a violation.
+
+``cond-misuse``
+    Condition-vs-Lock misuse on objects created as
+    ``threading.Condition()``: ``.wait()``/``.notify()``/
+    ``.notify_all()`` outside ``with <cond>:`` (the condition's lock is
+    not held — CPython raises RuntimeError at runtime; the lint moves it
+    to review time), and ``.notify*()`` inside a ``with <cond>:`` block
+    that changes NO state (no assignment, augmented assignment, delete,
+    or mutating method call) — waiters wake, re-test an unchanged
+    predicate, and sleep again: the notify is dead or the state change
+    leaked outside the lock.
+
 Suppression: append ``# lint-ok: <justification>`` to the flagged line to
 mark a reviewed true negative; suppressed findings are reported in the
 summary but do not fail the run.
@@ -79,7 +102,12 @@ TELEMETRY_NAMES = ("TRACER", "REGISTRY")
 _LOCKISH = re.compile(r"(^|_)(lock|locks|mu|mutex|cv|emu)$", re.I)
 
 _GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_CALLER_GUARD_RE = re.compile(
+    r"#\s*guarded-by-caller:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 _OK_RE = re.compile(r"#\s*lint-ok:\s*(.+)")
+
+#: condition-object methods that require the condition's lock
+_COND_CALLS = frozenset({"wait", "wait_for", "notify", "notify_all"})
 
 
 @dataclass
@@ -127,6 +155,8 @@ class _FileInfo:
         self.attr_guards: Dict[str, str] = {}
         # lock attr/name -> "lock" | "rlock" | "condition"
         self.lock_kinds: Dict[str, str] = {}
+        # function name -> lock name, for `def f():  # guarded-by-caller`
+        self.fn_caller_guards: Dict[str, str] = {}
 
 
 def _lock_kind_of_call(call: ast.Call) -> Optional[str]:
@@ -137,9 +167,21 @@ def _lock_kind_of_call(call: ast.Call) -> Optional[str]:
 
 def _collect_annotations(files: List[_FileInfo],
                          name_guards: Dict[str, str]):
-    """Pass 1: guarded-field declarations + lock construction kinds."""
+    """Pass 1: guarded-field declarations + lock construction kinds +
+    guarded-by-caller function annotations."""
     for fi in files:
         for node in ast.walk(fi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # annotation rides the def line (or the signature's
+                # continuation lines, up to the first body statement)
+                stop = node.body[0].lineno if node.body else node.lineno
+                for ln in range(node.lineno, stop + 1):
+                    m = _CALLER_GUARD_RE.search(fi.comments.get(ln, ""))
+                    if m:
+                        fi.fn_caller_guards[node.name] = \
+                            m.group(1).rsplit(".", 1)[-1]
+                        break
+                continue
             if not isinstance(node, (ast.Assign, ast.AnnAssign)):
                 continue
             end = getattr(node, "end_lineno", node.lineno)
@@ -173,19 +215,29 @@ def _collect_annotations(files: List[_FileInfo],
 # rule: guarded-field
 # ---------------------------------------------------------------------------
 
-class _GuardChecker(ast.NodeVisitor):
-    def __init__(self, fi: _FileInfo, name_guards, report):
-        self.fi = fi
-        self.name_guards = name_guards
-        self.report = report
+class _ScopeVisitor(ast.NodeVisitor):
+    """Shared lexical-scope tracking: which ``with`` locks are active
+    and which function encloses the current node.  The guarded-field
+    checker and the call-site collector both subclass this, so the
+    fiddly bookkeeping (per-function reset, the with-stack restore)
+    lives ONCE — a divergence here would make guarded-by and
+    guarded-by-caller disagree about which locks are held at a line."""
+
+    def __init__(self):
         self.with_locks: List[str] = []    # terminal names of live withs
         self.func_stack: List[str] = []
 
-    # -- scope tracking ------------------------------------------------------
+    def enter_function(self, node) -> List[str]:
+        """Locks to seed the fresh function scope with (subclass hook)."""
+        return []
+
+    def enter_with(self, node, names) -> None:
+        """Subclass hook, called with the with's locks already live."""
+
     def visit_FunctionDef(self, node):
         self.func_stack.append(node.name)
         outer = self.with_locks
-        self.with_locks = []               # withs do not cross functions
+        self.with_locks = list(self.enter_function(node))
         self.generic_visit(node)
         self.with_locks = outer
         self.func_stack.pop()
@@ -197,12 +249,74 @@ class _GuardChecker(ast.NodeVisitor):
                  for item in node.items]
         # `with self._cv:` on a Condition acquires its underlying lock
         self.with_locks.extend(n for n in names if n)
+        self.enter_with(node, names)
         for stmt in node.body:
             self.visit(stmt)
         for item in node.items:            # context exprs themselves
             self.visit(item.context_expr)
         del self.with_locks[len(self.with_locks) - len(
             [n for n in names if n]):]
+
+
+class _GuardChecker(_ScopeVisitor):
+    def __init__(self, fi: _FileInfo, name_guards, report):
+        super().__init__()
+        self.fi = fi
+        self.name_guards = name_guards
+        self.report = report
+
+    # -- scope hooks ---------------------------------------------------------
+    def enter_function(self, node) -> List[str]:
+        guard = self.fi.fn_caller_guards.get(node.name)
+        if not guard:
+            return []
+        # guarded-by-caller: the lock is held for the whole body (the
+        # call-site check verifies the assertion separately)
+        if self.fi.lock_kinds.get(guard) == "condition":
+            self._check_notify_scope(node, guard, node.body)
+        return [guard]
+
+    def enter_with(self, node, names):
+        for n in names:
+            if n and self.fi.lock_kinds.get(n) == "condition":
+                self._check_notify_scope(node, n, node.body)
+
+    @staticmethod
+    def _scope_changes_state(body) -> bool:
+        """True when any statement in ``body``'s subtree changes state:
+        an assignment (incl. subscript/attribute targets), augmented
+        assignment, delete, or a mutating container-method call.  Local
+        binds count too — conservatively (a false 'changed' only keeps
+        the lint quiet), since the waiter's predicate is opaque here."""
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                  ast.AugAssign, ast.Delete)):
+                    return True
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in MUTATORS:
+                    return True
+        return False
+
+    def _check_notify_scope(self, node, cond_name, body):
+        """``cond-misuse`` rule half 2: a notify inside this
+        lock-holding scope must ride a state change, or waiters wake to
+        an unchanged predicate."""
+        notifies = [
+            n for stmt in body for n in ast.walk(stmt)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in ("notify", "notify_all")
+            and _terminal_name(n.func.value) == cond_name]
+        if notifies and not self._scope_changes_state(body):
+            self.report(
+                notifies[0].lineno, "cond-misuse",
+                f".{notifies[0].func.attr}() on condition "
+                f"{cond_name!r} with no state change under the lock — "
+                "waiters wake, re-test an unchanged predicate, and "
+                "sleep again; change the predicate state inside the "
+                "`with` (or drop the dead notify)")
 
     # -- mutation sites ------------------------------------------------------
     def _guard_for(self, target) -> Optional[Tuple[str, str]]:
@@ -255,6 +369,22 @@ class _GuardChecker(ast.NodeVisitor):
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
             self._check(f.value, node.lineno)
+        # cond-misuse rule half 1: wait/notify on a known Condition
+        # object require its lock (CPython raises RuntimeError at
+        # runtime; this moves it to review time) — `with cond:` or a
+        # guarded-by-caller annotation supplies it
+        if isinstance(f, ast.Attribute) and f.attr in _COND_CALLS:
+            cond = _terminal_name(f.value)
+            if cond and self.fi.lock_kinds.get(cond) == "condition" \
+                    and cond not in self.with_locks \
+                    and self.func_stack:
+                self.report(
+                    node.lineno, "cond-misuse",
+                    f".{f.attr}() on condition {cond!r} outside "
+                    f"`with {cond}:` — the condition's lock is not "
+                    "held (RuntimeError at runtime); wrap the call, or "
+                    "annotate the enclosing function `# guarded-by-"
+                    f"caller: {cond}` if callers hold it")
         self.generic_visit(node)
 
 
@@ -377,6 +507,67 @@ def _check_finalize_callbacks(fi: _FileInfo, report):
 
 
 # ---------------------------------------------------------------------------
+# rule: guarded-by-caller (call-site verification)
+# ---------------------------------------------------------------------------
+
+class _CallSiteCollector(_ScopeVisitor):
+    """Record, for every call in a module, the callee's terminal name,
+    the lexically active ``with`` locks, and the enclosing function —
+    the evidence the guarded-by-caller verification needs.  Scope
+    tracking comes from :class:`_ScopeVisitor`, the same rules the
+    guarded-field checker applies."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls: List[tuple] = []   # (callee, locks, enclosing, line)
+
+    def visit_Call(self, node):
+        callee = _terminal_name(node.func)
+        if callee:
+            self.calls.append((
+                callee, frozenset(self.with_locks),
+                self.func_stack[-1] if self.func_stack else None,
+                node.lineno))
+        self.generic_visit(node)
+
+
+def _check_caller_guards(fi: _FileInfo, report):
+    """Verify every ``# guarded-by-caller: <lock>`` assertion: each
+    same-module call site must hold the lock lexically, or sit inside
+    another function asserting the same lock (propagation: a ``*_locked``
+    helper may call another)."""
+    if not fi.fn_caller_guards:
+        return
+    collector = _CallSiteCollector()
+    collector.visit(fi.tree)
+    by_callee: Dict[str, list] = {}
+    for callee, locks, enclosing, line in collector.calls:
+        by_callee.setdefault(callee, []).append((locks, enclosing, line))
+    fn_lines = {n.name: n.lineno for n in ast.walk(fi.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for fn, lock in fi.fn_caller_guards.items():
+        sites = by_callee.get(fn, [])
+        if not sites:
+            report(fn_lines.get(fn, 1), "guarded-by-caller",
+                   f"{fn!r} is annotated `# guarded-by-caller: {lock}` "
+                   "but has no same-module caller — the contract is "
+                   "unverifiable; drop the annotation or add the "
+                   "locked call path")
+            continue
+        for locks, enclosing, line in sites:
+            if lock in locks:
+                continue
+            if enclosing is not None and \
+                    fi.fn_caller_guards.get(enclosing) == lock:
+                continue           # propagated: the caller asserts too
+            report(line, "guarded-by-caller",
+                   f"call of {fn!r} without holding {lock!r} "
+                   f"(declared `# guarded-by-caller: {lock}`) — wrap "
+                   f"the call in `with {lock}:` or annotate the "
+                   "calling function with the same contract")
+
+
+# ---------------------------------------------------------------------------
 # rule: thread-lifetime
 # ---------------------------------------------------------------------------
 
@@ -437,6 +628,7 @@ def lint_paths(paths) -> List[Violation]:
                 _fi.path, lineno, rule, message,
                 suppressed=ok.group(1).strip() if ok else None))
         _GuardChecker(fi, name_guards, report).visit(fi.tree)
+        _check_caller_guards(fi, report)
         _check_signal_handlers(fi, report)
         _check_finalize_callbacks(fi, report)
         _check_threads(fi, report)
