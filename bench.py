@@ -39,18 +39,58 @@ def emit(rec):
 
 def _device_info():
     import jax
+    from paddle_tpu.analysis import device_peak_flops
     dev = jax.devices()[0]
     platform = getattr(dev, "platform", "cpu")
     on_tpu = platform in ("tpu", "axon")
-    # peak dense bf16 FLOP/s per chip (TPU f32 matmuls run bf16 passes at
-    # DEFAULT precision, so bf16 peak is the right denominator)
-    PEAK = {"v5e": 197e12, "v5lite": 197e12, "v5": 197e12,
-            "v4": 275e12, "v5p": 459e12}
-    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
-    # longest key first so 'v5p' wins over its prefix 'v5'
-    peak = next((PEAK[k] for k in sorted(PEAK, key=len, reverse=True)
-                 if k in kind), 197e12)
+    # peak dense bf16 FLOP/s per chip — SHARED with the executor's live
+    # paddle_tpu_step_mfu gauge (analysis.cost.device_peak_flops), so
+    # the mfu:<workload> cross-check below compares numerators only
+    peak = device_peak_flops(dev) if on_tpu else 197e12
     return dev, on_tpu, peak
+
+
+#: runtime-vs-offline MFU agreement band for the mfu:<workload> lines —
+#: the two accountings share the peak denominator, so the ratio isolates
+#: analytic-model flops (cost.py) against the hand formulas below plus
+#: gauge-vs-best-rep timing noise; outside the band the line flags
+#: diverged=true so the trajectory can never drift silently
+_MFU_TOLERANCE = 2.0
+
+
+def _emit_runtime_mfu(name, exe, offline_mfu):
+    """mfu:<workload> line: the executor's LIVE paddle_tpu_step_mfu
+    gauge (analytic flops/step over the median dispatch interval x chip peak)
+    next to the workload's own offline MFU computation, with the
+    tolerance gate.  Never breaks the bench."""
+    try:
+        from paddle_tpu import monitor
+        fam = monitor.REGISTRY.get("paddle_tpu_step_mfu")
+        live = fam.value(executor=str(exe._stats.serial)) if fam else 0.0
+        ms_fam = monitor.REGISTRY.get("paddle_tpu_step_device_ms")
+        step_ms = (ms_fam.value(executor=str(exe._stats.serial))
+                   if ms_fam else 0.0)
+        offline = float(offline_mfu)
+        ratio = (live / offline) if (live > 0 and offline > 0) else 0.0
+        ok = bool(ratio and 1.0 / _MFU_TOLERANCE <= ratio
+                  <= _MFU_TOLERANCE)
+        rec = {
+            "metric": f"mfu:{name}",
+            "value": round(live * 100, 2),
+            "unit": "% MFU (live runtime gauge)",
+            "vs_baseline": 0,
+            "offline_pct": round(offline * 100, 2),
+            "live_vs_offline": round(ratio, 3),
+            "step_ms": round(step_ms, 2),
+            "tolerance": _MFU_TOLERANCE,
+        }
+        if not ok:
+            rec["diverged"] = True
+        emit(rec)
+    except Exception as e:   # the cross-check must never kill a line
+        emit({"metric": f"mfu:{name}", "value": 0,
+              "unit": "% MFU (live runtime gauge)", "vs_baseline": 0,
+              "error": repr(e)[:200]})
 
 
 def bench_resnet50(dev, on_tpu, peak, frozen_bn=False):
@@ -149,6 +189,8 @@ def bench_resnet50(dev, on_tpu, peak, frozen_bn=False):
             # the line measures the finetune step time/MFU only
             del rec["loss_first_last"]
         emit(rec)
+        if not frozen_bn:
+            _emit_runtime_mfu("resnet50", exe, mfu)
 
 
 def bench_bert(dev, on_tpu, peak):
@@ -216,6 +258,7 @@ def bench_bert(dev, on_tpu, peak):
             "device": str(dev),
             "batch": batch, "seq_len": seq_len,
         })
+        _emit_runtime_mfu("bert", exe, mfu)
 
 
 def bench_bert_masked(dev, on_tpu, peak):
@@ -285,6 +328,7 @@ def bench_bert_masked(dev, on_tpu, peak):
             "masked_per_seq": n_mask,
             "loss_first_last": [round(l0, 3), round(lN, 3)],
         })
+        _emit_runtime_mfu("bert_masked", exe, mfu)
 
 
 def bench_gpt_causal(dev, on_tpu, peak):
@@ -346,6 +390,7 @@ def bench_gpt_causal(dev, on_tpu, peak):
                      "ceiling: softmax VPU tile cost scales as 1/d "
                      "(skeleton microbench, LONGCTX_ABLATION.md r5)"),
         })
+        _emit_runtime_mfu("gpt_causal", exe, mfu)
 
 
 def bench_bert_long(dev, on_tpu, peak):
@@ -532,6 +577,7 @@ def bench_transformer_wmt(dev, on_tpu, peak):
             "device": str(dev), "batch": batch, "seq_len": seq_len,
             "loss_first_last": [round(l0, 3), round(lN, 3)],
         })
+        _emit_runtime_mfu("transformer_wmt", exe, mfu)
 
 
 def bench_deepfm_ps():

@@ -19,8 +19,16 @@ time, before anything is lowered).
   with a top-K per-op attribution table.  Feeds the verifier's
   ``memory_budget`` check, ``bench.py``'s ``memory:<workload>``
   estimate-vs-measured lines, and ``tools/analyze.py``.
+- :mod:`paddle_tpu.analysis.cost` — the analytic per-op flops/bytes
+  model: 2·MAC matmul/conv formulas, grad-op inheritance, per-op-class
+  roofline shares, cached on the program fingerprint.  Feeds the
+  executor's live ``paddle_tpu_step_mfu`` gauge, ``bench.py``'s
+  ``mfu:<workload>`` runtime-vs-offline cross-check, and the
+  ``FLAGS_cost_crosscheck`` parity gate against XLA's own
+  ``compiled.cost_analysis()``.
 """
 
+from .cost import CostPlan, device_peak_flops, plan_cost  # noqa: F401
 from .memory import MemoryPlan, plan_memory  # noqa: F401
 from .verifier import (  # noqa: F401
     CHECKS, Diagnostic, ProgramVerificationError, VerifyResult,
@@ -29,8 +37,8 @@ from .verifier import (  # noqa: F401
 )
 
 __all__ = [
-    "CHECKS", "Diagnostic", "MemoryPlan", "ProgramVerificationError",
-    "VerifyResult", "clear_cache", "collective_fingerprint",
-    "dynamic_int64_feeds", "plan_memory", "verify_or_raise",
-    "verify_program",
+    "CHECKS", "CostPlan", "Diagnostic", "MemoryPlan",
+    "ProgramVerificationError", "VerifyResult", "clear_cache",
+    "collective_fingerprint", "device_peak_flops", "dynamic_int64_feeds",
+    "plan_cost", "plan_memory", "verify_or_raise", "verify_program",
 ]

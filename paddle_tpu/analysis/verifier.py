@@ -160,6 +160,9 @@ class VerifyResult:
     #: static HBM plan (analysis.memory.MemoryPlan; None if planning
     #: failed — the plan must never block verification)
     memory_plan: Optional[object] = None
+    #: analytic flops/bytes plan (analysis.cost.CostPlan; None if
+    #: planning failed — same never-blocks contract as the memory plan)
+    cost_plan: Optional[object] = None
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == "error"]
@@ -772,6 +775,19 @@ def _check_memory(program: Program, fetch_names, diags):
     return plan
 
 
+def _check_cost(program: Program, fetch_names):
+    """Analytic per-op flops/bytes plan (analysis.cost): batch=1
+    per-example baseline, cached on the fingerprint alongside this
+    verify result.  Purely informational — it stamps the attribution the
+    executor's live MFU gauge and the fusion arc read; planning failures
+    never block verification."""
+    from . import cost as _cost
+    try:
+        return _cost.plan_cost(program, fetch_names, batch_size=1)
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -814,6 +830,7 @@ def _verify_cached(program: Program, fetch_names) -> \
         result.collective_fingerprint = _check_collective_order(
             program, graph, fetch_names, diags)
         result.memory_plan = _check_memory(program, fetch_names, diags)
+        result.cost_plan = _check_cost(program, fetch_names)
     for d in diags:
         _FINDING_CELLS[d.check].inc()
     # int64_feed "findings" are classifications, not diagnostics: the
@@ -834,6 +851,16 @@ def _verify_cached(program: Program, fetch_names) -> \
             "steady_bytes": plan.steady_bytes,
             "peak_op": plan.peak_op,
             "top_ops": [(p, t, b) for p, t, b, _ in plan.top_ops(5)],
+        },
+        # analytic flops/bytes model (batch=1 baseline): the per-step
+        # numbers the executor's live MFU gauge scales by the real
+        # batch, and the per-class roofline share the fusion arc ranks
+        # rewrite candidates by
+        "cost": None if result.cost_plan is None else {
+            "flops": result.cost_plan.flops,
+            "bytes": result.cost_plan.bytes,
+            "per_class": dict(result.cost_plan.per_class),
+            "intensity": result.cost_plan.intensity(),
         },
     }
     with _CACHE_LOCK:

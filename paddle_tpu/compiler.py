@@ -15,6 +15,7 @@ unnecessary because the mean over the global batch already spans devices.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import Optional
 
@@ -31,6 +32,16 @@ _OPT_CTR = _monitor.REGISTRY.counter(
 #: bound once: the hit side runs on every steady-state dispatch
 _OPT_HIT = _OPT_CTR.labels(cache="hit")
 _OPT_MISS = _OPT_CTR.labels(cache="miss")
+#: per-pass lowering-time attribution: each optimize-time stage
+#: (program verify, dead-op eliminate, fusion, graph->program) observes
+#: its wall ms here, and the compiler.optimize span carries the same
+#: numbers in its args — so a slow compile names the pass that ate it
+_PASS_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_compiler_pass_ms",
+    "per-pass wall time (ms) inside compiler.optimize, by pass",
+    ("pass",),
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+             250.0, 500.0, 1000.0, 5000.0))
 
 #: monotonic CompiledProgram identity — the executor's compiled-block
 #: cache keys on this serial: structurally-equal meshes from two
@@ -104,6 +115,26 @@ class ExecutionStrategy:
         object.__setattr__(self, name, value)
 
 
+@contextlib.contextmanager
+def _timed_pass(pass_ms: dict, pass_name: str):
+    """Per-pass lowering-time attribution: a ``compiler.pass.<name>``
+    child span, the pass histogram observation, and the wall ms
+    recorded into ``pass_ms`` (attached to the enclosing
+    compiler.optimize span's args)."""
+    import time as _time
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = _time.perf_counter()
+        ms = (t1 - t0) * 1e3
+        pass_ms[pass_name] = round(ms, 3)
+        _PASS_HIST.observe(ms, **{"pass": pass_name})
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.add_complete(
+                f"compiler.pass.{pass_name}", "compile", t0, t1)
+
+
 class CompiledProgram:
     def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
         self._program: Program = program_or_graph
@@ -137,8 +168,12 @@ class CompiledProgram:
                 # real lowering error is deterministic, and re-running it
                 # would just triple the time to the same diagnosis
                 _resil.maybe_inject("compile")
-                with _monitor.TRACER.span("compiler.optimize", "compile",
-                                          fetches=len(fetch_names)):
+                import functools
+                import time as _time
+                t_opt0 = _time.perf_counter()
+                pass_ms = {}
+                _timed = functools.partial(_timed_pass, pass_ms)
+                try:
                     from .flags import get_flags
                     prog = self._program
                     if get_flags("FLAGS_program_verify")[
@@ -150,29 +185,40 @@ class CompiledProgram:
                         # ProgramVerificationError is deterministic, so
                         # the transient-only retry policy never re-runs
                         # it.  Also stamps prog._attrs["verify"] (int64
-                        # feed classification, collective fingerprint),
-                        # which clone() carries onto the optimized
-                        # program below.
+                        # feed classification, collective fingerprint,
+                        # analytic cost), which clone() carries onto the
+                        # optimized program below.
                         from .analysis import verifier as _verifier
-                        _verifier.verify_or_raise(prog, fetch_names)
+                        with _timed("program_verify"):
+                            _verifier.verify_or_raise(prog, fetch_names)
                     from .framework import ir
                     g = ir.Graph(prog)
                     changed = False
                     # dead-op elimination before lowering: never trace a
                     # subgraph nothing observes (fetches are protected)
-                    g = ir.get_pass(
-                        "dead_op_eliminate",
-                        protected=frozenset(fetch_names)).apply(g)
+                    with _timed("dead_op_eliminate"):
+                        g = ir.get_pass(
+                            "dead_op_eliminate",
+                            protected=frozenset(fetch_names)).apply(g)
                     changed |= bool(g.attrs.get("dead_op_eliminate_count"))
                     if self._build_strategy.fuse_elewise_add_act_ops:
-                        g = ir.get_pass(
-                            "fuse_elewise_add_act_pass",
-                            protected=frozenset(fetch_names)).apply(g)
+                        with _timed("fuse_elewise_add_act"):
+                            g = ir.get_pass(
+                                "fuse_elewise_add_act_pass",
+                                protected=frozenset(fetch_names)).apply(g)
                         changed |= bool(
                             g.attrs.get("fuse_elewise_add_act_count"))
                     if changed:
-                        prog = g.to_program()
+                        with _timed("to_program"):
+                            prog = g.to_program()
                     return prog
+                finally:
+                    if _monitor.TRACER.enabled:
+                        _monitor.TRACER.add_complete(
+                            "compiler.optimize", "compile", t_opt0,
+                            _time.perf_counter(),
+                            {"fetches": len(fetch_names),
+                             "passes_ms": dict(pass_ms)})
 
             prog = _resil.retry_call("compile", _build,
                                      retryable=_resil.is_transient)

@@ -296,6 +296,10 @@ class GangCoordinator:
                  "last_hb": time.monotonic(),
                  "step": None, "steps": [], "cur_step": None,
                  "hb_steps": [], "fingerprint": None,
+                 # latest heartbeat metrics digest (step-time estimate, MFU,
+                 # queue, in-flight; byte-capped) — observability only,
+                 # like cur_step: never feeds commit decisions
+                 "digest": None,
                  "pid": None, "deaths": 0, "joins": 0,
                  # server-side barrier sequence: the k-th step_barrier
                  # arrival of every rank pairs with the k-th of its
@@ -329,6 +333,7 @@ class GangCoordinator:
             # post-prune holdings from _resume_gang.
             e["step"] = None
             e["steps"] = []
+            e["digest"] = None     # pre-death metrics are stale too
             e["joins"] += 1
             # barrier resync: the respawn's executor restarts its local
             # barrier count while survivors kept counting — reset EVERY
@@ -460,11 +465,18 @@ class GangCoordinator:
             for r in newly_dead:
                 _monitor.GANG_DEATH_CTR.inc()
                 _monitor.GANG_DEGRADED_GAUGE.set(1)
+                # a dead rank's digest series retire (counter totals
+                # fold to rank="retired", gauges drop — PR-2 semantics);
+                # the aggregate skew/straggler gauges recompute over the
+                # survivors only
+                _monitor.retire_gang_rank_series(r)
                 if _monitor.TRACER.enabled:
                     _monitor.TRACER.instant(
                         "gang.rank_dead", "gang",
                         {"rank": int(r),
                          "timeout_s": self.heartbeat_timeout_s})
+            if newly_dead:
+                self._refresh_gang_gauges()
 
     # -- request dispatch ----------------------------------------------------
     def _handle(self, req: dict) -> dict:
@@ -485,6 +497,24 @@ class GangCoordinator:
 
     def _op_heartbeat(self, req: dict) -> dict:
         rank = int(req["rank"])
+        digest = req.get("digest")
+        digest_ok = False
+        if isinstance(digest, dict):
+            # server-side byte-cap enforcement: an oversized digest is
+            # REFUSED (counted) while the beat itself still refreshes
+            # liveness — digest validity must never cost a rank its life
+            if len(json.dumps(digest, sort_keys=True)) \
+                    <= _monitor.DIGEST_MAX_BYTES:
+                digest_ok = True
+            else:
+                digest = None
+        else:
+            # a beat WITHOUT a digest CLEARS the stored one: a rank
+            # whose executor retired (metrics_digest() now empty) must
+            # drop out of straggler/skew math, not haunt it with its
+            # last reading forever.  Old digest-less clients simply
+            # keep the field at its initial None.
+            digest = None
         with self._cv:
             e = self._touch_locked(rank)
             # heartbeat progress is observability + fingerprint
@@ -502,10 +532,79 @@ class GangCoordinator:
                 # erase a known fingerprint — that would un-latch a
                 # genuine mismatch between beats
                 e["fingerprint"] = req["fingerprint"]
+            digest_changed = e["digest"] != digest
+            e["digest"] = digest
             self._check_fingerprints_locked()
             view = self._gang_view_locked()
         _monitor.GANG_HB_CTR.inc(1, role="coordinator")
+        if digest_ok:
+            self._fold_digest(rank, digest)
+        elif isinstance(req.get("digest"), dict):
+            _monitor.GANG_DIGEST_OVERSIZE_CTR.inc()
+        if req.get("step") is not None or digest_changed:
+            self._refresh_gang_gauges()
         return {"ok": True, **view}
+
+    #: digest key -> the per-rank gauge family it lands in
+    _DIGEST_GAUGES = {
+        "step_ms": _monitor.GANG_RANK_STEP_MS,
+        "mfu": _monitor.GANG_RANK_MFU,
+        "queue": _monitor.GANG_RANK_QUEUE,
+        "inflight": _monitor.GANG_RANK_INFLIGHT,
+    }
+
+    def _fold_digest(self, rank: int, digest: dict) -> None:
+        """Per-rank digest values → per-rank registry series (exported
+        by monitor.export on the coordinator host).  Runs OUTSIDE _cv:
+        gauge cells have their own locks, and metric work must never
+        stall the liveness scan."""
+        _monitor.GANG_DIGEST_CTR.inc(1, rank=str(rank))
+        for key, fam in self._DIGEST_GAUGES.items():
+            v = digest.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                fam.set(float(v), rank=str(rank))
+
+    def _aggregates_locked(self) -> dict:  # guarded-by-caller: _cv
+        """Gang-level aggregates over the LIVE ranks' heartbeat state —
+        the ONE place the selection rules live (gauges, the status
+        payload, and therefore gangtop all read this).  Degraded-aware
+        by construction: dead and departed ranks drop out of the
+        snapshot, so a degraded gang's skew reflects only the
+        survivors still training.  A straggler is a COMPARISON — with
+        fewer than two live digests there is nobody to be slower than,
+        and the skews reset to 0 rather than freeze (a gauge latched
+        at its pre-death maximum would keep an alert firing against a
+        healthy solo survivor forever)."""
+        live = {r: e for r, e in self._ranks.items()
+                if e["alive"] and not e["finished"]}
+        steps = [e["cur_step"] for e in live.values()
+                 if e["cur_step"] is not None]
+        step_ms = {r: e["digest"]["step_ms"]
+                   for r, e in live.items()
+                   if isinstance(e.get("digest"), dict)
+                   and isinstance(e["digest"].get("step_ms"),
+                                  (int, float))}
+        agg = {"step_skew": (max(steps) - min(steps)
+                             if len(steps) >= 2 else 0),
+               "step_time_skew_ms": 0.0,
+               "straggler": -1, "straggler_step_ms": 0.0}
+        if len(step_ms) >= 2:
+            slow = max(step_ms, key=step_ms.get)
+            agg["straggler"] = int(slow)
+            agg["straggler_step_ms"] = float(step_ms[slow])
+            agg["step_time_skew_ms"] = \
+                max(step_ms.values()) - min(step_ms.values())
+        return agg
+
+    def _refresh_gang_gauges(self) -> None:
+        """Publish the aggregates as registry gauges (exported by
+        monitor.export on the coordinator host)."""
+        with self._cv:
+            agg = self._aggregates_locked()
+        _monitor.GANG_STEP_SKEW_GAUGE.set(agg["step_skew"])
+        _monitor.GANG_STEP_TIME_SKEW_GAUGE.set(agg["step_time_skew_ms"])
+        _monitor.GANG_STRAGGLER_GAUGE.set(agg["straggler"])
+        _monitor.GANG_STRAGGLER_MS_GAUGE.set(agg["straggler_step_ms"])
 
     def _op_announce(self, req: dict) -> dict:
         rank = int(req["rank"])
@@ -533,6 +632,11 @@ class GangCoordinator:
                 # completed gang (the runbook keys on it)
                 _monitor.GANG_DEGRADED_GAUGE.set(0)
             self._cv.notify_all()
+        # an orderly departure retires its digest series exactly like a
+        # death: the rank is gone either way, and the skew/straggler
+        # aggregates must track only the ranks still training
+        _monitor.retire_gang_rank_series(int(req["rank"]))
+        self._refresh_gang_gauges()
         return {"ok": True}
 
     def _op_peers(self, req: dict) -> dict:
@@ -720,12 +824,15 @@ class GangCoordinator:
                               "cur_step": e["cur_step"],
                               "hb_steps": list(e["hb_steps"]),
                               "fingerprint": e["fingerprint"],
+                              "digest": (dict(e["digest"])
+                                         if e["digest"] else None),
                               "pid": e["pid"], "deaths": e["deaths"],
                               "joins": e["joins"],
                               "age_s": round(
                                   time.monotonic() - e["last_hb"], 3)}
                      for r, e in self._ranks.items()}
             return {"ok": True, "ranks": ranks,
+                    "aggregates": self._aggregates_locked(),
                     **self._gang_view_locked()}
 
 
@@ -779,6 +886,9 @@ class GangClient:
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._degraded_noted = False
+        #: None = auto-collect monitor.metrics_digest() per beat;
+        #: a dict = fixed override (tests, foreign runners)
+        self._digest_override: Optional[Dict[str, Any]] = None  # guarded-by: _state_mu
 
     # -- connection plumbing -------------------------------------------------
     def _dial(self, timeout_s: float = 10.0) -> socket.socket:
@@ -914,6 +1024,18 @@ class GangClient:
                 with self._state_mu:
                     payload = {"op": "heartbeat", "rank": self.rank,
                                **self._progress}
+                    override = self._digest_override
+                digest = override
+                if digest is None:
+                    # auto-collect this rank's runtime digest (a few
+                    # targeted registry reads — the beat stays cheap);
+                    # digest failure must never cost a heartbeat
+                    try:
+                        digest = _monitor.metrics_digest()
+                    except Exception:
+                        digest = None
+                if digest:
+                    payload["digest"] = _monitor.capped_digest(digest)
                 send_frame(sock, payload)
                 resp = recv_frame(sock)
                 _monitor.GANG_HB_CTR.inc(1, role="client")
@@ -945,6 +1067,13 @@ class GangClient:
                 self._progress["steps"] = sorted(int(s) for s in steps)
             if fingerprint is not None:
                 self._progress["fingerprint"] = str(fingerprint)
+
+    def set_digest(self, digest: Optional[Dict[str, Any]]) -> None:
+        """Override the metrics digest the heartbeat carries (``None``
+        returns to auto-collection from the monitor registry).  For
+        runners whose metrics live outside this process's registry."""
+        with self._state_mu:
+            self._digest_override = dict(digest) if digest else None
 
     @property
     def degraded(self) -> bool:

@@ -153,6 +153,24 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_gang_step_barrier": False,
     # step_barrier timeout for the automatic executor barrier above
     "FLAGS_gang_step_barrier_timeout_s": 60.0,
+    # sampling profiler (paddle_tpu.profiler.SAMPLER): every N executor
+    # dispatches, capture a jax.profiler device-trace window of
+    # FLAGS_profile_sample_window_steps steps into a bounded rotating
+    # directory (FLAGS_profile_sample_dir, at most
+    # FLAGS_profile_sample_max_windows kept, oldest deleted; a
+    # manifest.json maps each window to its step range) — a week-long
+    # run costs a few sampled windows, not a monolithic trace.  0
+    # disables (default): the hot path is then one int compare.
+    "FLAGS_profile_sample_every_n_steps": 0,
+    "FLAGS_profile_sample_window_steps": 4,
+    "FLAGS_profile_sample_dir": "",
+    "FLAGS_profile_sample_max_windows": 8,
+    # analytic-cost cross-check (analysis.cost vs XLA cost_analysis):
+    # when on, a fresh compile goes through the AOT path so XLA's own
+    # flop count is available, and the analytic model diverging >3x
+    # warns + counts in paddle_tpu_cost_crosscheck_total{verdict}.  Off
+    # by default: the AOT lower() pays a second trace of the block.
+    "FLAGS_cost_crosscheck": False,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -206,6 +224,22 @@ def _apply_side_effects(name: str, value):
     elif name == "FLAGS_watchdog_escalate":
         from . import resilience
         resilience.WATCHDOG.escalate = str(value)
+    elif name in ("FLAGS_profile_sample_every_n_steps",
+                  "FLAGS_profile_sample_window_steps",
+                  "FLAGS_profile_sample_dir",
+                  "FLAGS_profile_sample_max_windows"):
+        from . import profiler
+        # the store write precedes side effects in set_flags, so this
+        # re-read already sees the new value
+        fl = get_flags(["FLAGS_profile_sample_every_n_steps",
+                        "FLAGS_profile_sample_window_steps",
+                        "FLAGS_profile_sample_dir",
+                        "FLAGS_profile_sample_max_windows"])
+        profiler.SAMPLER.configure(
+            int(fl["FLAGS_profile_sample_every_n_steps"]),
+            int(fl["FLAGS_profile_sample_window_steps"]),
+            str(fl["FLAGS_profile_sample_dir"]),
+            int(fl["FLAGS_profile_sample_max_windows"]))
     elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
         # the NATIVE ps client reads these via getenv (retry_times per
         # request, deadline at connect) — mirror flag changes into the

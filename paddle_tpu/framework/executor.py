@@ -62,6 +62,43 @@ _COLLECTIVE_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_collective_launches_total",
     "host-launched collectives by kind (in-graph c_* ops are compiled "
     "into the step and do not count here)", ("kind",))
+#: runtime device-time attribution (analysis.cost): live MFU as a
+#: per-executor gauge series instead of a bench-only offline number.
+#: step_device_ms is the windowed median inter-dispatch interval — in a
+#: throttled steady-state loop the host dispatches exactly as fast as
+#: the device retires steps, so the interval IS the per-step device
+#: time; mfu = analytic flops/step over (interval x chip peak).
+_STEP_MS_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_step_device_ms",
+    "median per-step time (ms) at the dispatch boundary — equals "
+    "device step time in a throttled steady-state loop", ("executor",))
+_STEP_MFU_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_step_mfu",
+    "live model-flops utilization in [0,1]: analytic flops/step "
+    "(analysis.cost) over step-time estimate x device peak", ("executor",))
+_CLASS_SHARE_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_step_flops_share",
+    "analytic flop share by op class of the most recently planned "
+    "step (conv/matmul/embedding/norm/softmax/attention/...) — the "
+    "roofline attribution the fusion arc picks candidates from",
+    ("op_class",))
+_ANALYTIC_FLOPS_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_analytic_step_flops",
+    "analytic flops per step of the most recently compiled block")
+_XLA_FLOPS_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_xla_step_flops",
+    "XLA cost_analysis() flops per step of the most recently "
+    "cross-checked block (FLAGS_cost_crosscheck)")
+_COST_XCHK_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_cost_crosscheck_total",
+    "analytic-cost vs compiled.cost_analysis() comparisons at compile "
+    "time: 'ok' within the 3x band, 'divergent' outside it, 'skipped' "
+    "for programs without dominant MXU-class work, 'unavailable' when "
+    "XLA reported no flops", ("verdict",))
+#: analytic-vs-XLA agreement band: XLA folds elementwise work into
+#: fusions and counts transcendentals its own way, so exact equality is
+#: not expected — an order-of-magnitude drift is what the gate catches
+_COST_XCHK_BAND = 3.0
 
 _HELP = {
     "cache_hits": "dispatches served by the compiled-block cache",
@@ -122,6 +159,14 @@ class _DispatchStats:
             for f in self._INT_FIELDS + self._US_FIELDS}
         self._cells = {f: fam.labels(**lbl)
                        for f, fam in self._fams.items()}
+        # live attribution gauges, bound once (a per-step update is two
+        # lock+store ops — the hot path never resolves labels)
+        self._ms_cell = _STEP_MS_GAUGE.labels(**lbl)
+        self._mfu_cell = _STEP_MFU_GAUGE.labels(**lbl)
+
+    def set_step_timing(self, step_ms: float, mfu: float):
+        self._ms_cell.set(step_ms)
+        self._mfu_cell.set(mfu)
 
     def retire(self):
         """Fold this executor's label series into ``executor="retired"``
@@ -138,6 +183,11 @@ class _DispatchStats:
         for fam in self._fams.values():
             fam.fold(src, dst)
         self._cells = retired
+        # a dead executor's last step time / MFU is meaningless: drop
+        # the gauge series (PR-2 retirement semantics for gauges); the
+        # detached cells absorb any straggling set() harmlessly
+        _STEP_MS_GAUGE.fold(src, None)
+        _STEP_MFU_GAUGE.fold(src, None)
 
     def reset(self):
         for c in self._cells.values():
@@ -180,6 +230,71 @@ def _compile_cache_entries(cache_dir: str) -> int:
 #: live executors, for profiler-level aggregation (weak: an executor's
 #: stats die with it, matching the reference's per-executor profiler state)
 _EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
+
+#: process-global step ids: every dispatch (any executor) gets one, and
+#: the SAME id keys the host-side executor.dispatch tracer span, the
+#: jax.profiler StepTraceAnnotation the device trace records, and the
+#: sampling-profiler window manifest — so a device trace window maps
+#: back to exactly the monitor.py spans it overlapped
+_GLOBAL_STEPS = itertools.count(1)
+
+_device_peak_cache: List[float] = []
+
+
+def _maybe_sample_step(step_id: int) -> None:
+    """Memoized trampoline to profiler.maybe_sample_step: the profiler
+    module cannot be imported at executor module load (it resolves
+    through the partially-initialized package during bootstrap), and a
+    per-dispatch import statement would put import-lock machinery on
+    the hottest path — so the bound function is cached on first use."""
+    global _maybe_sample_step
+    from ..profiler import maybe_sample_step
+    _maybe_sample_step = maybe_sample_step
+    maybe_sample_step(step_id)
+
+
+def _device_peak() -> float:
+    """Memoized chip peak FLOP/s (the live-MFU denominator)."""
+    if not _device_peak_cache:
+        from ..analysis.cost import device_peak_flops
+        _device_peak_cache.append(device_peak_flops())
+    return _device_peak_cache[0]
+
+
+def _resolve_cost(cb, program, feeds):
+    """Once per compiled block: the analytic flops-per-step of this
+    program at the REAL feed batch (the verifier stamps a batch=1
+    baseline; the plan cache makes the re-plan at the true batch a
+    fingerprint-keyed one-off).  Also publishes the per-op-class flop
+    shares.  Returns (flops, peak_flops_per_s) or None — cost modeling
+    must never break dispatch."""
+    try:
+        from ..analysis.cost import plan_cost
+        batch = 1
+        for f in feeds:
+            shape = getattr(f, "shape", None)
+            if shape:
+                batch = int(shape[0])
+                break
+        plan = plan_cost(program, cb.fetch_names, batch_size=batch)
+        if not plan.flops:
+            return None
+        share = plan.share()
+        # the family reports THE most recently planned step: drop stale
+        # op-class series first, or a conv model's shares would keep
+        # exporting next to a later transformer's (summing to ~2 and
+        # attributing flops to classes the current program lacks)
+        for labels, _cell in _CLASS_SHARE_GAUGE.series():
+            if labels.get("op_class") not in share:
+                _CLASS_SHARE_GAUGE.fold(labels, None)
+        for cls, s in share.items():
+            _CLASS_SHARE_GAUGE.set(s, op_class=cls)
+        _ANALYTIC_FLOPS_GAUGE.set(float(plan.flops))
+        mxu = sum(share.get(c, 0.0)
+                  for c in ("matmul", "conv", "attention"))
+        return float(plan.flops), _device_peak(), mxu
+    except Exception:
+        return None
 
 
 def _scope_evict_cb(exe_ref, scope_tok):
@@ -878,6 +993,11 @@ class Executor:
         # once the scope holds the step's (possibly in-flight) outputs —
         # the checkpoint daemon's capture point (resilience.py)
         self._step_hooks: List[Any] = []  # guarded-by: _lock
+        # live device-time attribution: inter-dispatch interval window
+        # (median feeds the step_device_ms / step_mfu gauges)
+        self._last_dispatch_t: Optional[float] = None  # guarded-by: _lock
+        self._step_win: collections.deque = \
+            collections.deque(maxlen=9)  # guarded-by: _lock
         _EXECUTORS.add(self)
         # registry hygiene: when this executor dies, its 13 label series
         # fold into executor="retired" (the callback must not hold a ref
@@ -1181,17 +1301,39 @@ class Executor:
                 cb.pending_compile = False
         if pending_compile:
             from ..flags import get_flags as _gf
-            cache_dir = _gf("FLAGS_xla_compile_cache_dir")[
-                "FLAGS_xla_compile_cache_dir"]
+            fl_c = _gf(["FLAGS_xla_compile_cache_dir",
+                        "FLAGS_cost_crosscheck"])
+            cache_dir = fl_c["FLAGS_xla_compile_cache_dir"]
             n_before = _compile_cache_entries(cache_dir)
             tc0 = time.perf_counter()
+            if fl_c["FLAGS_cost_crosscheck"]:
+                # AOT-compile so XLA's own cost_analysis() is available
+                # to cross-check the analytic model; the compiled object
+                # is then USED for execution (same pattern as the
+                # RECORD_HBM path), so the check costs no extra compile
+                try:
+                    compiled = cb.jitted.lower(
+                        feeds, ro_vals, rw_vals, seed_arr).compile()
+                    cb._compiled_aot = compiled
+                    from ..analysis.cost import xla_cost_totals
+                    cb._xla_cost = xla_cost_totals(
+                        compiled.cost_analysis())
+                except Exception:
+                    cb._xla_cost = None
+        step_id = next(_GLOBAL_STEPS)
         try:
             # watchdog: a dispatch (incl. a first-call compile) exceeding
             # FLAGS_watchdog_timeout_s becomes a HungStepError with a
             # stack+telemetry dump instead of an indefinite hang; the
             # injection hook fires INSIDE the watched region so a
-            # 'hang'-mode fault exercises exactly that path
-            with _resil.WATCHDOG.watch("executor.dispatch"):
+            # 'hang'-mode fault exercises exactly that path.  The
+            # StepTraceAnnotation stamps the SAME step id onto the
+            # device trace (jax.profiler/xprof groups device ops under
+            # it), so sampled device windows correlate 1:1 with the
+            # host-side executor.dispatch span for the step.
+            with _resil.WATCHDOG.watch("executor.dispatch"), \
+                    jax.profiler.StepTraceAnnotation(
+                        "paddle_tpu.step", step_num=step_id):
                 _resil.maybe_inject("executor.dispatch")
                 fetches, new_rw, probe = cb(feeds, ro_vals, rw_vals,
                                             seed_arr)
@@ -1244,7 +1386,70 @@ class Executor:
         stats.incr("time_to_dispatch_us", (tdisp - t0) * 1e6)
         if _monitor.TRACER.enabled:
             _monitor.TRACER.add_complete("executor.dispatch", "dispatch",
-                                         t0, tdisp)
+                                         t0, tdisp, {"step": step_id})
+        # -- live device-time attribution (analysis.cost) -----------------
+        # resolved ONCE per compiled block (fingerprint-cached plan);
+        # the steady-state step pays one getattr + a median-window
+        # update + two
+        # bound-gauge stores — nothing here syncs the device
+        cost = getattr(cb, "cost_info", _UNSET)
+        if cost is _UNSET:
+            cost = cb.cost_info = _resolve_cost(cb, program, feeds)
+            xla_cost = getattr(cb, "_xla_cost", None)
+            if xla_cost is not None and cost is not None:
+                xla_flops = xla_cost[0]
+                _XLA_FLOPS_GAUGE.set(xla_flops)
+                if xla_flops <= 0:
+                    verdict = "unavailable"
+                elif cost[2] < 0.5:
+                    # MXU-class work (matmul/conv/attention) is where the
+                    # two accountings must agree; a program dominated by
+                    # elementwise/RNG ops (a startup init, a metrics
+                    # pass) diverges legitimately — XLA bills
+                    # transcendentals, the analytic model bills elements
+                    verdict = "skipped"
+                else:
+                    ratio = cost[0] / xla_flops
+                    verdict = ("ok" if 1.0 / _COST_XCHK_BAND <= ratio
+                               <= _COST_XCHK_BAND else "divergent")
+                _COST_XCHK_CTR.inc(1, verdict=verdict)
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "cost.crosscheck", "compile",
+                        {"analytic_flops": cost[0],
+                         "xla_flops": xla_flops, "verdict": verdict})
+                if verdict == "divergent":
+                    import warnings
+                    warnings.warn(
+                        f"analytic cost model reports {cost[0]:.3g} "
+                        f"flops/step but XLA cost_analysis() reports "
+                        f"{xla_flops:.3g} (>{_COST_XCHK_BAND}x apart) — "
+                        "the live MFU gauge and bench offline MFU may "
+                        "disagree; check analysis/cost.py coverage for "
+                        "this program's ops")
+        if cost is not None:
+            # median of the last few inter-dispatch intervals, not an
+            # EMA: the first interval after a compile carries warmup
+            # noise an EMA would average in for many steps, while the
+            # median discards it after two clean steps.  Tracked
+            # PER-EXECUTOR, not per compiled block: an executor
+            # alternating two blocks (train + eval) would otherwise
+            # measure each block's interval across the whole A->B->A
+            # cycle and report ~2x the real step time.  Lock-guarded:
+            # concurrent run() threads iterate the deque (sorted) while
+            # appending.
+            with self._lock:
+                last = self._last_dispatch_t
+                self._last_dispatch_t = tdisp
+                med = None
+                if last is not None and tdisp > last:
+                    self._step_win.append(tdisp - last)
+                    med = sorted(self._step_win)[
+                        len(self._step_win) // 2]
+            if med is not None:
+                stats.set_step_timing(med * 1e3,
+                                      cost[0] / med / cost[1])
+        _maybe_sample_step(step_id)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
         if self._step_hooks:

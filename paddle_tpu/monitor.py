@@ -44,7 +44,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "StepTracer", "TRACER", "span", "export", "telemetry_snapshot",
-    "counter_totals",
+    "counter_totals", "metrics_digest", "capped_digest",
+    "DIGEST_MAX_BYTES",
 ]
 
 # ---------------------------------------------------------------------------
@@ -399,6 +400,146 @@ GANG_FP_CTR = REGISTRY.counter(
     "cross-rank collective-fingerprint mismatches detected (heartbeat "
     "exchange or step-barrier refusal) — each one is a divergence that "
     "would otherwise hang inside a collective")
+
+# -- gang metrics digests (this PR): every rank's heartbeat carries a
+# compact, byte-capped digest of its runtime metrics (step-time estimate, MFU,
+# queue occupancy, in-flight depth); the coordinator folds the digests
+# into the gang-level skew/straggler series below and per-rank series a
+# `tools/gangtop.py` table renders live.  Declared here for the same
+# reason as the families above: both socket ends touch them.
+
+#: serialized digest size cap: a gang control frame stays tiny by
+#: contract — the client drops keys to fit, the coordinator REFUSES
+#: oversized digests outright (a compat guard against a future client
+#: stuffing the liveness plane)
+DIGEST_MAX_BYTES = 512
+
+GANG_RANK_STEP_MS = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_step_ms",
+    "per-rank step-time estimate (ms) from the heartbeat digest", ("rank",))
+GANG_RANK_MFU = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_mfu",
+    "per-rank live MFU from the heartbeat digest", ("rank",))
+GANG_RANK_QUEUE = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_queue_depth",
+    "per-rank dataloader prefetch-queue depth from the heartbeat "
+    "digest", ("rank",))
+GANG_RANK_INFLIGHT = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_inflight",
+    "per-rank executor in-flight step depth from the heartbeat digest",
+    ("rank",))
+GANG_DIGEST_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_digests_total",
+    "heartbeat metrics digests accepted by the coordinator, per rank",
+    ("rank",))
+GANG_DIGEST_OVERSIZE_CTR = REGISTRY.counter(
+    "paddle_tpu_gang_digest_oversize_total",
+    "heartbeat digests REFUSED for exceeding DIGEST_MAX_BYTES "
+    "serialized (the beat itself is still accepted — liveness never "
+    "rides on digest validity)")
+GANG_STEP_SKEW_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_gang_step_skew",
+    "max-min current training step across LIVE ranks (degraded-aware: "
+    "dead and departed ranks are excluded) — sustained growth names a "
+    "straggler or a wedged rank")
+GANG_STEP_TIME_SKEW_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_gang_step_time_skew_ms",
+    "max-min per-rank step-time estimate (ms) across live ranks with "
+    "digests — the throughput form of the step skew")
+GANG_STRAGGLER_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_gang_straggler_rank",
+    "rank id with the slowest step-time estimate among live ranks (-1 when "
+    "no digests have arrived) — the rank gangtop flags")
+GANG_STRAGGLER_MS_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_gang_straggler_step_ms",
+    "the straggler rank's step-time estimate (ms)")
+
+
+def metrics_digest() -> Dict[str, Any]:
+    """Compact snapshot of THIS rank's runtime health for the gang
+    heartbeat: step-time estimate + live MFU (the newest live executor's
+    ``paddle_tpu_step_device_ms``/``paddle_tpu_step_mfu`` series),
+    dataloader queue depth, executor in-flight depth, and total steps
+    dispatched.  Reads a handful of specific families — never a full
+    registry collect — so the heartbeat thread stays cheap."""
+    digest: Dict[str, Any] = {}
+
+    def newest_executor_series(name):
+        fam = REGISTRY.get(name)
+        if fam is None:
+            return None
+        best, best_serial = None, -1
+        for labels, cell in fam.series():
+            try:
+                serial = int(labels.get("executor", -1))
+            except (TypeError, ValueError):
+                continue                  # the "retired" fold series
+            if serial > best_serial:
+                best_serial, best = serial, cell.get()
+        return best
+
+    ms = newest_executor_series("paddle_tpu_step_device_ms")
+    if ms is not None:
+        digest["step_ms"] = round(float(ms), 3)
+    mfu = newest_executor_series("paddle_tpu_step_mfu")
+    if mfu is not None:
+        digest["mfu"] = round(float(mfu), 5)
+    qd = REGISTRY.get("paddle_tpu_dataloader_queue_depth")
+    if qd is not None:
+        vals = [cell.get() for labels, cell in qd.series()
+                if labels.get("pipeline") != "retired"]
+        if vals:
+            digest["queue"] = float(sum(vals))
+    steps_fam = REGISTRY.get("paddle_tpu_executor_steps_dispatched")
+    if steps_fam is not None:
+        total = sum(cell.get() for _, cell in steps_fam.series())
+        if total:
+            digest["steps"] = int(total)
+    try:
+        from .framework.executor import _EXECUTORS
+        digest["inflight"] = int(sum(
+            len(e._inflight) for e in list(_EXECUTORS)))
+    except Exception:
+        pass
+    return digest
+
+
+#: digest keys the gang skew/straggler plane reads, most important
+#: first — capped_digest sheds from the BOTTOM of this list, and sheds
+#: keys not on it before any that are
+_DIGEST_PRIORITY = ("step_ms", "mfu", "queue", "inflight", "steps")
+
+
+def capped_digest(digest: Dict[str, Any],
+                  max_bytes: int = DIGEST_MAX_BYTES) -> Dict[str, Any]:
+    """Enforce the serialized digest byte cap client-side by dropping
+    keys until the JSON fits: unknown extras first (reverse-sorted, so
+    the order is deterministic), then known keys from least to most
+    important — ``step_ms``, the input the whole straggler plane runs
+    on, is the LAST to go.  The coordinator re-checks and refuses
+    anything still over."""
+    d = dict(digest)
+    while d and len(json.dumps(d, sort_keys=True)) > max_bytes:
+        extras = sorted((k for k in d if k not in _DIGEST_PRIORITY),
+                        reverse=True)
+        if extras:
+            d.pop(extras[0])
+        else:
+            d.pop(next(k for k in reversed(_DIGEST_PRIORITY) if k in d))
+    return d
+
+
+def retire_gang_rank_series(rank) -> None:
+    """Registry hygiene when a rank dies or departs: its digest counter
+    folds into ``rank="retired"`` (process totals stay exact — PR 2's
+    retirement semantics) and its gauge series are dropped (a dead
+    rank's last step time is meaningless, and an elastic gang respawning
+    ranks must not grow the registry per incarnation)."""
+    src = {"rank": str(rank)}
+    GANG_DIGEST_CTR.fold(src, {"rank": "retired"})
+    for g in (GANG_RANK_STEP_MS, GANG_RANK_MFU, GANG_RANK_QUEUE,
+              GANG_RANK_INFLIGHT):
+        g.fold(src, None)
 
 
 # ---------------------------------------------------------------------------

@@ -236,3 +236,201 @@ def device_profiler(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler: periodic jax.profiler windows for multi-day runs
+# ---------------------------------------------------------------------------
+
+class SamplingProfiler:
+    """Periodic ``jax.profiler`` capture windows, driven by the executor's
+    per-dispatch step counter — the multi-day-run answer to "a monolithic
+    device trace of a week costs more than the training".
+
+    Every ``every_n`` steps a window opens (``jax.profiler.start_trace``
+    into its own subdirectory) and closes ``window_steps`` dispatches
+    later.  Windows live in a BOUNDED rotating directory: at most
+    ``max_windows`` are kept, oldest deleted first, and
+    ``manifest.json`` maps every kept window to its [start, end) step
+    range plus wall-clock times — so a sampled device trace correlates
+    back to the step ids the executor stamps on its host spans and
+    ``StepTraceAnnotation``s.
+
+    The hot path is one attribute check when disabled
+    (``every_n <= 0``); all state mutation happens at window boundaries,
+    off the per-step critical path.  Capture errors never fail the step:
+    they count in ``paddle_tpu_profile_windows_total{outcome="error"}``
+    and disarm the window.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.every_n = 0                 # fast-path guard (int compare)
+        self.window_steps = 4            # guarded-by: _mu
+        self.base_dir = ""               # guarded-by: _mu
+        self.max_windows = 8             # guarded-by: _mu
+        self._active = None              # guarded-by: _mu  (window dict)
+        self._atexit_armed = False       # guarded-by: _mu
+
+    def configure(self, every_n: int, window_steps: int, base_dir: str,
+                  max_windows: int) -> None:
+        with self._mu:
+            self.window_steps = max(int(window_steps), 1)
+            self.base_dir = str(base_dir) or "pt_profile_samples"
+            self.max_windows = max(int(max_windows), 1)
+            if not self._atexit_armed and int(every_n) > 0:
+                import atexit
+                atexit.register(self.close)
+                self._atexit_armed = True
+            # set LAST: the armed fast path must only observe a fully
+            # configured sampler
+            self.every_n = int(every_n)
+
+    # -- step hook (called by the executor per dispatch) ---------------------
+    def on_step(self, step_id: int) -> None:
+        if self.every_n <= 0 and self._active is None:
+            return
+        with self._mu:
+            act = self._active
+            if act is not None:
+                if step_id - act["opened_at"] >= self.window_steps:
+                    # this step's annotation already closed inside the
+                    # active trace: the capture runs through step_id, so
+                    # the half-open manifest range ends at step_id + 1
+                    self._finish_locked(act, step_id + 1)
+                else:
+                    act["last_step"] = step_id
+                return
+            if self.every_n > 0 and step_id % self.every_n == 0:
+                self._open_locked(step_id)
+
+    def close(self) -> None:
+        """Finish any in-flight window (process exit / reconfigure).
+        A window that observed NO steps is abandoned outright — an
+        empty capture would pollute the manifest with a vacuous
+        ``[N, N)`` range and burn a rotation slot."""
+        import jax
+        import shutil
+        with self._mu:
+            act = self._active
+            if act is None:
+                return
+            if "last_step" in act:
+                self._finish_locked(act, act["last_step"] + 1)
+                return
+            self._active = None
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                _note_window_error(e)
+            _window_ctr("empty")
+            shutil.rmtree(act["dir"], ignore_errors=True)
+
+    # -- window lifecycle (all hold _mu) -------------------------------------
+    def _open_locked(self, step_id: int):  # guarded-by-caller: _mu
+        import jax
+        wdir = os.path.join(self.base_dir, f"window_{step_id:08d}")
+        try:
+            os.makedirs(wdir, exist_ok=True)
+            jax.profiler.start_trace(wdir)
+        except Exception as e:
+            _window_ctr("error")
+            _note_window_error(e)
+            # un-manifested dirs are invisible to rotation — leaving
+            # this one behind would defeat the max_windows disk bound
+            # on exactly the runs (recurring capture errors) that hit
+            # this path the most
+            import shutil
+            shutil.rmtree(wdir, ignore_errors=True)
+            return
+        # this hook runs at the END of step_id's dispatch — its
+        # StepTraceAnnotation has already closed, so the first step the
+        # open trace observes is step_id + 1 (the manifest's start)
+        self._active = {"dir": wdir, "start_step": int(step_id) + 1,
+                        "opened_at": int(step_id),
+                        "wall_start": time.time()}
+        from . import monitor as _monitor
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant("profile.window_start", "profile",
+                                    {"step": int(step_id), "dir": wdir})
+
+    def _finish_locked(self, act, end_step: int):  # guarded-by-caller: _mu
+        import jax
+        self._active = None
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            _window_ctr("error")
+            _note_window_error(e)
+            # the partial capture never reaches the manifest, so
+            # rotation could never reclaim it — delete it now (same
+            # disk-bound rationale as the open-failure path)
+            import shutil
+            shutil.rmtree(act["dir"], ignore_errors=True)
+            return
+        act["end_step"] = int(end_step)
+        act["wall_end"] = time.time()
+        _window_ctr("ok")
+        from . import monitor as _monitor
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "profile.window_stop", "profile",
+                {"step": int(end_step), "dir": act["dir"]})
+        try:
+            self._rotate_and_manifest_locked(act)
+        except OSError:
+            pass          # a full disk must not fail the training step
+
+    def _rotate_and_manifest_locked(self, act):  # guarded-by-caller: _mu
+        import shutil
+        path = os.path.join(self.base_dir, "manifest.json")
+        manifest = {"windows": []}
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            pass
+        windows = [w for w in manifest.get("windows", [])
+                   if isinstance(w, dict)]
+        windows.append({k: act[k] for k in
+                        ("dir", "start_step", "end_step",
+                         "wall_start", "wall_end")})
+        windows.sort(key=lambda w: w.get("start_step", 0))
+        while len(windows) > self.max_windows:
+            victim = windows.pop(0)
+            shutil.rmtree(victim.get("dir", ""), ignore_errors=True)
+        manifest["windows"] = windows
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, path)
+
+
+def _window_ctr(outcome: str):
+    from . import monitor as _monitor
+    _monitor.REGISTRY.counter(
+        "paddle_tpu_profile_windows_total",
+        "sampling-profiler capture windows by outcome",
+        ("outcome",)).inc(1, outcome=outcome)
+
+
+_last_window_error = []
+
+
+def _note_window_error(e: BaseException):
+    """Remember the last capture failure (visible via last_window_error()
+    — a sampler that silently never captures is undebuggable)."""
+    _last_window_error[:] = [repr(e)]
+
+
+def last_window_error():
+    return _last_window_error[0] if _last_window_error else None
+
+
+SAMPLER = SamplingProfiler()
+
+
+def maybe_sample_step(step_id: int) -> None:
+    """Executor per-dispatch hook: one int compare when sampling is off
+    (the default), window open/close bookkeeping at boundaries when on."""
+    SAMPLER.on_step(step_id)

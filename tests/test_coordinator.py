@@ -9,6 +9,7 @@ step, and the combined per-step loss trajectory exactly equals an
 uninterrupted baseline.
 """
 
+import json
 import os
 import signal
 import socket
@@ -834,4 +835,261 @@ def test_step_barrier_repairs_after_elastic_respawn():
         c0.close(goodbye=False)
         c1b.close(goodbye=False)
     finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat metrics digests (this PR: the gang observability plane)
+# ---------------------------------------------------------------------------
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_digest_rides_heartbeat_into_status_and_rank_series():
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_digest({"step_ms": 100.0, "mfu": 0.41, "queue": 2,
+                       "inflight": 2})
+        c1.set_digest({"step_ms": 160.0, "mfu": 0.30, "queue": 0,
+                       "inflight": 1})
+        c0.set_progress(step=12)
+        c1.set_progress(step=9)
+
+        def both_digests():
+            ranks = c0.status()["ranks"]
+            return ((ranks.get("0", {}).get("digest") or {})
+                    .get("step_ms") == 100.0 and
+                    (ranks.get("1", {}).get("digest") or {})
+                    .get("step_ms") == 160.0)
+        assert _wait_for(both_digests)
+        st = c0.status()
+        assert st["ranks"]["0"]["digest"]["mfu"] == 0.41
+        # per-rank registry series on the coordinator host
+        assert monitor.GANG_RANK_STEP_MS.value(rank="0") == 100.0
+        assert monitor.GANG_RANK_STEP_MS.value(rank="1") == 160.0
+        assert monitor.GANG_RANK_MFU.value(rank="1") == 0.30
+        assert monitor.GANG_RANK_INFLIGHT.value(rank="0") == 2
+        assert monitor.GANG_DIGEST_CTR.value(rank="0") >= 1
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_gang_skew_and_straggler_gauge_math():
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_digest({"step_ms": 100.0})
+        c1.set_digest({"step_ms": 160.0})
+        c0.set_progress(step=12)
+        c1.set_progress(step=9)
+        # both ranks' digests + cur_steps must have landed before the
+        # aggregates are meaningful (the beats arrive independently)
+        assert _wait_for(
+            lambda: monitor.GANG_RANK_STEP_MS.value(rank="0") == 100.0
+            and monitor.GANG_RANK_STEP_MS.value(rank="1") == 160.0
+            and monitor.GANG_STEP_TIME_SKEW_GAUGE.value() == 60.0
+            and monitor.GANG_STEP_SKEW_GAUGE.value() == 3)
+        # step skew = max-min cur_step over live ranks; straggler names
+        # the slowest step-time estimate; time skew is its throughput form
+        assert monitor.GANG_STRAGGLER_GAUGE.value() == 1
+        assert monitor.GANG_STRAGGLER_MS_GAUGE.value() == 160.0
+        # the straggler flips when the other rank slows down
+        c1.set_digest({"step_ms": 50.0})
+        assert _wait_for(
+            lambda: monitor.GANG_STRAGGLER_GAUGE.value() == 0
+            and monitor.GANG_STRAGGLER_MS_GAUGE.value() == 100.0)
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_digest_byte_cap_client_truncates_server_refuses():
+    # client side: capped_digest drops keys deterministically until the
+    # serialized form fits
+    big = {f"k{i:03d}": 1.0 for i in range(200)}
+    capped = monitor.capped_digest(big)
+    assert len(json.dumps(capped, sort_keys=True)) <= \
+        monitor.DIGEST_MAX_BYTES
+    assert capped and set(capped) < set(big)
+    # server side: an OVERSIZED digest in a hand-rolled beat is refused
+    # (counted) while the beat itself still refreshes liveness
+    before = _totals()
+    coord = GangCoordinator(world_size=1, heartbeat_timeout_s=30).start()
+    try:
+        s = socket.create_connection(
+            ("127.0.0.1", coord.port), timeout=5)
+        try:
+            send_frame(s, {"op": "heartbeat", "rank": 0, "step": 7,
+                           "digest": {"blob": "x" * 2048}})
+            resp = recv_frame(s)
+            assert resp["ok"]
+        finally:
+            s.close()
+        st = coord._ranks[0]
+        assert st["digest"] is None           # refused, not stored
+        assert st["cur_step"] == 7            # the beat still landed
+        after = _totals()
+        assert _delta(before, after,
+                      "paddle_tpu_gang_digest_oversize_total") == 1
+    finally:
+        coord.stop()
+
+
+def test_digestless_old_client_beats_stay_compatible():
+    """A beat WITHOUT the digest field (an old client) must work exactly
+    as before: liveness refreshes, fingerprints exchange, no digest
+    machinery fires."""
+    before = _totals()
+    coord = GangCoordinator(world_size=1, heartbeat_timeout_s=30).start()
+    try:
+        s = socket.create_connection(
+            ("127.0.0.1", coord.port), timeout=5)
+        try:
+            send_frame(s, {"op": "heartbeat", "rank": 0, "step": 3,
+                           "fingerprint": "fp"})
+            resp = recv_frame(s)
+            assert resp["ok"] and resp["status"] in ("ok", "forming")
+        finally:
+            s.close()
+        e = coord._ranks[0]
+        assert e["alive"] and e["cur_step"] == 3
+        assert e["fingerprint"] == "fp"
+        assert e["digest"] is None
+        after = _totals()
+        assert _delta(before, after,
+                      "paddle_tpu_gang_digests_total") == 0
+        assert _delta(before, after,
+                      "paddle_tpu_gang_digest_oversize_total") == 0
+    finally:
+        coord.stop()
+
+
+def test_dead_rank_digest_folds_into_retired_series():
+    before = _totals()
+    coord, (c0, c1) = _gang()                 # 0.6 s heartbeat timeout
+    try:
+        c0.set_digest({"step_ms": 100.0})
+        c1.set_digest({"step_ms": 160.0})
+        assert _wait_for(
+            lambda: monitor.GANG_DIGEST_CTR.value(rank="1") >= 1)
+        c1.close(goodbye=False)               # SIGKILL-style silence
+        assert _wait_for(lambda: c0.degraded)
+        # the liveness loop retires the dead rank's series within one
+        # poll interval: gauges drop, the digest counter folds into
+        # rank="retired" with process totals intact
+        assert _wait_for(lambda: {"rank": "1"} not in [
+            lbl for lbl, _ in monitor.GANG_RANK_STEP_MS.series()])
+        assert monitor.GANG_DIGEST_CTR.value(rank="retired") >= 1
+        # degraded-aware aggregates RESET with one live rank left: a
+        # skew/straggler gauge frozen at its pre-death value would keep
+        # an alert firing against the healthy survivor forever
+        assert _wait_for(
+            lambda: monitor.GANG_STRAGGLER_GAUGE.value() == -1)
+        assert monitor.GANG_STEP_TIME_SKEW_GAUGE.value() == 0
+        assert monitor.GANG_STEP_SKEW_GAUGE.value() == 0
+        after = _totals()
+        assert _delta(before, after,
+                      "paddle_tpu_gang_digests_total") >= 2
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_gangtop_once_renders_table(tmp_path):
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_digest({"step_ms": 100.0, "mfu": 0.41})
+        c1.set_digest({"step_ms": 160.0, "mfu": 0.30})
+        c0.set_progress(step=12)
+        c1.set_progress(step=9)
+        assert _wait_for(lambda: (c0.status()["ranks"].get("1", {})
+                                  .get("digest") or {}).get("step_ms"))
+        tool = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "gangtop.py")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, tool, "--coord", coord.address, "--once"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "straggler" in r.stdout        # rank 1 flagged
+        assert "step_skew=3" in r.stdout
+        for token in ("RANK", "STEP_MS", "MFU%"):
+            assert token in r.stdout
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_capped_digest_sheds_extras_before_step_ms():
+    """The byte cap must shed unknown extras first and step_ms LAST —
+    it is the input the whole straggler plane runs on (review finding:
+    reverse-alphabetical dropping discarded steps/step_ms first)."""
+    big = {"step_ms": 123.0, "mfu": 0.4, "steps": 10}
+    big.update({f"extra{i:03d}": 1.0 for i in range(200)})
+    capped = monitor.capped_digest(big)
+    assert len(json.dumps(capped, sort_keys=True)) <= \
+        monitor.DIGEST_MAX_BYTES
+    assert capped["step_ms"] == 123.0
+    assert capped["mfu"] == 0.4
+    # tiny cap: only the most important keys survive, step_ms last
+    tiny = monitor.capped_digest(big, max_bytes=20)
+    assert list(tiny) == ["step_ms"]
+
+
+def test_digestless_beat_clears_stored_digest():
+    """A rank whose executor retired stops producing digests; its beat
+    then omits the field and the coordinator must CLEAR the stale one
+    so skew/straggler math drops the rank (review finding: the last
+    digest haunted the aggregates forever)."""
+    coord = GangCoordinator(world_size=1, heartbeat_timeout_s=30).start()
+    try:
+        s = socket.create_connection(
+            ("127.0.0.1", coord.port), timeout=5)
+        try:
+            send_frame(s, {"op": "heartbeat", "rank": 0,
+                           "digest": {"step_ms": 99.0}})
+            assert recv_frame(s)["ok"]
+            assert coord._ranks[0]["digest"] == {"step_ms": 99.0}
+            send_frame(s, {"op": "heartbeat", "rank": 0})  # no digest
+            assert recv_frame(s)["ok"]
+            assert coord._ranks[0]["digest"] is None
+        finally:
+            s.close()
+    finally:
+        coord.stop()
+
+
+def test_status_aggregates_match_gauges():
+    """The status payload carries the SAME aggregates the gauges
+    publish (one computation — gangtop can never disagree with
+    paddle_tpu_gang_straggler_rank)."""
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_digest({"step_ms": 100.0})
+        c1.set_digest({"step_ms": 160.0})
+        c0.set_progress(step=12)
+        c1.set_progress(step=9)
+        assert _wait_for(
+            lambda: (c0.status().get("aggregates") or {})
+            .get("straggler") == 1)
+        agg = c0.status()["aggregates"]
+        assert agg["step_skew"] == 3
+        assert agg["straggler_step_ms"] == 160.0
+        assert agg["step_time_skew_ms"] == 60.0
+        assert monitor.GANG_STRAGGLER_GAUGE.value() == agg["straggler"]
+        assert monitor.GANG_STEP_SKEW_GAUGE.value() == agg["step_skew"]
+    finally:
+        c0.close()
+        c1.close()
         coord.stop()

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""gangtop: a live per-rank table of the gang, rendered from the
+coordinator's ``status`` view — `top` for a training gang.
+
+Each row is one rank: liveness, current training step, durably-committed
+step, and the heartbeat metrics digest (step-time estimate, live MFU,
+dataloader queue depth, executor in-flight depth).  The slowest live
+rank is flagged ``<-- straggler`` (the same rank the coordinator's
+``paddle_tpu_gang_straggler_rank`` gauge names), and the footer carries
+the gang-level view: status, step skew, manifest, fingerprint mismatch.
+
+Usage:
+    python tools/gangtop.py [--coord HOST:PORT] [--interval 2.0] [--once]
+
+``--coord`` defaults to ``$PADDLE_GANG_COORD`` (the launcher exports it
+for every rank).  ``--once`` prints a single snapshot and exits — the
+scriptable/CI form; without it the table refreshes in place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fetch_status(address: str, timeout_s: float = 5.0) -> dict:
+    """One status round-trip on a one-shot connection (no paddle_tpu
+    import cycle: the frame codec is inlined-compatible — 4-byte BE
+    length + JSON — but we use the shared implementation)."""
+    from paddle_tpu.distributed.coordinator import recv_frame, send_frame
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        send_frame(s, {"op": "status"})
+        return recv_frame(s)
+
+
+def _fmt(v, spec="{:.1f}", dash="-"):
+    if v is None:
+        return dash
+    try:
+        return spec.format(v)
+    except (TypeError, ValueError):
+        return dash
+
+
+def render(status: dict) -> str:
+    ranks = status.get("ranks", {})
+    rows = []
+    header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
+              f"{'STEP_MS':>9} {'MFU%':>6} {'QUEUE':>5} {'INFL':>4} "
+              f"{'HB_AGE':>7} {'DEATHS':>6}")
+    rows.append(header)
+    rows.append("-" * len(header))
+    # the coordinator computes the aggregates ONCE (_aggregates_locked)
+    # and ships them in the status payload, so this table can never
+    # disagree with the paddle_tpu_gang_straggler_rank gauge
+    agg = status.get("aggregates") or {}
+    straggler = str(agg.get("straggler", -1))
+    for r in sorted(ranks, key=int):
+        e = ranks[r]
+        state = ("done" if e.get("finished")
+                 else "alive" if e.get("alive") else "DEAD")
+        d = e.get("digest") or {}
+        mfu = d.get("mfu")
+        line = (f"{r:>4}  {state:<8} {_fmt(e.get('cur_step'), '{}'):>8} "
+                f"{_fmt(e.get('step'), '{}'):>7} "
+                f"{_fmt(d.get('step_ms')):>9} "
+                f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
+                f"{_fmt(d.get('queue'), '{:.0f}'):>5} "
+                f"{_fmt(d.get('inflight'), '{}'):>4} "
+                f"{_fmt(e.get('age_s'), '{:.1f}s'):>7} "
+                f"{_fmt(e.get('deaths'), '{}'):>6}")
+        if r == straggler:
+            line += "   <-- straggler"
+        rows.append(line)
+    rows.append("")
+    rows.append(f"gang: {status.get('status', '?')}"
+                f"  dead={status.get('dead', [])}"
+                f"  step_skew={_fmt(agg.get('step_skew'), '{}')}"
+                f"  manifest={status.get('manifest')}")
+    mm = status.get("mismatch")
+    if mm:
+        rows.append(f"FINGERPRINT MISMATCH: {mm.get('detail', mm)}")
+    return "\n".join(rows)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--coord", default=os.getenv("PADDLE_GANG_COORD", ""),
+                   help="coordinator host:port "
+                        "(default: $PADDLE_GANG_COORD)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (scriptable form)")
+    p.add_argument("--json", action="store_true",
+                   help="with --once: dump the raw status JSON instead "
+                        "of the table")
+    args = p.parse_args(argv)
+    if not args.coord or ":" not in args.coord:
+        p.error("no coordinator address: pass --coord HOST:PORT or "
+                "export PADDLE_GANG_COORD")
+    while True:
+        try:
+            status = fetch_status(args.coord)
+        except (OSError, ConnectionError, ValueError) as e:
+            print(f"gangtop: coordinator at {args.coord} unreachable: "
+                  f"{e}", file=sys.stderr)
+            return 1
+        if args.once:
+            print(json.dumps(status, indent=1) if args.json
+                  else render(status))
+            return 0
+        # in-place refresh: clear screen + home, like top
+        sys.stdout.write("\x1b[2J\x1b[H")
+        print(f"gangtop — {args.coord} — "
+              f"{time.strftime('%H:%M:%S')}  (Ctrl-C to quit)\n")
+        print(render(status))
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -11,6 +11,17 @@ Checks, each fatal on failure:
   3. the Prometheus text parses line-by-line
   4. the JSON metrics parse, and the exported dispatch counters match
      ``Executor.dispatch_stats()`` EXACTLY (one source of truth)
+  5. device-span correlation: every executor.dispatch span carries a
+     unique, increasing integer step id (the same id stamped on the
+     jax.profiler StepTraceAnnotation), and the compiler.optimize span
+     carries per-pass lowering-time attribution
+  6. the sampling profiler rotated its capture windows UNDER the
+     configured directory bound, with a manifest mapping window -> step
+     range
+  7. analytic-cost vs compiled.cost_analysis() parity on the training
+     program (FLAGS_cost_crosscheck): at least one 'ok' verdict, zero
+     'divergent'
+  8. the --rank-lanes gang merge passes strict validate()
 
 Usage: JAX_PLATFORMS=cpu python tools/telemetry_smoke.py [outdir]
 """
@@ -35,12 +46,18 @@ def main():
 
     import numpy as np
     import paddle_tpu as pt
-    from paddle_tpu import layers, monitor
+    from paddle_tpu import layers, monitor, profiler
     from paddle_tpu.data.dataloader import _prefetch_to_device
     from paddle_tpu.framework import (Program, Scope, program_guard,
                                       scope_guard)
 
-    pt.set_flags({"FLAGS_telemetry": True})
+    sample_dir = os.path.join(outdir, "profile_samples")
+    pt.set_flags({"FLAGS_telemetry": True,
+                  "FLAGS_cost_crosscheck": True,
+                  "FLAGS_profile_sample_every_n_steps": 3,
+                  "FLAGS_profile_sample_window_steps": 2,
+                  "FLAGS_profile_sample_dir": sample_dir,
+                  "FLAGS_profile_sample_max_windows": 2})
 
     scope = Scope()
     with scope_guard(scope), program_guard(Program(), Program()):
@@ -48,22 +65,27 @@ def main():
         h = layers.fc(x, size=16, act="relu")
         loss = layers.mean(layers.fc(h, size=4))
         pt.optimizer.SGD(0.01).minimize(loss)
+        # the cost crosscheck + verifier stamp ride compiler.optimize
+        cp = pt.CompiledProgram(pt.default_main_program())
         exe = pt.Executor()
         exe.run(pt.default_startup_program(), scope=scope)
 
         def batches():
-            for i in range(8):
+            for i in range(24):
                 yield {"x": np.full((4, 8), 0.1 * i, np.float32)}
 
         handle = None
         for feed in _prefetch_to_device(batches, capacity=2):
-            handle, = exe.run(feed=feed, fetch_list=[loss.name],
+            handle, = exe.run(cp, feed=feed, fetch_list=[loss.name],
                               scope=scope, return_numpy=False)
         final = float(handle.numpy())
         if not np.isfinite(final):
             fail(f"training produced non-finite loss {final}")
         stats = exe.dispatch_stats()
         serial = exe._stats.serial
+    pt.set_flags({"FLAGS_profile_sample_every_n_steps": 0,
+                  "FLAGS_cost_crosscheck": False})
+    profiler.SAMPLER.close()
 
     paths = monitor.export(outdir)
     print(f"exported: {paths}")
@@ -93,6 +115,74 @@ def main():
     mstats = timeline.validate(merged)
     if mstats["events"] != 2 * tstats["events"]:
         fail("rank merge dropped events")
+
+    # 5: step-keyed device-span correlation — every executor.dispatch
+    # span carries the unique increasing step id that also keys the
+    # jax.profiler StepTraceAnnotation and the sampling-window manifest
+    with open(paths["trace"]) as f:
+        tdata = json.load(f)
+    tevents = tdata if isinstance(tdata, list) else tdata["traceEvents"]
+    step_ids = [ev.get("args", {}).get("step") for ev in tevents
+                if ev.get("name") == "executor.dispatch"]
+    if not step_ids:
+        fail("no executor.dispatch spans in trace")
+    if any(not isinstance(s, int) for s in step_ids):
+        fail(f"executor.dispatch spans missing integer step ids: "
+             f"{step_ids[:5]}")
+    if sorted(set(step_ids)) != step_ids:
+        fail(f"dispatch step ids not unique/increasing: {step_ids[:10]}")
+    opt_spans = [ev for ev in tevents
+                 if ev.get("name") == "compiler.optimize"]
+    if not any(isinstance(ev.get("args", {}).get("passes_ms"), dict)
+               and ev["args"]["passes_ms"]
+               for ev in opt_spans):
+        fail("compiler.optimize span lacks per-pass lowering-time "
+             "attribution (passes_ms)")
+    if "compiler.pass.program_verify" not in tstats["names"]:
+        fail("trace missing per-pass span compiler.pass.program_verify")
+
+    # 6: sampling-window rotation stays under the directory bound
+    wdirs = sorted(d for d in os.listdir(sample_dir)
+                   if d.startswith("window_"))
+    if not (1 <= len(wdirs) <= 2):
+        fail(f"sampling profiler kept {len(wdirs)} windows, bound is 2 "
+             f"({wdirs})")
+    with open(os.path.join(sample_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    windows = manifest.get("windows", [])
+    if len(windows) != len(wdirs):
+        fail(f"manifest lists {len(windows)} windows but "
+             f"{len(wdirs)} dirs exist")
+    for w in windows:
+        if not (isinstance(w.get("start_step"), int)
+                and isinstance(w.get("end_step"), int)
+                and w["end_step"] > w["start_step"]):
+            fail(f"manifest window lacks a step range: {w}")
+        if os.path.basename(w["dir"]) not in wdirs:
+            fail(f"manifest names a deleted window dir: {w['dir']}")
+    if profiler.last_window_error() is not None:
+        fail(f"sampling capture errored: {profiler.last_window_error()}")
+
+    # 7: analytic cost vs XLA cost_analysis() parity on this program
+    snap = monitor.telemetry_snapshot()
+    ok_n = snap.get('paddle_tpu_cost_crosscheck_total{verdict="ok"}', 0)
+    div_n = snap.get(
+        'paddle_tpu_cost_crosscheck_total{verdict="divergent"}', 0)
+    if ok_n < 1:
+        fail(f"cost crosscheck produced no 'ok' verdict (snapshot: "
+             f"{ {k: v for k, v in snap.items() if 'crosscheck' in k} })")
+    if div_n > 0:
+        fail(f"analytic cost model DIVERGED from XLA cost_analysis() "
+             f"({div_n} divergent verdicts) — analysis/cost.py no "
+             f"longer matches what XLA emits for this program")
+
+    # 8: gang view — the --rank-lanes merge passes STRICT validation
+    lanes = os.path.join(outdir, "timeline_lanes.json")
+    timeline.merge(f"0={paths['trace']},1={paths['trace']}", lanes,
+                   align=True, rank_lanes=True)
+    lstats = timeline.validate(lanes, strict=True)
+    if lstats["events"] < tstats["events"]:
+        fail("rank-lanes merge dropped events")
 
     # 3: prometheus text parses
     with open(paths["prom"]) as f:

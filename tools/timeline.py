@@ -12,6 +12,15 @@ Usage:
 is t=0 (the step tracer stamps epoch-aligned microseconds so ranks line
 up; aligning keeps chrome's axis readable).  ``validate()`` is the
 malformed-output check the CI telemetry smoke step runs.
+
+``--rank-lanes`` builds a GANG timeline instead: each rank becomes one
+integer pid lane (``pid = rank``), named ``rank N`` and sorted by rank
+via ``process_sort_index`` metadata, with the rank's threads as rows
+inside its lane — the one-glance view of a 2+-rank gang where skew and
+stragglers are visible as horizontally-offset step spans.  Incoming
+per-process ``process_name`` metadata is replaced by the lane labels;
+everything else (thread names, spans, counters) is preserved.  The
+merged output still passes strict ``validate()``.
 """
 
 from __future__ import annotations
@@ -25,8 +34,9 @@ _KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s",
                  "t", "f"}
 
 
-def merge(profile_paths, out_path, align=False):
+def merge(profile_paths, out_path, align=False, rank_lanes=False):
     events = []
+    lane_ranks = set()
     for spec in profile_paths.split(","):
         if "=" in spec:
             rank, path = spec.split("=", 1)
@@ -38,8 +48,24 @@ def merge(profile_paths, out_path, align=False):
         evs = data if isinstance(data, list) else data.get("traceEvents", [])
         for ev in evs:
             ev = dict(ev)
-            ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
+            if rank_lanes:
+                # one integer pid lane per rank; the source process's
+                # own process_name row is dropped (the lane metadata
+                # emitted below names the lane "rank N" instead) while
+                # thread_name rows survive, re-homed into the lane
+                if ev.get("ph") == "M" and \
+                        ev.get("name") == "process_name":
+                    continue
+                ev["pid"] = int(rank)
+                lane_ranks.add(int(rank))
+            else:
+                ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
             events.append(ev)
+    for r in sorted(lane_ranks):
+        events.append({"name": "process_name", "ph": "M", "pid": r,
+                       "tid": 0, "args": {"name": f"rank {r}"}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": r,
+                       "tid": 0, "args": {"sort_index": r}})
     if align:
         t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
         for ev in events:
@@ -122,8 +148,13 @@ def main(argv=None):
     p.add_argument("--timeline_path", default="timeline.json")
     p.add_argument("--align", action="store_true",
                    help="shift timestamps so the earliest event is t=0")
+    p.add_argument("--rank-lanes", action="store_true",
+                   help="gang view: one integer pid lane per rank "
+                        "('rank N', sorted by rank) instead of "
+                        "string-prefixed pids")
     args = p.parse_args(argv)
-    n = merge(args.profile_path, args.timeline_path, align=args.align)
+    n = merge(args.profile_path, args.timeline_path, align=args.align,
+              rank_lanes=args.rank_lanes)
     # lenient: merged inputs may include foreign profilers' event phases
     stats = validate(args.timeline_path, strict=False)
     print(f"wrote {n} events to {args.timeline_path} "
