@@ -93,6 +93,70 @@ def _emit_runtime_mfu(name, exe, offline_mfu):
               "error": repr(e)[:200]})
 
 
+def _fusion_counts(since=None):
+    """Cumulative {(pattern, verdict): n} of the fusion decision counter
+    (optionally as a delta against an earlier snapshot)."""
+    try:
+        from paddle_tpu import monitor
+        fam = monitor.REGISTRY.get("paddle_tpu_fusion_candidates_total")
+        now = {}
+        for labels, cell in (fam.series() if fam else ()):
+            k = (labels.get("pattern", "?"), labels.get("verdict", "?"))
+            now[k] = now.get(k, 0) + cell.get()
+        if since:
+            now = {k: v - since.get(k, 0) for k, v in now.items()
+                   if v - since.get(k, 0)}
+        return now
+    except Exception:
+        return {}
+
+
+def _emit_fusion_line(name, exe, scope, loss_name, feed, steps, dt_fused,
+                      counts):
+    """fusion:<workload> line: applied-rewrite counts (the graph-fusion
+    decision counter deltas for THIS workload) next to a fused-vs-unfused
+    steps/s comparison — the same program re-measured with
+    FLAGS_graph_fusion off on the same executor (the fusion config token
+    keys the dispatch plan, so the flip compiles the unfused block).
+    The fused config is the product default; autotune's measured
+    fallback is what keeps the ratio from regressing on real chips."""
+    import paddle_tpu as pt
+    try:
+        from paddle_tpu.flags import get_flags as _gf
+        prior = bool(_gf("FLAGS_graph_fusion")["FLAGS_graph_fusion"])
+        pt.set_flags({"FLAGS_graph_fusion": False})
+        try:
+            lv, = exe.run(feed=feed, fetch_list=[loss_name], scope=scope)
+            udts = []
+            for _rep in range(2):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    lv, = exe.run(feed=feed, fetch_list=[loss_name],
+                                  scope=scope, return_numpy=False)
+                np.asarray(lv)
+                udts.append((time.perf_counter() - t0) / steps)
+            dt_unfused = min(udts)
+        finally:
+            pt.set_flags({"FLAGS_graph_fusion": prior})
+        applied = {p: n for (p, v), n in counts.items() if v == "applied"}
+        emit({
+            "metric": f"fusion:{name}",
+            "value": int(sum(applied.values())),
+            "unit": "applied fusion rewrites",
+            "vs_baseline": 0,
+            "applied_by_pattern": applied,
+            "decisions": {f"{p}:{v}": int(n)
+                          for (p, v), n in sorted(counts.items())},
+            "steps_per_s_fused": round(1.0 / dt_fused, 3),
+            "steps_per_s_unfused": round(1.0 / dt_unfused, 3),
+            "fused_vs_unfused": round(dt_unfused / dt_fused, 3),
+        })
+    except Exception as e:      # the comparison must never kill a line
+        emit({"metric": f"fusion:{name}", "value": 0,
+              "unit": "applied fusion rewrites", "vs_baseline": 0,
+              "error": repr(e)[:200]})
+
+
 def bench_resnet50(dev, on_tpu, peak, frozen_bn=False):
     """Batch-stat line (the honest from-scratch training config) plus a
     separately-labeled frozen-BN finetune line (`use_global_stats=True`,
@@ -111,6 +175,7 @@ def bench_resnet50(dev, on_tpu, peak, frozen_bn=False):
     from paddle_tpu.models.resnet import build_resnet_train
 
     scope = Scope()
+    fusion_before = _fusion_counts()
     with scope_guard(scope), program_guard(Program(), Program()):
         if on_tpu:
             class_dim, image, batch, steps = 1000, (3, 224, 224), 256, 32
@@ -191,6 +256,9 @@ def bench_resnet50(dev, on_tpu, peak, frozen_bn=False):
         emit(rec)
         if not frozen_bn:
             _emit_runtime_mfu("resnet50", exe, mfu)
+            _emit_fusion_line("resnet50", exe, scope, loss.name, feed,
+                              steps, dt,
+                              _fusion_counts(since=fusion_before))
 
 
 def bench_bert(dev, on_tpu, peak):

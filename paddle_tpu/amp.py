@@ -55,6 +55,13 @@ _FLOATS = (jnp.float32, jnp.bfloat16, jnp.float16)
 _SLOT_RESTRICT = {"batch_norm": {"X"}, "layer_norm": {"X"},
                   "group_norm": {"X"}}
 
+# NOTE: the analysis.fusion targets (fused_dense_act,
+# fused_embedding_layer_norm) appear in NO list above on purpose: one
+# blanket cast over a fused op would differ from the per-op casts of the
+# chain it replaced (e.g. a 2-D bias add stays f32 unfused), so their
+# lowerings in ops/fused_ops.py replicate this module's per-stage policy
+# internally — keep the three policies in sync when editing the lists.
+
 
 def _cast_all(ins, target, slots=None):
     out = {}

@@ -23,12 +23,22 @@ time, before anything is lowered).
   model: 2·MAC matmul/conv formulas, grad-op inheritance, per-op-class
   roofline shares, cached on the program fingerprint.  Feeds the
   executor's live ``paddle_tpu_step_mfu`` gauge, ``bench.py``'s
-  ``mfu:<workload>`` runtime-vs-offline cross-check, and the
+  ``mfu:<workload>`` runtime-vs-offline cross-check, the
   ``FLAGS_cost_crosscheck`` parity gate against XLA's own
-  ``compiled.cost_analysis()``.
+  ``compiled.cost_analysis()``, and the fusion pass's candidate
+  ranking.
+- :mod:`paddle_tpu.analysis.fusion` — the cost-guided training-safe
+  graph fusion pass (``FLAGS_graph_fusion``): PDPattern-matched
+  candidates (conv+bn+relu, dense epilogues, embedding+layernorm),
+  static legality analysis with grad-chain rewrite-or-reject, roofline
+  ranking, and the ``FLAGS_fusion_autotune`` measured fallback; runs in
+  ``compiler.optimize``'s pass slot with the verifier before and after.
 """
 
 from .cost import CostPlan, device_peak_flops, plan_cost  # noqa: F401
+from .fusion import (  # noqa: F401
+    FusionDecision, FusionReport, analyze_program, fuse_program,
+)
 from .memory import MemoryPlan, plan_memory  # noqa: F401
 from .verifier import (  # noqa: F401
     CHECKS, Diagnostic, ProgramVerificationError, VerifyResult,
@@ -37,8 +47,9 @@ from .verifier import (  # noqa: F401
 )
 
 __all__ = [
-    "CHECKS", "CostPlan", "Diagnostic", "MemoryPlan",
-    "ProgramVerificationError", "VerifyResult", "clear_cache",
-    "collective_fingerprint", "device_peak_flops", "dynamic_int64_feeds",
+    "CHECKS", "CostPlan", "Diagnostic", "FusionDecision", "FusionReport",
+    "MemoryPlan", "ProgramVerificationError", "VerifyResult",
+    "analyze_program", "clear_cache", "collective_fingerprint",
+    "device_peak_flops", "dynamic_int64_feeds", "fuse_program",
     "plan_cost", "plan_memory", "verify_or_raise", "verify_program",
 ]

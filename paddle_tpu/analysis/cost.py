@@ -53,7 +53,7 @@ from .. import monitor as _monitor
 from ..framework.core import Block, Program
 
 __all__ = ["CostPlan", "plan_cost", "clear_cache", "device_peak_flops",
-           "xla_cost_totals"]
+           "xla_cost_breakdown", "xla_cost_totals"]
 
 _PLAN_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_cost_plans_total",
@@ -77,6 +77,10 @@ _CLASS_OF = {
     "adam": "optimizer", "momentum": "optimizer", "sgd": "optimizer",
     "adagrad": "optimizer", "lamb": "optimizer", "rmsprop": "optimizer",
     "flash_attention": "attention", "fused_attention": "attention",
+    # analysis.fusion rewrite targets keep their source chain's class so
+    # the roofline shares (and the live MFU numerator) survive fusion
+    "fused_conv1x1_bn": "conv", "fused_dense_act": "matmul",
+    "fused_embedding_layer_norm": "embedding",
 }
 
 #: per-element flop factors for the cheap (VPU) classes; everything not
@@ -88,6 +92,7 @@ _ELEM_FLOPS = {
     "gelu": 9.0, "tanh": 6.0, "sigmoid": 4.0, "erf": 6.0,
     "exp": 2.0, "log": 2.0, "sqrt": 2.0, "rsqrt": 2.0, "pow": 3.0,
     "dropout": 2.0, "adam": 10.0, "lamb": 14.0, "momentum": 4.0,
+    "fused_embedding_layer_norm": 8.0,
 }
 
 _ITEMSIZE = {"bfloat16": 2, "float16": 2, "bool": 1}
@@ -254,6 +259,40 @@ def _conv_flops(block, op, batch_size) -> Optional[int]:
     return 2 * _numel(out) * w[1] * w[2] * w[3]
 
 
+def _fused_conv1x1_flops(block, op, batch_size) -> Optional[int]:
+    """fused_conv1x1_bn: the 1x1 conv is 2·Cin MACs per output element
+    (the BN epilogue is VPU noise the conv formula dominates)."""
+    f = _slot(op, "Filter")
+    y = op.output("Y") or op.input("OG$Y")
+    if not f or not y:
+        return None
+    w = _shape(block, f[0], batch_size)
+    out = _shape(block, y[0], batch_size)
+    if not w or not out or len(w) < 2:
+        return None
+    return 2 * _numel(out) * w[1]
+
+
+def _fused_dense_flops(block, op, batch_size) -> Optional[int]:
+    """fused_dense_act: 2·M·K·N over the flattened x (mul semantics at
+    ``x_num_col_dims``; -1 = matmul over the trailing dim)."""
+    xs = _slot(op, "X")
+    ws = _slot(op, "W")
+    if not xs or not ws:
+        return None
+    x = _shape(block, xs[0], batch_size)
+    w = _shape(block, ws[0], batch_size)
+    if not x or not w:
+        return None
+    ncd = int(op.attrs.get("x_num_col_dims", 1))
+    if ncd < 0:
+        ncd = len(x) - 1
+    m = _numel(x[:ncd])
+    k = _numel(x[ncd:])
+    n = _numel(w[1:]) if len(w) > 1 else 1
+    return 2 * m * k * n
+
+
 def _op_cost(block: Block, op, batch_size: int) -> Tuple[int, int, str]:
     """(flops, bytes, op_class) of one op at the resolved batch."""
     typ = op.type
@@ -273,6 +312,10 @@ def _op_cost(block: Block, op, batch_size: int) -> Tuple[int, int, str]:
         flops = _matmul_flops(block, op, batch_size)
     elif fwd in ("conv2d", "depthwise_conv2d", "conv2d_transpose"):
         flops = _conv_flops(block, op, batch_size)
+    elif fwd == "fused_conv1x1_bn":
+        flops = _fused_conv1x1_flops(block, op, batch_size)
+    elif fwd == "fused_dense_act":
+        flops = _fused_dense_flops(block, op, batch_size)
     elif fwd in ("lookup_table", "lookup_table_v2", "gather", "gather_nd",
                  "scatter", "scatter_nd_add"):
         flops = 0
@@ -373,3 +416,38 @@ def xla_cost_totals(cost_analysis) -> Tuple[float, float]:
         return 0.0, 0.0
     return float(ca.get("flops", 0.0) or 0.0), \
         float(ca.get("bytes accessed", 0.0) or 0.0)
+
+
+def xla_cost_breakdown(cost_analysis) -> Dict[str, object]:
+    """The FULL utilization breakdown of a ``cost_analysis()`` result —
+    not just the totals: transcendentals (XLA bills RNG/gelu erf here,
+    a common totals-divergence cause) and the per-operand ``bytes
+    accessedN{}``/``utilizationN{}`` keys, parsed into nested dicts the
+    crosscheck attaches to its tracer record and divergence warning."""
+    ca = cost_analysis
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: Dict[str, object] = {
+        "flops": float(ca.get("flops", 0.0) or 0.0),
+        "transcendentals": float(ca.get("transcendentals", 0.0) or 0.0),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+    }
+    operand_bytes: Dict[str, float] = {}
+    operand_util: Dict[str, float] = {}
+    for k, v in ca.items():
+        try:
+            fv = float(v)
+        except (TypeError, ValueError):
+            continue
+        tag = k.replace("{}", "").strip()
+        if k.startswith("bytes accessed") and k != "bytes accessed":
+            operand_bytes[tag[len("bytes accessed"):] or "out"] = fv
+        elif k.startswith("utilization"):
+            operand_util[tag[len("utilization"):] or "out"] = fv
+    if operand_bytes:
+        out["operand_bytes"] = operand_bytes
+    if operand_util:
+        out["operand_utilization"] = operand_util
+    return out

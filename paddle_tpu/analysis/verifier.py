@@ -538,8 +538,12 @@ def _classify_int64_feeds(program: Program, fetch_names=()):
         else:
             def slot(s, _op=op):
                 return _op.input(s)
-        if typ in ("lookup_table", "lookup_table_v2") and \
+        if typ in ("lookup_table", "lookup_table_v2",
+                   "fused_embedding_layer_norm") and \
                 name in slot("Ids"):
+            # the fused embedding+LN op (analysis.fusion) gathers rows
+            # exactly like lookup_table: the table's row count bounds
+            # valid ids, so fusion must not demote a static feed
             w = slot("W")
             return "safe" if w and _dim_bounded(w[0], blk, axis=0) \
                 else "unsafe"

@@ -145,14 +145,19 @@ class CompiledProgram:
         self._is_data_parallel = False
         self._serial = next(_cp_serials)
 
-    def _optimized(self, fetch_names=()) -> Program:
+    def _optimized(self, fetch_names=(), feed_shapes=None) -> Program:
         """Apply the BuildStrategy's graph passes (ref BuildStrategy::Apply,
         details/build_strategy.cc:299 — there the pass list builds the whole
-        multi-device graph; here only the program-level canonicalizations
-        remain meaningful, XLA owns fusion/memory).  Keyed by program
-        version + fetch set: fetched intermediates must survive fusion, and
-        a mutated program must re-optimize."""
-        key = (self._program.fingerprint(), frozenset(fetch_names))
+        multi-device graph; here the program-level canonicalizations plus
+        the cost-guided fusion pass, XLA owns the rest).  Keyed by program
+        version + fetch set + fusion config + feed batch: fetched
+        intermediates must survive fusion, a mutated program must
+        re-optimize, and a fusion-flag flip (or a batch change, which
+        re-ranks/re-tunes candidates) must not reuse a stale rewrite."""
+        from .analysis import fusion as _fusion
+        batch = _fusion._batch_of(feed_shapes)
+        key = (self._program.fingerprint(), frozenset(fetch_names),
+               _fusion.config_token(), batch)
         cache = getattr(self, "_optimized_cache", None)
         if cache is None:
             cache = self._optimized_cache = {}
@@ -201,6 +206,19 @@ class CompiledProgram:
                             "dead_op_eliminate",
                             protected=frozenset(fetch_names)).apply(g)
                     changed |= bool(g.attrs.get("dead_op_eliminate_count"))
+                    if changed:
+                        with _timed("to_program"):
+                            prog = g.to_program()
+                        changed = False
+                    # cost-guided fusion BEFORE fuse_elewise_add_act,
+                    # which would otherwise consume the bias+act tails
+                    # the dense-epilogue pattern targets (program-level:
+                    # the pass verifies before/after and re-ranks by the
+                    # cost model at the real feed batch)
+                    with _timed("graph_fusion"):
+                        prog = _fusion.fuse_program(
+                            prog, fetch_names, feed_shapes=feed_shapes)
+                    g = ir.Graph(prog)
                     if self._build_strategy.fuse_elewise_add_act_ops:
                         with _timed("fuse_elewise_add_act"):
                             g = ir.get_pass(

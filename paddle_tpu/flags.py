@@ -165,6 +165,33 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_profile_sample_window_steps": 4,
     "FLAGS_profile_sample_dir": "",
     "FLAGS_profile_sample_max_windows": 8,
+    # cost-guided graph fusion (analysis.fusion): the master gate for
+    # the training-safe fusion pass in compiler.optimize's
+    # pass-before-lowering slot (conv+bn+relu, matmul+bias+act+dropout,
+    # embedding+layernorm -> fused Pallas-backed ops).  Default on:
+    # with autotune off the pass applies on static legality + roofline
+    # rank alone, and every fused lowering is an exact composition of
+    # the unfused ops.  Executor dispatch plans and compiled programs
+    # key on the fusion config, so flipping any of these invalidates
+    # stale plans.
+    "FLAGS_graph_fusion": True,
+    # measured fallback: micro-benchmark each legal candidate (fused op
+    # vs the XLA default chain, fingerprint+shape-keyed, persisted next
+    # to the XLA compile cache) and rewrite only when the fused kernel
+    # wins — makes a fused-program regression structurally impossible.
+    # Off by default: the first encounter of each (pattern, shape) pays
+    # two small jit compiles.
+    "FLAGS_fusion_autotune": False,
+    # roofline rank threshold: a candidate whose op class is below this
+    # share of the program's analytic flop AND byte budget
+    # (analysis.cost per-class shares) is not worth a rewrite
+    "FLAGS_fusion_rank_threshold": 0.02,
+    # sampling-profiler auto-trigger: when > 0, a capture window opens
+    # the moment the executor's windowed-median step time regresses by
+    # this fraction over the best median seen — the trace captures
+    # exactly the slow window instead of whatever the periodic cadence
+    # lands on.  Re-arms after the median recovers.  0 disables.
+    "FLAGS_profile_sample_regress_frac": 0.0,
     # analytic-cost cross-check (analysis.cost vs XLA cost_analysis):
     # when on, a fresh compile goes through the AOT path so XLA's own
     # flop count is available, and the analytic model diverging >3x
@@ -227,19 +254,23 @@ def _apply_side_effects(name: str, value):
     elif name in ("FLAGS_profile_sample_every_n_steps",
                   "FLAGS_profile_sample_window_steps",
                   "FLAGS_profile_sample_dir",
-                  "FLAGS_profile_sample_max_windows"):
+                  "FLAGS_profile_sample_max_windows",
+                  "FLAGS_profile_sample_regress_frac"):
         from . import profiler
         # the store write precedes side effects in set_flags, so this
         # re-read already sees the new value
         fl = get_flags(["FLAGS_profile_sample_every_n_steps",
                         "FLAGS_profile_sample_window_steps",
                         "FLAGS_profile_sample_dir",
-                        "FLAGS_profile_sample_max_windows"])
+                        "FLAGS_profile_sample_max_windows",
+                        "FLAGS_profile_sample_regress_frac"])
         profiler.SAMPLER.configure(
             int(fl["FLAGS_profile_sample_every_n_steps"]),
             int(fl["FLAGS_profile_sample_window_steps"]),
             str(fl["FLAGS_profile_sample_dir"]),
-            int(fl["FLAGS_profile_sample_max_windows"]))
+            int(fl["FLAGS_profile_sample_max_windows"]),
+            regress_frac=float(
+                fl["FLAGS_profile_sample_regress_frac"]))
     elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
         # the NATIVE ps client reads these via getenv (retry_times per
         # request, deadline at connect) — mirror flag changes into the

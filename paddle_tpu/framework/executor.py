@@ -95,6 +95,11 @@ _COST_XCHK_CTR = _monitor.REGISTRY.counter(
     "time: 'ok' within the 3x band, 'divergent' outside it, 'skipped' "
     "for programs without dominant MXU-class work, 'unavailable' when "
     "XLA reported no flops", ("verdict",))
+_COST_XCHK_CLASS_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_cost_crosscheck_divergent_total",
+    "divergent cost crosschecks attributed to the analytic op class "
+    "with the largest flop share — the class whose formula to audit "
+    "first", ("op_class",))
 #: analytic-vs-XLA agreement band: XLA folds elementwise work into
 #: fusions and counts transcendentals its own way, so exact equality is
 #: not expected — an order-of-magnitude drift is what the gate catches
@@ -241,16 +246,30 @@ _GLOBAL_STEPS = itertools.count(1)
 _device_peak_cache: List[float] = []
 
 
-def _maybe_sample_step(step_id: int) -> None:
+def _maybe_sample_step(step_id: int, step_ms=None) -> None:
     """Memoized trampoline to profiler.maybe_sample_step: the profiler
     module cannot be imported at executor module load (it resolves
     through the partially-initialized package during bootstrap), and a
     per-dispatch import statement would put import-lock machinery on
-    the hottest path — so the bound function is cached on first use."""
+    the hottest path — so the bound function is cached on first use.
+    ``step_ms`` (the windowed median dispatch interval) feeds the
+    FLAGS_profile_sample_regress_frac auto-trigger."""
     global _maybe_sample_step
     from ..profiler import maybe_sample_step
     _maybe_sample_step = maybe_sample_step
-    maybe_sample_step(step_id)
+    maybe_sample_step(step_id, step_ms)
+
+
+_fusion_mod = []
+
+
+def _fusion():
+    """Memoized analysis.fusion module (same bootstrap rationale as the
+    sampler trampoline — the hot path reads one config token per run)."""
+    if not _fusion_mod:
+        from ..analysis import fusion
+        _fusion_mod.append(fusion)
+    return _fusion_mod[0]
 
 
 def _device_peak() -> float:
@@ -261,13 +280,36 @@ def _device_peak() -> float:
     return _device_peak_cache[0]
 
 
+def _restamp_memory(program, fetch_names, batch):
+    """PR-7 follow-on: the verifier's HBM plan is a batch=1 lower bound
+    stamped before any dispatch plan exists; once the executor knows the
+    REAL feed shapes, re-plan at that batch and re-stamp
+    ``_attrs["verify"]["memory"]`` so tools/bench/OOM reports see the
+    actual step footprint (fingerprint-cached — a one-off per block)."""
+    va = program._attrs.get("verify")
+    if va is None or batch <= 1:
+        return
+    from ..analysis.memory import plan_memory
+    plan = plan_memory(program, fetch_names, batch_size=batch)
+    va["memory"] = {
+        "peak_bytes": plan.peak_bytes,
+        "resident_bytes": plan.resident_bytes,
+        "steady_bytes": plan.steady_bytes,
+        "peak_op": plan.peak_op,
+        "top_ops": [(p, t, b) for p, t, b, _ in plan.top_ops(5)],
+        "batch": batch,
+    }
+
+
 def _resolve_cost(cb, program, feeds):
     """Once per compiled block: the analytic flops-per-step of this
     program at the REAL feed batch (the verifier stamps a batch=1
     baseline; the plan cache makes the re-plan at the true batch a
     fingerprint-keyed one-off).  Also publishes the per-op-class flop
-    shares.  Returns (flops, peak_flops_per_s) or None — cost modeling
-    must never break dispatch."""
+    shares, stashes them on the block for the cost-crosscheck's
+    divergence attribution, and re-stamps the verify-time HBM plan at
+    the real batch.  Returns (flops, peak_flops_per_s, mxu_share) or
+    None — cost modeling must never break dispatch."""
     try:
         from ..analysis.cost import plan_cost
         batch = 1
@@ -276,7 +318,12 @@ def _resolve_cost(cb, program, feeds):
             if shape:
                 batch = int(shape[0])
                 break
+        try:
+            _restamp_memory(program, cb.fetch_names, batch)
+        except Exception:
+            pass
         plan = plan_cost(program, cb.fetch_names, batch_size=batch)
+        cb.cost_share = dict(plan.share())
         if not plan.flops:
             return None
         share = plan.share()
@@ -1127,8 +1174,13 @@ class Executor:
         collective = program._attrs.get("collective")
         coll_tok = (tuple(sorted(collective.items()))
                     if collective else None)
+        # fusion config in the key: a FLAGS_graph_fusion/_autotune/
+        # _rank_threshold flip changes what _optimized/fuse_program
+        # produce without touching the program fingerprint — stale plans
+        # would silently run the old rewrite
+        fus_tok = _fusion().config_token()
         fast_key = (program.fingerprint(), tuple(feed), fetch_names,
-                    scope_tok, check_nan, cp_tok, coll_tok)
+                    scope_tok, check_nan, cp_tok, coll_tok, fus_tok)
         plan = self._plans.get(fast_key)
         if plan is not None and plan.feed_sigs == tuple(
                 _feed_sig(feed[n]) for n in plan.feed_names):
@@ -1137,8 +1189,10 @@ class Executor:
                                   plan.program, return_numpy, seed, t0)
 
         # ---- slow path: full classification + (maybe) lowering -------------
+        feed_shapes = {n: _feed_sig(v)[0] for n, v in feed.items()}
         if compiled is not None:
-            program = compiled._optimized(fetch_names)
+            program = compiled._optimized(fetch_names,
+                                          feed_shapes=feed_shapes)
             mesh = compiled._mesh
             in_shardings = compiled._build_in_shardings
             collective = program._attrs.get("collective")
@@ -1149,6 +1203,16 @@ class Executor:
         if lsv is not None:
             from ..distributed import ps as _ps
             return _ps.run_pserver(lsv, scope)
+        if compiled is None:
+            # plain-Program dispatch gets the same fusion slot
+            # CompiledProgram._optimized runs (this is how bench.py's
+            # direct exe.run() loops reach the pass), at the REAL feed
+            # batch; fuse_program's result cache makes the repeat entry
+            # a dict probe
+            from ..compiler import _timed_pass
+            with _timed_pass({}, "graph_fusion"):
+                program = _fusion().fuse_program(
+                    program, fetch_names, feed_shapes=feed_shapes)
         feed_names = tuple(sorted(feed))
 
         block = program.global_block()
@@ -1160,7 +1224,8 @@ class Executor:
         # CompiledProgram keys by its own serial for the same reason.
         key = (program.fingerprint(), feed_names,
                tuple(_feed_sig(feed[n]) for n in feed_names),
-               fetch_names, scope_tok, cp_tok, check_nan, coll_tok)
+               fetch_names, scope_tok, cp_tok, check_nan, coll_tok,
+               fus_tok)
         with self._lock:
             cb = self._cache.get(key)
             if cb is None:
@@ -1315,9 +1380,11 @@ class Executor:
                     compiled = cb.jitted.lower(
                         feeds, ro_vals, rw_vals, seed_arr).compile()
                     cb._compiled_aot = compiled
-                    from ..analysis.cost import xla_cost_totals
-                    cb._xla_cost = xla_cost_totals(
-                        compiled.cost_analysis())
+                    from ..analysis.cost import (xla_cost_breakdown,
+                                                 xla_cost_totals)
+                    ca = compiled.cost_analysis()
+                    cb._xla_cost = xla_cost_totals(ca)
+                    cb._xla_breakdown = xla_cost_breakdown(ca)
                 except Exception:
                     cb._xla_cost = None
         step_id = next(_GLOBAL_STEPS)
@@ -1413,43 +1480,63 @@ class Executor:
                     verdict = ("ok" if 1.0 / _COST_XCHK_BAND <= ratio
                                <= _COST_XCHK_BAND else "divergent")
                 _COST_XCHK_CTR.inc(1, verdict=verdict)
+                # per-op-class attribution (not just totals): the XLA
+                # utilization/bytes-per-operand breakdown rides the
+                # tracer record, and a divergent verdict NAMES the
+                # analytic class with the largest flop share — the
+                # formula to audit first
+                breakdown = getattr(cb, "_xla_breakdown", None) or {}
+                share = getattr(cb, "cost_share", None) or {}
+                div_class = max(share, key=share.get) if share else \
+                    "unknown"
                 if _monitor.TRACER.enabled:
                     _monitor.TRACER.instant(
                         "cost.crosscheck", "compile",
                         {"analytic_flops": cost[0],
-                         "xla_flops": xla_flops, "verdict": verdict})
+                         "xla_flops": xla_flops, "verdict": verdict,
+                         "analytic_share": {k: round(v, 4) for k, v
+                                            in share.items()},
+                         "xla_breakdown": breakdown,
+                         **({"divergent_class": div_class}
+                            if verdict == "divergent" else {})})
                 if verdict == "divergent":
+                    _COST_XCHK_CLASS_CTR.inc(1, op_class=div_class)
                     import warnings
+                    util = breakdown.get("operand_utilization", {})
                     warnings.warn(
                         f"analytic cost model reports {cost[0]:.3g} "
                         f"flops/step but XLA cost_analysis() reports "
-                        f"{xla_flops:.3g} (>{_COST_XCHK_BAND}x apart) — "
-                        "the live MFU gauge and bench offline MFU may "
-                        "disagree; check analysis/cost.py coverage for "
-                        "this program's ops")
-        if cost is not None:
-            # median of the last few inter-dispatch intervals, not an
-            # EMA: the first interval after a compile carries warmup
-            # noise an EMA would average in for many steps, while the
-            # median discards it after two clean steps.  Tracked
-            # PER-EXECUTOR, not per compiled block: an executor
-            # alternating two blocks (train + eval) would otherwise
-            # measure each block's interval across the whole A->B->A
-            # cycle and report ~2x the real step time.  Lock-guarded:
-            # concurrent run() threads iterate the deque (sorted) while
-            # appending.
-            with self._lock:
-                last = self._last_dispatch_t
-                self._last_dispatch_t = tdisp
-                med = None
-                if last is not None and tdisp > last:
-                    self._step_win.append(tdisp - last)
-                    med = sorted(self._step_win)[
-                        len(self._step_win) // 2]
-            if med is not None:
-                stats.set_step_timing(med * 1e3,
-                                      cost[0] / med / cost[1])
-        _maybe_sample_step(step_id)
+                        f"{xla_flops:.3g} (>{_COST_XCHK_BAND}x apart); "
+                        f"largest analytic share: {div_class} "
+                        f"({share.get(div_class, 0.0):.0%}) — audit its "
+                        f"formula in analysis/cost.py first (XLA "
+                        f"transcendentals="
+                        f"{breakdown.get('transcendentals', 0):.3g}, "
+                        f"operand utilization={util})")
+        # median of the last few inter-dispatch intervals, not an
+        # EMA: the first interval after a compile carries warmup
+        # noise an EMA would average in for many steps, while the
+        # median discards it after two clean steps.  Tracked
+        # PER-EXECUTOR, not per compiled block: an executor
+        # alternating two blocks (train + eval) would otherwise
+        # measure each block's interval across the whole A->B->A
+        # cycle and report ~2x the real step time.  Lock-guarded:
+        # concurrent run() threads iterate the deque (sorted) while
+        # appending.  Computed cost-plan or not: the sampling
+        # profiler's regression auto-trigger keys off the same median.
+        with self._lock:
+            last = self._last_dispatch_t
+            self._last_dispatch_t = tdisp
+            med = None
+            if last is not None and tdisp > last:
+                self._step_win.append(tdisp - last)
+                med = sorted(self._step_win)[
+                    len(self._step_win) // 2]
+        if med is not None and cost is not None:
+            stats.set_step_timing(med * 1e3,
+                                  cost[0] / med / cost[1])
+        _maybe_sample_step(step_id,
+                           med * 1e3 if med is not None else None)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
         if self._step_hooks:

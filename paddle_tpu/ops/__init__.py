@@ -7,6 +7,7 @@ from . import (attention_ops, control_flow_ops, detection_ops,  # noqa
                quant_ops, reduce_ops, rnn_ops, sequence_ops,
                structured_ops, tensor_ops)
 from . import conv_bn_ops  # noqa
+from . import fused_ops  # noqa  (analysis.fusion rewrite targets)
 from . import moe_ops  # noqa
 from . import compat_ops  # noqa  (must come last: aliases existing ops)
 from ..framework.registry import registered_ops  # noqa

@@ -261,34 +261,58 @@ class SamplingProfiler:
     off the per-step critical path.  Capture errors never fail the step:
     they count in ``paddle_tpu_profile_windows_total{outcome="error"}``
     and disarm the window.
+
+    **Regression auto-trigger** (``FLAGS_profile_sample_regress_frac``):
+    the executor feeds its windowed-MEDIAN dispatch interval into
+    ``on_step``; when the median regresses by the configured fraction
+    over the best median seen, a capture window opens IMMEDIATELY —
+    the trace records exactly the slow window, not whatever the
+    periodic cadence happens to land on.  Hysteresis re-arms the
+    trigger only after the median recovers to within half the
+    threshold, so a sustained slowdown costs one window, not one per
+    step.
     """
+
+    #: medians observed before the regression baseline is trusted (the
+    #: first few include compile warmup bleeding into the window)
+    _REGRESS_WARMUP = 8
 
     def __init__(self):
         self._mu = threading.Lock()
         self.every_n = 0                 # fast-path guard (int compare)
+        self.regress_frac = 0.0          # fast-path guard (float compare)
         self.window_steps = 4            # guarded-by: _mu
         self.base_dir = ""               # guarded-by: _mu
         self.max_windows = 8             # guarded-by: _mu
         self._active = None              # guarded-by: _mu  (window dict)
         self._atexit_armed = False       # guarded-by: _mu
+        self._best_med = None            # guarded-by: _mu
+        self._med_obs = 0                # guarded-by: _mu
+        self._regress_armed = True       # guarded-by: _mu
 
     def configure(self, every_n: int, window_steps: int, base_dir: str,
-                  max_windows: int) -> None:
+                  max_windows: int, regress_frac: float = 0.0) -> None:
         with self._mu:
             self.window_steps = max(int(window_steps), 1)
             self.base_dir = str(base_dir) or "pt_profile_samples"
             self.max_windows = max(int(max_windows), 1)
-            if not self._atexit_armed and int(every_n) > 0:
+            if not self._atexit_armed and (int(every_n) > 0 or
+                                           float(regress_frac) > 0):
                 import atexit
                 atexit.register(self.close)
                 self._atexit_armed = True
+            self._best_med = None
+            self._med_obs = 0
+            self._regress_armed = True
             # set LAST: the armed fast path must only observe a fully
             # configured sampler
+            self.regress_frac = float(regress_frac)
             self.every_n = int(every_n)
 
     # -- step hook (called by the executor per dispatch) ---------------------
-    def on_step(self, step_id: int) -> None:
-        if self.every_n <= 0 and self._active is None:
+    def on_step(self, step_id: int, step_ms=None) -> None:
+        if self.every_n <= 0 and self.regress_frac <= 0 and \
+                self._active is None:
             return
         with self._mu:
             act = self._active
@@ -300,9 +324,33 @@ class SamplingProfiler:
                     self._finish_locked(act, step_id + 1)
                 else:
                     act["last_step"] = step_id
+                self._observe_median_locked(step_ms)
+                return
+            if self._observe_median_locked(step_ms):
+                self._open_locked(step_id, trigger="regress")
                 return
             if self.every_n > 0 and step_id % self.every_n == 0:
                 self._open_locked(step_id)
+
+    def _observe_median_locked(self, step_ms) -> bool:  # guarded-by-caller: _mu
+        """Track the best median and decide whether the regression
+        trigger should fire (True only when no window is active)."""
+        if self.regress_frac <= 0 or step_ms is None or step_ms <= 0:
+            return False
+        self._med_obs += 1
+        if self._best_med is None or step_ms < self._best_med:
+            self._best_med = float(step_ms)
+        if self._med_obs < self._REGRESS_WARMUP:
+            return False
+        threshold = self._best_med * (1.0 + self.regress_frac)
+        if step_ms >= threshold:
+            if self._regress_armed and self._active is None:
+                self._regress_armed = False
+                return True
+            return False
+        if step_ms <= self._best_med * (1.0 + self.regress_frac / 2.0):
+            self._regress_armed = True    # recovered: re-arm
+        return False
 
     def close(self) -> None:
         """Finish any in-flight window (process exit / reconfigure).
@@ -327,7 +375,8 @@ class SamplingProfiler:
             shutil.rmtree(act["dir"], ignore_errors=True)
 
     # -- window lifecycle (all hold _mu) -------------------------------------
-    def _open_locked(self, step_id: int):  # guarded-by-caller: _mu
+    def _open_locked(self, step_id: int,
+                     trigger: str = "periodic"):  # guarded-by-caller: _mu
         import jax
         wdir = os.path.join(self.base_dir, f"window_{step_id:08d}")
         try:
@@ -348,11 +397,13 @@ class SamplingProfiler:
         # open trace observes is step_id + 1 (the manifest's start)
         self._active = {"dir": wdir, "start_step": int(step_id) + 1,
                         "opened_at": int(step_id),
-                        "wall_start": time.time()}
+                        "wall_start": time.time(),
+                        "trigger": trigger}
         from . import monitor as _monitor
         if _monitor.TRACER.enabled:
             _monitor.TRACER.instant("profile.window_start", "profile",
-                                    {"step": int(step_id), "dir": wdir})
+                                    {"step": int(step_id), "dir": wdir,
+                                     "trigger": trigger})
 
     def _finish_locked(self, act, end_step: int):  # guarded-by-caller: _mu
         import jax
@@ -394,7 +445,8 @@ class SamplingProfiler:
                    if isinstance(w, dict)]
         windows.append({k: act[k] for k in
                         ("dir", "start_step", "end_step",
-                         "wall_start", "wall_end")})
+                         "wall_start", "wall_end", "trigger")
+                        if k in act})
         windows.sort(key=lambda w: w.get("start_step", 0))
         while len(windows) > self.max_windows:
             victim = windows.pop(0)
@@ -430,7 +482,9 @@ def last_window_error():
 SAMPLER = SamplingProfiler()
 
 
-def maybe_sample_step(step_id: int) -> None:
-    """Executor per-dispatch hook: one int compare when sampling is off
-    (the default), window open/close bookkeeping at boundaries when on."""
-    SAMPLER.on_step(step_id)
+def maybe_sample_step(step_id: int, step_ms=None) -> None:
+    """Executor per-dispatch hook: two scalar compares when sampling is
+    off (the default), window open/close bookkeeping at boundaries when
+    on.  ``step_ms`` is the executor's windowed-median dispatch interval
+    — the signal for the regression auto-trigger."""
+    SAMPLER.on_step(step_id, step_ms)

@@ -361,3 +361,101 @@ def test_failed_window_dir_removed(tmp_path, monkeypatch):
                     if d.startswith("window_")]
     finally:
         profiler.SAMPLER.configure(0, 2, sdir, 2)
+
+
+# ---------------------------------------------------------------------------
+# PR-9 satellites: regression auto-trigger, per-op-class crosscheck
+# breakdown, real-batch HBM restamp
+# ---------------------------------------------------------------------------
+
+def test_sampling_profiler_regress_trigger(tmp_path):
+    """A windowed-median regression past FLAGS_profile_sample_regress_frac
+    opens a capture window IMMEDIATELY (trigger='regress' in the
+    manifest), and hysteresis keeps a sustained slowdown at one window."""
+    sdir = str(tmp_path / "regress")
+    fluid.set_flags({"FLAGS_profile_sample_every_n_steps": 0,
+                     "FLAGS_profile_sample_window_steps": 2,
+                     "FLAGS_profile_sample_dir": sdir,
+                     "FLAGS_profile_sample_max_windows": 4,
+                     "FLAGS_profile_sample_regress_frac": 0.5})
+    try:
+        step = 0
+        for _ in range(10):                    # healthy baseline, 10 ms
+            step += 1
+            profiler.SAMPLER.on_step(step, 10.0)
+        assert profiler.SAMPLER._active is None
+        for _ in range(6):                     # sustained 2x regression
+            step += 1
+            profiler.SAMPLER.on_step(step, 20.0)
+        profiler.SAMPLER.close()
+        # (last_window_error is a sticky last-FAILURE note — an earlier
+        # test's injected capture failure legitimately lingers there)
+        with open(os.path.join(sdir, "manifest.json")) as f:
+            windows = json.load(f)["windows"]
+        regress = [w for w in windows if w.get("trigger") == "regress"]
+        assert len(regress) == 1               # hysteresis: one window
+        assert windows == regress              # no periodic windows
+        # the window opened AT the regressed step, not on a cadence
+        assert regress[0]["start_step"] >= 11
+    finally:
+        fluid.set_flags({"FLAGS_profile_sample_regress_frac": 0.0,
+                         "FLAGS_profile_sample_every_n_steps": 0})
+
+
+def test_sampling_profiler_regress_rearms_after_recovery(tmp_path):
+    sdir = str(tmp_path / "rearm")
+    fluid.set_flags({"FLAGS_profile_sample_every_n_steps": 0,
+                     "FLAGS_profile_sample_window_steps": 1,
+                     "FLAGS_profile_sample_dir": sdir,
+                     "FLAGS_profile_sample_max_windows": 4,
+                     "FLAGS_profile_sample_regress_frac": 0.5})
+    try:
+        step = 0
+        for ms in [10.0] * 10 + [20.0] * 3 + [10.0] * 3 + [20.0] * 3:
+            step += 1
+            profiler.SAMPLER.on_step(step, ms)
+        profiler.SAMPLER.close()
+        with open(os.path.join(sdir, "manifest.json")) as f:
+            windows = json.load(f)["windows"]
+        regress = [w for w in windows if w.get("trigger") == "regress"]
+        assert len(regress) == 2       # recovered in between: re-armed
+    finally:
+        fluid.set_flags({"FLAGS_profile_sample_regress_frac": 0.0,
+                         "FLAGS_profile_sample_every_n_steps": 0})
+
+
+def test_xla_cost_breakdown_parsing():
+    """The crosscheck consumes the per-operand utilization/bytes keys,
+    not just the totals (PR-8 follow-on)."""
+    from paddle_tpu.analysis.cost import xla_cost_breakdown
+    ca = {"flops": 100.0, "transcendentals": 7.0, "bytes accessed": 50.0,
+          "bytes accessed0{}": 20.0, "bytes accessedout{}": 10.0,
+          "utilization0{}": 2.0, "utilization1{}": 1.0}
+    out = xla_cost_breakdown([ca])          # list form tolerated
+    assert out["flops"] == 100.0
+    assert out["transcendentals"] == 7.0
+    assert out["bytes_accessed"] == 50.0
+    assert out["operand_bytes"] == {"0": 20.0, "out": 10.0}
+    assert out["operand_utilization"] == {"0": 2.0, "1": 1.0}
+    assert xla_cost_breakdown(None) == {}
+
+
+def test_memory_restamped_at_real_feed_batch():
+    """PR-7 follow-on: once a dispatch plan exists, the verify-time HBM
+    stamp is re-planned at the REAL feed batch (not the batch=1 lower
+    bound) on the optimized program."""
+    from paddle_tpu.compiler import CompiledProgram
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        loss = _mlp(in_dim=8, hidden=16, out=4)
+        exe = Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        cp = CompiledProgram(fluid.default_main_program())
+        feed = {"x": np.zeros((4, 8), np.float32)}
+        exe.run(cp, feed=feed, fetch_list=[loss.name], scope=scope)
+        optprog = cp._optimized((loss.name,), feed_shapes={"x": (4, 8)})
+        mem = optprog._attrs["verify"]["memory"]
+        assert mem["batch"] == 4
+        from paddle_tpu.analysis import plan_memory
+        base = plan_memory(optprog, (loss.name,), batch_size=1)
+        assert mem["peak_bytes"] > base.peak_bytes

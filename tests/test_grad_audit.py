@@ -207,6 +207,25 @@ def _configs(op):
              "use_global_stats": False},
             nodiff={"Mean", "Variance"}, loss_outputs=["Y"],
             eps=5e-2, rtol=1.5e-1, atol=5e-2),
+        # analysis.fusion rewrite target: exact composition of
+        # mul+bias+gelu+tagged dropout (mask is a pure function of the
+        # fixed executor seed + tag, so central differences see a
+        # constant mask)
+        "fused_dense_act": lambda: _Cfg(
+            {"X": [f(3, 4)], "W": [f(4, 5)], "Bias": [f(5)]},
+            {"x_num_col_dims": 1, "bias_axis": 1, "act": "gelu",
+             "approximate": False, "dropout_prob": 0.25, "seed": 7,
+             "is_test": False,
+             "dropout_implementation": "upscale_in_train",
+             "use_pallas": False}),
+        # analysis.fusion rewrite target: gather + add + layer_norm;
+        # like layer_norm, only Y's gradient is the op contract
+        "fused_embedding_layer_norm": lambda: _Cfg(
+            {"Ids": [i(3, 1, n=8)], "W": [f(8, 6)],
+             "Addends": [f(3, 6)], "Scale": [f(6)], "Bias": [f(6)]},
+            {"padding_idx": -1, "epsilon": 1e-5, "begin_norm_axis": 1,
+             "use_pallas": False},
+            loss_outputs=["Out"]),
         "fused_elemwise_activation": lambda: _Cfg(
             {"X": [f(2, 3)], "Y": [f(2, 3)]},
             {"functor_list": ["elementwise_add", "relu"], "axis": -1}),

@@ -1,21 +1,27 @@
 #!/usr/bin/env python
 """Whole-program static analysis over a SAVED program, no dispatch:
-the verifier's full diagnostic report (``--verify``) and/or the static
-HBM peak-memory plan (``--memory``) — the offline entry point to the
-same ``paddle_tpu.analysis`` suite ``compiler.optimize`` runs inline.
+the verifier's full diagnostic report (``--verify``), the static HBM
+peak-memory plan (``--memory``), and/or the graph-fusion candidate
+report (``--fusion``) — the offline entry point to the same
+``paddle_tpu.analysis`` suite ``compiler.optimize`` runs inline.
 
 Usage::
 
-    python tools/analyze.py [--verify] [--memory] [--json]
+    python tools/analyze.py [--verify] [--memory] [--fusion] [--json]
         [--fetch name[,name...]] [--batch N] PROGRAM
 
 ``PROGRAM`` is either a serialized program blob
 (``Program.serialize_to_string`` — e.g. ``main_program`` from
 ``tools/export_demo_program.py``) or an inference-model directory
 (``io.save_inference_model`` — its ``__model__``'s saved fetch list is
-the default ``--fetch``).  With neither ``--verify`` nor ``--memory``,
-both run.  ``--batch`` resolves symbolic (-1) dims in the memory plan
-(default 1: a per-example lower bound).
+the default ``--fetch``).  With none of ``--verify``/``--memory``/
+``--fusion``, verify+memory run.  ``--batch`` resolves symbolic (-1)
+dims in the memory plan and the fusion cost ranking (default 1: a
+per-example lower bound).
+
+``--fusion`` is REPORT-ONLY (no rewrite is applied): every candidate
+with its legality verdict, per-class roofline rank, and — when
+``FLAGS_fusion_autotune`` is on — the cached micro-benchmark decision.
 
 Exit status: 0 clean, 1 when ``--verify`` finds error-severity
 diagnostics, 2 on usage errors.
@@ -56,6 +62,7 @@ def main(argv=None) -> int:
         return 0 if argv else 2
     want_verify = "--verify" in argv
     want_memory = "--memory" in argv
+    want_fusion = "--fusion" in argv
     as_json = "--json" in argv
     fetch = ()
     batch = 1
@@ -78,7 +85,7 @@ def main(argv=None) -> int:
             batch = int(argv[i + 1])
             skip.add(i + 1)
         elif a.startswith("--"):
-            if a not in ("--verify", "--memory", "--json"):
+            if a not in ("--verify", "--memory", "--fusion", "--json"):
                 print(f"analyze: unknown flag {a!r}", file=sys.stderr)
                 return 2
         else:
@@ -87,7 +94,7 @@ def main(argv=None) -> int:
         print("analyze: exactly one PROGRAM path required",
               file=sys.stderr)
         return 2
-    if not want_verify and not want_memory:
+    if not want_verify and not want_memory and not want_fusion:
         want_verify = want_memory = True
 
     try:
@@ -98,7 +105,8 @@ def main(argv=None) -> int:
     fetch = fetch or saved_fetch
 
     from paddle_tpu import debugger
-    from paddle_tpu.analysis import plan_memory, verify_program
+    from paddle_tpu.analysis import (analyze_program, plan_memory,
+                                     verify_program)
 
     out = {"program": paths[0], "fetch": list(fetch)}
     rc = 0
@@ -139,6 +147,10 @@ def main(argv=None) -> int:
                  "transient_bytes": tr}
                 for p, t, b, tr in plan.top_ops(10)],
         }
+    fusion_report = None
+    if want_fusion:
+        fusion_report = analyze_program(program, fetch, batch_size=batch)
+        out["fusion"] = fusion_report.as_dict()
     if as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
         return rc
@@ -157,6 +169,19 @@ def main(argv=None) -> int:
     if want_memory and plan is not None:
         print("== memory ==")
         print(plan.report())
+    if fusion_report is not None:
+        r = out["fusion"]
+        print(f"== fusion: {r['applied']} applicable candidate(s) of "
+              f"{len(r['candidates'])} matched ==")
+        for c in r["candidates"]:
+            extra = f" rule={c['rule']}" if c.get("rule") else ""
+            tune = c.get("autotune")
+            if tune:
+                extra += (f" autotune: fused {tune['fused_ms']} ms vs "
+                          f"base {tune['base_ms']} ms"
+                          + (" (cached)" if tune.get("cached") else ""))
+            print(f"  [{c['verdict']:>13}] {c['pattern']:<22} "
+                  f"@ {c['anchor']} rank={c['rank']:.3f}{extra}")
     return rc
 
 

@@ -74,6 +74,9 @@ assert out["verify"]["errors"] == 0, out["verify"]
 print(f"memory plan OK: peak {mem[\"peak_bytes\"]} B at {mem[\"peak_op\"]}")'
 rm -rf "$an_tmp"
 
+echo "== fusion smoke (zero-fusion-when-disabled, verifier-clean-when-enabled, loss parity, autotune cache) =="
+JAX_PLATFORMS=cpu python tools/fusion_smoke.py
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 
