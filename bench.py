@@ -829,6 +829,154 @@ def bench_memory(dev, on_tpu, peak):
         gc.collect()
 
 
+def _serving_latencies(futs, timeout_s=600.0):
+    """Per-request latency ms in submit order: poll done() so each
+    completion is timestamped when it happens (a sequential result()
+    walk would bill early completions for their predecessors' waits)."""
+    pending = {i: t0 for i, (t0, _f) in enumerate(futs)}
+    lat = [0.0] * len(futs)
+    deadline = time.monotonic() + timeout_s
+    while pending:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"{len(pending)} serving futures pending")
+        done = [i for i in pending if futs[i][1].done()]
+        now = time.perf_counter()
+        for i in done:
+            lat[i] = (now - pending.pop(i)) * 1e3
+        if not done:
+            time.sleep(0.0005)
+    for _, f in futs:
+        f.result(0)            # surface any request failure
+    return lat
+
+
+def _pctl(sorted_vals, q):
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * (len(sorted_vals) - 1) + 0.5))]
+
+
+def bench_serving(dev, on_tpu, peak):
+    """serving:bert / serving:gpt_causal — the heavy-traffic half of the
+    north star: p50/p99 request latency and sustained QPS of the
+    continuous-batching multi-tenant server under a synthetic open-loop
+    client (Poisson arrivals at ~70% of the measured single-batch
+    capacity), plus mean batch occupancy and the compile-bucket count.
+    CPU smoke uses a toy config; TPU uses BERT-base dims."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+    from paddle_tpu.models import transformer as T
+
+    if on_tpu:
+        cfg = T.BertConfig(max_pos=512, dropout=0.0)
+        buckets, max_batch, n_requests = (128, 256, 512), 8, 48
+        dec_slots, dec_new, dec_requests, dec_page = 8, 32, 16, 64
+    else:
+        cfg = T.BertConfig(vocab_size=64, d_model=16, n_layer=2, n_head=2,
+                           d_inner=32, max_pos=64, dropout=0.0)
+        buckets, max_batch, n_requests = (8, 16), 4, 24
+        dec_slots, dec_new, dec_requests, dec_page = 2, 4, 6, 4
+
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        T.build_gpt_serving(cfg, buckets[0], attn_impl="base")
+        exe0 = pt.Executor()
+        exe0.run(pt.default_startup_program(), scope=scope, seed=11)
+
+    def factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            _, logits = T.build_gpt_serving(
+                cfg, seq, attn_impl="auto" if on_tpu else "base")
+        return prog, ["src_ids"], [logits.name]
+
+    srv = serving.InferenceServer(factory, scope, buckets=buckets,
+                                  max_batch=max_batch, batch_wait_ms=2.0)
+    srv.warmup()
+    srv.start()
+    rng = np.random.RandomState(0)
+    # calibrate: one full batch through the mid bucket bounds capacity
+    mid = buckets[len(buckets) // 2]
+    tcal0 = time.perf_counter()
+    calib = [srv.submit("calib", {"src_ids": rng.randint(
+        1, cfg.vocab_size, (mid,)).astype(np.int64)})
+        for _ in range(max_batch)]
+    for f in calib:
+        f.result(timeout=600)
+    step_s = max(1e-4, time.perf_counter() - tcal0)
+    rate = 0.7 * max_batch / step_s          # requests/s, open loop
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    futs = []
+    t_open0 = time.perf_counter()
+    for i in range(n_requests):
+        n = int(rng.randint(buckets[0] // 2, buckets[-1] + 1))
+        ids = rng.randint(1, cfg.vocab_size, (n,)).astype(np.int64)
+        t0 = time.perf_counter()
+        futs.append((t0, srv.submit("bench_a" if i % 2 else "bench_b",
+                                    {"src_ids": ids})))
+        time.sleep(float(gaps[i]))
+    lat = sorted(_serving_latencies(futs))
+    wall = time.perf_counter() - t_open0
+    from paddle_tpu import monitor
+    tot = monitor.counter_totals()
+    occ_n = tot.get("paddle_tpu_serving_batch_occupancy_count", 0)
+    occ = (tot.get("paddle_tpu_serving_batch_occupancy_sum", 0.0)
+           / occ_n) if occ_n else 0.0
+    stats = srv.compile_stats()
+    emit({
+        "metric": "serving:bert",
+        "value": round(n_requests / wall, 2),
+        "unit": "req/s sustained",
+        "vs_baseline": 0,
+        "p50_ms": round(_pctl(lat, 0.50), 2),
+        "p99_ms": round(_pctl(lat, 0.99), 2),
+        "open_loop_rate": round(rate, 2),
+        "occupancy_mean": round(occ, 2),
+        "buckets": list(buckets),
+        "compiles": stats["traces"],
+        "max_batch": max_batch,
+        "device": str(dev),
+        "d_model": cfg.d_model, "layers": cfg.n_layer,
+    })
+    srv.drain(120)
+    srv.stop()
+
+    # -- decode serving: paged-KV continuous batching ------------------
+    eng = serving.DecodeEngine(cfg, scope, max_slots=dec_slots,
+                               page_len=dec_page,
+                               max_seq=min(cfg.max_pos, 8 * dec_page))
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    dfuts = []
+    t0_all = time.perf_counter()
+    for i in range(dec_requests):
+        p = rng.randint(1, cfg.vocab_size,
+                        (int(rng.randint(4, 2 * dec_page)),))
+        t0 = time.perf_counter()
+        dfuts.append((t0, dsrv.submit(
+            "bench_a" if i % 2 else "bench_b", p,
+            max_new_tokens=dec_new)))
+    dlat = sorted(_serving_latencies(dfuts))
+    dwall = time.perf_counter() - t0_all
+    emit({
+        "metric": "serving:gpt_causal",
+        "value": round(dec_requests / dwall, 2),
+        "unit": "req/s sustained",
+        "vs_baseline": 0,
+        "p50_ms": round(_pctl(dlat, 0.50), 2),
+        "p99_ms": round(_pctl(dlat, 0.99), 2),
+        "tokens_per_s": round(dec_requests * dec_new / dwall, 1),
+        "new_tokens_per_req": dec_new,
+        "kv_slots": dec_slots, "kv_page_len": dec_page,
+        "decode_traces": eng.trace_count,
+        "device": str(dev),
+    })
+    dsrv.drain(120)
+    dsrv.stop()
+
+
 def _setup_compile_cache():
     """Persistent XLA compile cache (ROADMAP open item): first-compile of
     a big train step is 20-40 s; a workspace-local disk cache removes it
@@ -1007,6 +1155,8 @@ def main(argv=None):
         ("transformer_wmt", lambda: bench_transformer_wmt(dev, on_tpu, peak)),
         ("deepfm_ps", bench_deepfm_ps),
         ("gpt_causal", lambda: bench_gpt_causal(dev, on_tpu, peak)),
+        # serving plane: p50/p99 + sustained QPS next to the MFU lines
+        ("serving", lambda: bench_serving(dev, on_tpu, peak)),
         ("bert_masked", lambda: bench_bert_masked(dev, on_tpu, peak)),
         # flagship metric printed last among the verbose lines
         ("bert", lambda: bench_bert(dev, on_tpu, peak)),

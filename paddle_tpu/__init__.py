@@ -34,6 +34,7 @@ from . import native  # noqa
 from . import monitor  # noqa  (metrics registry + step tracer)
 from . import resilience  # noqa  (fault injection, retries, preemption)
 from . import analysis  # noqa  (program verifier: static checks at optimize time)
+from . import serving  # noqa  (multi-tenant continuous-batching server)
 from . import profiler  # noqa
 from . import data  # noqa
 from .data import DataFeeder, DataLoader, PyReader  # noqa

@@ -198,6 +198,40 @@ _DEFAULTS: Dict[str, Any] = {
     # warns + counts in paddle_tpu_cost_crosscheck_total{verdict}.  Off
     # by default: the AOT lower() pays a second trace of the block.
     "FLAGS_cost_crosscheck": False,
+    # -- serving plane (paddle_tpu.serving) --------------------------------
+    # bucketized shape cache: the sequence-length compile buckets incoming
+    # requests are padded up to.  "16,32,64" = explicit list;
+    # "pow2:LO:HI" = powers of two from LO to HI inclusive; "" lets the
+    # server derive pow2 buckets from its max request length.  Compile
+    # cost is bounded by the bucket count — arbitrary request shapes
+    # never trigger a fresh XLA compile (TVM-style AOT shape buckets).
+    "FLAGS_serving_shape_buckets": "",
+    # continuous-batching width: requests per dispatched batch (each
+    # bucket's batch is padded to exactly this many rows, so one bucket =
+    # one compiled executable).  Per-bucket width is lowered automatically
+    # when the static HBM plan at this width exceeds
+    # FLAGS_memory_budget_mb (admission control).
+    "FLAGS_serving_max_batch": 8,
+    # how long the scheduler waits for more same-bucket arrivals before
+    # dispatching a partial batch (the continuous-batching coalescing
+    # window; 0 = dispatch immediately)
+    "FLAGS_serving_batch_wait_ms": 2.0,
+    # per-tenant admission quota: max requests a tenant may have queued +
+    # in flight; excess submits are rejected (counted per tenant).
+    # 0 = unlimited.
+    "FLAGS_serving_tenant_quota": 0,
+    # transient-fault absorption: how many times the scheduler re-runs a
+    # batch whose dispatch raised a transient error (injected faults,
+    # infra flakes tagged via resilience.mark_transient) before failing
+    # the batch's requests
+    "FLAGS_serving_max_retries": 1,
+    # paged KV cache (gpt_causal decode serving): fixed-size page length
+    # in tokens, and the page-pool size (0 = derive from the decode
+    # engine's slot count and max sequence length).  Pages are donated to
+    # each decode step so updates alias in place; per-request page lists
+    # are freed on completion and reused with no recompile.
+    "FLAGS_serving_kv_page_len": 16,
+    "FLAGS_serving_kv_pages": 0,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without

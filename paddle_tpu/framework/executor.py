@@ -1107,6 +1107,10 @@ class Executor:
         from ..flags import get_flags
         out = self._stats.snapshot()
         out["steps_in_flight"] = len(self._inflight)
+        # distinct lowered executables this executor holds — the serving
+        # smoke's "compile count == shape buckets" gate reads this
+        with self._lock:
+            out["compiled_blocks"] = len(self._cache)
         out["max_in_flight"] = int(get_flags(
             "FLAGS_executor_max_inflight_steps")
             ["FLAGS_executor_max_inflight_steps"])

@@ -11,5 +11,5 @@ standing in for the reference's saved TensorRT engines.
 
 from .api import NativePaddlePredictor  # noqa
 from .api import (AnalysisConfig, AnalysisPredictor, PaddlePredictor,  # noqa
-                  PaddleTensor, ZeroCopyTensor, create_paddle_predictor,
-                  export_stablehlo)
+                  PaddleTensor, ZeroCopyTensor, clear_engine_cache,
+                  create_paddle_predictor, export_stablehlo)

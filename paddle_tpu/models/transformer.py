@@ -292,6 +292,23 @@ def build_gpt_pretrain(cfg: BertConfig, seq_len, is_test=False,
     return (src_ids, lm_label), logits, avg_loss
 
 
+def build_gpt_serving(cfg: BertConfig, seq_len, attn_impl="auto"):
+    """Inference-only causal LM: ids → next-token logits, no label feed
+    and no loss — the program a serving bucket factory materializes per
+    sequence-length bucket (``paddle_tpu.serving.InferenceServer``).
+    Parameter names match :func:`build_gpt_pretrain` exactly (shared
+    ``lm_out`` head), so a trained scope serves unchanged."""
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    enc = encoder(src_ids, None, cfg.vocab_size, cfg.max_pos, cfg.n_layer,
+                  cfg.d_model, cfg.d_inner, cfg.n_head, 0.0,
+                  is_test=True, attn_impl=attn_impl, arange_pos=True,
+                  causal=True)
+    logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_out.w"),
+                       bias_attr=ParamAttr(name="lm_out.b"))
+    return (src_ids,), logits
+
+
 def annotate_tensor_parallel(program=None):
     """Megatron-style TP layout via dist_spec (SURVEY §2.5: TP is a
     capability the reference LACKS — first-class here)."""

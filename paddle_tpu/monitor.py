@@ -45,7 +45,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "StepTracer", "TRACER", "span", "export", "telemetry_snapshot",
     "counter_totals", "metrics_digest", "capped_digest",
-    "DIGEST_MAX_BYTES",
+    "DIGEST_MAX_BYTES", "retire_tenant_series",
 ]
 
 # ---------------------------------------------------------------------------
@@ -527,6 +527,60 @@ def capped_digest(digest: Dict[str, Any],
         else:
             d.pop(next(k for k in reversed(_DIGEST_PRIORITY) if k in d))
     return d
+
+
+# -- serving tenant plane (paddle_tpu.serving): per-tenant label series
+# of the request server.  Declared here (like the gang families above)
+# because the server, the scheduler thread, and the retirement helper
+# below all touch them, and `retire_tenant_series` must see the exact
+# family objects to fold.  Tenant churn retires through
+# `retire_tenant_series`, so a revolving tenant population cannot grow
+# the registry unbounded while `counter_totals()` stays exact.
+
+SERVING_REQ_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_requests_total",
+    "requests ADMITTED into the serving queue, per tenant", ("tenant",))
+SERVING_DONE_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_completed_total",
+    "requests completed (future resolved with a result), per tenant",
+    ("tenant",))
+SERVING_FAIL_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_failed_total",
+    "requests failed (future resolved with an error), per tenant",
+    ("tenant",))
+SERVING_REJECT_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_rejected_total",
+    "requests refused at admission, per tenant and reason "
+    "(quota / draining / too_long)", ("tenant", "reason"))
+SERVING_QUEUE_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_serving_queue_depth",
+    "requests currently queued + in flight, per tenant", ("tenant",))
+SERVING_LAT_HIST = REGISTRY.histogram(
+    "paddle_tpu_serving_latency_ms",
+    "end-to-end request latency (submit -> future resolved), ms, per "
+    "tenant", ("tenant",),
+    buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+             1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 120000.0))
+
+
+def retire_tenant_series(tenant) -> None:
+    """Registry hygiene for tenant eviction (PR-2 retirement semantics):
+    the tenant's counter/histogram series fold into ``tenant="retired"``
+    (process totals stay exact — ``counter_totals()`` sums the retired
+    aggregate) and its queue-depth gauge is dropped (a departed tenant
+    has no queue)."""
+    src = {"tenant": str(tenant)}
+    dst = {"tenant": "retired"}
+    SERVING_REQ_CTR.fold(src, dst)
+    SERVING_DONE_CTR.fold(src, dst)
+    SERVING_FAIL_CTR.fold(src, dst)
+    SERVING_LAT_HIST.fold(src, dst)
+    for labels, _cell in SERVING_REJECT_CTR.series():
+        if labels.get("tenant") == str(tenant):
+            SERVING_REJECT_CTR.fold(
+                labels, {"tenant": "retired",
+                         "reason": labels.get("reason", "")})
+    SERVING_QUEUE_GAUGE.fold(src, None)
 
 
 def retire_gang_rank_series(rank) -> None:

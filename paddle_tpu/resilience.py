@@ -182,7 +182,7 @@ KNOWN_SITES = (
     "ps.put", "ps.get", "ps.push_dense", "ps.push_sparse", "ps.get_rows",
     "ps.put_typed", "ps.get_typed", "ps.push_typed",
     "dataloader.produce", "compile", "executor.dispatch",
-    "fetch.materialize", "checkpoint.write",
+    "fetch.materialize", "checkpoint.write", "serving.decode_step",
 )
 
 _ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")

@@ -1,0 +1,30 @@
+"""Production inference serving (ROADMAP: the "heavy traffic" half of the
+north star).
+
+The reference stack ships a standalone inference engine
+(``inference/api/analysis_predictor.h``) but no server; this package turns
+the training runtime's substrate — persistent XLA compile cache, async
+executor in-flight throttle, per-series telemetry with retirement, static
+HBM planning, fault-injection absorption, preemption drain — into a
+latency-governed multi-tenant request path:
+
+- :mod:`bucketing` — TVM-style compile buckets: arbitrary request shapes
+  pad onto a small fixed set, one XLA executable per bucket, persisted
+  across restarts.
+- :mod:`scheduler` — continuous batching: coalesce queued requests into
+  the widest same-bucket batch, dispatch through the executor's lazy-fetch
+  path, absorb transient dispatch faults.
+- :mod:`kv_cache` — donated paged KV cache + the single compiled
+  ``gpt_causal`` decode step; requests join/leave the slot batch between
+  iterations with zero recompiles.
+- :mod:`server` — the tenant plane (quotas, per-tenant telemetry with
+  retirement) and SIGTERM graceful drain.
+"""
+
+from .bucketing import BucketPlan, bucket_for, pad_to_bucket, parse_buckets  # noqa
+from .kv_cache import (DecodeEngine, GPTDecodeModel, PagedKVCache,  # noqa
+                       params_from_scope)
+from .scheduler import (ContinuousBatcher, DecodeScheduler, Request,  # noqa
+                        ServingFuture)
+from .server import (AdmissionError, DecodeServer, InferenceServer,  # noqa
+                     TenantPlane)

@@ -1,0 +1,538 @@
+"""Continuous-batching scheduler over the async executor.
+
+Two execution loops, one admission contract:
+
+- :class:`ContinuousBatcher` (stateless request/response models): an
+  admission queue drained by a scheduler thread that coalesces queued
+  requests into the widest same-bucket batch available (waiting at most
+  ``FLAGS_serving_batch_wait_ms`` for stragglers), pads the batch to the
+  bucket's fixed (width, seq) shape, and dispatches through
+  ``Executor.run(..., return_numpy=False)`` — the PR-1 lazy-fetch path, so
+  host batch assembly of request *i+1* overlaps device execution of *i*
+  and ``FLAGS_executor_max_inflight_steps`` bounds run-ahead.  A separate
+  completion thread materializes fetch handles, slices each request's rows
+  back out (padding trimmed), and resolves futures.
+
+- :class:`DecodeScheduler` (``gpt_causal`` token generation): drives the
+  :class:`~paddle_tpu.serving.kv_cache.DecodeEngine` — each iteration runs
+  ONE compiled step over the fixed slot batch; requests join a free slot
+  (prefill consumes prompt tokens one per iteration through the same
+  step), leave on EOS/max-tokens (pages freed), and the batch composition
+  changes every iteration with zero recompiles.
+
+Dispatch faults that are transient (``FLAGS_fault_inject`` fires,
+infra errors tagged via ``resilience.mark_transient``) are ABSORBED: the
+batch re-dispatches up to ``FLAGS_serving_max_retries`` times before the
+batch's requests fail — counted in
+``paddle_tpu_serving_faults_absorbed_total``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import monitor as _monitor
+from .bucketing import PAD_TOKENS_CTR
+
+OCCUPANCY_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_serving_batch_occupancy",
+    "real requests per dispatched batch/decode iteration (mean > 1 == "
+    "continuous batching is actually coalescing)",
+    buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 64.0))
+BATCHES_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_serving_batches_total",
+    "dispatched serving batches / decode iterations, by bucket "
+    "(bucket='decode' for the KV-cache loop)", ("bucket",))
+FAULTS_ABSORBED_CTR = _monitor.REGISTRY.counter(
+    "paddle_tpu_serving_faults_absorbed_total",
+    "transient dispatch faults absorbed by a batch re-dispatch "
+    "(requests completed anyway)")
+
+
+class ServingFuture:
+    """Resolution handle for one request (threading.Event based)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serving request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class Request:
+    """One admitted request: per-example feeds (no batch dim) + future."""
+
+    __slots__ = ("tenant", "feeds", "seq_len", "bucket", "future",
+                 "t_submit", "prompt", "max_new_tokens", "eos_id",
+                 "admit_gen")
+
+    def __init__(self, tenant: str, feeds: Optional[Dict[str, Any]] = None,
+                 seq_len: int = 0, bucket: int = 0,
+                 prompt=None, max_new_tokens: int = 0,
+                 eos_id: Optional[int] = None):
+        self.tenant = tenant
+        self.feeds = feeds
+        self.seq_len = seq_len
+        self.bucket = bucket
+        self.future = ServingFuture()
+        self.t_submit = time.perf_counter()
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.admit_gen = 0   # tenant incarnation at admission (server)
+
+
+class ContinuousBatcher:
+    """Bucket-coalescing scheduler + completion pipeline (batch mode)."""
+
+    def __init__(self, executor, scope, bucket_plan, on_complete,
+                 on_fail, max_retries: int = 1, batch_wait_ms: float = 0.0):
+        self._exe = executor
+        self._scope = scope
+        self._plan = bucket_plan
+        self._on_complete = on_complete      # (request, result, latency_ms)
+        self._on_fail = on_fail              # (request, exception)
+        self._max_retries = int(max_retries)
+        self._wait_s = max(0.0, float(batch_wait_ms)) / 1e3
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()  # guarded-by: _cv
+        self._pending = 0          # admitted, not yet resolved  # guarded-by: _cv
+        self._stop = False         # guarded-by: _cv
+        self._done_cv = threading.Condition()
+        self._done_q: collections.deque = \
+            collections.deque()    # guarded-by: _done_cv
+        self._done_stop = False    # guarded-by: _done_cv
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        for name, fn in (("serving-scheduler", self._schedule_loop),
+                         ("serving-completion", self._complete_loop)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        """Stop accepting work; both threads exit after finishing what is
+        already queued/in flight (the scheduler drains the queue, then
+        its exit releases the completion thread — never the reverse, so
+        a dispatched batch's futures always resolve)."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def enqueue(self, req: Request) -> bool:
+        """False when the scheduler has been stopped — nothing would ever
+        pop the queue, so the caller must fail the request instead of
+        stranding its future (enqueue racing stop())."""
+        with self._cv:
+            if self._stop:
+                return False
+            self._queue.append(req)
+            self._pending += 1
+            self._cv.notify()
+        return True
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until every admitted request has resolved (completed or
+        failed) — the SIGTERM graceful-drain barrier.  False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._pending > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    # -- scheduler thread ----------------------------------------------------
+    def _take_batch(self) -> Optional[List[Request]]:
+        """Pop the widest same-bucket batch available, coalescing-wait up
+        to the window for stragglers; None on stop with an empty queue."""
+        with self._cv:
+            while not self._queue and not self._stop:
+                self._cv.wait(0.1)
+            if not self._queue:
+                return None
+            bucket = self._queue[0].bucket
+        # resolve the bucket plan OUTSIDE the queue lock: a cold bucket
+        # builds a program + HBM plan here, and submitters must not
+        # block behind it.  Only this scheduler thread pops, so the
+        # peeked head cannot be stolen meanwhile.  A factory/build error
+        # fails that bucket's queued requests — not this thread (a dead
+        # scheduler would strand every later future forever).
+        try:
+            width = self._plan.plan(bucket)[3]
+        except Exception as e:
+            with self._cv:
+                bad = self._pop_bucket_locked(bucket, len(self._queue))
+            self._fail_batch(bad, e)
+            return []
+        with self._cv:
+            deadline = time.monotonic() + self._wait_s
+            batch = self._pop_bucket_locked(bucket, width)
+            while len(batch) < width and not self._stop:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+                batch.extend(
+                    self._pop_bucket_locked(bucket, width - len(batch)))
+            return batch
+
+    def _pop_bucket_locked(self, bucket: int, n: int) -> List[Request]:
+        # guarded-by-caller: _cv
+        out: List[Request] = []
+        if n <= 0:
+            return out
+        keep: collections.deque = collections.deque()
+        while self._queue:
+            r = self._queue.popleft()
+            if r.bucket == bucket and len(out) < n:
+                out.append(r)
+            else:
+                keep.append(r)
+        self._queue.extend(keep)
+        return out
+
+    def _schedule_loop(self) -> None:
+        try:
+            self._schedule_loop_inner()
+        finally:
+            # the completion thread exits only AFTER this thread: a
+            # stop() racing an in-flight batch must let the completion
+            # side drain everything the scheduler ever appended, or the
+            # batch's futures would strand un-resolved
+            with self._done_cv:
+                self._done_stop = True
+                self._done_cv.notify_all()
+
+    def _schedule_loop_inner(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue             # bucket-plan failure already handled
+            bucket = batch[0].bucket
+            try:
+                compiled, feed_names, fetch_names, width = \
+                    self._plan.plan(bucket)
+                feed = self._assemble(batch, bucket, feed_names, width,
+                                      compiled.program)
+            except Exception as e:
+                # a malformed request (missing feed key, oversize or
+                # ragged array) must fail ITS batch, never kill this
+                # thread — a dead scheduler would strand every later
+                # request's future forever
+                self._fail_batch(batch, e)
+                continue
+            PAD_TOKENS_CTR.inc(width - len(batch))
+            handles = self._dispatch(compiled, feed, fetch_names, batch)
+            BATCHES_CTR.inc(1, bucket=str(bucket))
+            OCCUPANCY_HIST.observe(float(len(batch)))
+            if handles is None:
+                continue                     # batch failed; futures done
+            with self._done_cv:
+                self._done_q.append((batch, handles, bucket))
+                self._done_cv.notify()
+
+    @staticmethod
+    def _assemble(batch, bucket, feed_names, width, program):
+        """Padded fixed-shape batch feed from the requests' per-example
+        arrays (raises on malformed requests — caller fails the batch).
+        The BUCKET PROGRAM's declared var shapes say which feeds carry
+        the sequence axis: only feeds declared at the bucket length are
+        padded; fixed-length feeds (a static feature vector) stack as-is
+        and a mismatch fails the batch loudly instead of smuggling a
+        wrong shape into a fresh compile."""
+        from .bucketing import pad_to_bucket
+        block = program.global_block()
+        feed = {}
+        for name in feed_names:
+            declared = tuple(block.var(name).shape or ()) \
+                if block.has_var(name) else ()
+            is_seq = len(declared) > 1 and declared[1] == bucket
+            rows = [pad_to_bucket(r.feeds[name], bucket) if is_seq
+                    else np.asarray(r.feeds[name]) for r in batch]
+            a = np.stack(rows)
+            if len(batch) < width:           # fixed-shape dummy rows
+                a = np.pad(a, [(0, width - len(batch))] +
+                           [(0, 0)] * (a.ndim - 1))
+            feed[name] = a
+        return feed
+
+    def _dispatch(self, compiled, feed, fetch_names, batch):
+        """Run the batch; transient faults re-dispatch up to the retry
+        budget (injected-fault absorption), anything else — or an
+        exhausted budget — fails the batch's futures."""
+        from .. import resilience as _resil
+        attempt = 0
+        while True:
+            try:
+                return self._exe.run(
+                    compiled, feed=feed, fetch_list=list(fetch_names),
+                    scope=self._scope, return_numpy=False)
+            except Exception as e:
+                if _resil.is_transient(e) and attempt < self._max_retries:
+                    attempt += 1
+                    FAULTS_ABSORBED_CTR.inc()
+                    if _monitor.TRACER.enabled:
+                        _monitor.TRACER.instant(
+                            "serving.fault_absorbed", "serving",
+                            {"attempt": attempt, "error": repr(e)[:120]})
+                    continue
+                self._fail_batch(batch, e)
+                return None
+
+    def _fail_batch(self, batch, err) -> None:
+        for r in batch:
+            self._on_fail(r, err)
+        with self._cv:
+            self._pending -= len(batch)
+            self._cv.notify_all()
+
+    # -- completion thread ---------------------------------------------------
+    def _complete_loop(self) -> None:
+        while True:
+            with self._done_cv:
+                while not self._done_q:
+                    if self._done_stop:
+                        return
+                    self._done_cv.wait(0.1)
+                batch, handles, bucket = self._done_q.popleft()
+            try:
+                # materialize AND slice before resolving anything: a
+                # failure here (async device error, unexpected fetch
+                # rank) fails the whole batch's futures instead of
+                # killing this thread with some futures half-resolved
+                outs = [np.asarray(h) for h in handles]
+                results = []
+                for i, r in enumerate(batch):
+                    result = []
+                    for a in outs:
+                        row = a[i]
+                        if (row.ndim >= 1 and row.shape[0] == bucket
+                                and r.seq_len != bucket):
+                            row = row[:r.seq_len]  # trim bucket padding
+                        result.append(row)
+                    results.append(result)
+            except Exception as e:
+                self._fail_batch(batch, e)
+                continue
+            now = time.perf_counter()
+            for r, result in zip(batch, results):
+                self._on_complete(r, result, (now - r.t_submit) * 1e3)
+            with self._cv:
+                self._pending -= len(batch)
+                self._cv.notify_all()
+
+
+class _SlotState:
+    __slots__ = ("req", "tokens", "pos", "generated")
+
+    def __init__(self, req: Request):
+        self.req = req
+        self.tokens: List[int] = [int(t) for t in np.asarray(
+            req.prompt).ravel()]
+        self.pos = 0
+        self.generated: List[int] = []
+
+
+class DecodeScheduler:
+    """Continuous-batching loop over the paged-KV decode engine.
+
+    One thread, one compiled step: every iteration admits queued requests
+    into free slots, feeds each active slot its current token (prompt
+    token during prefill, previous argmax during generation), and retires
+    slots whose request hit EOS / max_new_tokens — freeing their pages
+    for the next request with the compile counter flat."""
+
+    def __init__(self, engine, on_complete, on_fail,
+                 max_retries: int = 1):
+        self._engine = engine
+        self._on_complete = on_complete
+        self._on_fail = on_fail
+        self._max_retries = int(max_retries)
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()  # guarded-by: _cv
+        self._pending = 0   # guarded-by: _cv
+        self._stop = False  # guarded-by: _cv
+        self._slots: List[Optional[_SlotState]] = \
+            [None] * engine.max_slots
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-decode", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def enqueue(self, req: Request) -> bool:
+        """False when the decode loop has been stopped (see
+        :meth:`ContinuousBatcher.enqueue`)."""
+        with self._cv:
+            if self._stop:
+                return False
+            self._queue.append(req)
+            self._pending += 1
+            self._cv.notify()
+        return True
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._pending > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    # -- decode loop ---------------------------------------------------------
+    def _admit_locked(self) -> None:
+        # guarded-by-caller: _cv
+        for s, state in enumerate(self._slots):
+            if state is not None or not self._queue:
+                continue
+            req = self._queue[0]
+            # reserve the request's WORST-CASE pages now: admission is
+            # the only safe wait point (completions run on this same
+            # thread, so a mid-flight page stall could never resolve)
+            need = -(-(int(np.asarray(req.prompt).size)
+                       + req.max_new_tokens) // self._engine.page_len)
+            if not self._engine.reserve_slot(s, max(1, need)):
+                break               # pool exhausted: wait for completions
+            self._queue.popleft()
+            self._slots[s] = _SlotState(req)
+
+    def _loop(self) -> None:
+        eng = self._engine
+        S = eng.max_slots
+        while True:
+            with self._cv:
+                self._admit_locked()
+                active_slots = [s for s in range(S)
+                                if self._slots[s] is not None]
+                if not active_slots:
+                    if self._stop:
+                        return
+                    self._cv.wait(0.1)
+                    continue
+            ids = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            active = np.zeros(S, bool)
+            stepped = []
+            for s in active_slots:
+                st = self._slots[s]
+                # the page covering this position must exist BEFORE the
+                # step writes into it; an exhausted pool parks the slot
+                # for this iteration (completions will free pages)
+                if not eng.ensure_page(s, st.pos):
+                    continue
+                ids[s] = st.tokens[st.pos]
+                pos[s] = st.pos
+                active[s] = True
+                stepped.append(s)
+            if not stepped:
+                time.sleep(0.001)
+                continue
+            logits = self._run_step(ids, pos, active, stepped)
+            if logits is None:
+                continue
+            BATCHES_CTR.inc(1, bucket="decode")
+            OCCUPANCY_HIST.observe(float(len(stepped)))
+            now = time.perf_counter()
+            for s in stepped:
+                st = self._slots[s]
+                st.pos += 1
+                if st.pos < len(st.tokens):
+                    continue                   # prefill: next prompt token
+                nxt = int(np.argmax(logits[s]))
+                st.tokens.append(nxt)
+                st.generated.append(nxt)
+                done = (len(st.generated) >= st.req.max_new_tokens
+                        or (st.req.eos_id is not None
+                            and nxt == st.req.eos_id)
+                        or st.pos + 1 >= eng.max_seq)
+                if done:
+                    self._retire(s, st, now)
+
+    def _run_step(self, ids, pos, active, stepped):
+        from .. import resilience as _resil
+        attempt = 0
+        while True:
+            try:
+                _resil.maybe_inject("serving.decode_step")
+                return self._engine.run_iteration(ids, pos, active)
+            except Exception as e:
+                # retry only while the donated pools survived the
+                # failure: a fault from INSIDE the jitted step consumed
+                # the k/v buffers, and re-invoking with deleted arrays
+                # would just fail differently — fail the requests and
+                # rebuild the pools instead
+                alive = self._engine.cache.buffers_alive()
+                if (alive and _resil.is_transient(e)
+                        and attempt < self._max_retries):
+                    attempt += 1
+                    FAULTS_ABSORBED_CTR.inc()
+                    continue
+                # every active slot's cached prefix rides those pools —
+                # all of them are lost, not just this iteration's set
+                failed = [s for s in range(len(self._slots))
+                          if self._slots[s] is not None] \
+                    if not alive else list(stepped)
+                for s in failed:
+                    st = self._slots[s]
+                    self._engine.release_slot(s)
+                    self._slots[s] = None
+                    self._on_fail(st.req, e)
+                if not alive:
+                    self._engine.cache.reinit_pools()
+                with self._cv:
+                    self._pending -= len(failed)
+                    self._cv.notify_all()
+                return None
+
+    def _retire(self, s, st, now) -> None:
+        self._engine.release_slot(s)
+        self._slots[s] = None
+        self._on_complete(st.req, np.asarray(st.generated, np.int32),
+                          (now - st.req.t_submit) * 1e3)
+        with self._cv:
+            self._pending -= 1
+            self._cv.notify_all()

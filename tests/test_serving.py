@@ -1,0 +1,577 @@
+"""Production serving plane (PR 10): bucketized shape cache, continuous
+batching, paged-KV decode, tenant telemetry + retirement, fault
+absorption, graceful drain, and the memoized predictor engine."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor, serving
+from paddle_tpu.framework import (Executor, Program, Scope, program_guard,
+                                  scope_guard)
+from paddle_tpu.models import transformer as T
+
+CFG = dict(vocab_size=48, d_model=16, n_layer=2, n_head=2, d_inner=32,
+           max_pos=64, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    """Tiny causal LM: one initialized scope + a per-seq-len factory."""
+    cfg = T.BertConfig(**CFG)
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        T.build_gpt_serving(cfg, 8, attn_impl="base")
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=7)
+
+    def factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            _, logits = T.build_gpt_serving(cfg, seq, attn_impl="base")
+        return prog, ["src_ids"], [logits.name]
+
+    return cfg, scope, factory
+
+
+_REF = {}
+
+
+def _ref_logits(factory, scope, ids, ref_len=16):
+    """Reference logits for a request, via ONE shared fixed-length
+    program: causal attention makes tail padding invisible to earlier
+    positions, so the first len(ids) rows at length ``ref_len`` equal
+    the natural-length result (and the test's padded-batch rows must
+    match them too)."""
+    key = id(scope)
+    if key not in _REF:
+        _REF[key] = (Executor(),) + tuple(factory(ref_len))
+    exe, prog, _, fetches = _REF[key]
+    padded = np.zeros(ref_len, np.int64)
+    padded[:len(ids)] = ids
+    ref, = exe.run(prog, feed={"src_ids": padded[None, :]},
+                   fetch_list=fetches, scope=scope)
+    return np.asarray(ref)[0][:len(ids)]
+
+
+def _totals(name, **labels):
+    fam = monitor.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return sum(cell.get() for lbl, cell in fam.series()
+               if all(lbl.get(k) == v for k, v in labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+def test_parse_buckets_grammar():
+    assert serving.parse_buckets("16,4,64") == (4, 16, 64)
+    assert serving.parse_buckets("pow2:16:128") == (16, 32, 64, 128)
+    assert serving.parse_buckets("pow2:16:100") == (16, 32, 64, 100)
+    assert serving.parse_buckets("", max_len=32) == (8, 16, 32)
+    for bad in ("pow2:0:8", "pow2:8", "a,b", "-4,8"):
+        with pytest.raises(ValueError):
+            serving.parse_buckets(bad)
+
+
+def test_bucket_for_and_padding():
+    assert serving.bucket_for(5, (8, 16)) == 8
+    assert serving.bucket_for(9, (8, 16)) == 16
+    assert serving.bucket_for(17, (8, 16)) is None
+    a = np.arange(5, dtype=np.int64)
+    p = serving.pad_to_bucket(a, 8)
+    assert p.shape == (8,) and (p[:5] == a).all() and (p[5:] == 0).all()
+    with pytest.raises(ValueError):
+        serving.pad_to_bucket(np.arange(9), 8)
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching server
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_batch_server_parity_coalescing_and_compile_bound(gpt_model):
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8, 16),
+                                  max_batch=4, batch_wait_ms=10.0)
+    assert srv.warmup() == 2
+    traces0 = srv.compile_stats()["traces"]
+    assert traces0 == 2        # one compile per bucket, none extra
+    occ0_sum = _totals("paddle_tpu_serving_batch_occupancy")
+    srv.start()
+    rng = np.random.RandomState(0)
+    reqs = []
+    for i in range(10):
+        n = int(rng.randint(3, 15))
+        ids = rng.randint(1, cfg.vocab_size, (n,)).astype(np.int64)
+        tenant = "pt_a" if i % 2 else "pt_b"
+        reqs.append((ids, srv.submit(tenant, {"src_ids": ids})))
+    outs = [f.result(timeout=120) for _, f in reqs]
+    # every fetch row is trimmed back to the request's natural length
+    # and numerically matches the unbatched, unpadded reference
+    for (ids, _), out in zip(reqs, outs):
+        assert out[0].shape == (len(ids), cfg.vocab_size)
+        np.testing.assert_allclose(out[0], _ref_logits(factory, scope, ids),
+                                   rtol=2e-4, atol=2e-4)
+    # 10 requests of 10 distinct shapes -> ZERO new compiles
+    assert srv.compile_stats()["traces"] == traces0
+    assert srv.drain(30)
+    srv.stop()
+
+
+def test_tenant_quota_and_retirement(gpt_model):
+    cfg, scope, factory = gpt_model
+    # server NOT started: submits stay queued, so quota pressure is exact
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2, tenant_quota=2)
+    ids = np.arange(1, 5, dtype=np.int64)
+    f1 = srv.submit("quota_t", {"src_ids": ids})
+    f2 = srv.submit("quota_t", {"src_ids": ids})
+    f3 = srv.submit("quota_t", {"src_ids": ids})   # over quota
+    assert not f1.done() and not f2.done()
+    with pytest.raises(serving.AdmissionError):
+        f3.result(0)
+    assert _totals("paddle_tpu_serving_rejected_total", tenant="quota_t",
+                   reason="quota") == 1
+    # per-tenant quota override beats the default
+    srv.tenants.set_quota("vip", 3)
+    for _ in range(3):
+        assert not srv.submit("vip", {"src_ids": ids}).done()
+    with pytest.raises(serving.AdmissionError):
+        srv.submit("vip", {"src_ids": ids}).result(0)
+
+    # tenant churn folds series instead of growing the registry forever
+    before_series = len(monitor.REGISTRY.get(
+        "paddle_tpu_serving_requests_total").series())
+    before_total = _totals("paddle_tpu_serving_requests_total")
+    for i in range(10):
+        t = f"churn_{i}"
+        srv.submit(t, {"src_ids": ids})
+        srv.tenants.evict(t)
+    fam = monitor.REGISTRY.get("paddle_tpu_serving_requests_total")
+    after = {tuple(lbl.items()) for lbl, _ in fam.series()}
+    assert (("tenant", "retired"),) in after
+    assert not any("churn_" in str(lbl) for lbl in after)
+    # at most ONE new series (the shared "retired" fold target)
+    assert len(after) <= before_series + 1
+    # ...while process-lifetime totals stay exact
+    assert _totals("paddle_tpu_serving_requests_total") == \
+        before_total + 10
+    srv.stop()
+
+
+@pytest.mark.slow
+def test_too_long_request_rejected(gpt_model):
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2)
+    f = srv.submit("pt_a", {"src_ids": np.arange(1, 12, dtype=np.int64)})
+    with pytest.raises(serving.AdmissionError):
+        f.result(0)
+    assert _totals("paddle_tpu_serving_rejected_total", tenant="pt_a",
+                   reason="too_long") >= 1
+    srv.stop()
+
+
+@pytest.mark.slow
+def test_dispatch_fault_absorbed(gpt_model):
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    absorbed0 = _totals("paddle_tpu_serving_faults_absorbed_total")
+    pt.set_flags({"FLAGS_fault_inject": "executor.dispatch:once"})
+    try:
+        ids = np.arange(1, 6, dtype=np.int64)
+        f = srv.submit("fault_t", {"src_ids": ids})
+        out = f.result(timeout=120)     # completed DESPITE the fault
+    finally:
+        pt.set_flags({"FLAGS_fault_inject": ""})
+    np.testing.assert_allclose(out[0], _ref_logits(factory, scope, ids),
+                               rtol=2e-4, atol=2e-4)
+    assert _totals("paddle_tpu_serving_faults_absorbed_total") == \
+        absorbed0 + 1
+    assert _totals("paddle_tpu_serving_failed_total", tenant="fault_t") \
+        == 0
+    srv.stop()
+
+
+def test_memory_budget_narrows_batch_width():
+    # big enough that width 8 breaks a 1 MiB budget (logits alone:
+    # 8 x 64 x 2048 x 4 B = 4 MiB) while width 1 fits comfortably
+    cfg = T.BertConfig(vocab_size=2048, d_model=32, n_layer=1, n_head=2,
+                       d_inner=32, max_pos=64, dropout=0.0)
+
+    def factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            _, logits = T.build_gpt_serving(cfg, seq, attn_impl="base")
+        return prog, ["src_ids"], [logits.name]
+
+    full = serving.BucketPlan((64,), factory, max_batch=8,
+                              memory_budget_mb=0)
+    capped = serving.BucketPlan((64,), factory, max_batch=8,
+                                memory_budget_mb=1)
+    assert full.plan(64)[3] == 8
+    assert capped.plan(64)[3] < 8      # admission narrowed the batch
+
+
+@pytest.mark.slow
+def test_drain_completes_then_rejects(gpt_model):
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=4, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    ids = np.arange(1, 7, dtype=np.int64)
+    futs = [srv.submit("drain_t", {"src_ids": ids}) for _ in range(6)]
+    assert srv.drain(60)
+    assert all(f.done() for f in futs)
+    for f in futs:
+        f.result(0)                     # zero dropped
+    late = srv.submit("drain_t", {"src_ids": ids})
+    with pytest.raises(serving.AdmissionError):
+        late.result(0)
+    assert _totals("paddle_tpu_serving_rejected_total", tenant="drain_t",
+                   reason="draining") == 1
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# paged-KV decode (gpt_causal)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_decode_engine_matches_full_program(gpt_model):
+    cfg, scope, factory = gpt_model
+    eng = serving.DecodeEngine(cfg, scope, max_slots=3, page_len=4,
+                               max_seq=32)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(2, 7)),)).astype(np.int64)
+               for _ in range(4)]
+    futs = [dsrv.submit("pt_a" if i % 2 else "pt_b", p, max_new_tokens=5)
+            for i, p in enumerate(prompts)]
+    gens = [list(map(int, f.result(timeout=300))) for f in futs]
+    # reference: greedy continuation via ONE fixed-length full-context
+    # program (causal: right padding never reaches position len(toks)-1)
+    for p, g in zip(prompts, gens):
+        toks = list(map(int, p))
+        ref = []
+        for _ in range(5):
+            logits = _ref_logits(factory, scope, toks)
+            nxt = int(np.argmax(logits[-1]))
+            ref.append(nxt)
+            toks.append(nxt)
+        assert g == ref, (g, ref)
+    assert dsrv.drain(10)
+    dsrv.stop()
+
+
+@pytest.mark.slow
+def test_decode_slot_reuse_no_recompile_pages_freed(gpt_model):
+    cfg, scope, _ = gpt_model
+    eng = serving.DecodeEngine(cfg, scope, max_slots=2, page_len=4,
+                               max_seq=32)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    rng = np.random.RandomState(2)
+    # 6 requests through 2 slots: joins/leaves between iterations
+    futs = [dsrv.submit("pt_a", rng.randint(1, cfg.vocab_size, (3 + i % 4,)),
+                        max_new_tokens=3) for i in range(6)]
+    for f in futs:
+        assert len(f.result(timeout=300)) == 3
+    assert eng.trace_count == 1        # ONE compiled step, ever
+    assert eng.cache.pages_in_use() == 0   # every page recycled
+    # a second wave reuses the freed slots/pages, still no recompile
+    f = dsrv.submit("pt_b", rng.randint(1, cfg.vocab_size, (4,)),
+                    max_new_tokens=2)
+    assert len(f.result(timeout=300)) == 2
+    assert eng.trace_count == 1
+    assert dsrv.drain(10)
+    dsrv.stop()
+
+
+@pytest.mark.slow
+def test_decode_eos_stops_generation(gpt_model):
+    cfg, scope, _ = gpt_model
+    eng = serving.DecodeEngine(cfg, scope, max_slots=1, page_len=4,
+                               max_seq=32)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    prompt = np.asarray([3, 9, 17], np.int64)
+    first = dsrv.submit("pt_a", prompt, max_new_tokens=6).result(
+        timeout=300)
+    assert len(first) == 6
+    # same greedy decode with eos at the first generated token stops at 1
+    gen = dsrv.submit("pt_a", prompt, max_new_tokens=6,
+                      eos_id=int(first[0])).result(timeout=300)
+    assert list(gen) == [int(first[0])]
+    # context-window overflow is an admission error, not a hang
+    with pytest.raises(serving.AdmissionError):
+        dsrv.submit("pt_a", np.arange(1, 30, dtype=np.int64),
+                    max_new_tokens=10).result(0)
+    dsrv.stop()
+
+
+@pytest.mark.slow
+def test_decode_tight_pool_no_deadlock(gpt_model):
+    """A page pool too small for both slots at once must SERIALIZE the
+    requests (admission-time worst-case reservation), not deadlock two
+    optimistically-admitted requests on each other's unreleased pages —
+    completions happen on the decode thread itself, so a mid-flight page
+    stall could never resolve."""
+    cfg, scope, _ = gpt_model
+    # each request needs ceil((4+4)/2) = 4 pages; pool holds 5 usable:
+    # optimistic admission would start both and wedge mid-growth
+    eng = serving.DecodeEngine(cfg, scope, max_slots=2, page_len=2,
+                               max_seq=8, n_pages=6)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    rng = np.random.RandomState(5)
+    futs = [dsrv.submit("pool_t", rng.randint(1, cfg.vocab_size, (4,)),
+                        max_new_tokens=4) for _ in range(2)]
+    for f in futs:
+        assert len(f.result(timeout=120)) == 4
+    assert eng.cache.pages_in_use() == 0
+    dsrv.stop()
+
+
+@pytest.mark.slow
+def test_cold_bucket_factory_error_fails_requests_not_thread(gpt_model):
+    """A program_factory that raises on a cold bucket fails that
+    bucket's requests; the scheduler thread survives to serve others."""
+    cfg, scope, factory = gpt_model
+
+    def flaky_factory(seq):
+        if seq == 16:
+            raise RuntimeError("no model at this length")
+        return factory(seq)
+
+    srv = serving.InferenceServer(flaky_factory, scope, buckets=(8, 16),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup(buckets=(8,))
+    srv.start()
+    bad = srv.submit("cold_t", {"src_ids": np.arange(1, 13,
+                                                     dtype=np.int64)})
+    with pytest.raises(RuntimeError, match="no model"):
+        bad.result(timeout=60)
+    ids = np.arange(1, 6, dtype=np.int64)
+    good = srv.submit("cold_t", {"src_ids": ids}).result(timeout=60)
+    np.testing.assert_allclose(good[0],
+                               _ref_logits(factory, scope, ids),
+                               rtol=2e-4, atol=2e-4)
+    assert srv.drain(10)
+    srv.stop()
+
+
+@pytest.mark.slow
+def test_fixed_length_feed_not_padded():
+    """Only feeds the bucket program declares at the bucket length carry
+    the sequence axis; a fixed-length feature feed stacks unpadded."""
+    def factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            x = layers.data("x", shape=[seq], dtype="float32")
+            f = layers.data("f", shape=[3], dtype="float32")
+            out = layers.concat([x, f], axis=1)
+        return prog, ["x", "f"], [out.name]
+
+    scope = Scope()
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    traces0 = srv.compile_stats()["traces"]
+    srv.start()
+    xv = np.arange(1, 6, dtype=np.float32)          # padded 5 -> 8
+    fv = np.array([9.0, 8.0, 7.0], np.float32)      # stays length 3
+    out, = srv.submit("fix_t", {"x": xv, "f": fv},
+                      seq_len=5).result(timeout=60)
+    assert out.shape == (11,)                       # concat(8, 3)
+    np.testing.assert_allclose(out[:5], xv)
+    np.testing.assert_allclose(out[5:8], 0.0)
+    np.testing.assert_allclose(out[8:], fv)
+    assert srv.compile_stats()["traces"] == traces0  # no fresh compile
+    srv.stop()
+
+
+def test_paged_cache_pool_accounting():
+    cache = serving.PagedKVCache(n_layers=1, n_pages=4, page_len=2,
+                                 n_head=1, d_head=4, max_slots=2)
+    p1 = cache.alloc_page(0)
+    p2 = cache.alloc_page(0)
+    p3 = cache.alloc_page(1)
+    assert {p1, p2, p3} <= {1, 2, 3} and len({p1, p2, p3}) == 3
+    assert cache.alloc_page(1) is None       # exhausted (page 0 reserved)
+    assert cache.pages_in_use() == 3
+    assert cache.free_slot(0) == 2
+    assert cache.pages_in_use() == 1
+    assert cache.alloc_page(1) is not None   # freed pages reused
+    cache.free_slot(1)
+    assert cache.pages_in_use() == 0
+
+
+# ---------------------------------------------------------------------------
+# memoized predictor engine (satellite)
+# ---------------------------------------------------------------------------
+
+def test_predictor_engine_memoized(tmp_path, monkeypatch):
+    """A second AnalysisPredictor on the same saved model must be a full
+    cache hit: no model re-load, no analysis-pass re-run, the SAME jitted
+    callable (PR-4 call-counting pattern on the engine builder)."""
+    from paddle_tpu import inference
+
+    model_dir = str(tmp_path / "memo_model")
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[16], dtype="float32")
+        out = layers.fc(x, size=4, act="softmax")
+        exe = Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=3)
+        pt.io.save_inference_model(model_dir, ["x"], [out], executor=exe,
+                                   scope=scope)
+
+    inference.clear_engine_cache()
+    builds = []
+    real = inference.AnalysisPredictor._build_engine
+
+    def counting(config):
+        builds.append(1)
+        return real(config)
+
+    monkeypatch.setattr(inference.AnalysisPredictor, "_build_engine",
+                        staticmethod(counting))
+    miss0 = _totals("paddle_tpu_predictor_engine_total", cache="miss")
+    hit0 = _totals("paddle_tpu_predictor_engine_total", cache="hit")
+    xv = np.random.RandomState(0).rand(2, 16).astype(np.float32)
+    p1 = inference.create_paddle_predictor(
+        inference.AnalysisConfig(model_dir))
+    r1, = p1.run([inference.PaddleTensor(xv, name="x")])
+    p2 = inference.create_paddle_predictor(
+        inference.AnalysisConfig(model_dir))
+    r2, = p2.run([inference.PaddleTensor(xv, name="x")])
+    assert len(builds) == 1            # second predictor built NOTHING
+    assert p1._jitted is p2._jitted    # shared jit cache => no re-trace
+    assert _totals("paddle_tpu_predictor_engine_total",
+                   cache="miss") == miss0 + 1
+    assert _totals("paddle_tpu_predictor_engine_total",
+                   cache="hit") == hit0 + 1
+    np.testing.assert_allclose(r1.data, r2.data, rtol=1e-6)
+
+    # re-saving the artifact at the same path MISSES (mtime in the key)
+    time.sleep(0.01)
+    with scope_guard(scope):
+        pt.io.save_inference_model(model_dir, ["x"], [out], executor=exe,
+                                   main_program=out.block.program,
+                                   scope=scope)
+    inference.AnalysisPredictor(inference.AnalysisConfig(model_dir))
+    assert len(builds) == 2
+
+
+@pytest.mark.slow
+def test_malformed_request_fails_batch_not_scheduler(gpt_model):
+    """A request with a missing/ragged feed must fail ITS OWN future —
+    and the scheduler thread must survive to serve the next request
+    (review finding: an uncaught assembly error killed the thread and
+    hung every later future)."""
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    bad = srv.submit("mal_t", {"wrong_feed_name":
+                               np.arange(1, 5, dtype=np.int64)})
+    with pytest.raises(Exception):
+        bad.result(timeout=60)
+    # the scheduler is still alive: a well-formed request completes
+    ids = np.arange(1, 6, dtype=np.int64)
+    good = srv.submit("mal_t", {"src_ids": ids}).result(timeout=60)
+    np.testing.assert_allclose(good[0],
+                               _ref_logits(factory, scope, ids),
+                               rtol=2e-4, atol=2e-4)
+    srv.stop()
+
+
+@pytest.mark.slow
+def test_understated_seq_len_still_fits_bucket(gpt_model):
+    """seq_len only controls trimming; the BUCKET must fit every feed, so
+    an understated length cannot smuggle an oversize array past padding."""
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8, 16),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    ids = np.arange(1, 13, dtype=np.int64)        # 12 > bucket 8
+    out = srv.submit("seq_t", {"src_ids": ids},
+                     seq_len=4).result(timeout=60)
+    assert out[0].shape[0] == 4                   # trimmed to seq_len
+    np.testing.assert_allclose(out[0],
+                               _ref_logits(factory, scope, ids)[:4],
+                               rtol=2e-4, atol=2e-4)
+    srv.stop()
+
+
+def test_evicted_tenant_completion_does_not_resurrect_series():
+    """In-flight work finishing AFTER eviction accrues to the "retired"
+    series instead of re-minting the just-folded per-tenant ones."""
+    plane = serving.TenantPlane()
+    t = "ghost_tenant"
+    assert plane.try_admit(t)
+    plane.evict(t)
+    plane.complete(t, 5.0)       # straggler completion post-eviction
+    plane.fail(t)
+    plane.reject(t, "quota")
+    for fam_name in ("paddle_tpu_serving_requests_total",
+                     "paddle_tpu_serving_completed_total",
+                     "paddle_tpu_serving_failed_total",
+                     "paddle_tpu_serving_latency_ms",
+                     "paddle_tpu_serving_queue_depth",
+                     "paddle_tpu_serving_rejected_total"):
+        fam = monitor.REGISTRY.get(fam_name)
+        assert not any(lbl.get("tenant") == t for lbl, _ in fam.series()), \
+            fam_name
+    assert _totals("paddle_tpu_serving_completed_total",
+                   tenant="retired") >= 1
+    # a RE-ADMITTED tenant is a new incarnation with fresh series
+    gen_old = plane.generation(t) - 1      # the pre-eviction generation
+    assert plane.try_admit(t)
+    assert _totals("paddle_tpu_serving_requests_total", tenant=t) == 1
+    # a straggler from the PRE-eviction incarnation must not decrement
+    # the new incarnation's outstanding count or touch its live series
+    plane.complete(t, 1.0, gen=gen_old)
+    assert plane.outstanding(t) == 1
+    assert _totals("paddle_tpu_serving_completed_total", tenant=t) == 0
+
+
+def test_enqueue_after_stop_fails_fast(gpt_model):
+    """enqueue racing stop(): the scheduler refuses and the future fails
+    immediately instead of waiting on a queue no thread services."""
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2)
+    srv._sched.stop()            # scheduler stopped, server not draining
+    f = srv.submit("race_t", {"src_ids": np.arange(1, 5,
+                                                   dtype=np.int64)})
+    with pytest.raises(serving.AdmissionError):
+        f.result(0)
+    assert srv._sched.drain(0.1)     # nothing stranded in _pending
+    srv.stop()
+
+
+def test_serving_future_timeout(gpt_model):
+    cfg, scope, factory = gpt_model
+    srv = serving.InferenceServer(factory, scope, buckets=(8,),
+                                  max_batch=2)
+    # not started: the future must time out rather than hang forever
+    f = srv.submit("pt_a", {"src_ids": np.arange(1, 5, dtype=np.int64)})
+    with pytest.raises(TimeoutError):
+        f.result(timeout=0.05)
+    srv.stop()

@@ -25,6 +25,7 @@ MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.resilience",
     "paddle_tpu.analysis",
+    "paddle_tpu.serving",
     "paddle_tpu.initializer",
     "paddle_tpu.regularizer",
     "paddle_tpu.clip",

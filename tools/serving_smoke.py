@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Serving smoke (CI gate): the continuous-batching multi-tenant server
+must, under concurrent clients across 2 tenants:
+
+1. complete EVERY admitted request with exact counter totals
+   (requests_total == completed_total per tenant, failed == 0);
+2. demonstrably coalesce — mean batch occupancy > 1 in the telemetry
+   histogram;
+3. bound compile cost: executor traces == number of warmed shape
+   buckets, FLAT after the load (arbitrary request shapes never compile);
+4. absorb an injected dispatch fault (``FLAGS_fault_inject``):
+   faults_injected == faults_absorbed == 1, zero failed requests;
+5. bound p99 latency under the smoke's load;
+6. run the ``gpt_causal`` decode loop with KV slot reuse across more
+   requests than slots, ONE compiled step (trace count 1), and every
+   page freed at the end;
+7. (subprocess) drain on SIGTERM mid-load: stop admitting, finish every
+   in-flight request, exit 0 with zero dropped.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _build(cfg_kw=None):
+    import paddle_tpu as pt
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+    from paddle_tpu.models import transformer as T
+    cfg = T.BertConfig(**(cfg_kw or dict(
+        vocab_size=48, d_model=16, n_layer=2, n_head=2, d_inner=32,
+        max_pos=64, dropout=0.0)))
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        T.build_gpt_pretrain(cfg, 16, is_test=True, fused_head=False,
+                             attn_impl="base")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope, seed=7)
+
+    def factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            _, logits = T.build_gpt_serving(cfg, seq, attn_impl="base")
+        return prog, ["src_ids"], [logits.name]
+
+    return cfg, scope, factory
+
+
+def _submit_load(srv, cfg, n_requests=36, n_clients=6, seed=0):
+    """Concurrent open-ish-loop clients across 2 tenants; returns the
+    futures with their tenants."""
+    import threading
+    rng = np.random.RandomState(seed)
+    lengths = [int(rng.randint(3, 15)) for _ in range(n_requests)]
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).astype(np.int64)
+               for n in lengths]
+    out, mu = [], threading.Lock()
+
+    def client(cid):
+        r = np.random.RandomState(100 + cid)
+        for i in range(cid, n_requests, n_clients):
+            tenant = "tenant_a" if i % 2 else "tenant_b"
+            f = srv.submit(tenant, {"src_ids": prompts[i]})
+            with mu:
+                out.append((tenant, f))
+            time.sleep(float(r.rand()) * 0.002)
+
+    threads = [__import__("threading").Thread(target=client, args=(c,),
+                                              daemon=True)
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def counter_total(name, **labels):
+    from paddle_tpu import monitor
+    fam = monitor.REGISTRY.get(name)
+    if fam is None:
+        return 0
+    return sum(cell.get() for lbl, cell in fam.series()
+               if all(lbl.get(k) == v for k, v in labels.items()))
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu import monitor, serving
+
+    cfg, scope, factory = _build()
+    srv = serving.InferenceServer(factory, scope, buckets=(8, 16),
+                                  max_batch=4, batch_wait_ms=5.0)
+    warmed = srv.warmup()
+    traces_after_warmup = srv.compile_stats()["traces"]
+    assert warmed == 2 and traces_after_warmup == 2, (
+        warmed, traces_after_warmup)
+    srv.start()
+
+    # one injected dispatch fault AFTER warmup: the scheduler must absorb
+    # it (batch re-dispatch) with zero failed requests
+    pt.set_flags({"FLAGS_fault_inject": "executor.dispatch:once@3"})
+    try:
+        pairs = _submit_load(srv, cfg)
+        lat_ms = []
+        for tenant, f in pairs:
+            t0 = time.perf_counter()
+            f.result(timeout=120)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        pt.set_flags({"FLAGS_fault_inject": ""})
+    assert srv.drain(30), "drain timed out with requests in flight"
+
+    # exact counter totals, per tenant and overall
+    n = len(pairs)
+    req_a = counter_total("paddle_tpu_serving_requests_total",
+                          tenant="tenant_a")
+    req_b = counter_total("paddle_tpu_serving_requests_total",
+                          tenant="tenant_b")
+    done_a = counter_total("paddle_tpu_serving_completed_total",
+                           tenant="tenant_a")
+    done_b = counter_total("paddle_tpu_serving_completed_total",
+                           tenant="tenant_b")
+    failed = counter_total("paddle_tpu_serving_failed_total")
+    assert req_a + req_b == n and req_a == done_a and req_b == done_b, (
+        req_a, req_b, done_a, done_b, n)
+    assert failed == 0, failed
+    injected = counter_total("paddle_tpu_fault_injected_total",
+                             site="executor.dispatch")
+    absorbed = counter_total("paddle_tpu_serving_faults_absorbed_total")
+    assert injected == 1 and absorbed == 1, (injected, absorbed)
+
+    # continuous batching actually coalesces
+    tot = monitor.counter_totals()
+    occ = (tot["paddle_tpu_serving_batch_occupancy_sum"]
+           / tot["paddle_tpu_serving_batch_occupancy_count"])
+    assert occ > 1.0, f"mean batch occupancy {occ:.2f} <= 1"
+
+    # compile count == warmed buckets, flat under 36 distinct shapes
+    stats = srv.compile_stats()
+    assert stats["traces"] == traces_after_warmup, stats
+
+    # latency bound (generous: CPU smoke under CI load)
+    lat_ms.sort()
+    p99 = lat_ms[int(0.99 * (len(lat_ms) - 1))]
+    assert p99 < 30000, f"p99 {p99:.0f} ms"
+    srv.stop()
+
+    # -- gpt_causal decode loop: slot reuse, one compile, pages freed ----
+    eng = serving.DecodeEngine(cfg, scope, max_slots=2, page_len=4,
+                               max_seq=32)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    rng = np.random.RandomState(3)
+    futs = [dsrv.submit("tenant_a" if i % 2 else "tenant_b",
+                        rng.randint(1, cfg.vocab_size,
+                                    (int(rng.randint(2, 7)),)),
+                        max_new_tokens=4)
+            for i in range(5)]          # 5 requests > 2 slots
+    gens = [f.result(timeout=120) for f in futs]
+    assert all(len(g) == 4 for g in gens), [len(g) for g in gens]
+    assert eng.trace_count == 1, eng.trace_count
+    assert eng.cache.pages_in_use() == 0, eng.cache.pages_in_use()
+    assert dsrv.drain(10)
+    dsrv.stop()
+
+    print(f"serving smoke OK: {n} requests across 2 tenants, mean "
+          f"occupancy {occ:.2f}, p99 {p99:.0f} ms, traces "
+          f"{stats['traces']} == buckets {warmed}, fault absorbed, "
+          f"decode slot-reuse with 1 trace")
+
+
+def child_drain():
+    """SIGTERM-drain scenario (run as a subprocess): serve under load,
+    report readiness, absorb the parent's SIGTERM by draining, print the
+    admitted/completed ledger, exit 0."""
+    from paddle_tpu import serving
+    cfg, scope, factory = _build()
+    srv = serving.InferenceServer(factory, scope, buckets=(8, 16),
+                                  max_batch=4, batch_wait_ms=5.0)
+    srv.warmup()
+    srv.start()
+    srv.install_signal_handlers()
+
+    import threading
+    rng = np.random.RandomState(11)
+    admitted, rejected = [], [0]
+
+    def client():
+        i = 0
+        while not srv._draining.is_set():
+            n = int(rng.randint(3, 15))
+            ids = rng.randint(1, cfg.vocab_size, (n,)).astype(np.int64)
+            f = srv.submit("tenant_a" if i % 2 else "tenant_b",
+                           {"src_ids": ids})
+            i += 1
+            if f.done():
+                try:
+                    f.result(0)
+                except serving.AdmissionError:
+                    rejected[0] += 1
+                    continue
+            admitted.append(f)
+            time.sleep(0.002)
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    print("SERVING_READY", flush=True)
+    code = srv.serve_until_terminated(drain_timeout_s=60)
+    t.join(timeout=10)
+    done = sum(1 for f in admitted if f.done())
+    completed = 0
+    for f in admitted:
+        try:
+            f.result(0)
+            completed += 1
+        except Exception:
+            pass
+    print(json.dumps({"admitted": len(admitted), "resolved": done,
+                      "completed": completed,
+                      "rejected_after_drain": rejected[0],
+                      "exit": code}), flush=True)
+    sys.exit(0 if (code == 0 and done == len(admitted)
+                   and completed == len(admitted)) else 1)
+
+
+def drain_scenario():
+    """Parent side: SIGTERM the serving child mid-load, require exit 0
+    and a zero-drop ledger."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--drain-child"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    try:
+        deadline = time.time() + 300
+        for line in p.stdout:
+            if line.strip() == "SERVING_READY":
+                break
+            if time.time() > deadline:
+                raise AssertionError("child never became ready")
+        time.sleep(1.0)              # let the load build up mid-flight
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=180)
+    except Exception:
+        p.kill()
+        raise
+    ledger = None
+    for line in out.splitlines():
+        try:
+            ledger = json.loads(line)
+        except ValueError:
+            continue
+    assert p.returncode == 0, (p.returncode, out[-500:], err[-500:])
+    assert ledger is not None and ledger["admitted"] > 0, (out, err)
+    assert ledger["completed"] == ledger["admitted"], ledger
+    print(f"drain smoke OK: SIGTERM mid-load, {ledger['admitted']} "
+          f"admitted, {ledger['completed']} completed, 0 dropped, exit 0")
+
+
+if __name__ == "__main__":
+    if "--drain-child" in sys.argv:
+        child_drain()
+    else:
+        main()
+        drain_scenario()
+        print("OK")
