@@ -913,6 +913,41 @@ def _autotune_path() -> Optional[str]:
     return os.path.join(str(d), "fusion_autotune.json") if d else None
 
 
+def _device_key() -> str:
+    """Autotune cache key component naming the ACTUAL hardware:
+    ``<device_kind>x<device_count>`` (e.g. ``TPU_v5ex4``, ``cpux8``).
+    A backend name alone ("tpu") would let a v4 verdict steer a v5e —
+    different MXU shapes, different winners (ROADMAP carried-over
+    follow-on)."""
+    import jax
+    try:
+        devs = jax.devices()
+        kind = str(devs[0].device_kind).replace(" ", "_")
+        return f"{kind}x{len(devs)}"
+    except Exception:
+        return str(jax.default_backend())
+
+
+def _migrate_autotune_key(key: str) -> str:
+    """Re-key a pre-device-kind cache entry: old keys carried the bare
+    backend name ("cpu"/"gpu"/"tpu") in slot 3; entries recorded on THIS
+    backend migrate to the current :func:`_device_key` (best available
+    interpretation — the measurements came from some device of this
+    backend), foreign-backend entries are kept as-is for their own
+    process to migrate."""
+    import jax
+    try:
+        parts = json.loads(key)
+    except ValueError:
+        return key
+    if (isinstance(parts, list) and len(parts) == 5
+            and parts[3] in ("cpu", "gpu", "tpu")
+            and parts[3] == jax.default_backend()):
+        parts[3] = _device_key()
+        return json.dumps(parts, default=str)
+    return key
+
+
 def _autotune_load_locked():   # guarded-by-caller: _AUTOTUNE_LOCK
     if _AUTOTUNE_LOADED[0]:
         return
@@ -923,9 +958,26 @@ def _autotune_load_locked():   # guarded-by-caller: _AUTOTUNE_LOCK
     try:
         with open(path) as f:
             data = json.load(f)
-        if isinstance(data, dict):
-            _AUTOTUNE_MEM.update(
-                {k: v for k, v in data.items() if isinstance(v, dict)})
+        if not isinstance(data, dict):
+            return
+        # two passes so a measurement already taken under a new-style
+        # key is never clobbered by a migrated old one, regardless of
+        # the entries' order in the file
+        migrated = False
+        deferred = []
+        for k, v in data.items():
+            if not isinstance(v, dict):
+                continue
+            nk = _migrate_autotune_key(k)
+            if nk != k:
+                migrated = True
+                deferred.append((nk, v))
+            else:
+                _AUTOTUNE_MEM.setdefault(k, v)
+        for nk, v in deferred:
+            _AUTOTUNE_MEM.setdefault(nk, v)
+        if migrated:
+            _autotune_persist_locked()   # one-shot cache migration
     except (OSError, ValueError):
         pass
 
@@ -1004,14 +1056,13 @@ def _time_chain(descs, ext_vals, reps=3, amp=False):
 
 def _autotune(cand: _Candidate, batch: int) -> Optional[dict]:
     """Measured fused-vs-base verdict for one candidate, cached on
-    (pattern, shape key, backend).  None when the candidate cannot be
+    (pattern, shape key, batch, device kind x topology, amp regime).
+    None when the candidate cannot be
     replayed (unknown shapes) — callers fall back to rank-only."""
     if not cand.ext_inputs or not cand.base_descs:
         return None
-    import jax
-    backend = jax.default_backend()
     amp = bool(getattr(cand, "amp", False))
-    key = json.dumps([cand.pattern, cand.shape_key, batch, backend,
+    key = json.dumps([cand.pattern, cand.shape_key, batch, _device_key(),
                       "amp" if amp else "f32"], default=str)
     with _AUTOTUNE_LOCK:
         _autotune_load_locked()

@@ -545,12 +545,20 @@ class GangCoordinator:
             self._refresh_gang_gauges()
         return {"ok": True, **view}
 
-    #: digest key -> the per-rank gauge family it lands in
+    #: digest key -> the per-rank gauge family it lands in.  The
+    #: serving keys (srv_q/occ/slots/tps) are the per-replica load
+    #: signal the fleet router/autoscaler consumes — published here so
+    #: the coordinator host's /metrics (or file export) carries the
+    #: whole fleet's serving load.
     _DIGEST_GAUGES = {
         "step_ms": _monitor.GANG_RANK_STEP_MS,
         "mfu": _monitor.GANG_RANK_MFU,
         "queue": _monitor.GANG_RANK_QUEUE,
         "inflight": _monitor.GANG_RANK_INFLIGHT,
+        "srv_q": _monitor.GANG_RANK_SRVQ,
+        "occ": _monitor.GANG_RANK_OCC,
+        "slots": _monitor.GANG_RANK_FREE_SLOTS,
+        "tps": _monitor.GANG_RANK_TPS,
     }
 
     def _fold_digest(self, rank: int, digest: dict) -> None:
@@ -563,6 +571,12 @@ class GangCoordinator:
             v = digest.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 fam.set(float(v), rank=str(rank))
+            else:
+                # the key stopped riding the digest (server stopped, or
+                # shed under the byte cap): DROP the rank's series — a
+                # frozen last value would read as live load to a router
+                # doing least-loaded placement on it
+                fam.fold({"rank": str(rank)}, None)
 
     def _aggregates_locked(self) -> dict:  # guarded-by-caller: _cv
         """Gang-level aggregates over the LIVE ranks' heartbeat state —
@@ -885,6 +899,13 @@ class GangClient:
             "mismatch": None}
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        #: the heartbeat thread's live socket, mirrored here so close()
+        #: can interrupt a blocking send/recv (a beat mid-flight when
+        #: the client is closed would otherwise outlive the 2 s join —
+        #: and a zombie beat can re-dial an EPHEMERAL PORT a newer
+        #: coordinator has since reused, injecting a stale rank entry
+        #: into a foreign gang: the in-suite flake PR 9 noted)
+        self._hb_sock: Optional[socket.socket] = None  # guarded-by: _state_mu
         self._degraded_noted = False
         #: None = auto-collect monitor.metrics_digest() per beat;
         #: a dict = fixed override (tests, foreign runners)
@@ -974,6 +995,11 @@ class GangClient:
 
     def close(self, goodbye: bool = True) -> None:
         self._hb_stop.set()
+        # interrupt a beat blocked in send/recv (socket timeouts run to
+        # 5 s, longer than the join below) — closing the socket makes
+        # the blocking call raise NOW, so the thread reliably dies
+        # inside this close() instead of beating once more afterwards
+        self._drop_hb_sock()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
             self._hb_thread = None
@@ -1013,14 +1039,29 @@ class GangClient:
         elif view["status"] == "ok":
             self._degraded_noted = False
 
+    def _drop_hb_sock(self) -> None:
+        with self._state_mu:
+            sock, self._hb_sock = self._hb_sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def _hb_loop(self) -> None:
-        sock: Optional[socket.socket] = None
         while not self._hb_stop.is_set():
             try:
+                with self._state_mu:
+                    sock = self._hb_sock
                 if sock is None:
+                    # dial FIRST, publish under the lock after — close()
+                    # observing None here is fine: the stop flag is
+                    # checked again before the next beat is sent
                     sock = self._dial()
                     sock.settimeout(
                         max(4.0 * self.heartbeat_interval_s, 5.0))
+                    with self._state_mu:
+                        self._hb_sock = sock
                 with self._state_mu:
                     payload = {"op": "heartbeat", "rank": self.rank,
                                **self._progress}
@@ -1036,24 +1077,17 @@ class GangClient:
                         digest = None
                 if digest:
                     payload["digest"] = _monitor.capped_digest(digest)
+                if self._hb_stop.is_set():
+                    break        # close() raced the dial: never beat
                 send_frame(sock, payload)
                 resp = recv_frame(sock)
                 _monitor.GANG_HB_CTR.inc(1, role="client")
                 if resp.get("ok"):
                     self._absorb_view(resp)
             except (OSError, ConnectionError, ValueError):
-                if sock is not None:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-                sock = None               # reconnect on the next beat
+                self._drop_hb_sock()      # reconnect on the next beat
             self._hb_stop.wait(self.heartbeat_interval_s)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        self._drop_hb_sock()
 
     def set_progress(self, step: Optional[int] = None,
                      steps=None, fingerprint: Optional[str] = None) -> None:

@@ -232,6 +232,36 @@ _DEFAULTS: Dict[str, Any] = {
     # are freed on completion and reused with no recompile.
     "FLAGS_serving_kv_page_len": 16,
     "FLAGS_serving_kv_pages": 0,
+    # per-tenant SLO objectives (serving.slo):
+    # "tenantA:p99_ms=250,avail=99.9;tenantB:avail=99;*:p99_ms=500" —
+    # p99_ms is the latency objective (a slower completed request is a
+    # bad event), avail the good-fraction objective in percent (default
+    # 99.0 when only p99_ms is given; failed requests are always bad).
+    # Empty (default) disables the whole SLO plane.  Parse errors reject
+    # at set_flags.
+    "FLAGS_serving_slo": "",
+    # multi-window burn-rate evaluation: trailing window lengths and the
+    # breach threshold.  burn = bad_fraction / (1 - avail/100); a tenant
+    # breaches when burn >= threshold on BOTH windows and recovers when
+    # the fast-window burn falls under threshold/2 (hysteresis).
+    "FLAGS_serving_slo_fast_window_s": 60.0,
+    "FLAGS_serving_slo_slow_window_s": 600.0,
+    "FLAGS_serving_slo_burn_threshold": 10.0,
+    # evaluator cadence of the server's SLO thread
+    "FLAGS_serving_slo_eval_interval_s": 1.0,
+    # shed-on-burn: while a tenant is in breach, reject its NEW submits
+    # at admission (reason="slo_shed") instead of queueing work that
+    # will miss its objective anyway.  Off by default: shedding is a
+    # policy decision (it trades availability burn for latency burn).
+    "FLAGS_serving_slo_shed": False,
+    # live scrape surface (serving.httpd): /metrics (Prometheus text),
+    # /healthz (drain-aware), /statusz (JSON) on this port.  0 (default)
+    # disables; serve_until_terminated starts it automatically when set.
+    "FLAGS_metrics_port": 0,
+    # bind address of the scrape endpoint.  The default exposes it to
+    # the fleet (scrapers/balancers are off-box); set 127.0.0.1 to keep
+    # it loopback-only.  Only consulted when the port is enabled.
+    "FLAGS_metrics_host": "0.0.0.0",
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -338,11 +368,34 @@ def set_flags(flags: Dict[str, Any]):
             # stored while silently never injecting
             from . import resilience
             resilience.parse_fault_inject(coerced[name])
+        if name == "FLAGS_serving_slo" and coerced[name]:
+            # same validate-before-apply treatment: a typo'd SLO spec
+            # must not be stored to fail later at server construction
+            from .serving.slo import parse_slo
+            parse_slo(coerced[name])
         if name == "FLAGS_watchdog_escalate" and \
                 coerced[name] not in ("", "abort"):
             raise ValueError(
                 f"FLAGS_watchdog_escalate must be '' or 'abort', got "
                 f"{coerced[name]!r}")
+    slo_numeric = ("FLAGS_serving_slo_fast_window_s",
+                   "FLAGS_serving_slo_slow_window_s",
+                   "FLAGS_serving_slo_burn_threshold")
+    if any(n in coerced for n in slo_numeric):
+        # validate the EFFECTIVE window pair/threshold (new values merged
+        # over current) so an inconsistent pair is refused here, not at
+        # server construction deep inside a deployment's startup
+        eff = {n: float(coerced.get(n, _values[n])) for n in slo_numeric}
+        fast = eff["FLAGS_serving_slo_fast_window_s"]
+        slow = eff["FLAGS_serving_slo_slow_window_s"]
+        if not 0 < fast <= slow:
+            raise ValueError(
+                "SLO windows must satisfy 0 < fast <= slow (got "
+                f"fast={fast}, slow={slow})")
+        if eff["FLAGS_serving_slo_burn_threshold"] <= 0:
+            raise ValueError(
+                "FLAGS_serving_slo_burn_threshold must be > 0 (got "
+                f"{eff['FLAGS_serving_slo_burn_threshold']})")
     for name, value in coerced.items():
         _values[name] = value
         _apply_side_effects(name, value)

@@ -243,6 +243,20 @@ _EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
 #: back to exactly the monitor.py spans it overlapped
 _GLOBAL_STEPS = itertools.count(1)
 
+#: the most recently ISSUED step id (0 before the first dispatch).  A
+#: plain int store under the GIL; readers (the serving scheduler
+#: stamping its serving.dispatch span so a request trace joins the
+#: device trace) get *a* recent step id — with concurrent executors
+#: that is exactly the precision a correlation hint can honestly offer.
+_LAST_STEP_ID = 0
+
+
+def last_step_id() -> int:
+    """Process-global id of the most recently dispatched step (the same
+    id on the executor.dispatch span and the StepTraceAnnotation)."""
+    return _LAST_STEP_ID
+
+
 _device_peak_cache: List[float] = []
 
 
@@ -1392,6 +1406,8 @@ class Executor:
                 except Exception:
                     cb._xla_cost = None
         step_id = next(_GLOBAL_STEPS)
+        global _LAST_STEP_ID
+        _LAST_STEP_ID = step_id
         try:
             # watchdog: a dispatch (incl. a first-call compile) exceeding
             # FLAGS_watchdog_timeout_s becomes a HungStepError with a

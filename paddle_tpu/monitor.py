@@ -428,6 +428,23 @@ GANG_RANK_INFLIGHT = REGISTRY.gauge(
     "paddle_tpu_gang_rank_inflight",
     "per-rank executor in-flight step depth from the heartbeat digest",
     ("rank",))
+GANG_RANK_SRVQ = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_serving_queue_depth",
+    "per-rank serving queue depth (queued + in-flight requests across "
+    "tenants) from the heartbeat digest — the primary least-loaded "
+    "routing signal for a serving fleet", ("rank",))
+GANG_RANK_OCC = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_batch_occupancy",
+    "per-rank most-recent dispatched-batch occupancy (real requests per "
+    "batch) from the heartbeat digest", ("rank",))
+GANG_RANK_FREE_SLOTS = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_free_decode_slots",
+    "per-rank free KV decode slots from the heartbeat digest (0 = the "
+    "replica's decode batch is full)", ("rank",))
+GANG_RANK_TPS = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_tokens_per_s",
+    "per-rank decode throughput (generated tokens/s, windowed) from the "
+    "heartbeat digest", ("rank",))
 GANG_DIGEST_CTR = REGISTRY.counter(
     "paddle_tpu_gang_digests_total",
     "heartbeat metrics digests accepted by the coordinator, per rank",
@@ -501,13 +518,33 @@ def metrics_digest() -> Dict[str, Any]:
             len(e._inflight) for e in list(_EXECUTORS)))
     except Exception:
         pass
+    # serving load (this PR): the per-replica signals the fleet
+    # router/autoscaler consumes — queue depth across tenants, the last
+    # dispatched batch's occupancy, free decode slots, and decode
+    # tokens/s.  Presence-gated on the series actually existing, so a
+    # pure training rank's digest carries none of them.
+    sq = REGISTRY.get("paddle_tpu_serving_queue_depth")
+    if sq is not None:
+        vals = [cell.get() for labels, cell in sq.series()
+                if labels.get("tenant") != "retired"]
+        if vals:
+            digest["srv_q"] = float(sum(vals))
+    for key, fam_name in (("occ", "paddle_tpu_serving_last_batch_occupancy"),
+                          ("slots", "paddle_tpu_serving_free_decode_slots"),
+                          ("tps", "paddle_tpu_serving_tokens_per_s")):
+        fam = REGISTRY.get(fam_name)
+        if fam is not None:
+            cells = [cell.get() for _, cell in fam.series()]
+            if cells:
+                digest[key] = round(float(cells[-1]), 3)
     return digest
 
 
 #: digest keys the gang skew/straggler plane reads, most important
 #: first — capped_digest sheds from the BOTTOM of this list, and sheds
 #: keys not on it before any that are
-_DIGEST_PRIORITY = ("step_ms", "mfu", "queue", "inflight", "steps")
+_DIGEST_PRIORITY = ("step_ms", "mfu", "srv_q", "queue", "inflight",
+                    "occ", "slots", "tps", "steps")
 
 
 def capped_digest(digest: Dict[str, Any],
@@ -562,6 +599,57 @@ SERVING_LAT_HIST = REGISTRY.histogram(
     buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
              1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 120000.0))
 
+# -- request-path tracing + SLO plane (this PR): the serving pipeline's
+# per-phase latency decomposition and the per-tenant burn-rate gauges.
+# Declared here (like the families above) so retire_tenant_series can
+# fold tenant churn and metrics_digest can read the load gauges.
+
+SERVING_PHASE_HIST = REGISTRY.histogram(
+    "paddle_tpu_serving_phase_ms",
+    "per-request phase latency (ms) of the serving pipeline by phase "
+    "(admit / queue_wait / batch_wait / dispatch / decode / "
+    "materialize), tenant and bucket (bucket='decode' for the KV decode "
+    "loop) — phases partition submit->resolve, so their sum is the "
+    "request's end-to-end latency and p99 decomposes by phase",
+    ("phase", "tenant", "bucket"),
+    buckets=(0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+             500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0))
+SERVING_LAST_OCC_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_serving_last_batch_occupancy",
+    "occupancy (real requests) of the most recently dispatched serving "
+    "batch / decode iteration — the instantaneous load form of the "
+    "paddle_tpu_serving_batch_occupancy histogram, carried in the gang "
+    "heartbeat digest as 'occ'")
+SERVING_FREE_SLOTS_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_serving_free_decode_slots",
+    "KV decode slots currently unoccupied (digest key 'slots'; 0 = the "
+    "decode batch is full and new requests queue)")
+SERVING_TPS_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_serving_tokens_per_s",
+    "decode throughput: generated tokens per second over a short "
+    "trailing window (digest key 'tps')")
+SERVING_TOKENS_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_generated_tokens_total",
+    "tokens generated by the decode loop (prefill consumption excluded)")
+
+SLO_BURN_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_slo_burn_rate",
+    "per-tenant SLO error-budget burn rate, by window ('fast' / "
+    "'slow'): (bad-event fraction in the window) / (1 - objective) — "
+    "1.0 means the budget is consumed exactly at the rate the SLO "
+    "allows, a sustained burn above the threshold on BOTH windows is a "
+    "breach", ("tenant", "window"))
+SLO_BREACHED_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_slo_breached",
+    "1 while the tenant's SLO is in breach (multi-window burn rate over "
+    "threshold; clears with hysteresis at threshold/2 on the fast "
+    "window)", ("tenant",))
+SLO_BREACH_CTR = REGISTRY.counter(
+    "paddle_tpu_slo_breach_total",
+    "SLO breach EVENTS per tenant (each breach->recovery cycle counts "
+    "once; the instant is also recorded in the trace ring as "
+    "'slo.breach')", ("tenant",))
+
 
 def retire_tenant_series(tenant) -> None:
     """Registry hygiene for tenant eviction (PR-2 retirement semantics):
@@ -580,7 +668,17 @@ def retire_tenant_series(tenant) -> None:
             SERVING_REJECT_CTR.fold(
                 labels, {"tenant": "retired",
                          "reason": labels.get("reason", "")})
+    for labels, _cell in SERVING_PHASE_HIST.series():
+        if labels.get("tenant") == str(tenant):
+            SERVING_PHASE_HIST.fold(labels, dict(labels, tenant="retired"))
     SERVING_QUEUE_GAUGE.fold(src, None)
+    # SLO series: the breach-event counter folds (totals stay exact);
+    # the burn/breached gauges drop — a departed tenant has no burn
+    SLO_BREACH_CTR.fold(src, dst)
+    SLO_BREACHED_GAUGE.fold(src, None)
+    for labels, _cell in SLO_BURN_GAUGE.series():
+        if labels.get("tenant") == str(tenant):
+            SLO_BURN_GAUGE.fold(labels, None)
 
 
 def retire_gang_rank_series(rank) -> None:
@@ -592,7 +690,8 @@ def retire_gang_rank_series(rank) -> None:
     src = {"rank": str(rank)}
     GANG_DIGEST_CTR.fold(src, {"rank": "retired"})
     for g in (GANG_RANK_STEP_MS, GANG_RANK_MFU, GANG_RANK_QUEUE,
-              GANG_RANK_INFLIGHT):
+              GANG_RANK_INFLIGHT, GANG_RANK_SRVQ, GANG_RANK_OCC,
+              GANG_RANK_FREE_SLOTS, GANG_RANK_TPS):
         g.fold(src, None)
 
 

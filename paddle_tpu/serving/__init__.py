@@ -19,12 +19,25 @@ latency-governed multi-tenant request path:
   iterations with zero recompiles.
 - :mod:`server` — the tenant plane (quotas, per-tenant telemetry with
   retirement) and SIGTERM graceful drain.
+- :mod:`slo` — per-tenant objectives (``FLAGS_serving_slo``) evaluated
+  with fast/slow multi-window burn-rate math; breaches are trace
+  instants, gauges, and (optionally) an admission shed signal.
+- :mod:`httpd` — the live scrape surface: ``/metrics`` ``/healthz``
+  ``/statusz`` on ``FLAGS_metrics_port``.
+
+Every request carries a trace id from admission through queueing,
+batch coalescing, dispatch (correlated with the executor's process-
+global step id), and fetch materialization — the phase spans partition
+submit→resolve, so ``tools/latency_report.py`` decomposes p99 by phase
+per tenant and bucket from the exported trace ring.
 """
 
 from .bucketing import BucketPlan, bucket_for, pad_to_bucket, parse_buckets  # noqa
+from .httpd import MetricsHTTPServer  # noqa
 from .kv_cache import (DecodeEngine, GPTDecodeModel, PagedKVCache,  # noqa
                        params_from_scope)
 from .scheduler import (ContinuousBatcher, DecodeScheduler, Request,  # noqa
                         ServingFuture)
 from .server import (AdmissionError, DecodeServer, InferenceServer,  # noqa
                      TenantPlane)
+from .slo import BurnRateEvaluator, SLOTarget, parse_slo  # noqa

@@ -156,5 +156,12 @@ class BucketPlan:
             width //= 2
         return width
 
+    def width_of(self, bucket: int) -> Optional[int]:
+        """Admitted width of an ALREADY-BUILT bucket plan; None for a
+        cold bucket (statusz must never trigger a build/compile)."""
+        with self._mu:
+            entry = self._plans.get(int(bucket))
+        return entry[3] if entry is not None else None
+
     def bucket_for(self, seq_len: int) -> Optional[int]:
         return bucket_for(seq_len, self.buckets)

@@ -30,6 +30,7 @@ batch's requests fail — counted in
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -37,12 +38,16 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import monitor as _monitor
+from ..framework.executor import last_step_id
 from .bucketing import PAD_TOKENS_CTR
 
 OCCUPANCY_HIST = _monitor.REGISTRY.histogram(
     "paddle_tpu_serving_batch_occupancy",
     "real requests per dispatched batch/decode iteration (mean > 1 == "
-    "continuous batching is actually coalescing)",
+    "continuous batching is actually coalescing), by mode: 'batch' for "
+    "the coalescing batcher, 'decode' for the KV decode loop — a "
+    "process running both must not blend them in per-server views",
+    labelnames=("mode",),
     buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 64.0))
 BATCHES_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_serving_batches_total",
@@ -52,6 +57,43 @@ FAULTS_ABSORBED_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_serving_faults_absorbed_total",
     "transient dispatch faults absorbed by a batch re-dispatch "
     "(requests completed anyway)")
+
+#: per-process request trace ids: every admitted request gets one, and
+#: every phase span of its lifetime carries it — `trace` in the span
+#: args groups the chain admission->materialize in the exported ring
+_TRACE_IDS = itertools.count(1)
+
+
+def _emit_request_trace(req: "Request", phases, e2e_ms: float,
+                        bucket=None, extra=None) -> None:
+    """Emit the request's phase spans (each tagged with its trace id,
+    tenant, and bucket) into the tracer ring and the per-phase latency
+    histograms.  ``phases`` is an ordered list of (name, t0, t1)
+    perf_counter boundaries that PARTITION submit->resolve, so the
+    per-phase sum reconstructs the measured end-to-end latency (the
+    serving_smoke 10% gate).  ``extra`` maps phase name -> extra span
+    args (the dispatch phase carries the process-global step id, batch
+    width/occupancy, and the padding overhead)."""
+    bucket = str(req.bucket if bucket is None else bucket)
+    tenant = str(req.tenant)
+    tracer = _monitor.TRACER
+    for name, t0, t1 in phases:
+        if t0 is None or t1 is None or t1 < t0:
+            continue
+        _monitor.SERVING_PHASE_HIST.observe(
+            (t1 - t0) * 1e3, phase=name, tenant=tenant, bucket=bucket)
+        if tracer.enabled:
+            args = {"trace": req.trace_id, "tenant": tenant,
+                    "bucket": bucket}
+            if name == "materialize":
+                # the request's measured e2e rides the LAST span of the
+                # chain, so an offline reader can check the phase sum
+                # against it without any out-of-band ledger
+                args["e2e_ms"] = round(e2e_ms, 3)
+            if extra and name in extra:
+                args.update(extra[name])
+            tracer.add_complete("serving." + name, "serving", t0, t1,
+                                args)
 
 
 class ServingFuture:
@@ -86,7 +128,7 @@ class Request:
 
     __slots__ = ("tenant", "feeds", "seq_len", "bucket", "future",
                  "t_submit", "prompt", "max_new_tokens", "eos_id",
-                 "admit_gen")
+                 "admit_gen", "trace_id", "tm")
 
     def __init__(self, tenant: str, feeds: Optional[Dict[str, Any]] = None,
                  seq_len: int = 0, bucket: int = 0,
@@ -102,6 +144,12 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.admit_gen = 0   # tenant incarnation at admission (server)
+        self.trace_id = next(_TRACE_IDS)
+        # phase boundary marks (perf_counter): written strictly along
+        # the request's pipeline handoffs (submit thread -> scheduler
+        # thread -> completion thread), each handoff through a lock, so
+        # readers always see the marks of the phases that finished
+        self.tm: Dict[str, float] = {"submit": self.t_submit}
 
 
 class ContinuousBatcher:
@@ -150,6 +198,7 @@ class ContinuousBatcher:
         with self._cv:
             if self._stop:
                 return False
+            req.tm["enq"] = time.perf_counter()
             self._queue.append(req)
             self._pending += 1
             self._cv.notify()
@@ -211,10 +260,12 @@ class ContinuousBatcher:
         out: List[Request] = []
         if n <= 0:
             return out
+        now = time.perf_counter()
         keep: collections.deque = collections.deque()
         while self._queue:
             r = self._queue.popleft()
             if r.bucket == bucket and len(out) < n:
+                r.tm["pop"] = now        # queue_wait ends here
                 out.append(r)
             else:
                 keep.append(r)
@@ -254,13 +305,22 @@ class ContinuousBatcher:
                 self._fail_batch(batch, e)
                 continue
             PAD_TOKENS_CTR.inc(width - len(batch))
+            t_d0 = time.perf_counter()
             handles = self._dispatch(compiled, feed, fetch_names, batch)
+            t_d1 = time.perf_counter()
             BATCHES_CTR.inc(1, bucket=str(bucket))
-            OCCUPANCY_HIST.observe(float(len(batch)))
+            OCCUPANCY_HIST.observe(float(len(batch)), mode="batch")
+            _monitor.SERVING_LAST_OCC_GAUGE.set(float(len(batch)))
             if handles is None:
                 continue                     # batch failed; futures done
+            # correlation hint: the step id the executor just stamped on
+            # its executor.dispatch span + StepTraceAnnotation — this
+            # scheduler thread dispatched it, so reading it here (before
+            # any other run() of ours) names OUR step
+            meta = {"t_d0": t_d0, "t_d1": t_d1, "step": last_step_id(),
+                    "width": width, "occupancy": len(batch)}
             with self._done_cv:
-                self._done_q.append((batch, handles, bucket))
+                self._done_q.append((batch, handles, bucket, meta))
                 self._done_cv.notify()
 
     @staticmethod
@@ -326,7 +386,7 @@ class ContinuousBatcher:
                     if self._done_stop:
                         return
                     self._done_cv.wait(0.1)
-                batch, handles, bucket = self._done_q.popleft()
+                batch, handles, bucket, meta = self._done_q.popleft()
             try:
                 # materialize AND slice before resolving anything: a
                 # failure here (async device error, unexpected fetch
@@ -347,15 +407,28 @@ class ContinuousBatcher:
                 self._fail_batch(batch, e)
                 continue
             now = time.perf_counter()
+            pad = meta["width"] - meta["occupancy"]
+            dispatch_args = {
+                "step": meta["step"], "width": meta["width"],
+                "occupancy": meta["occupancy"], "pad_rows": pad,
+                "pad_frac": round(pad / float(meta["width"]), 4)}
             for r, result in zip(batch, results):
-                self._on_complete(r, result, (now - r.t_submit) * 1e3)
+                e2e_ms = (now - r.t_submit) * 1e3
+                _emit_request_trace(r, (
+                    ("admit", r.tm.get("submit"), r.tm.get("enq")),
+                    ("queue_wait", r.tm.get("enq"), r.tm.get("pop")),
+                    ("batch_wait", r.tm.get("pop"), meta["t_d0"]),
+                    ("dispatch", meta["t_d0"], meta["t_d1"]),
+                    ("materialize", meta["t_d1"], now),
+                ), e2e_ms, extra={"dispatch": dispatch_args})
+                self._on_complete(r, result, e2e_ms)
             with self._cv:
                 self._pending -= len(batch)
                 self._cv.notify_all()
 
 
 class _SlotState:
-    __slots__ = ("req", "tokens", "pos", "generated")
+    __slots__ = ("req", "tokens", "pos", "generated", "iters")
 
     def __init__(self, req: Request):
         self.req = req
@@ -363,6 +436,7 @@ class _SlotState:
             req.prompt).ravel()]
         self.pos = 0
         self.generated: List[int] = []
+        self.iters = 0          # decode iterations this request rode
 
 
 class DecodeScheduler:
@@ -387,6 +461,10 @@ class DecodeScheduler:
         self._slots: List[Optional[_SlotState]] = \
             [None] * engine.max_slots
         self._thread: Optional[threading.Thread] = None
+        self._iter = 0                 # decode-loop iterations (loop thread only)
+        #: trailing (t, n_generated) window for the tokens/s gauge —
+        #: touched only by the decode thread
+        self._tok_win: collections.deque = collections.deque()
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -404,6 +482,7 @@ class DecodeScheduler:
         with self._cv:
             if self._stop:
                 return False
+            req.tm["enq"] = time.perf_counter()
             self._queue.append(req)
             self._pending += 1
             self._cv.notify()
@@ -438,6 +517,7 @@ class DecodeScheduler:
             if not self._engine.reserve_slot(s, max(1, need)):
                 break               # pool exhausted: wait for completions
             self._queue.popleft()
+            req.tm["slot"] = time.perf_counter()   # queue_wait ends
             self._slots[s] = _SlotState(req)
 
     def _loop(self) -> None:
@@ -471,26 +551,40 @@ class DecodeScheduler:
             if not stepped:
                 time.sleep(0.001)
                 continue
+            _monitor.SERVING_FREE_SLOTS_GAUGE.set(
+                float(S - len(active_slots)))
+            self._iter += 1
+            t_i0 = time.perf_counter()
             logits = self._run_step(ids, pos, active, stepped)
+            t_i1 = time.perf_counter()
+            if _monitor.TRACER.enabled:
+                _monitor.TRACER.add_complete(
+                    "serving.decode_iter", "serving", t_i0, t_i1,
+                    {"iter": self._iter, "occupancy": len(stepped)})
             if logits is None:
                 continue
             BATCHES_CTR.inc(1, bucket="decode")
-            OCCUPANCY_HIST.observe(float(len(stepped)))
+            OCCUPANCY_HIST.observe(float(len(stepped)), mode="decode")
+            _monitor.SERVING_LAST_OCC_GAUGE.set(float(len(stepped)))
             now = time.perf_counter()
+            n_gen = 0
             for s in stepped:
                 st = self._slots[s]
                 st.pos += 1
+                st.iters += 1
                 if st.pos < len(st.tokens):
                     continue                   # prefill: next prompt token
                 nxt = int(np.argmax(logits[s]))
                 st.tokens.append(nxt)
                 st.generated.append(nxt)
+                n_gen += 1
                 done = (len(st.generated) >= st.req.max_new_tokens
                         or (st.req.eos_id is not None
                             and nxt == st.req.eos_id)
                         or st.pos + 1 >= eng.max_seq)
                 if done:
                     self._retire(s, st, now)
+            self._update_token_rate(now, n_gen)
 
     def _run_step(self, ids, pos, active, stepped):
         from .. import resilience as _resil
@@ -528,11 +622,41 @@ class DecodeScheduler:
                     self._cv.notify_all()
                 return None
 
+    def _update_token_rate(self, now: float, n_gen: int,
+                           window_s: float = 5.0) -> None:
+        """Windowed generated-tokens/s into the gauge the heartbeat
+        digest ships as ``tps`` (decode thread only — no lock)."""
+        if n_gen:
+            _monitor.SERVING_TOKENS_CTR.inc(n_gen)
+        win = self._tok_win
+        win.append((now, n_gen))
+        while win and now - win[0][0] > window_s:
+            win.popleft()
+        # a lone sample after an idle gap carries no rate information:
+        # floor its span at 1 s so the first token back doesn't publish
+        # a phantom 1000 tok/s spike into the routing digest
+        span = max(now - win[0][0], 1.0 if len(win) == 1 else 1e-3)
+        _monitor.SERVING_TPS_GAUGE.set(
+            round(sum(n for _, n in win) / span, 3))
+
     def _retire(self, s, st, now) -> None:
         self._engine.release_slot(s)
         self._slots[s] = None
-        self._on_complete(st.req, np.asarray(st.generated, np.int32),
-                          (now - st.req.t_submit) * 1e3)
+        out = np.asarray(st.generated, np.int32)
+        done_t = time.perf_counter()
+        e2e_ms = (done_t - st.req.t_submit) * 1e3
+        tm = st.req.tm
+        _emit_request_trace(st.req, (
+            ("admit", tm.get("submit"), tm.get("enq")),
+            ("queue_wait", tm.get("enq"), tm.get("slot")),
+            ("decode", tm.get("slot"), now),
+            ("materialize", now, done_t),
+        ), e2e_ms, bucket="decode",
+            extra={"decode": {"iters": st.iters,
+                              "generated": len(st.generated)}})
+        _monitor.SERVING_FREE_SLOTS_GAUGE.set(float(sum(
+            1 for x in self._slots if x is None)))
+        self._on_complete(st.req, out, e2e_ms)
         with self._cv:
             self._pending -= 1
             self._cv.notify_all()

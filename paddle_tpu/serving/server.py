@@ -20,6 +20,7 @@ from __future__ import annotations
 import signal
 import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -33,8 +34,9 @@ from .scheduler import (ContinuousBatcher, DecodeScheduler, Request,
 class TenantPlane:
     """Per-tenant admission + telemetry bookkeeping."""
 
-    def __init__(self, default_quota: int = 0):
+    def __init__(self, default_quota: int = 0, on_evict=None):
         self._mu = threading.Lock()
+        self._on_evict = on_evict    # eviction hook (e.g. slo.forget)
         self._outstanding: Dict[str, int] = {}  # guarded-by: _mu
         self._quotas: Dict[str, int] = {}  # guarded-by: _mu
         self._evicted: set = set()  # guarded-by: _mu
@@ -48,6 +50,16 @@ class TenantPlane:
     def generation(self, tenant: str) -> int:
         with self._mu:
             return self._gen.get(str(tenant), 0)
+
+    def is_current(self, tenant: str, gen: Optional[int]) -> bool:
+        """True when the request's admission incarnation is still live:
+        tenant not evicted and (when the request carries one) its
+        admission generation matches the current incarnation."""
+        tenant = str(tenant)
+        with self._mu:
+            if tenant in self._evicted:
+                return False
+            return gen is None or gen == self._gen.get(tenant, 0)
 
     def set_quota(self, tenant: str, quota: int) -> None:
         with self._mu:
@@ -107,6 +119,13 @@ class TenantPlane:
                 tenant = "retired"
         _monitor.SERVING_REJECT_CTR.inc(1, tenant=tenant, reason=reason)
 
+    def snapshot(self) -> Dict[str, int]:
+        """Per-tenant outstanding (queued + in-flight) counts — the
+        ``/statusz`` queue-depth view."""
+        with self._mu:
+            return {t: n for t, n in self._outstanding.items()
+                    if t not in self._evicted}
+
     def evict(self, tenant: str) -> None:
         """Drop the tenant and retire its registry series (PR-2 fold
         semantics: counters fold into tenant="retired", totals exact).
@@ -119,6 +138,8 @@ class TenantPlane:
             self._evicted.add(tenant)
             self._gen[tenant] = self._gen.get(tenant, 0) + 1
         _monitor.retire_tenant_series(tenant)
+        if self._on_evict is not None:
+            self._on_evict(tenant)
 
     def outstanding(self, tenant: str) -> int:
         with self._mu:
@@ -131,42 +152,101 @@ class _ServerBase:
     def __init__(self, tenant_quota: Optional[int] = None,
                  max_retries: Optional[int] = None):
         from ..flags import get_flags
+        from .slo import BurnRateEvaluator
         fl = get_flags(["FLAGS_serving_tenant_quota",
-                        "FLAGS_serving_max_retries"])
+                        "FLAGS_serving_max_retries",
+                        "FLAGS_serving_slo_shed",
+                        "FLAGS_serving_slo_eval_interval_s"])
         quota = fl["FLAGS_serving_tenant_quota"] \
             if tenant_quota is None else tenant_quota
-        self.tenants = TenantPlane(int(quota))
+        self.tenants = TenantPlane(int(quota), on_evict=self._forget_slo)
         self._max_retries = int(fl["FLAGS_serving_max_retries"]
                                 if max_retries is None else max_retries)
         self._draining = threading.Event()
         self._started = False
         self._old_handlers: Dict[int, Any] = {}
         self._sched = None       # set by the subclass
+        #: per-tenant burn-rate state machine; None = SLO plane off
+        self.slo = BurnRateEvaluator.from_flags()
+        self._slo_shed = bool(fl["FLAGS_serving_slo_shed"])
+        self._slo_interval = float(
+            fl["FLAGS_serving_slo_eval_interval_s"])
+        self._slo_stop = threading.Event()
+        self._slo_thread: Optional[threading.Thread] = None
+        self._slo_eval_warned = False
+        self._http = None        # MetricsHTTPServer (enable_http)
+
+    def _forget_slo(self, tenant: str) -> None:
+        """Tenant-eviction hook: the evaluator must stop tracking the
+        tenant or its next tick re-mints the SLO gauge series that
+        ``retire_tenant_series`` just dropped."""
+        if self.slo is not None:
+            self.slo.forget(tenant)
+
+    def _slo_eval_safe(self) -> None:
+        """One evaluator tick.  The loop must outlive evaluator bugs,
+        but not silently — a dead SLO plane showing breach-free gauges
+        during an outage is worse than a crash, so the first failure
+        warns with the error."""
+        try:
+            self.slo.evaluate()
+        except Exception as e:
+            if not self._slo_eval_warned:
+                self._slo_eval_warned = True
+                warnings.warn(
+                    "serving SLO evaluator failed — burn/breach gauges "
+                    f"are stale until it recovers: {e!r}")
 
     # -- admission -----------------------------------------------------------
-    def _admit(self, tenant: str) -> bool:
+    def _admit(self, tenant: str) -> Optional[str]:
+        """None = admitted (one outstanding unit reserved); otherwise
+        the rejection reason (already counted per tenant)."""
         if self._draining.is_set():
             self.tenants.reject(tenant, "draining")
-            return False
+            return "draining"
+        if (self._slo_shed and self.slo is not None
+                and self.slo.in_breach(tenant)):
+            # shed-on-burn: while the tenant's SLO is in breach, new
+            # work would only deepen the burn — refuse it at the door
+            self.tenants.reject(tenant, "slo_shed")
+            return "slo_shed"
         if not self.tenants.try_admit(tenant):
             self.tenants.reject(tenant, "quota")
-            return False
-        return True
+            return "quota"
+        return None
 
     def _on_complete(self, req: Request, result, latency_ms: float):
         req.future._resolve(result)
         self.tenants.complete(req.tenant, latency_ms, gen=req.admit_gen)
+        # stale-generation guard mirrors TenantPlane._account: an
+        # in-flight request resolving AFTER its tenant's eviction must
+        # not un-forget the tenant and resurrect its retired SLO series
+        if self.slo is not None \
+                and self.tenants.is_current(req.tenant, req.admit_gen):
+            self.slo.record(req.tenant, ok=True, latency_ms=latency_ms)
 
     def _on_fail(self, req: Request, err: BaseException):
         req.future._fail(err)
         self.tenants.fail(req.tenant, gen=req.admit_gen)
+        if self.slo is not None \
+                and self.tenants.is_current(req.tenant, req.admit_gen):
+            self.slo.record(req.tenant, ok=False)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         if not self._started:
             self._sched.start()
             self._started = True
+        if self.slo is not None and self._slo_thread is None:
+            self._slo_stop.clear()
+            self._slo_thread = threading.Thread(
+                target=self._slo_loop, name="serving-slo", daemon=True)
+            self._slo_thread.start()
         return self
+
+    def _slo_loop(self) -> None:
+        while not self._slo_stop.wait(self._slo_interval):
+            self._slo_eval_safe()
 
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Stop admitting and block until every in-flight request has
@@ -177,9 +257,57 @@ class _ServerBase:
     def stop(self) -> None:
         self._draining.set()
         self._sched.stop()
+        self._slo_stop.set()
+        if self._slo_thread is not None:
+            self._slo_thread.join(timeout=2.0)
+            self._slo_thread = None      # start() can relaunch it
+        if self.slo is not None:
+            self._slo_eval_safe()          # final state for the export
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
 
     def queue_depth(self) -> int:
         return self._sched.queue_depth()
+
+    # -- live scrape surface -------------------------------------------------
+    def _health(self):
+        draining = self._draining.is_set()
+        return (not draining, "draining" if draining else "ok")
+
+    def statusz(self) -> Dict[str, Any]:
+        """Operational snapshot for ``/statusz`` (subclasses extend)."""
+        return {"draining": self._draining.is_set(),
+                "queue_depth": self.queue_depth(),
+                "tenants": self.tenants.snapshot(),
+                "slo": self.slo.state() if self.slo is not None else None}
+
+    def enable_http(self, port: Optional[int] = None,
+                    host: Optional[str] = None):
+        """Start the /metrics /healthz /statusz endpoint for this
+        server (idempotent).  ``port=None`` reads FLAGS_metrics_port —
+        whose 0 default means DISABLED, so the call returns None rather
+        than opening an unconfigured fleet-facing socket.  An explicit
+        ``port=0`` argument binds an ephemeral port (read ``.port``).
+        ``host=None`` reads FLAGS_metrics_host (default 0.0.0.0: the
+        endpoint is fleet-facing — scrapers and balancers are
+        off-box)."""
+        if self._http is not None:
+            return self._http
+        from ..flags import get_flags
+        if port is None:
+            port = int(get_flags("FLAGS_metrics_port")
+                       ["FLAGS_metrics_port"])
+            if port <= 0:
+                return None
+        if host is None:
+            host = str(get_flags("FLAGS_metrics_host")
+                       ["FLAGS_metrics_host"])
+        from .httpd import MetricsHTTPServer
+        self._http = MetricsHTTPServer(
+            port=int(port), host=host, health_fn=self._health,
+            status_fn=self.statusz).start()
+        return self._http
 
     # -- SIGTERM graceful drain (PreemptionGuard pattern) --------------------
     def install_signal_handlers(
@@ -196,8 +324,15 @@ class _ServerBase:
                                drain_timeout_s: float = 60.0) -> int:
         """Block until SIGTERM/SIGINT, then drain and return the exit
         code (0 = zero dropped in-flight requests).  Exports telemetry
-        when ``FLAGS_telemetry_export_path`` is set (at-exit hook)."""
+        when ``FLAGS_telemetry_export_path`` is set (at-exit hook);
+        exposes the live scrape endpoint when ``FLAGS_metrics_port`` is
+        set (``/healthz`` flips to 503 the moment draining starts, so a
+        balancer can eject the replica before the drain finishes)."""
         self.install_signal_handlers()
+        from ..flags import get_flags
+        if self._http is None and int(
+                get_flags("FLAGS_metrics_port")["FLAGS_metrics_port"]) > 0:
+            self.enable_http()
         try:
             while not self._draining.is_set():
                 time.sleep(poll_s)
@@ -289,6 +424,7 @@ class InferenceServer(_ServerBase):
         ``seq_len`` overrides the TRIM length of the fetches; the bucket
         is always chosen to fit every feed (a caller-understated length
         must not smuggle an oversize array past padding)."""
+        t0 = time.perf_counter()
         feeds = {k: np.asarray(v) for k, v in feeds.items()}
         longest = max((a.shape[0] for a in feeds.values() if a.ndim),
                       default=0)
@@ -301,13 +437,18 @@ class InferenceServer(_ServerBase):
                 f"request length {max(n, longest)} exceeds the largest "
                 f"bucket {self.buckets[-1]}"))
             return f
-        if not self._admit(tenant):
+        reason = self._admit(tenant)
+        if reason is not None:
             f = ServingFuture()
             f._fail(AdmissionError(
-                f"tenant {tenant!r} rejected "
-                f"({'draining' if self._draining.is_set() else 'quota'})"))
+                f"tenant {tenant!r} rejected ({reason})"))
             return f
         req = Request(tenant, feeds=feeds, seq_len=n, bucket=bucket)
+        # the admit phase starts at submit ENTRY (bucket choice + quota
+        # accounting belong to it), so the phase chain partitions the
+        # whole measured e2e latency
+        req.t_submit = t0
+        req.tm["submit"] = t0
         req.admit_gen = self.tenants.generation(tenant)
         if not self._sched.enqueue(req):
             # enqueue raced stop(): nothing will ever service the queue
@@ -319,6 +460,24 @@ class InferenceServer(_ServerBase):
         return {"traces": int(st["traces"]),
                 "compiled_blocks": int(st.get("compiled_blocks", 0)),
                 "buckets": len(self.buckets)}
+
+    def statusz(self) -> Dict[str, Any]:
+        out = super().statusz()
+        out["buckets"] = {str(b): self.plan.width_of(b)
+                         for b in self.buckets}
+        out["compile"] = self.compile_stats()
+        occ = _monitor.REGISTRY.get("paddle_tpu_serving_batch_occupancy")
+        if occ is not None:
+            tot_sum = tot_n = 0.0
+            for labels, cell in occ.series():
+                if labels.get("mode") != "batch":
+                    continue    # a coexisting decode loop's iterations
+                _counts, s, c = cell.snapshot()
+                tot_sum += s
+                tot_n += c
+            if tot_n:
+                out["mean_occupancy"] = round(tot_sum / tot_n, 3)
+        return out
 
 
 class DecodeServer(_ServerBase):
@@ -340,6 +499,7 @@ class DecodeServer(_ServerBase):
 
     def submit(self, tenant: str, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None) -> ServingFuture:
+        t0 = time.perf_counter()
         prompt = np.asarray(prompt).ravel()
         if prompt.size == 0:
             self.tenants.reject(tenant, "too_long")
@@ -354,14 +514,16 @@ class DecodeServer(_ServerBase):
                 f"exceeds the engine context window "
                 f"{self.engine.max_seq}"))
             return f
-        if not self._admit(tenant):
+        reason = self._admit(tenant)
+        if reason is not None:
             f = ServingFuture()
             f._fail(AdmissionError(
-                f"tenant {tenant!r} rejected "
-                f"({'draining' if self._draining.is_set() else 'quota'})"))
+                f"tenant {tenant!r} rejected ({reason})"))
             return f
         req = Request(tenant, prompt=prompt,
                       max_new_tokens=int(max_new_tokens), eos_id=eos_id)
+        req.t_submit = t0
+        req.tm["submit"] = t0
         req.admit_gen = self.tenants.generation(tenant)
         if not self._sched.enqueue(req):
             self._on_fail(req, AdmissionError("server stopped"))
@@ -370,6 +532,16 @@ class DecodeServer(_ServerBase):
     def compile_stats(self) -> Dict[str, int]:
         return {"traces": int(self.engine.trace_count),
                 "kv_pages_in_use": self.engine.cache.pages_in_use()}
+
+    def statusz(self) -> Dict[str, Any]:
+        out = super().statusz()
+        free = sum(1 for s in self._sched._slots if s is None)
+        out["slots"] = {"total": self.engine.max_slots, "free": free}
+        out["kv_pages_in_use"] = self.engine.cache.pages_in_use()
+        out["tokens_per_s"] = float(_monitor.SERVING_TPS_GAUGE.value()) \
+            if _monitor.REGISTRY.get(
+                "paddle_tpu_serving_tokens_per_s").series() else 0.0
+        return out
 
 
 class AdmissionError(RuntimeError):

@@ -882,6 +882,35 @@ def test_digest_rides_heartbeat_into_status_and_rank_series():
         coord.stop()
 
 
+def test_digest_key_disappearance_drops_rank_series():
+    # a serving gauge frozen at its last value reads as live load to a
+    # router doing least-loaded placement — when a live rank's digest
+    # stops carrying a key (server stopped, or shed under the byte
+    # cap), the coordinator must DROP that rank's series, not hold it
+    coord, (c0, c1) = _gang(timeout=30)
+    try:
+        c0.set_digest({"step_ms": 100.0, "tps": 55.0, "slots": 3})
+        assert _wait_for(
+            lambda: monitor.GANG_RANK_TPS.value(rank="0") == 55.0
+            and monitor.GANG_RANK_FREE_SLOTS.value(rank="0") == 3)
+        c0.set_digest({"step_ms": 100.0})   # serving stopped
+
+        def serving_series_gone():
+            tps = monitor.REGISTRY.get("paddle_tpu_gang_rank_tokens_per_s")
+            slots = monitor.REGISTRY.get(
+                "paddle_tpu_gang_rank_free_decode_slots")
+            return (not any(l.get("rank") == "0" for l, _ in tps.series())
+                    and not any(l.get("rank") == "0"
+                                for l, _ in slots.series()))
+        assert _wait_for(serving_series_gone)
+        # the training key the digest still carries stays published
+        assert monitor.GANG_RANK_STEP_MS.value(rank="0") == 100.0
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
 def test_gang_skew_and_straggler_gauge_math():
     coord, (c0, c1) = _gang(timeout=30)
     try:
@@ -1080,13 +1109,19 @@ def test_status_aggregates_match_gauges():
         c1.set_digest({"step_ms": 160.0})
         c0.set_progress(step=12)
         c1.set_progress(step=9)
-        assert _wait_for(
-            lambda: (c0.status().get("aggregates") or {})
-            .get("straggler") == 1)
+
+        # wait for the FULLY-converged state, not just the straggler
+        # flag: rank 1's digest alone already names it straggler while
+        # rank 0's step=12 beat may still be in flight under suite
+        # load — sampling at that instant reads a stale step skew
+        def _converged():
+            agg = c0.status().get("aggregates") or {}
+            return (agg.get("straggler") == 1
+                    and agg.get("step_skew") == 3
+                    and agg.get("straggler_step_ms") == 160.0
+                    and agg.get("step_time_skew_ms") == 60.0)
+        assert _wait_for(_converged, timeout=15.0)
         agg = c0.status()["aggregates"]
-        assert agg["step_skew"] == 3
-        assert agg["straggler_step_ms"] == 160.0
-        assert agg["step_time_skew_ms"] == 60.0
         assert monitor.GANG_STRAGGLER_GAUGE.value() == agg["straggler"]
         assert monitor.GANG_STEP_SKEW_GAUGE.value() == agg["step_skew"]
     finally:

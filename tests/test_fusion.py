@@ -363,6 +363,51 @@ def test_autotune_cache_hit_miss_counters(tmp_path):
                       "FLAGS_xla_compile_cache_dir": ""})
 
 
+def test_autotune_cache_migrates_backend_keys(tmp_path):
+    """Pre-device-kind caches keyed the device slot on the bare backend
+    name; loading one now re-keys entries of THIS backend onto the
+    ``device_kind x count`` key (a v4 verdict must not steer a v5e), a
+    one-shot migration persisted back to disk.  Foreign-backend entries
+    stay for their own process to migrate, and an existing new-style
+    entry is never clobbered by a migrated old one."""
+    import json as _json
+
+    import jax
+    backend = jax.default_backend()
+    foreign = "tpu" if backend != "tpu" else "gpu"
+    old_rec = {"base_ms": 1.0, "fused_ms": 0.5, "win": True}
+    new_rec = {"base_ms": 1.0, "fused_ms": 2.0, "win": False}
+    old_key = _json.dumps(["conv1x1_bn_relu", "sk", 4, backend, "f32"])
+    new_key = _json.dumps(["conv1x1_bn_relu", "sk", 4,
+                           fusion._device_key(), "f32"])
+    other_old = _json.dumps(["dense_act", "sk2", 8, backend, "amp"])
+    foreign_key = _json.dumps(["dense_act", "sk3", 8, foreign, "f32"])
+    (tmp_path / "fusion_autotune.json").write_text(_json.dumps({
+        old_key: old_rec,          # migrates
+        new_key: new_rec,          # already new-style: must WIN
+        other_old: old_rec,        # migrates (no new-style sibling)
+        foreign_key: old_rec,      # other backend: untouched
+    }))
+    pt.set_flags({"FLAGS_xla_compile_cache_dir": str(tmp_path)})
+    try:
+        fusion.clear_cache()
+        with fusion._AUTOTUNE_LOCK:
+            fusion._autotune_load_locked()
+            mem = dict(fusion._AUTOTUNE_MEM)
+        other_new = _json.dumps(["dense_act", "sk2", 8,
+                                 fusion._device_key(), "amp"])
+        assert mem[new_key] == new_rec            # not clobbered
+        assert mem[other_new] == old_rec          # re-keyed
+        assert old_key not in mem and other_old not in mem
+        assert mem[foreign_key] == old_rec        # left as-is
+        on_disk = _json.loads(
+            (tmp_path / "fusion_autotune.json").read_text())
+        assert set(on_disk) == set(mem)           # migration persisted
+    finally:
+        fusion.clear_cache()
+        pt.set_flags({"FLAGS_xla_compile_cache_dir": ""})
+
+
 def test_flag_flip_invalidates_executor_plan():
     scope = Scope()
     with scope_guard(scope), program_guard(Program(), Program()):

@@ -102,7 +102,6 @@ def test_batch_server_parity_coalescing_and_compile_bound(gpt_model):
     assert srv.warmup() == 2
     traces0 = srv.compile_stats()["traces"]
     assert traces0 == 2        # one compile per bucket, none extra
-    occ0_sum = _totals("paddle_tpu_serving_batch_occupancy")
     srv.start()
     rng = np.random.RandomState(0)
     reqs = []

@@ -1,0 +1,664 @@
+"""Request-path observability plane (PR 11): per-request trace
+propagation (phase spans partitioning submit->resolve under one trace
+id), per-tenant SLO burn-rate math (multi-window, hysteresis,
+zero-traffic), shed-on-burn admission, the live /metrics /healthz
+/statusz scrape surface, and the serving keys of the gang heartbeat
+digest."""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor, serving
+from paddle_tpu.framework import Program, Scope, program_guard
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import timeline  # noqa: E402  (tools/timeline.py: validators)
+
+
+def _concat_factory(seq):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = layers.data("x", shape=[seq], dtype="float32")
+        out = layers.concat([x, x], axis=1)
+    return prog, ["x"], [out.name]
+
+
+def _serving_spans(trace_id):
+    """All serving.* complete-spans of one request, in time order."""
+    evs = [(name, t0, t0 + dur, args)
+           for ph, name, cat, _tid, t0, dur, args
+           in list(monitor.TRACER._events)
+           if ph == "X" and cat == "serving" and args
+           and args.get("trace") == trace_id]
+    evs.sort(key=lambda e: e[1])
+    return evs
+
+
+def _totals(name, **labels):
+    fam = monitor.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return sum(cell.get() for lbl, cell in fam.series()
+               if all(lbl.get(k) == v for k, v in labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# SLO grammar + flag validation
+# ---------------------------------------------------------------------------
+
+def test_parse_slo_grammar():
+    t = serving.parse_slo(
+        "tenantA:p99_ms=250,avail=99.9;tenantB:avail=99;*:p99_ms=500")
+    assert t["tenantA"].p99_ms == 250 and t["tenantA"].avail == 99.9
+    assert t["tenantB"].p99_ms is None and t["tenantB"].avail == 99.0
+    assert t["*"].p99_ms == 500 and t["*"].avail == 99.0  # p99 default
+    assert serving.parse_slo("") == {}
+    assert abs(t["tenantB"].budget - 0.01) < 1e-12
+    for bad in ("nocolon", "t:", "t:frobs=3", "t:p99_ms=abc",
+                "t:avail=0", "t:avail=101", "t:p99_ms=-5"):
+        with pytest.raises(ValueError):
+            serving.parse_slo(bad)
+
+
+def test_slo_flag_validated_at_set_flags():
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_serving_slo": "t:not_a_key=1"})
+    pt.set_flags({"FLAGS_serving_slo": "t:p99_ms=100"})   # accepted
+    pt.set_flags({"FLAGS_serving_slo": ""})
+
+
+def test_slo_window_flags_validated_at_set_flags():
+    # the EFFECTIVE pair is validated: fast merged over the current slow
+    # (600 default) must still satisfy fast <= slow — the refusal lands
+    # at set_flags, not at server construction deep in a deployment
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_serving_slo_fast_window_s": 900.0})
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_serving_slo_fast_window_s": 0.0})
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_serving_slo_burn_threshold": 0.0})
+    # validate-before-apply: the refused pair left nothing half-set
+    fl = pt.get_flags(["FLAGS_serving_slo_fast_window_s",
+                       "FLAGS_serving_slo_slow_window_s"])
+    assert fl == {"FLAGS_serving_slo_fast_window_s": 60.0,
+                  "FLAGS_serving_slo_slow_window_s": 600.0}
+    # a consistent pair set together is accepted even though the fast
+    # value alone would conflict with the stored slow
+    pt.set_flags({"FLAGS_serving_slo_fast_window_s": 900.0,
+                  "FLAGS_serving_slo_slow_window_s": 1800.0})
+    pt.set_flags({"FLAGS_serving_slo_fast_window_s": 60.0,
+                  "FLAGS_serving_slo_slow_window_s": 600.0})
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math: windows, breach, hysteresis, zero traffic
+# ---------------------------------------------------------------------------
+
+def _evaluator(**kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("threshold", 10.0)
+    targets = kw.pop("targets", {"bt": serving.SLOTarget(avail=99.0)})
+    return serving.BurnRateEvaluator(targets, **kw)
+
+
+def test_burn_rate_breach_recovery_hysteresis():
+    ev = _evaluator()
+    t0 = 1000.0
+    for i in range(5):
+        ev.record("bt", ok=False, now=t0 + i * 0.1)
+    n_breach0 = _totals("paddle_tpu_slo_breach_total", tenant="bt")
+    st = ev.evaluate(now=t0 + 1)
+    # all-bad traffic: bad_frac 1.0 over budget 0.01 -> burn 100 on
+    # BOTH windows -> breach
+    assert st["bt"]["burn_fast"] == pytest.approx(100.0)
+    assert st["bt"]["burn_slow"] == pytest.approx(100.0)
+    assert st["bt"]["breached"] and ev.in_breach("bt")
+    assert _totals("paddle_tpu_slo_breach_total", tenant="bt") \
+        == n_breach0 + 1
+    assert monitor.SLO_BURN_GAUGE.value(tenant="bt", window="fast") \
+        == pytest.approx(100.0)
+    assert monitor.SLO_BREACHED_GAUGE.value(tenant="bt") == 1
+    # good traffic dilutes the fast burn to 9.1 — UNDER the breach
+    # threshold but ABOVE the recovery threshold (10 * 0.5): hysteresis
+    # holds the breach
+    for i in range(50):
+        ev.record("bt", ok=True, latency_ms=1.0, now=t0 + 2 + i * 0.01)
+    st = ev.evaluate(now=t0 + 3)
+    assert 5.0 < st["bt"]["burn_fast"] < 10.0
+    assert st["bt"]["breached"]
+    # the bad events age OUT of the fast window -> burn 0 -> recovery
+    st = ev.evaluate(now=t0 + 70)
+    assert st["bt"]["burn_fast"] == 0.0
+    assert st["bt"]["burn_slow"] > 0.0      # still inside slow window
+    assert not st["bt"]["breached"] and not ev.in_breach("bt")
+    assert monitor.SLO_BREACHED_GAUGE.value(tenant="bt") == 0
+    # recovery does not re-count: breach EVENTS stay at +1
+    assert _totals("paddle_tpu_slo_breach_total", tenant="bt") \
+        == n_breach0 + 1
+    # breach + recovery instants are in the trace ring
+    kinds = [args for ph, name, cat, _t, _ts, _d, args
+             in list(monitor.TRACER._events)
+             if ph == "i" and name in ("slo.breach", "slo.recover")
+             and args and args.get("tenant") == "bt"]
+    assert any(a["burn_fast"] == pytest.approx(100.0) for a in kinds)
+    assert len(kinds) >= 2
+
+
+def test_burn_rate_latency_objective_counts_slow_as_bad():
+    ev = _evaluator(targets={"lt": serving.SLOTarget(p99_ms=100)})
+    t0 = 50.0
+    for i in range(4):
+        # 2 fast + 2 slow completions: bad_frac 0.5, budget 0.01
+        ev.record("lt", ok=True, latency_ms=50 + 100 * (i % 2),
+                  now=t0 + i * 0.1)
+    st = ev.evaluate(now=t0 + 1)
+    assert st["lt"]["burn_fast"] == pytest.approx(50.0)
+
+
+def test_burn_rate_zero_traffic_and_untracked():
+    ev = _evaluator(targets={"idle": serving.SLOTarget(avail=99.0)})
+    # a declared tenant with zero traffic still reports — burn 0,
+    # never a breach
+    st = ev.evaluate(now=10.0)
+    assert st["idle"] == dict(st["idle"], burn_fast=0.0, burn_slow=0.0,
+                              breached=False)
+    # a tenant with no target (and no '*' default) is not tracked
+    ev.record("stranger", ok=False, now=10.0)
+    assert "stranger" not in ev.evaluate(now=11.0)
+
+
+def test_burn_rate_window_pruning_and_edges():
+    ev = _evaluator()
+    t0 = 2000.0
+    ev.record("bt", ok=False, now=t0)
+    # inside the fast window by epsilon: counted (burn = 1 / 0.01)
+    st = ev.evaluate(now=t0 + ev.fast_window_s - 1e-6)
+    assert st["bt"]["burn_fast"] == pytest.approx(100.0)
+    # an event exactly AT the cutoff is outside the window (t <= cutoff);
+    # it still sits inside the slow window
+    st = ev.evaluate(now=t0 + ev.fast_window_s)
+    assert st["bt"]["burn_fast"] == 0.0
+    assert st["bt"]["burn_slow"] == pytest.approx(100.0)
+    # events older than the slow window are pruned from the ring
+    ev.evaluate(now=t0 + ev.slow_window_s + 1)
+    with ev._mu:
+        assert len(ev._events["bt"]) == 0
+    with pytest.raises(ValueError):
+        _evaluator(fast_window_s=60.0, slow_window_s=30.0)
+    with pytest.raises(ValueError):
+        _evaluator(fast_window_s=0.0)
+
+
+def test_evaluator_from_flags_off_by_default():
+    assert serving.BurnRateEvaluator.from_flags() is None
+    pt.set_flags({"FLAGS_serving_slo": "ff:p99_ms=10",
+                  "FLAGS_serving_slo_fast_window_s": 5.0})
+    try:
+        ev = serving.BurnRateEvaluator.from_flags()
+        assert ev is not None and ev.fast_window_s == 5.0
+        assert ev.targets["ff"].p99_ms == 10
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": "",
+                      "FLAGS_serving_slo_fast_window_s": 60.0})
+
+
+def test_evaluator_forgets_evicted_tenant():
+    ev = _evaluator(targets={"*": serving.SLOTarget(avail=99.0)})
+    ev.record("churn_t", ok=False, now=100.0)
+    ev.evaluate(now=100.5)
+    fam = monitor.REGISTRY.get("paddle_tpu_slo_burn_rate")
+    assert any(l.get("tenant") == "churn_t" for l, _ in fam.series())
+    # the eviction path: registry series retired, then forget — the
+    # next tick must NOT re-mint the just-dropped series
+    monitor.retire_tenant_series("churn_t")
+    ev.forget("churn_t")
+    st = ev.evaluate(now=101.0)
+    assert "churn_t" not in st
+    assert not any(l.get("tenant") == "churn_t" for l, _ in fam.series())
+    with ev._mu:
+        assert "churn_t" not in ev._events
+        assert "churn_t" not in ev._breached
+        assert "churn_t" not in ev._last_burn
+    # an EXPLICITLY declared tenant must not be re-minted by the
+    # declared-tenants loop either; new traffic (re-admission) resumes
+    ev2 = _evaluator(targets={"decl_ev": serving.SLOTarget(avail=99.0)})
+    ev2.record("decl_ev", ok=True, now=10.0)
+    ev2.evaluate(now=10.5)
+    assert any(l.get("tenant") == "decl_ev" for l, _ in fam.series())
+    monitor.retire_tenant_series("decl_ev")
+    ev2.forget("decl_ev")
+    st = ev2.evaluate(now=11.0)
+    assert "decl_ev" not in st
+    assert not any(l.get("tenant") == "decl_ev" for l, _ in fam.series())
+    ev2.record("decl_ev", ok=True, now=11.5)       # re-admitted
+    st = ev2.evaluate(now=12.0)
+    assert "decl_ev" in st and not st["decl_ev"]["breached"]
+
+
+def test_tenant_evict_wires_slo_forget():
+    from paddle_tpu.serving.server import _ServerBase
+    pt.set_flags({"FLAGS_serving_slo": "*:avail=99"})
+    try:
+        base = _ServerBase()
+        assert base.slo is not None
+        base.slo.record("ev_hook_t", ok=False)
+        base.tenants.evict("ev_hook_t")
+        with base.slo._mu:
+            assert "ev_hook_t" not in base.slo._events
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": ""})
+
+
+def test_idle_wildcard_tenant_pruned_and_series_dropped():
+    ev = _evaluator(targets={"*": serving.SLOTarget(avail=99.0)})
+    ev.record("idle_w", ok=True, now=50.0)
+    st = ev.evaluate(now=51.0)
+    assert "idle_w" in st
+    fam = monitor.REGISTRY.get("paddle_tpu_slo_burn_rate")
+    assert any(l.get("tenant") == "idle_w" for l, _ in fam.series())
+    # fully idle past the slow window: dropped from the evaluator AND
+    # its gauge series folded away (bounded under tenant churn)
+    st = ev.evaluate(now=51.0 + ev.slow_window_s + 1)
+    assert "idle_w" not in st
+    assert not any(l.get("tenant") == "idle_w" for l, _ in fam.series())
+    with ev._mu:
+        assert "idle_w" not in ev._events
+    # a breached tenant first fires its recovery, then drops next tick
+    ev.record("br_w", ok=False, now=2000.0)
+    st = ev.evaluate(now=2000.5)
+    assert st["br_w"]["breached"]
+    st = ev.evaluate(now=2000.5 + ev.slow_window_s + 1)
+    assert "br_w" in st and not st["br_w"]["breached"]
+    st = ev.evaluate(now=2000.5 + ev.slow_window_s + 2)
+    assert "br_w" not in st
+    # explicitly declared tenants always keep reporting (burn 0)
+    ev2 = _evaluator(targets={"decl_t": serving.SLOTarget(avail=99.0)})
+    ev2.record("decl_t", ok=True, now=10.0)
+    st = ev2.evaluate(now=10.0 + ev2.slow_window_s + 5)
+    assert st["decl_t"]["burn_fast"] == 0.0
+
+
+def test_stale_completion_does_not_resurrect_slo():
+    from paddle_tpu.serving.scheduler import Request
+    from paddle_tpu.serving.server import _ServerBase
+    pt.set_flags({"FLAGS_serving_slo": "*:avail=99"})
+    try:
+        base = _ServerBase()
+        req = Request("stale_t", feeds={})
+        req.admit_gen = base.tenants.generation("stale_t")
+        base.tenants.evict("stale_t")     # retires series + forgets
+        # the in-flight request resolves AFTER the eviction: its SLO
+        # record must be dropped, not re-create the tenant's state
+        base._on_complete(req, [np.zeros(1)], 1.0)
+        base._on_fail(Request("stale_t", feeds={}), RuntimeError("x"))
+        with base.slo._mu:
+            assert "stale_t" not in base.slo._events
+        assert "stale_t" not in base.slo.evaluate()
+        # a FRESH admission (new incarnation) is tracked again
+        assert base.tenants.try_admit("stale_t")
+        req2 = Request("stale_t", feeds={})
+        req2.admit_gen = base.tenants.generation("stale_t")
+        base._on_complete(req2, [np.zeros(1)], 1.0)
+        assert "stale_t" in base.slo.evaluate()
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": ""})
+
+
+def test_enable_http_honors_disabled_flag():
+    from paddle_tpu.serving.server import _ServerBase
+    base = _ServerBase()
+    # FLAGS_metrics_port defaults to 0 = disabled: no socket may open
+    assert base.enable_http() is None
+    assert base._http is None
+
+
+def test_slo_eval_failure_warns_once():
+    import warnings as _w
+    from paddle_tpu.serving.server import _ServerBase
+    pt.set_flags({"FLAGS_serving_slo": "*:avail=99"})
+    try:
+        base = _ServerBase()
+
+        def boom(now=None):
+            raise RuntimeError("boom")
+        base.slo.evaluate = boom
+        with pytest.warns(UserWarning, match="SLO evaluator failed"):
+            base._slo_eval_safe()
+        with _w.catch_warnings():
+            _w.simplefilter("error")     # a second warning would raise
+            base._slo_eval_safe()        # swallowed silently (warn once)
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": ""})
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation: one request -> complete span chain
+# ---------------------------------------------------------------------------
+
+def test_trace_chain_partitions_e2e_latency():
+    scope = Scope()
+    srv = serving.InferenceServer(_concat_factory, scope, buckets=(8,),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    try:
+        xv = np.arange(1, 6, dtype=np.float32)
+        f = srv.submit("trace_t", {"x": xv}, seq_len=5)
+        req_trace = None
+        f.result(timeout=60)
+        # the Request object is internal; recover the trace id from the
+        # newest materialize span of our tenant
+        mats = [(args.get("trace"), args) for ph, name, cat, _t, _ts, _d,
+                args in list(monitor.TRACER._events)
+                if ph == "X" and name == "serving.materialize" and args
+                and args.get("tenant") == "trace_t"]
+        assert mats, "no materialize span emitted"
+        req_trace, mat_args = mats[-1]
+        spans = _serving_spans(req_trace)
+        names = [n for n, _t0, _t1, _a in spans]
+        assert names == ["serving.admit", "serving.queue_wait",
+                         "serving.batch_wait", "serving.dispatch",
+                         "serving.materialize"]
+        # the chain is CONTIGUOUS: each phase starts where the previous
+        # ended (they partition submit -> resolve)
+        for (_n1, _s1, e1, _a1), (_n2, s2, _e2, _a2) in zip(spans,
+                                                            spans[1:]):
+            assert s2 == pytest.approx(e1, abs=1e-6)
+        # ... so the phase sum reconstructs the measured e2e latency
+        phase_sum_ms = sum((t1 - t0) for _n, t0, t1, _a in spans) * 1e3
+        e2e_ms = mat_args["e2e_ms"]
+        assert phase_sum_ms == pytest.approx(e2e_ms, rel=0.10)
+        # dispatch carries the step-id correlation + padding attribution
+        d_args = dict(spans[3][3])
+        assert d_args["step"] >= 1
+        assert d_args["width"] >= d_args["occupancy"] >= 1
+        assert d_args["pad_rows"] == d_args["width"] - d_args["occupancy"]
+        # every span names the same tenant + bucket
+        assert all(a["tenant"] == "trace_t" and a["bucket"] == "8"
+                   for _n, _t0, _t1, a in spans)
+        # the dispatch span's step id names a REAL executor step: the
+        # executor.dispatch span with that id overlaps our dispatch phase
+        from paddle_tpu.framework.executor import last_step_id
+        assert d_args["step"] <= last_step_id()
+        # per-phase histograms carry the same decomposition
+        fam = monitor.REGISTRY.get("paddle_tpu_serving_phase_ms")
+        phases = {lbl["phase"] for lbl, _c in fam.series()
+                  if lbl.get("tenant") == "trace_t"}
+        assert phases == {"admit", "queue_wait", "batch_wait",
+                          "dispatch", "materialize"}
+    finally:
+        srv.stop()
+
+
+def test_decode_trace_chain_and_load_gauges():
+    """The decode loop emits its own chain (admit -> queue_wait ->
+    decode -> materialize, bucket='decode'), per-iteration decode_iter
+    spans, and feeds the free-slots / tokens-per-second load gauges."""
+    from paddle_tpu.models import transformer as T
+    cfg = T.BertConfig(vocab_size=48, d_model=16, n_layer=1, n_head=2,
+                       d_inner=32, max_pos=32, dropout=0.0)
+    scope = Scope()
+    with pt.framework.scope_guard(scope), \
+            program_guard(Program(), Program()):
+        T.build_gpt_serving(cfg, 8, attn_impl="base")
+        from paddle_tpu.framework import Executor
+        Executor().run(pt.default_startup_program(), scope=scope, seed=3)
+    eng = serving.DecodeEngine(cfg, scope, max_slots=2, page_len=4,
+                               max_seq=16)
+    dsrv = serving.DecodeServer(eng)
+    dsrv.start()
+    try:
+        tok0 = _totals("paddle_tpu_serving_generated_tokens_total")
+        f = dsrv.submit("dec_t", np.array([3, 5, 7], np.int64),
+                        max_new_tokens=3)
+        assert len(f.result(timeout=300)) == 3
+        mats = [args for ph, name, cat, _t, _ts, _d, args
+                in list(monitor.TRACER._events)
+                if ph == "X" and name == "serving.materialize" and args
+                and args.get("tenant") == "dec_t"]
+        assert mats
+        spans = _serving_spans(mats[-1]["trace"])
+        names = [n for n, _t0, _t1, _a in spans]
+        assert names == ["serving.admit", "serving.queue_wait",
+                         "serving.decode", "serving.materialize"]
+        assert all(a["bucket"] == "decode" for _n, _t0, _t1, a in spans)
+        dec_args = spans[2][3]
+        # 3 prompt-prefill iterations + 3 generated tokens (the last
+        # generation decides completion without another iteration)
+        assert dec_args["generated"] == 3
+        assert dec_args["iters"] >= 3
+        phase_sum_ms = sum((t1 - t0) for _n, t0, t1, _a in spans) * 1e3
+        assert phase_sum_ms == pytest.approx(mats[-1]["e2e_ms"],
+                                             rel=0.10)
+        assert any(ph == "X" and name == "serving.decode_iter"
+                   for ph, name, *_ in list(monitor.TRACER._events))
+        assert _totals("paddle_tpu_serving_generated_tokens_total") \
+            == tok0 + 3
+        assert monitor.SERVING_TPS_GAUGE.value() > 0
+        # all slots free again after retirement
+        assert monitor.SERVING_FREE_SLOTS_GAUGE.value() == 2
+        assert dsrv.statusz()["slots"] == {"total": 2, "free": 2}
+    finally:
+        assert dsrv.drain(10)
+        dsrv.stop()
+
+
+def test_trace_ids_unique_per_request():
+    r1 = serving.Request("u_t", feeds={}, seq_len=1, bucket=8)
+    r2 = serving.Request("u_t", feeds={}, seq_len=1, bucket=8)
+    assert r1.trace_id != r2.trace_id
+
+
+# ---------------------------------------------------------------------------
+# shed-on-burn admission
+# ---------------------------------------------------------------------------
+
+def test_shed_on_burn_admission():
+    pt.set_flags({"FLAGS_serving_slo": "shed_t:avail=99",
+                  "FLAGS_serving_slo_shed": True})
+    try:
+        scope = Scope()
+        srv = serving.InferenceServer(_concat_factory, scope,
+                                      buckets=(8,), max_batch=2)
+        assert srv.slo is not None and srv._slo_shed
+        for _ in range(5):
+            srv.slo.record("shed_t", ok=False)
+        srv.slo.evaluate()
+        assert srv.slo.in_breach("shed_t")
+        n0 = _totals("paddle_tpu_serving_rejected_total",
+                     tenant="shed_t", reason="slo_shed")
+        f = srv.submit("shed_t", {"x": np.ones(4, np.float32)})
+        with pytest.raises(serving.AdmissionError, match="slo_shed"):
+            f.result(0)
+        assert _totals("paddle_tpu_serving_rejected_total",
+                       tenant="shed_t", reason="slo_shed") == n0 + 1
+        # an unrelated tenant (no target, no '*') is NOT shed
+        f2 = srv.submit("other_t", {"x": np.ones(4, np.float32)})
+        assert not f2.done() or f2.result(0) is not None
+        srv.stop()
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": "",
+                      "FLAGS_serving_slo_shed": False})
+
+
+# ---------------------------------------------------------------------------
+# live scrape surface
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_httpd_metrics_healthz_statusz():
+    pt.set_flags({"FLAGS_serving_slo": "*:p99_ms=500"})
+    try:
+        scope = Scope()
+        srv = serving.InferenceServer(_concat_factory, scope,
+                                      buckets=(8,), max_batch=2,
+                                      batch_wait_ms=0.0)
+        srv.warmup()
+        srv.start()
+        http = srv.enable_http(0, host="127.0.0.1")   # ephemeral, loopback
+        assert srv.enable_http(0) is http          # idempotent
+        srv.submit("http_t", {"x": np.ones(4, np.float32)}) \
+           .result(timeout=60)
+        # /metrics: live scrape passes strict Prometheus validation and
+        # carries the serving phase histogram
+        code, body = _get(http.url + "/metrics")
+        assert code == 200
+        assert timeline.validate_prometheus(body) > 0
+        assert "paddle_tpu_serving_phase_ms" in body
+        # /healthz: ok while serving
+        code, body = _get(http.url + "/healthz")
+        assert (code, body.strip()) == (200, "ok")
+        # /statusz: operational snapshot
+        code, body = _get(http.url + "/statusz")
+        assert code == 200
+        st = json.loads(body)
+        assert st["draining"] is False
+        assert set(st["buckets"]) == {"8"}
+        assert st["buckets"]["8"] >= 1          # warmed width
+        assert "http_t" in st["tenants"] or st["tenants"] == {}
+        assert st["compile"]["traces"] >= 1
+        # unknown path -> 404, folded under one counter label
+        code, _ = _get(http.url + "/nope")
+        assert code == 404
+        assert _totals("paddle_tpu_metrics_http_requests_total",
+                       path="other", status="404") >= 1
+        # drain flips /healthz to 503 BEFORE the drain finishes
+        srv._draining.set()
+        code, body = _get(http.url + "/healthz")
+        assert (code, body.strip()) == (503, "draining")
+        st = json.loads(_get(http.url + "/statusz")[1])
+        assert st["draining"] is True
+        srv.stop()
+        assert srv._http is None          # stop() tears the endpoint down
+    finally:
+        pt.set_flags({"FLAGS_serving_slo": ""})
+
+
+def test_httpd_standalone_exporter():
+    """A bare MetricsHTTPServer (no serving plane) is a valid live
+    exporter for a training rank."""
+    with serving.MetricsHTTPServer(port=0) as http:
+        code, body = _get(http.url + "/metrics")
+        assert code == 200 and timeline.validate_prometheus(body) > 0
+        assert _get(http.url + "/healthz")[0] == 200
+        assert json.loads(_get(http.url + "/statusz")[1]) == {}
+
+
+# ---------------------------------------------------------------------------
+# offline phase decomposition (tools/latency_report.py)
+# ---------------------------------------------------------------------------
+
+def test_latency_report_decomposes_exported_trace(tmp_path):
+    import latency_report
+
+    def span(name, trace, tenant, bucket, ts, dur_ms, **extra):
+        return {"ph": "X", "name": "serving." + name, "cat": "serving",
+                "ts": ts, "dur": dur_ms * 1e3,
+                "args": dict(trace=trace, tenant=tenant, bucket=bucket,
+                             **extra)}
+
+    events = []
+    for i, e2e in enumerate((10.0, 30.0)):      # two lat_t requests
+        t = 1000 + i
+        events += [
+            span("admit", t, "lat_t", "8", 0, 1.0),
+            span("queue_wait", t, "lat_t", "8", 1e3, 2.0),
+            span("batch_wait", t, "lat_t", "8", 3e3, 1.0),
+            span("dispatch", t, "lat_t", "8", 4e3, e2e - 5.0,
+                 step=7, pad_frac=0.25 * i),
+            span("materialize", t, "lat_t", "8", (e2e - 1.0) * 1e3,
+                 1.0, e2e_ms=e2e),
+        ]
+    # decode-path chain for another tenant
+    events += [
+        span("admit", 2000, "dec_t", "decode", 0, 1.0),
+        span("queue_wait", 2000, "dec_t", "decode", 1e3, 1.0),
+        span("decode", 2000, "dec_t", "decode", 2e3, 17.0),
+        span("materialize", 2000, "dec_t", "decode", 19e3, 1.0,
+             e2e_ms=20.0),
+    ]
+    # an in-flight chain (no materialize yet) + unrelated noise
+    events.append(span("admit", 3000, "lat_t", "8", 0, 1.0))
+    events.append({"ph": "X", "name": "executor.dispatch", "ts": 0,
+                   "dur": 5.0, "args": {"step": 7}})
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+
+    rep = latency_report.report(latency_report.load_chains(str(path)))
+    assert rep["total_requests"] == 3
+    assert rep["in_flight_at_export"] == 1
+    by_key = {(g["tenant"], g["bucket"]): g for g in rep["groups"]}
+    lat = by_key[("lat_t", "8")]
+    assert lat["requests"] == 2
+    assert lat["e2e"] == {"p50_ms": 10.0, "p99_ms": 30.0}
+    assert lat["phases"]["dispatch"]["p99_ms"] == 25.0
+    assert lat["phases"]["admit"] == {"p50_ms": 1.0, "p99_ms": 1.0}
+    assert "decode" not in lat["phases"]
+    assert lat["pad_frac_p50"] == 0.0
+    dec = by_key[("dec_t", "decode")]
+    assert dec["phases"]["decode"] == {"p50_ms": 17.0, "p99_ms": 17.0}
+    assert "batch_wait" not in dec["phases"]
+    # tenant filter + rendered table
+    only = latency_report.report(latency_report.load_chains(str(path)),
+                                 tenant="dec_t")
+    assert [g["tenant"] for g in only["groups"]] == ["dec_t"]
+    text = latency_report.render(rep)
+    assert "lat_t" in text and "dec_t" in text and "PAD" in text
+
+
+# ---------------------------------------------------------------------------
+# serving keys of the gang heartbeat digest
+# ---------------------------------------------------------------------------
+
+def test_metrics_digest_carries_serving_load():
+    monitor.SERVING_QUEUE_GAUGE.set(3, tenant="dg_a")
+    monitor.SERVING_QUEUE_GAUGE.set(2, tenant="dg_b")
+    monitor.SERVING_QUEUE_GAUGE.set(99, tenant="retired")  # excluded
+    monitor.SERVING_LAST_OCC_GAUGE.set(4)
+    monitor.SERVING_FREE_SLOTS_GAUGE.set(1)
+    monitor.SERVING_TPS_GAUGE.set(123.456)
+    d = monitor.metrics_digest()
+    assert d["srv_q"] >= 5.0        # dg_a + dg_b (other tests may add)
+    assert d["occ"] == 4.0 and d["slots"] == 1.0
+    assert d["tps"] == 123.456
+    # the serving keys survive the digest byte cap AFTER the core
+    # training keys (priority order), and shed before step_ms/mfu
+    capped = monitor.capped_digest(dict(d), max_bytes=2048)
+    assert "srv_q" in capped
+    monitor.SERVING_QUEUE_GAUGE.fold({"tenant": "dg_a"}, None)
+    monitor.SERVING_QUEUE_GAUGE.fold({"tenant": "dg_b"}, None)
+
+
+def test_slo_series_retire_with_tenant():
+    monitor.SLO_BURN_GAUGE.set(5.0, tenant="bye_t", window="fast")
+    monitor.SLO_BREACHED_GAUGE.set(1, tenant="bye_t")
+    monitor.SLO_BREACH_CTR.inc(2, tenant="bye_t")
+    monitor.SERVING_PHASE_HIST.observe(1.0, phase="admit",
+                                       tenant="bye_t", bucket="8")
+    tot0 = _totals("paddle_tpu_slo_breach_total")
+    monitor.retire_tenant_series("bye_t")
+    for fam_name in ("paddle_tpu_slo_burn_rate", "paddle_tpu_slo_breached",
+                     "paddle_tpu_slo_breach_total",
+                     "paddle_tpu_serving_phase_ms"):
+        fam = monitor.REGISTRY.get(fam_name)
+        assert not any(lbl.get("tenant") == "bye_t"
+                       for lbl, _ in fam.series()), fam_name
+    # the breach-event counter FOLDS (totals stay exact), gauges drop
+    assert _totals("paddle_tpu_slo_breach_total") == tot0
+    assert _totals("paddle_tpu_slo_breach_total", tenant="retired") >= 2

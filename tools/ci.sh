@@ -45,8 +45,20 @@ python -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])' > "$sitedi
     "import paddle_tpu; paddle_tpu.install_check.run_check()")
 rm -rf "$wheeldir" "$venvdir"
 
-echo "== telemetry smoke (chrome trace + metrics export validation) =="
-JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+echo "== telemetry smoke (chrome trace + metrics export + live /metrics scrape validation) =="
+tel_tmp=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/telemetry_smoke.py "$tel_tmp"
+
+echo "== latency report (offline phase decomposition from the smoke's trace) =="
+python tools/latency_report.py "$tel_tmp/trace.json"
+python tools/latency_report.py "$tel_tmp/trace.json" --json | python -c '
+import json, sys
+rep = json.load(sys.stdin)
+assert rep["total_requests"] >= 1, rep
+g = rep["groups"][0]
+assert "dispatch" in g["phases"] and g["e2e"]["p99_ms"] > 0, g
+print("latency report OK: %d request(s) decomposed" % rep["total_requests"])'
+rm -rf "$tel_tmp"
 
 echo "== resilience smoke (fault injection + retries + ckpt integrity) =="
 JAX_PLATFORMS=cpu python tools/resilience_smoke.py

@@ -4,7 +4,9 @@ coordinator's ``status`` view — `top` for a training gang.
 
 Each row is one rank: liveness, current training step, durably-committed
 step, and the heartbeat metrics digest (step-time estimate, live MFU,
-dataloader queue depth, executor in-flight depth).  The slowest live
+dataloader queue depth, executor in-flight depth, plus the serving-load
+columns a fleet router reads — serving queue depth SRVQ, last batch
+occupancy OCC, free decode slots SLOT, decode TOK/S).  The slowest live
 rank is flagged ``<-- straggler`` (the same rank the coordinator's
 ``paddle_tpu_gang_straggler_rank`` gauge names), and the footer carries
 the gang-level view: status, step skew, manifest, fingerprint mismatch.
@@ -57,6 +59,7 @@ def render(status: dict) -> str:
     rows = []
     header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
               f"{'STEP_MS':>9} {'MFU%':>6} {'QUEUE':>5} {'INFL':>4} "
+              f"{'SRVQ':>5} {'OCC':>5} {'SLOT':>4} {'TOK/S':>7} "
               f"{'HB_AGE':>7} {'DEATHS':>6}")
     rows.append(header)
     rows.append("-" * len(header))
@@ -77,6 +80,10 @@ def render(status: dict) -> str:
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
                 f"{_fmt(d.get('queue'), '{:.0f}'):>5} "
                 f"{_fmt(d.get('inflight'), '{}'):>4} "
+                f"{_fmt(d.get('srv_q'), '{:.0f}'):>5} "
+                f"{_fmt(d.get('occ'), '{:.1f}'):>5} "
+                f"{_fmt(d.get('slots'), '{:.0f}'):>4} "
+                f"{_fmt(d.get('tps'), '{:.1f}'):>7} "
                 f"{_fmt(e.get('age_s'), '{:.1f}s'):>7} "
                 f"{_fmt(e.get('deaths'), '{}'):>6}")
         if r == straggler:

@@ -16,6 +16,22 @@ must, under concurrent clients across 2 tenants:
    page freed at the end;
 7. (subprocess) drain on SIGTERM mid-load: stop admitting, finish every
    in-flight request, exit 0 with zero dropped.
+
+PR 11 (request-path observability) adds, same process:
+
+8. every completed request has a COMPLETE span chain under one trace id
+   (serving.admit -> queue_wait -> batch_wait -> dispatch ->
+   materialize) whose per-phase sum is within 10% of the request's
+   measured end-to-end latency, with the executor step id on the
+   dispatch span;
+9. a live curl-style scrape of ``/metrics`` (FLAGS_metrics_port plane)
+   passes strict Prometheus validation, ``/healthz`` answers ok and
+   ``/statusz`` reports the warmed buckets;
+10. injected latency (a canary tenant whose p99 objective is below any
+    physically possible request) drives ``paddle_tpu_slo_burn_rate``
+    above the breach threshold and back down (hysteresis recovery),
+    with the breach instant present in the exported trace;
+11. the SLO state is breach-free at exit.
 """
 
 import json
@@ -94,13 +110,45 @@ def counter_total(name, **labels):
                if all(lbl.get(k) == v for k, v in labels.items()))
 
 
+def _request_chains(tenants):
+    """serving.* phase spans from the tracer ring, grouped by trace id,
+    for requests of the given tenants (decode-bucket chains excluded)."""
+    from paddle_tpu import monitor
+    chains = {}
+    for ph, name, cat, _tid, t0, dur, args in list(monitor.TRACER._events):
+        if ph != "X" or cat != "serving" or not args:
+            continue
+        if args.get("tenant") not in tenants or args.get("bucket") == \
+                "decode":
+            continue
+        chains.setdefault(args["trace"], []).append(
+            (name, t0, t0 + dur, args))
+    for spans in chains.values():
+        spans.sort(key=lambda s: s[1])
+    return chains
+
+
 def main():
+    import urllib.request
+
     import paddle_tpu as pt
     from paddle_tpu import monitor, serving
+
+    # the SLO plane rides the whole scenario: generous latency
+    # objectives for the load tenants (must stay breach-free), an
+    # impossible one for the canary (check 10 — every real completed
+    # request is "injected latency" against a 1 µs objective), and
+    # sub-second windows so the breach ages out within the smoke
+    pt.set_flags({"FLAGS_serving_slo":
+                  "tenant_a:p99_ms=60000;tenant_b:p99_ms=60000,avail=99;"
+                  "slo_canary:p99_ms=0.001",
+                  "FLAGS_serving_slo_fast_window_s": 0.5,
+                  "FLAGS_serving_slo_slow_window_s": 1.0})
 
     cfg, scope, factory = _build()
     srv = serving.InferenceServer(factory, scope, buckets=(8, 16),
                                   max_batch=4, batch_wait_ms=5.0)
+    assert srv.slo is not None
     warmed = srv.warmup()
     traces_after_warmup = srv.compile_stats()["traces"]
     assert warmed == 2 and traces_after_warmup == 2, (
@@ -119,7 +167,9 @@ def main():
             lat_ms.append((time.perf_counter() - t0) * 1e3)
     finally:
         pt.set_flags({"FLAGS_fault_inject": ""})
-    assert srv.drain(30), "drain timed out with requests in flight"
+    # barrier only (queue empty, nothing in flight) — admission stays
+    # open for the SLO-canary checks below; the full drain runs at exit
+    assert srv._sched.drain(30), "requests still in flight after load"
 
     # exact counter totals, per tenant and overall
     n = len(pairs)
@@ -154,7 +204,78 @@ def main():
     lat_ms.sort()
     p99 = lat_ms[int(0.99 * (len(lat_ms) - 1))]
     assert p99 < 30000, f"p99 {p99:.0f} ms"
+
+    # 8: every completed request has a COMPLETE chain under one trace
+    # id whose phase sum reconstructs its measured e2e latency
+    chains = _request_chains({"tenant_a", "tenant_b"})
+    assert len(chains) == n, (len(chains), n)
+    want = ["serving.admit", "serving.queue_wait", "serving.batch_wait",
+            "serving.dispatch", "serving.materialize"]
+    for trace_id, spans in chains.items():
+        names = [s[0] for s in spans]
+        assert names == want, (trace_id, names)
+        phase_sum_ms = sum(t1 - t0 for _n, t0, t1, _a in spans) * 1e3
+        e2e_ms = spans[-1][3]["e2e_ms"]
+        assert abs(phase_sum_ms - e2e_ms) <= 0.10 * e2e_ms + 0.05, (
+            trace_id, phase_sum_ms, e2e_ms)
+        d_args = spans[3][3]
+        assert isinstance(d_args["step"], int) and d_args["step"] >= 1, \
+            d_args
+        assert d_args["pad_rows"] == d_args["width"] - d_args["occupancy"]
+
+    # 9: live scrape surface — curl-style GET against the HTTP plane
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import timeline
+    http = srv.enable_http(0, host="127.0.0.1")
+    with urllib.request.urlopen(http.url + "/metrics", timeout=10) as r:
+        assert r.status == 200, r.status
+        live = r.read().decode()
+    n_live = timeline.validate_prometheus(live)
+    assert n_live > 0 and "paddle_tpu_serving_phase_ms" in live, n_live
+    with urllib.request.urlopen(http.url + "/healthz", timeout=10) as r:
+        assert (r.status, r.read().decode().strip()) == (200, "ok")
+    with urllib.request.urlopen(http.url + "/statusz", timeout=10) as r:
+        statusz = json.loads(r.read().decode())
+    assert set(statusz["buckets"]) == {"8", "16"}, statusz
+    assert statusz["draining"] is False
+
+    # 10: injected latency breaches the canary SLO, then hysteresis
+    # recovers it once the bad events age out of the fast window
+    for f in [srv.submit("slo_canary", {"src_ids": np.arange(
+            1, 6, dtype=np.int64)}) for _ in range(3)]:
+        f.result(timeout=120)
+    state = srv.slo.evaluate()
+    burn = state["slo_canary"]["burn_fast"]
+    assert burn >= srv.slo.threshold and state["slo_canary"]["breached"], \
+        state["slo_canary"]
+    assert monitor.SLO_BURN_GAUGE.value(tenant="slo_canary",
+                                        window="fast") >= srv.slo.threshold
+    time.sleep(1.2)                  # bad events leave both windows
+    state = srv.slo.evaluate()
+    assert state["slo_canary"]["burn_fast"] == 0.0
+    assert not state["slo_canary"]["breached"], state["slo_canary"]
+    assert monitor.SLO_BREACHED_GAUGE.value(tenant="slo_canary") == 0
+
+    # ... with the breach instant present in the EXPORTED trace
+    import tempfile
+    paths = monitor.export(tempfile.mkdtemp(prefix="pt_serving_smoke_"))
+    with open(paths["trace"]) as fh:
+        tdata = json.load(fh)
+    tevents = tdata if isinstance(tdata, list) else tdata["traceEvents"]
+    slo_marks = {ev["name"] for ev in tevents
+                 if ev.get("ph") == "i" and ev.get("args", {})
+                 .get("tenant") == "slo_canary"}
+    assert slo_marks == {"slo.breach", "slo.recover"}, slo_marks
+
+    # 11: breach-free SLO state at exit (the load tenants never burned)
+    final_state = srv.slo.evaluate()
+    assert not any(s["breached"] for s in final_state.values()), \
+        final_state
+    assert srv.drain(30), "drain timed out with requests in flight"
     srv.stop()
+    pt.set_flags({"FLAGS_serving_slo": "",
+                  "FLAGS_serving_slo_fast_window_s": 60.0,
+                  "FLAGS_serving_slo_slow_window_s": 600.0})
 
     # -- gpt_causal decode loop: slot reuse, one compile, pages freed ----
     eng = serving.DecodeEngine(cfg, scope, max_slots=2, page_len=4,
@@ -177,7 +298,10 @@ def main():
     print(f"serving smoke OK: {n} requests across 2 tenants, mean "
           f"occupancy {occ:.2f}, p99 {p99:.0f} ms, traces "
           f"{stats['traces']} == buckets {warmed}, fault absorbed, "
-          f"decode slot-reuse with 1 trace")
+          f"decode slot-reuse with 1 trace, {len(chains)} complete "
+          f"trace chains (phase sum ~ e2e), live /metrics scrape "
+          f"{n_live} samples, SLO canary breached+recovered, exit "
+          f"state breach-free")
 
 
 def child_drain():

@@ -22,6 +22,13 @@ Checks, each fatal on failure:
      program (FLAGS_cost_crosscheck): at least one 'ok' verdict, zero
      'divergent'
   8. the --rank-lanes gang merge passes strict validate()
+  9. request-span/step-id correlation (PR 11): a served request's
+     serving.dispatch span carries the step id of an executor.dispatch
+     span in the SAME trace, and the span intervals overlap — host
+     request traces join device traces
+ 10. the LIVE /metrics scrape (serving.MetricsHTTPServer) passes
+     strict Prometheus validation, like the file export it replaces as
+     the fleet-facing interface
 
 Usage: JAX_PLATFORMS=cpu python tools/telemetry_smoke.py [outdir]
 """
@@ -87,6 +94,27 @@ def main():
                   "FLAGS_cost_crosscheck": False})
     profiler.SAMPLER.close()
 
+    # one served request BEFORE the export, so the request-path spans
+    # land in the same trace as the training spans (check 9)
+    from paddle_tpu import serving
+
+    def _srv_factory(seq):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            xs = layers.data("xs", shape=[seq], dtype="float32")
+            out = layers.concat([xs, xs], axis=1)
+        return prog, ["xs"], [out.name]
+
+    srv = serving.InferenceServer(_srv_factory, Scope(), buckets=(8,),
+                                  max_batch=2, batch_wait_ms=0.0)
+    srv.warmup()
+    srv.start()
+    srv.submit("smoke_t", {"xs": np.ones(5, np.float32)}) \
+       .result(timeout=120)
+    if not srv.drain(30):
+        fail("serving drain timed out")
+    srv.stop()
+
     paths = monitor.export(outdir)
     print(f"exported: {paths}")
 
@@ -140,6 +168,62 @@ def main():
              "attribution (passes_ms)")
     if "compiler.pass.program_verify" not in tstats["names"]:
         fail("trace missing per-pass span compiler.pass.program_verify")
+
+    # 9: request-span/step-id correlation — the served request's
+    # serving.dispatch span names an executor.dispatch step id present
+    # in the SAME trace, and the intervals overlap (the host request
+    # phase contains the device dispatch it rode)
+    exec_spans = {ev["args"]["step"]: ev for ev in tevents
+                  if ev.get("name") == "executor.dispatch"}
+    sdisp = [ev for ev in tevents if ev.get("name") == "serving.dispatch"]
+    if not sdisp:
+        fail("no serving.dispatch spans in trace")
+    for ev in sdisp:
+        args = ev.get("args", {})
+        step = args.get("step")
+        if not isinstance(step, int) or step not in exec_spans:
+            fail(f"serving.dispatch step id {step!r} does not name an "
+                 f"executor.dispatch span in the trace")
+        dev = exec_spans[step]
+        if not (ev["ts"] - 1e3 <= dev["ts"]
+                and dev["ts"] + dev["dur"] <= ev["ts"] + ev["dur"] + 1e3):
+            fail(f"serving.dispatch [{ev['ts']}, +{ev['dur']}] does not "
+                 f"cover executor.dispatch step {step} "
+                 f"[{dev['ts']}, +{dev['dur']}]")
+        if args.get("trace") is None:
+            fail("serving.dispatch span carries no request trace id")
+    # ... and the request's chain is complete under that trace id
+    req_trace = sdisp[-1]["args"]["trace"]
+    chain = sorted((ev["ts"], ev["name"]) for ev in tevents
+                   if ev.get("args", {}).get("trace") == req_trace
+                   and str(ev.get("name", "")).startswith("serving."))
+    if [n for _ts, n in chain] != ["serving.admit", "serving.queue_wait",
+                                   "serving.batch_wait",
+                                   "serving.dispatch",
+                                   "serving.materialize"]:
+        fail(f"incomplete request span chain for trace {req_trace}: "
+             f"{[n for _ts, n in chain]}")
+
+    # 10: the LIVE scrape surface serves the registry over HTTP and
+    # passes the same strict Prometheus validation as the file export
+    import urllib.request
+    with serving.MetricsHTTPServer(port=0) as http:
+        with urllib.request.urlopen(http.url + "/metrics",
+                                    timeout=10) as r:
+            if r.status != 200:
+                fail(f"/metrics -> HTTP {r.status}")
+            live = r.read().decode()
+        with urllib.request.urlopen(http.url + "/healthz",
+                                    timeout=10) as r:
+            if (r.status, r.read().decode().strip()) != (200, "ok"):
+                fail("/healthz of a standalone exporter not ok")
+    try:
+        n_live = timeline.validate_prometheus(live)
+    except ValueError as e:
+        fail(f"live /metrics scrape invalid: {e}")
+    if n_live < 10 or "paddle_tpu_executor_steps_dispatched" not in live:
+        fail(f"live /metrics scrape suspiciously small ({n_live} "
+             f"samples) or missing executor families")
 
     # 6: sampling-window rotation stays under the directory bound
     wdirs = sorted(d for d in os.listdir(sample_dir)
