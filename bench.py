@@ -749,6 +749,99 @@ def bench_dispatch_overhead(dev, on_tpu, peak):
         })
 
 
+def bench_numerics(dev, on_tpu, peak):
+    """Cost-of-the-plane trajectory lines: steps/s of a small MLP train
+    loop at FLAGS_numerics=off/sentinel/full — ``numerics:mlp`` carries
+    the sentinel overhead % (the tier meant to stay on in production,
+    budget < 5%) with the full-mode overhead riding along — plus
+    ``numerics_loss_fp:mlp``, a sha1 fingerprint of the per-step loss
+    trajectory under each mode.  The fingerprints MUST match: the stats
+    are pure observers, and this line is the loss-parity gate the
+    quantized-collectives arc will reuse (a codec change that perturbs
+    the trajectory flips ``match`` to false in the bench record, not in
+    a user's training run)."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.flags import get_flags, set_flags
+    from paddle_tpu.framework import Program, Scope, program_guard, \
+        scope_guard
+    from paddle_tpu.analysis import numerics
+
+    saved = get_flags("FLAGS_numerics")["FLAGS_numerics"]
+    steps, warmup = 40, 3
+    results = {}
+
+    def one_mode(mode):
+        set_flags({"FLAGS_numerics": mode})
+        scope = Scope()
+        with scope_guard(scope), program_guard(Program(), Program()):
+            pt.default_main_program().random_seed = 7
+            pt.default_startup_program().random_seed = 7
+            # sized so per-element math dominates the step (~5-10 ms on
+            # the CPU smoke): at micro-step scale the fixed per-step
+            # cost (one 6-float D2H + frame decode) would read as tens
+            # of percent and measure the harness, not the plane
+            x = layers.data("x", shape=[256], dtype="float32")
+            h = layers.fc(x, size=512, act="relu")
+            h = layers.fc(h, size=512, act="relu")
+            loss = layers.mean(layers.fc(h, size=256))
+            pt.optimizer.SGD(0.01).minimize(loss)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            feed = {"x": jax.device_put(
+                np.linspace(-1, 1, 256 * 256, dtype=np.float32)
+                .reshape(256, 256))}
+            handles = []
+            for _ in range(warmup):
+                exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                        return_numpy=False)
+            exe.drain()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                h_, = exe.run(feed=feed, fetch_list=[loss.name],
+                              scope=scope, return_numpy=False)
+                handles.append(h_)
+            handles[-1].numpy()            # one sync bounds the pipeline
+            dt = time.perf_counter() - t0
+            losses = [float(h.numpy()) for h in handles]
+            numerics.ENGINE.poll(force=True)
+            return steps / dt, numerics.loss_fingerprint(losses)
+
+    try:
+        for mode in ("off", "sentinel", "full"):
+            results[mode] = one_mode(mode)
+    finally:
+        set_flags({"FLAGS_numerics": saved})
+
+    sps = {m: r[0] for m, r in results.items()}
+    fps = {m: r[1] for m, r in results.items()}
+    ovh = {m: round((sps["off"] / sps[m] - 1.0) * 100, 2)
+           for m in ("sentinel", "full")}
+    emit({
+        "metric": "numerics:mlp",
+        "value": ovh["sentinel"],
+        "unit": "% steps/s overhead at FLAGS_numerics=sentinel "
+                "(lower is better; budget < 5%)",
+        "vs_baseline": 0,
+        "steps_s_off": round(sps["off"], 1),
+        "steps_s_sentinel": round(sps["sentinel"], 1),
+        "steps_s_full": round(sps["full"], 1),
+        "overhead_full_pct": ovh["full"],
+        "device": str(dev),
+    })
+    emit({
+        "metric": "numerics_loss_fp:mlp",
+        "value": int(fps["off"] == fps["sentinel"] == fps["full"]),
+        "unit": "loss-trajectory parity across numerics modes (1 = "
+                "bit-identical — the quantized-collectives parity gate)",
+        "vs_baseline": 0,
+        "fp_off": fps["off"], "fp_sentinel": fps["sentinel"],
+        "fp_full": fps["full"],
+        "match": bool(fps["off"] == fps["sentinel"] == fps["full"]),
+    })
+
+
 def bench_memory(dev, on_tpu, peak):
     """Static HBM planner vs reality: for two workloads, run a few real
     steps, then pair the planner's step-boundary live-byte estimate
@@ -1148,6 +1241,8 @@ def main(argv=None):
         # cheap static-analysis trajectory line: planner estimate vs
         # measured live bytes (runs on CPU and TPU alike)
         ("memory", lambda: bench_memory(dev, on_tpu, peak)),
+        # numerics-plane cost + loss-parity fingerprint (cheap, CPU+TPU)
+        ("numerics", lambda: bench_numerics(dev, on_tpu, peak)),
         ("resnet50", lambda: bench_resnet50(dev, on_tpu, peak)),
         ("resnet50_frozen_bn",
          lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True)),

@@ -103,19 +103,114 @@ def enable(program=None):
     return program
 
 
+class DynamicLossScaler:
+    """Dynamic loss-scaling state machine (ref decorator.py:208
+    ``update_loss_scaling``): halve the scale (and SKIP the step) on a
+    non-finite gradient, grow it after ``incr_every_n_steps``
+    consecutive clean steps.
+
+    What's new here is the observability (this PR's satellite): every
+    scale move and every skipped step used to be INVISIBLE — now each
+    emits an ``amp.loss_scale`` trace instant in the numerics-anomaly
+    record format (``analysis.numerics.record_anomaly``: loss-scale
+    events are first-class anomaly records, counted in
+    ``paddle_tpu_numerics_anomalies_total{kind}``), the live scale is
+    the ``paddle_tpu_amp_scale`` gauge, and skipped steps count in
+    ``paddle_tpu_amp_skipped_steps_total`` — a run silently wedged at
+    scale 1 with every step skipped is diagnosable from /metrics alone.
+    """
+
+    def __init__(self, init_loss_scaling=2 ** 15, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                 decr_ratio=0.8, min_scale=1.0):
+        self.scale = float(init_loss_scaling)
+        self.incr_every_n_steps = int(incr_every_n_steps)
+        self.decr_every_n_nan_or_inf = max(int(decr_every_n_nan_or_inf), 1)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
+        self.min_scale = float(min_scale)
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._step = 0
+        from . import monitor as _monitor
+        self._gauge = _monitor.REGISTRY.gauge(
+            "paddle_tpu_amp_scale",
+            "current dynamic loss scale (fp16 AMP); a scale pinned at "
+            "its minimum with skipped steps climbing means the model "
+            "is producing non-finite grads every step")
+        self._skip_ctr = _monitor.REGISTRY.counter(
+            "paddle_tpu_amp_skipped_steps_total",
+            "optimizer steps SKIPPED by dynamic loss scaling "
+            "(non-finite gradients at the current scale)")
+        self._gauge.set(self.scale)
+
+    def _event(self, kind, value=None, detail=None):
+        from .analysis import numerics as _numerics
+        _numerics.record_anomaly(
+            kind, step=self._step, value=value,
+            detail=dict(detail or (), scale=self.scale),
+            instant="amp.loss_scale")
+
+    def update(self, found_inf) -> bool:
+        """Feed one step's found-non-finite verdict; returns True when
+        the step's update should be APPLIED, False when it must be
+        skipped (grads were non-finite at the current scale)."""
+        self._step += 1
+        if bool(found_inf):
+            self._good_steps = 0
+            self._bad_steps += 1
+            self._skip_ctr.inc()
+            if self._bad_steps >= self.decr_every_n_nan_or_inf:
+                self._bad_steps = 0
+                old = self.scale
+                self.scale = max(self.scale * self.decr_ratio,
+                                 self.min_scale)
+                self._gauge.set(self.scale)
+                self._event("loss_scale_decreased", value=self.scale,
+                            detail={"from": old})
+            else:
+                self._event("step_skipped", value=self.scale)
+            return False
+        self._bad_steps = 0
+        self._good_steps += 1
+        if self._good_steps >= self.incr_every_n_steps:
+            self._good_steps = 0
+            old = self.scale
+            self.scale = self.scale * self.incr_ratio
+            self._gauge.set(self.scale)
+            self._event("loss_scale_increased", value=self.scale,
+                        detail={"from": old})
+        return True
+
+
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
              incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
              incr_ratio=2.0, decr_ratio=0.8,
              use_dynamic_loss_scaling=True):
     """ref decorator.py:27 — returns an optimizer whose minimize() enables
     bf16 AMP on the program.  bf16 needs no loss scaling (unlike the
-    reference's fp16), so the scaling knobs are accepted for API parity and
-    recorded on the wrapper."""
+    reference's fp16) so the lowering never applies the scale, but the
+    scaler STATE MACHINE is real (``.loss_scaler``): fp16-policy callers
+    drive it with per-step found-inf verdicts and get the skip/halve/
+    grow protocol plus its telemetry (``amp.loss_scale`` instants,
+    ``paddle_tpu_amp_scale`` gauge, skipped-step counter)."""
 
     class _AmpOptimizer:
         def __init__(self, inner):
             self._inner = inner
-            self._loss_scaling = init_loss_scaling
+            self.loss_scaler = (
+                DynamicLossScaler(
+                    init_loss_scaling=init_loss_scaling,
+                    incr_every_n_steps=incr_every_n_steps,
+                    decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+                    incr_ratio=incr_ratio, decr_ratio=decr_ratio)
+                if use_dynamic_loss_scaling else None)
+
+        @property
+        def _loss_scaling(self):
+            return (self.loss_scaler.scale
+                    if self.loss_scaler is not None
+                    else float(init_loss_scaling))
 
         def __getattr__(self, name):
             return getattr(self._inner, name)

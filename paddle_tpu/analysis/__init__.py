@@ -27,6 +27,13 @@ time, before anything is lowered).
   ``FLAGS_cost_crosscheck`` parity gate against XLA's own
   ``compiled.cost_analysis()``, and the fusion pass's candidate
   ranking.
+- :mod:`paddle_tpu.analysis.numerics` — the numerics observability
+  plane (``FLAGS_numerics``): in-graph tensor-health statistics packed
+  into one per-step output (NaN/Inf sentinels, grad norms, update
+  ratios, dynamic-range histograms), the anomaly engine (spike
+  detection, profiler auto-capture, checkpoint quarantine), and the
+  ``gnorm``/``nanf`` gang-digest keys — the value-domain counterpart of
+  the cost/attribution plane.
 - :mod:`paddle_tpu.analysis.fusion` — the cost-guided training-safe
   graph fusion pass (``FLAGS_graph_fusion``): PDPattern-matched
   candidates (conv+bn+relu, dense epilogues, embedding+layernorm),
@@ -40,6 +47,10 @@ from .fusion import (  # noqa: F401
     FusionDecision, FusionReport, analyze_program, fuse_program,
 )
 from .memory import MemoryPlan, plan_memory  # noqa: F401
+from .numerics import (  # noqa: F401
+    NumericsEngine, NumericsFrame, StatsLayout, loss_fingerprint,
+    plan_numerics, record_anomaly,
+)
 from .verifier import (  # noqa: F401
     CHECKS, Diagnostic, ProgramVerificationError, VerifyResult,
     clear_cache, collective_fingerprint, dynamic_int64_feeds,
@@ -48,8 +59,10 @@ from .verifier import (  # noqa: F401
 
 __all__ = [
     "CHECKS", "CostPlan", "Diagnostic", "FusionDecision", "FusionReport",
-    "MemoryPlan", "ProgramVerificationError", "VerifyResult",
+    "MemoryPlan", "NumericsEngine", "NumericsFrame",
+    "ProgramVerificationError", "StatsLayout", "VerifyResult",
     "analyze_program", "clear_cache", "collective_fingerprint",
     "device_peak_flops", "dynamic_int64_feeds", "fuse_program",
-    "plan_cost", "plan_memory", "verify_or_raise", "verify_program",
+    "loss_fingerprint", "plan_cost", "plan_memory", "plan_numerics",
+    "record_anomaly", "verify_or_raise", "verify_program",
 ]

@@ -229,6 +229,17 @@ class CompiledProgram:
                     if changed:
                         with _timed("to_program"):
                             prog = g.to_program()
+                    from .analysis import numerics as _numerics
+                    if _numerics.mode() != "off":
+                        # stat-capture slot AFTER fusion: the numerics
+                        # census must see the vars the REWRITTEN
+                        # program actually produces (fused grad names),
+                        # not the pre-fusion chain it replaced.
+                        # Advisory stamp — the trace-time builder
+                        # intersects it with the live value env.
+                        with _timed("numerics_spec"):
+                            prog._attrs["numerics"] = \
+                                _numerics.plan_numerics(prog, fetch_names)
                     return prog
                 finally:
                     if _monitor.TRACER.enabled:

@@ -499,15 +499,19 @@ class GangCoordinator:
         rank = int(req["rank"])
         digest = req.get("digest")
         digest_ok = False
+        digest_capped = False
         if isinstance(digest, dict):
             # server-side byte-cap enforcement: an oversized digest is
-            # REFUSED (counted) while the beat itself still refreshes
-            # liveness — digest validity must never cost a rank its life
+            # CAPPED with the same priority-ordered key dropping the
+            # client applies (counted; the beat itself always refreshes
+            # liveness) — refusing the whole digest would blind the
+            # skew/straggler/NaN plane to exactly the rank whose client
+            # mis-sized its payload
             if len(json.dumps(digest, sort_keys=True)) \
-                    <= _monitor.DIGEST_MAX_BYTES:
-                digest_ok = True
-            else:
-                digest = None
+                    > _monitor.DIGEST_MAX_BYTES:
+                digest = _monitor.capped_digest(digest) or None
+                digest_capped = True
+            digest_ok = digest is not None
         else:
             # a beat WITHOUT a digest CLEARS the stored one: a rank
             # whose executor retired (metrics_digest() now empty) must
@@ -537,10 +541,10 @@ class GangCoordinator:
             self._check_fingerprints_locked()
             view = self._gang_view_locked()
         _monitor.GANG_HB_CTR.inc(1, role="coordinator")
+        if digest_capped:
+            _monitor.GANG_DIGEST_OVERSIZE_CTR.inc()
         if digest_ok:
             self._fold_digest(rank, digest)
-        elif isinstance(req.get("digest"), dict):
-            _monitor.GANG_DIGEST_OVERSIZE_CTR.inc()
         if req.get("step") is not None or digest_changed:
             self._refresh_gang_gauges()
         return {"ok": True, **view}
@@ -559,6 +563,10 @@ class GangCoordinator:
         "occ": _monitor.GANG_RANK_OCC,
         "slots": _monitor.GANG_RANK_FREE_SLOTS,
         "tps": _monitor.GANG_RANK_TPS,
+        # numerics plane: grad-norm + cumulative non-finite count — the
+        # "which rank is NaN'ing" columns gangtop renders
+        "gnorm": _monitor.GANG_RANK_GNORM,
+        "nanf": _monitor.GANG_RANK_NANF,
     }
 
     def _fold_digest(self, rank: int, digest: dict) -> None:

@@ -262,6 +262,31 @@ _DEFAULTS: Dict[str, Any] = {
     # the fleet (scrapers/balancers are off-box); set 127.0.0.1 to keep
     # it loopback-only.  Only consulted when the port is enabled.
     "FLAGS_metrics_host": "0.0.0.0",
+    # -- numerics observability plane (analysis.numerics) ------------------
+    # in-graph tensor-health statistics folded into one packed output per
+    # lowered step: "off" (default, zero cost), "sentinel" (NaN/Inf
+    # trips for gradients + weight state and the global grad norm, one
+    # reduction per tensor — the cheap always-on tier; no absmax, no
+    # activations), "full" (adds per-variable grad norms/absmax,
+    # weight-update ratios ‖Δw‖/‖w‖, activation absmax and log2
+    # dynamic-range histograms).
+    # Stats ride the PR-1 lazy-fetch path: the training thread never
+    # syncs on them.  The mode is part of the executor's compiled-block
+    # key, so flipping it re-lowers cleanly.
+    "FLAGS_numerics": "off",
+    # spike detection: a per-variable grad norm above spike_factor x its
+    # windowed median fires a numerics.anomaly record (hysteresis
+    # re-arms at factor/2); window is the median's sample count
+    "FLAGS_numerics_spike_factor": 10.0,
+    "FLAGS_numerics_window": 16,
+    # bounded per-variable gauge series: only the top-K variables by
+    # grad norm / update ratio hold registry series at a time (churn
+    # folds out — PR-2 retirement semantics)
+    "FLAGS_numerics_topk": 8,
+    # checkpoint quarantine: a NaN/Inf-poisoned step HOLDS CheckpointDaemon
+    # commits so the (gang) manifest never advances past the last
+    # healthy step.  Disable only if you want poisoned snapshots.
+    "FLAGS_numerics_quarantine": True,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -335,6 +360,19 @@ def _apply_side_effects(name: str, value):
             int(fl["FLAGS_profile_sample_max_windows"]),
             regress_frac=float(
                 fl["FLAGS_profile_sample_regress_frac"]))
+    elif name in ("FLAGS_numerics", "FLAGS_numerics_spike_factor",
+                  "FLAGS_numerics_window", "FLAGS_numerics_topk",
+                  "FLAGS_numerics_quarantine"):
+        from .analysis import numerics
+        fl = get_flags(["FLAGS_numerics", "FLAGS_numerics_spike_factor",
+                        "FLAGS_numerics_window", "FLAGS_numerics_topk",
+                        "FLAGS_numerics_quarantine"])
+        numerics.configure(
+            str(fl["FLAGS_numerics"]),
+            spike_factor=float(fl["FLAGS_numerics_spike_factor"]),
+            window=int(fl["FLAGS_numerics_window"]),
+            topk=int(fl["FLAGS_numerics_topk"]),
+            quarantine=bool(fl["FLAGS_numerics_quarantine"]))
     elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
         # the NATIVE ps client reads these via getenv (retry_times per
         # request, deadline at connect) — mirror flag changes into the
@@ -373,6 +411,11 @@ def set_flags(flags: Dict[str, Any]):
             # must not be stored to fail later at server construction
             from .serving.slo import parse_slo
             parse_slo(coerced[name])
+        if name == "FLAGS_numerics" and \
+                coerced[name] not in ("off", "sentinel", "full"):
+            raise ValueError(
+                "FLAGS_numerics must be 'off', 'sentinel' or 'full', "
+                f"got {coerced[name]!r}")
         if name == "FLAGS_watchdog_escalate" and \
                 coerced[name] not in ("", "abort"):
             raise ValueError(

@@ -286,6 +286,18 @@ def _fusion():
     return _fusion_mod[0]
 
 
+_numerics_mod = []
+
+
+def _numerics():
+    """Memoized analysis.numerics module (the hot path reads one mode
+    string per run; the engine consumes the lazily-fetched stats)."""
+    if not _numerics_mod:
+        from ..analysis import numerics
+        _numerics_mod.append(numerics)
+    return _numerics_mod[0]
+
+
 def _device_peak() -> float:
     """Memoized chip peak FLOP/s (the live-MFU denominator)."""
     if not _device_peak_cache:
@@ -806,7 +818,7 @@ class _CompiledBlock:
                  feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
                  persist_ro: Tuple[str, ...], persist_rw: Tuple[str, ...],
                  mesh=None, in_shardings=None, donate=True,
-                 collective=None, feed_ndims=None):
+                 collective=None, feed_ndims=None, numerics_mode="off"):
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.persist_ro = persist_ro
@@ -815,6 +827,15 @@ class _CompiledBlock:
         self._donating = bool(donate and persist_rw)
         block = program.blocks[block_idx]
         amp_on = bool(program._attrs.get("amp", False))
+        # numerics observability (analysis.numerics): the lowered step
+        # folds tensor-health stats into ONE extra packed output.  Mode
+        # is latched at trace time (it is part of the executor's cache
+        # key); the layout lands in a box the first trace fills, read
+        # back as `numerics_layout` after the first call.
+        num_on = numerics_mode != "off"
+        num_spec = program._attrs.get("numerics")
+        self._num_layout_box: list = []
+        self.numerics_layout = None
 
         collective_axis = "dp" if collective else None
 
@@ -848,6 +869,15 @@ class _CompiledBlock:
             # loops whose rw state the next step donates.  seed is always
             # a uint32 scalar here (_finish_run mints it).
             probe = seed + jnp.uint32(1)
+            if num_on:
+                # force=True keeps the output arity FIXED (out_shardings
+                # / shard_map out_specs are declared before tracing): a
+                # block with nothing to observe emits an all-zero header
+                layout, packed = _numerics().build_step_stats(
+                    state.values, state.written, feed_names, persist_rw,
+                    rw, new_rw, numerics_mode, spec=num_spec, force=True)
+                self._num_layout_box[:] = [layout]
+                return fetches, new_rw, probe, packed
             return fetches, new_rw, probe
 
         if collective:
@@ -888,7 +918,8 @@ class _CompiledBlock:
                 # independent seeds) — fold in the rank
                 rank_seed = seed + lax.axis_index("dp").astype(
                     jnp.uint32) * jnp.uint32(1000003)
-                fetches, new_rw, _ = step(feeds, ro, rw, rank_seed)
+                out = step(feeds, ro, rw, rank_seed)
+                fetches, new_rw = out[0], out[1]
                 synced_rw = []
                 for v, is_p in zip(new_rw, rw_is_param):
                     if is_p:
@@ -900,18 +931,27 @@ class _CompiledBlock:
                 # probe from the PRE-fold seed: replicated by construction
                 # (its per-rank counterpart diverges and would need a
                 # collective to satisfy the replicated out_spec)
-                return [f[None] for f in fetches], synced_rw, \
-                    seed + jnp.uint32(1)
+                res = ([f[None] for f in fetches], synced_rw,
+                       seed + jnp.uint32(1))
+                if len(out) == 4:
+                    # per-rank stats stack like fetches; the engine's
+                    # frame decoder combines them (counts sum, absmax
+                    # maxes) so a NaN on ANY rank trips the sentinel
+                    res = res + (out[3][None],)
+                return res
 
             # scalar feeds replicate; batched feeds shard on dim 0
             fspecs = [P("dp") if nd >= 1 else P()
                       for nd in (feed_ndims or [1] * len(feed_names))]
+            out_specs = ([P("dp")] * len(fetch_names),
+                         [P()] * len(persist_rw), P())
+            if num_on:
+                out_specs = out_specs + (P("dp"),)
             sm_kwargs = dict(
                 mesh=cmesh,
                 in_specs=(fspecs, [P()] * len(persist_ro),
                           [P()] * len(persist_rw), P()),
-                out_specs=([P("dp")] * len(fetch_names),
-                           [P()] * len(persist_rw), P()))
+                out_specs=out_specs)
             try:
                 inner = shard_map(sharded_step, check_vma=False, **sm_kwargs)
             except TypeError:  # older jax: the kwarg is check_rep
@@ -931,8 +971,11 @@ class _CompiledBlock:
             kwargs["in_shardings"] = in_shardings
             # updated state must come back in its declared layout, or the
             # next call's arg shardings mismatch the jit signature; the
-            # probe output is a replicated scalar (None = let GSPMD pick)
-            kwargs["out_shardings"] = (None, list(in_shardings[2]), None)
+            # probe output is a replicated scalar (None = let GSPMD pick),
+            # and so is the numerics stats vector when enabled
+            kwargs["out_shardings"] = (
+                (None, list(in_shardings[2]), None, None) if num_on
+                else (None, list(in_shardings[2]), None))
         if program._attrs.get("is_distributed") and \
                 jax.default_backend() != "cpu":
             # PS trainer programs embed host-RPC send/recv io_callbacks,
@@ -1197,8 +1240,13 @@ class Executor:
         # produce without touching the program fingerprint — stale plans
         # would silently run the old rewrite
         fus_tok = _fusion().config_token()
+        # numerics mode is read at trace time (step() folds the stats
+        # output in) — a FLAGS_numerics flip must re-lower, not reuse a
+        # block with the wrong output arity
+        num_tok = _numerics().mode()
         fast_key = (program.fingerprint(), tuple(feed), fetch_names,
-                    scope_tok, check_nan, cp_tok, coll_tok, fus_tok)
+                    scope_tok, check_nan, cp_tok, coll_tok, fus_tok,
+                    num_tok)
         plan = self._plans.get(fast_key)
         if plan is not None and plan.feed_sigs == tuple(
                 _feed_sig(feed[n]) for n in plan.feed_names):
@@ -1243,7 +1291,7 @@ class Executor:
         key = (program.fingerprint(), feed_names,
                tuple(_feed_sig(feed[n]) for n in feed_names),
                fetch_names, scope_tok, cp_tok, check_nan, coll_tok,
-               fus_tok)
+               fus_tok, num_tok)
         with self._lock:
             cb = self._cache.get(key)
             if cb is None:
@@ -1259,7 +1307,8 @@ class Executor:
                     tuple(ro), tuple(rw), mesh=mesh,
                     in_shardings=shardings, collective=collective,
                     feed_ndims=tuple(len(_feed_sig(feed[n])[0])
-                                     for n in feed_names))
+                                     for n in feed_names),
+                    numerics_mode=num_tok)
                 cb.rw_read = frozenset(n for n in rw if n in read_set)
                 # first call pays trace+compile: _finish_run times it and
                 # records the persistent-cache outcome (compile telemetry)
@@ -1342,6 +1391,22 @@ class Executor:
                         "buffers, so aliased scope entries are invalid — "
                         "np.copy() the value when duplicating it")
 
+        try:
+            # value-domain fault drill (tools/numerics_smoke.py): the
+            # 'numerics.poison' site corrupts one float rw persistable
+            # INPUT the way a bf16 overflow inside the step would — an
+            # async device op; the poisoned step's OWN stats frame shows
+            # the NaN, so the numerics plane (not this hook) detects it
+            # and quarantines the step before its capture can commit
+            _resil.maybe_inject("numerics.poison")
+        except _resil.InjectedFault:
+            rw_vals = list(rw_vals)
+            for i, v in enumerate(rw_vals):
+                if hasattr(v, "dtype") and getattr(v, "ndim", 0) >= 1 \
+                        and jnp.issubdtype(v.dtype, jnp.floating):
+                    rw_vals[i] = v * jnp.asarray(
+                        float("nan"), dtype=v.dtype)
+                    break
         if cb.collective_nranks:
             # FLAGS_gang_step_barrier: fingerprint-checked gang barrier
             # BEFORE the dispatch — divergent programs refuse here
@@ -1422,8 +1487,12 @@ class Executor:
                     jax.profiler.StepTraceAnnotation(
                         "paddle_tpu.step", step_num=step_id):
                 _resil.maybe_inject("executor.dispatch")
-                fetches, new_rw, probe = cb(feeds, ro_vals, rw_vals,
-                                            seed_arr)
+                out = cb(feeds, ro_vals, rw_vals, seed_arr)
+                if len(out) == 4:
+                    fetches, new_rw, probe, num_stats = out
+                else:
+                    fetches, new_rw, probe = out
+                    num_stats = None
         except Exception as e:
             # never cache a block whose trace failed (a later run with a
             # fixed scope/feed must re-lower); drop plans pointing at it
@@ -1557,6 +1626,17 @@ class Executor:
                                   cost[0] / med / cost[1])
         _maybe_sample_step(step_id,
                            med * 1e3 if med is not None else None)
+        # -- numerics observability (analysis.numerics) --------------------
+        # the packed stats vector is an in-flight device array: hand it
+        # to the engine and poll — ready frames are decoded, pending ones
+        # stay lazy (zero host syncs on this thread in steady state)
+        if num_stats is not None:
+            num_layout = cb.numerics_layout
+            if num_layout is None and cb._num_layout_box:
+                num_layout = cb.numerics_layout = cb._num_layout_box[0]
+            if num_layout is not None:
+                _numerics().ENGINE.note_step(step_id, num_stats,
+                                             num_layout)
         for n, v in zip(cb.persist_rw, new_rw):
             scope.set_var(n, v)
         if self._step_hooks:

@@ -409,9 +409,10 @@ GANG_FP_CTR = REGISTRY.counter(
 # reason as the families above: both socket ends touch them.
 
 #: serialized digest size cap: a gang control frame stays tiny by
-#: contract — the client drops keys to fit, the coordinator REFUSES
-#: oversized digests outright (a compat guard against a future client
-#: stuffing the liveness plane)
+#: contract — the client drops keys to fit, and the coordinator CAPS
+#: anything still over with the same priority-ordered dropping
+#: (counted; a compat guard against a future client stuffing the
+#: liveness plane)
 DIGEST_MAX_BYTES = 512
 
 GANG_RANK_STEP_MS = REGISTRY.gauge(
@@ -445,15 +446,27 @@ GANG_RANK_TPS = REGISTRY.gauge(
     "paddle_tpu_gang_rank_tokens_per_s",
     "per-rank decode throughput (generated tokens/s, windowed) from the "
     "heartbeat digest", ("rank",))
+GANG_RANK_GNORM = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_grad_norm",
+    "per-rank global gradient L2 norm from the heartbeat digest "
+    "(numerics plane 'gnorm' key) — a rank whose norm diverges from "
+    "its peers is de-synced or about to blow up", ("rank",))
+GANG_RANK_NANF = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_nonfinite",
+    "per-rank cumulative non-finite element count from the heartbeat "
+    "digest (numerics plane 'nanf' key) — nonzero on exactly one rank "
+    "fingers the chip/input producing the NaNs", ("rank",))
 GANG_DIGEST_CTR = REGISTRY.counter(
     "paddle_tpu_gang_digests_total",
     "heartbeat metrics digests accepted by the coordinator, per rank",
     ("rank",))
 GANG_DIGEST_OVERSIZE_CTR = REGISTRY.counter(
     "paddle_tpu_gang_digest_oversize_total",
-    "heartbeat digests REFUSED for exceeding DIGEST_MAX_BYTES "
-    "serialized (the beat itself is still accepted — liveness never "
-    "rides on digest validity)")
+    "heartbeat digests that exceeded DIGEST_MAX_BYTES serialized and "
+    "were CAPPED server-side with the same priority-ordered key "
+    "dropping the client applies (the surviving keys still feed the "
+    "per-rank gauges; the beat itself is always accepted — liveness "
+    "never rides on digest validity)")
 GANG_STEP_SKEW_GAUGE = REGISTRY.gauge(
     "paddle_tpu_gang_step_skew",
     "max-min current training step across LIVE ranks (degraded-aware: "
@@ -537,24 +550,41 @@ def metrics_digest() -> Dict[str, Any]:
             cells = [cell.get() for _, cell in fam.series()]
             if cells:
                 digest[key] = round(float(cells[-1]), 3)
+    # numerics plane (this PR): global grad norm + cumulative non-finite
+    # count, presence-gated on the numerics engine having published —
+    # the fleet-wide "which rank is producing NaNs" signal.  nanf rides
+    # whenever gnorm does (a healthy 0 is the signal's baseline).
+    gn = REGISTRY.get("paddle_tpu_numerics_global_grad_norm")
+    if gn is not None:
+        cells = [cell.get() for _, cell in gn.series()]
+        if cells:
+            digest["gnorm"] = round(float(cells[-1]), 4)
+            nf = REGISTRY.get("paddle_tpu_numerics_nonfinite_total")
+            if nf is not None:
+                digest["nanf"] = int(sum(
+                    cell.get() for _, cell in nf.series()))
     return digest
 
 
 #: digest keys the gang skew/straggler plane reads, most important
 #: first — capped_digest sheds from the BOTTOM of this list, and sheds
-#: keys not on it before any that are
-_DIGEST_PRIORITY = ("step_ms", "mfu", "srv_q", "queue", "inflight",
-                    "occ", "slots", "tps", "steps")
+#: keys not on it before any that are.  nanf/gnorm rank right after the
+#: straggler inputs: a NaN'ing rank must stay identifiable fleet-wide
+#: even under the byte cap.
+_DIGEST_PRIORITY = ("step_ms", "nanf", "gnorm", "mfu", "srv_q", "queue",
+                    "inflight", "occ", "slots", "tps", "steps")
 
 
 def capped_digest(digest: Dict[str, Any],
                   max_bytes: int = DIGEST_MAX_BYTES) -> Dict[str, Any]:
-    """Enforce the serialized digest byte cap client-side by dropping
-    keys until the JSON fits: unknown extras first (reverse-sorted, so
-    the order is deterministic), then known keys from least to most
-    important — ``step_ms``, the input the whole straggler plane runs
-    on, is the LAST to go.  The coordinator re-checks and refuses
-    anything still over."""
+    """Enforce the serialized digest byte cap by dropping keys until
+    the JSON fits: unknown extras first (reverse-sorted, so the order
+    is deterministic), then known keys from least to most important —
+    ``step_ms``, the input the whole straggler plane runs on, is the
+    LAST to go.  Both socket ends use it: the client caps before
+    sending, and the coordinator re-applies it to anything still over
+    (counted in ``paddle_tpu_gang_digest_oversize_total``) instead of
+    refusing the digest."""
     d = dict(digest)
     while d and len(json.dumps(d, sort_keys=True)) > max_bytes:
         extras = sorted((k for k in d if k not in _DIGEST_PRIORITY),
@@ -691,7 +721,8 @@ def retire_gang_rank_series(rank) -> None:
     GANG_DIGEST_CTR.fold(src, {"rank": "retired"})
     for g in (GANG_RANK_STEP_MS, GANG_RANK_MFU, GANG_RANK_QUEUE,
               GANG_RANK_INFLIGHT, GANG_RANK_SRVQ, GANG_RANK_OCC,
-              GANG_RANK_FREE_SLOTS, GANG_RANK_TPS):
+              GANG_RANK_FREE_SLOTS, GANG_RANK_TPS, GANG_RANK_GNORM,
+              GANG_RANK_NANF):
         g.fold(src, None)
 
 

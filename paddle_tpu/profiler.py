@@ -352,6 +352,26 @@ class SamplingProfiler:
             self._regress_armed = True    # recovered: re-arm
         return False
 
+    def trigger_window(self, step_id=None, trigger: str = "anomaly") -> bool:
+        """Open a capture window NOW (no-op while one is active) — the
+        numerics anomaly engine's entry point: a NaN trip or grad-norm
+        spike captures exactly the poisoned steps, stamped with
+        ``trigger`` in the manifest.  Works with periodic sampling off:
+        the window still closes ``window_steps`` dispatches later (the
+        executor's per-dispatch hook keeps running while a window is
+        active).  Returns True iff a window opened."""
+        with self._mu:
+            if self._active is not None:
+                return False
+            if not self.base_dir:
+                self.base_dir = "pt_profile_samples"
+            if not self._atexit_armed:
+                import atexit
+                atexit.register(self.close)
+                self._atexit_armed = True
+            self._open_locked(int(step_id or 0), trigger=trigger)
+            return self._active is not None
+
     def close(self) -> None:
         """Finish any in-flight window (process exit / reconfigure).
         A window that observed NO steps is abandoned outright — an

@@ -183,6 +183,10 @@ KNOWN_SITES = (
     "ps.put_typed", "ps.get_typed", "ps.push_typed",
     "dataloader.produce", "compile", "executor.dispatch",
     "fetch.materialize", "checkpoint.write", "serving.decode_step",
+    # value-domain drill: corrupts one float rw persistable with NaN
+    # after a dispatched step — the numerics plane must DETECT it (the
+    # hook itself never raises out of the executor)
+    "numerics.poison",
 )
 
 _ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")
@@ -989,10 +993,39 @@ class CheckpointDaemon:
         step = int(step)
         if not self.due(step):
             return False
-        self.capture(step, scope=scope)
+        return self.capture(step, scope=scope)
+
+    @staticmethod
+    def _quarantined(step: int, kind: str) -> bool:
+        """Numerics quarantine gate: once the anomaly engine has the run
+        poisoned (NaN/Inf in grads/weights), HOLD every capture — a
+        snapshot of poisoned state advancing the (gang) manifest would
+        destroy the exact recovery floor quarantine exists to protect.
+        The engine is force-polled first: captures are rare, so the
+        materializing poll is off the steady-state path, and it closes
+        the race where the poisoning step's stats are still in flight
+        when its own capture comes due."""
+        try:
+            from .analysis import numerics as _numerics
+        except Exception:
+            return False
+        if _numerics.mode() == "off":
+            return False
+        try:
+            _numerics.ENGINE.poll(force=True)
+        except Exception:
+            pass
+        if not _numerics.is_poisoned():
+            return False
+        _numerics.QUARANTINE_CTR.inc()
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "checkpoint.quarantine_hold", "checkpoint",
+                {"step": int(step), "kind": kind,
+                 "poisoned_since": _numerics.poisoned_since()})
         return True
 
-    def capture(self, step: int, scope=None, kind: str = "daemon") -> None:
+    def capture(self, step: int, scope=None, kind: str = "daemon") -> bool:
         """Snapshot every persistable at a (consistent) step boundary —
         device arrays via async on-device copies, host arrays via host
         copies.  Default mode keeps every copy device-side (no sync on
@@ -1001,12 +1034,15 @@ class CheckpointDaemon:
         ``FLAGS_checkpoint_capture_chunk_mb`` > 0, copies are taken in
         bounded-size groups and each group is materialized to host
         before the next is copied, so the extra HBM is capped at the
-        chunk size (the per-chunk device→host sync lands here)."""
+        chunk size (the per-chunk device→host sync lands here).
+        Returns False when the numerics quarantine HELD the capture."""
         from .framework.core import default_main_program
         from .framework.scope import global_scope
         from .io import get_program_persistable_vars
         import jax
         import jax.numpy as jnp
+        if self._quarantined(step, kind):
+            return False
         t0 = time.perf_counter()
         program = self.program or default_main_program()
         scope = scope or self.scope or global_scope()
@@ -1060,6 +1096,7 @@ class CheckpointDaemon:
                 "checkpoint.capture", "checkpoint", t0,
                 time.perf_counter(), args)
         self._wake.set()
+        return True
 
     # -- daemon-thread side --------------------------------------------------
     def _loop(self) -> None:

@@ -563,6 +563,7 @@ class DecodeScheduler:
                     {"iter": self._iter, "occupancy": len(stepped)})
             if logits is None:
                 continue
+            self._logits_sentinel(logits, stepped)
             BATCHES_CTR.inc(1, bucket="decode")
             OCCUPANCY_HIST.observe(float(len(stepped)), mode="decode")
             _monitor.SERVING_LAST_OCC_GAUGE.set(float(len(stepped)))
@@ -621,6 +622,27 @@ class DecodeScheduler:
                     self._pending -= len(failed)
                     self._cv.notify_all()
                 return None
+
+    def _logits_sentinel(self, logits, stepped) -> None:
+        """Decode-path numerics sentinel (behind ``FLAGS_numerics``): a
+        non-finite logit means the model/KV state is poisoned and every
+        argmax downstream of it is garbage — count it per class
+        ('logits') and emit ONE anomaly record per episode.  The logits
+        are already host-side at argmax time, so the scan costs one
+        vectorized pass, no device sync."""
+        try:
+            from ..analysis import numerics as _numerics
+            if _numerics.mode() == "off":
+                return
+            sub = logits[stepped] if len(stepped) < logits.shape[0] \
+                else logits
+            bad = int(sub.size - np.count_nonzero(np.isfinite(sub)))
+            _numerics.note_nonfinite(
+                "logits", bad, step=self._iter,
+                detail={"slots": list(map(int, stepped))} if bad
+                else None)
+        except Exception:
+            pass            # the sentinel must never fail a decode step
 
     def _update_token_rate(self, now: float, n_gen: int,
                            window_s: float = 5.0) -> None:

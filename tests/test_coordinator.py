@@ -940,7 +940,7 @@ def test_gang_skew_and_straggler_gauge_math():
         coord.stop()
 
 
-def test_digest_byte_cap_client_truncates_server_refuses():
+def test_digest_byte_cap_client_truncates_server_caps():
     # client side: capped_digest drops keys deterministically until the
     # serialized form fits
     big = {f"k{i:03d}": 1.0 for i in range(200)}
@@ -948,8 +948,10 @@ def test_digest_byte_cap_client_truncates_server_refuses():
     assert len(json.dumps(capped, sort_keys=True)) <= \
         monitor.DIGEST_MAX_BYTES
     assert capped and set(capped) < set(big)
-    # server side: an OVERSIZED digest in a hand-rolled beat is refused
-    # (counted) while the beat itself still refreshes liveness
+    # server side: an OVERSIZED digest in a hand-rolled beat is CAPPED
+    # with the same priority-ordered key dropping (counted) instead of
+    # refused outright — the high-priority keys (step_ms, nanf) must
+    # survive, the beat always refreshes liveness
     before = _totals()
     coord = GangCoordinator(world_size=1, heartbeat_timeout_s=30).start()
     try:
@@ -957,14 +959,23 @@ def test_digest_byte_cap_client_truncates_server_refuses():
             ("127.0.0.1", coord.port), timeout=5)
         try:
             send_frame(s, {"op": "heartbeat", "rank": 0, "step": 7,
-                           "digest": {"blob": "x" * 2048}})
+                           "digest": {"step_ms": 12.5, "nanf": 3,
+                                      **{f"blob{i:03d}": 1.0
+                                         for i in range(200)}}})
             resp = recv_frame(s)
             assert resp["ok"]
         finally:
             s.close()
         st = coord._ranks[0]
-        assert st["digest"] is None           # refused, not stored
+        assert st["digest"] is not None       # capped, not refused
+        assert st["digest"]["step_ms"] == 12.5
+        assert st["digest"]["nanf"] == 3
+        assert len(json.dumps(st["digest"], sort_keys=True)) <= \
+            monitor.DIGEST_MAX_BYTES
         assert st["cur_step"] == 7            # the beat still landed
+        # the capped digest still feeds the per-rank gauges
+        assert monitor.GANG_RANK_STEP_MS.value(rank="0") == 12.5
+        assert monitor.GANG_RANK_NANF.value(rank="0") == 3
         after = _totals()
         assert _delta(before, after,
                       "paddle_tpu_gang_digest_oversize_total") == 1

@@ -1,0 +1,448 @@
+"""Numerics observability plane (analysis.numerics): in-graph stats
+packing, anomaly engine (sentinel trips, spike detection, hysteresis),
+checkpoint quarantine, bounded top-K gauge series, digest keys, and the
+amp loss-scale event satellite."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor
+from paddle_tpu.analysis import numerics
+from paddle_tpu.analysis.numerics import (
+    ENGINE, HIST_BINS, NumericsFrame, StatsLayout, build_step_stats,
+    loss_fingerprint)
+from paddle_tpu.framework import (Program, Scope, program_guard,
+                                  scope_guard)
+
+
+@pytest.fixture(autouse=True)
+def _numerics_isolation():
+    """Every test starts with a clean engine and ends with the plane
+    off (the global flags/state must not leak across tests)."""
+    ENGINE.reset()
+    yield
+    pt.set_flags({"FLAGS_numerics": "off",
+                  "FLAGS_numerics_spike_factor": 10.0,
+                  "FLAGS_numerics_window": 16,
+                  "FLAGS_numerics_topk": 8,
+                  "FLAGS_numerics_quarantine": True})
+    ENGINE.reset()
+
+
+def _frame(layout, vec, step=1):
+    return NumericsFrame(step, np.asarray(vec, np.float64), layout)
+
+
+# ---------------------------------------------------------------------------
+# packing / unpacking
+# ---------------------------------------------------------------------------
+
+def _pack(mode, values, written, rw=(), rw_in=(), rw_out=()):
+    import jax.numpy as jnp
+    values = {k: jnp.asarray(v) for k, v in values.items()}
+    rw_in = [jnp.asarray(v) for v in rw_in]
+    rw_out = [jnp.asarray(v) for v in rw_out]
+    return build_step_stats(values, set(written), (), tuple(rw),
+                            rw_in, rw_out, mode)
+
+
+def test_full_pack_unpack_roundtrip():
+    g = np.array([[1.0, -2.0], [2.0, 4.0]], np.float32)
+    act = np.array([0.5, -8.0, 0.25], np.float32)
+    w_old = np.ones((2, 2), np.float32)
+    w_new = w_old - 0.1
+    layout, packed = _pack(
+        "full",
+        {"w@GRAD": g, "h": act, "w": w_new},
+        ["w@GRAD", "h", "w"],
+        rw=["w"], rw_in=[w_old], rw_out=[w_new])
+    assert layout.mode == "full"
+    assert layout.grads == ("w@GRAD",)
+    assert layout.weights == ("w",)
+    assert packed.shape == (layout.size,)
+    f = _frame(layout, np.asarray(packed), step=3)
+    assert f.step == 3
+    assert f.nonfinite == 0
+    assert f.global_gnorm == pytest.approx(np.sqrt((g ** 2).sum()))
+    assert f.grads["w@GRAD"]["norm"] == pytest.approx(
+        np.sqrt((g ** 2).sum()))
+    assert f.grads["w@GRAD"]["absmax"] == pytest.approx(4.0)
+    assert f.act_absmax == pytest.approx(8.0)
+    # update ratio: ||dw|| / ||w_new||
+    exp = np.sqrt((0.1 ** 2 * 4) / (w_new ** 2).sum())
+    assert f.weights["w"]["update_ratio"] == pytest.approx(exp, rel=1e-5)
+    # dynamic-range histogram: counts every finite nonzero element
+    assert f.grad_hist.sum() == g.size
+    assert f.act_hist.sum() == act.size
+    assert NumericsFrame.range_bits(f.grad_hist) >= 2
+
+
+def test_full_counts_nonfinite_elements():
+    g = np.array([1.0, np.nan, np.inf, 2.0], np.float32)
+    layout, packed = _pack("full", {"w@GRAD": g}, ["w@GRAD"])
+    f = _frame(layout, np.asarray(packed))
+    assert f.nonfinite_grad == 2
+    assert f.grads["w@GRAD"]["nonfinite"] == 2
+    # non-finite elements never land in the histogram
+    assert f.grad_hist.sum() == 2
+
+
+def test_sentinel_is_tensor_level_and_cheap():
+    g_ok = np.ones((4,), np.float32)
+    g_bad = np.array([1.0, np.nan], np.float32)
+    layout, packed = _pack("sentinel", {"a@GRAD": g_ok, "b@GRAD": g_bad},
+                           ["a@GRAD", "b@GRAD"])
+    assert layout.mode == "sentinel"
+    assert layout.size == StatsLayout.HEADER
+    f = _frame(layout, np.asarray(packed))
+    assert f.nonfinite_grad == 1          # tensors, not elements
+    assert not f.grads                    # no per-var sections
+    assert not np.isfinite(f.global_gnorm)
+
+
+def test_sentinel_catches_poisoned_weight_state_not_just_grads():
+    # the relu-mask blind spot: NaN'd weight, clean (zero) grads
+    w_new = np.array([np.nan, 1.0], np.float32)
+    layout, packed = _pack("sentinel", {"w@GRAD": np.zeros(2, np.float32)},
+                           ["w@GRAD"], rw=["w"],
+                           rw_in=[np.ones(2, np.float32)],
+                           rw_out=[w_new])
+    f = _frame(layout, np.asarray(packed))
+    assert f.nonfinite_weight == 1
+    assert f.nonfinite > 0
+
+
+def test_empty_block_opts_out_unless_forced():
+    layout, packed = _pack("sentinel", {}, [])
+    assert layout is None and packed is None
+    import jax.numpy as jnp
+    layout, packed = build_step_stats({}, set(), (), (), [], [],
+                                      "sentinel", force=True)
+    assert layout is not None
+    assert np.asarray(packed).shape == (StatsLayout.HEADER,)
+    assert float(np.asarray(packed).sum()) == 0.0
+
+
+def test_rank_stacked_frame_combines():
+    g = np.array([3.0, 4.0], np.float32)       # norm 5
+    layout, packed = _pack("sentinel", {"w@GRAD": g}, ["w@GRAD"])
+    v = np.asarray(packed)
+    bad = v.copy()
+    bad[0] = 2.0                                # rank 1: 2 tripped tensors
+    stacked = np.stack([v, bad])
+    f = _frame(layout, stacked)
+    assert f.nonfinite_grad == 2                # counts SUM across ranks
+    assert f.global_gnorm == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# engine: sentinel trips, spikes, hysteresis, quarantine
+# ---------------------------------------------------------------------------
+
+def _full_frame_for(gnorms, step, nonfinite=0.0):
+    """Synthesize a full-mode frame with the given per-var grad norms."""
+    names = tuple(sorted(gnorms))
+    layout = StatsLayout("full", names, ())
+    vec = np.zeros(layout.size, np.float64)
+    vec[0] = nonfinite
+    vec[3] = sum(v * v for v in gnorms.values())
+    for i, n in enumerate(names):
+        vec[StatsLayout.HEADER + 3 * i] = gnorms[n] ** 2
+    return NumericsFrame(step, vec, layout)
+
+
+def test_engine_nonfinite_trip_latches_once_and_quarantines():
+    before = monitor.counter_totals()
+    for step in (5, 6, 7):
+        ENGINE._process(_full_frame_for({"w@GRAD": 1.0}, step,
+                                        nonfinite=3.0))
+    recs = [r for r in ENGINE.anomalies if r["kind"] == "nonfinite"]
+    assert len(recs) == 1                    # latched per episode
+    assert recs[0]["step"] == 5
+    assert numerics.is_poisoned()
+    assert numerics.poisoned_since() == 5
+    after = monitor.counter_totals()
+    assert after["paddle_tpu_numerics_anomalies_total"] - \
+        before.get("paddle_tpu_numerics_anomalies_total", 0) == 1
+    # the counter accumulated every frame's count regardless of latch
+    assert after["paddle_tpu_numerics_nonfinite_total"] - \
+        before.get("paddle_tpu_numerics_nonfinite_total", 0) == 9
+    numerics.clear_quarantine()
+    assert not numerics.is_poisoned()
+
+
+def test_engine_spike_detection_with_hysteresis():
+    pt.set_flags({"FLAGS_numerics_spike_factor": 10.0})
+    step = [0]
+
+    def feed(v):
+        step[0] += 1
+        ENGINE._process(_full_frame_for({"w@GRAD": v}, step[0]))
+
+    for _ in range(8):
+        feed(1.0)                           # build a stable median
+    assert not [r for r in ENGINE.anomalies if r["kind"] == "grad_spike"]
+    feed(50.0)                              # 50x the median: spike
+    spikes = [r for r in ENGINE.anomalies if r["kind"] == "grad_spike"]
+    assert len(spikes) == 1
+    assert spikes[0]["var"] == "w@GRAD"
+    assert spikes[0]["value"] == pytest.approx(50.0)
+    feed(49.0)                              # still high: disarmed, no spam
+    assert len([r for r in ENGINE.anomalies
+                if r["kind"] == "grad_spike"]) == 1
+    # spikes do NOT quarantine (values are finite)
+    assert not numerics.is_poisoned()
+    for _ in range(3):
+        feed(1.0)                           # recovered: re-arms
+    feed(60.0)
+    assert len([r for r in ENGINE.anomalies
+                if r["kind"] == "grad_spike"]) == 2
+
+
+def test_spike_window_does_not_self_legitimize():
+    """A sustained spike must not drag the median up to its own level:
+    the window freezes while tripped."""
+    step = [0]
+
+    def feed(v):
+        step[0] += 1
+        ENGINE._process(_full_frame_for({"w@GRAD": v}, step[0]))
+
+    for _ in range(8):
+        feed(1.0)
+    for _ in range(20):
+        feed(50.0)
+    win = ENGINE._windows["w@GRAD"]
+    assert sorted(win)[len(win) // 2] == pytest.approx(1.0)
+
+
+def test_checkpoint_daemon_holds_capture_while_poisoned():
+    from paddle_tpu.resilience import CheckpointDaemon
+
+    class _StubCkpt:
+        def __init__(self):
+            self.saved = []
+
+        def save_arrays(self, step, state, force=True, kind="daemon"):
+            self.saved.append(int(step))
+            return True
+
+        def wait_until_finished(self):
+            pass
+
+        def latest_step(self):
+            return max(self.saved) if self.saved else None
+
+    pt.set_flags({"FLAGS_numerics": "sentinel"})
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=4))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        ckpt = _StubCkpt()
+        daemon = CheckpointDaemon(ckpt, program=pt.default_main_program(),
+                                  scope=scope, interval_steps=1)
+        before = monitor.counter_totals()
+        assert daemon.step_completed(1, scope=scope)
+        ENGINE._process(_full_frame_for({"w@GRAD": 1.0}, 2,
+                                        nonfinite=1.0))
+        assert not daemon.step_completed(2, scope=scope)   # HELD
+        assert not daemon.step_completed(3, scope=scope)   # still held
+        after = monitor.counter_totals()
+        assert after["paddle_tpu_checkpoint_quarantine_holds_total"] - \
+            before.get("paddle_tpu_checkpoint_quarantine_holds_total",
+                       0) == 2
+        numerics.clear_quarantine()
+        assert daemon.step_completed(4, scope=scope)       # released
+        daemon.stop()
+        assert 2 not in ckpt.saved and 3 not in ckpt.saved
+
+
+# ---------------------------------------------------------------------------
+# bounded top-K gauge series (PR-2 retirement semantics)
+# ---------------------------------------------------------------------------
+
+def test_topk_gauge_churn_stays_bounded_and_totals_exact():
+    """Satellite: 200 synthetic vars churning through the per-variable
+    gauges leave the registry bounded at K series and counter_totals()
+    exact."""
+    pt.set_flags({"FLAGS_numerics_topk": 5})
+    before = monitor.counter_totals()
+    total_nf = 0
+    for step in range(1, 201):
+        name = f"var_{step:03d}@GRAD"
+        nf = step % 3
+        total_nf += nf
+        ENGINE._process(_full_frame_for({name: float(step)}, step,
+                                        nonfinite=float(nf)))
+        ENGINE._class_tripped.clear()   # each frame = its own episode
+    gnorm_series = [lbl for lbl, _ in
+                    numerics.NUM_GNORM_GAUGE.series()]
+    absmax_series = [lbl for lbl, _ in
+                     numerics.NUM_ABSMAX_GAUGE.series()]
+    assert len(gnorm_series) <= 5
+    assert len(absmax_series) <= 5
+    # the survivor is the current frame's var (top-K of the last frame)
+    assert {"var": "var_200@GRAD"} in gnorm_series
+    after = monitor.counter_totals()
+    assert after["paddle_tpu_numerics_nonfinite_total"] - \
+        before.get("paddle_tpu_numerics_nonfinite_total", 0) == total_nf
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the executor
+# ---------------------------------------------------------------------------
+
+def _train_once(mode, steps=6, seed=3):
+    pt.set_flags({"FLAGS_numerics": mode})
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        pt.default_main_program().random_seed = seed
+        pt.default_startup_program().random_seed = seed
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        loss = layers.mean(layers.fc(h, size=4))
+        pt.optimizer.SGD(0.05).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        feed = {"x": np.linspace(-1, 1, 4 * 8,
+                                 np.float32).astype(np.float32)
+                .reshape(4, 8)}
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+            losses.append(float(np.asarray(lv)))
+        ENGINE.poll(force=True)
+        return losses, exe
+
+
+def test_end_to_end_full_mode_publishes_per_var_stats():
+    losses, _ = _train_once("full")
+    f = ENGINE.last_frame
+    assert f is not None and f.grads
+    assert any(n.endswith("@GRAD") for n in f.grads)
+    assert f.weights and all(
+        0 < w["update_ratio"] < 1 for w in f.weights.values())
+    assert monitor.REGISTRY.get(
+        "paddle_tpu_numerics_global_grad_norm").value() > 0
+    assert ENGINE.frames_processed >= 6
+    # dynamic-range gauge populated for both classes
+    assert monitor.REGISTRY.get(
+        "paddle_tpu_numerics_dynamic_range_bits").value(
+        var_class="grad") > 0
+
+
+def test_loss_parity_across_modes():
+    """The stats are pure observers: identical trajectories, and the
+    fingerprint (the quantized-collectives parity gate) pins it."""
+    base, _ = _train_once("off")
+    for mode in ("sentinel", "full"):
+        ENGINE.reset()
+        got, _ = _train_once(mode)
+        assert loss_fingerprint(got) == loss_fingerprint(base), mode
+
+
+def test_mode_flip_relowers_block():
+    _, exe = _train_once("off", steps=2)
+    # same program shape under a different mode must re-trace (the mode
+    # is part of the cache key), not reuse the 3-output block
+    ENGINE.reset()
+    _train_once("sentinel", steps=2)
+    assert ENGINE.frames_processed >= 2
+
+
+def test_digest_carries_gnorm_and_nanf():
+    _train_once("sentinel", steps=3)
+    d = monitor.metrics_digest()
+    assert "gnorm" in d and d["gnorm"] >= 0
+    # nanf is the CUMULATIVE process count (monotonic, like any counter)
+    assert "nanf" in d and d["nanf"] == int(
+        monitor.counter_totals()["paddle_tpu_numerics_nonfinite_total"])
+    capped = monitor.capped_digest(
+        dict(d, **{f"extra{i:02d}": float(i) for i in range(100)}))
+    assert len(json.dumps(capped, sort_keys=True)) <= \
+        monitor.DIGEST_MAX_BYTES
+    # satellite regression: with EVERY known digest key present next to
+    # the srv_* serving keys, the serialized digest fits the 512-byte
+    # cap with room to spare, and the priority order keeps nanf/gnorm
+    # ahead of the serving load keys under a tiny cap
+    full = {"step_ms": 1234.567, "mfu": 0.54321, "srv_q": 123.0,
+            "queue": 12.0, "inflight": 2, "occ": 7.5, "slots": 3.0,
+            "tps": 512.25, "steps": 123456, "gnorm": 1234.5678,
+            "nanf": 99999}
+    assert len(json.dumps(full, sort_keys=True)) <= \
+        monitor.DIGEST_MAX_BYTES
+    tiny = monitor.capped_digest(full, max_bytes=40)
+    assert "step_ms" in tiny
+    assert "nanf" in tiny
+    assert "tps" not in tiny and "steps" not in tiny
+
+
+def test_serving_logits_sentinel_records_and_unlatches():
+    numerics.note_nonfinite("logits", 5, step=7, detail={"slots": [0]})
+    recs = [r for r in ENGINE.anomalies
+            if r["kind"] == "nonfinite_logits"]
+    assert len(recs) == 1 and recs[0]["value"] == 5
+    numerics.note_nonfinite("logits", 2, step=8)
+    assert len([r for r in ENGINE.anomalies
+                if r["kind"] == "nonfinite_logits"]) == 1   # latched
+    numerics.note_nonfinite("logits", 0, step=9)            # clean
+    numerics.note_nonfinite("logits", 1, step=10)
+    assert len([r for r in ENGINE.anomalies
+                if r["kind"] == "nonfinite_logits"]) == 2
+    # out-of-graph sentinels never quarantine the checkpoint plane
+    assert not numerics.is_poisoned()
+    assert numerics.NONFINITE_CTR.value(var_class="logits") == 8
+
+
+def test_flag_validation():
+    with pytest.raises(ValueError):
+        pt.set_flags({"FLAGS_numerics": "everything"})
+    assert pt.get_flags("FLAGS_numerics")["FLAGS_numerics"] == "off"
+
+
+# ---------------------------------------------------------------------------
+# amp loss-scale events (satellite)
+# ---------------------------------------------------------------------------
+
+def test_amp_dynamic_loss_scaler_events_and_gauge():
+    from paddle_tpu.amp import DynamicLossScaler
+    before = monitor.counter_totals()
+    s = DynamicLossScaler(init_loss_scaling=1024.0, incr_every_n_steps=3,
+                          decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                          decr_ratio=0.5)
+    assert monitor.REGISTRY.get("paddle_tpu_amp_scale").value() == 1024.0
+    assert s.update(False)
+    assert not s.update(True)               # skip 1: no decr yet
+    assert s.scale == 1024.0
+    assert not s.update(True)               # skip 2: halve
+    assert s.scale == 512.0
+    assert all(s.update(False) for _ in range(3))
+    assert s.scale == 1024.0                # grew back
+    after = monitor.counter_totals()
+    assert after["paddle_tpu_amp_skipped_steps_total"] - \
+        before.get("paddle_tpu_amp_skipped_steps_total", 0) == 2
+    kinds = [r["kind"] for r in ENGINE.anomalies]
+    assert "step_skipped" in kinds
+    assert "loss_scale_decreased" in kinds
+    assert "loss_scale_increased" in kinds
+    # the records reuse the numerics anomaly format (counted per kind)
+    delta = after["paddle_tpu_numerics_anomalies_total"] - \
+        before.get("paddle_tpu_numerics_anomalies_total", 0)
+    assert delta == 3
+
+
+def test_amp_decorate_wires_scaler():
+    from paddle_tpu import amp
+    opt = amp.decorate(pt.optimizer.SGD(0.1), init_loss_scaling=256.0,
+                       use_dynamic_loss_scaling=True)
+    assert opt.loss_scaler is not None
+    assert opt._loss_scaling == 256.0
+    nop = amp.decorate(pt.optimizer.SGD(0.1),
+                       use_dynamic_loss_scaling=False)
+    assert nop.loss_scaler is None

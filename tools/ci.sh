@@ -89,6 +89,9 @@ rm -rf "$an_tmp"
 echo "== fusion smoke (zero-fusion-when-disabled, verifier-clean-when-enabled, loss parity, autotune cache) =="
 JAX_PLATFORMS=cpu python tools/fusion_smoke.py
 
+echo "== numerics smoke (in-graph stats, NaN poison -> anomaly + capture window + checkpoint quarantine) =="
+JAX_PLATFORMS=cpu python tools/numerics_smoke.py
+
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 

@@ -58,7 +58,8 @@ def render(status: dict) -> str:
     ranks = status.get("ranks", {})
     rows = []
     header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
-              f"{'STEP_MS':>9} {'MFU%':>6} {'QUEUE':>5} {'INFL':>4} "
+              f"{'STEP_MS':>9} {'MFU%':>6} {'GNORM':>8} {'NANF':>6} "
+              f"{'QUEUE':>5} {'INFL':>4} "
               f"{'SRVQ':>5} {'OCC':>5} {'SLOT':>4} {'TOK/S':>7} "
               f"{'HB_AGE':>7} {'DEATHS':>6}")
     rows.append(header)
@@ -74,10 +75,13 @@ def render(status: dict) -> str:
                  else "alive" if e.get("alive") else "DEAD")
         d = e.get("digest") or {}
         mfu = d.get("mfu")
+        nanf = d.get("nanf")
         line = (f"{r:>4}  {state:<8} {_fmt(e.get('cur_step'), '{}'):>8} "
                 f"{_fmt(e.get('step'), '{}'):>7} "
                 f"{_fmt(d.get('step_ms')):>9} "
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
+                f"{_fmt(d.get('gnorm'), '{:.3g}'):>8} "
+                f"{_fmt(nanf, '{:.0f}'):>6} "
                 f"{_fmt(d.get('queue'), '{:.0f}'):>5} "
                 f"{_fmt(d.get('inflight'), '{}'):>4} "
                 f"{_fmt(d.get('srv_q'), '{:.0f}'):>5} "
@@ -88,6 +92,8 @@ def render(status: dict) -> str:
                 f"{_fmt(e.get('deaths'), '{}'):>6}")
         if r == straggler:
             line += "   <-- straggler"
+        if isinstance(nanf, (int, float)) and nanf > 0:
+            line += "   <-- NONFINITE"
         rows.append(line)
     rows.append("")
     rows.append(f"gang: {status.get('status', '?')}"
