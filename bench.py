@@ -749,6 +749,74 @@ def bench_dispatch_overhead(dev, on_tpu, peak):
         })
 
 
+def bench_comms(dev, on_tpu, peak):
+    """``comms:allreduce_mlp`` line: the collective-communication
+    observability plane's trajectory metric — analytic vs measured
+    collective bytes (MUST match exactly: the per-launch accounting is
+    the static plan priced per dispatch), the analytic comm-time
+    estimate and comm-vs-compute bound verdict, the measured bus
+    bandwidth (algorithm bandwidth over link peak — the network MFU),
+    and the wait fraction of the measured comm time.  This is the
+    before/after gate the quantized-collectives arc inherits: a codec
+    halving the wire bytes must move ``bytes_per_step`` and ``bus_bw``
+    here, not in a one-off notebook.
+
+    The collective shard_map path needs >= 2 local devices, so the run
+    happens in a subprocess with a 2-virtual-device CPU mesh (the
+    tools/comms_smoke.py single-process mode — one measurement path for
+    CI and bench)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+              "PADDLE_GANG_COORD", "PADDLE_GANG_DIR",
+              "FLAGS_fault_inject"):
+        env.pop(k, None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "comms_smoke.py"), "--single-json"],
+        env=env, capture_output=True, text=True, timeout=900)
+    rec = None
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("COMMS_SINGLE "):
+            rec = json.loads(line[len("COMMS_SINGLE "):])
+    if r.returncode != 0 or rec is None:
+        raise RuntimeError(
+            f"comms child failed rc={r.returncode}: "
+            f"{(r.stderr or r.stdout or '')[-300:]}")
+    plan = rec["plan"]
+    exact = rec["measured_bytes"] == rec["expected_bytes"]
+    comm_ms = rec["measured_comm_ms"]
+    emit({
+        "metric": "comms:allreduce_mlp",
+        "value": round(rec["bus_bw"], 9),
+        "unit": "measured bus bandwidth / link peak (network MFU)",
+        "vs_baseline": 0,             # trajectory metric, no BASELINE
+        "nranks": plan["nranks"],
+        "collectives": plan["collectives"],
+        "bytes_per_step": plan["payload_bytes"],
+        "wire_bytes_per_step": plan["wire_bytes"],
+        "measured_bytes": rec["measured_bytes"],
+        "bytes_exact": exact,
+        "analytic_comm_ms": round(plan["est_ms"], 6),
+        "analytic_compute_ms": round(plan["compute_ms"], 6),
+        "bound": plan["bound"],
+        "measured_comm_ms": round(comm_ms, 3),
+        "wait_frac": round(rec["measured_wait_ms"] / comm_ms, 4)
+        if comm_ms > 0 else 0.0,
+        "plan_fingerprint": plan["fingerprint"][:12],
+        "note": ("2-virtual-device GradAllReduce MLP; bytes_exact gates "
+                 "measured == static plan; the quantized-collectives "
+                 "arc's before/after rides this line"),
+    })
+    if not exact:
+        raise RuntimeError(
+            f"measured collective bytes {rec['measured_bytes']} != "
+            f"plan {rec['expected_bytes']}")
+
+
 def bench_numerics(dev, on_tpu, peak):
     """Cost-of-the-plane trajectory lines: steps/s of a small MLP train
     loop at FLAGS_numerics=off/sentinel/full — ``numerics:mlp`` carries
@@ -1243,6 +1311,9 @@ def main(argv=None):
         ("memory", lambda: bench_memory(dev, on_tpu, peak)),
         # numerics-plane cost + loss-parity fingerprint (cheap, CPU+TPU)
         ("numerics", lambda: bench_numerics(dev, on_tpu, peak)),
+        # comms plane: analytic vs measured collective bytes/bandwidth
+        # (cheap 2-virtual-device subprocess; CPU and TPU alike)
+        ("comms", lambda: bench_comms(dev, on_tpu, peak)),
         ("resnet50", lambda: bench_resnet50(dev, on_tpu, peak)),
         ("resnet50_frozen_bn",
          lambda: bench_resnet50(dev, on_tpu, peak, frozen_bn=True)),

@@ -42,6 +42,9 @@ time, before anything is lowered).
   ``compiler.optimize``'s pass slot with the verifier before and after.
 """
 
+from .comms import (  # noqa: F401
+    CommsPlan, device_link_bandwidth, plan_comms,
+)
 from .cost import CostPlan, device_peak_flops, plan_cost  # noqa: F401
 from .fusion import (  # noqa: F401
     FusionDecision, FusionReport, analyze_program, fuse_program,
@@ -58,11 +61,12 @@ from .verifier import (  # noqa: F401
 )
 
 __all__ = [
-    "CHECKS", "CostPlan", "Diagnostic", "FusionDecision", "FusionReport",
-    "MemoryPlan", "NumericsEngine", "NumericsFrame",
+    "CHECKS", "CommsPlan", "CostPlan", "Diagnostic", "FusionDecision",
+    "FusionReport", "MemoryPlan", "NumericsEngine", "NumericsFrame",
     "ProgramVerificationError", "StatsLayout", "VerifyResult",
     "analyze_program", "clear_cache", "collective_fingerprint",
-    "device_peak_flops", "dynamic_int64_feeds", "fuse_program",
-    "loss_fingerprint", "plan_cost", "plan_memory", "plan_numerics",
-    "record_anomaly", "verify_or_raise", "verify_program",
+    "device_link_bandwidth", "device_peak_flops", "dynamic_int64_feeds",
+    "fuse_program", "loss_fingerprint", "plan_comms", "plan_cost",
+    "plan_memory", "plan_numerics", "record_anomaly", "verify_or_raise",
+    "verify_program",
 ]

@@ -163,6 +163,12 @@ class VerifyResult:
     #: analytic flops/bytes plan (analysis.cost.CostPlan; None if
     #: planning failed — same never-blocks contract as the memory plan)
     cost_plan: Optional[object] = None
+    #: static comms plan (analysis.comms.CommsPlan; None for programs
+    #: that launch no collectives or when planning failed).  Its
+    #: fingerprint folds into ``collective_fingerprint``, so ranks whose
+    #: COMMS PLANS diverge (payload bytes, nranks) refuse at the gang
+    #: barrier exactly like divergent collective sequences.
+    comms_plan: Optional[object] = None
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == "error"]
@@ -792,6 +798,26 @@ def _check_cost(program: Program, fetch_names):
         return None
 
 
+def _check_comms(program: Program, fetch_names):
+    """Static comms plan (analysis.comms): per-collective payload bytes,
+    algorithm-bandwidth wire traffic, and the analytic comm-vs-compute
+    bound at batch=1.  Same contract as the memory/cost planners:
+    informational, fingerprint-cached, never blocks verification."""
+    from . import comms as _comms
+    try:
+        return _comms.plan_comms(program, fetch_names, batch_size=1)
+    except Exception:
+        return None
+
+
+def _comms_attrs(plan):
+    from . import comms as _comms
+    try:
+        return _comms.stamp_attrs(plan)
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -835,6 +861,18 @@ def _verify_cached(program: Program, fetch_names) -> \
             program, graph, fetch_names, diags)
         result.memory_plan = _check_memory(program, fetch_names, diags)
         result.cost_plan = _check_cost(program, fetch_names)
+        result.comms_plan = _check_comms(program, fetch_names)
+        if result.comms_plan is not None and \
+                result.collective_fingerprint is not None:
+            # fold the comms plan (nranks + ordered per-collective
+            # payload bytes) into the cross-rank fingerprint: the gang
+            # compares ONE token over the heartbeat/step-barrier, and a
+            # divergent comms plan must refuse exactly like a divergent
+            # collective sequence.  Every rank derives it through this
+            # same function, so matching programs keep matching.
+            result.collective_fingerprint = hashlib.sha1(
+                (result.collective_fingerprint + "|"
+                 + result.comms_plan.fingerprint).encode()).hexdigest()
     for d in diags:
         _FINDING_CELLS[d.check].inc()
     # int64_feed "findings" are classifications, not diagnostics: the
@@ -866,6 +904,12 @@ def _verify_cached(program: Program, fetch_names) -> \
             "per_class": dict(result.cost_plan.per_class),
             "intensity": result.cost_plan.intensity(),
         },
+        # static comms model (batch=1 baseline): per-collective payload/
+        # wire bytes, the analytic comm-time estimate at link peak, and
+        # the comm-vs-compute bound verdict — what the executor's
+        # collective launch telemetry, bench.py's comms: lines, and the
+        # quantized-collectives gate read without re-planning
+        "comms": _comms_attrs(result.comms_plan),
     }
     with _CACHE_LOCK:
         fresh = key not in _CACHE

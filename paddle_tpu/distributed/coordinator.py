@@ -172,6 +172,10 @@ class GangCoordinator:
         self._ranks: Dict[int, dict] = {}       # guarded-by: _cv
         self._manifest: Optional[int] = None    # guarded-by: _cv
         self._barriers: Dict[int, dict] = {}    # guarded-by: _cv
+        self._comm_gates: Dict[int, dict] = {}  # guarded-by: _cv
+        #: optional scrape surface (FLAGS_coordinator_metrics_port /
+        #: start_metrics_http) — stopped with the coordinator
+        self._metrics_http = None
         self._mismatch: Optional[dict] = None   # guarded-by: _cv
         self._stopping = False                  # guarded-by: _cv
         self._conns: List[socket.socket] = []   # guarded-by: _cv
@@ -215,6 +219,12 @@ class GangCoordinator:
         return f"{self.host}:{self.port}"
 
     def stop(self) -> None:
+        http, self._metrics_http = self._metrics_http, None
+        if http is not None:
+            try:
+                http.stop()
+            except Exception:
+                pass
         with self._cv:
             self._stopping = True
             conns, self._conns = self._conns, []
@@ -304,7 +314,10 @@ class GangCoordinator:
                  # server-side barrier sequence: the k-th step_barrier
                  # arrival of every rank pairs with the k-th of its
                  # peers (see _op_step_barrier)
-                 "bseq": 0}
+                 "bseq": 0,
+                 # server-side comm-gate sequence (the pre-collective
+                 # timestamp exchange pairs the same way)
+                 "cseq": 0}
             self._ranks[rank] = e
         return e
 
@@ -344,7 +357,9 @@ class GangCoordinator:
             # across a rejoin).
             for other in self._ranks.values():
                 other["bseq"] = 0
+                other["cseq"] = 0
             self._barriers.clear()
+            self._comm_gates.clear()
             _monitor.GANG_REJOIN_CTR.inc()
             if _monitor.TRACER.enabled:
                 _monitor.TRACER.instant(
@@ -567,6 +582,13 @@ class GangCoordinator:
         # "which rank is NaN'ing" columns gangtop renders
         "gnorm": _monitor.GANG_RANK_GNORM,
         "nanf": _monitor.GANG_RANK_NANF,
+        # comms plane: per-step measured comm time (wait + wire), its
+        # straggler-wait part, and the bus-bandwidth gauge — gangtop's
+        # COMM/BW% columns, and comm_wait feeds the net-of-wait
+        # straggler selection below
+        "comm_ms": _monitor.GANG_RANK_COMM_MS,
+        "comm_wait": _monitor.GANG_RANK_COMM_WAIT,
+        "comm_bw": _monitor.GANG_RANK_COMM_BW,
     }
 
     def _fold_digest(self, rank: int, digest: dict) -> None:
@@ -606,14 +628,26 @@ class GangCoordinator:
                    if isinstance(e.get("digest"), dict)
                    and isinstance(e["digest"].get("step_ms"),
                                   (int, float))}
+        # straggler selection is NET of comm wait (digest 'comm_wait',
+        # the comms plane's measured peer-arrival skew): a rank whose
+        # step is long because it sat WAITING for a slow peer is the
+        # victim, not the straggler — blaming it would point the
+        # runbook at exactly the wrong chip
+        def _net(r):
+            d = live[r].get("digest") or {}
+            w = d.get("comm_wait")
+            if isinstance(w, (int, float)) and not isinstance(w, bool):
+                return max(float(step_ms[r]) - float(w), 0.0)
+            return float(step_ms[r])
         agg = {"step_skew": (max(steps) - min(steps)
                              if len(steps) >= 2 else 0),
                "step_time_skew_ms": 0.0,
                "straggler": -1, "straggler_step_ms": 0.0}
         if len(step_ms) >= 2:
-            slow = max(step_ms, key=step_ms.get)
+            slow = max(step_ms, key=_net)
             agg["straggler"] = int(slow)
             agg["straggler_step_ms"] = float(step_ms[slow])
+            agg["straggler_net_ms"] = round(_net(slow), 3)
             agg["step_time_skew_ms"] = \
                 max(step_ms.values()) - min(step_ms.values())
         return agg
@@ -837,7 +871,68 @@ class GangCoordinator:
                                       f"{self.world_size} ranks arrived"}
                 self._cv.wait(timeout=min(left, 0.25))
 
-    def _op_status(self, req: dict) -> dict:
+    def _op_comm_gate(self, req: dict) -> dict:
+        """Pre-collective timestamp exchange (the comms-observability
+        "timestamp allgather" over the socket plane): each rank posts
+        its host wall-clock arrival at the k-th collective launch and
+        waits (bounded) for every live peer's, so each rank can
+        decompose the collective's measured wall time into
+        straggler-wait (max peer arrival minus its own) vs wire time.
+
+        Pairing is by server-side arrival order, exactly like
+        ``_op_step_barrier`` (and reset with it on an elastic rejoin).
+        Unlike the barrier this op NEVER refuses: telemetry must not
+        fail a step — a timeout, a dead or departed peer just returns
+        the partial timestamp view (``released=False``), and a timed-out
+        arrival is withdrawn so a retry re-pairs at the same sequence."""
+        rank = int(req["rank"])
+        ts = float(req["ts"])
+        deadline = time.monotonic() + float(req.get("timeout_s", 10.0))
+        with self._cv:
+            e = self._touch_locked(rank)
+            seq = e["cseq"]
+            e["cseq"] = seq + 1
+            g = self._comm_gates.setdefault(seq, {"ts": {}})
+            g["ts"][rank] = ts
+            # bounded history pruned on ENTRY, not on release: the
+            # partial-return paths below (dead peer, timeout) are the
+            # steady state of a degraded-but-running gang, and pruning
+            # only on full release would leak one entry per collective
+            # step forever.  A straggler later arriving at a pruned seq
+            # just re-creates it and gets a partial view — the same
+            # contract a timeout gives it.
+            for s in [s for s in self._comm_gates if s < seq - 8]:
+                del self._comm_gates[s]
+            self._cv.notify_all()
+            while True:
+                view = {str(r): t for r, t in g["ts"].items()}
+                if len(g["ts"]) >= self.world_size:
+                    return {"ok": True, "released": True, "ts": view}
+                blocked = sorted(
+                    r for r, o in self._ranks.items()
+                    if r not in g["ts"]
+                    and (not o["alive"] or o["finished"]))
+                if blocked:
+                    # a dead/departed peer can never arrive: return the
+                    # partial view NOW instead of stalling the step for
+                    # the whole timeout
+                    return {"ok": True, "released": False, "ts": view,
+                            "missing": blocked}
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    # withdraw the un-released arrival so a retry pairs
+                    # at the sequence the late peers will reach (the
+                    # step-barrier discipline)
+                    if e["cseq"] == seq + 1:
+                        e["cseq"] = seq
+                        g["ts"].pop(rank, None)
+                    return {"ok": True, "released": False, "ts": view}
+                self._cv.wait(timeout=min(left, 0.25))
+
+    def status_snapshot(self) -> dict:
+        """The full gang view (rank table + aggregates) — one payload
+        shared by the ``status`` socket op, gangtop, and the
+        ``/statusz`` scrape endpoint, so the three can never disagree."""
         with self._cv:
             ranks = {str(r): {"alive": e["alive"],
                               "finished": e["finished"],
@@ -853,9 +948,34 @@ class GangCoordinator:
                               "age_s": round(
                                   time.monotonic() - e["last_hb"], 3)}
                      for r, e in self._ranks.items()}
-            return {"ok": True, "ranks": ranks,
+            return {"ranks": ranks,
                     "aggregates": self._aggregates_locked(),
                     **self._gang_view_locked()}
+
+    def _op_status(self, req: dict) -> dict:
+        return {"ok": True, **self.status_snapshot()}
+
+    # -- scrape surface ------------------------------------------------------
+    def start_metrics_http(self, port: int, host: str = "0.0.0.0"):
+        """Serve ``/metrics`` ``/healthz`` ``/statusz`` off this
+        coordinator's process registry (the launcher folds every rank's
+        heartbeat digest into per-rank gauges here, so one scrape covers
+        the whole gang — no serving stack required).  Reuses the serving
+        plane's :class:`~paddle_tpu.serving.httpd.MetricsHTTPServer`;
+        ``/healthz`` answers 503 while the gang is degraded, so the same
+        probe a load balancer uses works for a training gang.  Stopped
+        with the coordinator."""
+        from ..serving.httpd import MetricsHTTPServer
+
+        def health():
+            with self._cv:
+                status = self._status_locked()
+            return status != "degraded", status
+
+        self._metrics_http = MetricsHTTPServer(
+            port=int(port), host=host, health_fn=health,
+            status_fn=self.status_snapshot).start()
+        return self._metrics_http
 
 
 # ---------------------------------------------------------------------------
@@ -1165,6 +1285,21 @@ class GangClient:
                        "fingerprint": fingerprint,
                        "timeout_s": timeout_s},
                       timeout_s=timeout_s + 10.0, oneshot=True)
+
+    def comm_gate(self, ts: float, timeout_s: float = 10.0) -> dict:
+        """Pre-collective timestamp exchange (comms observability): post
+        this rank's collective-launch arrival timestamp (epoch seconds)
+        and collect every live peer's, pairing by server-side arrival
+        order.  Returns ``{"released": bool, "ts": {rank: epoch_s}}`` —
+        ``released=False`` means the view is partial (timeout, or a
+        dead/departed peer).  Never raises a gang refusal: this is
+        telemetry, not coordination — transport errors do propagate so
+        the caller can latch the gate off."""
+        resp = self._rpc({"op": "comm_gate", "ts": float(ts),
+                          "timeout_s": float(timeout_s)},
+                         timeout_s=float(timeout_s) + 10.0, oneshot=True)
+        return {"released": bool(resp.get("released")),
+                "ts": resp.get("ts") or {}}
 
     # -- GangRendezvous protocol (socket transport) --------------------------
     @property

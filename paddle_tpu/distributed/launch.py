@@ -167,8 +167,30 @@ def start_coordinator(args):
         return None
     from .coordinator import GangCoordinator
     host, _, port = gang_coord_address(args).rpartition(":")
-    return GangCoordinator(world, host=host, port=int(port),
-                           manifest_dir=_resolve_gang_dir(args)).start()
+    coord = GangCoordinator(world, host=host, port=int(port),
+                            manifest_dir=_resolve_gang_dir(args)).start()
+    # FLAGS_coordinator_metrics_port: the launcher's process registry
+    # holds the whole gang's per-rank digest gauges (the coordinator
+    # folds every heartbeat into it), so serving /metrics + /statusz
+    # HERE makes the gang scrapeable with no serving stack — reusing
+    # the serving plane's MetricsHTTPServer.  /statusz carries the same
+    # rank table gangtop renders; /healthz answers 503 while degraded.
+    try:
+        from ..flags import get_flags
+        fl = get_flags(["FLAGS_coordinator_metrics_port",
+                        "FLAGS_metrics_host"])
+        mport = int(fl["FLAGS_coordinator_metrics_port"])
+        if mport:
+            srv = coord.start_metrics_http(
+                mport, host=str(fl["FLAGS_metrics_host"]))
+            sys.stderr.write(
+                f"paddle_tpu launch: coordinator metrics at "
+                f"{srv.url}/metrics\n")
+    except Exception as e:       # scrape surface must never kill launch
+        sys.stderr.write(
+            f"paddle_tpu launch: coordinator metrics server failed: "
+            f"{e!r}\n")
+    return coord
 
 
 def _spawn(args, env, log_mode="w"):

@@ -29,10 +29,12 @@ balance very large embeddings — sparse tables here shard by ROW via
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import monitor as _monitor
 from .. import native
 from .. import resilience as _resil
 from ..framework import core
@@ -56,6 +58,23 @@ _clients_lock = threading.Lock()
 #: the no-failure hot path (every push/pull of every step) pays one flag
 #: read + dict probe, not a RetryPolicy allocation per RPC
 _policy_cache: Dict[tuple, "_resil.RetryPolicy"] = {}
+
+#: per-endpoint RPC latency (the PS path's comms attribution — the
+#: trainer-side analogue of paddle_tpu_collective_ms): wall time of the
+#: whole _rpc envelope (native transport retries included), per endpoint
+#: and op.  Failures observe too — a dying endpoint's deadline-long
+#: calls are exactly the tail worth seeing.
+_PS_RPC_HIST = _monitor.REGISTRY.histogram(
+    "paddle_tpu_ps_rpc_ms",
+    "parameter-server RPC wall time (ms) per endpoint and op (ps.put / "
+    "ps.get / ps.push_dense / ps.push_sparse / ...), native transport "
+    "retries included; circuit-open fail-fast rejections are excluded "
+    "(they never touch the wire — see "
+    "paddle_tpu_retry_circuit_open_total)",
+    ("endpoint", "op"),
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+             100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+             30000.0, 120000.0, 300000.0))
 
 
 def _rpc(site: str, fn, breaker: "Optional[_resil.CircuitBreaker]" = None):
@@ -93,6 +112,15 @@ def _rpc(site: str, fn, breaker: "Optional[_resil.CircuitBreaker]" = None):
         # one derivation of the flag->policy mapping, shared with direct
         # retry_call('ps.*') users
         policy = _policy_cache[key] = _resil.RetryPolicy.from_flags(site)
+    # per-endpoint latency attribution: the whole envelope (native
+    # transport retries + injected-fault retries) observes into
+    # paddle_tpu_ps_rpc_ms — failures included, because a dying
+    # endpoint's deadline-long calls ARE the tail worth seeing.  The
+    # breaker's fail-fast rejections above never reach here (no wire
+    # time to attribute).
+    endpoint = (breaker.name if breaker is not None and breaker.name
+                else "local")
+    t0 = time.perf_counter()
     try:
         out = _resil.retry_call(site, fn, policy=policy,
                                 retryable=_resil.is_transient)
@@ -108,6 +136,9 @@ def _rpc(site: str, fn, breaker: "Optional[_resil.CircuitBreaker]" = None):
             else:
                 breaker.record_success()
         raise
+    finally:
+        _PS_RPC_HIST.observe((time.perf_counter() - t0) * 1e3,
+                             endpoint=endpoint, op=site)
     if breaker is not None:
         breaker.record_success()
     return out

@@ -287,6 +287,28 @@ _DEFAULTS: Dict[str, Any] = {
     # commits so the (gang) manifest never advances past the last
     # healthy step.  Disable only if you want poisoned snapshots.
     "FLAGS_numerics_quarantine": True,
+    # -- collective-communication observability (analysis.comms) ----------
+    # per-collective attribution for the executor's collective shard_map
+    # path: synchronous payload-byte counters, a pre-collective host
+    # timestamp exchange through the gang coordinator (straggler-wait vs
+    # wire-time decomposition), and an off-thread monitor publishing
+    # collective_ms/wait_ms histograms + the live bus-bandwidth gauge.
+    # Default on: the hot-path cost is a few counter bumps and one queue
+    # append; the coordinator gate engages only when a socket gang is
+    # attached.
+    "FLAGS_comms_telemetry": True,
+    # how long the pre-collective timestamp exchange waits for every
+    # rank to arrive before returning a partial view (the collective
+    # itself would block at least this long on the same straggler; the
+    # gate self-disarms after 3 consecutive failures so a desynced or
+    # coordinator-less gang never stalls training on telemetry)
+    "FLAGS_comms_gate_timeout_s": 10.0,
+    # coordinator scrape surface: the launcher hosting the gang
+    # coordinator also serves /metrics /healthz /statusz (the serving
+    # MetricsHTTPServer, reused) on this port, so gang/comms gauges are
+    # scrapeable without a serving stack.  0 (default) disables;
+    # /healthz answers 503 while the gang is degraded.
+    "FLAGS_coordinator_metrics_port": 0,
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without

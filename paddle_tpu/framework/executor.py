@@ -298,6 +298,18 @@ def _numerics():
     return _numerics_mod[0]
 
 
+_comms_mod = []
+
+
+def _comms():
+    """Memoized analysis.comms module (same bootstrap rationale as the
+    trampolines above; the collective launch path reads it per dispatch)."""
+    if not _comms_mod:
+        from ..analysis import comms
+        _comms_mod.append(comms)
+    return _comms_mod[0]
+
+
 def _device_peak() -> float:
     """Memoized chip peak FLOP/s (the live-MFU denominator)."""
     if not _device_peak_cache:
@@ -327,6 +339,37 @@ def _restamp_memory(program, fetch_names, batch):
     }
 
 
+def _feed_batch(feeds) -> int:
+    """Batch size of a staged feed list: the leading dim of the first
+    shaped feed (the convention every planner resolves -1 dims
+    through); 1 when nothing is shaped.  Shared by the cost and comms
+    resolvers so the two plans can never price different batches for
+    the same block."""
+    for f in feeds:
+        shape = getattr(f, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 1
+
+
+def _resolve_comms(cb, program, feeds):
+    """Once per compiled collective block: the static comms plan at the
+    REAL feed batch plus the pre-bound per-collective byte-counter cells
+    (analysis.comms) — the per-dispatch accounting is then a lock+add per
+    collective.  Returns (plan, [(cell, payload_bytes)]) or None; comms
+    modeling must never break dispatch."""
+    try:
+        comms = _comms()
+        plan = comms.plan_comms(program, cb.fetch_names,
+                                batch_size=_feed_batch(feeds),
+                                nranks=cb.collective_nranks)
+        if plan is None:
+            return None
+        return plan, comms.bound_byte_cells(plan)
+    except Exception:
+        return None
+
+
 def _resolve_cost(cb, program, feeds):
     """Once per compiled block: the analytic flops-per-step of this
     program at the REAL feed batch (the verifier stamps a batch=1
@@ -338,12 +381,7 @@ def _resolve_cost(cb, program, feeds):
     None — cost modeling must never break dispatch."""
     try:
         from ..analysis.cost import plan_cost
-        batch = 1
-        for f in feeds:
-            shape = getattr(f, "shape", None)
-            if shape:
-                batch = int(shape[0])
-                break
+        batch = _feed_batch(feeds)
         try:
             _restamp_memory(program, cb.fetch_names, batch)
         except Exception:
@@ -1085,6 +1123,11 @@ class Executor:
         # gang client (resolved once; _UNSET = not yet resolved)
         self._barrier_step = 0
         self._gang = _UNSET
+        # pre-collective timestamp gate (analysis.comms): consecutive
+        # failure count + self-disarm latch — telemetry must never
+        # stall training against a half-dead gang
+        self._comm_gate_fails = 0
+        self._comm_gate_off = False
         self._stats = _DispatchStats()
         # async dispatch throttle: representative output arrays of the last
         # N dispatched steps; run() blocks on the oldest once more than
@@ -1407,12 +1450,21 @@ class Executor:
                     rw_vals[i] = v * jnp.asarray(
                         float("nan"), dtype=v.dtype)
                     break
+        comms_note = None
         if cb.collective_nranks:
             # FLAGS_gang_step_barrier: fingerprint-checked gang barrier
             # BEFORE the dispatch — divergent programs refuse here
             # (GangFingerprintError naming both ranks) instead of
             # deadlocking inside the first unpaired collective
             self._maybe_step_barrier(cb, program)
+            # collective-launch observability (analysis.comms): the
+            # drill site fires first (hang mode makes THIS rank the
+            # straggler its peers must attribute), then the plan's byte
+            # counters bump and the coordinator timestamp exchange
+            # measures peer arrival skew — the straggler-wait half of
+            # the decomposition the off-thread monitor completes
+            _resil.maybe_inject("collective.launch")
+            comms_note = self._comms_prelaunch(cb, program, feeds)
         self._step_seed += 1
         seed_val = seed if seed is not None else (
             program.random_seed * 1000003 + self._step_seed)
@@ -1538,6 +1590,29 @@ class Executor:
                      "fetches": list(cb.fetch_names)})
         if cb.collective_nranks:
             _COLL_STEP.inc()
+            if comms_note is not None:
+                # synchronous byte accounting (a lock+add per collective
+                # on pre-bound cells — failed dispatches never count, so
+                # the counter is exactly plan-bytes x dispatched steps),
+                # then hand the step's probe to the comms monitor: it
+                # blocks until the step retires OFF this thread and
+                # decomposes the wall time into wait vs wire (zero added
+                # host blocks on the training thread — the smoke's
+                # gate (c))
+                plan, cells, t_launch, wait_ms = comms_note
+                try:
+                    for cell, payload in cells:
+                        cell.inc(payload)
+                    if not pending_compile:
+                        # a compiling first call would bill trace+lower+
+                        # XLA-compile seconds as wire time — bytes count
+                        # (the launch happened), the timing sample
+                        # starts with the first steady-state dispatch
+                        _comms().MONITOR.note_launch(
+                            step_id, probe, plan, t_launch, tdisp,
+                            wait_ms)
+                except Exception:
+                    pass     # telemetry must never fail a step
         stats.incr("steps_dispatched")
         stats.incr("time_to_dispatch_us", (tdisp - t0) * 1e6)
         if _monitor.TRACER.enabled:
@@ -1688,9 +1763,11 @@ class Executor:
                 stats.incr("fetch_materializations", len(fetches))
                 stats.block("materialize_block_us", (tm1 - tm) * 1e6)
                 if _monitor.TRACER.enabled:
+                    # step id on the span: tools/latency_report.py chains
+                    # executor-only traces (dispatch + materialize) by it
                     _monitor.TRACER.add_complete(
                         "fetch.materialize", "fetch", tm, tm1,
-                        {"n": len(fetches)})
+                        {"n": len(fetches), "step": step_id})
                 # this step's fetches are on host, and per-device
                 # execution is in-order, so every earlier step's probe is
                 # complete — retaining them after a lazy→eager switch
@@ -1716,18 +1793,7 @@ class Executor:
                         "FLAGS_gang_step_barrier_timeout_s"])
         if not fl["FLAGS_gang_step_barrier"]:
             return
-        gang = self._gang
-        if gang is _UNSET:
-            try:
-                from ..distributed.env import GangRendezvous
-                gang = GangRendezvous.from_env()
-            except ConnectionError:
-                raise      # split coordination plane: fail loud (PR 6)
-            except Exception:
-                gang = None
-            if gang is not None and not hasattr(gang, "step_barrier"):
-                gang = None    # file backend has no liveness plane
-            self._gang = gang
+        gang = self._resolve_gang()
         if gang is None:
             return
         fp = getattr(cb, "gang_fingerprint", _UNSET)
@@ -1746,6 +1812,125 @@ class Executor:
             self._barrier_step, fingerprint=fp,
             timeout_s=float(fl["FLAGS_gang_step_barrier_timeout_s"]))
         _COLL_BARRIER.inc()
+
+    def _resolve_gang(self):
+        """Memoized socket-gang client for this process's rank (the PR-6
+        liveness plane), or None: no launcher env, the file backend (no
+        liveness plane), or single-rank.  ConnectionError propagates —
+        a reachable-for-peers coordinator this rank cannot reach is a
+        split coordination plane and must fail loud (PR 6)."""
+        gang = self._gang
+        if gang is _UNSET:
+            try:
+                from ..distributed.env import GangRendezvous
+                gang = GangRendezvous.from_env()
+            except ConnectionError:
+                raise
+            except Exception:
+                gang = None
+            if gang is not None and not hasattr(gang, "step_barrier"):
+                gang = None    # file backend has no liveness plane
+            self._gang = gang
+        return gang
+
+    def _comms_prelaunch(self, cb, program, feeds):
+        """FLAGS_comms_telemetry: per-collective-dispatch observability
+        prologue.  Resolves the static comms plan once per compiled
+        block, exchanges this rank's arrival timestamp through the gang
+        coordinator (``comm_gate`` — the socket-plane timestamp
+        allgather), and returns ``(plan, byte_cells, t_launch, wait_ms)``
+        for the post-dispatch accounting, or None when telemetry is off
+        or the program has no comms plan.  Never raises: telemetry must
+        not fail a step."""
+        from ..flags import get_flags
+        try:
+            if not get_flags("FLAGS_comms_telemetry")[
+                    "FLAGS_comms_telemetry"]:
+                return None
+            info = getattr(cb, "comms_info", _UNSET)
+            if info is _UNSET:
+                info = cb.comms_info = _resolve_comms(cb, program, feeds)
+            if info is None:
+                return None
+            plan, cells = info
+            wait_ms = self._comm_gate_wait()
+            return plan, cells, time.perf_counter(), wait_ms
+        except Exception:
+            return None
+
+    def _comm_gate_wait(self):
+        """Pre-collective timestamp exchange: post this rank's wall-clock
+        arrival to the coordinator's ``comm_gate`` and wait (bounded) for
+        every live peer's, returning the straggler wait in ms — how long
+        this rank would stall inside the collective for its slowest
+        peer.  None when no socket gang is attached (a single-process
+        multi-device run: all "ranks" arrive together, wait is 0 by
+        construction and the monitor records it as such).  The gate
+        latches itself off after 3 consecutive failures so a desynced or
+        half-dead gang can never stall training on telemetry."""
+        if self._comm_gate_off:
+            return None
+        gang = None
+        try:
+            gang = self._resolve_gang()
+        except ConnectionError:
+            self._comm_gate_off = True     # telemetry never fails a step
+            _comms().COMMS_GATE_CTR.inc(1, outcome="disabled")
+            return None
+        if gang is None or not hasattr(gang, "comm_gate"):
+            return None
+        from ..flags import get_flags
+        timeout_s = float(get_flags("FLAGS_comms_gate_timeout_s")
+                          ["FLAGS_comms_gate_timeout_s"])
+        # NOTE: arrival timestamps are wall-clock epoch seconds compared
+        # ACROSS processes — exact on one host (the current multi-chip
+        # deployment); across hosts, NTP skew reads as (or cancels)
+        # straggler wait, so cross-host wait decomposition is only as
+        # good as the fleet's clock sync (documented in README)
+        t_arrive = time.time()
+        t0 = time.perf_counter()
+        try:
+            resp = gang.comm_gate(t_arrive, timeout_s=timeout_s)
+        except Exception:
+            self._note_gate_failure("error")
+            return None
+        ts = {int(r): float(t) for r, t in (resp.get("ts") or {}).items()}
+        released = bool(resp.get("released"))
+        if not released and \
+                time.perf_counter() - t0 >= 0.8 * timeout_s:
+            # a TIMEOUT-scale partial is a stall this gate itself paid:
+            # a peer that stopped posting (its telemetry off, its own
+            # gate latched) would otherwise cost every OTHER rank the
+            # full timeout on every step — these count toward the
+            # self-disarm latch exactly like transport errors.  Fast
+            # partials (dead/departed peer: the coordinator returns
+            # immediately) cost nothing and don't count.
+            self._note_gate_failure("timeout")
+            return None
+        _comms().COMMS_GATE_CTR.inc(
+            1, outcome="released" if released else "partial")
+        self._comm_gate_fails = 0
+        if not ts:
+            return None
+        # a fast partial view understates the skew; report what was
+        # actually observed rather than guessing
+        return max(0.0, (max(ts.values()) - t_arrive) * 1e3)
+
+    def _note_gate_failure(self, kind):
+        """Count a comm-gate failure toward the 3-strike self-disarm
+        latch (transport errors and timeout-scale stalls alike —
+        telemetry must never keep stalling training)."""
+        _comms().COMMS_GATE_CTR.inc(1, outcome=kind)
+        self._comm_gate_fails += 1
+        if self._comm_gate_fails >= 3:
+            self._comm_gate_off = True
+            import warnings
+            warnings.warn(
+                "comms telemetry: pre-collective timestamp gate failed "
+                f"3 times in a row (last: {kind}); disabling the gate "
+                "for this executor (wait decomposition reads 0, wire "
+                "measurement continues)")
+            _comms().COMMS_GATE_CTR.inc(1, outcome="disabled")
 
     def _throttle(self, probe, fetches, new_rw, limit):
         """Bound async run-ahead: remember one output array per dispatched

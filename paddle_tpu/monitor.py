@@ -37,6 +37,7 @@ import collections
 import contextlib
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -456,6 +457,21 @@ GANG_RANK_NANF = REGISTRY.gauge(
     "per-rank cumulative non-finite element count from the heartbeat "
     "digest (numerics plane 'nanf' key) — nonzero on exactly one rank "
     "fingers the chip/input producing the NaNs", ("rank",))
+GANG_RANK_COMM_MS = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_comm_ms",
+    "per-rank measured comm time per collective step (ms, wait + wire) "
+    "from the heartbeat digest (comms plane 'comm_ms' key)", ("rank",))
+GANG_RANK_COMM_WAIT = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_comm_wait_ms",
+    "per-rank straggler-wait part of the comm time (ms) from the "
+    "heartbeat digest ('comm_wait') — the coordinator subtracts it "
+    "from step_ms when picking the straggler, so a rank stalled on a "
+    "slow peer never reads as the slow one", ("rank",))
+GANG_RANK_COMM_BW = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_comm_bw",
+    "per-rank measured collective bus bandwidth over link peak in "
+    "[0,1] from the heartbeat digest ('comm_bw') — the network MFU "
+    "column gangtop renders as BW%", ("rank",))
 GANG_DIGEST_CTR = REGISTRY.counter(
     "paddle_tpu_gang_digests_total",
     "heartbeat metrics digests accepted by the coordinator, per rank",
@@ -563,15 +579,57 @@ def metrics_digest() -> Dict[str, Any]:
             if nf is not None:
                 digest["nanf"] = int(sum(
                     cell.get() for _, cell in nf.series()))
+    # comms plane (this PR): measured comm time per collective step,
+    # its straggler-wait part, and the bus-bandwidth gauge — presence-
+    # gated on the comms monitor having published RECENTLY, so a rank
+    # that never dispatches collectives carries none of them and a rank
+    # that STOPPED dispatching them ages out instead of haunting the
+    # net-of-wait straggler math with frozen medians (a stale comm_wait
+    # would excuse a genuinely slow rank forever).  comm_wait rides
+    # whenever comm_ms does (a measured 0 is the signal's baseline).
+    cm = REGISTRY.get("paddle_tpu_comm_step_ms")
+    if cm is not None and _comm_digest_fresh():
+        cells = [cell.get() for _, cell in cm.series()]
+        if cells:
+            digest["comm_ms"] = round(float(cells[-1]), 3)
+            cw = REGISTRY.get("paddle_tpu_comm_wait_ms")
+            if cw is not None:
+                wcells = [cell.get() for _, cell in cw.series()]
+                if wcells:
+                    digest["comm_wait"] = round(float(wcells[-1]), 3)
+            bw = REGISTRY.get("paddle_tpu_collective_bus_bw")
+            if bw is not None:
+                bcells = [cell.get() for _, cell in bw.series()]
+                if bcells:
+                    digest["comm_bw"] = round(float(bcells[-1]), 5)
     return digest
+
+
+#: how long the comm_* digest keys outlive the comms monitor's last
+#: gauge publish.  Generous on purpose — a giant-model step can take a
+#: minute — and degradation is safe: once the keys drop, straggler
+#: selection falls back to raw step_ms (the pre-comms behavior).
+_COMM_DIGEST_TTL_S = 120.0
+
+
+def _comm_digest_fresh() -> bool:
+    mod = sys.modules.get("paddle_tpu.analysis.comms")
+    if mod is None:
+        return False                # plane never loaded: nothing to carry
+    last = getattr(mod.MONITOR, "last_publish_wall", 0.0)
+    return bool(last) and time.time() - last <= _COMM_DIGEST_TTL_S
 
 
 #: digest keys the gang skew/straggler plane reads, most important
 #: first — capped_digest sheds from the BOTTOM of this list, and sheds
-#: keys not on it before any that are.  nanf/gnorm rank right after the
-#: straggler inputs: a NaN'ing rank must stay identifiable fleet-wide
-#: even under the byte cap.
-_DIGEST_PRIORITY = ("step_ms", "nanf", "gnorm", "mfu", "srv_q", "queue",
+#: keys not on it before any that are.  comm_wait rides right behind
+#: step_ms: the two TOGETHER are the straggler input (the coordinator
+#: picks the straggler net of comm wait, so shedding comm_wait while
+#: keeping step_ms would mis-blame the waiting rank).  nanf/gnorm rank
+#: next: a NaN'ing rank must stay identifiable fleet-wide even under
+#: the byte cap.
+_DIGEST_PRIORITY = ("step_ms", "comm_wait", "nanf", "gnorm", "mfu",
+                    "comm_ms", "comm_bw", "srv_q", "queue",
                     "inflight", "occ", "slots", "tps", "steps")
 
 
@@ -722,7 +780,8 @@ def retire_gang_rank_series(rank) -> None:
     for g in (GANG_RANK_STEP_MS, GANG_RANK_MFU, GANG_RANK_QUEUE,
               GANG_RANK_INFLIGHT, GANG_RANK_SRVQ, GANG_RANK_OCC,
               GANG_RANK_FREE_SLOTS, GANG_RANK_TPS, GANG_RANK_GNORM,
-              GANG_RANK_NANF):
+              GANG_RANK_NANF, GANG_RANK_COMM_MS, GANG_RANK_COMM_WAIT,
+              GANG_RANK_COMM_BW):
         g.fold(src, None)
 
 

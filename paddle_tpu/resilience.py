@@ -117,6 +117,17 @@ _CIRCUIT_CTR = _monitor.REGISTRY.counter(
     "paddle_tpu_retry_circuit_open_total",
     "calls failed fast by an open circuit breaker (no RPC attempted, no "
     "backoff paid)", ("site",))
+#: live breaker state per named breaker (PS clients name theirs by
+#: endpoint): 0=closed, 1=half_open (cool-down elapsed, probe pending or
+#: in flight), 2=open.  Transitions were previously counters only —
+#: invisible mid-flight; this gauge is the live view a dashboard needs.
+BREAKER_STATE = {"closed": 0, "half_open": 1, "open": 2}
+_BREAKER_GAUGE = _monitor.REGISTRY.gauge(
+    "paddle_tpu_circuit_breaker_state",
+    "live circuit-breaker state per named breaker (PS: per endpoint): "
+    "0=closed, 1=half_open (a probe call was claimed), 2=open (a "
+    "cooled-down breaker stays 2 until some call claims the probe)",
+    ("endpoint",))
 
 
 # ---------------------------------------------------------------------------
@@ -183,6 +194,11 @@ KNOWN_SITES = (
     "ps.put_typed", "ps.get_typed", "ps.push_typed",
     "dataloader.produce", "compile", "executor.dispatch",
     "fetch.materialize", "checkpoint.write", "serving.decode_step",
+    # fires at the top of every collective shard_map dispatch (before
+    # the pre-collective timestamp exchange) — hang mode makes THIS rank
+    # the straggler its peers' wait decomposition must attribute
+    # (tools/comms_smoke.py's drill)
+    "collective.launch",
     # value-domain drill: corrupts one float rw persistable with NaN
     # after a dispatched step — the numerics plane must DETECT it (the
     # hook itself never raises out of the executor)
@@ -492,6 +508,16 @@ class CircuitBreaker:
         self._mu = threading.Lock()
         self._opened_at: Optional[float] = None
         self._probing = False
+        # live state gauge, bound once per NAMED breaker (anonymous
+        # test breakers stay out of the registry); transitions publish
+        # through _publish so the gauge can never lag the state
+        self._state_cell = (_BREAKER_GAUGE.labels(endpoint=name)
+                            if name else None)
+        self._publish("closed")
+
+    def _publish(self, state: str) -> None:
+        if self._state_cell is not None:
+            self._state_cell.set(BREAKER_STATE[state])
 
     def cooldown_s(self) -> float:
         if self._cooldown is not None:
@@ -526,6 +552,7 @@ class CircuitBreaker:
             elapsed = self._clock() - self._opened_at
             if not self._probing and elapsed >= cd:
                 self._probing = True        # this caller IS the probe
+                self._publish("half_open")
                 return
             remaining = max(cd - elapsed, 0.0)
         label = site or self.name or "<unnamed>"
@@ -542,6 +569,7 @@ class CircuitBreaker:
         with self._mu:
             self._opened_at = None
             self._probing = False
+            self._publish("closed")
 
     def record_giveup(self) -> None:
         """A retry budget was exhausted: (re)open the breaker and restart
@@ -549,6 +577,7 @@ class CircuitBreaker:
         with self._mu:
             self._opened_at = self._clock()
             self._probing = False
+            self._publish("open")
 
 
 # ---------------------------------------------------------------------------

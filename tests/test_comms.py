@@ -1,0 +1,564 @@
+"""Collective-communication observability (paddle_tpu/analysis/comms.py):
+the static comms plan (payload bytes, algorithm-bandwidth model,
+comm-vs-compute verdict, fingerprint parity), the runtime measurement
+path (per-launch byte accounting, the off-thread wait/wire
+decomposition, the coordinator comm_gate), the fleet surfaces (digest
+keys, net-of-wait straggler, gangtop COMM columns, timeline comm lane),
+and this PR's satellites (coordinator scrape surface, breaker state
+gauge)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor
+from paddle_tpu import optimizer as opt
+from paddle_tpu.analysis import comms, verifier
+from paddle_tpu.distributed.coordinator import (GangClient,
+                                                GangCoordinator,
+                                                GangFingerprintError)
+from paddle_tpu.distributed.transpiler import GradAllReduce
+from paddle_tpu.framework import (Program, Scope, program_guard,
+                                  scope_guard, unique_name)
+
+
+def _build_dp_program(nranks=2, hidden=16):
+    """Deterministic GradAllReduce training program.  Built under its
+    own unique_name guard so two calls mint IDENTICAL programs — the
+    "two ranks build the same model" scenario."""
+    with unique_name.guard():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", shape=[8], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=hidden, act="tanh")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt.SGDOptimizer(0.1).minimize(loss)
+            eps = ",".join(f"127.0.0.1:{6170 + i}" for i in range(nranks))
+            GradAllReduce().transpile(
+                rank=0, endpoints=eps, current_endpoint=eps.split(",")[0],
+                startup_program=startup, main_program=main)
+    return main, startup, loss.name
+
+
+# ---------------------------------------------------------------------------
+# static comms plan
+# ---------------------------------------------------------------------------
+
+def test_plan_allreduce_bytes_and_algorithm_model():
+    main, _, loss_name = _build_dp_program(nranks=2, hidden=16)
+    plan = comms.plan_comms(main, [loss_name], nranks=2)
+    assert plan is not None and plan.nranks == 2
+    # GradAllReduce allreduces every param grad: fc W [8,16], b [16],
+    # fc W [16,1], b [1] — all fp32
+    assert len(plan.collectives) == 4
+    assert {c.op for c in plan.collectives} == {"c_allreduce_sum"}
+    expect_payload = 4 * (8 * 16 + 16 + 16 * 1 + 1)
+    assert plan.payload_bytes == expect_payload
+    # ring allreduce: each rank moves 2(n-1)/n x payload = payload at n=2
+    assert plan.wire_bytes == expect_payload
+    for c in plan.collectives:
+        assert c.wire_bytes == c.payload_bytes       # 2(2-1)/2 == 1
+        assert c.est_ms == pytest.approx(
+            c.wire_bytes / plan.link_bw * 1e3)
+        assert c.signature.startswith("c_allreduce_sum:r0:float32:")
+    assert plan.est_ms == pytest.approx(
+        plan.wire_bytes / plan.link_bw * 1e3)
+    assert plan.bound in ("comm", "compute")
+    assert 0.0 <= plan.comm_frac <= 1.0
+    assert "comms plan" in plan.report()
+
+    # at n=4 the ring factor grows to 2*(3)/4 = 1.5x payload
+    plan4 = comms.plan_comms(main, [loss_name], nranks=4)
+    assert plan4.wire_bytes == int(expect_payload * 1.5)
+
+
+def test_plan_parity_and_divergence_fingerprints():
+    main_a, _, loss_a = _build_dp_program(nranks=2, hidden=16)
+    main_b, _, loss_b = _build_dp_program(nranks=2, hidden=16)
+    pa = comms.plan_comms(main_a, [loss_a], nranks=2)
+    pb = comms.plan_comms(main_b, [loss_b], nranks=2)
+    # two independently-built ranks of the same model agree exactly:
+    # signatures, bytes, fingerprint (the cross-rank parity contract)
+    assert [c.signature for c in pa.collectives] == \
+        [c.signature for c in pb.collectives]
+    assert pa.payload_bytes == pb.payload_bytes
+    assert pa.fingerprint == pb.fingerprint
+    # a divergent model (different payload) is a different plan
+    main_c, _, loss_c = _build_dp_program(nranks=2, hidden=32)
+    pc = comms.plan_comms(main_c, [loss_c], nranks=2)
+    assert pc.fingerprint != pa.fingerprint
+    # SAME collective signatures but different nranks: the sequence
+    # fingerprint alone cannot see it, the comms plan must
+    p3 = comms.plan_comms(main_a, [loss_a], nranks=3)
+    assert [c.signature for c in p3.collectives] == \
+        [c.signature for c in pa.collectives]
+    assert p3.fingerprint != pa.fingerprint
+
+
+def test_plan_none_without_collectives():
+    with unique_name.guard():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            loss = layers.mean(layers.fc(x, size=4))
+    assert comms.plan_comms(main, [loss.name]) is None
+
+
+def test_verifier_stamps_comms_and_folds_fingerprint():
+    main_a, _, loss_a = _build_dp_program(nranks=2)
+    verifier.clear_cache()
+    res_a = verifier.verify_program(main_a, [loss_a])
+    va = main_a._attrs["verify"]["comms"]
+    assert va is not None
+    assert va["nranks"] == 2
+    assert va["payload_bytes"] == res_a.comms_plan.payload_bytes
+    assert va["bound"] in ("comm", "compute")
+    assert va["fingerprint"] == res_a.comms_plan.fingerprint
+    assert len(va["collectives"]) == 4
+    # the comms plan folds into the cross-rank collective fingerprint:
+    # same collective SEQUENCE but different nranks must now diverge
+    # (the old sequence-only fingerprint could not see it) — so a gang
+    # whose ranks disagree on the comms plan refuses at the barrier
+    main_b, _, loss_b = _build_dp_program(nranks=3)
+    res_b = verifier.verify_program(main_b, [loss_b])
+    assert res_a.collective_fingerprint
+    assert res_b.collective_fingerprint
+    assert res_a.collective_fingerprint != res_b.collective_fingerprint
+    # ...while two identical builds still agree
+    main_a2, _, loss_a2 = _build_dp_program(nranks=2)
+    res_a2 = verifier.verify_program(main_a2, [loss_a2])
+    assert res_a2.collective_fingerprint == res_a.collective_fingerprint
+
+
+# ---------------------------------------------------------------------------
+# runtime measurement (collective shard_map dispatch on the 8-dev mesh)
+# ---------------------------------------------------------------------------
+
+def test_collective_dispatch_accounts_bytes_and_decomposes():
+    main, startup, loss_name = _build_dp_program(nranks=2)
+    scope = Scope()
+    with scope_guard(scope), program_guard(main, startup):
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, seed=11)
+        rng = np.random.RandomState(3)
+        xv = rng.rand(8, 8).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        plan = comms.plan_comms(main, [loss_name], batch_size=8,
+                                nranks=2)
+        before = monitor.counter_totals()
+        monitor.TRACER.clear()
+        steps = 3
+        timed_steps = steps - 1   # the compiling first call is bytes-
+        #                           only: billing compile as wire time
+        #                           would skew the histograms
+        for _ in range(steps):
+            exe.run(main, feed={"x": xv, "y": yv},
+                    fetch_list=[loss_name], scope=scope)
+        assert comms.MONITOR.drain(timeout_s=30)
+        after = monitor.counter_totals()
+    delta = after.get("paddle_tpu_collective_bytes_total", 0) - \
+        before.get("paddle_tpu_collective_bytes_total", 0)
+    assert delta == plan.payload_bytes * steps        # EXACT, the gate
+    # per-signature series exist with op labels
+    fam = monitor.REGISTRY.get("paddle_tpu_collective_bytes_total")
+    sigs = {lbl["signature"] for lbl, _ in fam.series()
+            if lbl.get("op") == "c_allreduce_sum"}
+    assert {c.signature for c in plan.collectives} <= sigs
+    # decomposition published: comm_ms gauge set, wait histogram
+    # observed (0 — no gang attached), bus bw computed
+    assert monitor.REGISTRY.get("paddle_tpu_comm_step_ms").value() > 0
+    assert monitor.REGISTRY.get("paddle_tpu_comm_wait_ms").value() == 0
+    wait_fam = monitor.REGISTRY.get("paddle_tpu_collective_wait_ms")
+    assert sum(s["count"] for s in
+               next(m for m in monitor.REGISTRY.collect()
+                    if m["name"] == "paddle_tpu_collective_wait_ms")
+               ["series"]) >= timed_steps
+    assert wait_fam is not None
+    # the collective.launch tracer span carries the correlation payload
+    spans = [ev for ev in monitor.TRACER.chrome_events()
+             if ev.get("name") == "collective.launch"]
+    assert len(spans) >= timed_steps
+    args = spans[-1]["args"]
+    assert args["bytes"] == plan.payload_bytes
+    assert args["signature"] == plan.fingerprint[:12]
+    assert "wait_ms" in args and "step_id" in args
+    assert spans[-1].get("cat") == "collective"
+    # digest carries the comms keys, capped digest keeps them
+    digest = monitor.metrics_digest()
+    assert "comm_ms" in digest and "comm_wait" in digest \
+        and "comm_bw" in digest
+    assert "comm_wait" in monitor.capped_digest(digest, max_bytes=80)
+
+
+def test_comms_telemetry_flag_off_measures_nothing():
+    main, startup, loss_name = _build_dp_program(nranks=2)
+    scope = Scope()
+    pt.set_flags({"FLAGS_comms_telemetry": False})
+    try:
+        with scope_guard(scope), program_guard(main, startup):
+            exe = pt.Executor()
+            exe.run(startup, scope=scope, seed=11)
+            xv = np.ones((8, 8), np.float32)
+            yv = xv.sum(1, keepdims=True)
+            before = monitor.counter_totals()
+            exe.run(main, feed={"x": xv, "y": yv},
+                    fetch_list=[loss_name], scope=scope)
+            after = monitor.counter_totals()
+        assert after.get("paddle_tpu_collective_bytes_total", 0) == \
+            before.get("paddle_tpu_collective_bytes_total", 0)
+    finally:
+        pt.set_flags({"FLAGS_comms_telemetry": True})
+
+
+# ---------------------------------------------------------------------------
+# coordinator comm gate (the timestamp allgather) + net-of-wait straggler
+# ---------------------------------------------------------------------------
+
+def test_comm_gate_measures_peer_arrival_skew():
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    c0 = GangClient(coord.address, rank=0, world_size=2).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2).connect()
+    try:
+        out = {}
+
+        def late_rank():
+            time.sleep(0.2)
+            out[1] = c1.comm_gate(time.time(), timeout_s=10)
+
+        t = threading.Thread(target=late_rank)
+        t0 = time.time()
+        t.start()
+        out[0] = c0.comm_gate(t0, timeout_s=10)
+        t.join()
+        for r in (0, 1):
+            assert out[r]["released"] is True
+            assert set(out[r]["ts"]) == {"0", "1"}
+        skew = out[0]["ts"]["1"] - out[0]["ts"]["0"]
+        assert 0.1 < skew < 5.0       # rank 1 arrived ~0.2 s late
+        # second gate pairs at the next sequence (no cross-step mixing)
+        def next_gate():
+            out["n1"] = c1.comm_gate(time.time(), timeout_s=10)
+        t2 = threading.Thread(target=next_gate)
+        t2.start()
+        out["n0"] = c0.comm_gate(time.time(), timeout_s=10)
+        t2.join()
+        assert out["n0"]["released"] and out["n1"]["released"]
+    finally:
+        c0.close(goodbye=False)
+        c1.close(goodbye=False)
+        coord.stop()
+
+
+def test_comm_gate_partial_on_departed_peer_not_a_hang():
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    c0 = GangClient(coord.address, rank=0, world_size=2).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2).connect()
+    try:
+        c1.goodbye()                  # rank 1 departs cleanly
+        t0 = time.monotonic()
+        resp = c0.comm_gate(time.time(), timeout_s=30)
+        assert time.monotonic() - t0 < 5.0   # returned NOW, not at 30 s
+        assert resp["released"] is False
+        assert set(resp["ts"]) == {"0"}      # partial view, never an error
+    finally:
+        c0.close(goodbye=False)
+        c1.close(goodbye=False)
+        coord.stop()
+
+
+def test_straggler_selection_is_net_of_comm_wait():
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    c0 = GangClient(coord.address, rank=0, world_size=2,
+                    heartbeat_interval_s=0.05).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2,
+                    heartbeat_interval_s=0.05).connect()
+    try:
+        # rank 0: 300 ms steps, 250 of which are WAITING on rank 1;
+        # rank 1: 290 ms steps, no wait.  Raw step time blames rank 0;
+        # net of wait the straggler is rank 1 — the truth.
+        c0.set_digest({"step_ms": 300.0, "comm_ms": 260.0,
+                       "comm_wait": 250.0, "comm_bw": 0.1})
+        c1.set_digest({"step_ms": 290.0, "comm_ms": 10.0,
+                       "comm_wait": 0.0, "comm_bw": 0.1})
+        c0.start_heartbeat()
+        c1.start_heartbeat()
+        deadline = time.monotonic() + 5
+        agg = {}
+        while time.monotonic() < deadline:
+            agg = c0.status().get("aggregates") or {}
+            if agg.get("straggler") == 1:
+                break
+            time.sleep(0.05)
+        assert agg.get("straggler") == 1, agg
+        assert agg.get("straggler_step_ms") == 290.0
+        assert agg.get("straggler_net_ms") == 290.0
+        # per-rank comm gauges folded from the digests
+        fam = monitor.REGISTRY.get("paddle_tpu_gang_rank_comm_ms")
+        vals = {lbl["rank"]: cell.get() for lbl, cell in fam.series()}
+        assert vals.get("0") == 260.0 and vals.get("1") == 10.0
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+def test_divergent_comms_plan_surfaces_as_fingerprint_error():
+    """The parity satellite: two ranks whose COMMS PLANS diverge (same
+    collective sequence, different nranks stamp) must refuse with the
+    existing GangFingerprintError — on the heartbeat exchange AND at the
+    step barrier — not hang inside a collective."""
+    main_a, _, loss_a = _build_dp_program(nranks=2)
+    main_b, _, loss_b = _build_dp_program(nranks=3)
+    fp_a = verifier.collective_fingerprint(main_a)
+    fp_b = verifier.collective_fingerprint(main_b)
+    assert fp_a and fp_b and fp_a != fp_b
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    c0 = GangClient(coord.address, rank=0, world_size=2,
+                    heartbeat_interval_s=0.05).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2,
+                    heartbeat_interval_s=0.05).connect()
+    try:
+        # heartbeat exchange latches the mismatch into check()
+        c0.set_progress(fingerprint=fp_a)
+        c1.set_progress(fingerprint=fp_b)
+        c0.start_heartbeat()
+        c1.start_heartbeat()
+        deadline = time.monotonic() + 5
+        latched = False
+        while time.monotonic() < deadline:
+            try:
+                c0.check()
+            except GangFingerprintError:
+                latched = True
+                break
+            time.sleep(0.05)
+        assert latched, "heartbeat exchange never latched the mismatch"
+        # the barrier refuses immediately for both ranks (not a hang)
+        errs = {}
+
+        def arrive(rank, client, fp):
+            try:
+                client.step_barrier(1, fingerprint=fp, timeout_s=10)
+            except Exception as e:
+                errs[rank] = e
+        t0 = threading.Thread(target=arrive, args=(0, c0, fp_a))
+        t1 = threading.Thread(target=arrive, args=(1, c1, fp_b))
+        start = time.monotonic()
+        t0.start()
+        t1.start()
+        t0.join()
+        t1.join()
+        assert time.monotonic() - start < 5.0
+        assert isinstance(errs.get(0), GangFingerprintError)
+        assert isinstance(errs.get(1), GangFingerprintError)
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# coordinator scrape surface (satellite)
+# ---------------------------------------------------------------------------
+
+def _http_get(url):
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+    try:
+        with urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode()
+    except HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_coordinator_metrics_http_scrape_surface():
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=0.6).start()
+    http = coord.start_metrics_http(0, host="127.0.0.1")
+    c0 = GangClient(coord.address, rank=0, world_size=2,
+                    heartbeat_interval_s=0.1).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2,
+                    heartbeat_interval_s=0.1).connect()
+    try:
+        c0.set_digest({"step_ms": 12.0, "comm_ms": 3.0,
+                       "comm_wait": 1.0})
+        c0.start_heartbeat()
+        c1.start_heartbeat()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if c0.status()["status"] == "ok":
+                break
+            time.sleep(0.02)
+        status, body = _http_get(http.url + "/metrics")
+        assert status == 200
+        assert "paddle_tpu_gang_heartbeats_total" in body
+        # prometheus-valid (the timeline validator's line checker)
+        import os as _os
+        import sys as _sys
+        _sys.path.insert(0, _os.path.join(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))), "tools"))
+        import timeline
+        assert timeline.validate_prometheus(body) > 0
+        status, body = _http_get(http.url + "/healthz")
+        assert (status, body.strip()) in ((200, "ok"), (200, "forming"))
+        status, body = _http_get(http.url + "/statusz")
+        assert status == 200
+        sz = json.loads(body)
+        assert set(sz["ranks"]) == {"0", "1"} and "aggregates" in sz
+        assert sz["ranks"]["0"]["digest"]["comm_ms"] == 3.0
+        # degraded gang -> 503 (a load balancer's probe contract)
+        c1.close(goodbye=False)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not c0.degraded:
+            time.sleep(0.02)
+        status, body = _http_get(http.url + "/healthz")
+        assert status == 503 and body.strip() == "degraded"
+    finally:
+        c0.close()
+        try:
+            c1.close(goodbye=False)
+        except Exception:
+            pass
+        coord.stop()
+    # stop() tore the http server down with the coordinator
+    with pytest.raises(RuntimeError):
+        http.url
+
+
+# ---------------------------------------------------------------------------
+# breaker state gauge + PS RPC histogram family (satellite)
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_state_gauge_transitions():
+    from paddle_tpu import resilience
+    clock = [0.0]
+    br = resilience.CircuitBreaker(name="127.0.0.1:9999",
+                                   cooldown_s=5.0,
+                                   clock=lambda: clock[0])
+    fam = monitor.REGISTRY.get("paddle_tpu_circuit_breaker_state")
+
+    def state():
+        return fam.value(endpoint="127.0.0.1:9999")
+
+    assert state() == 0                       # closed
+    br.record_giveup()
+    assert state() == 2                       # open
+    with pytest.raises(resilience.CircuitOpenError):
+        br.check("ps.put")
+    assert state() == 2                       # still open mid cool-down
+    clock[0] = 6.0
+    br.check("ps.put")                        # claims the half-open probe
+    assert state() == 1
+    br.record_success()
+    assert state() == 0                       # probe succeeded: closed
+    # anonymous breakers stay out of the registry
+    resilience.CircuitBreaker(cooldown_s=1.0)
+    assert all(lbl["endpoint"] for lbl, _ in fam.series())
+
+
+def test_ps_rpc_histogram_family_registered():
+    from paddle_tpu.distributed import ps  # noqa: F401
+    fam = monitor.REGISTRY.get("paddle_tpu_ps_rpc_ms")
+    assert fam is not None
+    assert fam.labelnames == ("endpoint", "op")
+
+
+# ---------------------------------------------------------------------------
+# gangtop columns + COMM-BOUND flag
+# ---------------------------------------------------------------------------
+
+def _gangtop():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import gangtop
+    return gangtop
+
+
+def test_gangtop_comm_columns_and_straggler_consistent_flag():
+    gangtop = _gangtop()
+    status = {
+        "status": "ok", "dead": [], "manifest": 4, "mismatch": None,
+        "aggregates": {"straggler": 1, "step_skew": 0},
+        "ranks": {
+            # rank 0: wait-dominated comm (victim of the straggler)
+            "0": {"alive": True, "finished": False, "step": 4,
+                  "cur_step": 8, "steps": [4], "hb_steps": [4],
+                  "fingerprint": None, "pid": 1, "deaths": 0,
+                  "joins": 1, "age_s": 0.1,
+                  "digest": {"step_ms": 300.0, "mfu": 0.2,
+                             "comm_ms": 260.0, "comm_wait": 250.0,
+                             "comm_bw": 0.4}},
+            # rank 1: the straggler
+            "1": {"alive": True, "finished": False, "step": 4,
+                  "cur_step": 8, "steps": [4], "hb_steps": [4],
+                  "fingerprint": None, "pid": 2, "deaths": 0,
+                  "joins": 1, "age_s": 0.1,
+                  "digest": {"step_ms": 290.0, "mfu": 0.1,
+                             "comm_ms": 10.0, "comm_wait": 0.0,
+                             "comm_bw": 0.4}},
+            # rank 2: genuinely wire-bound (slow link, no wait)
+            "2": {"alive": True, "finished": False, "step": 4,
+                  "cur_step": 8, "steps": [4], "hb_steps": [4],
+                  "fingerprint": None, "pid": 3, "deaths": 0,
+                  "joins": 1, "age_s": 0.1,
+                  "digest": {"step_ms": 100.0, "mfu": 0.1,
+                             "comm_ms": 80.0, "comm_wait": 5.0,
+                             "comm_bw": 0.9}},
+        }}
+    out = gangtop.render(status)
+    assert "COMM" in out and "BW%" in out
+    lines = {ln.strip().split()[0]: ln for ln in out.splitlines()
+             if ln.strip() and ln.strip().split()[0] in "012"}
+    assert "<-- straggler" in lines["1"]
+    # the waiting rank must NOT read as comm-bound (its comm time is
+    # the straggler's fault); the wire-bound rank must
+    assert "COMM-BOUND" not in lines["0"]
+    assert "COMM-BOUND" in lines["2"]
+    assert "260.0" in lines["0"] and "40.0" in lines["0"]  # COMM + BW%
+    # the predicate itself
+    assert not gangtop.comm_bound({"step_ms": 300.0, "comm_ms": 260.0,
+                                   "comm_wait": 250.0})
+    assert gangtop.comm_bound({"step_ms": 100.0, "comm_ms": 80.0,
+                               "comm_wait": 5.0})
+    assert not gangtop.comm_bound({"step_ms": 100.0, "comm_ms": 10.0})
+
+
+# ---------------------------------------------------------------------------
+# timeline --rank-lanes comm lane
+# ---------------------------------------------------------------------------
+
+def test_timeline_rank_lanes_comm_lane(tmp_path):
+    gangtop = _gangtop()  # noqa: F841  (ensures tools on sys.path)
+    import timeline
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 7, "tid": 0,
+         "args": {"name": "paddle_tpu:7"}},
+        {"name": "executor.dispatch", "ph": "X", "cat": "dispatch",
+         "pid": 7, "tid": 123456, "ts": 10.0, "dur": 5.0,
+         "args": {"step": 1}},
+        {"name": "collective.launch", "ph": "X", "cat": "collective",
+         "pid": 7, "tid": 123456, "ts": 11.0, "dur": 2.0,
+         "args": {"bytes": 644, "wait_ms": 0.0, "step_id": 1}},
+    ]
+    src = tmp_path / "r0.json"
+    src.write_text(json.dumps({"traceEvents": events}))
+    out = tmp_path / "merged.json"
+    timeline.merge(f"0={src}", str(out), rank_lanes=True)
+    merged = json.loads(out.read_text())["traceEvents"]
+    coll = [ev for ev in merged if ev["name"] == "collective.launch"]
+    assert coll and all(ev["tid"] == timeline.COMM_LANE_TID
+                        for ev in coll)
+    disp = [ev for ev in merged if ev["name"] == "executor.dispatch"]
+    assert disp[0]["tid"] == 123456          # compute rows untouched
+    names = [ev for ev in merged if ev.get("ph") == "M"
+             and ev["name"] == "thread_name"
+             and ev["tid"] == timeline.COMM_LANE_TID]
+    assert names and names[0]["args"]["name"] == "comms"
+    assert timeline.validate(str(out), strict=True)["events"] >= 5

@@ -593,10 +593,17 @@ def test_latency_report_decomposes_exported_trace(tmp_path):
         span("materialize", 2000, "dec_t", "decode", 19e3, 1.0,
              e2e_ms=20.0),
     ]
-    # an in-flight chain (no materialize yet) + unrelated noise
+    # an in-flight chain (no materialize yet) + bare executor steps: a
+    # SERVING trace's executor spans are the same milliseconds its
+    # serving phases already attribute, so they must NOT double-count
     events.append(span("admit", 3000, "lat_t", "8", 0, 1.0))
-    events.append({"ph": "X", "name": "executor.dispatch", "ts": 0,
-                   "dur": 5.0, "args": {"step": 7}})
+    exec_spans = [
+        {"ph": "X", "name": "executor.dispatch", "ts": 0,
+         "dur": 5e3, "args": {"step": 7}},
+        {"ph": "X", "name": "fetch.materialize", "ts": 6e3,
+         "dur": 2e3, "args": {"n": 1, "step": 7}},
+    ]
+    events += exec_spans
     path = tmp_path / "trace.json"
     path.write_text(json.dumps({"traceEvents": events}))
 
@@ -604,6 +611,21 @@ def test_latency_report_decomposes_exported_trace(tmp_path):
     assert rep["total_requests"] == 3
     assert rep["in_flight_at_export"] == 1
     by_key = {(g["tenant"], g["bucket"]): g for g in rep["groups"]}
+    assert ("untagged", "untagged") not in by_key
+
+    # an executor-ONLY trace (no serving plane at all) decomposes under
+    # 'untagged' instead of producing an empty report
+    xpath = tmp_path / "exec_trace.json"
+    xpath.write_text(json.dumps({"traceEvents": exec_spans}))
+    xrep = latency_report.report(latency_report.load_chains(str(xpath)))
+    assert xrep["total_requests"] == 1
+    unt = xrep["groups"][0]
+    assert (unt["tenant"], unt["bucket"]) == ("untagged", "untagged")
+    assert unt["phases"]["dispatch"] == {"p50_ms": 5.0, "p99_ms": 5.0}
+    assert unt["phases"]["materialize"] == {"p50_ms": 2.0, "p99_ms": 2.0}
+    # no submit->resolve envelope on an executor chain: e2e is the
+    # phase sum, so the chain reports instead of reading as in-flight
+    assert unt["e2e"] == {"p50_ms": 7.0, "p99_ms": 7.0}
     lat = by_key[("lat_t", "8")]
     assert lat["requests"] == 2
     assert lat["e2e"] == {"p50_ms": 10.0, "p99_ms": 30.0}

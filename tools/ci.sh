@@ -92,6 +92,9 @@ JAX_PLATFORMS=cpu python tools/fusion_smoke.py
 echo "== numerics smoke (in-graph stats, NaN poison -> anomaly + capture window + checkpoint quarantine) =="
 JAX_PLATFORMS=cpu python tools/numerics_smoke.py
 
+echo "== comms smoke (static plan vs measured bytes, straggler-wait decomposition, zero added host blocks) =="
+JAX_PLATFORMS=cpu python tools/comms_smoke.py
+
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 

@@ -4,12 +4,15 @@ coordinator's ``status`` view — `top` for a training gang.
 
 Each row is one rank: liveness, current training step, durably-committed
 step, and the heartbeat metrics digest (step-time estimate, live MFU,
-dataloader queue depth, executor in-flight depth, plus the serving-load
-columns a fleet router reads — serving queue depth SRVQ, last batch
-occupancy OCC, free decode slots SLOT, decode TOK/S).  The slowest live
-rank is flagged ``<-- straggler`` (the same rank the coordinator's
-``paddle_tpu_gang_straggler_rank`` gauge names), and the footer carries
-the gang-level view: status, step skew, manifest, fingerprint mismatch.
+the comms plane's COMM time and BW% bus bandwidth, dataloader queue
+depth, executor in-flight depth, plus the serving-load columns a fleet
+router reads — serving queue depth SRVQ, last batch occupancy OCC, free
+decode slots SLOT, decode TOK/S).  The slowest live rank NET of comm
+wait is flagged ``<-- straggler`` (the same rank the coordinator's
+``paddle_tpu_gang_straggler_rank`` gauge names); a rank whose step is
+dominated by WIRE time (not straggler wait) is flagged
+``<-- COMM-BOUND``.  The footer carries the gang-level view: status,
+step skew, manifest, fingerprint mismatch.
 
 Usage:
     python tools/gangtop.py [--coord HOST:PORT] [--interval 2.0] [--once]
@@ -54,11 +57,28 @@ def _fmt(v, spec="{:.1f}", dash="-"):
         return dash
 
 
+def comm_bound(digest: dict) -> bool:
+    """A rank is COMM-BOUND when over half its step is comm time AND
+    that comm time is wire-dominated (less than half of it is straggler
+    wait).  Wait-dominated comm means the rank is stalled on a slow
+    PEER — that peer gets the straggler flag; flagging the waiting rank
+    comm-bound would send the runbook after the wrong problem."""
+    step = digest.get("step_ms")
+    comm = digest.get("comm_ms")
+    if not isinstance(step, (int, float)) or \
+            not isinstance(comm, (int, float)) or step <= 0 or comm <= 0:
+        return False
+    wait = digest.get("comm_wait")
+    wait = float(wait) if isinstance(wait, (int, float)) else 0.0
+    return comm / step > 0.5 and wait / comm < 0.5
+
+
 def render(status: dict) -> str:
     ranks = status.get("ranks", {})
     rows = []
     header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
-              f"{'STEP_MS':>9} {'MFU%':>6} {'GNORM':>8} {'NANF':>6} "
+              f"{'STEP_MS':>9} {'MFU%':>6} {'COMM':>7} {'BW%':>6} "
+              f"{'GNORM':>8} {'NANF':>6} "
               f"{'QUEUE':>5} {'INFL':>4} "
               f"{'SRVQ':>5} {'OCC':>5} {'SLOT':>4} {'TOK/S':>7} "
               f"{'HB_AGE':>7} {'DEATHS':>6}")
@@ -76,10 +96,13 @@ def render(status: dict) -> str:
         d = e.get("digest") or {}
         mfu = d.get("mfu")
         nanf = d.get("nanf")
+        bw = d.get("comm_bw")
         line = (f"{r:>4}  {state:<8} {_fmt(e.get('cur_step'), '{}'):>8} "
                 f"{_fmt(e.get('step'), '{}'):>7} "
                 f"{_fmt(d.get('step_ms')):>9} "
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
+                f"{_fmt(d.get('comm_ms')):>7} "
+                f"{_fmt(bw * 100 if isinstance(bw, (int, float)) else None):>6} "
                 f"{_fmt(d.get('gnorm'), '{:.3g}'):>8} "
                 f"{_fmt(nanf, '{:.0f}'):>6} "
                 f"{_fmt(d.get('queue'), '{:.0f}'):>5} "
@@ -92,6 +115,12 @@ def render(status: dict) -> str:
                 f"{_fmt(e.get('deaths'), '{}'):>6}")
         if r == straggler:
             line += "   <-- straggler"
+        elif comm_bound(d):
+            # straggler-consistent by construction: the flag fires only
+            # on WIRE-dominated comm time, and never on the straggler
+            # itself — a rank whose comm is mostly WAIT is a victim of
+            # the straggler (already flagged above), not of the network
+            line += "   <-- COMM-BOUND"
         if isinstance(nanf, (int, float)) and nanf > 0:
             line += "   <-- NONFINITE"
         rows.append(line)

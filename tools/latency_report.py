@@ -8,6 +8,14 @@ serving plane (``serving.admit / queue_wait / batch_wait / dispatch /
 decode / materialize``), plus the end-to-end quantiles and the padding
 overhead attribution carried on the dispatch spans.
 
+Non-serving traces decompose too: spans missing tenant/bucket tags fall
+back to an ``untagged`` group instead of being discarded, and
+executor-only traces (no serving plane at all) are chained by the step
+id the executor stamps on its ``executor.dispatch`` /
+``fetch.materialize`` spans — so a plain training run's trace yields a
+dispatch/materialize decomposition under ``untagged`` rather than an
+empty report.
+
     python tools/latency_report.py trace.json
     python tools/latency_report.py trace.json --json
     python tools/latency_report.py trace.json --tenant tenant_a
@@ -26,30 +34,60 @@ import sys
 PHASES = ("admit", "queue_wait", "batch_wait", "dispatch", "decode",
           "materialize")
 
+#: group name for chains whose spans carry no tenant/bucket tags
+#: (executor-only traces, foreign serving spans)
+UNTAGGED = "untagged"
+
+#: executor span name -> phase it contributes to an untagged step chain
+_EXECUTOR_PHASES = {"executor.dispatch": "dispatch",
+                    "fetch.materialize": "materialize"}
+
 
 def load_chains(path):
-    """trace json -> {(pid, trace_id): {"tenant", "bucket", "phases":
-    {phase: ms}, "e2e_ms", "pad_frac"}} for every serving.* chain.
-    Trace ids are only PROCESS-unique (a per-process counter), so a
-    multi-rank merged gang trace is keyed on (pid, trace) — two ranks'
+    """trace json -> {(pid, chain_id): {"tenant", "bucket", "phases":
+    {phase: ms}, "e2e_ms", "pad_frac"}} for every serving.* chain PLUS
+    an untagged chain per executor step (see module docstring).
+    Trace/step ids are only PROCESS-unique (per-process counters), so a
+    multi-rank merged gang trace is keyed on (pid, id) — two ranks'
     request 1 must not fuse into one chain."""
     with open(path) as f:
         data = json.load(f)
     events = data if isinstance(data, list) else data.get(
         "traceEvents", [])
     chains = {}
+    executor_chains = {}
     for ev in events:
         name = str(ev.get("name", ""))
         args = ev.get("args") or {}
-        if (ev.get("ph") != "X" or not name.startswith("serving.")
-                or "trace" not in args):
+        if ev.get("ph") != "X":
             continue
-        phase = name[len("serving."):]
-        if phase not in PHASES:
+        if name.startswith("serving.") and "trace" in args:
+            phase = name[len("serving."):]
+            if phase not in PHASES:
+                continue
+            # spans without tenant/bucket tags (foreign emitters, older
+            # exports) fall back to the untagged group instead of being
+            # silently mislabeled or dropped
+            dst = chains
+            key = (ev.get("pid"), args["trace"])
+            tenant = str(args.get("tenant", UNTAGGED))
+            bucket = str(args.get("bucket", UNTAGGED))
+        elif name in _EXECUTOR_PHASES and "step" in args:
+            # executor-ONLY decomposition: chain dispatch+materialize by
+            # the step id the executor stamps on both spans.  Collected
+            # separately and used only when the trace has NO serving
+            # chains — a serving trace's executor spans are the same
+            # milliseconds its serving.dispatch/materialize phases
+            # already attribute, and double-counting them would inflate
+            # the report
+            dst = executor_chains
+            phase = _EXECUTOR_PHASES[name]
+            key = (ev.get("pid"), f"step:{args['step']}")
+            tenant = bucket = UNTAGGED
+        else:
             continue
-        c = chains.setdefault((ev.get("pid"), args["trace"]), {
-            "tenant": str(args.get("tenant", "?")),
-            "bucket": str(args.get("bucket", "?")),
+        c = dst.setdefault(key, {
+            "tenant": tenant, "bucket": bucket,
             "phases": {}, "e2e_ms": None, "pad_frac": None})
         c["phases"][phase] = c["phases"].get(phase, 0.0) \
             + ev.get("dur", 0.0) / 1e3
@@ -57,6 +95,15 @@ def load_chains(path):
             c["e2e_ms"] = float(args["e2e_ms"])
         if phase == "dispatch" and "pad_frac" in args:
             c["pad_frac"] = float(args["pad_frac"])
+    if not chains:
+        chains = executor_chains
+        for c in chains.values():
+            if c["e2e_ms"] is None and c["phases"]:
+                # executor chains carry no submit->resolve envelope;
+                # the recorded phases ARE the chain, so their sum is
+                # the honest end-to-end (otherwise report() would drop
+                # the chain as in-flight)
+                c["e2e_ms"] = sum(c["phases"].values())
     return chains
 
 

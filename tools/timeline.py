@@ -17,10 +17,15 @@ malformed-output check the CI telemetry smoke step runs.
 integer pid lane (``pid = rank``), named ``rank N`` and sorted by rank
 via ``process_sort_index`` metadata, with the rank's threads as rows
 inside its lane — the one-glance view of a 2+-rank gang where skew and
-stragglers are visible as horizontally-offset step spans.  Incoming
-per-process ``process_name`` metadata is replaced by the lane labels;
-everything else (thread names, spans, counters) is preserved.  The
-merged output still passes strict ``validate()``.
+stragglers are visible as horizontally-offset step spans.  Collective
+spans (``cat == "collective"`` — the executor's ``collective.launch``
+decompositions, barrier waits, host↔global assemblies) are re-homed
+onto a dedicated ``comms`` row pinned at the top of each rank's lane,
+so cross-rank communication stacks visually against the compute rows
+it overlaps.  Incoming per-process ``process_name`` metadata is
+replaced by the lane labels; everything else (thread names, spans,
+counters) is preserved.  The merged output still passes strict
+``validate()``.
 """
 
 from __future__ import annotations
@@ -33,10 +38,16 @@ import json
 _KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s",
                  "t", "f"}
 
+#: rank-lane mode: tid of the dedicated per-rank comm row that
+#: ``cat == "collective"`` spans are re-homed onto (real thread ids are
+#: ``threading.get_ident() & 0xffffff`` — never this small)
+COMM_LANE_TID = 1
+
 
 def merge(profile_paths, out_path, align=False, rank_lanes=False):
     events = []
     lane_ranks = set()
+    comm_ranks = set()
     for spec in profile_paths.split(","):
         if "=" in spec:
             rank, path = spec.split("=", 1)
@@ -58,6 +69,14 @@ def merge(profile_paths, out_path, align=False, rank_lanes=False):
                     continue
                 ev["pid"] = int(rank)
                 lane_ranks.add(int(rank))
+                if ev.get("cat") == "collective" and ev.get("ph") != "M":
+                    # distinct comm row per rank lane: collective spans
+                    # (launch decompositions, barrier waits, host<->
+                    # global assembly) stack against the compute rows
+                    # they overlap instead of hiding inside the
+                    # dispatching thread's row
+                    ev["tid"] = COMM_LANE_TID
+                    comm_ranks.add(int(rank))
             else:
                 ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
             events.append(ev)
@@ -66,6 +85,11 @@ def merge(profile_paths, out_path, align=False, rank_lanes=False):
                        "tid": 0, "args": {"name": f"rank {r}"}})
         events.append({"name": "process_sort_index", "ph": "M", "pid": r,
                        "tid": 0, "args": {"sort_index": r}})
+    for r in sorted(comm_ranks):
+        events.append({"name": "thread_name", "ph": "M", "pid": r,
+                       "tid": COMM_LANE_TID, "args": {"name": "comms"}})
+        events.append({"name": "thread_sort_index", "ph": "M", "pid": r,
+                       "tid": COMM_LANE_TID, "args": {"sort_index": -1}})
     if align:
         t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
         for ev in events:
