@@ -911,22 +911,27 @@ def bench_numerics(dev, on_tpu, peak):
 
 
 def bench_memory(dev, on_tpu, peak):
-    """Static HBM planner vs reality: for two workloads, run a few real
-    steps, then pair the planner's step-boundary live-byte estimate
+    """Static HBM planner vs the runtime memory plane: for two
+    workloads, run a few real steps, then pair the planner's
+    step-boundary live-byte estimate
     (``analysis.plan_memory(...).steady_bytes`` at the true batch)
-    against the measured live device bytes (``memory.live_bytes`` delta
-    over the workload).  One ``memory:<workload>`` line each; `value` is
-    estimate/measured (1.0 = exact).  The planner's transient peak
-    (``peak_bytes``, includes mid-step temporaries XLA frees before the
-    boundary) rides along for the trajectory."""
+    against the measured live device bytes — read through
+    ``hbm.measure_live_bytes``, the SAME reader the runtime accountant
+    publishes its gauges from, so bench and the live plane can never
+    disagree on what 'measured' means.  One ``memory:<workload>`` line
+    each (`value` = estimate/measured, 1.0 = exact) plus an
+    ``hbm:<workload>`` line pairing the accountant's live/peak/drift
+    gauges against the plan — the plan-vs-measured gate the GSPMD
+    sharding chooser's headroom signal rides on."""
     import gc
 
     import jax
     import paddle_tpu as pt
-    from paddle_tpu import layers, memory as mem
+    from paddle_tpu import hbm, layers
     from paddle_tpu.analysis import plan_memory
     from paddle_tpu.framework import Program, Scope, program_guard, \
         scope_guard
+    from paddle_tpu.monitor import REGISTRY
 
     def mlp_adam():
         x = layers.data("x", shape=[256], dtype="float32")
@@ -949,7 +954,7 @@ def bench_memory(dev, on_tpu, peak):
     for name, build in (("mlp_adam", mlp_adam),
                         ("wide_embedding", wide_embedding)):
         gc.collect()
-        base = mem.live_bytes()
+        base = hbm.measure_live_bytes()
         scope = Scope()
         with scope_guard(scope), program_guard(Program(), Program()):
             feed_np, loss = build()
@@ -967,7 +972,7 @@ def bench_memory(dev, on_tpu, peak):
             batch = next(iter(feed_np.values())).shape[0]
             plan = plan_memory(prog, (loss.name,), batch_size=batch)
             gc.collect()
-            measured = mem.live_bytes() - base
+            measured = hbm.measure_live_bytes() - base
             est = plan.steady_bytes
             emit({
                 "metric": f"memory:{name}",
@@ -984,10 +989,47 @@ def bench_memory(dev, on_tpu, peak):
                 "note": ("estimate = planner steady (step-boundary live "
                          "set: persistables counted once under donation "
                          "+ staged feeds + pinned fetches); measured = "
-                         "live device bytes delta over the workload"),
+                         "live device bytes delta over the workload, via "
+                         "hbm.measure_live_bytes — the accountant's "
+                         "reader"),
+            })
+            # runtime plane: drain the off-thread accountant and pair
+            # its gauges against the same plan.  `value` is the
+            # delta-based plan-vs-measured ratio (the planner's
+            # established 1.000-1.006 band); the raw drift gauge
+            # (process live / plan steady) rides along — it includes
+            # residual allocations from earlier workloads, so the gated
+            # number is the delta form.
+            hbm.ACCOUNTANT.drain(10.0)
+
+            def _gauge(fam):
+                g = REGISTRY.get(fam)
+                cells = g.series() if g is not None else []
+                return float(cells[-1][1].get()) if cells else 0.0
+            emit({
+                "metric": f"hbm:{name}",
+                "value": round(measured / est, 3) if est else 0,
+                "unit": "measured/plan (runtime accountant reader; "
+                        "1.0 = plan exact)",
+                "vs_baseline": 0,
+                "plan_steady_bytes": int(est),
+                "measured_bytes": int(measured),
+                "live_gauge_bytes": int(_gauge("paddle_tpu_hbm_live_bytes")),
+                "peak_gauge_bytes": int(_gauge("paddle_tpu_hbm_peak_bytes")),
+                "drift_gauge": round(
+                    _gauge("paddle_tpu_hbm_plan_drift"), 4),
+                "samples": int(monitor_counter_total(
+                    "paddle_tpu_hbm_samples_total")),
+                "batch": int(batch),
+                "device": str(dev),
             })
         del scope
         gc.collect()
+
+
+def monitor_counter_total(fam: str) -> float:
+    from paddle_tpu.monitor import counter_totals
+    return counter_totals().get(fam, 0.0)
 
 
 def _serving_latencies(futs, timeout_s=600.0):

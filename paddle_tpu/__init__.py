@@ -32,6 +32,7 @@ from . import io  # noqa
 from . import memory  # noqa
 from . import native  # noqa
 from . import monitor  # noqa  (metrics registry + step tracer)
+from . import hbm  # noqa  (runtime HBM accountant + OOM forensics)
 from . import resilience  # noqa  (fault injection, retries, preemption)
 from . import analysis  # noqa  (program verifier: static checks at optimize time)
 from . import serving  # noqa  (multi-tenant continuous-batching server)

@@ -589,6 +589,12 @@ class GangCoordinator:
         "comm_ms": _monitor.GANG_RANK_COMM_MS,
         "comm_wait": _monitor.GANG_RANK_COMM_WAIT,
         "comm_bw": _monitor.GANG_RANK_COMM_BW,
+        # hbm plane: measured live bytes + headroom (budget - live) —
+        # gangtop's HBM/HDRM% columns and OOM-RISK flag, and the
+        # fleet-wide headroom surface the GSPMD sharding chooser and an
+        # autoscaler consume
+        "hbm": _monitor.GANG_RANK_HBM,
+        "hdrm": _monitor.GANG_RANK_HDRM,
     }
 
     def _fold_digest(self, rank: int, digest: dict) -> None:

@@ -309,6 +309,33 @@ _DEFAULTS: Dict[str, Any] = {
     # scrapeable without a serving stack.  0 (default) disables;
     # /healthz answers 503 while the gang is degraded.
     "FLAGS_coordinator_metrics_port": 0,
+    # -- runtime HBM observability plane (paddle_tpu.hbm) ------------------
+    # per-step live-bytes accounting: the executor notes every sampled
+    # step boundary to an off-thread accountant that publishes
+    # paddle_tpu_hbm_{live,peak,budget,headroom}_bytes, the plan-drift
+    # gauge, and the per-class attribution.  Default on: the hot-path
+    # cost is one bounded deque append per sampled step.
+    "FLAGS_hbm_telemetry": True,
+    # sample every Nth dispatched step (1 = every step; raise it on
+    # very fast steps to cut worker-thread churn)
+    "FLAGS_hbm_sample_every_n_steps": 1,
+    # peak-watermark window: paddle_tpu_hbm_peak_bytes is the max of the
+    # last N live-bytes samples
+    "FLAGS_hbm_window": 16,
+    # record each compiled executable's XLA buffer-assignment plan
+    # (memory_analysis) through hbm.record_xla_plan on its first call —
+    # the AOT object is reused for execution, so recording costs no
+    # extra compile.  PADDLE_TPU_RECORD_HBM=1 is the legacy env alias.
+    "FLAGS_hbm_record_plans": False,
+    # headroom-regression capture trigger (the memory twin of
+    # FLAGS_profile_sample_regress_frac): when > 0 and a budget is
+    # known, a profiler capture window (trigger:"hbm_regress") opens
+    # the sample the measured headroom shrinks by this fraction under
+    # the best headroom seen; re-arms after it recovers half-way.
+    "FLAGS_hbm_headroom_regress_frac": 0.0,
+    # where OOM forensics dumps land ("" = FLAGS_watchdog_dump_dir,
+    # else the system temp dir)
+    "FLAGS_oom_dump_dir": "",
     # async dispatch throttle: max run() calls in flight before the
     # executor blocks on the oldest step's output.  2 ≈ classic double
     # buffering — enough to hide host work behind device compute without
@@ -395,6 +422,18 @@ def _apply_side_effects(name: str, value):
             window=int(fl["FLAGS_numerics_window"]),
             topk=int(fl["FLAGS_numerics_topk"]),
             quarantine=bool(fl["FLAGS_numerics_quarantine"]))
+    elif name in ("FLAGS_hbm_telemetry", "FLAGS_hbm_sample_every_n_steps",
+                  "FLAGS_hbm_window", "FLAGS_hbm_headroom_regress_frac"):
+        from . import hbm
+        fl = get_flags(["FLAGS_hbm_telemetry",
+                        "FLAGS_hbm_sample_every_n_steps",
+                        "FLAGS_hbm_window",
+                        "FLAGS_hbm_headroom_regress_frac"])
+        hbm.ACCOUNTANT.configure(
+            bool(fl["FLAGS_hbm_telemetry"]),
+            int(fl["FLAGS_hbm_sample_every_n_steps"]),
+            int(fl["FLAGS_hbm_window"]),
+            float(fl["FLAGS_hbm_headroom_regress_frac"]))
     elif name in ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline"):
         # the NATIVE ps client reads these via getenv (retry_times per
         # request, deadline at connect) — mirror flag changes into the

@@ -310,6 +310,18 @@ def _comms():
     return _comms_mod[0]
 
 
+_hbm_mod = []
+
+
+def _hbm():
+    """Memoized paddle_tpu.hbm module (the step boundary reads one
+    enabled flag + queues one record per sampled step)."""
+    if not _hbm_mod:
+        from .. import hbm
+        _hbm_mod.append(hbm)
+    return _hbm_mod[0]
+
+
 def _device_peak() -> float:
     """Memoized chip peak FLOP/s (the live-MFU denominator)."""
     if not _device_peak_cache:
@@ -337,6 +349,45 @@ def _restamp_memory(program, fetch_names, batch):
         "top_ops": [(p, t, b) for p, t, b, _ in plan.top_ops(5)],
         "batch": batch,
     }
+
+
+def _resolve_hbm_info(cb, program, feeds):
+    """Once per compiled block: the class name-sets (params vs other
+    persistables = optimizer state / BN stats) plus the static plan's
+    bytes at the real batch — what the off-thread HBM accountant joins
+    live samples against.  Prefers the ``_attrs["verify"]["memory"]``
+    stamp ``_resolve_cost`` re-planned earlier in the same first
+    dispatch; programs the verifier never stamped plan directly
+    (``plan_memory`` is fingerprint-cached, so this is a one-off per
+    block, the same cost the restamp pays).  None on failure —
+    accounting must never break dispatch."""
+    try:
+        block = program.global_block()
+        params, opt = [], []
+        for n in tuple(cb.persist_ro) + tuple(cb.persist_rw):
+            if not block.has_var(n):
+                continue
+            v = block.var(n)
+            if not v.persistable:
+                continue
+            (params if getattr(v, "is_parameter", False)
+             else opt).append(n)
+        va = program._attrs.get("verify") or {}
+        mem = va.get("memory") or {}
+        steady = int(mem.get("steady_bytes", 0) or 0)
+        peak = int(mem.get("peak_bytes", 0) or 0)
+        batch = int(mem.get("batch", 1) or 1)
+        if not steady:
+            from ..analysis.memory import plan_memory
+            batch = _feed_batch(feeds)
+            plan = plan_memory(program, cb.fetch_names,
+                               batch_size=batch)
+            steady, peak = int(plan.steady_bytes), int(plan.peak_bytes)
+        return {"params": frozenset(params), "opt_state": frozenset(opt),
+                "plan_steady": steady, "plan_peak": peak,
+                "plan_batch": batch}
+    except Exception:
+        return None
 
 
 def _feed_batch(feeds) -> int:
@@ -1033,20 +1084,20 @@ class _CompiledBlock:
     _compiled_aot = None
 
     def __call__(self, feeds, ro, rw, seed):
-        if not self._hbm_recorded and \
-                os.environ.get("PADDLE_TPU_RECORD_HBM"):
+        if not self._hbm_recorded and _hbm().plans_enabled():
             # capture the executable's HBM allocation plan (ref
             # allocator_facade stats): device.memory_stats() is unavailable
             # through the axon tunnel, but the AOT-compiled executable's
             # memory_analysis IS the on-chip buffer assignment — arguments
             # + temps + outputs is what the runtime allocates for a step.
             # The AOT object is then used for execution, so recording costs
-            # no extra compile.
+            # no extra compile.  Routed through hbm.record_xla_plan (the
+            # one ingestion point for measured bytes; FLAGS_hbm_record_plans
+            # with PADDLE_TPU_RECORD_HBM kept as the legacy env alias).
             self._hbm_recorded = True
             try:
                 compiled = self.jitted.lower(feeds, ro, rw, seed).compile()
-                from .. import memory as _mem
-                _mem.record_hbm_plan(
+                _hbm().record_xla_plan(
                     ",".join(self.fetch_names) or "<block>",
                     compiled.memory_analysis())
                 self._compiled_aot = compiled
@@ -1539,6 +1590,10 @@ class Executor:
                     jax.profiler.StepTraceAnnotation(
                         "paddle_tpu.step", step_num=step_id):
                 _resil.maybe_inject("executor.dispatch")
+                # OOM drill site: an injected fault here runs the SAME
+                # forensics path a real RESOURCE_EXHAUSTED from the
+                # compile/dispatch below does (tools/hbm_smoke.py)
+                _resil.maybe_inject("memory.oom")
                 out = cb(feeds, ro_vals, rw_vals, seed_arr)
                 if len(out) == 4:
                     fetches, new_rw, probe, num_stats = out
@@ -1561,15 +1616,40 @@ class Executor:
                                if p.key == key]:
                         self._plans.pop(fk, None)
             from .. import memory as _memory
-            if _memory._is_oom_error(e):
+            injected_oom = getattr(e, "site", None) == "memory.oom"
+            if _memory._is_oom_error(e) or injected_oom:
                 # an on-chip OOM is a raw XLA error; attach what was
                 # actually resident (ref retry_allocator/facade stats
-                # surface the same information on CUDA OOM).  The summary
-                # itself must never mask the OOM.
+                # surface the same information on CUDA OOM) and write the
+                # full forensics dump (paddle_tpu.hbm: static-plan live
+                # set at the peak op, budget/plan/measured/requested
+                # arithmetic, serving census) — counted in
+                # paddle_tpu_oom_total, traced as a memory.oom instant,
+                # and it opens a profiler window (trigger:"oom").
+                # Neither step must ever mask the OOM itself.
+                dump_path = None
+                try:
+                    dump_path = _hbm().oom_forensics(
+                        e, scope=scope, program=program,
+                        fetch_names=cb.fetch_names,
+                        batch=_feed_batch(feeds),
+                        site="injected" if injected_oom else
+                        ("compile" if pending_compile else "dispatch"))
+                except Exception:
+                    pass
                 try:
                     report = _memory.summary(scope)
                 except Exception:
                     report = "(memory summary unavailable)"
+                if dump_path:
+                    report += f"\n\noom forensics dump: {dump_path}"
+                if injected_oom:
+                    # the drill must stay an InjectedFault (transient by
+                    # contract — serving retry absorption, resilience
+                    # counters); append the forensics in place
+                    e.args = ((f"{e.args[0]}\n\n{report}"
+                               if e.args else report),)
+                    raise
                 try:
                     wrapped = type(e)(f"{e}\n\n{report}")
                 except Exception:
@@ -1721,6 +1801,22 @@ class Executor:
             # checkpoint daemon's contract)
             for h in list(self._step_hooks):
                 h(self, scope)
+        # -- runtime HBM accounting (paddle_tpu.hbm) -----------------------
+        # one bounded deque append per sampled step: the accountant
+        # samples live bytes OFF-thread and joins them against the
+        # static plan — zero added host blocks on this thread (the
+        # hbm_smoke gate).  After the hooks, so a checkpoint capture's
+        # transient copies are attributed to ckpt_capture same-step.
+        acc = _hbm().ACCOUNTANT
+        if acc.enabled and step_id % acc.every_n == 0:
+            info = getattr(cb, "hbm_info", _UNSET)
+            if info is _UNSET:
+                info = cb.hbm_info = _resolve_hbm_info(cb, program,
+                                                       feeds)
+            with self._lock:
+                infl = sum(int(getattr(a, "nbytes", 0) or 0)
+                           for a in self._inflight)
+            acc.note_step(step_id, scope, info, infl)
         from ..flags import get_flags
         fl = get_flags(["FLAGS_benchmark",
                         "FLAGS_executor_max_inflight_steps"])

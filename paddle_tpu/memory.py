@@ -132,7 +132,10 @@ def summary(scope: Optional[object] = None, max_rows: int = 40) -> str:
 _HBM_PLANS: dict = {}
 
 
-def record_hbm_plan(tag: str, ma) -> None:
+def record_hbm_plan(tag: str, ma) -> str:
+    """Store one executable's memory_analysis; returns the tag the plan
+    was stored under (suffixed on collision — callers reading the entry
+    back must use the RETURNED tag, not the one they passed)."""
     # distinct compiled blocks can share a fetch list (startup programs
     # all tag '<block>') — suffix instead of silently overwriting
     if tag in _HBM_PLANS:
@@ -151,6 +154,7 @@ def record_hbm_plan(tag: str, ma) -> None:
         # donated (aliased) outputs reuse their argument buffers
         "peak_bytes": arg + out + tmp + code - alias,
     }
+    return tag
 
 
 def hbm_plans() -> dict:

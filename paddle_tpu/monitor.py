@@ -472,6 +472,17 @@ GANG_RANK_COMM_BW = REGISTRY.gauge(
     "per-rank measured collective bus bandwidth over link peak in "
     "[0,1] from the heartbeat digest ('comm_bw') — the network MFU "
     "column gangtop renders as BW%", ("rank",))
+GANG_RANK_HBM = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_hbm_bytes",
+    "per-rank measured live HBM bytes from the heartbeat digest (hbm "
+    "plane 'hbm' key) — the fleet-wide residency view gangtop renders "
+    "as the HBM column", ("rank",))
+GANG_RANK_HDRM = REGISTRY.gauge(
+    "paddle_tpu_gang_rank_hbm_headroom_bytes",
+    "per-rank measured HBM headroom (budget - live) from the heartbeat "
+    "digest ('hdrm'; present only while the rank knows a budget) — the "
+    "admission signal the GSPMD sharding chooser and an autoscaler "
+    "read, and the gangtop HDRM%/OOM-RISK input", ("rank",))
 GANG_DIGEST_CTR = REGISTRY.counter(
     "paddle_tpu_gang_digests_total",
     "heartbeat metrics digests accepted by the coordinator, per rank",
@@ -587,6 +598,21 @@ def metrics_digest() -> Dict[str, Any]:
     # net-of-wait straggler math with frozen medians (a stale comm_wait
     # would excuse a genuinely slow rank forever).  comm_wait rides
     # whenever comm_ms does (a measured 0 is the signal's baseline).
+    # hbm plane (this PR): measured live bytes + headroom — presence-
+    # gated on the accountant having published RECENTLY (same frozen-
+    # value discipline as the comms keys: a rank that stopped sampling
+    # must not read as holding its last-known residency forever).
+    # hdrm rides only when the rank knows a budget — a budget-less
+    # rank's headroom is undefined, not zero.
+    if _hbm_digest_fresh():
+        mod = sys.modules.get("paddle_tpu.hbm")
+        sample = getattr(mod.ACCOUNTANT, "last_sample", None) \
+            if mod is not None else None
+        if sample is not None:
+            live, headroom = sample
+            digest["hbm"] = int(live)
+            if headroom is not None:
+                digest["hdrm"] = int(headroom)
     cm = REGISTRY.get("paddle_tpu_comm_step_ms")
     if cm is not None and _comm_digest_fresh():
         cells = [cell.get() for _, cell in cm.series()]
@@ -620,6 +646,14 @@ def _comm_digest_fresh() -> bool:
     return bool(last) and time.time() - last <= _COMM_DIGEST_TTL_S
 
 
+def _hbm_digest_fresh() -> bool:
+    mod = sys.modules.get("paddle_tpu.hbm")
+    if mod is None:
+        return False                # plane never loaded: nothing to carry
+    last = getattr(mod.ACCOUNTANT, "last_publish_wall", 0.0)
+    return bool(last) and time.time() - last <= _COMM_DIGEST_TTL_S
+
+
 #: digest keys the gang skew/straggler plane reads, most important
 #: first — capped_digest sheds from the BOTTOM of this list, and sheds
 #: keys not on it before any that are.  comm_wait rides right behind
@@ -627,10 +661,14 @@ def _comm_digest_fresh() -> bool:
 #: picks the straggler net of comm wait, so shedding comm_wait while
 #: keeping step_ms would mis-blame the waiting rank).  nanf/gnorm rank
 #: next: a NaN'ing rank must stay identifiable fleet-wide even under
-#: the byte cap.
-_DIGEST_PRIORITY = ("step_ms", "comm_wait", "nanf", "gnorm", "mfu",
-                    "comm_ms", "comm_bw", "srv_q", "queue",
-                    "inflight", "occ", "slots", "tps", "steps")
+#: the byte cap, and hbm/hdrm right after — a rank about to OOM must
+#: stay identifiable too.  hbm BEFORE hdrm: gangtop's HDRM%/OOM-RISK
+#: need BOTH keys (budget = hbm + hdrm), so if the cap cuts between
+#: them the surviving key must be the one that renders alone (the HBM
+#: residency column) — a lone hdrm would render nothing.
+_DIGEST_PRIORITY = ("step_ms", "comm_wait", "nanf", "gnorm", "hbm",
+                    "hdrm", "mfu", "comm_ms", "comm_bw", "srv_q",
+                    "queue", "inflight", "occ", "slots", "tps", "steps")
 
 
 def capped_digest(digest: Dict[str, Any],
@@ -738,6 +776,27 @@ SLO_BREACH_CTR = REGISTRY.counter(
     "once; the instant is also recorded in the trace ring as "
     "'slo.breach')", ("tenant",))
 
+# -- per-tenant KV-page plane (this PR): which tenant's decode requests
+# own the paged-KV pool.  Declared here so retire_tenant_series folds
+# tenant churn (PR-2 semantics: counter totals exact, gauges dropped).
+
+SERVING_KV_TENANT_PAGES = REGISTRY.gauge(
+    "paddle_tpu_serving_kv_tenant_pages",
+    "KV-cache pages currently owned by the tenant's in-flight decode "
+    "requests — the per-tenant occupancy slice of "
+    "paddle_tpu_serving_kv_pages_in_use", ("tenant",))
+SERVING_KV_TENANT_FRAG = REGISTRY.gauge(
+    "paddle_tpu_serving_kv_tenant_frag",
+    "internal fragmentation of the tenant's KV pages in [0,1]: "
+    "1 - written_tokens / (pages * page_len) — reserved-but-unwritten "
+    "tail capacity (worst-case admission reservations inflate it early "
+    "in a request's life)", ("tenant",))
+SERVING_KV_TENANT_ALLOC_CTR = REGISTRY.counter(
+    "paddle_tpu_serving_kv_tenant_pages_total",
+    "KV pages RESERVED for the tenant's requests at admission, "
+    "cumulative (folds to tenant=\"retired\" on eviction so "
+    "counter_totals() stays exact across tenant churn)", ("tenant",))
+
 
 def retire_tenant_series(tenant) -> None:
     """Registry hygiene for tenant eviction (PR-2 retirement semantics):
@@ -760,6 +819,12 @@ def retire_tenant_series(tenant) -> None:
         if labels.get("tenant") == str(tenant):
             SERVING_PHASE_HIST.fold(labels, dict(labels, tenant="retired"))
     SERVING_QUEUE_GAUGE.fold(src, None)
+    # KV-page plane: the cumulative reservation counter folds (totals
+    # exact); the occupancy/fragmentation gauges drop — a departed
+    # tenant owns no pages
+    SERVING_KV_TENANT_ALLOC_CTR.fold(src, dst)
+    SERVING_KV_TENANT_PAGES.fold(src, None)
+    SERVING_KV_TENANT_FRAG.fold(src, None)
     # SLO series: the breach-event counter folds (totals stay exact);
     # the burn/breached gauges drop — a departed tenant has no burn
     SLO_BREACH_CTR.fold(src, dst)
@@ -781,7 +846,7 @@ def retire_gang_rank_series(rank) -> None:
               GANG_RANK_INFLIGHT, GANG_RANK_SRVQ, GANG_RANK_OCC,
               GANG_RANK_FREE_SLOTS, GANG_RANK_TPS, GANG_RANK_GNORM,
               GANG_RANK_NANF, GANG_RANK_COMM_MS, GANG_RANK_COMM_WAIT,
-              GANG_RANK_COMM_BW):
+              GANG_RANK_COMM_BW, GANG_RANK_HBM, GANG_RANK_HDRM):
         g.fold(src, None)
 
 
@@ -840,12 +905,15 @@ class StepTracer:
             self._events.append(("i", name, cat, self._tid(),
                                  time.perf_counter(), 0.0, args))
 
-    def counter(self, name: str, value: float):
-        """Chrome counter track (e.g. dataloader queue depth over time)."""
+    def counter(self, name: str, value: float, cat: str = ""):
+        """Chrome counter track (e.g. dataloader queue depth over time).
+        ``cat`` lets lane-routing consumers (tools/timeline.py re-homes
+        ``cat == "memory"`` onto the per-rank hbm row) pick the track
+        up; existing callers omit it."""
         if not self.enabled:
             return
         with self._emu:
-            self._events.append(("C", name, "", self._tid(),
+            self._events.append(("C", name, cat, self._tid(),
                                  time.perf_counter(), 0.0,
                                  {"value": value}))
 

@@ -203,6 +203,11 @@ KNOWN_SITES = (
     # after a dispatched step — the numerics plane must DETECT it (the
     # hook itself never raises out of the executor)
     "numerics.poison",
+    # OOM drill: fires inside the executor's dispatch try block, so the
+    # raised fault runs the SAME hbm.oom_forensics path a real
+    # RESOURCE_EXHAUSTED does (dump + paddle_tpu_oom_total + memory.oom
+    # instant + trigger:"oom" profiler window — tools/hbm_smoke.py)
+    "memory.oom",
 )
 
 _ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")
@@ -829,6 +834,17 @@ WATCHDOG = Watchdog()
 # background checkpoint daemon
 # ---------------------------------------------------------------------------
 
+def _report_capture_bytes(n: int) -> None:
+    """Attribute the in-flight snapshot copies' device bytes to the HBM
+    accountant's ``ckpt_capture`` class (paddle_tpu.hbm) — best-effort,
+    a telemetry failure must never touch the checkpoint path."""
+    try:
+        from . import hbm as _hbm
+        _hbm.set_ckpt_capture_bytes(n)
+    except Exception:
+        pass
+
+
 class CheckpointDaemon:
     """Gang-aware background checkpointing off the training thread.
 
@@ -1080,6 +1096,13 @@ class CheckpointDaemon:
         group: List[tuple] = []
         group_bytes = 0
         chunks = 0
+        # transient capture bytes reported to the HBM accountant: the
+        # capture-window live-bytes spike is attributed to ckpt_capture
+        # instead of reading as a leak.  Unchunked captures hold the
+        # whole snapshot device-side until _save materializes it (the
+        # daemon thread clears the report); chunked captures hold at
+        # most one chunk (cleared per flush).
+        dev_bytes = 0
 
         def _flush_group():
             nonlocal group, group_bytes, chunks
@@ -1091,6 +1114,7 @@ class CheckpointDaemon:
                 chunks += 1
             group = []
             group_bytes = 0
+            _report_capture_bytes(0)
 
         for v in get_program_persistable_vars(program):
             val = scope.find_var(v.name)
@@ -1100,17 +1124,21 @@ class CheckpointDaemon:
                     "scope; did you run the startup program before "
                     "enabling the checkpoint daemon?")
             if isinstance(val, jax.Array):
+                nbytes = int(getattr(val, "nbytes", 0) or 0)
                 if not chunk_bytes:
                     state[v.name] = jnp.copy(val)
+                    dev_bytes += nbytes
                     continue
-                nbytes = int(getattr(val, "nbytes", 0) or 0)
                 if group and group_bytes + nbytes > chunk_bytes:
                     _flush_group()
                 group.append((v.name, jnp.copy(val)))
                 group_bytes += nbytes
+                _report_capture_bytes(group_bytes)
             else:
                 state[v.name] = np.array(val, copy=True)
         _flush_group()
+        if dev_bytes:
+            _report_capture_bytes(dev_bytes)
         with self._mu:
             self._pending = (int(step), state, kind)
             self._last_capture_step = int(step)
@@ -1152,6 +1180,10 @@ class CheckpointDaemon:
         # (plus the checkpoint.write retry/injection plane).
         t_save0 = time.monotonic()
         host = {name: np.asarray(v) for name, v in state.items()}
+        # the device-side snapshot copies are gone now — clear the
+        # accountant's ckpt_capture attribution (unchunked captures
+        # reported the whole snapshot at capture time)
+        _report_capture_bytes(0)
         if not self.checkpoint.save_arrays(step, host, force=True,
                                            kind=kind):
             return

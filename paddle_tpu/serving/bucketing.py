@@ -163,5 +163,22 @@ class BucketPlan:
             entry = self._plans.get(int(bucket))
         return entry[3] if entry is not None else None
 
+    def static_peak_of(self, bucket: int) -> Optional[int]:
+        """Static HBM peak (bytes) of an ALREADY-BUILT bucket program at
+        its admitted width — the /statusz memory section's per-bucket
+        plan.  Fingerprint-cached (plan_memory), so a statusz scrape
+        never re-plans; None for cold buckets or on planner failure."""
+        with self._mu:
+            entry = self._plans.get(int(bucket))
+        if entry is None:
+            return None
+        compiled, _feeds, fetch_names, width = entry
+        try:
+            from ..analysis.memory import plan_memory
+            return int(plan_memory(compiled.program, tuple(fetch_names),
+                                   batch_size=width).peak_bytes)
+        except Exception:
+            return None
+
     def bucket_for(self, seq_len: int) -> Optional[int]:
         return bucket_for(seq_len, self.buckets)

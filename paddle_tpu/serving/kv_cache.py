@@ -67,6 +67,11 @@ class PagedKVCache:
         self._mu = threading.Lock()
         self._free: List[int] = list(range(1, n_pages))  # guarded-by: _mu
         self._owned: Dict[int, List[int]] = {}  # guarded-by: _mu
+        # the pool's device bytes are attributed to the HBM accountant's
+        # kv_pages class (weak registration — telemetry must not keep a
+        # dead engine's pools alive)
+        from .. import hbm as _hbm
+        _hbm.register_kv_pool(self)
 
     def alloc_page(self, slot: int) -> Optional[int]:
         """Grant ``slot`` one more page; None when the pool is exhausted
@@ -118,6 +123,12 @@ class PagedKVCache:
     def pages_of(self, slot: int) -> List[int]:
         with self._mu:
             return list(self._owned.get(slot, []))
+
+    def pool_bytes(self) -> int:
+        """Device bytes of the K/V pools (both stacks) — the resident
+        cost of the cache regardless of page occupancy."""
+        return (int(getattr(self.k, "nbytes", 0) or 0)
+                + int(getattr(self.v, "nbytes", 0) or 0))
 
 
 def params_from_scope(scope, cfg) -> Dict[str, jnp.ndarray]:

@@ -465,6 +465,11 @@ class DecodeScheduler:
         #: trailing (t, n_generated) window for the tokens/s gauge —
         #: touched only by the decode thread
         self._tok_win: collections.deque = collections.deque()
+        #: per-tenant KV-page ownership — admits happen in _admit_locked
+        #: and releases in _retire/_run_step, all on the decode thread,
+        #: so no lock; statusz readers go through kv_census() which
+        #: snapshots the slot list
+        self._tenant_pages: Dict[str, int] = {}
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -519,6 +524,9 @@ class DecodeScheduler:
             self._queue.popleft()
             req.tm["slot"] = time.perf_counter()   # queue_wait ends
             self._slots[s] = _SlotState(req)
+            self._kv_account(req.tenant,
+                             len(self._engine.cache.pages_of(s)),
+                             reserved=True)
 
     def _loop(self) -> None:
         eng = self._engine
@@ -613,6 +621,9 @@ class DecodeScheduler:
                     if not alive else list(stepped)
                 for s in failed:
                     st = self._slots[s]
+                    self._kv_account(
+                        st.req.tenant,
+                        -len(self._engine.cache.pages_of(s)))
                     self._engine.release_slot(s)
                     self._slots[s] = None
                     self._on_fail(st.req, e)
@@ -644,6 +655,54 @@ class DecodeScheduler:
         except Exception:
             pass            # the sentinel must never fail a decode step
 
+    def _kv_account(self, tenant, delta: int, reserved: bool = False) -> None:
+        """Per-tenant KV-page bookkeeping (decode thread only): the
+        occupancy gauge tracks pages currently owned by the tenant's
+        requests, and each admission's reservation bumps the cumulative
+        counter — both fold on tenant eviction through
+        ``monitor.retire_tenant_series`` (PR-2 semantics), so a
+        revolving tenant population cannot grow the registry while
+        ``counter_totals()`` stays exact."""
+        tenant = str(tenant)
+        total = max(self._tenant_pages.get(tenant, 0) + int(delta), 0)
+        self._tenant_pages[tenant] = total
+        _monitor.SERVING_KV_TENANT_PAGES.set(float(total), tenant=tenant)
+        if total == 0:
+            # no pages -> no fragmentation: the frag gauge is otherwise
+            # written only by kv_census() scrapes and would freeze at
+            # the last in-flight value after the tenant's requests retire
+            _monitor.SERVING_KV_TENANT_FRAG.set(0.0, tenant=tenant)
+        if reserved and delta > 0:
+            _monitor.SERVING_KV_TENANT_ALLOC_CTR.inc(int(delta),
+                                                     tenant=tenant)
+
+    def kv_census(self) -> Dict[str, dict]:
+        """Per-tenant KV-page occupancy + internal fragmentation (the
+        /statusz memory section): for every in-flight request, pages
+        owned vs positions actually written — ``frag = 1 - written /
+        (pages * page_len)`` is the reserved-but-unwritten tail (worst-
+        case admission reservations inflate it early in a request's
+        life).  Also refreshes the per-tenant fragmentation gauge.
+        Reads a snapshot of the slot list, so a concurrent decode
+        iteration costs at most a stale row, never a crash."""
+        page_len = int(self._engine.page_len)
+        census: Dict[str, dict] = {}
+        for s, st in enumerate(list(self._slots)):
+            if st is None:
+                continue
+            t = str(st.req.tenant)
+            row = census.setdefault(
+                t, {"pages": 0, "written_tokens": 0, "requests": 0})
+            row["pages"] += len(self._engine.cache.pages_of(s))
+            row["written_tokens"] += int(st.pos)
+            row["requests"] += 1
+        for t, row in census.items():
+            cap = row["pages"] * page_len
+            row["frag"] = round(1.0 - row["written_tokens"] / cap,
+                                4) if cap else 0.0
+            _monitor.SERVING_KV_TENANT_FRAG.set(row["frag"], tenant=t)
+        return census
+
     def _update_token_rate(self, now: float, n_gen: int,
                            window_s: float = 5.0) -> None:
         """Windowed generated-tokens/s into the gauge the heartbeat
@@ -662,6 +721,8 @@ class DecodeScheduler:
             round(sum(n for _, n in win) / span, 3))
 
     def _retire(self, s, st, now) -> None:
+        self._kv_account(st.req.tenant,
+                         -len(self._engine.cache.pages_of(s)))
         self._engine.release_slot(s)
         self._slots[s] = None
         out = np.asarray(st.generated, np.int32)

@@ -237,6 +237,12 @@ class _ServerBase:
         if not self._started:
             self._sched.start()
             self._started = True
+            # OOM forensics census: a RESOURCE_EXHAUSTED dump includes
+            # this server's memory section (bucket widths / KV page
+            # occupancy) — weak registration, the dump never keeps a
+            # stopped server alive
+            from .. import hbm as _hbm
+            _hbm.register_census(self.statusz)
         if self.slo is not None and self._slo_thread is None:
             self._slo_stop.clear()
             self._slo_thread = threading.Thread(
@@ -466,6 +472,18 @@ class InferenceServer(_ServerBase):
         out["buckets"] = {str(b): self.plan.width_of(b)
                          for b in self.buckets}
         out["compile"] = self.compile_stats()
+        # memory section: the budget in force + each BUILT bucket's
+        # admitted width and static HBM peak at that width (cold
+        # buckets report null — statusz never triggers a build)
+        from ..flags import get_flags
+        out["memory"] = {
+            "budget_mb": int(get_flags("FLAGS_memory_budget_mb")
+                             ["FLAGS_memory_budget_mb"]),
+            "per_bucket": {
+                str(b): {"width": self.plan.width_of(b),
+                         "static_peak_bytes": self.plan.static_peak_of(b)}
+                for b in self.buckets},
+        }
         occ = _monitor.REGISTRY.get("paddle_tpu_serving_batch_occupancy")
         if occ is not None:
             tot_sum = tot_n = 0.0
@@ -541,6 +559,20 @@ class DecodeServer(_ServerBase):
         out["tokens_per_s"] = float(_monitor.SERVING_TPS_GAUGE.value()) \
             if _monitor.REGISTRY.get(
                 "paddle_tpu_serving_tokens_per_s").series() else 0.0
+        # memory section: budget + KV pool census with per-tenant page
+        # occupancy and internal fragmentation (retire-on-eviction fold
+        # keeps the backing gauges bounded across tenant churn)
+        from ..flags import get_flags
+        cache = self.engine.cache
+        out["memory"] = {
+            "budget_mb": int(get_flags("FLAGS_memory_budget_mb")
+                             ["FLAGS_memory_budget_mb"]),
+            "kv": {"page_len": int(self.engine.page_len),
+                   "pages_total": int(cache.n_pages),
+                   "pages_in_use": int(cache.pages_in_use()),
+                   "pool_bytes": int(cache.pool_bytes()),
+                   "per_tenant": self._sched.kv_census()},
+        }
         return out
 
 

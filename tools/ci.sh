@@ -95,6 +95,9 @@ JAX_PLATFORMS=cpu python tools/numerics_smoke.py
 echo "== comms smoke (static plan vs measured bytes, straggler-wait decomposition, zero added host blocks) =="
 JAX_PLATFORMS=cpu python tools/comms_smoke.py
 
+echo "== hbm smoke (live accounting zero host blocks, memory.oom drill -> forensics dump, KV-page churn exact) =="
+JAX_PLATFORMS=cpu python tools/hbm_smoke.py
+
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 

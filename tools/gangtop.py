@@ -4,9 +4,11 @@ coordinator's ``status`` view — `top` for a training gang.
 
 Each row is one rank: liveness, current training step, durably-committed
 step, and the heartbeat metrics digest (step-time estimate, live MFU,
-the comms plane's COMM time and BW% bus bandwidth, dataloader queue
-depth, executor in-flight depth, plus the serving-load columns a fleet
-router reads — serving queue depth SRVQ, last batch occupancy OCC, free
+the hbm plane's live HBM bytes and HDRM% headroom-of-budget — a rank
+under the risk threshold is flagged ``<-- OOM-RISK`` — the comms
+plane's COMM time and BW% bus bandwidth, dataloader queue depth,
+executor in-flight depth, plus the serving-load columns a fleet router
+reads — serving queue depth SRVQ, last batch occupancy OCC, free
 decode slots SLOT, decode TOK/S).  The slowest live rank NET of comm
 wait is flagged ``<-- straggler`` (the same rank the coordinator's
 ``paddle_tpu_gang_straggler_rank`` gauge names); a rank whose step is
@@ -57,6 +59,34 @@ def _fmt(v, spec="{:.1f}", dash="-"):
         return dash
 
 
+#: a rank is flagged <-- OOM-RISK when its measured headroom fraction
+#: (hdrm / (hbm + hdrm) = headroom over budget) falls under this
+#: (mirrors paddle_tpu.hbm.OOM_RISK_HEADROOM_FRAC — this tool must not
+#: import paddle_tpu)
+OOM_RISK_FRAC = 0.10
+
+
+def hdrm_frac(digest: dict):
+    """Headroom fraction of budget from the digest's hbm/hdrm keys
+    (budget = live + headroom by construction); None when the rank
+    carries no headroom signal (no budget known, or keys shed)."""
+    hbm = digest.get("hbm")
+    hdrm = digest.get("hdrm")
+    if not isinstance(hbm, (int, float)) or \
+            not isinstance(hdrm, (int, float)) or hbm + hdrm <= 0:
+        return None
+    return hdrm / float(hbm + hdrm)
+
+
+def oom_risk(digest: dict) -> bool:
+    """True when the rank's measured HBM headroom fraction is under the
+    risk threshold — the gang is one allocation spike from a dead rank,
+    and the runbook (README 'Memory observability') should fire BEFORE
+    the OOM forensics dump has to."""
+    frac = hdrm_frac(digest)
+    return frac is not None and frac < OOM_RISK_FRAC
+
+
 def comm_bound(digest: dict) -> bool:
     """A rank is COMM-BOUND when over half its step is comm time AND
     that comm time is wire-dominated (less than half of it is straggler
@@ -77,7 +107,9 @@ def render(status: dict) -> str:
     ranks = status.get("ranks", {})
     rows = []
     header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
-              f"{'STEP_MS':>9} {'MFU%':>6} {'COMM':>7} {'BW%':>6} "
+              f"{'STEP_MS':>9} {'MFU%':>6} "
+              f"{'HBM':>8} {'HDRM%':>6} "
+              f"{'COMM':>7} {'BW%':>6} "
               f"{'GNORM':>8} {'NANF':>6} "
               f"{'QUEUE':>5} {'INFL':>4} "
               f"{'SRVQ':>5} {'OCC':>5} {'SLOT':>4} {'TOK/S':>7} "
@@ -97,10 +129,14 @@ def render(status: dict) -> str:
         mfu = d.get("mfu")
         nanf = d.get("nanf")
         bw = d.get("comm_bw")
+        hbm = d.get("hbm")
+        hfrac = hdrm_frac(d)
         line = (f"{r:>4}  {state:<8} {_fmt(e.get('cur_step'), '{}'):>8} "
                 f"{_fmt(e.get('step'), '{}'):>7} "
                 f"{_fmt(d.get('step_ms')):>9} "
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
+                f"{_fmt(hbm / 2**30 if isinstance(hbm, (int, float)) else None, '{:.2f}G'):>8} "
+                f"{_fmt(hfrac * 100 if hfrac is not None else None, '{:.0f}'):>6} "
                 f"{_fmt(d.get('comm_ms')):>7} "
                 f"{_fmt(bw * 100 if isinstance(bw, (int, float)) else None):>6} "
                 f"{_fmt(d.get('gnorm'), '{:.3g}'):>8} "
@@ -123,6 +159,8 @@ def render(status: dict) -> str:
             line += "   <-- COMM-BOUND"
         if isinstance(nanf, (int, float)) and nanf > 0:
             line += "   <-- NONFINITE"
+        if oom_risk(d):
+            line += "   <-- OOM-RISK"
         rows.append(line)
     rows.append("")
     rows.append(f"gang: {status.get('status', '?')}"

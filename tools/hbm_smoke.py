@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""HBM-observability smoke (wired into tools/ci.sh): the end-to-end
+gates of the runtime memory plane.
+
+1. **Steady-state cleanliness**: a lazy-fetch train loop with
+   ``FLAGS_hbm_telemetry`` on (the default) must add ZERO host blocks on
+   the training thread — the accountant samples off-thread
+   (``dispatch_stats`` materialize deltas stay flat across the steady
+   window) while actually publishing (samples_total ok > 0, live gauge
+   set, plan drift within the planner's band).
+
+2. **OOM drill**: an injected ``memory.oom`` fault must produce a
+   forensics dump whose budget/plan/measured/requested arithmetic is
+   self-consistent (the smoke re-adds it), that names the top live
+   tensors, counts in ``paddle_tpu_oom_total``, records a ``memory.oom``
+   trace instant, opens a profiler window with ``trigger:"oom"`` — and
+   training must continue afterwards (the drill never evicts the
+   compiled block).
+
+3. **KV-page accounting**: per-tenant page gauges/counters stay EXACT
+   across request churn on a decode scheduler (every reserved page
+   released, gauge back to zero), and evicting the tenants folds their
+   series (registry bounded, ``counter_totals()`` exact — PR-2
+   semantics).
+"""
+
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg):
+    print(f"HBM SMOKE FAILED: {msg}")
+    sys.exit(1)
+
+
+def check_steady_state():
+    """Gate 1: zero added training-thread host blocks with the plane on,
+    while the accountant publishes real samples."""
+    import paddle_tpu as pt
+    from paddle_tpu import hbm, layers, monitor
+    from paddle_tpu.framework import (Program, Scope, program_guard,
+                                      scope_guard)
+
+    pt.set_flags({"FLAGS_hbm_telemetry": True})
+    scope = Scope()
+    with scope_guard(scope), program_guard(Program(), Program()):
+        x = layers.data("x", shape=[16], dtype="float32")
+        h = layers.fc(x, size=64, act="relu",
+                      param_attr=pt.ParamAttr(name="hs_w0"))
+        loss = layers.mean(layers.fc(h, size=8))
+        pt.optimizer.Adam(1e-3).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), scope=scope)
+        feed = {"x": np.linspace(-1, 1, 8 * 16,
+                                 dtype=np.float32).reshape(8, 16)}
+        handles = []
+        for _ in range(5):          # warmup: compile + steady state
+            hd, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                          return_numpy=False)
+            handles.append(hd)
+        ok0 = monitor.counter_totals().get(
+            "paddle_tpu_hbm_samples_total", 0)
+        s0 = exe.dispatch_stats()
+        for _ in range(25):
+            hd, = exe.run(feed=feed, fetch_list=[loss.name], scope=scope,
+                          return_numpy=False)
+            handles.append(hd)
+        s1 = exe.dispatch_stats()
+        handles[-1].numpy()
+        exe.drain()
+        if not hbm.ACCOUNTANT.drain(30):
+            fail("accountant did not drain")
+        ok1 = monitor.counter_totals().get(
+            "paddle_tpu_hbm_samples_total", 0)
+        delta = {k: s1[k] - s0[k] for k in s1 if k in s0}
+        if delta.get("fetch_materializations", 1) != 0:
+            fail(f"steady loop materialized fetches: {delta}")
+        if delta.get("materialize_block_us", 1) != 0:
+            fail(f"steady loop host-blocked on materialization: {delta}")
+        if ok1 - ok0 < 20:
+            fail(f"accountant published too few samples: {ok1 - ok0}")
+        reg = monitor.REGISTRY
+        live = reg.get("paddle_tpu_hbm_live_bytes").value()
+        drift = reg.get("paddle_tpu_hbm_plan_drift").value()
+        if live <= 0:
+            fail(f"live gauge unset: {live}")
+        if not 0.8 <= drift <= 1.5:
+            fail(f"plan drift {drift} outside the sanity band (planner's "
+                 "established band is ~1.000-1.006 on a clean process)")
+        cls = {lbl["cls"]: c.get() for lbl, c in
+               reg.get("paddle_tpu_hbm_class_bytes").series()}
+        if cls.get("params", 0) <= 0 or cls.get("opt_state", 0) <= 0:
+            fail(f"class attribution missing params/opt_state: {cls}")
+    print(f"hbm smoke 1 OK: zero added steady-state host blocks "
+          f"(delta={ {k: v for k, v in delta.items() if v} }), "
+          f"{int(ok1 - ok0)} samples, drift {drift:.4f}")
+
+
+def check_oom_drill():
+    """Gate 2: injected memory.oom -> self-consistent forensics dump,
+    counter, trace instant, trigger:'oom' window, training continues."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, monitor
+    from paddle_tpu.framework import (Program, Scope, program_guard,
+                                      scope_guard)
+    from paddle_tpu.profiler import SAMPLER
+
+    dump_dir = tempfile.mkdtemp(prefix="pt_hbm_oom_")
+    prof_dir = tempfile.mkdtemp(prefix="pt_hbm_prof_")
+    oom0 = monitor.counter_totals().get("paddle_tpu_oom_total", 0)
+    pt.set_flags({
+        "FLAGS_oom_dump_dir": dump_dir,
+        "FLAGS_profile_sample_dir": prof_dir,
+        "FLAGS_memory_budget_mb": 1,
+        "FLAGS_fault_inject": "memory.oom:once@4",
+    })
+    scope = Scope()
+    try:
+        with scope_guard(scope), program_guard(Program(), Program()):
+            x = layers.data("x", shape=[16], dtype="float32")
+            loss = layers.mean(layers.fc(
+                x, size=32, param_attr=pt.ParamAttr(name="oomdrill_w")))
+            pt.optimizer.SGD(0.1).minimize(loss)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program(), scope=scope)
+            feed = {"x": np.ones((4, 16), np.float32)}
+            tripped = completed_after = 0
+            for _ in range(8):
+                try:
+                    exe.run(feed=feed, fetch_list=[loss.name],
+                            scope=scope)
+                    if tripped:
+                        completed_after += 1
+                except Exception as e:
+                    if "memory.oom" not in str(e):
+                        raise
+                    tripped += 1
+                    if "oom forensics dump:" not in str(e):
+                        fail("drill error carries no dump path: "
+                             f"{str(e)[:300]}")
+            if tripped != 1:
+                fail(f"expected exactly 1 drill trip, got {tripped}")
+            if completed_after < 3:
+                fail("training did not continue after the drill "
+                     f"(completed_after={completed_after})")
+            dumps = glob.glob(os.path.join(dump_dir,
+                                           "paddle_tpu_oom_*.txt"))
+            if len(dumps) != 1:
+                fail(f"expected 1 forensics dump, found {dumps}")
+            txt = open(dumps[0]).read()
+            for marker in ("=== hbm oom forensics ===",
+                           "budget arithmetic", "oomdrill_w",
+                           "residency summary"):
+                if marker not in txt:
+                    fail(f"dump missing {marker!r}")
+            vals = {}
+            for k in ("budget_bytes", "plan_peak_bytes", "measured_bytes",
+                      "requested_bytes", "measured_plus_requested",
+                      "deficit_bytes"):
+                m = re.search(rf"^{k}: (-?\d+)$", txt, re.M)
+                if not m:
+                    fail(f"dump missing arithmetic line {k}")
+                vals[k] = int(m.group(1))
+            if vals["measured_plus_requested"] != \
+                    vals["measured_bytes"] + vals["requested_bytes"]:
+                fail(f"arithmetic does not sum: {vals}")
+            if vals["deficit_bytes"] != \
+                    vals["measured_plus_requested"] - vals["budget_bytes"]:
+                fail(f"deficit does not sum: {vals}")
+            if vals["budget_bytes"] != 1 << 20:
+                fail(f"budget not FLAGS_memory_budget_mb: {vals}")
+            if vals["plan_peak_bytes"] <= 0 or vals["measured_bytes"] <= 0:
+                fail(f"plan/measured missing: {vals}")
+            oom1 = monitor.counter_totals().get("paddle_tpu_oom_total", 0)
+            if oom1 - oom0 != 1:
+                fail(f"paddle_tpu_oom_total delta {oom1 - oom0} != 1")
+            if not [e for e in monitor.TRACER.chrome_events()
+                    if e.get("name") == "memory.oom"]:
+                fail("no memory.oom trace instant")
+            SAMPLER.close()
+            with open(os.path.join(prof_dir, "manifest.json")) as f:
+                windows = json.load(f).get("windows", [])
+            if not any(w.get("trigger") == "oom" for w in windows):
+                fail(f"no trigger:'oom' window in manifest: {windows}")
+        print(f"hbm smoke 2 OK: drill dump arithmetic sums ({vals}), "
+              "counter/instant/window present, training continued")
+    finally:
+        pt.set_flags({"FLAGS_fault_inject": "", "FLAGS_memory_budget_mb": 0,
+                      "FLAGS_oom_dump_dir": "",
+                      "FLAGS_profile_sample_dir": ""})
+        shutil.rmtree(dump_dir, ignore_errors=True)
+        shutil.rmtree(prof_dir, ignore_errors=True)
+
+
+class _StubDecodeEngine:
+    """Minimal decode engine for the KV churn gate: a real PagedKVCache
+    + page-table bookkeeping (the DecodeEngine methods, reused unbound)
+    under a model stub whose argmax is always EOS — every request costs
+    its real page reservations and finishes after one generated token."""
+
+    def __init__(self, max_slots=3, page_len=4, max_seq=32, n_pages=64,
+                 vocab=8, eos=7):
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+        self.page_len = int(page_len)
+        self.max_seq = int(max_seq)
+        self.max_pages = -(-max_seq // page_len)
+        self.max_slots = int(max_slots)
+        self.trace_count = 1
+        self.vocab, self.eos = vocab, eos
+        self.cache = PagedKVCache(1, n_pages, page_len, 1, 1, max_slots)
+        self.page_table = np.zeros((max_slots, self.max_pages), np.int32)
+
+    def run_iteration(self, ids, pos, active):
+        logits = np.zeros((self.max_slots, self.vocab), np.float32)
+        logits[:, self.eos] = 1.0
+        return logits
+
+
+def check_kv_churn():
+    """Gate 3: per-tenant KV accounting exact across churn + bounded
+    registry after eviction."""
+    from paddle_tpu import monitor
+    from paddle_tpu.serving.kv_cache import DecodeEngine
+    from paddle_tpu.serving.server import DecodeServer
+
+    # borrow the real page bookkeeping (reserve/ensure/release)
+    _StubDecodeEngine.reserve_slot = DecodeEngine.reserve_slot
+    _StubDecodeEngine.ensure_page = DecodeEngine.ensure_page
+    _StubDecodeEngine.release_slot = DecodeEngine.release_slot
+
+    eng = _StubDecodeEngine()
+    srv = DecodeServer(eng).start()
+    tenants = [f"churn{i}" for i in range(10)]
+    futs = []
+    try:
+        for i, t in enumerate(tenants):
+            # worst-case reservation: prompt 3 + max_new 1 = 4 tokens
+            # = exactly 1 page (page_len 4)
+            futs.append(srv.submit(t, [1, 2, 3], max_new_tokens=1,
+                                   eos_id=eng.eos))
+        for f in futs:
+            out = f.result(timeout=30)
+            if len(out) != 1 or int(out[0]) != eng.eos:
+                fail(f"decode result wrong: {out}")
+        deadline = time.monotonic() + 10
+        while eng.cache.pages_in_use() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if eng.cache.pages_in_use() != 0:
+            fail(f"pages leaked: {eng.cache.pages_in_use()}")
+        fam_pages = monitor.REGISTRY.get(
+            "paddle_tpu_serving_kv_tenant_pages")
+        fam_ctr = monitor.REGISTRY.get(
+            "paddle_tpu_serving_kv_tenant_pages_total")
+        per_tenant = {lbl["tenant"]: c.get() for lbl, c in
+                      fam_ctr.series()}
+        for t in tenants:
+            if per_tenant.get(t) != 1.0:
+                fail(f"tenant {t} reserved-page counter {per_tenant.get(t)}"
+                     " != 1 (prompt 3 + 1 new = 1 page)")
+            g = {lbl["tenant"]: c.get() for lbl, c in fam_pages.series()}
+            if g.get(t) != 0.0:
+                fail(f"tenant {t} page gauge {g.get(t)} != 0 after "
+                     "completion")
+        total_before = monitor.counter_totals().get(
+            "paddle_tpu_serving_kv_tenant_pages_total", 0)
+        for t in tenants:
+            srv.tenants.evict(t)
+        churn_rows = [lbl for lbl, _c in fam_ctr.series()
+                      if lbl["tenant"].startswith("churn")]
+        if churn_rows:
+            fail(f"evicted tenants still hold counter series: {churn_rows}")
+        gauge_rows = [lbl for lbl, _c in fam_pages.series()
+                      if lbl["tenant"].startswith("churn")]
+        if gauge_rows:
+            fail(f"evicted tenants still hold gauge series: {gauge_rows}")
+        total_after = monitor.counter_totals().get(
+            "paddle_tpu_serving_kv_tenant_pages_total", 0)
+        if total_after != total_before:
+            fail(f"counter_totals changed across eviction fold: "
+                 f"{total_before} -> {total_after}")
+        census = srv.statusz().get("memory", {})
+        if "kv" not in census or census["kv"]["pages_in_use"] != 0:
+            fail(f"statusz memory section wrong: {census}")
+    finally:
+        srv.stop()
+    print(f"hbm smoke 3 OK: 10-tenant churn exact "
+          f"(total={int(total_after)} pages), series folded on eviction")
+
+
+def main():
+    check_steady_state()
+    check_oom_drill()
+    check_kv_churn()
+    print("HBM SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

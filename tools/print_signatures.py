@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.nets",
     "paddle_tpu.io",
     "paddle_tpu.resilience",
+    "paddle_tpu.hbm",
     "paddle_tpu.analysis",
     "paddle_tpu.serving",
     "paddle_tpu.initializer",

@@ -22,7 +22,9 @@ spans (``cat == "collective"`` — the executor's ``collective.launch``
 decompositions, barrier waits, host↔global assemblies) are re-homed
 onto a dedicated ``comms`` row pinned at the top of each rank's lane,
 so cross-rank communication stacks visually against the compute rows
-it overlaps.  Incoming per-process ``process_name`` metadata is
+it overlaps, and memory events (``cat == "memory"`` — the HBM
+accountant's samples, the live-bytes counter track, OOM instants) onto
+a per-rank ``hbm`` row right under it.  Incoming per-process ``process_name`` metadata is
 replaced by the lane labels; everything else (thread names, spans,
 counters) is preserved.  The merged output still passes strict
 ``validate()``.
@@ -43,11 +45,19 @@ _KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s",
 #: ``threading.get_ident() & 0xffffff`` — never this small)
 COMM_LANE_TID = 1
 
+#: rank-lane mode: tid of the dedicated per-rank memory row —
+#: ``cat == "memory"`` events (the HBM accountant's ``hbm.sample``
+#: instants, ``hbm.live_bytes`` counter track, ``memory.oom`` instants)
+#: re-home here, so per-rank residency stacks against the compute and
+#: comm rows it explains
+MEM_LANE_TID = 2
+
 
 def merge(profile_paths, out_path, align=False, rank_lanes=False):
     events = []
     lane_ranks = set()
     comm_ranks = set()
+    mem_ranks = set()
     for spec in profile_paths.split(","):
         if "=" in spec:
             rank, path = spec.split("=", 1)
@@ -77,6 +87,12 @@ def merge(profile_paths, out_path, align=False, rank_lanes=False):
                     # dispatching thread's row
                     ev["tid"] = COMM_LANE_TID
                     comm_ranks.add(int(rank))
+                elif ev.get("cat") == "memory" and ev.get("ph") != "M":
+                    # distinct memory row per rank lane: the HBM
+                    # accountant's samples / live-bytes counter track /
+                    # OOM instants render as one per-rank memory lane
+                    ev["tid"] = MEM_LANE_TID
+                    mem_ranks.add(int(rank))
             else:
                 ev["pid"] = f"rank{rank}:{ev.get('pid', 0)}"
             events.append(ev)
@@ -90,6 +106,11 @@ def merge(profile_paths, out_path, align=False, rank_lanes=False):
                        "tid": COMM_LANE_TID, "args": {"name": "comms"}})
         events.append({"name": "thread_sort_index", "ph": "M", "pid": r,
                        "tid": COMM_LANE_TID, "args": {"sort_index": -1}})
+    for r in sorted(mem_ranks):
+        events.append({"name": "thread_name", "ph": "M", "pid": r,
+                       "tid": MEM_LANE_TID, "args": {"name": "hbm"}})
+        events.append({"name": "thread_sort_index", "ph": "M", "pid": r,
+                       "tid": MEM_LANE_TID, "args": {"sort_index": 0}})
     if align:
         t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0)
         for ev in events:
