@@ -44,7 +44,8 @@ import numpy as np
 from .. import monitor as _monitor
 from ..framework.core import Block, Program
 
-__all__ = ["MemoryPlan", "clear_cache", "plan_memory"]
+__all__ = ["MemoryPlan", "clear_cache", "plan_memory",
+           "plan_sharded_memory"]
 
 #: dtype -> bytes per element (numpy lacks bfloat16)
 _ITEMSIZE = {"bfloat16": 2, "float16": 2, "bool": 1}
@@ -231,8 +232,52 @@ def plan_memory(program: Program, fetch_names=(),
     return plan
 
 
+def plan_sharded_memory(program: Program, fetch_names=(),
+                        batch_size: int = 1, specs=None,
+                        axis_sizes=None) -> MemoryPlan:
+    """PER-SHARD variant of :func:`plan_memory` for the GSPMD rule-table
+    planner (``parallel.partitioner.choose_rules``): every var named in
+    ``specs`` ({name -> dist_spec tuple}) is charged its per-device
+    slice — bytes divided by the product of the mesh axis sizes
+    (``axis_sizes``) appearing in its spec — instead of its global size.
+    Unlisted vars are replicated and cost full bytes on every shard.
+    Cached alongside the unsharded plans, with the sharding layout
+    folded into the key."""
+    fetch_names = tuple(
+        f.name if hasattr(f, "name") else f for f in (fetch_names or ()))
+    axis_sizes = dict(axis_sizes or {})
+    shard_div: Dict[str, int] = {}
+    for name, spec in (specs or {}).items():
+        d = 1
+        for ax in (spec or ()):
+            for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                d *= max(int(axis_sizes.get(a, 1) or 1), 1)
+        if d > 1:
+            shard_div[name] = d
+    key = (program.fingerprint(), fetch_names, int(batch_size),
+           tuple(sorted(shard_div.items())))
+    with _CACHE_LOCK:
+        cached = _CACHE.get(key)
+    if cached is not None:
+        _PLAN_HIT.inc()
+        return cached
+    _PLAN_MISS.inc()
+    with _monitor.TRACER.span("memory.plan_sharded", "compile",
+                              fetches=len(fetch_names),
+                              sharded=len(shard_div)):
+        plan = _plan(program, fetch_names, int(batch_size),
+                     shard_div=shard_div)
+    with _CACHE_LOCK:
+        if key not in _CACHE:
+            if len(_CACHE) >= _CACHE_CAP:
+                _CACHE.pop(next(iter(_CACHE)))
+            _CACHE[key] = plan
+        plan = _CACHE[key]
+    return plan
+
+
 def _plan(program: Program, fetch_names: tuple,
-          batch_size: int) -> MemoryPlan:
+          batch_size: int, shard_div=None) -> MemoryPlan:
     from ..framework import ir
     from ..framework.core import Block as _Block
     block = program.global_block()
@@ -241,6 +286,16 @@ def _plan(program: Program, fetch_names: tuple,
     pos = {n.id: i for i, n in enumerate(order)}
     n_ops = len(order)
     end = n_ops                      # end-of-step boundary position
+
+    shard_div = shard_div or {}
+
+    def vb(v, name=None):
+        """_var_bytes, divided down to the per-shard slice when the
+        caller supplied a sharding layout for this var (ceil — GSPMD
+        pads the ragged shard)."""
+        b = _var_bytes(v, batch_size)
+        d = shard_div.get(name, 1) if name else 1
+        return -(-b // d) if d > 1 else b
 
     fetched = set(fetch_names)
     # rw persistables: donated, so old+new share ONE buffer all step
@@ -259,13 +314,11 @@ def _plan(program: Program, fetch_names: tuple,
                 seen.add(name)
                 v = block.var(name)
                 if v.persistable:
-                    resident += _var_bytes(v, batch_size)
-                    resident_names.append(
-                        (name, _var_bytes(v, batch_size), "persist"))
+                    resident += vb(v, name)
+                    resident_names.append((name, vb(v, name), "persist"))
                 elif getattr(v, "is_data", False):
-                    resident += _var_bytes(v, batch_size)
-                    resident_names.append(
-                        (name, _var_bytes(v, batch_size), "feed"))
+                    resident += vb(v, name)
+                    resident_names.append((name, vb(v, name), "feed"))
 
     # inplace aliases: the pair's output shares the input buffer — count
     # the output's bytes zero and stretch the input's interval instead
@@ -319,19 +372,19 @@ def _plan(program: Program, fetch_names: tuple,
             if rentry is not None:
                 rentry[1] = max(rentry[1], last)
             else:
-                intervals[root] = [d, last, _var_bytes(rv, batch_size)
+                intervals[root] = [d, last, vb(rv, root)
                                    if rv is not None else 0, "temp"]
             continue
         if entry is not None:
             entry[0] = min(entry[0], d)
             entry[1] = max(entry[1], last)
         else:
-            intervals[name] = [d, last, _var_bytes(v, batch_size), "temp"]
+            intervals[name] = [d, last, vb(v, name), "temp"]
 
     # fetched rw persistables cost one defensive copy (executor's
     # donation-aliasing jnp.copy), live from step end onward
     copy_bytes = sum(
-        _var_bytes(block.var(n), batch_size) for n in fetched
+        vb(block.var(n), n) for n in fetched
         if block.has_var(n) and block.var(n).persistable and n in written)
 
     # difference-array sweep: O(ops + vars), not O(ops * vars) — this

@@ -822,6 +822,20 @@ def _comms_attrs(plan):
 # entry points
 # ---------------------------------------------------------------------------
 
+def _partition_token(program: Program) -> Optional[str]:
+    """GSPMD partition fingerprint of ``program``'s partition stamp
+    (``with_gspmd``'s ``_attrs["partition"]``), or None when the program
+    is unpartitioned."""
+    stamp = program._attrs.get("partition")
+    if not stamp:
+        return None
+    try:
+        from ..parallel.partitioner import partition_fingerprint
+        return partition_fingerprint(stamp)
+    except Exception:
+        return None
+
+
 def _verify_cached(program: Program, fetch_names) -> \
         Tuple[VerifyResult, bool]:
     """(result, fresh): ``fresh`` is True for exactly ONE caller per
@@ -831,8 +845,12 @@ def _verify_cached(program: Program, fetch_names) -> \
         f.name if hasattr(f, "name") else f for f in (fetch_names or ()))
     # keyed on the fetch TUPLE: order matters — the collective
     # fingerprint hashes the materialization (fetch) order, so a
-    # reordered fetch list must re-verify, not hit a stale result
-    key = (program.fingerprint(), fetch_names)
+    # reordered fetch list must re-verify, not hit a stale result.
+    # The GSPMD partition stamp joins the key: it lives in _attrs (not
+    # the structural fingerprint), and a re-partitioned program must
+    # re-derive its folded fingerprint, not hit the old table's.
+    ptok = _partition_token(program)
+    key = (program.fingerprint(), fetch_names, ptok)
     with _CACHE_LOCK:
         cached = _CACHE.get(key)
     if cached is not None:
@@ -873,6 +891,19 @@ def _verify_cached(program: Program, fetch_names) -> \
             result.collective_fingerprint = hashlib.sha1(
                 (result.collective_fingerprint + "|"
                  + result.comms_plan.fingerprint).encode()).hexdigest()
+        if ptok:
+            # fold the GSPMD partition stamp (mesh shape + per-param
+            # PartitionSpecs) the same way: ranks that chose divergent
+            # rule tables refuse at the step barrier instead of
+            # deadlocking inside mismatched collectives.  Base may be
+            # None — a pjit-partitioned program has no explicit
+            # collective ops.  The "#rules=<table>" suffix survives the
+            # hash so the coordinator's mismatch detail, which prints
+            # both raw fingerprints, NAMES both tables.
+            base = result.collective_fingerprint or ""
+            digest = hashlib.sha1((base + "|" + ptok).encode()).hexdigest()
+            result.collective_fingerprint = \
+                digest + ptok[ptok.index("#"):]
     for d in diags:
         _FINDING_CELLS[d.check].inc()
     # int64_feed "findings" are classifications, not diagnostics: the

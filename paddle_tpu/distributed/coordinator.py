@@ -422,13 +422,22 @@ class GangCoordinator:
             return None
         (r1, f1) = named[0]
         (r2, f2) = next((r, f) for r, f in named[1:] if f != f1)
+        detail = (f"collective fingerprint mismatch{where}: "
+                  f"rank {r1} reports {f1!r} but rank {r2} "
+                  f"reports {f2!r} — divergent programs would "
+                  "deadlock inside the first unpaired collective")
+        # GSPMD-partitioned fingerprints carry a "#rules=<table>" suffix
+        # (verifier partition fold): when both sides have one, name the
+        # rule tables outright — "mp_hidden vs replicated" is actionable
+        # in a way two hex digests are not
+        t1, t2 = (f.split("#rules=", 1)[1] if "#rules=" in str(f) else None
+                  for f in (f1, f2))
+        if t1 is not None and t2 is not None and t1 != t2:
+            detail += (f" (divergent GSPMD rule tables: rank {r1} "
+                       f"chose {t1!r}, rank {r2} chose {t2!r})")
         mm = {"ranks": [int(r1), int(r2)],
               "fingerprints": [f1, f2],
-              "detail": (f"collective fingerprint mismatch{where}: "
-                         f"rank {r1} reports {f1!r} but rank {r2} "
-                         f"reports {f2!r} — divergent programs would "
-                         "deadlock inside the first unpaired "
-                         "collective")}
+              "detail": detail}
         _monitor.GANG_FP_CTR.inc()
         if _monitor.TRACER.enabled:
             _monitor.TRACER.instant("gang.fingerprint_mismatch", "gang",

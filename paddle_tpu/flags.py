@@ -153,6 +153,17 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_gang_step_barrier": False,
     # step_barrier timeout for the automatic executor barrier above
     "FLAGS_gang_step_barrier_timeout_s": 60.0,
+    # -- GSPMD model parallelism (paddle_tpu.parallel.partitioner) ---------
+    # default mesh for CompiledProgram.with_gspmd when neither `mesh` nor
+    # `axes` is passed: "dp:2,mp:4" grammar ({axis: size}, sizes must
+    # multiply to the visible device count).  "" = 1×model-parallel over
+    # every visible device.
+    "FLAGS_gspmd_mesh": "",
+    # default rule table for with_gspmd: "auto" (planner-driven — the
+    # cheapest-communication table whose PER-SHARD static peak fits
+    # FLAGS_memory_budget_mb), or a table name ("replicated",
+    # "mp_hidden", "mp_hidden_vocab")
+    "FLAGS_gspmd_rules": "auto",
     # sampling profiler (paddle_tpu.profiler.SAMPLER): every N executor
     # dispatches, capture a jax.profiler device-trace window of
     # FLAGS_profile_sample_window_steps steps into a bounded rotating
@@ -482,6 +493,23 @@ def set_flags(flags: Dict[str, Any]):
             raise ValueError(
                 f"FLAGS_watchdog_escalate must be '' or 'abort', got "
                 f"{coerced[name]!r}")
+        if name == "FLAGS_gspmd_mesh" and coerced[name]:
+            # validate the "axis:size,axis:size" grammar here so a typo
+            # refuses at set_flags, not inside with_gspmd at compile time
+            try:
+                parsed = {k: int(v) for k, v in
+                          (kv.split(":") for kv in coerced[name].split(","))}
+            except Exception:
+                raise ValueError(
+                    "FLAGS_gspmd_mesh must be 'axis:size[,axis:size...]' "
+                    f"e.g. 'dp:2,mp:4', got {coerced[name]!r}")
+            if not parsed or any(s <= 0 for s in parsed.values()):
+                raise ValueError(
+                    f"FLAGS_gspmd_mesh sizes must be positive: "
+                    f"{coerced[name]!r}")
+        if name == "FLAGS_gspmd_rules" and coerced[name] != "auto":
+            from .parallel.partitioner import rule_table
+            rule_table(coerced[name])   # raises on unknown table name
     slo_numeric = ("FLAGS_serving_slo_fast_window_s",
                    "FLAGS_serving_slo_slow_window_s",
                    "FLAGS_serving_slo_burn_threshold")

@@ -739,11 +739,19 @@ def _seed_to_key(seed):
 
 
 class _ExecState:
-    """SSA value environment while lowering a block."""
+    """SSA value environment while lowering a block.
 
-    def __init__(self, values: Dict[str, Any]):
+    ``constraints`` ({var name -> (spec tuple, NamedSharding)}) is the
+    GSPMD partitioner's activation-sharding table: every write of a
+    listed activation pins its layout with
+    ``jax.lax.with_sharding_constraint`` (t5x discipline, SNIPPETS.md
+    [1]) so XLA's propagation cannot drift from the layout the
+    rule-table planner priced."""
+
+    def __init__(self, values: Dict[str, Any], constraints=None):
         self.values = values
         self.written: set = set()
+        self.constraints = constraints
         # fwd-output name -> ctx._counter before that op's lowering; lets
         # generic grad ops replay a sampling op's rng stream (see run_op)
         self.rng_marks: Dict[str, int] = {}
@@ -760,6 +768,11 @@ class _ExecState:
     def write(self, name: str, value):
         if name == "" or name is None:
             return
+        if self.constraints is not None:
+            c = self.constraints.get(name)
+            if c is not None and getattr(value, "ndim", -1) == len(c[0]):
+                import jax
+                value = jax.lax.with_sharding_constraint(value, c[1])
         self.values[name] = value
         self.written.add(name)
 
@@ -928,6 +941,21 @@ class _CompiledBlock:
 
         collective_axis = "dp" if collective else None
 
+        # GSPMD activation constraints (parallel.partitioner): the
+        # partition stamp's per-activation specs resolve to
+        # NamedShardings once here; _ExecState.write pins each listed
+        # activation at trace time.  Only in the pjit path — the
+        # shard_map collective path is already per-device.
+        part = program._attrs.get("partition")
+        self.partitioned = bool(part)
+        constraints = None
+        if part and mesh is not None and not collective and \
+                part.get("activations"):
+            from ..parallel.mesh import sharding_for
+            constraints = {
+                n: (tuple(spec), sharding_for(mesh, tuple(spec)))
+                for n, spec in part["activations"].items()}
+
         def step(feeds, ro, rw, seed):
             ctx = LowerCtx(seed, mesh=mesh, amp=amp_on,
                            collective_axis=collective_axis)
@@ -935,7 +963,7 @@ class _CompiledBlock:
             values.update(dict(zip(persist_ro, ro)))
             values.update(dict(zip(persist_rw, rw)))
             values.update(dict(zip(feed_names, feeds)))
-            state = _ExecState(values)
+            state = _ExecState(values, constraints=constraints)
             run_block(ctx, block, state)
             fetches = [state.values[n] for n in fetch_names]
             new_rw = [state.values[n] for n in persist_rw]
@@ -1502,12 +1530,17 @@ class Executor:
                         float("nan"), dtype=v.dtype)
                     break
         comms_note = None
-        if cb.collective_nranks:
+        if cb.collective_nranks or getattr(cb, "partitioned", False):
             # FLAGS_gang_step_barrier: fingerprint-checked gang barrier
             # BEFORE the dispatch — divergent programs refuse here
             # (GangFingerprintError naming both ranks) instead of
-            # deadlocking inside the first unpaired collective
+            # deadlocking inside the first unpaired collective.  GSPMD-
+            # partitioned steps take the same gate: their fingerprint
+            # folds mesh shape + PartitionSpecs (+ "#rules=<table>"), so
+            # ranks that planner-picked divergent rule tables refuse by
+            # table name instead of deadlocking inside XLA's collectives
             self._maybe_step_barrier(cb, program)
+        if cb.collective_nranks:
             # collective-launch observability (analysis.comms): the
             # drill site fires first (hang mode makes THIS rank the
             # straggler its peers must attribute), then the plan's byte
@@ -1792,8 +1825,15 @@ class Executor:
             if num_layout is not None:
                 _numerics().ENGINE.note_step(step_id, num_stats,
                                              num_layout)
-        for n, v in zip(cb.persist_rw, new_rw):
-            scope.set_var(n, v)
+        # batch write-back (async scope plane): one epoch bump per step,
+        # values stay in-flight device arrays — scope.find_var readers
+        # remain lazy, host consumers call scope.materialize(name)
+        wb = dict(zip(cb.persist_rw, new_rw))
+        if hasattr(scope, "set_vars"):
+            scope.set_vars(wb)
+        else:                       # foreign scope-likes (tests, tools)
+            for n, v in wb.items():
+                scope.set_var(n, v)
         if self._step_hooks:
             # step boundary: scope state is complete for this step (the
             # arrays may still be in flight on device — hooks that need

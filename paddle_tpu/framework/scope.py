@@ -28,6 +28,14 @@ class Scope:
         self._vars: Dict[str, Any] = {}
         self.kids = []
         self._serial = next(_scope_serials)
+        # device-resident scope epoch (async write-back plane): bumped
+        # once per batch write-back (executor step boundary).  Values
+        # written by a step are in-flight jax Arrays — find_var stays
+        # LAZY on them (no host sync); a host consumer that needs bytes
+        # calls materialize().  The epoch lets such consumers (and the
+        # pjit reshard path) detect "scope advanced since I last read"
+        # with one int compare instead of touching device buffers.
+        self.epoch = 0
 
     def var(self, name: str):
         """Create-or-get, like ref Scope::Var."""
@@ -53,6 +61,30 @@ class Scope:
 
     def set_var(self, name: str, value) -> None:
         self._vars[name] = value
+
+    def set_vars(self, mapping: Dict[str, Any]) -> None:
+        """Batch write-back of one step's updated persistables: a single
+        dict.update + ONE epoch bump, so every var of a step lands under
+        the same epoch (the executor's _finish_run path — per-name
+        set_var loops would publish a torn epoch where a concurrent
+        reader sees step N's moments next to step N-1's params)."""
+        self._vars.update(mapping)
+        self.epoch += 1
+
+    def materialize(self, name: str):
+        """Host-materialize one var: resolve ``name`` (parent fallback),
+        block until the device buffer is ready, store and return the
+        host copy.  The boundary where the async write-back plane's
+        laziness ends — checkpoint writers and eval readers that need
+        bytes call this instead of np.asarray(find_var(...)) so the
+        sync is attributed here, not hidden inside a numpy coercion."""
+        s = self._owning_scope(name)
+        if s is None:
+            return None
+        v = s._vars[name]
+        if hasattr(v, "block_until_ready"):
+            v.block_until_ready()
+        return v
 
     def erase(self, name: str) -> None:
         """Remove ``name`` from the scope that OWNS it (same walk as

@@ -438,9 +438,32 @@ class HBMAccountant:
 def _scope_nbytes(scope, name: str) -> int:
     try:
         v = scope.find_var(name)
-        return int(getattr(v, "nbytes", 0) or 0)
+        return per_device_nbytes(v)
     except Exception:
         return 0
+
+
+def per_device_nbytes(v) -> int:
+    """Bytes ONE device holds for an array: sharded jax Arrays (GSPMD
+    params under a rule table, ZeRO-1 optimizer state) cost their shard,
+    not the global shape — ``sharding.shard_shape`` is the same
+    arithmetic XLA's buffer assignment uses, so a dp-sharded Adam moment
+    reports 1/dp of its global bytes.  Replicated (or host/numpy) values
+    keep their full nbytes."""
+    nbytes = int(getattr(v, "nbytes", 0) or 0)
+    sharding = getattr(v, "sharding", None)
+    shape = getattr(v, "shape", None)
+    if sharding is None or not shape or not nbytes:
+        return nbytes
+    try:
+        shard = sharding.shard_shape(tuple(shape))
+    except Exception:
+        return nbytes
+    n, g = 1, 1
+    for sd, gd in zip(shard, shape):
+        n *= int(sd)
+        g *= int(gd)
+    return nbytes if g == 0 else int(nbytes * n // g)
 
 
 #: process-wide accountant — the executor's step boundary feeds it

@@ -1,6 +1,11 @@
 from .mesh import hierarchical_allreduce, make_hierarchical_mesh  # noqa
-from .mesh import (current_mesh, data_parallel_mesh, make_mesh, set_mesh,  # noqa
+from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
+                   make_topology_mesh, mesh_axis_sizes, set_mesh,
                    sharding_for)
+from .partitioner import (DEFAULT_RULE_TABLES, LogicalAxisRules,  # noqa
+                          apply_rules, choose_rules, infer_logical_axes,
+                          partition_fingerprint, partition_program,
+                          rule_table)
 from .pipeline import (PipelineEngine, PipelineOptimizer,  # noqa
                        Section, split_program)
 from .dgc import DGCGradAllReduce  # noqa  (registers dgc_* op lowerings)

@@ -1124,7 +1124,12 @@ class CheckpointDaemon:
                     "scope; did you run the startup program before "
                     "enabling the checkpoint daemon?")
             if isinstance(val, jax.Array):
-                nbytes = int(getattr(val, "nbytes", 0) or 0)
+                # jnp.copy preserves sharding, so a GSPMD/ZeRO-1-sharded
+                # var's capture copy costs each device only its SHARD —
+                # attribute per-device bytes, or the ckpt_capture class
+                # over-reports by the mesh size on sharded snapshots
+                from . import hbm as _hbm
+                nbytes = _hbm.per_device_nbytes(val)
                 if not chunk_bytes:
                     state[v.name] = jnp.copy(val)
                     dev_bytes += nbytes

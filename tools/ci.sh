@@ -98,6 +98,9 @@ JAX_PLATFORMS=cpu python tools/comms_smoke.py
 echo "== hbm smoke (live accounting zero host blocks, memory.oom drill -> forensics dump, KV-page churn exact) =="
 JAX_PLATFORMS=cpu python tools/hbm_smoke.py
 
+echo "== gspmd smoke (planner pick under memory pressure, sharded-vs-single-chip parity, ZeRO-1 opt_state gauge) =="
+JAX_PLATFORMS=cpu python tools/gspmd_smoke.py
+
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
