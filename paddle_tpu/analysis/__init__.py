@@ -34,6 +34,17 @@ time, before anything is lowered).
   detection, profiler auto-capture, checkpoint quarantine), and the
   ``gnorm``/``nanf`` gang-digest keys — the value-domain counterpart of
   the cost/attribution plane.
+- :mod:`paddle_tpu.analysis.device_profile` — MEASURED device-time
+  attribution from the sampling profiler's captured windows: a
+  chrome-trace + xplane.pb (dependency-free wire-format) parser joined
+  to framework steps by the ``paddle_tpu.step`` ids, HLO/fusion kernel
+  names mapped back to the cost-model op classes, per-step device time
+  / idle fraction / per-class shares, measured MFU
+  (``paddle_tpu_step_mfu_measured``, the ``mfu_m`` gang-digest key),
+  and the measured-vs-analytic divergence table persisted as
+  ``<window>/summary.json`` — the autotune search's objective oracle.
+  NOT imported eagerly here: it is the profiler's lazy post-close
+  dependency.
 - :mod:`paddle_tpu.analysis.fusion` — the cost-guided training-safe
   graph fusion pass (``FLAGS_graph_fusion``): PDPattern-matched
   candidates (conv+bn+relu, dense epilogues, embedding+layernorm),

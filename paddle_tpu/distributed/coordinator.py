@@ -413,6 +413,16 @@ class GangCoordinator:
                           format_manifest(step, self.world_size))
 
     @staticmethod
+    def _gspmd_rules_of(fingerprint) -> Optional[str]:
+        """GSPMD rule-table name from a fingerprint's ``#rules=<table>``
+        suffix (the verifier's partition fold stamps it) — None for
+        unpartitioned programs.  Surfaced per-rank in the status payload
+        so gangtop shows a mixed-table gang BEFORE the step-barrier
+        refusal fires."""
+        f = str(fingerprint) if fingerprint is not None else ""
+        return f.split("#rules=", 1)[1] if "#rules=" in f else None
+
+    @staticmethod
     def _find_mismatch(named, where: str) -> Optional[dict]:
         """First disagreeing (rank, fingerprint) pair in a sorted list
         of non-None fingerprints, as a diagnostic record naming both
@@ -665,6 +675,15 @@ class GangCoordinator:
             agg["straggler_net_ms"] = round(_net(slow), 3)
             agg["step_time_skew_ms"] = \
                 max(step_ms.values()) - min(step_ms.values())
+        # distinct GSPMD rule tables among live ranks: >1 means the
+        # planners diverged and the NEXT step barrier will refuse —
+        # surfacing it here makes the condition visible in gangtop /
+        # /statusz while the gang is still running
+        tables = sorted({t for t in (
+            self._gspmd_rules_of(e["fingerprint"]) for e in live.values())
+            if t is not None})
+        if tables:
+            agg["gspmd_rule_tables"] = tables
         return agg
 
     def _refresh_gang_gauges(self) -> None:
@@ -956,6 +975,8 @@ class GangCoordinator:
                               "cur_step": e["cur_step"],
                               "hb_steps": list(e["hb_steps"]),
                               "fingerprint": e["fingerprint"],
+                              "gspmd_rules": self._gspmd_rules_of(
+                                  e["fingerprint"]),
                               "digest": (dict(e["digest"])
                                          if e["digest"] else None),
                               "pid": e["pid"], "deaths": e["deaths"],

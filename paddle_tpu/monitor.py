@@ -541,6 +541,17 @@ def metrics_digest() -> Dict[str, Any]:
     mfu = newest_executor_series("paddle_tpu_step_mfu")
     if mfu is not None:
         digest["mfu"] = round(float(mfu), 5)
+    # measured MFU (this PR): analytic flops over MEASURED device-busy
+    # time from the last parsed profiler window — presence-gated on the
+    # window summary having published RECENTLY (same frozen-value
+    # discipline as the comms/hbm keys: a rank that stopped capturing
+    # windows must not report its last measured MFU forever).
+    if _measured_mfu_fresh():
+        fam = REGISTRY.get("paddle_tpu_step_mfu_measured")
+        if fam is not None:
+            v = fam.value()
+            if v:
+                digest["mfu_m"] = round(float(v), 5)
     qd = REGISTRY.get("paddle_tpu_dataloader_queue_depth")
     if qd is not None:
         vals = [cell.get() for labels, cell in qd.series()
@@ -654,6 +665,21 @@ def _hbm_digest_fresh() -> bool:
     return bool(last) and time.time() - last <= _COMM_DIGEST_TTL_S
 
 
+#: mfu_m freshness window — much longer than the comms/hbm TTL because
+#: profiler windows are SPARSE by design (every_n steps apart, or only
+#: on regression/anomaly triggers); a measurement from the last few
+#: minutes is still the rank's best measured truth
+_MFU_MEASURED_TTL_S = 600.0
+
+
+def _measured_mfu_fresh() -> bool:
+    mod = sys.modules.get("paddle_tpu.analysis.device_profile")
+    if mod is None:
+        return False                # plane never loaded: nothing to carry
+    last = getattr(mod, "last_publish_wall", 0.0)
+    return bool(last) and time.time() - last <= _MFU_MEASURED_TTL_S
+
+
 #: digest keys the gang skew/straggler plane reads, most important
 #: first — capped_digest sheds from the BOTTOM of this list, and sheds
 #: keys not on it before any that are.  comm_wait rides right behind
@@ -667,8 +693,9 @@ def _hbm_digest_fresh() -> bool:
 #: them the surviving key must be the one that renders alone (the HBM
 #: residency column) — a lone hdrm would render nothing.
 _DIGEST_PRIORITY = ("step_ms", "comm_wait", "nanf", "gnorm", "hbm",
-                    "hdrm", "mfu", "comm_ms", "comm_bw", "srv_q",
-                    "queue", "inflight", "occ", "slots", "tps", "steps")
+                    "hdrm", "mfu", "mfu_m", "comm_ms", "comm_bw",
+                    "srv_q", "queue", "inflight", "occ", "slots", "tps",
+                    "steps")
 
 
 def capped_digest(digest: Dict[str, Any],

@@ -451,6 +451,16 @@ class SamplingProfiler:
             self._rotate_and_manifest_locked(act)
         except OSError:
             pass          # a full disk must not fail the training step
+        # post-close attribution: parse the window just captured into
+        # <window>/summary.json + the measured gauges
+        # (paddle_tpu_step_mfu_measured, idle fraction, per-class
+        # device-time shares).  Best-effort by contract: the hook warns
+        # and skips on malformed captures and must NEVER fail the step.
+        try:
+            from .analysis import device_profile
+            device_profile.summarize_and_publish(act["dir"])
+        except Exception as e:
+            _note_window_error(e)
 
     def _rotate_and_manifest_locked(self, act):  # guarded-by-caller: _mu
         import shutil
@@ -467,6 +477,21 @@ class SamplingProfiler:
                         ("dir", "start_step", "end_step",
                          "wall_start", "wall_end", "trigger")
                         if k in act})
+        # dedupe by window dir, newest entry winning (a re-triggered
+        # step id re-uses its dir — jax writes a fresh timestamped run
+        # under plugins/profile/ — and the pre-dedupe manifest listed
+        # such dirs once per capture), and prune entries whose dirs no
+        # longer exist (externally deleted captures must not pin
+        # rotation slots or mislead readers)
+        by_dir = {}
+        for w in windows:
+            d = w.get("dir", "")
+            prev = by_dir.get(d)
+            if prev is None or w.get("wall_end", 0.0) >= \
+                    prev.get("wall_end", 0.0):
+                by_dir[d] = w
+        windows = [w for d, w in by_dir.items()
+                   if d == act.get("dir") or os.path.isdir(d)]
         windows.sort(key=lambda w: w.get("start_step", 0))
         while len(windows) > self.max_windows:
             victim = windows.pop(0)

@@ -1,0 +1,144 @@
+"""Regenerate the synthetic xprof capture fixture
+(``tests/fixtures/xprof_window/``) — a hand-built two-step window in
+the exact layout the sampling profiler captures
+(``plugins/profile/<run>/fix.trace.json.gz`` + ``fix.xplane.pb``), so
+xprof parsing / step-join / op-class attribution are unit-tested
+without a live TPU.
+
+The numbers are chosen to make every assertion exact:
+
+- two ``paddle_tpu.step`` spans (ids 100, 101), 1000 us each;
+- a ``/device:TPU:0`` lane with one kernel per op class of interest —
+  ``dot.1`` (matmul, 400 us per step), ``fusion.2`` (elementwise,
+  100 us per step), ``all-reduce.3`` (collective, 100 us, step 100
+  only), ``infeed.4`` (infeed, 50 us, step 101 only);
+- one infrastructure span (``ThreadpoolListener::OnComplete``) that
+  overlaps the kernels and must NOT count as device time;
+- one kernel outside any step span (``dot.1`` at t=3500 us) that must
+  land in ``unattributed_ms``;
+- an xplane.pb whose device plane carries the same per-kernel totals
+  (dot.1 = 900 us, fusion.2 = 200 us), so the wire-format reader can be
+  cross-checked against the JSON trace.
+
+Run from the repo root:  python tests/fixtures/make_xprof_fixture.py
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUN_DIR = os.path.join(HERE, "xprof_window", "plugins", "profile",
+                       "2026_01_01_00_00_00")
+
+TRACE = {"traceEvents": [
+    # metadata: pid 1 is the device, pid 2 the host python process
+    {"ph": "M", "pid": 1, "name": "process_name",
+     "args": {"name": "/device:TPU:0"}},
+    {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+     "args": {"name": "TensorFlow Ops"}},
+    {"ph": "M", "pid": 2, "name": "process_name",
+     "args": {"name": "python"}},
+    {"ph": "M", "pid": 2, "tid": 20, "name": "thread_name",
+     "args": {"name": "python"}},
+    # framework steps (host lane): ids 100/101, 1000 us each
+    {"ph": "X", "pid": 2, "tid": 20, "name": "paddle_tpu.step",
+     "ts": 1000, "dur": 1000, "args": {"step_num": "100"}},
+    {"ph": "X", "pid": 2, "tid": 20, "name": "paddle_tpu.step",
+     "ts": 2000, "dur": 1000, "args": {"step_num": "101"}},
+    # device kernels, step 100: 600 us busy of 1000 -> idle 0.4
+    {"ph": "X", "pid": 1, "tid": 10, "name": "dot.1",
+     "ts": 1100, "dur": 400, "args": {}},
+    {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.2",
+     "ts": 1550, "dur": 100, "args": {}},
+    {"ph": "X", "pid": 1, "tid": 10, "name": "all-reduce.3",
+     "ts": 1700, "dur": 100, "args": {}},
+    # device kernels, step 101: 550 us busy
+    {"ph": "X", "pid": 1, "tid": 10, "name": "dot.1",
+     "ts": 2100, "dur": 400, "args": {}},
+    {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.2",
+     "ts": 2550, "dur": 100, "args": {}},
+    {"ph": "X", "pid": 1, "tid": 10, "name": "infeed.4",
+     "ts": 2700, "dur": 50, "args": {}},
+    # infrastructure span overlapping step 101's kernels: excluded
+    {"ph": "X", "pid": 1, "tid": 10,
+     "name": "ThreadpoolListener::OnComplete",
+     "ts": 2100, "dur": 500, "args": {}},
+    # a kernel OUTSIDE both steps: lands in unattributed_ms
+    {"ph": "X", "pid": 1, "tid": 10, "name": "dot.1",
+     "ts": 3500, "dur": 100, "args": {}},
+]}
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(n: int, wire: int, payload) -> bytes:
+    tag = _varint((n << 3) | wire)
+    if wire == 0:
+        return tag + _varint(payload)
+    return tag + _varint(len(payload)) + payload
+
+
+def _msg(*fields: bytes) -> bytes:
+    return b"".join(fields)
+
+
+def build_xplane() -> bytes:
+    """Encode the minimal XSpace: one '/device:TPU:0' plane, metadata
+    for two kernels, one line whose event totals match the JSON trace
+    (dot.1 = 900 us, fusion.2 = 200 us; durations in picoseconds)."""
+    def emeta(mid, name):
+        inner = _msg(_field(1, 0, mid),
+                     _field(2, 2, name.encode()))
+        return _field(4, 2, _msg(_field(1, 0, mid),
+                                 _field(2, 2, inner)))
+
+    def event(mid, offset_ps, dur_ps):
+        return _field(4, 2, _msg(_field(1, 0, mid),
+                                 _field(2, 0, offset_ps),
+                                 _field(3, 0, dur_ps)))
+
+    line = _field(3, 2, _msg(
+        _field(1, 0, 10),                       # line id
+        _field(2, 2, b"TensorFlow Ops"),        # line name
+        _field(3, 0, 0),                        # timestamp_ns
+        event(1, 100_000_000, 900_000_000),     # dot.1: 900 us total
+        event(2, 550_000_000, 200_000_000),     # fusion.2: 200 us total
+    ))
+    plane = _field(1, 2, _msg(
+        _field(2, 2, b"/device:TPU:0"),
+        emeta(1, "dot.1"),
+        emeta(2, "fusion.2"),
+        line,
+    ))
+    return plane
+
+
+def main():
+    os.makedirs(RUN_DIR, exist_ok=True)
+    trace_path = os.path.join(RUN_DIR, "fix.trace.json.gz")
+    # mtime=0 keeps the gzip byte-identical across regenerations
+    with open(trace_path, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:
+            f.write(json.dumps(TRACE).encode())
+    xplane_path = os.path.join(RUN_DIR, "fix.xplane.pb")
+    with open(xplane_path, "wb") as f:
+        f.write(build_xplane())
+    print(f"wrote {trace_path} ({os.path.getsize(trace_path)} B), "
+          f"{xplane_path} ({os.path.getsize(xplane_path)} B)")
+
+
+if __name__ == "__main__":
+    main()

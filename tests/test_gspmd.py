@@ -428,3 +428,66 @@ def test_rule_table_resolution():
     assert rule_table(t) is t
     with pytest.raises(ValueError, match="unknown rule table"):
         rule_table("bogus")
+
+
+# ---------------------------------------------------------------------------
+# planner-choice observability: the choice counter and per-shard gauge
+# ---------------------------------------------------------------------------
+
+def test_choice_counter_increments_once_per_compile():
+    """paddle_tpu_gspmd_rule_choices_total ticks exactly once per
+    planner run, labeled with the chosen table and outcome — a compile
+    that re-plans (or a counter wired into a per-step path by mistake)
+    would break the fleet-wide 'how often does the planner fall back'
+    signal."""
+    from paddle_tpu import monitor
+    ctr = monitor.REGISTRY.get("paddle_tpu_gspmd_rule_choices_total")
+    main, loss = _planner_program()
+
+    before = ctr.value(rules="replicated", outcome="fit")
+    choose_rules(main, {"dp": 2, "mp": 4}, fetch_names=[loss.name],
+                 batch_size=16, budget_mb=100.0)
+    assert ctr.value(rules="replicated", outcome="fit") == before + 1
+
+    # nothing fits -> one fallback tick for the most-sharded table, and
+    # the fit cell did NOT move again
+    fb_before = ctr.value(rules="mp_hidden_vocab", outcome="fallback")
+    choose_rules(main, {"dp": 2, "mp": 4}, fetch_names=[loss.name],
+                 batch_size=16, budget_mb=1e-6)
+    assert ctr.value(rules="mp_hidden_vocab", outcome="fallback") == \
+        fb_before + 1
+    assert ctr.value(rules="replicated", outcome="fit") == before + 1
+
+    # end to end: one with_gspmd(rules="auto") compile = one tick total
+    total_before = sum(cell.get() for _, cell in ctr.series())
+    _train_mlp(lambda m, l: pt.CompiledProgram(m).with_gspmd(
+        axes={"dp": 2, "mp": 4}, rules="auto", zero_stage=1,
+        fetch_names=[l.name], batch_size=16, budget_mb=100.0),
+        steps=2, prefix="ctr")
+    assert sum(cell.get() for _, cell in ctr.series()) == total_before + 1
+
+
+def test_per_shard_gauge_tracks_shard_bytes_not_global():
+    """paddle_tpu_gspmd_per_shard_peak_bytes reports the CHOSEN
+    candidate's per-shard peak: for a sharded table that is strictly
+    less than the replicated (global) peak — a gauge publishing global
+    bytes would make every budget check read as over."""
+    from paddle_tpu import monitor
+    gauge = monitor.REGISTRY.get("paddle_tpu_gspmd_per_shard_peak_bytes")
+    main, loss = _planner_program()
+    _, rep = choose_rules(main, {"dp": 2, "mp": 4},
+                          fetch_names=[loss.name], batch_size=16,
+                          budget_mb=100.0)
+    peaks = {r["rules"]: r["per_shard_peak_bytes"] for r in rep}
+    # loose budget: replicated chosen, gauge = its (unsharded) peak
+    assert gauge.value() == float(peaks["replicated"])
+
+    # force a sharded choice: the gauge now tracks SHARD bytes
+    mid_mb = (peaks["mp_hidden"] + peaks["replicated"]) / 2 / (1 << 20)
+    table2, rep2 = choose_rules(main, {"dp": 2, "mp": 4},
+                                fetch_names=[loss.name], batch_size=16,
+                                budget_mb=mid_mb)
+    chosen2 = next(r for r in rep2 if r["chosen"])
+    assert table2.name != "replicated"
+    assert gauge.value() == float(chosen2["per_shard_peak_bytes"])
+    assert gauge.value() < float(peaks["replicated"])

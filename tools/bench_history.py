@@ -1,0 +1,240 @@
+"""bench_history: regression tracker over the ``BENCH_r*.json``
+trajectory — per-metric deltas across bench rounds, and a ``--gate``
+mode that fails CI when the latest round regresses past tolerance.
+Flat-MFU-for-six-rounds becomes a red gate instead of a ROADMAP
+footnote.
+
+Each ``BENCH_r<NN>.json`` is one bench run's record
+(``{"n", "cmd", "rc", "tail", "parsed"}``); the ``tail`` holds the
+run's stdout, which ``bench.py`` salts with compact JSON metric records
+(``{"metric": ..., "value": ..., ...}``).  Tails are TRUNCATED stream
+captures — a round can start mid-record — so extraction brace-scans
+for every ``{"metric"`` object and silently drops the ones that do not
+parse.
+
+Direction semantics per metric (name-driven, matching bench.py's
+families):
+
+- zero values mean "did not run this round" (a CPU round cannot
+  produce a TPU-only line) and are SKIPPED, never compared;
+- ``telemetry:*`` and ``*_ms`` / ``*p99*`` / ``*latency*`` are
+  lower-is-better;
+- ``hbm:*`` / ``memory:*`` / ``numerics_loss_fp*`` / ``gspmd:*`` are
+  plan-vs-measured ratios gated to a band around their previous value
+  (drift in either direction is the signal);
+- ``bench_error:*`` / ``fusion:*`` / ``comms:*`` are informational
+  (verdict/plan lines, not scalar performance) and are skipped;
+- everything else (mfu, examples/s, tokens/s, ...) is
+  higher-is-better with a relative tolerance.
+
+Usage:
+    python tools/bench_history.py                    # trajectory table
+    python tools/bench_history.py --json             # machine-readable
+    python tools/bench_history.py --gate             # exit 1 on regression
+    python tools/bench_history.py --gate --tolerance 0.08
+    python tools/bench_history.py --gate --inject bert_base_train_mfu=20
+                                                     # prove the gate bites
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: informational families — verdict/plan/error lines, not scalar perf
+_SKIP_RX = re.compile(r"^(bench_error:|fusion:|comms:)")
+#: lower-is-better families
+_LOWER_RX = re.compile(r"^telemetry:|_ms\b|_ms_|p99|latency",
+                       re.IGNORECASE)
+#: ratio families: gate to a band around the previous value — drift in
+#: either direction is the regression
+_RATIO_RX = re.compile(r"^(hbm:|memory:|numerics_loss_fp|gspmd:)")
+
+
+def _extract_metrics(tail: str) -> Dict[str, float]:
+    """Brace-scan ``{"metric" ...}`` objects out of one round's stdout
+    tail.  Truncated leading/trailing records fail json.loads and drop;
+    the LAST occurrence of a metric in a round wins (bench re-emits the
+    full array at exit)."""
+    out: Dict[str, float] = {}
+    i = 0
+    while True:
+        i = tail.find('{"metric"', i)
+        if i < 0:
+            break
+        depth = 0
+        j = i
+        while j < len(tail):
+            c = tail[j]
+            if c == '{':
+                depth += 1
+            elif c == '}':
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if depth != 0:
+            break  # truncated trailing record
+        try:
+            rec = json.loads(tail[i:j + 1])
+            name = rec.get("metric")
+            val = rec.get("value")
+            if isinstance(name, str) and isinstance(val, (int, float)) \
+                    and not isinstance(val, bool):
+                out[name] = float(val)
+        except (ValueError, TypeError):
+            pass
+        i = j + 1
+    return out
+
+
+def load_rounds(repo_dir: str = ".") -> List[Tuple[int, Dict[str, float]]]:
+    """[(round_number, {metric: value})] sorted by round, from every
+    ``BENCH_r*.json`` in the repo root.  Unreadable rounds warn to
+    stderr and drop."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            tail = data.get("tail", "") if isinstance(data, dict) else ""
+            metrics = _extract_metrics(str(tail))
+        except (OSError, ValueError) as e:
+            print(f"bench_history: skipping {path}: {e!r}",
+                  file=sys.stderr)
+            continue
+        rounds.append((int(m.group(1)), metrics))
+    rounds.sort()
+    return rounds
+
+
+def _direction(metric: str) -> str:
+    if _SKIP_RX.search(metric):
+        return "skip"
+    if _RATIO_RX.search(metric):
+        return "band"
+    if _LOWER_RX.search(metric):
+        return "lower"
+    return "higher"
+
+
+def compare(rounds: List[Tuple[int, Dict[str, float]]],
+            tolerance: float = 0.05) -> List[Dict[str, Any]]:
+    """Per-metric trajectory rows.  The gate compares the last two
+    rounds CARRYING each metric (zero = did-not-run is never
+    'carrying'), so a CPU round neither fails every TPU-only metric
+    nor shadows a regression a later round would otherwise hide."""
+    if not rounds:
+        return []
+    names = sorted({m for _, ms in rounds for m in ms})
+    out = []
+    for name in names:
+        traj = [(n, ms[name]) for n, ms in rounds
+                if name in ms and ms[name] != 0.0]
+        direction = _direction(name)
+        row: Dict[str, Any] = {
+            "metric": name, "direction": direction,
+            "trajectory": [{"round": n, "value": v} for n, v in traj],
+        }
+        if direction != "skip" and len(traj) >= 2:
+            (pn, pv), (cn, cv) = traj[-2], traj[-1]
+            delta = cv - pv
+            rel = delta / abs(pv) if pv else None
+            row.update({"prev_round": pn, "prev": pv,
+                        "round": cn, "value": cv,
+                        "delta": round(delta, 6),
+                        "rel": round(rel, 6) if rel is not None else None})
+            regressed = False
+            if rel is not None:
+                if direction == "higher":
+                    regressed = rel < -tolerance
+                elif direction == "lower":
+                    regressed = rel > tolerance
+                elif direction == "band":
+                    regressed = abs(rel) > tolerance
+            row["regressed"] = regressed
+        out.append(row)
+    return out
+
+
+def render(rows: List[Dict[str, Any]]) -> str:
+    out = [f"{'METRIC':<40} {'DIR':<6} {'PREV':>12} {'LATEST':>12} "
+           f"{'REL':>8}  TRAJECTORY"]
+    for r in rows:
+        traj = " ".join(f"r{p['round']:02d}={p['value']:g}"
+                        for p in r["trajectory"][-5:])
+        if "value" in r:
+            rel = f"{100.0 * r['rel']:+.1f}%" if r["rel"] is not None \
+                else "--"
+            flag = "  <-- REGRESSED" if r.get("regressed") else ""
+            out.append(f"{r['metric'][:40]:<40} {r['direction']:<6} "
+                       f"{r['prev']:>12g} {r['value']:>12g} {rel:>8}  "
+                       f"{traj}{flag}")
+        else:
+            out.append(f"{r['metric'][:40]:<40} {r['direction']:<6} "
+                       f"{'--':>12} {'--':>12} {'--':>8}  {traj}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-metric deltas across BENCH_r*.json rounds, "
+                    "with a CI regression gate")
+    ap.add_argument("--repo_dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative regression tolerance (default 5%%)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 if any metric regressed past tolerance")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="METRIC=VALUE",
+                    help="append a synthetic next round carrying "
+                         "METRIC=VALUE (repeatable) — CI uses this to "
+                         "prove the gate fails on a real regression")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.repo_dir)
+    if args.inject:
+        synth: Dict[str, float] = {}
+        for spec in args.inject:
+            name, _, val = spec.partition("=")
+            try:
+                synth[name] = float(val)
+            except ValueError:
+                ap.error(f"bad --inject {spec!r}")
+        next_n = (rounds[-1][0] + 1) if rounds else 1
+        rounds.append((next_n, synth))
+    rows = compare(rounds, tolerance=args.tolerance)
+    regressed = [r for r in rows if r.get("regressed")]
+    if args.json:
+        print(json.dumps({"rounds": [n for n, _ in rounds],
+                          "tolerance": args.tolerance,
+                          "metrics": rows,
+                          "regressed": [r["metric"] for r in regressed]},
+                         indent=1))
+    else:
+        print(render(rows))
+        if regressed:
+            print(f"\nbench_history: {len(regressed)} metric(s) "
+                  f"regressed past {100 * args.tolerance:.0f}%: "
+                  + ", ".join(r["metric"] for r in regressed))
+        else:
+            print(f"\nbench_history: no regressions past "
+                  f"{100 * args.tolerance:.0f}% "
+                  f"across {len(rounds)} round(s)")
+    if args.gate and regressed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,77 @@ JAX_PLATFORMS=cpu python tools/gspmd_smoke.py
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
+echo "== xprof smoke (fixture parse + live capture -> summary.json keys, measured vs analytic MFU band) =="
+# 1) the checked-in synthetic window parses to the exact designed
+#    attribution (step join, op classes, idle fraction, xplane agreement)
+JAX_PLATFORMS=cpu python tools/xprof.py --window tests/fixtures/xprof_window \
+    --flops_per_step 5.75e8 --peak_flops 1e12 \
+    --share matmul=0.8,elementwise=0.2 --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+assert s["n_steps"] == 2 and [r["step"] for r in s["steps"]] == [100, 101], s["steps"]
+assert abs(s["idle_frac"] - 0.425) < 1e-9, s["idle_frac"]
+assert abs(s["per_class_share"]["matmul"] - 0.72) < 1e-9, s["per_class_share"]
+assert abs(s["measured"]["mfu_measured"] - 1.0) < 1e-6, s["measured"]
+assert s["xplane_kernel_ms"] == {"dot.1": 0.9, "fusion.2": 0.2}, s.get("xplane_kernel_ms")
+assert s["divergence"]["wasted_headroom"], "empty headroom ranking"
+print("xprof fixture OK: 2 steps, idle %.1f%%, measured MFU %.2f" % (
+    100 * s["idle_frac"], s["measured"]["mfu_measured"]))'
+# 2) a real CPU capture round-trips through the post-close hook: the
+#    window summary exists, carries the schema, and measured/analytic
+#    agree within a band loose enough for CPU dispatch slack
+JAX_PLATFORMS=cpu python -c '
+import json, os, tempfile
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor, profiler
+from paddle_tpu.framework import Executor, Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+sdir = tempfile.mkdtemp(prefix="ci_xprof_")
+scope = Scope()
+with scope_guard(scope), program_guard(Program(), Program()):
+    x = layers.data("x", shape=[128], dtype="float32")
+    h = layers.fc(x, size=256, act="relu")
+    loss = layers.mean(layers.fc(h, size=64))
+    pt.optimizer.SGD(0.01).minimize(loss)
+    exe = Executor()
+    exe.run(pt.default_startup_program(), scope=scope)
+    feed = {"x": np.ones((32, 128), np.float32)}
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+    profiler.SAMPLER.configure(2, 3, sdir, 2)
+    for _ in range(8):
+        exe.run(feed=feed, fetch_list=[loss.name], scope=scope)
+    profiler.SAMPLER.close()
+    profiler.SAMPLER.configure(0, 4, "", 8)
+windows = json.load(open(os.path.join(sdir, "manifest.json")))["windows"]
+dirs = [w["dir"] for w in windows]
+assert len(dirs) == len(set(dirs)), f"manifest duplicates: {dirs}"
+s = json.load(open(os.path.join(windows[-1]["dir"], "summary.json")))
+for key in ("steps", "per_class_ms", "per_class_share", "idle_frac",
+            "kernels", "measured", "divergence"):
+    assert key in s, key
+m = s["measured"]
+assert m["mfu_measured"] and m["mfu_measured"] > 0, m
+fam = monitor.REGISTRY.get("paddle_tpu_step_mfu_measured")
+assert fam is not None and fam.value() > 0
+assert monitor.metrics_digest().get("mfu_m"), "mfu_m missing from digest"
+# measured >= analytic-over-span by construction (busy <= span), and on
+# CPU the two stay within a generous band (dispatch slack dominates)
+ratio = m["mfu_measured"] / m["mfu_analytic_over_span"]
+assert 1.0 <= ratio < 100.0, ratio
+import shutil; shutil.rmtree(sdir, ignore_errors=True)
+print("xprof live capture OK: measured %.2f%%, analytic-over-span %.2f%%, mfu_m in digest" % (
+    100 * m["mfu_measured"], 100 * m["mfu_analytic_over_span"]))'
+
+echo "== bench history gate (BENCH_r*.json trajectory; injected regression must fail) =="
+python tools/bench_history.py --gate
+# the gate must DEMONSTRABLY bite: an injected 50% MFU collapse fails
+if python tools/bench_history.py --gate --inject bert_base_train_mfu=20 > /dev/null 2>&1; then
+    echo "bench_history gate failed to catch an injected regression"; exit 1
+fi
+echo "bench_history gate OK (passes trajectory, catches injected regression)"
+
 echo "== bench smoke (CPU fallback) =="
 JAX_PLATFORMS=cpu python bench.py
 

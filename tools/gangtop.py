@@ -4,7 +4,12 @@ coordinator's ``status`` view — `top` for a training gang.
 
 Each row is one rank: liveness, current training step, durably-committed
 step, and the heartbeat metrics digest (step-time estimate, live MFU,
-the hbm plane's live HBM bytes and HDRM% headroom-of-budget — a rank
+measured MFU_M% from the rank's last parsed profiler window (digest key
+``mfu_m``, presence-gated — only ranks with a recent window summary
+carry it), the GSPMD RULES table the rank's planner chose (from the
+fingerprint's ``#rules=`` suffix; a mixed-table gang gets a footer flag
+BEFORE the step barrier refuses), the hbm plane's live HBM bytes and
+HDRM% headroom-of-budget — a rank
 under the risk threshold is flagged ``<-- OOM-RISK`` — the comms
 plane's COMM time and BW% bus bandwidth, dataloader queue depth,
 executor in-flight depth, plus the serving-load columns a fleet router
@@ -107,12 +112,13 @@ def render(status: dict) -> str:
     ranks = status.get("ranks", {})
     rows = []
     header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
-              f"{'STEP_MS':>9} {'MFU%':>6} "
+              f"{'STEP_MS':>9} {'MFU%':>6} {'MFU_M%':>6} "
               f"{'HBM':>8} {'HDRM%':>6} "
               f"{'COMM':>7} {'BW%':>6} "
               f"{'GNORM':>8} {'NANF':>6} "
               f"{'QUEUE':>5} {'INFL':>4} "
               f"{'SRVQ':>5} {'OCC':>5} {'SLOT':>4} {'TOK/S':>7} "
+              f"{'RULES':>10} "
               f"{'HB_AGE':>7} {'DEATHS':>6}")
     rows.append(header)
     rows.append("-" * len(header))
@@ -127,6 +133,10 @@ def render(status: dict) -> str:
                  else "alive" if e.get("alive") else "DEAD")
         d = e.get("digest") or {}
         mfu = d.get("mfu")
+        # measured MFU (digest key mfu_m): presence-gated like the
+        # serving keys — only ranks that recently parsed a profiler
+        # window carry it, everyone else renders '-'
+        mfu_m = d.get("mfu_m")
         nanf = d.get("nanf")
         bw = d.get("comm_bw")
         hbm = d.get("hbm")
@@ -135,6 +145,7 @@ def render(status: dict) -> str:
                 f"{_fmt(e.get('step'), '{}'):>7} "
                 f"{_fmt(d.get('step_ms')):>9} "
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
+                f"{_fmt(mfu_m * 100 if isinstance(mfu_m, (int, float)) else None):>6} "
                 f"{_fmt(hbm / 2**30 if isinstance(hbm, (int, float)) else None, '{:.2f}G'):>8} "
                 f"{_fmt(hfrac * 100 if hfrac is not None else None, '{:.0f}'):>6} "
                 f"{_fmt(d.get('comm_ms')):>7} "
@@ -147,6 +158,7 @@ def render(status: dict) -> str:
                 f"{_fmt(d.get('occ'), '{:.1f}'):>5} "
                 f"{_fmt(d.get('slots'), '{:.0f}'):>4} "
                 f"{_fmt(d.get('tps'), '{:.1f}'):>7} "
+                f"{str(e.get('gspmd_rules') or '-')[:10]:>10} "
                 f"{_fmt(e.get('age_s'), '{:.1f}s'):>7} "
                 f"{_fmt(e.get('deaths'), '{}'):>6}")
         if r == straggler:
@@ -167,6 +179,13 @@ def render(status: dict) -> str:
                 f"  dead={status.get('dead', [])}"
                 f"  step_skew={_fmt(agg.get('step_skew'), '{}')}"
                 f"  manifest={status.get('manifest')}")
+    # mixed GSPMD rule tables among live ranks: the next step barrier
+    # WILL refuse — flag it now, while the gang still renders healthy
+    tables = agg.get("gspmd_rule_tables") or []
+    if len(tables) > 1:
+        rows.append("MIXED GSPMD RULE TABLES: "
+                    + ", ".join(str(t) for t in tables)
+                    + "  (step barrier will refuse)")
     mm = status.get("mismatch")
     if mm:
         rows.append(f"FINGERPRINT MISMATCH: {mm.get('detail', mm)}")

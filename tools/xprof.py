@@ -1,0 +1,150 @@
+"""xprof: measured device-time attribution for one captured profiler
+window (ref TensorFlow's xprof/op_profile: profile proto → per-op time
+breakdown; here the capture is the sampling profiler's
+``trace.json.gz`` + ``xplane.pb`` and the breakdown lands on the
+cost-model op classes).
+
+Usage:
+    python tools/xprof.py --window pt_profile_samples/window_00000007
+    python tools/xprof.py --window ... --json          # machine-readable
+    python tools/xprof.py --base_dir pt_profile_samples  # newest window
+    python tools/xprof.py --window ... --write         # persist summary.json
+
+Prints per-op-class measured device-time shares, per-step device time
+and idle/gap fraction, measured MFU (when ``--flops_per_step`` /
+``--peak_flops`` are given or the live analytic gauges are populated),
+and the measured-vs-analytic divergence table ranking kernels by
+wasted roofline headroom — the objective oracle the autotune search
+consumes.  Exit 0 with a summary, 1 when the window has no parseable
+capture (malformed files warn and skip; they never raise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.analysis import device_profile as dp  # noqa: E402
+
+
+def _pick_window(args) -> str:
+    if args.window:
+        return args.window
+    wins = sorted(d for d in glob.glob(
+        os.path.join(args.base_dir, "window_*")) if os.path.isdir(d))
+    if not wins:
+        raise SystemExit(f"no windows under {args.base_dir!r}")
+    return wins[-1]
+
+
+def _fmt_pct(v):
+    return f"{100.0 * v:6.2f}%" if v is not None else "     --"
+
+
+def render(summary) -> str:
+    out = [f"window   {summary['window']}",
+           f"trace    {summary['trace']}"
+           + (f"  (+ {summary['xplane']})" if "xplane" in summary
+              else ""),
+           f"steps    {summary['n_steps']}   device total "
+           f"{summary['device_ms_total']:.3f} ms   idle "
+           f"{_fmt_pct(summary['idle_frac'])}"]
+    m = summary.get("measured", {})
+    if m.get("mfu_measured") is not None:
+        out.append(
+            f"MFU      measured {_fmt_pct(m['mfu_measured'])}   "
+            f"analytic-over-span "
+            f"{_fmt_pct(m['mfu_analytic_over_span'])}")
+    out.append("")
+    out.append(f"{'OP CLASS':<12} {'TIME':>10} {'SHARE':>8}")
+    for cls, ms in sorted(summary["per_class_ms"].items(),
+                          key=lambda kv: -kv[1]):
+        out.append(f"{cls:<12} {ms:>8.3f}ms "
+                   f"{_fmt_pct(summary['per_class_share'].get(cls))}")
+    if summary.get("unattributed_ms"):
+        out.append(f"{'(no step)':<12} "
+                   f"{summary['unattributed_ms']:>8.3f}ms")
+    div = summary.get("divergence")
+    if div:
+        out.append("")
+        out.append(f"{'OP CLASS':<12} {'TIME%':>8} {'FLOP%':>8} "
+                   f"{'T/F':>6}   (time share >> flop share => "
+                   "memory/latency-bound)")
+        for row in div["per_class"]:
+            r = row["time_over_flop_ratio"]
+            out.append(
+                f"{row['op_class']:<12} "
+                f"{_fmt_pct(row['measured_time_share']):>8} "
+                f"{_fmt_pct(row['analytic_flop_share']):>8} "
+                f"{r if r is not None else '--':>6}")
+        if div["wasted_headroom"]:
+            out.append("")
+            out.append(f"{'KERNEL':<28} {'CLASS':<12} {'MS/STEP':>9} "
+                       f"{'ROOFLINE':>9} {'WASTED':>9}")
+            for row in div["wasted_headroom"][:12]:
+                out.append(
+                    f"{row['kernel'][:28]:<28} {row['op_class']:<12} "
+                    f"{row['ms_per_step']:>9.4f} "
+                    f"{row['roofline_min_ms']:>9.4f} "
+                    f"{row['wasted_ms']:>9.4f}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="measured device-time attribution for one captured "
+                    "profiler window")
+    ap.add_argument("--window", default=None,
+                    help="capture window dir (default: newest under "
+                         "--base_dir)")
+    ap.add_argument("--base_dir", default="pt_profile_samples")
+    ap.add_argument("--flops_per_step", type=float, default=None,
+                    help="analytic flops/step (default: live gauge)")
+    ap.add_argument("--peak_flops", type=float, default=None,
+                    help="device peak flops (default: analysis.cost)")
+    ap.add_argument("--share", default=None,
+                    help="analytic per-class flop shares as "
+                         "CLASS=FRAC[,CLASS=FRAC...] (default: the live "
+                         "paddle_tpu_step_flops_share gauges) — enables "
+                         "the divergence table offline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full summary as JSON")
+    ap.add_argument("--write", action="store_true",
+                    help="persist <window>/summary.json")
+    args = ap.parse_args(argv)
+
+    window = _pick_window(args)
+    flops, peak, share = dp._live_analytic()
+    if args.flops_per_step is not None:
+        flops = args.flops_per_step
+    if args.peak_flops is not None:
+        peak = args.peak_flops
+    if args.share is not None:
+        share = {}
+        for part in args.share.split(","):
+            cls, _, frac = part.partition("=")
+            share[cls.strip()] = float(frac)
+    summary = dp.summarize_window(window, flops_per_step=flops,
+                                  peak_flops=peak,
+                                  analytic_share=share or None)
+    if summary is None:
+        print(f"xprof: no parseable capture under {window!r}",
+              file=sys.stderr)
+        return 1
+    if args.write:
+        dp.write_summary(window, summary)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
