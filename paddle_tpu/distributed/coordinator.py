@@ -59,6 +59,29 @@ Durability note: the coordinator keeps gang state in memory (it outlives
 any rank when hosted by the launcher).  Pass ``manifest_dir`` to also
 persist the ``COMMITTED`` manifest through the same fsync'd-atomic file
 the file backend uses, so a full job restart still refuses torn saves.
+
+High availability (PR 18)
+-------------------------
+A coordinator constructed with ``standby_of="host:port"`` runs as a
+WARM STANDBY: it serves read-only ops, and instead of the liveness scan
+it runs a mirror loop pulling the primary's replicated log (``repl_sync``
+frames over the same socket plane) — the durable events (hello
+role/endpoint, announce, manifest publish, goodbye) replay into its own
+tables.  When the primary goes silent past ``heartbeat_timeout_s`` the
+standby PROMOTES: it bumps the leadership ``epoch``, reloads the shared
+``MANIFEST`` file (replication lag must never regress the durable
+record), grants every mirrored rank a fresh heartbeat grace, and starts
+the liveness scan.  Epoch fencing kills split-brain twice over: every
+request/response carries the epoch (a coordinator receiving a NEWER
+epoch than its own knows it is a zombie and refuses with ``fenced``),
+and the manifest mirror path writes through an ``EPOCH`` file in
+``manifest_dir`` — a zombie primary's mirror write observes the
+promoted standby's higher fence and is dropped, so the manifest can
+never be torn backward across a failover.  Clients accept a
+comma-separated multi-address ``PADDLE_GANG_COORD`` and replace the old
+fail-loud two-attempt ConnectionError with a bounded, backed-off
+re-dial that rotates addresses on transport errors and on
+``standby``/``fenced`` refusals.
 """
 
 from __future__ import annotations
@@ -69,6 +92,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 from .. import monitor as _monitor
@@ -151,10 +175,20 @@ class GangCoordinator:
     it, so a rank death or a barrier release wakes every waiter at once.
     """
 
+    #: how many replicated-log entries the primary retains — a standby
+    #: further behind than this gets a full snapshot instead (repl_sync)
+    REPL_LOG_KEEP = 512
+
+    #: ops a STANDBY serves (read-only + the replication pull itself);
+    #: everything else is refused with ``standby`` so clients rotate to
+    #: the primary instead of split-braining state onto the mirror
+    _STANDBY_OPS = ("status", "manifest", "repl_sync")
+
     def __init__(self, world_size: int, host: str = "127.0.0.1",
                  port: int = 0,
                  heartbeat_timeout_s: Optional[float] = None,
-                 manifest_dir: Optional[str] = None):
+                 manifest_dir: Optional[str] = None,
+                 standby_of: Optional[str] = None):
         from ..flags import get_flags
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = float(
@@ -164,6 +198,9 @@ class GangCoordinator:
         self.host = host
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.manifest_dir = manifest_dir
+        #: the primary this coordinator mirrors ("host:port"), None for
+        #: a primary.  Fixed at construction; the live role is _role.
+        self.standby_of = standby_of
         self._requested_port = int(port)
         #: the actually-bound port, set by start() (an ephemeral request
         #: gets a fresh port on every (re)start)
@@ -173,6 +210,17 @@ class GangCoordinator:
         self._manifest: Optional[int] = None    # guarded-by: _cv
         self._barriers: Dict[int, dict] = {}    # guarded-by: _cv
         self._comm_gates: Dict[int, dict] = {}  # guarded-by: _cv
+        #: leadership role + epoch fence (HA): the epoch bumps on every
+        #: standby promotion and rides every request/response; the
+        #: manifest mirror writes through the EPOCH file against it
+        self._role = "standby" if standby_of else "primary"  # guarded-by: _cv
+        self._epoch = 0                         # guarded-by: _cv
+        #: replicated log of durable events (hello role/endpoint,
+        #: announce, manifest publish, goodbye) the standby replays;
+        #: _log_base is the seq of _log[0] after pruning
+        self._log: List[dict] = []              # guarded-by: _cv
+        self._log_seq = 0                       # guarded-by: _cv
+        self._log_base = 0                      # guarded-by: _cv
         #: optional scrape surface (FLAGS_coordinator_metrics_port /
         #: start_metrics_http) — stopped with the coordinator
         self._metrics_http = None
@@ -205,8 +253,15 @@ class GangCoordinator:
         s.listen(128)
         self._lsock = s
         self.port = s.getsockname()[1]
-        for target, name in ((self._accept_loop, "pt-gang-accept"),
-                             (self._liveness_loop, "pt-gang-liveness")):
+        with self._cv:
+            standby = self._role == "standby"
+        # a standby runs the mirror loop INSTEAD of the liveness scan
+        # (it must not declare anyone dead off tables it only mirrors);
+        # promotion starts the liveness thread when it takes over
+        loops = ((self._accept_loop, "pt-gang-accept"),
+                 (self._mirror_loop, "pt-gang-mirror") if standby
+                 else (self._liveness_loop, "pt-gang-liveness"))
+        for target, name in loops:
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -230,6 +285,16 @@ class GangCoordinator:
             conns, self._conns = self._conns, []
             self._cv.notify_all()
         if self._lsock is not None:
+            # close() alone does NOT wake a thread blocked in accept():
+            # the in-flight syscall keeps the LISTEN socket alive in the
+            # kernel, which keeps completing handshakes nobody serves —
+            # a "stopped" coordinator that still looks connectable hangs
+            # dialing clients until timeout instead of refusing fast
+            # (the failover ladder in GangClient depends on the refusal)
+            try:
+                self._lsock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._lsock.close()
             except OSError:
@@ -268,17 +333,29 @@ class GangCoordinator:
             t.start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from .. import resilience as _resil
         try:
             while True:
                 req = recv_frame(conn)
+                # chaos site: an injected fault here drops the
+                # connection mid-exchange (the client sent a frame and
+                # never gets its response — the torn-frame drill); hang
+                # mode wedges this one conn's service thread
+                _resil.maybe_inject("coordinator.frame")
                 try:
-                    resp = self._handle(req)
+                    resp = self._fenced_handle(req)
                 except Exception as e:   # a bad request must not kill the
                     resp = {"ok": False,  # coordinator
                             "error": "internal",
                             "detail": repr(e)[:300]}
+                # every response carries the leadership epoch + role so
+                # clients track the newest leader and fence zombies
+                with self._cv:
+                    resp.setdefault("epoch", self._epoch)
+                    resp.setdefault("role", self._role)
                 send_frame(conn, resp)
-        except (ConnectionError, OSError, ValueError):
+        except (ConnectionError, OSError, ValueError,
+                _resil.InjectedFault):
             pass                           # client went away / bad frame
         finally:
             try:
@@ -311,6 +388,10 @@ class GangCoordinator:
                  # like cur_step: never feeds commit decisions
                  "digest": None,
                  "pid": None, "deaths": 0, "joins": 0,
+                 # fleet role ("trainer"/"replica"/"router", from hello)
+                 # + the serving endpoint a replica registered — the
+                 # router's discovery surface, replicated to the standby
+                 "role": "trainer", "endpoint": None,
                  # server-side barrier sequence: the k-th step_barrier
                  # arrival of every rank pairs with the k-th of its
                  # peers (see _op_step_barrier)
@@ -390,7 +471,19 @@ class GangCoordinator:
         — an fsync inside the one coordinator lock would stall every
         heartbeat, announce, and the liveness scan behind disk I/O."""
         self._manifest = int(step)
+        self._log_locked({"ev": "manifest", "step": int(step)})
         self._cv.notify_all()
+
+    def _log_locked(self, entry: dict) -> None:  # guarded-by-caller: _cv
+        """Append a durable event to the replicated log (bounded; a
+        standby further behind than the retained window re-syncs from a
+        full snapshot instead)."""
+        self._log.append(dict(entry, seq=self._log_seq))
+        self._log_seq += 1
+        overflow = len(self._log) - self.REPL_LOG_KEEP
+        if overflow > 0:
+            del self._log[:overflow]
+            self._log_base += overflow
 
     def _mirror_manifest(self) -> None:
         """Persist the CURRENT manifest to ``manifest_dir`` (same
@@ -407,8 +500,31 @@ class GangCoordinator:
         # temp name, and two serve threads mirroring concurrently (e.g.
         # a zombie wait_commit waiter racing a fresh commit_latest)
         # would truncate each other's staging file mid-fsync
+        with self._cv:
+            epoch = self._epoch
         with self._mirror_mu:
             os.makedirs(self.manifest_dir, exist_ok=True)
+            # epoch fencing folded into the manifest write path: the
+            # EPOCH file is the durable fence token.  A zombie primary
+            # (SIGKILL-survivor scheduling delay, partitioned host)
+            # reaching this point AFTER a standby promoted observes the
+            # newer fence and DROPS its write — the manifest can never
+            # be torn backward by a stale leader.
+            epath = os.path.join(self.manifest_dir, "EPOCH")
+            try:
+                with open(epath) as f:
+                    fence = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                fence = 0
+            if fence > epoch:
+                _monitor.COORD_FENCED_CTR.inc(1, path="manifest")
+                if _monitor.TRACER.enabled:
+                    _monitor.TRACER.instant(
+                        "gang.manifest_fenced", "gang",
+                        {"epoch": epoch, "fence": fence})
+                return
+            if epoch > fence:
+                _atomic_write(epath, f"{epoch}\n")
             _atomic_write(os.path.join(self.manifest_dir, "MANIFEST"),
                           format_manifest(step, self.world_size))
 
@@ -512,7 +628,164 @@ class GangCoordinator:
             if newly_dead:
                 self._refresh_gang_gauges()
 
+    # -- standby mirror / promotion ------------------------------------------
+    def _mirror_loop(self) -> None:
+        """Standby-side replication: poll the primary's ``repl_sync`` op
+        over a one-shot connection, absorb the snapshot/entry stream,
+        and promote when the primary stays silent past the heartbeat
+        timeout (the same staleness budget ranks get)."""
+        poll = max(min(self.heartbeat_timeout_s / 4.0, 0.5), 0.05)
+        since = 0
+        peer_epoch = 0
+        last_ok = time.monotonic()
+        host, _, port = str(self.standby_of).rpartition(":")
+        while True:
+            with self._cv:
+                if self._stopping or self._role != "standby":
+                    return
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=poll * 2) as s:
+                    send_frame(s, {"op": "repl_sync", "since": since})
+                    resp = recv_frame(s)
+                if isinstance(resp, dict) and resp.get("ok"):
+                    since = self._absorb_repl(resp)
+                    pe = resp.get("epoch")
+                    if isinstance(pe, int) and not isinstance(pe, bool):
+                        peer_epoch = max(peer_epoch, pe)
+                    last_ok = time.monotonic()
+            except (OSError, ConnectionError, ValueError):
+                pass                       # primary unreachable this round
+            if time.monotonic() - last_ok > self.heartbeat_timeout_s:
+                self._promote(peer_epoch)
+                return
+            with self._cv:
+                if self._stopping:
+                    return
+                self._cv.wait(timeout=poll)
+
+    def _absorb_repl(self, resp: dict) -> int:
+        """Fold a ``repl_sync`` response into the local tables; returns
+        the next log cursor.  Snapshot responses rebuild the rank table
+        wholesale; entry responses replay the durable event stream."""
+        with self._cv:
+            snap = resp.get("snapshot")
+            if isinstance(snap, dict):
+                mf = snap.get("manifest")
+                if mf is not None:
+                    self._manifest = (mf if self._manifest is None
+                                      else max(self._manifest, int(mf)))
+                for r, d in (snap.get("ranks") or {}).items():
+                    e = self._entry_locked(int(r))
+                    e["step"] = d.get("step")
+                    e["steps"] = list(d.get("steps") or [])
+                    e["role"] = d.get("role") or e["role"]
+                    e["endpoint"] = d.get("endpoint")
+                    e["pid"] = d.get("pid")
+            for entry in resp.get("entries") or ():
+                if isinstance(entry, dict):
+                    self._apply_repl_locked(entry)
+            return int(resp.get("next") or 0)
+
+    def _apply_repl_locked(self, entry: dict) -> None:  # guarded-by-caller: _cv
+        ev = entry.get("ev")
+        if ev == "hello":
+            e = self._entry_locked(int(entry["rank"]))
+            e["pid"] = entry.get("pid")
+            e["role"] = entry.get("role") or e["role"]
+            e["endpoint"] = entry.get("endpoint")
+        elif ev == "announce":
+            e = self._entry_locked(int(entry["rank"]))
+            e["step"] = entry.get("step")
+            e["steps"] = list(entry.get("steps") or [])
+        elif ev == "manifest":
+            step = int(entry["step"])
+            self._manifest = (step if self._manifest is None
+                              else max(self._manifest, step))
+        elif ev == "goodbye":
+            e = self._entry_locked(int(entry["rank"]))
+            e["alive"] = False
+            e["finished"] = True
+
+    def _promote(self, peer_epoch: int) -> None:
+        """Standby → primary takeover.  Epoch-fenced: the new epoch
+        strictly exceeds anything the old primary could have stamped, so
+        a zombie survivor is refused at both the frame layer (clients
+        carry the newer epoch) and the manifest write path (EPOCH file).
+        Ranks get a fresh heartbeat grace window — the standby only
+        mirrored their liveness, it never measured it."""
+        t0 = time.monotonic()
+        with self._cv:
+            if self._stopping or self._role != "standby":
+                return
+            self._role = "primary"
+            self._epoch = max(self._epoch, int(peer_epoch)) + 1
+            epoch = self._epoch
+            now = time.monotonic()
+            for e in self._ranks.values():
+                e["last_hb"] = now
+                if not e["finished"]:
+                    e["alive"] = True
+            self._cv.notify_all()
+        # durable catch-up: both coordinators share manifest_dir, and the
+        # replication stream may lag the primary's last fsync — the
+        # on-disk record must never regress across a failover
+        if self.manifest_dir:
+            disk = self._load_manifest(self.manifest_dir)
+            if disk is not None:
+                with self._cv:
+                    self._manifest = (disk if self._manifest is None
+                                      else max(self._manifest, disk))
+        _monitor.COORD_FAILOVER_CTR.inc()
+        _monitor.COORD_EPOCH_GAUGE.set(epoch)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant("gang.coord_failover", "gang",
+                                    {"epoch": epoch})
+        self._mirror_manifest()            # stamps the EPOCH fence token
+        t = threading.Thread(target=self._liveness_loop, daemon=True,
+                             name="pt-gang-liveness")
+        t.start()
+        self._threads.append(t)
+        _monitor.FLEET_FAILOVER_HIST.observe(
+            (time.monotonic() - t0) * 1e3)
+
+    def _op_repl_sync(self, req: dict) -> dict:
+        since = int(req.get("since", 0))
+        with self._cv:
+            if since < self._log_base:
+                # cursor fell off the bounded log — full snapshot resync
+                ranks = {str(r): {"step": e["step"],
+                                  "steps": list(e["steps"]),
+                                  "role": e["role"],
+                                  "endpoint": e["endpoint"],
+                                  "pid": e["pid"]}
+                         for r, e in self._ranks.items()}
+                return {"ok": True, "next": self._log_seq,
+                        "snapshot": {"manifest": self._manifest,
+                                     "ranks": ranks}}
+            return {"ok": True, "next": self._log_seq,
+                    "entries": list(self._log[since - self._log_base:])}
+
     # -- request dispatch ----------------------------------------------------
+    def _fenced_handle(self, req: dict) -> dict:
+        """Epoch fence + standby gate in front of the op table.  A
+        request carrying a NEWER epoch than ours proves a newer leader
+        exists — this coordinator is a zombie and must refuse (the
+        client rotates to the real primary); a standby refuses every
+        state-mutating op the same way."""
+        op = req.get("op")
+        peer_epoch = req.get("epoch")
+        with self._cv:
+            epoch, role = self._epoch, self._role
+        if isinstance(peer_epoch, int) and not isinstance(peer_epoch, bool) \
+                and peer_epoch > epoch:
+            _monitor.COORD_FENCED_CTR.inc(1, path="frame")
+            return {"ok": False, "error": "fenced", "epoch": epoch}
+        if role == "standby" and op not in self._STANDBY_OPS:
+            return {"ok": False, "error": "standby",
+                    "primary": self.standby_of, "epoch": epoch}
+        return self._handle(req)
+
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
         fn = getattr(self, f"_op_{op}", None)
@@ -526,6 +799,13 @@ class GangCoordinator:
                                    hello=True)
             if e["joins"] == 0:
                 e["joins"] = 1
+            if req.get("role"):
+                e["role"] = str(req["role"])
+            if req.get("endpoint"):
+                e["endpoint"] = str(req["endpoint"])
+            self._log_locked({"ev": "hello", "rank": int(req["rank"]),
+                              "pid": e["pid"], "role": e["role"],
+                              "endpoint": e["endpoint"]})
             return {"ok": True, "world_size": self.world_size,
                     **self._gang_view_locked()}
 
@@ -703,6 +983,9 @@ class GangCoordinator:
             e["step"] = int(req["step"])
             e["steps"] = sorted(int(s) for s in
                                 (req.get("steps") or [req["step"]]))
+            self._log_locked({"ev": "announce", "rank": rank,
+                              "step": e["step"],
+                              "steps": list(e["steps"])})
             # announcements move the wait_commit barrier
             self._cv.notify_all()
         return {"ok": True}
@@ -716,6 +999,7 @@ class GangCoordinator:
             e = self._entry_locked(int(req["rank"]))
             e["alive"] = False
             e["finished"] = True
+            self._log_locked({"ev": "goodbye", "rank": int(req["rank"])})
             if not self._dead_locked():
                 # a rank declared dead that then departs cleanly must
                 # not leave the degraded gauge latched on a healthy,
@@ -981,11 +1265,15 @@ class GangCoordinator:
                                          if e["digest"] else None),
                               "pid": e["pid"], "deaths": e["deaths"],
                               "joins": e["joins"],
+                              "role": e["role"],
+                              "endpoint": e["endpoint"],
                               "age_s": round(
                                   time.monotonic() - e["last_hb"], 3)}
                      for r, e in self._ranks.items()}
             return {"ranks": ranks,
                     "aggregates": self._aggregates_locked(),
+                    "epoch": self._epoch,
+                    "coord_role": self._role,
                     **self._gang_view_locked()}
 
     def _op_status(self, req: dict) -> dict:
@@ -1034,7 +1322,9 @@ class GangClient:
     def __init__(self, address: Optional[str] = None,
                  rank: Optional[int] = None,
                  world_size: Optional[int] = None,
-                 heartbeat_interval_s: Optional[float] = None):
+                 heartbeat_interval_s: Optional[float] = None,
+                 role: str = "trainer",
+                 endpoint: Optional[str] = None):
         from ..flags import get_flags
         env = Env()
         address = address or os.getenv("PADDLE_GANG_COORD", "")
@@ -1042,9 +1332,20 @@ class GangClient:
             raise ValueError(
                 f"gang coordinator address {address!r} is not host:port "
                 "(set PADDLE_GANG_COORD or pass address=)")
-        host, _, port = address.rpartition(":")
+        # comma-separated address list: primary first, warm standby
+        # after (launch.py exports both when --coordinator_standby);
+        # the client rotates through them on redial
+        self._addrs: List[tuple] = []
+        for a in address.split(","):
+            a = a.strip()
+            if not a:
+                continue
+            host, _, port = a.rpartition(":")
+            self._addrs.append((host, int(port)))
         self.address = address
-        self._host, self._port = host, int(port)
+        self._host, self._port = self._addrs[0]
+        self.role = str(role)
+        self.endpoint = endpoint
         self.rank = env.rank if rank is None else int(rank)
         self.world_size = env.world_size if world_size is None \
             else int(world_size)
@@ -1070,6 +1371,17 @@ class GangClient:
         #: coordinator has since reused, injecting a stale rank entry
         #: into a foreign gang: the in-suite flake PR 9 noted)
         self._hb_sock: Optional[socket.socket] = None  # guarded-by: _state_mu
+        #: which of _addrs the next dial targets — advanced by
+        #: _rotate_addr when the current coordinator is unreachable,
+        #: a standby, or fenced
+        self._addr_idx = 0                # guarded-by: _state_mu
+        #: highest leadership epoch observed in any response; stamped
+        #: into every request so a zombie primary fences itself
+        self._seen_epoch = 0              # guarded-by: _state_mu
+        # bounded redial budget per RPC: enough to visit every address
+        # twice plus a grace attempt (failover completes within one
+        # backoff ladder instead of failing loud on the first drop)
+        self._redial_attempts = max(4, 2 * len(self._addrs) + 1)
         self._degraded_noted = False
         #: None = auto-collect monitor.metrics_digest() per beat;
         #: a dict = fixed override (tests, foreign runners)
@@ -1077,50 +1389,147 @@ class GangClient:
 
     # -- connection plumbing -------------------------------------------------
     def _dial(self, timeout_s: float = 10.0) -> socket.socket:
-        s = socket.create_connection((self._host, self._port),
-                                     timeout=timeout_s)
+        with self._state_mu:
+            host, port = self._addrs[self._addr_idx]
+        s = socket.create_connection((host, port), timeout=timeout_s)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
+    def _rotate_addr(self) -> None:
+        """Advance the dial target to the next coordinator address
+        (no-op with a single address — the redial loop just re-dials)."""
+        with self._state_mu:
+            if len(self._addrs) > 1:
+                self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+
+    def _absorb_epoch(self, resp: dict) -> Optional[dict]:
+        """Track the newest leadership epoch; map the two failover
+        refusals (``standby``, ``fenced``) to ``None`` so the caller
+        rotates and retries instead of raising them at the user."""
+        ep = resp.get("epoch")
+        if isinstance(ep, int) and not isinstance(ep, bool):
+            with self._state_mu:
+                if ep > self._seen_epoch:
+                    self._seen_epoch = ep
+        if not resp.get("ok") and resp.get("error") in ("standby", "fenced"):
+            return None
+        return resp
+
     def _rpc(self, req: dict, timeout_s: float = 30.0,
              oneshot: bool = False) -> dict:
-        """One request/response.  Cheap ops share the persistent
-        connection (lock-serialized); blocking ops (``oneshot=True``)
-        dial their own so a parked ``wait_ready`` never queues the
-        daemon's announces or the heartbeat behind it."""
+        """One request/response with bounded failover.  Cheap ops share
+        the persistent connection (lock-serialized); blocking ops
+        (``oneshot=True``) dial their own so a parked ``wait_ready``
+        never queues the daemon's announces or the heartbeat behind it.
+        Transport errors and standby/fenced refusals redial through the
+        address list on a deterministic backoff ladder (PR-3 engine)
+        before the fail-loud ConnectionError — long enough for a warm
+        standby to promote, short enough that a truly dead plane still
+        fails fast."""
         req = dict(req)
         req.setdefault("rank", self.rank)
+        with self._state_mu:
+            req.setdefault("epoch", self._seen_epoch)
         if oneshot:
-            s = self._dial()
-            try:
-                s.settimeout(timeout_s)
-                send_frame(s, req)
-                return self._checked(recv_frame(s))
-            finally:
-                try:
-                    s.close()
-                except OSError:
-                    pass
+            return self._failover_oneshot(req, timeout_s)
         with self._mu:
-            last: Optional[BaseException] = None
-            for attempt in (0, 1):        # one transparent reconnect
+            return self._failover_persistent(req, timeout_s)
+
+    def _failover_oneshot(self, req: dict, timeout_s: float) -> dict:
+        from .. import resilience as _resil
+        delays = _resil.backoff_schedule(
+            self._redial_attempts, base_delay_s=0.05, max_delay_s=1.0,
+            seed=zlib.crc32(b"gang.oneshot") & 0xFFFFFFFF)
+        last: Optional[BaseException] = None
+        t_fail: Optional[float] = None
+        for attempt in range(self._redial_attempts):
+            try:
+                s = self._dial()
                 try:
-                    if self._sock is None:
-                        self._sock = self._dial()
-                    self._sock.settimeout(timeout_s)
-                    send_frame(self._sock, req)
-                    return self._checked(recv_frame(self._sock))
-                except (OSError, ConnectionError, ValueError) as e:
-                    last = e
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                    self._sock = None
-            raise ConnectionError(
-                f"gang coordinator at {self.address} unreachable: "
-                f"{last}") from last
+                    s.settimeout(timeout_s)
+                    send_frame(s, req)
+                    resp = self._absorb_epoch(recv_frame(s))
+                finally:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                if resp is None:          # standby/fenced: rotate + retry
+                    last = ConnectionError("coordinator is standby/fenced")
+                    t_fail = t_fail or time.monotonic()
+                    self._rotate_addr()
+                elif t_fail is not None:
+                    self._note_failover(t_fail)
+                    return self._checked(resp)
+                else:
+                    return self._checked(resp)
+            except (OSError, ConnectionError, ValueError) as e:
+                last = e
+                t_fail = t_fail or time.monotonic()
+                if attempt >= 1:          # first retry is a free re-dial
+                    self._rotate_addr()
+            if attempt < self._redial_attempts - 1:
+                time.sleep(delays[attempt])
+        raise ConnectionError(
+            f"gang coordinator(s) at {self.address} unreachable after "
+            f"{self._redial_attempts} attempts: {last}") from last
+
+    def _failover_persistent(self, req: dict,  # guarded-by-caller: _mu
+                             timeout_s: float) -> dict:
+        from .. import resilience as _resil
+        delays = _resil.backoff_schedule(
+            self._redial_attempts, base_delay_s=0.05, max_delay_s=1.0,
+            seed=zlib.crc32(b"gang.persistent") & 0xFFFFFFFF)
+        last: Optional[BaseException] = None
+        t_fail: Optional[float] = None
+        for attempt in range(self._redial_attempts):
+            try:
+                if self._sock is None:
+                    self._sock = self._dial()
+                self._sock.settimeout(timeout_s)
+                send_frame(self._sock, req)
+                resp = self._absorb_epoch(recv_frame(self._sock))
+                if resp is None:          # standby/fenced: rotate + retry
+                    last = ConnectionError("coordinator is standby/fenced")
+                    t_fail = t_fail or time.monotonic()
+                    self._close_sock_locked()
+                    self._rotate_addr()
+                else:
+                    if t_fail is not None:
+                        self._note_failover(t_fail)
+                    return self._checked(resp)
+            except (OSError, ConnectionError, ValueError) as e:
+                last = e
+                t_fail = t_fail or time.monotonic()
+                self._close_sock_locked()
+                if attempt >= 1:          # first retry is a free reconnect
+                    self._rotate_addr()
+            if attempt < self._redial_attempts - 1:
+                # bounded sleep (ladder caps at ~0.75 s total) under _mu:
+                # only other _rpc callers queue behind it, and they would
+                # hit the same dead coordinator anyway  # lint-ok: bounded backoff while the coordinator plane fails over
+                time.sleep(delays[attempt])
+        raise ConnectionError(
+            f"gang coordinator(s) at {self.address} unreachable after "
+            f"{self._redial_attempts} attempts: {last}") from last
+
+    def _close_sock_locked(self) -> None:  # guarded-by-caller: _mu
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+
+    def _note_failover(self, t_fail: float) -> None:
+        """An RPC that failed and then succeeded crossed a coordinator
+        failover (or blip) — record how long the client was dark."""
+        ms = (time.monotonic() - t_fail) * 1e3
+        _monitor.FLEET_FAILOVER_HIST.observe(ms)
+        if _monitor.TRACER.enabled:
+            _monitor.TRACER.instant(
+                "gang.client_failover", "gang",
+                {"rank": self.rank, "ms": round(ms, 3)})
 
     @staticmethod
     def _checked(resp: dict) -> dict:
@@ -1138,7 +1547,8 @@ class GangClient:
                            f"{err}: {detail}")
 
     def connect(self) -> "GangClient":
-        resp = self._rpc({"op": "hello", "pid": os.getpid()})
+        resp = self._rpc({"op": "hello", "pid": os.getpid(),
+                          "role": self.role, "endpoint": self.endpoint})
         self._absorb_view(resp)
         return self
 
@@ -1213,6 +1623,8 @@ class GangClient:
                 pass
 
     def _hb_loop(self) -> None:
+        from .. import resilience as _resil
+        fails = 0          # consecutive beat failures (loop-local)
         while not self._hb_stop.is_set():
             try:
                 with self._state_mu:
@@ -1228,6 +1640,7 @@ class GangClient:
                         self._hb_sock = sock
                 with self._state_mu:
                     payload = {"op": "heartbeat", "rank": self.rank,
+                               "epoch": self._seen_epoch,
                                **self._progress}
                     override = self._digest_override
                 digest = override
@@ -1243,13 +1656,34 @@ class GangClient:
                     payload["digest"] = _monitor.capped_digest(digest)
                 if self._hb_stop.is_set():
                     break        # close() raced the dial: never beat
+                # chaos site: a dropped/hung beat exercises the
+                # coordinator's liveness scan + the standby's promotion
+                _resil.maybe_inject("replica.heartbeat")
                 send_frame(sock, payload)
-                resp = recv_frame(sock)
+                resp = self._absorb_epoch(recv_frame(sock))
                 _monitor.GANG_HB_CTR.inc(1, role="client")
-                if resp.get("ok"):
+                if resp is None:
+                    # beating a standby (or a fenced zombie): rotate to
+                    # the real primary and re-hello so the new leader
+                    # learns this rank's role/endpoint
+                    self._drop_hb_sock()
+                    self._rotate_addr()
+                    try:
+                        self.connect()
+                    except (OSError, ConnectionError, RuntimeError):
+                        pass
+                elif resp.get("ok"):
+                    fails = 0
                     self._absorb_view(resp)
-            except (OSError, ConnectionError, ValueError):
+            except (OSError, ConnectionError, ValueError,
+                    _resil.InjectedFault):
                 self._drop_hb_sock()      # reconnect on the next beat
+                fails += 1
+                if fails >= 2:
+                    # two straight dead beats: the primary is likely
+                    # gone — try the next coordinator address
+                    self._rotate_addr()
+                    fails = 0
             self._hb_stop.wait(self.heartbeat_interval_s)
         self._drop_hb_sock()
 

@@ -71,6 +71,16 @@ def _parse_args(argv=None):
                         "exports PADDLE_GANG_COORD (liveness plane + "
                         "elastic recovery, no shared FS needed); 'file' "
                         "keeps the shared-directory rendezvous")
+    p.add_argument("--coordinator_standby", action="store_true",
+                   default=None,
+                   help="also host a warm-standby gang coordinator at "
+                        "started_port + world_size + 1 that mirrors the "
+                        "primary's manifest + announcements over a "
+                        "replicated log and promotes itself (epoch-"
+                        "fenced) on primary heartbeat loss; ranks get "
+                        "both addresses via PADDLE_GANG_COORD and fail "
+                        "over automatically (default: "
+                        "FLAGS_coordinator_standby)")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="how many abnormal rank exits the launcher may "
                         "absorb by respawning the rank (elastic "
@@ -100,6 +110,26 @@ def gang_coord_address(args) -> str:
     same address without any cross-node exchange."""
     node_ips, world = _cluster_shape(args)
     return f"{node_ips[0]}:{args.started_port + world}"
+
+
+def _standby_enabled(args) -> bool:
+    """--coordinator_standby, defaulting to FLAGS_coordinator_standby
+    when the CLI flag was not given (None)."""
+    if args.coordinator_standby is not None:
+        return bool(args.coordinator_standby)
+    try:
+        from ..flags import get_flags
+        return bool(get_flags("FLAGS_coordinator_standby")
+                    ["FLAGS_coordinator_standby"])
+    except Exception:
+        return False
+
+
+def gang_standby_address(args) -> str:
+    """The warm standby's endpoint: one port above the primary (same
+    derivable-everywhere property)."""
+    node_ips, world = _cluster_shape(args)
+    return f"{node_ips[0]}:{args.started_port + world + 1}"
 
 
 def _resolve_gang_dir(args) -> str:
@@ -150,25 +180,41 @@ def get_cluster_env(args):
             "TRAINING_ROLE": "TRAINER",
         }
         if args.gang_backend == "socket" and world > 1:
-            env["PADDLE_GANG_COORD"] = gang_coord_address(args)
+            addr = gang_coord_address(args)
+            if _standby_enabled(args):
+                # both addresses, primary first: GangClient rotates to
+                # the standby on primary loss (epoch-fenced failover)
+                addr = f"{addr},{gang_standby_address(args)}"
+            env["PADDLE_GANG_COORD"] = addr
         envs.append(env)
     return envs
 
 
 def start_coordinator(args):
     """Host the gang coordinator on the node-0 launcher (socket backend,
-    multi-rank jobs only).  Returns the started coordinator or None.
-    The launcher is the natural host: it outlives every rank, so rank
-    death, respawn, and the rejoin barrier all survive any trainer
-    process dying."""
+    multi-rank jobs only).  Returns the list of started coordinators
+    (primary first, then the warm standby when ``--coordinator_standby``)
+    — empty when this launcher hosts none.  The launcher is the natural
+    host: it outlives every rank, so rank death, respawn, and the rejoin
+    barrier all survive any trainer process dying."""
     node_ips, world = _cluster_shape(args)
     if args.gang_backend != "socket" or world <= 1 \
             or node_ips.index(args.node_ip) != 0:
-        return None
+        return []
     from .coordinator import GangCoordinator
     host, _, port = gang_coord_address(args).rpartition(":")
     coord = GangCoordinator(world, host=host, port=int(port),
                             manifest_dir=_resolve_gang_dir(args)).start()
+    coords = [coord]
+    if _standby_enabled(args):
+        sb_host, _, sb_port = gang_standby_address(args).rpartition(":")
+        # same manifest_dir: the standby's promotion path re-reads the
+        # durable MANIFEST so replication lag can never regress it, and
+        # its EPOCH fence token lands where the zombie primary looks
+        coords.append(GangCoordinator(
+            world, host=sb_host, port=int(sb_port),
+            manifest_dir=_resolve_gang_dir(args),
+            standby_of=coord.address).start())
     # FLAGS_coordinator_metrics_port: the launcher's process registry
     # holds the whole gang's per-rank digest gauges (the coordinator
     # folds every heartbeat into it), so serving /metrics + /statusz
@@ -190,7 +236,7 @@ def start_coordinator(args):
         sys.stderr.write(
             f"paddle_tpu launch: coordinator metrics server failed: "
             f"{e!r}\n")
-    return coord
+    return coords
 
 
 def _spawn(args, env, log_mode="w"):
@@ -302,7 +348,7 @@ def wait_procs(procs, grace_secs: float = 60.0, stop=None, args=None,
 def launch(argv=None):
     args = _parse_args(argv)
     envs = get_cluster_env(args)
-    coord = start_coordinator(args)
+    coords = start_coordinator(args)
     procs, logs = start_procs(args, envs)
     # a scheduler preempts the LAUNCHER: forward + drain, don't die and
     # leave ranks checkpointing into a gang that can never commit
@@ -320,8 +366,8 @@ def launch(argv=None):
     finally:
         if old is not None:
             signal.signal(signal.SIGTERM, old)
-        if coord is not None:
-            coord.stop()
+        for c in coords:
+            c.stop()
         for f in logs:
             f.close()
 

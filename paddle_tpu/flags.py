@@ -273,6 +273,23 @@ _DEFAULTS: Dict[str, Any] = {
     # the fleet (scrapers/balancers are off-box); set 127.0.0.1 to keep
     # it loopback-only.  Only consulted when the port is enabled.
     "FLAGS_metrics_host": "0.0.0.0",
+    # -- serving fleet (paddle_tpu.serving.fleet) --------------------------
+    # FleetRouter placement policy: "least_loaded" places each request on
+    # the fresh, non-draining replica with the smallest serving queue
+    # depth (srv_q digest key, tie-broken round-robin); "round_robin"
+    # ignores load and rotates.
+    "FLAGS_fleet_route_policy": "least_loaded",
+    # serving-load digest freshness TTL: the srv_q/occ/slots/tps digest
+    # keys stop riding the heartbeat (and the replica drops out of
+    # router placement) when the serving scheduler has not proven
+    # liveness within this many seconds — a wedged replica's last-known
+    # -good load digest must not attract traffic forever.  Must be > 0.
+    "FLAGS_fleet_digest_ttl_s": 10.0,
+    # coordinator high availability: the launcher also starts a warm
+    # standby coordinator (primary port + 1) mirroring manifest +
+    # durable announcements over the replicated log, and exports a
+    # two-address PADDLE_GANG_COORD so clients fail over to it.
+    "FLAGS_coordinator_standby": False,
     # -- numerics observability plane (analysis.numerics) ------------------
     # in-graph tensor-health statistics folded into one packed output per
     # lowered step: "off" (default, zero cost), "sentinel" (NaN/Inf
@@ -507,6 +524,15 @@ def set_flags(flags: Dict[str, Any]):
                 raise ValueError(
                     f"FLAGS_gspmd_mesh sizes must be positive: "
                     f"{coerced[name]!r}")
+        if name == "FLAGS_fleet_route_policy" and \
+                coerced[name] not in ("least_loaded", "round_robin"):
+            raise ValueError(
+                "FLAGS_fleet_route_policy must be 'least_loaded' or "
+                f"'round_robin', got {coerced[name]!r}")
+        if name == "FLAGS_fleet_digest_ttl_s" and coerced[name] <= 0:
+            raise ValueError(
+                "FLAGS_fleet_digest_ttl_s must be > 0 (a zero/negative "
+                f"TTL would blind placement), got {coerced[name]!r}")
         if name == "FLAGS_gspmd_rules" and coerced[name] != "auto":
             from .parallel.partitioner import rule_table
             rule_table(coerced[name])   # raises on unknown table name

@@ -511,6 +511,47 @@ GANG_STRAGGLER_MS_GAUGE = REGISTRY.gauge(
     "paddle_tpu_gang_straggler_step_ms",
     "the straggler rank's step-time estimate (ms)")
 
+# -- serving fleet + coordinator HA (this PR): the router's reroute
+# ledger, the per-replica placement-state gauge, the failover latency
+# surface, and the epoch-fencing counters.  Declared here because both
+# the router process and the coordinator processes touch them (the
+# same one-home rule as the gang families above).
+FLEET_REROUTE_CTR = REGISTRY.counter(
+    "paddle_tpu_fleet_reroutes_total",
+    "requests the FleetRouter moved off their placed replica, by reason "
+    "(drain = the replica refused admission while draining; dead = the "
+    "forward hit a transport error; circuit = the replica's breaker was "
+    "open at placement; error = the replica failed the request "
+    "non-transiently) — the chaos-drill ledger: completed requests = "
+    "first-try successes + exactly these", ("reason",))
+FLEET_REPLICA_STATE = REGISTRY.gauge(
+    "paddle_tpu_fleet_replica_state",
+    "router's placement view of each replica: 0=up 1=draining 2=dead "
+    "3=stale (load digest older than FLAGS_fleet_digest_ttl_s — held "
+    "out of least-loaded placement until it proves liveness again)",
+    ("replica",))
+FLEET_FAILOVER_HIST = REGISTRY.histogram(
+    "paddle_tpu_fleet_failover_ms",
+    "wall ms from a forward/coordinator failure to the request landing "
+    "on a healthy target (router reroutes and gang-client coordinator "
+    "failovers both observe here) — the p99 the chaos gate bounds",
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+             2500.0, 5000.0, 15000.0, 60000.0))
+COORD_EPOCH_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_coordinator_epoch",
+    "this coordinator's leadership epoch (bumped by each standby "
+    "promotion; the fencing token a zombie primary's manifest writes "
+    "are refused against)")
+COORD_FENCED_CTR = REGISTRY.counter(
+    "paddle_tpu_coordinator_fenced_total",
+    "operations refused by epoch fencing, by path (frame = a request "
+    "carried a newer epoch than this coordinator's — it is a zombie; "
+    "manifest = a mirror write observed a newer epoch in the EPOCH "
+    "file and was dropped)", ("path",))
+COORD_FAILOVER_CTR = REGISTRY.counter(
+    "paddle_tpu_coordinator_failovers_total",
+    "standby-to-primary promotions performed by this process")
+
 
 def metrics_digest() -> Dict[str, Any]:
     """Compact snapshot of THIS rank's runtime health for the gang
@@ -572,22 +613,29 @@ def metrics_digest() -> Dict[str, Any]:
     # serving load (this PR): the per-replica signals the fleet
     # router/autoscaler consumes — queue depth across tenants, the last
     # dispatched batch's occupancy, free decode slots, and decode
-    # tokens/s.  Presence-gated on the series actually existing, so a
-    # pure training rank's digest carries none of them.
-    sq = REGISTRY.get("paddle_tpu_serving_queue_depth")
-    if sq is not None:
-        vals = [cell.get() for labels, cell in sq.series()
-                if labels.get("tenant") != "retired"]
-        if vals:
-            digest["srv_q"] = float(sum(vals))
-    for key, fam_name in (("occ", "paddle_tpu_serving_last_batch_occupancy"),
-                          ("slots", "paddle_tpu_serving_free_decode_slots"),
-                          ("tps", "paddle_tpu_serving_tokens_per_s")):
-        fam = REGISTRY.get(fam_name)
-        if fam is not None:
-            cells = [cell.get() for _, cell in fam.series()]
-            if cells:
-                digest[key] = round(float(cells[-1]), 3)
+    # tokens/s.  Presence-gated on the series existing AND on the
+    # scheduler loops having proven liveness within
+    # FLAGS_fleet_digest_ttl_s (the aging discipline every other plane
+    # already has): a wedged scheduler's last-known-good load digest
+    # would otherwise read as an attractively idle replica to a
+    # least-loaded router forever — exactly the replica that must drop
+    # out of placement.
+    if _serving_digest_fresh():
+        sq = REGISTRY.get("paddle_tpu_serving_queue_depth")
+        if sq is not None:
+            vals = [cell.get() for labels, cell in sq.series()
+                    if labels.get("tenant") != "retired"]
+            if vals:
+                digest["srv_q"] = float(sum(vals))
+        for key, fam_name in (
+                ("occ", "paddle_tpu_serving_last_batch_occupancy"),
+                ("slots", "paddle_tpu_serving_free_decode_slots"),
+                ("tps", "paddle_tpu_serving_tokens_per_s")):
+            fam = REGISTRY.get(fam_name)
+            if fam is not None:
+                cells = [cell.get() for _, cell in fam.series()]
+                if cells:
+                    digest[key] = round(float(cells[-1]), 3)
     # numerics plane (this PR): global grad norm + cumulative non-finite
     # count, presence-gated on the numerics engine having published —
     # the fleet-wide "which rank is producing NaNs" signal.  nanf rides
@@ -678,6 +726,26 @@ def _measured_mfu_fresh() -> bool:
         return False                # plane never loaded: nothing to carry
     last = getattr(mod, "last_publish_wall", 0.0)
     return bool(last) and time.time() - last <= _MFU_MEASURED_TTL_S
+
+
+def _serving_digest_fresh() -> bool:
+    """The srv_q/occ/slots/tps keys ride only while a serving scheduler
+    loop (batcher dispatch or decode iteration) has woken within
+    FLAGS_fleet_digest_ttl_s.  Liveness, not traffic: an IDLE healthy
+    replica keeps beating (its loops wake on the coalescing timeout)
+    and stays the most attractive placement, while a scheduler wedged
+    inside a dispatch stops touching the wall and ages out."""
+    mod = sys.modules.get("paddle_tpu.serving.scheduler")
+    if mod is None:
+        return False                # plane never loaded: nothing to carry
+    last = getattr(mod, "last_alive_wall", 0.0)
+    try:
+        from .flags import get_flags
+        ttl = float(get_flags("FLAGS_fleet_digest_ttl_s")
+                    ["FLAGS_fleet_digest_ttl_s"])
+    except Exception:
+        ttl = 10.0
+    return bool(last) and time.time() - last <= ttl
 
 
 #: digest keys the gang skew/straggler plane reads, most important

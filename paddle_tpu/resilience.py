@@ -208,6 +208,17 @@ KNOWN_SITES = (
     # RESOURCE_EXHAUSTED does (dump + paddle_tpu_oom_total + memory.oom
     # instant + trigger:"oom" profiler window — tools/hbm_smoke.py)
     "memory.oom",
+    # fires inside ContinuousBatcher._dispatch before the batch executes
+    # (transient → absorbed by the scheduler's retry budget; hang mode
+    # trips the serving watchdog and fails the batch)
+    "serving.batch_dispatch",
+    # fleet chaos sites (tools/fleet_smoke.py): a router forward attempt
+    # (reroute drill), one coordinator frame service (torn-frame /
+    # dropped-connection drill), one client heartbeat send (liveness
+    # false-positive drill — the beat is skipped, not the rank killed)
+    "router.forward",
+    "coordinator.frame",
+    "replica.heartbeat",
 )
 
 _ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")

@@ -24,6 +24,10 @@ latency-governed multi-tenant request path:
   instants, gauges, and (optionally) an admission shed signal.
 - :mod:`httpd` — the live scrape surface: ``/metrics`` ``/healthz``
   ``/statusz`` on ``FLAGS_metrics_port``.
+- :mod:`fleet` — the multi-replica front door: ``ReplicaEndpoint``
+  fronts one server over the gang frame protocol, ``FleetRouter``
+  places each request on the least-loaded fresh replica and re-routes
+  around drains, deaths, and open breakers (README "Fleet").
 
 Every request carries a trace id from admission through queueing,
 batch coalescing, dispatch (correlated with the executor's process-
@@ -33,6 +37,7 @@ per tenant and bucket from the exported trace ring.
 """
 
 from .bucketing import BucketPlan, bucket_for, pad_to_bucket, parse_buckets  # noqa
+from .fleet import FleetError, FleetRouter, ReplicaEndpoint  # noqa
 from .httpd import MetricsHTTPServer  # noqa
 from .kv_cache import (DecodeEngine, GPTDecodeModel, PagedKVCache,  # noqa
                        params_from_scope)

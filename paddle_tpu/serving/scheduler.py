@@ -58,6 +58,20 @@ FAULTS_ABSORBED_CTR = _monitor.REGISTRY.counter(
     "transient dispatch faults absorbed by a batch re-dispatch "
     "(requests completed anyway)")
 
+#: wall clock of the most recent scheduler-loop wake (batcher dispatch
+#: or decode iteration) — the liveness proof behind the srv_q/occ/
+#: slots/tps digest keys' FLAGS_fleet_digest_ttl_s aging
+#: (monitor._serving_digest_fresh).  Liveness, not traffic: the idle
+#: loops wake on their bounded waits and keep touching this, while a
+#: scheduler wedged inside a dispatch stops — and its replica ages out
+#: of router placement.  Benign-race float: single word, newest wins.
+last_alive_wall = 0.0
+
+
+def _touch_alive() -> None:
+    global last_alive_wall
+    last_alive_wall = time.time()
+
 #: per-process request trace ids: every admitted request gets one, and
 #: every phase span of its lifetime carries it — `trace` in the span
 #: args groups the chain admission->materialize in the exported ring
@@ -226,7 +240,9 @@ class ContinuousBatcher:
         to the window for stragglers; None on stop with an empty queue."""
         with self._cv:
             while not self._queue and not self._stop:
+                _touch_alive()
                 self._cv.wait(0.1)
+            _touch_alive()
             if not self._queue:
                 return None
             bucket = self._queue[0].bucket
@@ -356,9 +372,15 @@ class ContinuousBatcher:
         attempt = 0
         while True:
             try:
-                return self._exe.run(
-                    compiled, feed=feed, fetch_list=list(fetch_names),
-                    scope=self._scope, return_numpy=False)
+                # watchdog-watched: a dispatch hung past
+                # FLAGS_watchdog_timeout_s dumps all stacks and raises
+                # HungStepError here — non-transient, so it falls through
+                # to _fail_batch instead of silently stalling the queue
+                with _resil.WATCHDOG.watch("serving.batch_dispatch"):
+                    _resil.maybe_inject("serving.batch_dispatch")
+                    return self._exe.run(
+                        compiled, feed=feed, fetch_list=list(fetch_names),
+                        scope=self._scope, return_numpy=False)
             except Exception as e:
                 if _resil.is_transient(e) and attempt < self._max_retries:
                     attempt += 1
@@ -532,6 +554,7 @@ class DecodeScheduler:
         eng = self._engine
         S = eng.max_slots
         while True:
+            _touch_alive()
             with self._cv:
                 self._admit_locked()
                 active_slots = [s for s in range(S)
@@ -600,8 +623,11 @@ class DecodeScheduler:
         attempt = 0
         while True:
             try:
-                _resil.maybe_inject("serving.decode_step")
-                return self._engine.run_iteration(ids, pos, active)
+                # watchdog-watched like the batcher's dispatch: a hung
+                # decode iteration dumps stacks and fails its requests
+                with _resil.WATCHDOG.watch("serving.decode_step"):
+                    _resil.maybe_inject("serving.decode_step")
+                    return self._engine.run_iteration(ids, pos, active)
             except Exception as e:
                 # retry only while the donated pools survived the
                 # failure: a fault from INSIDE the jitted step consumed

@@ -1,0 +1,457 @@
+"""Serving fleet (PR 18): router placement + digest TTL, replica
+endpoint protocol, re-route on replica death/drain, coordinator HA
+(replicated log, epoch-fenced standby promotion, multi-address client
+failover), and the chaos-drill harness.
+
+Fast tests use a stub serving server (no model build, no executor) so
+the router/endpoint/HA logic runs in milliseconds; the real 2-replica
+topology with live models runs in the slow-marked subprocess tests via
+``tools/fleet_smoke.py`` (tools/ci.sh runs its fast subset on every
+build).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor, resilience
+from paddle_tpu.distributed.coordinator import (GangClient,
+                                                GangCoordinator)
+from paddle_tpu.serving.fleet import (FleetError, FleetRouter,
+                                      ReplicaEndpoint)
+from paddle_tpu.serving.server import AdmissionError
+
+_SMOKE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "fleet_smoke.py")
+
+
+# ---------------------------------------------------------------------------
+# stub serving server: the endpoint/router contract without an executor
+# ---------------------------------------------------------------------------
+
+class _Future:
+    def __init__(self, value=None, err=None, delay_s=0.0):
+        self._value, self._err, self._delay = value, err, delay_s
+
+    def result(self, timeout=None):
+        if self._delay:
+            time.sleep(self._delay)
+        if self._err is not None:
+            raise self._err
+        return self._value
+
+
+class StubServer:
+    """Duck-typed stand-in for InferenceServer: submit/queue_depth/
+    _draining are the whole surface ReplicaEndpoint touches."""
+
+    def __init__(self, delay_s=0.0):
+        self._draining = threading.Event()
+        self.delay_s = delay_s
+        self.served = 0
+
+    def queue_depth(self):
+        return 0
+
+    def submit(self, tenant, feeds, seq_len=None, **kw):
+        if self._draining.is_set():
+            f = _Future(err=AdmissionError(
+                f"tenant {tenant!r} rejected (draining)"))
+            return f
+        self.served += 1
+        out = [np.asarray([[float(self.served)]])]
+        return _Future(value=out, delay_s=self.delay_s)
+
+
+def _fleet(n=2, **router_kw):
+    eps = [ReplicaEndpoint(StubServer(), replica_id=f"r{i}").start()
+           for i in range(n)]
+    router_kw.setdefault("digest_ttl_s", 0.5)
+    router = FleetRouter([e.address for e in eps], **router_kw)
+    return eps, router
+
+
+# ---------------------------------------------------------------------------
+# placement policy + digest TTL
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_placement_prefers_smallest_queue():
+    eps, router = _fleet(3)
+    try:
+        now = time.monotonic()
+        with router._mu:
+            for i, (addr, rep) in enumerate(router._reps.items()):
+                rep["last_seen"] = now
+                rep["load"] = {"srv_q": float(3 - i)}  # last is least
+        target = list(router._reps)[-1]
+        assert router._place() == target
+    finally:
+        for e in eps:
+            e.stop()
+
+
+def test_round_robin_rotates_over_fresh_replicas():
+    eps, router = _fleet(3, policy="round_robin")
+    try:
+        now = time.monotonic()
+        with router._mu:
+            for rep in router._reps.values():
+                rep["last_seen"] = now
+        picks = {router._place() for _ in range(6)}
+        assert picks == set(router._reps)
+    finally:
+        for e in eps:
+            e.stop()
+
+
+def test_digest_ttl_ages_replica_out_of_placement():
+    """The PR-18 satellite bug: a dead replica's stale srv_q digest
+    must not keep attracting traffic — the TTL holds it out once its
+    load report ages past FLAGS_fleet_digest_ttl_s."""
+    eps, router = _fleet(2, digest_ttl_s=0.2)
+    try:
+        now = time.monotonic()
+        addrs = list(router._reps)
+        with router._mu:
+            # replica 0: attractive-but-stale digest (e.g. SIGKILLed
+            # with an empty queue); replica 1: fresh but busier
+            router._reps[addrs[0]]["last_seen"] = now - 1.0
+            router._reps[addrs[0]]["load"] = {"srv_q": 0.0}
+            router._reps[addrs[1]]["last_seen"] = now
+            router._reps[addrs[1]]["load"] = {"srv_q": 50.0}
+        assert router._place() == addrs[1]
+        with router._mu:
+            assert router._reps[addrs[0]]["state"] == "stale"
+        # with NOTHING fresh, a stale (not draining/dead) replica is
+        # probed rather than refusing the whole fleet
+        with router._mu:
+            router._reps[addrs[1]]["last_seen"] = now - 1.0
+        assert router._place() in addrs
+    finally:
+        for e in eps:
+            e.stop()
+
+
+def test_draining_and_dead_replicas_excluded():
+    eps, router = _fleet(3)
+    try:
+        now = time.monotonic()
+        addrs = list(router._reps)
+        with router._mu:
+            for rep in router._reps.values():
+                rep["last_seen"] = now
+            router._set_state_locked(addrs[0], "draining")
+            router._set_state_locked(addrs[1], "dead")
+        for _ in range(4):
+            assert router._place() == addrs[2]
+        assert monitor.FLEET_REPLICA_STATE.value(
+            replica=addrs[1]) == 2.0
+    finally:
+        for e in eps:
+            e.stop()
+
+
+def test_serving_digest_freshness_gate():
+    """monitor.metrics_digest sheds srv_q/occ/slots/tps keys once the
+    scheduler liveness touch goes stale (satellite: freshness TTL)."""
+    import paddle_tpu.serving.scheduler as sched
+    old = sched.last_alive_wall
+    try:
+        sched.last_alive_wall = time.time()
+        assert monitor._serving_digest_fresh()
+        sched.last_alive_wall = time.time() - 1e4
+        assert not monitor._serving_digest_fresh()
+        assert "srv_q" not in monitor.metrics_digest()
+        sched.last_alive_wall = 0.0
+        assert not monitor._serving_digest_fresh()
+    finally:
+        sched.last_alive_wall = old
+
+
+def test_new_fault_sites_registered():
+    for site in ("serving.batch_dispatch", "router.forward",
+                 "coordinator.frame", "replica.heartbeat"):
+        assert site in resilience.KNOWN_SITES, site
+
+
+# ---------------------------------------------------------------------------
+# endpoint + router end-to-end (stub servers, real sockets)
+# ---------------------------------------------------------------------------
+
+def test_router_infer_end_to_end_and_ledger():
+    eps, router = _fleet(2)
+    router.start()
+    try:
+        out = router.infer("acme", {"x": [1.0, 2.0]})
+        assert np.asarray(out[0]).shape == (1, 1)
+        for _ in range(5):
+            router.infer("acme", {"x": [0.5]})
+        snap = router.snapshot()
+        assert snap["admitted"] == snap["completed"] == 6
+        assert snap["failed"] == snap["rejected"] == 0
+    finally:
+        router.stop()
+        for e in eps:
+            e.stop()
+
+
+def test_router_reroutes_around_dead_replica():
+    eps, router = _fleet(2)
+    try:
+        # both fresh; then one endpoint dies hard (socket closed)
+        now = time.monotonic()
+        with router._mu:
+            for rep in router._reps.values():
+                rep["last_seen"] = now
+        dead0 = monitor.FLEET_REROUTE_CTR.value(reason="dead")
+        eps[0].stop()
+        for i in range(6):
+            router.infer("acme", {"x": [float(i)]})
+        snap = router.snapshot()
+        assert snap["completed"] == 6 and snap["failed"] == 0
+        dead_addr = eps[0].address
+        assert snap["replicas"][dead_addr]["state"] == "dead"
+        assert monitor.FLEET_REROUTE_CTR.value(reason="dead") > dead0
+    finally:
+        router.stop()
+        for e in eps:
+            e.stop()
+
+
+def test_router_reroutes_around_draining_replica():
+    eps, router = _fleet(2, policy="round_robin")
+    try:
+        now = time.monotonic()
+        with router._mu:
+            for rep in router._reps.values():
+                rep["last_seen"] = now
+        drain0 = monitor.FLEET_REROUTE_CTR.value(reason="drain")
+        eps[0].server._draining.set()   # the SIGTERM guard path
+        for i in range(6):
+            router.infer("acme", {"x": [float(i)]})
+        snap = router.snapshot()
+        assert snap["completed"] == 6 and snap["failed"] == 0
+        assert snap["replicas"][eps[0].address]["state"] == "draining"
+        assert monitor.FLEET_REROUTE_CTR.value(
+            reason="drain") > drain0
+    finally:
+        router.stop()
+        for e in eps:
+            e.stop()
+
+
+def test_router_fleet_wide_quota():
+    """ONE admission decision at the router: a tenant's quota bounds
+    outstanding work across the whole fleet, not per replica."""
+    eps, router = _fleet(2, tenant_quota=1)
+    try:
+        slow = eps[0].server
+        slow.delay_s = 0.5
+        for e in eps:
+            e.server.delay_s = 0.5
+        now = time.monotonic()
+        with router._mu:
+            for rep in router._reps.values():
+                rep["last_seen"] = now
+        results = []
+
+        def go():
+            try:
+                router.infer("acme", {"x": [1.0]})
+                results.append("ok")
+            except AdmissionError:
+                results.append("quota")
+        threads = [threading.Thread(target=go) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results.count("ok") >= 1
+        assert results.count("quota") >= 1   # fleet-wide bound held
+    finally:
+        router.stop()
+        for e in eps:
+            e.stop()
+
+
+def test_router_fails_loud_when_whole_fleet_dead():
+    eps, router = _fleet(2, request_timeout_s=1.5)
+    try:
+        for e in eps:
+            e.stop()
+        with pytest.raises(FleetError):
+            router.infer("acme", {"x": [1.0]})
+        snap = router.snapshot()
+        assert snap["failed"] == 1 and snap["completed"] == 0
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# coordinator HA: replication, promotion, fencing, client failover
+# ---------------------------------------------------------------------------
+
+def _ha_pair(tmp_path, world=1, hb=0.3):
+    prim = GangCoordinator(world, port=0, heartbeat_timeout_s=hb,
+                           manifest_dir=str(tmp_path)).start()
+    sb = GangCoordinator(world, port=0, heartbeat_timeout_s=hb,
+                         manifest_dir=str(tmp_path),
+                         standby_of=prim.address).start()
+    return prim, sb
+
+
+def test_standby_mirrors_manifest_and_roles(tmp_path):
+    prim, sb = _ha_pair(tmp_path)
+    client = GangClient(address=f"{prim.address},{sb.address}", rank=0,
+                        world_size=1, heartbeat_interval_s=0.05,
+                        role="replica", endpoint="127.0.0.1:7").connect()
+    try:
+        client.publish(5)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            snap = sb.status_snapshot()
+            if snap["manifest"] == 5 and \
+                    snap["ranks"].get("0", {}).get("role") == "replica":
+                break
+            time.sleep(0.02)
+        snap = sb.status_snapshot()
+        assert snap["coord_role"] == "standby"
+        assert snap["manifest"] == 5
+        assert snap["ranks"]["0"]["role"] == "replica"
+        assert snap["ranks"]["0"]["endpoint"] == "127.0.0.1:7"
+    finally:
+        client.close(goodbye=False)
+        prim.stop()
+        sb.stop()
+
+
+def test_standby_refuses_mutations_until_promoted(tmp_path):
+    prim, sb = _ha_pair(tmp_path)
+    direct = GangClient(address=sb.address, rank=0, world_size=1,
+                        heartbeat_interval_s=0.05)
+    try:
+        # every mutating op is refused by the standby; the
+        # single-address client exhausts its redial budget fail-loud
+        with pytest.raises(ConnectionError, match="unreachable"):
+            direct.publish(1)
+    finally:
+        direct.close(goodbye=False)
+        prim.stop()
+        sb.stop()
+
+
+def test_promotion_epoch_fences_zombie_manifest_write(tmp_path):
+    prim, sb = _ha_pair(tmp_path)
+    addr = f"{prim.address},{sb.address}"
+    client = GangClient(address=addr, rank=0, world_size=1,
+                        heartbeat_interval_s=0.05).connect()
+    try:
+        client.publish(3)
+        prim.stop()
+        deadline = time.monotonic() + 5.0
+        while sb.status_snapshot()["coord_role"] != "primary":
+            assert time.monotonic() < deadline, "standby never promoted"
+            time.sleep(0.02)
+        assert sb.status_snapshot()["epoch"] >= 1
+        # client fails over transparently (bounded redial + rotation)
+        client.publish(7)
+        assert sb.status_snapshot()["manifest"] == 7
+        with open(tmp_path / "EPOCH") as f:
+            fence = int(f.read().strip())
+        assert fence >= 1
+        # the zombie primary (epoch 0) re-mirroring its stale manifest
+        # must be DROPPED by the durable fence
+        fenced0 = monitor.COORD_FENCED_CTR.value(path="manifest")
+        with prim._cv:
+            prim._manifest = 2          # older step, stale epoch
+        prim._mirror_manifest()
+        assert monitor.COORD_FENCED_CTR.value(
+            path="manifest") == fenced0 + 1
+        from paddle_tpu.distributed.env import parse_manifest
+        with open(tmp_path / "MANIFEST") as f:
+            assert parse_manifest(f.read()) == 7   # not regressed
+    finally:
+        client.close(goodbye=False)
+        prim.stop()
+        sb.stop()
+
+
+def test_frame_epoch_fences_stale_leader(tmp_path):
+    prim, _ = GangCoordinator(1, port=0, heartbeat_timeout_s=0.3,
+                              manifest_dir=str(tmp_path)).start(), None
+    try:
+        import socket as _s
+        from paddle_tpu.distributed.coordinator import (recv_frame,
+                                                        send_frame)
+        host, _, port = prim.address.rpartition(":")
+        with _s.create_connection((host, int(port)), timeout=5) as s:
+            # a request carrying a NEWER epoch proves a newer leader
+            # exists: this coordinator must refuse as fenced
+            send_frame(s, {"op": "status", "epoch": 99})
+            resp = recv_frame(s)
+        assert resp["ok"] is False and resp["error"] == "fenced"
+    finally:
+        prim.stop()
+
+
+def test_client_rotates_through_address_list():
+    coord = GangCoordinator(1, port=0, heartbeat_timeout_s=0.5).start()
+    try:
+        # dead first address: the bounded redial ladder rotates to the
+        # live one instead of failing loud on the first refusal
+        client = GangClient(address=f"127.0.0.1:1,{coord.address}",
+                            rank=0, world_size=1,
+                            heartbeat_interval_s=0.05)
+        client.connect()
+        assert client.wait_ready(timeout_s=5.0)
+    finally:
+        client.close(goodbye=False)
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos drills: the REAL topology (slow; ci.sh runs the fast subset)
+# ---------------------------------------------------------------------------
+
+def _run_smoke(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, _SMOKE, *args], env=env, timeout=600,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+@pytest.mark.slow
+def test_sigterm_drain_under_load_zero_failures():
+    """PR-18 satellite: SIGTERM one replica under load — the router
+    re-routes in-flight requests onto the survivor with zero
+    client-visible failures and an exactly-summing reason="drain"
+    ledger (asserted inside the drill)."""
+    r = _run_smoke("--scenario", "drain")
+    assert r.returncode == 0, r.stdout[-4000:]
+    assert "fleet drain OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_replica_sigkill_mid_request_zero_failures():
+    r = _run_smoke("--scenario", "kill")
+    assert r.returncode == 0, r.stdout[-4000:]
+    assert "fleet kill OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_coordinator_sigkill_failover_manifest_never_torn():
+    r = _run_smoke("--scenario", "coord")
+    assert r.returncode == 0, r.stdout[-4000:]
+    assert "fleet coord OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_full_kill_matrix_with_fault_injection():
+    r = _run_smoke("--full")
+    assert r.returncode == 0, r.stdout[-4000:]
+    assert "FLEET SMOKE PASS" in r.stdout

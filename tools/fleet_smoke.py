@@ -1,0 +1,464 @@
+#!/usr/bin/env python
+"""Fleet chaos drill (CI gate): a REAL 2-replica + router (+ gang
+coordinator with warm standby) topology under an open-loop client, with
+scripted kills — asserting the fleet drops nothing:
+
+1. ``drain``  — SIGTERM one replica mid-load: the replica's guard-path
+   drain finishes its in-flight work and exits 0; the router holds it
+   out of placement and re-routes (``reason="drain"``); the client sees
+   ZERO failures and the router ledger sums exactly
+   (completed == admitted, failed == rejected == 0).
+2. ``kill``   — SIGKILL one replica mid-request: in-flight idempotent
+   requests replay on the survivor (``reason="dead"`` re-routes ≥ 1),
+   zero client-visible failures, p99 bounded during the failover.
+3. ``coord``  — full topology (primary + standby coordinator, replicas
+   heart-beating as ``role=replica``, a rank-0 publisher committing
+   manifest steps): SIGKILL the PRIMARY coordinator mid-commit-loop.
+   The standby promotes (epoch-fenced), ranks and publisher fail over
+   with zero errors, serving traffic is untouched, and the durable
+   MANIFEST parses strictly at every instant (never torn) and never
+   regresses.
+
+``--full`` adds the fault-injection matrix on top: a torn router
+forward (``router.forward:once``) and a torn coordinator frame
+(``coordinator.frame:once@5``), each absorbed with exact
+injected/absorbed counter ledgers and zero client failures.
+
+Subprocess protocol: this file re-invokes itself with ``--role
+replica`` / ``--role coordinator``; children print ``READY <addr>`` on
+stdout once serving.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPLICA_BUCKETS = (32,)
+#: big enough that one request costs ~10ms+ on CPU — the drill needs a
+#: REAL drain window (requests in flight at SIGTERM) and a real
+#: failover window (requests in flight at SIGKILL), not a model so
+#: small every request completes before the kill signal propagates
+REPLICA_CFG = dict(vocab_size=64, d_model=64, n_layer=4, n_head=4,
+                   d_inner=256, max_pos=64, dropout=0.0)
+SEQ = 24
+HB_TIMEOUT = 0.3          # coordinator liveness + standby promotion clock
+
+
+# ---------------------------------------------------------------------------
+# child roles
+# ---------------------------------------------------------------------------
+
+def replica_main(args) -> int:
+    from serving_smoke import _build
+    from paddle_tpu.serving.fleet import ReplicaEndpoint
+    from paddle_tpu.serving.server import InferenceServer
+    cfg, scope, factory = _build(REPLICA_CFG)
+    srv = InferenceServer(factory, scope, buckets=REPLICA_BUCKETS,
+                          max_batch=4).start()
+    srv.warmup()
+    ep = ReplicaEndpoint(srv, port=args.port,
+                         replica_id=f"replica-{args.rank}").start()
+    client = None
+    if args.coord:
+        from paddle_tpu.distributed.coordinator import GangClient
+        client = GangClient(address=args.coord, rank=args.rank,
+                            world_size=args.world,
+                            heartbeat_interval_s=0.1, role="replica",
+                            endpoint=ep.address)
+        client.connect().start_heartbeat()
+    print(f"READY {ep.address}", flush=True)
+    # blocks until SIGTERM, then drains: exit 0 iff zero dropped
+    code = srv.serve_until_terminated(poll_s=0.02, drain_timeout_s=20.0)
+    if client is not None:
+        client.close()
+    ep.stop()
+    return code
+
+
+def coordinator_main(args) -> int:
+    from paddle_tpu.distributed.coordinator import GangCoordinator
+    coord = GangCoordinator(args.world, port=args.port,
+                            heartbeat_timeout_s=HB_TIMEOUT,
+                            manifest_dir=args.manifest_dir or None,
+                            standby_of=args.standby_of or None).start()
+    print(f"READY {coord.address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    signal.signal(signal.SIGINT, lambda s, f: stop.set())
+    stop.wait()
+    coord.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver plumbing
+# ---------------------------------------------------------------------------
+
+def _spawn(role: str, extra_args, env_extra=None):
+    """Start one child role; returns (proc, address) after READY."""
+    cmd = [sys.executable, "-u", __file__, "--role", role] + \
+        [str(a) for a in extra_args]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=sys.stderr, text=True)
+    deadline = time.monotonic() + 120.0
+    while True:
+        line = proc.stdout.readline()
+        if line.startswith("READY "):
+            return proc, line.split(None, 1)[1].strip()
+        if not line and proc.poll() is not None:
+            raise RuntimeError(f"{role} child died before READY "
+                               f"(exit {proc.returncode})")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"{role} child never became READY")
+
+
+def _wait_exit(proc, timeout_s=30.0) -> int:
+    try:
+        return proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+class OpenLoopLoad:
+    """N client threads firing inference at the router back-to-back
+    (small think time); records per-request latency and every error."""
+
+    def __init__(self, router, n_clients=6, think_s=0.005):
+        self.router = router
+        self.n_clients = n_clients
+        self.think_s = think_s
+        self._stop = threading.Event()
+        self._mu = threading.Lock()
+        self.latencies = []          # guarded-by: _mu
+        self.errors = []             # guarded-by: _mu
+        self._threads = []
+
+    def start(self):
+        for i in range(self.n_clients):
+            t = threading.Thread(target=self._client, args=(i,),
+                                 daemon=True, name=f"fleet-client-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _client(self, idx):
+        n = 0
+        while not self._stop.is_set():
+            feeds = {"src_ids": ((np.arange(SEQ) + idx + n) % 40)
+                     .astype("int64")}
+            t0 = time.perf_counter()
+            try:
+                self.router.infer(f"tenant{idx % 2}", feeds,
+                                  seq_len=SEQ, timeout_s=15.0)
+                with self._mu:
+                    self.latencies.append(time.perf_counter() - t0)
+            except Exception as e:
+                with self._mu:
+                    self.errors.append(repr(e))
+            n += 1
+            self._stop.wait(self.think_s)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=20.0)
+
+    def p99_ms(self) -> float:
+        with self._mu:
+            lats = sorted(self.latencies)
+        if not lats:
+            return 0.0
+        return lats[min(int(0.99 * len(lats)), len(lats) - 1)] * 1e3
+
+    def counts(self):
+        with self._mu:
+            return len(self.latencies), list(self.errors)
+
+
+def _ctr(counter, **labels) -> float:
+    try:
+        return float(counter.value(**labels))
+    except Exception:
+        return 0.0
+
+
+def _assert_ledger(router, load, scenario):
+    """completed == admitted exactly; zero failures anywhere."""
+    done, errors = load.counts()
+    snap = router.snapshot()
+    assert not errors, f"[{scenario}] client-visible failures: " \
+                       f"{errors[:5]} ({len(errors)} total)"
+    assert snap["failed"] == 0 and snap["rejected"] == 0, \
+        f"[{scenario}] router ledger has failures: {snap}"
+    assert snap["completed"] == snap["admitted"] == done, \
+        f"[{scenario}] ledger does not sum: admitted=" \
+        f"{snap['admitted']} completed={snap['completed']} " \
+        f"client-done={done}"
+    return done, snap
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_drain(full=False):
+    """SIGTERM one replica under load: zero failures, drain re-routes,
+    drained replica exits 0."""
+    from paddle_tpu import monitor as M
+    from paddle_tpu.serving.fleet import FleetRouter
+    drain0 = _ctr(M.FLEET_REROUTE_CTR, reason="drain")
+    r0, a0 = _spawn("replica", ["--rank", 0])
+    r1, a1 = _spawn("replica", ["--rank", 1])
+    # round_robin: placement keeps offering the SIGTERM'd replica until
+    # its draining refusal comes back, so the reason="drain" re-route
+    # ledger is deterministic (least_loaded would steer traffic away
+    # from the drained replica's non-empty queue before it ever refuses)
+    router = FleetRouter([a0, a1], policy="round_robin",
+                         digest_ttl_s=1.0).start()
+    load = OpenLoopLoad(router).start()
+    try:
+        time.sleep(1.5)               # both replicas take traffic
+        r0.send_signal(signal.SIGTERM)
+        time.sleep(2.5)               # drain + re-routed load
+        load.stop()
+        code = _wait_exit(r0)
+        assert code == 0, f"[drain] SIGTERM'd replica exited {code} " \
+                          "(dropped in-flight work)"
+        done, snap = _assert_ledger(router, load, "drain")
+        drains = _ctr(M.FLEET_REROUTE_CTR, reason="drain") - drain0
+        assert drains >= 1, "[drain] no drain re-route was recorded"
+        states = {a: r["state"] for a, r in snap["replicas"].items()}
+        print(f"fleet drain OK: {done} requests, 0 failed, "
+              f"{drains:.0f} drain re-route(s), replica exit 0, "
+              f"states={states}")
+    finally:
+        load.stop()
+        router.stop()
+        _kill_all([r0, r1])
+
+
+def scenario_kill(full=False, inject_forward=False):
+    """SIGKILL one replica mid-request: in-flight requests replay on
+    the survivor, zero failures, p99 bounded."""
+    from paddle_tpu import monitor as M
+    from paddle_tpu import resilience as R
+    from paddle_tpu.serving.fleet import FleetRouter
+    dead0 = _ctr(M.FLEET_REROUTE_CTR, reason="dead")
+    fault0 = _ctr(R._FAULT_CTR, site="router.forward")
+    if inject_forward:
+        from paddle_tpu.flags import set_flags
+        set_flags({"FLAGS_fault_inject": "router.forward:once"})
+    r0, a0 = _spawn("replica", ["--rank", 0])
+    r1, a1 = _spawn("replica", ["--rank", 1])
+    router = FleetRouter([a0, a1], digest_ttl_s=1.0).start()
+    load = OpenLoopLoad(router).start()
+    name = "kill+inject" if inject_forward else "kill"
+    try:
+        time.sleep(1.5)
+        r0.kill()                     # SIGKILL mid-request
+        time.sleep(2.5)
+        load.stop()
+        done, snap = _assert_ledger(router, load, name)
+        deads = _ctr(M.FLEET_REROUTE_CTR, reason="dead") - dead0
+        assert deads >= 1, f"[{name}] no dead re-route was recorded"
+        p99 = load.p99_ms()
+        assert p99 < 10000.0, f"[{name}] p99 unbounded: {p99:.0f}ms"
+        if inject_forward:
+            faults = _ctr(R._FAULT_CTR, site="router.forward") - fault0
+            assert faults == 1, f"[{name}] injected ledger: {faults}"
+        print(f"fleet {name} OK: {done} requests, 0 failed, "
+              f"{deads:.0f} dead re-route(s), p99 {p99:.0f}ms")
+    finally:
+        if inject_forward:
+            from paddle_tpu.flags import set_flags
+            set_flags({"FLAGS_fault_inject": ""})
+        load.stop()
+        router.stop()
+        _kill_all([r0, r1])
+
+
+def scenario_coord(full=False, inject_frame=False):
+    """SIGKILL the primary coordinator mid-commit-loop: the standby
+    promotes epoch-fenced, publisher + replicas fail over with zero
+    errors, serving traffic untouched, MANIFEST never torn."""
+    import tempfile
+    from paddle_tpu import monitor as M
+    from paddle_tpu.distributed.coordinator import GangClient
+    from paddle_tpu.distributed.env import parse_manifest
+    from paddle_tpu.serving.fleet import FleetRouter
+    from gangtop import fetch_status
+
+    mdir = tempfile.mkdtemp(prefix="pt_fleet_gang_")
+    world = 3                         # rank 0 publisher + 2 replicas
+    env_extra = ({"FLAGS_fault_inject": "coordinator.frame:once@5"}
+                 if inject_frame else None)
+    prim, prim_addr = _spawn(
+        "coordinator", ["--world", world, "--manifest_dir", mdir],
+        env_extra=env_extra)
+    stand, stand_addr = _spawn(
+        "coordinator", ["--world", world, "--manifest_dir", mdir,
+                        "--standby_of", prim_addr])
+    coord_addr = f"{prim_addr},{stand_addr}"
+    r0, a0 = _spawn("replica", ["--rank", 1, "--world", world,
+                                "--coord", coord_addr])
+    r1, a1 = _spawn("replica", ["--rank", 2, "--world", world,
+                                "--coord", coord_addr])
+    router = FleetRouter([a0, a1], digest_ttl_s=1.0).start()
+    load = OpenLoopLoad(router).start()
+    name = "coord+inject" if inject_frame else "coord"
+
+    pub = GangClient(address=coord_addr, rank=0, world_size=world,
+                     heartbeat_interval_s=0.1).connect().start_heartbeat()
+    pub_errors, published = [], [0]
+    torn, regressed = [], []
+    stop = threading.Event()
+
+    def publisher():
+        step = 0
+        while not stop.is_set():
+            step += 1
+            try:
+                pub.publish(step)
+                published[0] = step
+            except Exception as e:
+                pub_errors.append(repr(e))
+            stop.wait(0.05)
+
+    def manifest_watch():
+        """The torn-manifest probe: at EVERY instant the durable file
+        either does not exist yet or parses strictly, and the step
+        never regresses across the failover."""
+        last = 0
+        path = os.path.join(mdir, "MANIFEST")
+        while not stop.is_set():
+            time.sleep(0.002)
+            try:
+                with open(path) as f:
+                    text = f.read()
+            except OSError:
+                continue
+            try:
+                step = parse_manifest(text)
+            except ValueError as e:
+                torn.append(f"torn manifest: {e!r} text={text!r}")
+                continue
+            if step is not None:
+                if step < last:
+                    regressed.append((last, step))
+                last = step
+
+    threads = [threading.Thread(target=publisher, daemon=True),
+               threading.Thread(target=manifest_watch, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(1.5)               # commits + heartbeats flowing
+        prim.kill()                   # SIGKILL mid-commit-loop
+        time.sleep(4.0)               # promotion + post-failover load
+        stop.set()
+        load.stop()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not pub_errors, f"[{name}] publisher failures " \
+            f"across failover: {pub_errors[:3]}"
+        assert not torn, f"[{name}] {torn[:2]}"
+        assert not regressed, f"[{name}] manifest regressed: {regressed}"
+        done, snap = _assert_ledger(router, load, name)
+        st = fetch_status(stand_addr)
+        assert st.get("coord_role") == "primary", \
+            f"[{name}] standby never promoted: {st.get('coord_role')}"
+        assert int(st.get("epoch", 0)) >= 1, \
+            f"[{name}] promotion without epoch bump: {st.get('epoch')}"
+        assert int(st.get("manifest") or 0) >= published[0] - 1, \
+            f"[{name}] manifest lost commits: {st.get('manifest')} " \
+            f"vs published {published[0]}"
+        with open(os.path.join(mdir, "EPOCH")) as f:
+            fence = int(f.read().strip())
+        assert fence >= 1, f"[{name}] EPOCH fence not stamped: {fence}"
+        roles = {r: e.get("role") for r, e in st["ranks"].items()}
+        alive = all(e["alive"] or e["finished"]
+                    for r, e in st["ranks"].items()
+                    if roles.get(r) == "replica")
+        assert alive, f"[{name}] replicas lost after failover: " \
+                      f"{st['ranks']}"
+        print(f"fleet {name} OK: {done} requests 0 failed, "
+              f"{published[0]} steps published 0 errors, standby "
+              f"promoted epoch={st['epoch']}, manifest "
+              f"{st.get('manifest')} never torn, roles={roles}")
+    finally:
+        stop.set()
+        load.stop()
+        router.stop()
+        try:
+            pub.close(goodbye=False)
+        except Exception:
+            pass
+        _kill_all([prim, stand, r0, r1])
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=("driver", "replica",
+                                       "coordinator"), default="driver")
+    ap.add_argument("--scenario", choices=("drain", "kill", "coord"),
+                    default=None, help="run one scenario (driver)")
+    ap.add_argument("--full", action="store_true",
+                    help="run the full kill matrix incl. fault "
+                         "injection (slow)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--world", type=int, default=1)
+    ap.add_argument("--coord", default="")
+    ap.add_argument("--manifest_dir", default="")
+    ap.add_argument("--standby_of", default="")
+    args = ap.parse_args(argv)
+    if args.role == "replica":
+        return replica_main(args)
+    if args.role == "coordinator":
+        return coordinator_main(args)
+    scenarios = {"drain": scenario_drain, "kill": scenario_kill,
+                 "coord": scenario_coord}
+    if args.scenario:
+        scenarios[args.scenario](full=args.full)
+    else:
+        scenario_drain(full=args.full)
+        scenario_kill(full=args.full)
+        scenario_coord(full=args.full)
+        if args.full:
+            scenario_kill(full=True, inject_forward=True)
+            scenario_coord(full=True, inject_frame=True)
+    print("FLEET SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

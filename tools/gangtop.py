@@ -111,7 +111,8 @@ def comm_bound(digest: dict) -> bool:
 def render(status: dict) -> str:
     ranks = status.get("ranks", {})
     rows = []
-    header = (f"{'RANK':>4}  {'STATE':<8} {'STEP':>8} {'SAVED':>7} "
+    header = (f"{'RANK':>4}  {'STATE':<8} {'ROLE':<8} "
+              f"{'STEP':>8} {'SAVED':>7} "
               f"{'STEP_MS':>9} {'MFU%':>6} {'MFU_M%':>6} "
               f"{'HBM':>8} {'HDRM%':>6} "
               f"{'COMM':>7} {'BW%':>6} "
@@ -141,7 +142,9 @@ def render(status: dict) -> str:
         bw = d.get("comm_bw")
         hbm = d.get("hbm")
         hfrac = hdrm_frac(d)
-        line = (f"{r:>4}  {state:<8} {_fmt(e.get('cur_step'), '{}'):>8} "
+        line = (f"{r:>4}  {state:<8} "
+                f"{str(e.get('role') or 'trainer')[:8]:<8} "
+                f"{_fmt(e.get('cur_step'), '{}'):>8} "
                 f"{_fmt(e.get('step'), '{}'):>7} "
                 f"{_fmt(d.get('step_ms')):>9} "
                 f"{_fmt(mfu * 100 if isinstance(mfu, (int, float)) else None):>6} "
@@ -178,7 +181,17 @@ def render(status: dict) -> str:
     rows.append(f"gang: {status.get('status', '?')}"
                 f"  dead={status.get('dead', [])}"
                 f"  step_skew={_fmt(agg.get('step_skew'), '{}')}"
-                f"  manifest={status.get('manifest')}")
+                f"  manifest={status.get('manifest')}"
+                f"  coord={status.get('coord_role', 'primary')}"
+                f"/epoch={status.get('epoch', 0)}")
+    # a non-zero epoch means the serving coordinator answering this
+    # status is a PROMOTED standby (or a chain of failovers): flag it —
+    # the degraded-mode runbook (README "Fleet") starts here
+    if int(status.get("epoch") or 0) >= 1:
+        rows.append(f"COORD FAILOVER: epoch {status['epoch']} — a warm "
+                    "standby promoted after primary heartbeat loss "
+                    "(manifest epoch-fenced; zombie primary writes are "
+                    "dropped)")
     # mixed GSPMD rule tables among live ranks: the next step barrier
     # WILL refuse — flag it now, while the gang still renders healthy
     tables = agg.get("gspmd_rule_tables") or []
