@@ -1319,6 +1319,58 @@ def bench_serving(dev, on_tpu, peak):
     dsrv.stop()
 
 
+def bench_serving_fleet(dev, on_tpu, peak):
+    """``serving_fleet`` line: the self-driving-fleet trajectory metric
+    — a real router + subprocess-replica topology under the closed-loop
+    autoscaler.  ``value`` is the aggregate 2-replica QPS; the ride-along
+    keys are the tail the fleet controls: p99 while the autoscaler
+    absorbs a 24-client spike (spawning the second replica), p99 under a
+    replica SIGKILL (death repair + idempotent replay), and the
+    calibrated SLO objective both are judged against.  A regression that
+    makes scale-up slower or failover lossier moves these numbers — the
+    assertion-level contract lives in the tools/fleet_smoke.py scale
+    drill (tests/test_autoscaler.py runs it slow-marked).
+
+    Subprocess like comms/gspmd: the replicas are real processes (the
+    spawn/retire actuators need something to SIGTERM), one measurement
+    path for CI and bench."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+              "PADDLE_GANG_COORD", "PADDLE_GANG_DIR",
+              "FLAGS_fault_inject"):
+        env.pop(k, None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "fleet_smoke.py"), "--bench"],
+        env=env, capture_output=True, text=True, timeout=900)
+    rec = None
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("FLEET BENCH "):
+            rec = json.loads(line[len("FLEET BENCH "):])
+    if r.returncode != 0 or rec is None:
+        raise RuntimeError(
+            f"fleet bench child failed rc={r.returncode}: "
+            f"{(r.stderr or r.stdout or '')[-300:]}")
+    emit({
+        "metric": "serving_fleet",
+        "value": rec["aggregate_qps"],
+        "unit": "req/s aggregate",
+        "vs_baseline": 0,             # trajectory metric, no BASELINE
+        "p99_spike_ms": rec["p99_spike_ms"],
+        "p99_kill_ms": rec["p99_kill_ms"],
+        "slo_p99_ms": rec["slo_p99_ms"],
+        "replicas": rec["replicas"],
+        "device": str(dev),
+        "note": ("2-subprocess-replica fleet under the autoscaler; "
+                 "p99_spike is the tail while the controller spawns the "
+                 "second replica, p99_kill the tail through a SIGKILL "
+                 "death repair"),
+    })
+
+
 def _setup_compile_cache():
     """Persistent XLA compile cache (ROADMAP open item): first-compile of
     a big train step is 20-40 s; a workspace-local disk cache removes it
@@ -1510,6 +1562,9 @@ def main(argv=None):
         ("gpt_causal", lambda: bench_gpt_causal(dev, on_tpu, peak)),
         # serving plane: p50/p99 + sustained QPS next to the MFU lines
         ("serving", lambda: bench_serving(dev, on_tpu, peak)),
+        # fleet plane: aggregate QPS + tail under autoscaler-absorbed
+        # spike and replica-kill failover (subprocess topology)
+        ("serving_fleet", lambda: bench_serving_fleet(dev, on_tpu, peak)),
         ("bert_masked", lambda: bench_bert_masked(dev, on_tpu, peak)),
         # flagship metric printed last among the verbose lines
         ("bert", lambda: bench_bert(dev, on_tpu, peak)),

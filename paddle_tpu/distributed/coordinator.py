@@ -225,6 +225,10 @@ class GangCoordinator:
         #: start_metrics_http) — stopped with the coordinator
         self._metrics_http = None
         self._mismatch: Optional[dict] = None   # guarded-by: _cv
+        #: pluggable status sections (attach_status_section): name ->
+        #: zero-arg callable whose snapshot rides status_snapshot() —
+        #: how the fleet autoscaler's TGT/SIZE view reaches gangtop
+        self._status_sections: Dict[str, Any] = {}  # guarded-by: _cv
         self._stopping = False                  # guarded-by: _cv
         self._conns: List[socket.socket] = []   # guarded-by: _cv
         self._mirror_mu = threading.Lock()      # manifest-file writes
@@ -1270,11 +1274,29 @@ class GangCoordinator:
                               "age_s": round(
                                   time.monotonic() - e["last_hb"], 3)}
                      for r, e in self._ranks.items()}
-            return {"ranks": ranks,
-                    "aggregates": self._aggregates_locked(),
-                    "epoch": self._epoch,
-                    "coord_role": self._role,
-                    **self._gang_view_locked()}
+            out = {"ranks": ranks,
+                   "aggregates": self._aggregates_locked(),
+                   "epoch": self._epoch,
+                   "coord_role": self._role,
+                   **self._gang_view_locked()}
+            sections = dict(self._status_sections)
+        # section callables run OUTSIDE _cv: they take their own locks
+        # (the autoscaler's status() does), and a status scrape must
+        # never be able to deadlock the coordination plane
+        for name, fn in sections.items():
+            try:
+                out[name] = fn()
+            except Exception as e:   # a broken section must not break
+                out[name] = {"error": repr(e)[:200]}   # the whole view
+        return out
+
+    def attach_status_section(self, name: str, fn) -> None:
+        """Register a zero-arg callable whose dict snapshot appears as
+        ``name`` in every ``status_snapshot()`` (and hence the status
+        socket op, ``/statusz``, and gangtop).  Re-attaching a name
+        replaces it; the fleet autoscaler attaches as ``autoscaler``."""
+        with self._cv:
+            self._status_sections[str(name)] = fn
 
     def _op_status(self, req: dict) -> dict:
         return {"ok": True, **self.status_snapshot()}

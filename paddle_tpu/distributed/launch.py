@@ -125,11 +125,22 @@ def _standby_enabled(args) -> bool:
         return False
 
 
+def standby_node(node_ips) -> str:
+    """Cross-node standby placement (pure — the unit-tested decision):
+    the warm standby must not share the primary's failure domain, so it
+    lands on node 1 whenever the cluster HAS a second node; a
+    single-node cluster keeps it next to the primary (the pre-cross-node
+    behavior, still useful against process death)."""
+    node_ips = list(node_ips)
+    return node_ips[1] if len(node_ips) > 1 else node_ips[0]
+
+
 def gang_standby_address(args) -> str:
-    """The warm standby's endpoint: one port above the primary (same
-    derivable-everywhere property)."""
+    """The warm standby's endpoint: one port above the primary, hosted
+    on ``standby_node`` (same derivable-everywhere property — every
+    launcher computes the same address with no cross-node exchange)."""
     node_ips, world = _cluster_shape(args)
-    return f"{node_ips[0]}:{args.started_port + world + 1}"
+    return f"{standby_node(node_ips)}:{args.started_port + world + 1}"
 
 
 def _resolve_gang_dir(args) -> str:
@@ -191,30 +202,40 @@ def get_cluster_env(args):
 
 
 def start_coordinator(args):
-    """Host the gang coordinator on the node-0 launcher (socket backend,
-    multi-rank jobs only).  Returns the list of started coordinators
-    (primary first, then the warm standby when ``--coordinator_standby``)
-    — empty when this launcher hosts none.  The launcher is the natural
-    host: it outlives every rank, so rank death, respawn, and the rejoin
-    barrier all survive any trainer process dying."""
+    """Host this node's share of the gang coordination plane (socket
+    backend, multi-rank jobs only).  The node-0 launcher hosts the
+    primary; the ``standby_node`` launcher (node 1 on multi-node
+    clusters — cross-node placement, so the standby survives the
+    primary's whole node dying; node 0 itself when single-node) hosts
+    the warm standby.  Returns the list of coordinators THIS launcher
+    started — possibly empty.  The launcher is the natural host: it
+    outlives every rank, so rank death, respawn, and the rejoin barrier
+    all survive any trainer process dying."""
     node_ips, world = _cluster_shape(args)
-    if args.gang_backend != "socket" or world <= 1 \
-            or node_ips.index(args.node_ip) != 0:
+    if args.gang_backend != "socket" or world <= 1:
         return []
     from .coordinator import GangCoordinator
-    host, _, port = gang_coord_address(args).rpartition(":")
-    coord = GangCoordinator(world, host=host, port=int(port),
-                            manifest_dir=_resolve_gang_dir(args)).start()
-    coords = [coord]
-    if _standby_enabled(args):
+    coords = []
+    if node_ips.index(args.node_ip) == 0:
+        host, _, port = gang_coord_address(args).rpartition(":")
+        coords.append(GangCoordinator(
+            world, host=host, port=int(port),
+            manifest_dir=_resolve_gang_dir(args)).start())
+    if _standby_enabled(args) and args.node_ip == standby_node(node_ips):
         sb_host, _, sb_port = gang_standby_address(args).rpartition(":")
         # same manifest_dir: the standby's promotion path re-reads the
         # durable MANIFEST so replication lag can never regress it, and
-        # its EPOCH fence token lands where the zombie primary looks
+        # its EPOCH fence token lands where the zombie primary looks.
+        # (Multi-node jobs need --gang_dir on shared storage for the
+        # mirror to be shared — the same rule the file backend has.)
+        # standby_of is the DERIVED primary address: on a multi-node
+        # cluster this launcher never constructed the primary object.
         coords.append(GangCoordinator(
             world, host=sb_host, port=int(sb_port),
             manifest_dir=_resolve_gang_dir(args),
-            standby_of=coord.address).start())
+            standby_of=gang_coord_address(args)).start())
+    if not coords:
+        return []
     # FLAGS_coordinator_metrics_port: the launcher's process registry
     # holds the whole gang's per-rank digest gauges (the coordinator
     # folds every heartbeat into it), so serving /metrics + /statusz
@@ -227,7 +248,7 @@ def start_coordinator(args):
                         "FLAGS_metrics_host"])
         mport = int(fl["FLAGS_coordinator_metrics_port"])
         if mport:
-            srv = coord.start_metrics_http(
+            srv = coords[0].start_metrics_http(
                 mport, host=str(fl["FLAGS_metrics_host"]))
             sys.stderr.write(
                 f"paddle_tpu launch: coordinator metrics at "
@@ -343,6 +364,87 @@ def wait_procs(procs, grace_secs: float = 60.0, stop=None, args=None,
     except KeyboardInterrupt:
         ok = drain_gang(procs, grace_secs)
         raise SystemExit(0 if ok else 1) from None
+
+
+class ReplicaLauncher:
+    """The ``--max_restarts`` respawn machinery generalized into a
+    target-size actuator for the fleet autoscaler: ``spawn()`` starts
+    one serving-replica process and blocks until it prints its
+    ``READY <host:port>`` line; ``retire(addr)`` SIGTERMs it — the
+    replica's guard path drains its in-flight work (the PR-18 drain
+    contract, never a kill) — and SIGKILLs only a straggler still alive
+    past ``grace_secs``.
+
+    The command is re-invoked verbatim per spawn; each child inherits
+    ``env`` over the parent's.  The READY protocol is the same one
+    ``tools/fleet_smoke.py`` children speak, so the autoscaler drill
+    exercises this exact path.
+    """
+
+    def __init__(self, cmd, env=None, grace_secs: float = 30.0,
+                 ready_timeout_s: float = 120.0):
+        self.cmd = list(cmd)
+        self.env = dict(env or {})
+        self.grace_secs = float(grace_secs)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self._procs = {}    # addr -> subprocess.Popen
+
+    def spawn(self) -> str:
+        """Start one replica; returns its address.  Raises
+        ``RuntimeError`` when the child dies or stays silent past
+        ``ready_timeout_s`` (the autoscaler turns that into backoff +
+        re-shed, never a crash)."""
+        proc = subprocess.Popen(
+            self.cmd, env=dict(os.environ, **self.env),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        deadline = time.monotonic() + self.ready_timeout_s
+        addr = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break                      # child closed stdout / died
+            line = line.strip()
+            if line.startswith("READY "):
+                addr = line.split(None, 1)[1]
+                break
+        if addr is None:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+            raise RuntimeError(
+                f"replica spawn failed: no READY line (exit "
+                f"{proc.returncode})")
+        self._procs[addr] = proc
+        return addr
+
+    def retire(self, addr: str) -> int:
+        """Drain-then-stop the replica at ``addr``; returns its exit
+        code (0 = the drain finished every in-flight request)."""
+        proc = self._procs.pop(str(addr), None)
+        if proc is None:
+            raise KeyError(f"no spawned replica at {addr!r}")
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            deadline = time.monotonic() + self.grace_secs
+            while time.monotonic() < deadline and proc.poll() is None:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()
+        return proc.wait()
+
+    def alive(self):
+        """Addresses of spawned replicas whose process is still up."""
+        return [a for a, p in self._procs.items() if p.poll() is None]
+
+    def stop_all(self, grace_secs=None) -> None:
+        """Teardown: retire every spawned replica (best effort)."""
+        if grace_secs is not None:
+            self.grace_secs = float(grace_secs)
+        for addr in list(self._procs):
+            try:
+                self.retire(addr)
+            except Exception:
+                pass
 
 
 def launch(argv=None):

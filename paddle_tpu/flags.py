@@ -288,8 +288,57 @@ _DEFAULTS: Dict[str, Any] = {
     # coordinator high availability: the launcher also starts a warm
     # standby coordinator (primary port + 1) mirroring manifest +
     # durable announcements over the replicated log, and exports a
-    # two-address PADDLE_GANG_COORD so clients fail over to it.
+    # two-address PADDLE_GANG_COORD so clients fail over to it.  When
+    # the cluster has a second node, the STANDBY's launcher is node 1
+    # (cross-node placement — the standby must not share the primary's
+    # failure domain); single-node clusters keep both on node 0.
     "FLAGS_coordinator_standby": False,
+    # -- fleet autoscaler (serving.autoscaler) -----------------------------
+    # closed-loop target-size policy: the controller keeps the live
+    # replica count inside [min, max].  min == max pins a static fleet
+    # size (the controller still repairs deaths and runs the
+    # degradation ladder, but never scales).  min must be >= 1 and
+    # <= max (validated as an effective pair).
+    "FLAGS_fleet_min_replicas": 1,
+    "FLAGS_fleet_max_replicas": 4,
+    # controller tick cadence — every decision (scale, shed, shrink)
+    # is re-evaluated at this interval; the *_ticks knobs below are
+    # counted in units of it.  Must be > 0.
+    "FLAGS_fleet_scale_eval_interval_s": 2.0,
+    # hysteresis: how many CONSECUTIVE ticks the scale-up condition
+    # (fleet SLO burn breached on both windows AND mean queue depth >=
+    # queue_high) / the scale-down condition (no breach, queue empty,
+    # per-replica completion rate under idle_qps) must hold before the
+    # target moves — a one-tick blip never scales the fleet
+    "FLAGS_fleet_scale_up_ticks": 2,
+    "FLAGS_fleet_scale_down_ticks": 5,
+    # post-decision cooldown: after ANY target change the controller
+    # refuses further target changes this long (death repair is exempt
+    # — restoring a SIGKILLed replica is not a flap).  Must be >= 0.
+    "FLAGS_fleet_scale_cooldown_s": 30.0,
+    # scale-up pressure floor: mean srv_q across live replicas that
+    # (together with SLO breach) counts as sustained queue pressure
+    "FLAGS_fleet_queue_high": 4.0,
+    # scale-down idle floor: a fleet whose per-replica completion rate
+    # (req/s) stays under this while queues are empty is idle enough
+    # to drain-and-retire one replica (down to min_replicas)
+    "FLAGS_fleet_idle_qps": 0.5,
+    # shed-vs-scale arbitration: how many consecutive breached ticks
+    # before admission shedding engages (only while a spawn is in
+    # flight or the fleet is already at max_replicas, and only when
+    # FLAGS_serving_slo_shed is on — shedding is a policy decision)
+    "FLAGS_fleet_shed_after_ticks": 2,
+    # degradation ladder: a replica reporting HBM headroom below this
+    # fraction (the PR-15 OOM-risk signal) gets a bucket-width shrink
+    # control op before any global action; must be in [0, 1)
+    "FLAGS_fleet_oom_headroom_frac": 0.10,
+    # ladder escalation: ticks a replica may stay at OOM risk AFTER its
+    # shrink before the controller drains and respawns it fresh
+    "FLAGS_fleet_shrink_grace_ticks": 3,
+    # spawn-failure backoff: after a failed spawn the controller waits
+    # this long before retrying (shedding stays engaged meanwhile —
+    # the failure must re-shed, never crash the loop).  Must be >= 0.
+    "FLAGS_fleet_spawn_backoff_s": 10.0,
     # -- numerics observability plane (analysis.numerics) ------------------
     # in-graph tensor-health statistics folded into one packed output per
     # lowered step: "off" (default, zero cost), "sentinel" (NaN/Inf
@@ -533,6 +582,26 @@ def set_flags(flags: Dict[str, Any]):
             raise ValueError(
                 "FLAGS_fleet_digest_ttl_s must be > 0 (a zero/negative "
                 f"TTL would blind placement), got {coerced[name]!r}")
+        if name == "FLAGS_fleet_scale_eval_interval_s" and \
+                coerced[name] <= 0:
+            raise ValueError(
+                "FLAGS_fleet_scale_eval_interval_s must be > 0, got "
+                f"{coerced[name]!r}")
+        if name in ("FLAGS_fleet_scale_cooldown_s",
+                    "FLAGS_fleet_spawn_backoff_s",
+                    "FLAGS_fleet_queue_high",
+                    "FLAGS_fleet_idle_qps") and coerced[name] < 0:
+            raise ValueError(f"{name} must be >= 0, got {coerced[name]!r}")
+        if name in ("FLAGS_fleet_scale_up_ticks",
+                    "FLAGS_fleet_scale_down_ticks",
+                    "FLAGS_fleet_shed_after_ticks",
+                    "FLAGS_fleet_shrink_grace_ticks") and coerced[name] < 1:
+            raise ValueError(f"{name} must be >= 1, got {coerced[name]!r}")
+        if name == "FLAGS_fleet_oom_headroom_frac" and \
+                not 0 <= coerced[name] < 1:
+            raise ValueError(
+                "FLAGS_fleet_oom_headroom_frac must be in [0, 1), got "
+                f"{coerced[name]!r}")
         if name == "FLAGS_gspmd_rules" and coerced[name] != "auto":
             from .parallel.partitioner import rule_table
             rule_table(coerced[name])   # raises on unknown table name
@@ -554,6 +623,18 @@ def set_flags(flags: Dict[str, Any]):
             raise ValueError(
                 "FLAGS_serving_slo_burn_threshold must be > 0 (got "
                 f"{eff['FLAGS_serving_slo_burn_threshold']})")
+    fleet_size = ("FLAGS_fleet_min_replicas", "FLAGS_fleet_max_replicas")
+    if any(n in coerced for n in fleet_size):
+        # same effective-pair discipline: the bounds the controller will
+        # actually run with (new values merged over current) must form a
+        # sane interval, refused here rather than at controller start
+        eff = {n: int(coerced.get(n, _values[n])) for n in fleet_size}
+        lo = eff["FLAGS_fleet_min_replicas"]
+        hi = eff["FLAGS_fleet_max_replicas"]
+        if not 1 <= lo <= hi:
+            raise ValueError(
+                "fleet size bounds must satisfy 1 <= min <= max (got "
+                f"min={lo}, max={hi})")
     for name, value in coerced.items():
         _values[name] = value
         _apply_side_effects(name, value)

@@ -552,6 +552,41 @@ COORD_FAILOVER_CTR = REGISTRY.counter(
     "paddle_tpu_coordinator_failovers_total",
     "standby-to-primary promotions performed by this process")
 
+# -- fleet autoscaler (this PR): the closed-loop controller's decision
+# ledger.  Every target change is exactly one count here (spawn retries
+# after a failed launch do NOT recount — the chaos drill asserts the
+# ledger is oscillation-free), so dir=up{reason=burn_queue} after a load
+# spike reads exactly 1.
+FLEET_SCALE_CTR = REGISTRY.counter(
+    "paddle_tpu_fleet_scale_total",
+    "autoscaler scale decisions, by direction and reason (up/burn_queue "
+    "= sustained SLO burn + queue pressure raised the target; up/death "
+    "= a dead replica is being replaced to restore the target; "
+    "up/oom = a replica that kept breaching headroom after its bucket "
+    "shrink is being respawned fresh; down/idle = sustained idle "
+    "drained-and-retired one) — counted once per decision, never per "
+    "spawn attempt", ("dir", "reason"))
+FLEET_TARGET_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_fleet_target_replicas",
+    "the autoscaler's current target fleet size (clamped to "
+    "[FLAGS_fleet_min_replicas, FLAGS_fleet_max_replicas])")
+FLEET_SIZE_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_fleet_live_replicas",
+    "replicas the router currently counts as placeable (up or stale — "
+    "draining and dead replicas are out); TGT vs SIZE is the gangtop "
+    "footer")
+FLEET_SHED_GAUGE = REGISTRY.gauge(
+    "paddle_tpu_fleet_shedding",
+    "1 while the autoscaler has engaged fleet-wide admission shedding "
+    "(SLO breach sustained past FLAGS_fleet_shed_after_ticks with a "
+    "spawn in flight or the fleet at max), else 0")
+FLEET_SHRINK_CTR = REGISTRY.counter(
+    "paddle_tpu_fleet_width_shrinks_total",
+    "bucket-width shrink control ops the autoscaler sent to replicas "
+    "reporting HBM headroom under FLAGS_fleet_oom_headroom_frac (the "
+    "degradation ladder's first rung; the replica is named in the "
+    "autoscaler.shrink trace instant)")
+
 
 def metrics_digest() -> Dict[str, Any]:
     """Compact snapshot of THIS rank's runtime health for the gang

@@ -219,6 +219,16 @@ KNOWN_SITES = (
     "router.forward",
     "coordinator.frame",
     "replica.heartbeat",
+    # autoscaler control plane (tools/fleet_smoke.py --scenario scale):
+    # decide fires at the top of every controller tick (an injected
+    # fault skips the tick, never kills the loop); spawn fires inside
+    # the spawn worker before the launcher runs (the controller must
+    # back off and re-shed); retire fires before the drain-path retire
+    # (the un-SIGTERM'd replica self-heals back to "up" on its next
+    # reply)
+    "autoscaler.decide",
+    "autoscaler.spawn",
+    "autoscaler.retire",
 )
 
 _ONCE_RE = re.compile(r"^once(?:@(?:step)?(\d+))?$")

@@ -28,6 +28,11 @@ latency-governed multi-tenant request path:
   fronts one server over the gang frame protocol, ``FleetRouter``
   places each request on the least-loaded fresh replica and re-routes
   around drains, deaths, and open breakers (README "Fleet").
+- :mod:`autoscaler` — the closed loop that makes the fleet
+  self-driving: SLO burn + queue pressure spawn replicas through the
+  launcher, sustained idle drains-then-retires, OOM-risk headroom runs
+  the per-replica degradation ladder, and breach hysteresis arbitrates
+  shed-vs-scale (README "Fleet" → "Autoscaler runbook").
 
 Every request carries a trace id from admission through queueing,
 batch coalescing, dispatch (correlated with the executor's process-
@@ -36,6 +41,7 @@ submit→resolve, so ``tools/latency_report.py`` decomposes p99 by phase
 per tenant and bucket from the exported trace ring.
 """
 
+from .autoscaler import AutoscalerPolicy, FleetAutoscaler  # noqa
 from .bucketing import BucketPlan, bucket_for, pad_to_bucket, parse_buckets  # noqa
 from .fleet import FleetError, FleetRouter, ReplicaEndpoint  # noqa
 from .httpd import MetricsHTTPServer  # noqa

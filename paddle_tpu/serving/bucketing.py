@@ -156,6 +156,27 @@ class BucketPlan:
             width //= 2
         return width
 
+    def shrink_widths(self) -> Dict[int, int]:
+        """Halve the admitted width of every ALREADY-BUILT bucket (floor
+        1) — the fleet autoscaler's degradation-ladder rung for a replica
+        reporting OOM-risk headroom.  The scheduler re-reads the admitted
+        width from the memoized entry on every dispatch, so the shrink
+        takes effect on the next batch (one fresh XLA compile per shrunk
+        bucket — an acceptable one-time cost against an imminent OOM).
+        Cold buckets are untouched: they will admit at their planned
+        width when first built.  Returns {bucket: new_width}."""
+        out: Dict[int, int] = {}
+        with self._mu:
+            for bucket, entry in list(self._plans.items()):
+                compiled, feeds, fetches, width = entry
+                new = max(1, int(width) // 2)
+                if new != width:
+                    self._plans[bucket] = (compiled, feeds, fetches, new)
+                out[bucket] = new
+        for bucket, w in out.items():
+            BUCKET_WIDTH_GAUGE.set(w, bucket=str(bucket))
+        return out
+
     def width_of(self, bucket: int) -> Optional[int]:
         """Admitted width of an ALREADY-BUILT bucket plan; None for a
         cold bucket (statusz must never trigger a build/compile)."""

@@ -218,7 +218,9 @@ class ReplicaEndpoint:
             digest = _monitor.metrics_digest()
         except Exception:
             digest = {}
-        for k in ("occ", "slots", "tps"):
+        # hbm/hdrm ride along when the HBM accountant publishes them —
+        # the autoscaler's OOM-risk headroom signal (degradation ladder)
+        for k in ("occ", "slots", "tps", "hbm", "hdrm"):
             if k in digest:
                 load[k] = float(digest[k])
         return load
@@ -232,7 +234,31 @@ class ReplicaEndpoint:
             return self._op_infer(req)
         if op == "decode":
             return self._op_decode(req)
+        if op == "control":
+            return self._op_control(req)
         return {"ok": False, "error": "unknown_op", "detail": str(op)}
+
+    def _op_control(self, req: dict) -> dict:
+        """Autoscaler control plane.  ``shrink_width`` is the degradation
+        ladder's first rung: halve this replica's admitted bucket widths
+        to claw back HBM headroom.  A server without the actuator (e.g.
+        DecodeServer — no BucketPlan) answers ``unsupported``, which
+        escalates the controller's ladder straight to drain-and-respawn."""
+        cmd = req.get("cmd")
+        if cmd == "shrink_width":
+            fn = getattr(self.server, "shrink_widths", None)
+            if fn is None:
+                return {"ok": False, "error": "unsupported",
+                        "detail": f"{type(self.server).__name__} has no "
+                                  "bucket plan to shrink"}
+            try:
+                widths = fn()
+            except Exception as e:
+                return {"ok": False, "error": "internal",
+                        "detail": repr(e)[:300]}
+            return {"ok": True,
+                    "widths": {str(b): int(w) for b, w in widths.items()}}
+        return {"ok": False, "error": "unknown_cmd", "detail": str(cmd)}
 
     @staticmethod
     def _admission_reply(e: AdmissionError) -> dict:
@@ -314,6 +340,18 @@ class FleetRouter:
         self._rr = 0                            # guarded-by: _mu
         self._stats = {"admitted": 0, "completed": 0,  # guarded-by: _mu
                        "failed": 0, "rejected": 0}
+        #: autoscaler shed switch: while True, _admit rejects every new
+        #: request with reason="slo_shed" (cheap backpressure while a
+        #: spawn is in flight or the fleet is pinned at max)
+        self._shedding = False                  # guarded-by: _mu
+        # fleet-level SLO plane: the router records every request's e2e
+        # outcome, so the autoscaler reads ONE burn-rate signal for the
+        # whole fleet (per-replica evaluators see only their slice of
+        # traffic and none of the routing/retry latency).  None when
+        # FLAGS_serving_slo is empty — the controller then scales on
+        # queue pressure alone.
+        from .slo import BurnRateEvaluator
+        self.slo = BurnRateEvaluator.from_flags()
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
 
@@ -382,6 +420,61 @@ class FleetRouter:
             if addr in self._reps:
                 self._set_state_locked(addr, "draining")
 
+    def add_replica(self, addr: str) -> None:
+        """Admit a freshly spawned replica into placement (autoscaler
+        scale-up / death repair).  Idempotent; the new replica enters
+        with no load report and proves freshness on its first probe or
+        reply."""
+        addr = str(addr)
+        with self._mu:
+            if addr in self._reps:
+                return
+            self._reps[addr] = {
+                "state": "up", "load": {}, "last_seen": 0.0,
+                "breaker": _resil.CircuitBreaker(name=f"fleet.{addr}"),
+            }
+            _monitor.FLEET_REPLICA_STATE.set(_STATE_CODE["up"],
+                                             replica=addr)
+
+    def remove_replica(self, addr: str) -> None:
+        """Drop a retired/dead replica from the table (autoscaler
+        retire path, AFTER its drain finished).  Folds the replica's
+        state gauge series so the registry does not grow with fleet
+        churn (PR-2 retirement semantics)."""
+        addr = str(addr)
+        with self._mu:
+            rep = self._reps.pop(addr, None)
+        if rep is not None:
+            _monitor.FLEET_REPLICA_STATE.fold({"replica": addr}, None)
+
+    def set_shedding(self, on: bool) -> None:
+        """Engage/release fleet-wide admission shedding (the autoscaler's
+        shed-vs-scale arbitration actuator)."""
+        with self._mu:
+            self._shedding = bool(on)
+
+    def replica_view(self) -> Dict[str, dict]:
+        """The autoscaler's per-replica signal view: placement state,
+        last load report (srv_q + the digest keys incl. hbm/hdrm), and
+        whether the load report is fresh under the digest TTL."""
+        now = time.monotonic()
+        with self._mu:
+            return {a: {"state": r["state"],
+                        "load": dict(r["load"]),
+                        "fresh": bool(r["last_seen"] and
+                                      now - r["last_seen"]
+                                      <= self.digest_ttl_s)}
+                    for a, r in self._reps.items()}
+
+    def control(self, addr: str, cmd: str,
+                timeout_s: float = 5.0) -> dict:
+        """Send one control op (e.g. ``shrink_width``) directly to a
+        replica — control traffic never routes through placement."""
+        resp = self._call(addr, {"op": "control", "cmd": str(cmd)},
+                          timeout_s)
+        self._note_reply(addr, resp)
+        return resp
+
     def snapshot(self) -> Dict[str, Any]:
         """Operational view: per-replica state/load/freshness plus the
         router's exact request ledger (admitted == completed + failed +
@@ -395,7 +488,8 @@ class FleetRouter:
                         "breaker": r["breaker"].state}
                     for a, r in self._reps.items()}
             return {"replicas": reps, "policy": self.policy,
-                    "ttl_s": self.digest_ttl_s, **self._stats}
+                    "ttl_s": self.digest_ttl_s,
+                    "shedding": self._shedding, **self._stats}
 
     # -- placement -----------------------------------------------------------
     def _place(self, exclude=()) -> Optional[str]:
@@ -516,6 +610,16 @@ class FleetRouter:
 
     # -- client surface ------------------------------------------------------
     def _admit(self, tenant: str) -> None:
+        with self._mu:
+            shedding = self._shedding
+        if shedding:
+            # the autoscaler's arbitration verdict: cheap, immediate
+            # backpressure instead of queueing work that will miss its
+            # objective while the spawn warms up
+            self.tenants.reject(tenant, "slo_shed")
+            with self._mu:
+                self._stats["rejected"] += 1
+            raise AdmissionError(f"tenant {tenant!r} rejected (slo_shed)")
         if not self.tenants.try_admit(tenant):
             self.tenants.reject(tenant, "quota")
             with self._mu:
@@ -525,15 +629,20 @@ class FleetRouter:
             self._stats["admitted"] += 1
 
     def _finish(self, tenant: str, t0: float, err=None) -> None:
+        latency_ms = (time.perf_counter() - t0) * 1e3
         if err is None:
-            self.tenants.complete(tenant,
-                                  (time.perf_counter() - t0) * 1e3)
+            self.tenants.complete(tenant, latency_ms)
             with self._mu:
                 self._stats["completed"] += 1
         else:
             self.tenants.fail(tenant)
             with self._mu:
                 self._stats["failed"] += 1
+        if self.slo is not None:
+            # fleet-level burn signal: every ADMITTED request's e2e
+            # outcome (shed/quota rejections never reach here — they
+            # must not feed the breach that caused them)
+            self.slo.record(tenant, err is None, latency_ms)
 
     def infer(self, tenant: str, feeds: Dict[str, Any],
               seq_len: Optional[int] = None,

@@ -467,6 +467,13 @@ class InferenceServer(_ServerBase):
                 "compiled_blocks": int(st.get("compiled_blocks", 0)),
                 "buckets": len(self.buckets)}
 
+    def shrink_widths(self) -> Dict[int, int]:
+        """Degradation-ladder actuator (fleet autoscaler ``control`` op):
+        halve every built bucket's admitted batch width.  Delegates to
+        the :class:`~paddle_tpu.serving.bucketing.BucketPlan`; the
+        scheduler picks the new width up on its next dispatch."""
+        return self.plan.shrink_widths()
+
     def statusz(self) -> Dict[str, Any]:
         out = super().statusz()
         out["buckets"] = {str(b): self.plan.width_of(b)

@@ -421,7 +421,7 @@ def test_client_rotates_through_address_list():
 def _run_smoke(*args):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(
-        [sys.executable, _SMOKE, *args], env=env, timeout=600,
+        [sys.executable, _SMOKE, *args], env=env, timeout=1500,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
 

@@ -104,10 +104,12 @@ JAX_PLATFORMS=cpu python tools/gspmd_smoke.py
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
-echo "== fleet smoke (2-replica router drain/SIGKILL re-route, coordinator standby failover, manifest never torn) =="
+echo "== fleet smoke (2-replica router drain/SIGKILL re-route, coordinator standby failover, autoscaler scale drill, manifest never torn) =="
 # fast subset: one pass of each chaos drill (drain, replica SIGKILL,
-# primary-coordinator SIGKILL); the fault-injection kill matrix runs
-# under --full from the slow-marked test in tests/test_fleet.py
+# primary-coordinator SIGKILL, autoscaler spike->spawn / kill->repair /
+# idle->retire); the fault-injection kill matrix — including the failed
+# replica spawn and the coordinator failover under a running autoscaler
+# — runs under --full from the slow-marked tests in tests/test_fleet.py
 JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
 echo "== xprof smoke (fixture parse + live capture -> summary.json keys, measured vs analytic MFU band) =="

@@ -184,6 +184,22 @@ def render(status: dict) -> str:
                 f"  manifest={status.get('manifest')}"
                 f"  coord={status.get('coord_role', 'primary')}"
                 f"/epoch={status.get('epoch', 0)}")
+    # fleet autoscaler footer (the controller attaches its status to
+    # the coordinator via attach_status_section): target vs live size,
+    # shed state, and the last decision — the self-driving fleet's
+    # one-line health read
+    asc = status.get("autoscaler")
+    if isinstance(asc, dict) and "target" in asc:
+        last = asc.get("last") or {}
+        line = (f"fleet: TGT={asc.get('target')} SIZE={asc.get('size')}"
+                f"  bounds=[{asc.get('min')},{asc.get('max')}]"
+                f"  shed={'ON' if asc.get('shedding') else 'off'}"
+                f"  cooldown={asc.get('cooldown_ticks', 0)}t"
+                f"  last={last.get('action', 'none')}"
+                f"/{last.get('reason', '-') or '-'}")
+        if asc.get("spawn_inflight"):
+            line += "  <-- SPAWN IN FLIGHT"
+        rows.append(line)
     # a non-zero epoch means the serving coordinator answering this
     # status is a PROMOTED standby (or a chain of failovers): flag it —
     # the degraded-mode runbook (README "Fleet") starts here
