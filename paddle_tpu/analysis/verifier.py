@@ -83,6 +83,7 @@ CHECKS = (
     "def_before_use", "uninitialized_read", "dangling_fetch",
     "dangling_feed", "shape_consistency", "dead_op", "use_after_donate",
     "int64_feed", "collective_order", "memory_budget",
+    "spec_conflict", "shard_divisibility", "mesh_axis_overuse",
 )
 
 _FINDINGS = _monitor.REGISTRY.counter(
@@ -169,6 +170,14 @@ class VerifyResult:
     #: COMMS PLANS diverge (payload bytes, nranks) refuse at the gang
     #: barrier exactly like divergent collective sequences.
     comms_plan: Optional[object] = None
+    #: static GSPMD sharding plan (analysis.sharding.ShardingPlan; None
+    #: for unpartitioned programs or when planning failed).  UNLIKE the
+    #: planners above this one contributes blocking diagnostics
+    #: (spec_conflict / mesh_axis_overuse errors refuse a bad rule table
+    #: at optimize time with zero dispatches), and its ``#resh=`` token
+    #: folds into ``collective_fingerprint`` so divergent reshard plans
+    #: refuse at the step barrier even under IDENTICAL rule-table names.
+    sharding_plan: Optional[object] = None
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == "error"]
@@ -818,6 +827,32 @@ def _comms_attrs(plan):
         return None
 
 
+def _check_sharding(program: Program, fetch_names, diags):
+    """Static GSPMD sharding plan (analysis.sharding): PartitionSpec
+    propagation + per-edge reshard pricing over the partition stamp.
+    Unlike the memory/cost/comms planners this check CAN block
+    verification — its spec_conflict / mesh_axis_overuse errors are
+    exactly the optimize-time rule-table refusal — but a planner CRASH
+    still never blocks (same contract as the others)."""
+    from . import sharding as _sharding
+    try:
+        plan = _sharding.plan_sharding(program, fetch_names,
+                                       batch_size=1)
+    except Exception:
+        return None
+    if plan is not None:
+        diags.extend(plan.diagnostics)
+    return plan
+
+
+def _sharding_attrs(plan):
+    from . import sharding as _sharding
+    try:
+        return _sharding.stamp_attrs(plan)
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -880,6 +915,8 @@ def _verify_cached(program: Program, fetch_names) -> \
         result.memory_plan = _check_memory(program, fetch_names, diags)
         result.cost_plan = _check_cost(program, fetch_names)
         result.comms_plan = _check_comms(program, fetch_names)
+        result.sharding_plan = _check_sharding(program, fetch_names,
+                                               diags)
         if result.comms_plan is not None and \
                 result.collective_fingerprint is not None:
             # fold the comms plan (nranks + ordered per-collective
@@ -900,10 +937,22 @@ def _verify_cached(program: Program, fetch_names) -> \
             # collective ops.  The "#rules=<table>" suffix survives the
             # hash so the coordinator's mismatch detail, which prints
             # both raw fingerprints, NAMES both tables.
+            # the "#resh=<edges>x<sha8>" token joins the fold: two ranks
+            # running the SAME rule table over structurally divergent
+            # programs (different models, different zero stage) carry
+            # different reshard plans — the barrier refusal names both
+            # plans instead of deadlocking inside mismatched implicit
+            # collectives.  It precedes "#rules=" so the rules suffix
+            # stays the FINAL token (coordinator's _gspmd_rules_of
+            # parses split("#rules=")[1] verbatim).
+            resh = ""
+            if result.sharding_plan is not None:
+                resh = "#resh=" + result.sharding_plan.resh_token
             base = result.collective_fingerprint or ""
-            digest = hashlib.sha1((base + "|" + ptok).encode()).hexdigest()
+            digest = hashlib.sha1(
+                (base + "|" + ptok + resh).encode()).hexdigest()
             result.collective_fingerprint = \
-                digest + ptok[ptok.index("#"):]
+                digest + resh + ptok[ptok.index("#"):]
     for d in diags:
         _FINDING_CELLS[d.check].inc()
     # int64_feed "findings" are classifications, not diagnostics: the
@@ -941,6 +990,11 @@ def _verify_cached(program: Program, fetch_names) -> \
         # collective launch telemetry, bench.py's comms: lines, and the
         # quantized-collectives gate read without re-planning
         "comms": _comms_attrs(result.comms_plan),
+        # static GSPMD sharding model: propagated specs + priced reshard
+        # edges + the #resh= parity token — what tools/analyze.py
+        # --sharding, the gspmd/sharding smokes, and choose_rules
+        # auditing read without re-planning
+        "sharding": _sharding_attrs(result.sharding_plan),
     }
     with _CACHE_LOCK:
         fresh = key not in _CACHE

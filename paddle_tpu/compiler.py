@@ -156,8 +156,17 @@ class CompiledProgram:
         re-ranks/re-tunes candidates) must not reuse a stale rewrite."""
         from .analysis import fusion as _fusion
         batch = _fusion._batch_of(feed_shapes)
+        # the partition stamp lives in _attrs, outside the structural
+        # fingerprint: a re-applied rule table (apply_rules without a
+        # fresh with_gspmd) must re-verify/re-optimize, not reuse the
+        # old table's program
+        ptok = None
+        if self._program._attrs.get("partition"):
+            from .parallel.partitioner import partition_fingerprint
+            ptok = partition_fingerprint(
+                self._program._attrs["partition"])
         key = (self._program.fingerprint(), frozenset(fetch_names),
-               _fusion.config_token(), batch)
+               _fusion.config_token(), batch, ptok)
         cache = getattr(self, "_optimized_cache", None)
         if cache is None:
             cache = self._optimized_cache = {}
@@ -359,6 +368,10 @@ class CompiledProgram:
             raise ValueError("zero_stage must be 0 or 1 (ZeRO-1: "
                              "optimizer-state sharding)")
         self._zero_stage = int(zero_stage)
+        # the sharding analysis prices ZeRO-1's reduce-scatter/
+        # all-gather split off the stamp, and the partition fingerprint
+        # hashes it: ranks disagreeing on zero_stage must refuse
+        stamp["zero_stage"] = self._zero_stage
         # partition attrs change the verify stamp: drop any verify/plan
         # cached for the pre-partition program, then take a new serial
         # so the executor re-lowers under the new shardings

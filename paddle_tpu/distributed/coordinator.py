@@ -565,6 +565,20 @@ class GangCoordinator:
         if t1 is not None and t2 is not None and t1 != t2:
             detail += (f" (divergent GSPMD rule tables: rank {r1} "
                        f"chose {t1!r}, rank {r2} chose {t2!r})")
+        elif t1 is not None and t2 is not None:
+            # same rule-table NAME but still divergent: compare the
+            # "#resh=<edges>x<sha8>" reshard-plan tokens (the sharding
+            # analysis's traffic multiset) — two ranks running the same
+            # table over different programs are named by PLAN, so the
+            # operator sees "24x1a2b3c4d vs 30x5e6f7a8b" instead of two
+            # opaque digests
+            p1, p2 = (f.split("#resh=", 1)[1].split("#", 1)[0]
+                      if "#resh=" in str(f) else None for f in (f1, f2))
+            if p1 is not None and p2 is not None and p1 != p2:
+                detail += (f" (same rule table {t1!r} but divergent "
+                           f"GSPMD reshard plans: rank {r1} plans "
+                           f"{p1}, rank {r2} plans {p2} — the programs "
+                           "move different collective traffic)")
         mm = {"ranks": [int(r1), int(r2)],
               "fingerprints": [f1, f2],
               "detail": detail}

@@ -414,6 +414,16 @@ def _resolve_comms(cb, program, feeds):
         plan = comms.plan_comms(program, cb.fetch_names,
                                 batch_size=_feed_batch(feeds),
                                 nranks=cb.collective_nranks)
+        if plan is None and getattr(cb, "partitioned", False):
+            # pjit-partitioned programs launch no explicit c_* ops for
+            # plan_comms to find — their collective traffic is the
+            # GSPMD reshard plan (analysis.sharding), projected onto
+            # the same CommsPlan shape so the byte cells, wait/wire
+            # decomposition, and gangtop COMM column work unchanged
+            from ..analysis import sharding as _sharding
+            plan = _sharding.runtime_comms_plan(
+                program, cb.fetch_names,
+                batch_size=_feed_batch(feeds))
         if plan is None:
             return None
         return plan, comms.bound_byte_cells(plan)
@@ -1540,14 +1550,18 @@ class Executor:
             # ranks that planner-picked divergent rule tables refuse by
             # table name instead of deadlocking inside XLA's collectives
             self._maybe_step_barrier(cb, program)
-        if cb.collective_nranks:
+        if cb.collective_nranks or getattr(cb, "partitioned", False):
             # collective-launch observability (analysis.comms): the
             # drill site fires first (hang mode makes THIS rank the
             # straggler its peers must attribute), then the plan's byte
             # counters bump and the coordinator timestamp exchange
             # measures peer arrival skew — the straggler-wait half of
-            # the decomposition the off-thread monitor completes
-            _resil.maybe_inject("collective.launch")
+            # the decomposition the off-thread monitor completes.
+            # GSPMD-partitioned steps share the accounting path (their
+            # plan is the reshard projection) but not the drill site:
+            # the injection matrix targets explicit collective launches
+            if cb.collective_nranks:
+                _resil.maybe_inject("collective.launch")
             comms_note = self._comms_prelaunch(cb, program, feeds)
         self._step_seed += 1
         seed_val = seed if seed is not None else (
@@ -1701,8 +1715,9 @@ class Executor:
                     "xla.compile", "compile", tc0, tdisp,
                     {"persist_cache": outcome,
                      "fetches": list(cb.fetch_names)})
-        if cb.collective_nranks:
-            _COLL_STEP.inc()
+        if cb.collective_nranks or getattr(cb, "partitioned", False):
+            if cb.collective_nranks:
+                _COLL_STEP.inc()
             if comms_note is not None:
                 # synchronous byte accounting (a lock+add per collective
                 # on pre-bound cells — failed dispatches never count, so

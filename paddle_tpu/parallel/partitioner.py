@@ -49,6 +49,7 @@ drop by the data-parallel degree.
 from __future__ import annotations
 
 import hashlib
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -289,20 +290,27 @@ def infer_logical_axes(program) -> Dict[str, Tuple[Optional[str], ...]]:
 # ---------------------------------------------------------------------------
 
 def _spec_for(shape, logical, table: LogicalAxisRules,
-              axis_sizes: Dict[str, int]):
+              axis_sizes: Dict[str, int], dropped=None, name=None):
     """dist_spec tuple for one var, or None (fully replicated).  A dim
     stays replicated when its logical axis is unmapped, the mesh axis is
     absent/trivial, or the static dim doesn't divide evenly (GSPMD could
     pad, but the memory planner's per-shard arithmetic — and ZeRO-1's
-    scope layout — want exact shards)."""
+    scope layout — want exact shards).  A non-dividing MAPPED dim is the
+    silent-drop case: when ``dropped`` is a list, each such dim appends
+    ``(name, dim, logical_axis, mesh_axis, dim_size, axis_size)`` so the
+    drop surfaces as a ``shard_divisibility`` diagnostic instead of
+    vanishing."""
     spec = []
-    for d, ax in zip(shape, logical):
+    for i, (d, ax) in enumerate(zip(shape, logical)):
         m = table.mesh_axis(ax)
         size = axis_sizes.get(m, 0) if m else 0
         if m and size > 1 and isinstance(d, int) and d > 0 \
                 and d % size == 0:
             spec.append(m)
         else:
+            if dropped is not None and m and size > 1 \
+                    and isinstance(d, int) and d > 0:
+                dropped.append((name, i, ax, m, int(d), int(size)))
             spec.append(None)
     return tuple(spec) if any(s is not None for s in spec) else None
 
@@ -319,14 +327,16 @@ def apply_rules(program, table, axis_sizes: Dict[str, int],
         infer_logical_axes(program)
 
     params: Dict[str, tuple] = {}
-    for name, laxes in logical.items():
+    dropped: List[tuple] = []
+    for name, laxes in sorted(logical.items()):
         if not block.has_var(name):
             continue
         v = block.var(name)
         shape = tuple(v.shape or ())
         if len(shape) != len(laxes):
             continue
-        spec = _spec_for(shape, laxes, table, axis_sizes)
+        spec = _spec_for(shape, laxes, table, axis_sizes,
+                         dropped=dropped, name=name)
         v.dist_spec = spec
         if spec is not None:
             params[name] = spec
@@ -361,9 +371,53 @@ def apply_rules(program, table, axis_sizes: Dict[str, int],
         "mesh_axes": {a: int(s) for a, s in sorted(axis_sizes.items())},
         "params": params,
         "activations": acts,
+        # dims the divisibility guard kept replicated even though the
+        # table MAPS them — surfaced by the shard_divisibility check
+        # (analysis.sharding) instead of dropped silently
+        "dropped": dropped,
     }
     program._attrs["partition"] = stamp
+    _warn_dropped_dims(stamp)
     return stamp
+
+
+#: partition fingerprints whose divisibility drops were already warned —
+#: once per (table, mesh, specs), not once per re-apply
+_DROP_WARNED: set = set()  # guarded-by: _DROP_WARNED_LOCK
+_DROP_WARNED_LOCK = threading.Lock()
+
+
+def _warn_dropped_dims(stamp) -> None:
+    """One ``warnings.warn`` per partition fingerprint when the
+    divisibility guard dropped mapped dims, formatted through the
+    debugger's diagnostic renderer (the verify stamp carries the same
+    findings; this warning is the interactive surface)."""
+    dropped = stamp.get("dropped")
+    if not dropped:
+        return
+    fp = partition_fingerprint(stamp)
+    with _DROP_WARNED_LOCK:
+        if fp in _DROP_WARNED:
+            return
+        _DROP_WARNED.add(fp)
+    from .. import debugger
+    from ..analysis.verifier import Diagnostic
+    diags = [
+        Diagnostic(
+            check="shard_divisibility", severity="warning",
+            message=(
+                f"dim {dim} of {name!r} (size {dsize}, logical axis "
+                f"{lax!r}) does not divide mesh axis {max_!r} "
+                f"(size {asize}): kept REPLICATED"),
+            var=name,
+            fix_hint=(f"pad {name!r} to a multiple of {asize} along "
+                      f"dim {dim}, or unmap {lax!r} in the rule table"))
+        for name, dim, lax, max_, dsize, asize in dropped]
+    import warnings
+    warnings.warn(
+        f"GSPMD rule table {stamp.get('rules')!r} silently drops "
+        f"{len(dropped)} mapped dim(s):\n"
+        + debugger.format_diagnostics(diags), stacklevel=3)
 
 
 def _activation_axes(program, logical_axes) -> Dict[str, Optional[str]]:
@@ -506,8 +560,24 @@ def choose_rules(program, axis_sizes: Dict[str, int], fetch_names=(),
         plan = plan_sharded_memory(program, fetch_names,
                                    batch_size=batch_size, specs=specs,
                                    axis_sizes=axis_sizes)
-        comm_ms = _est_comm_ms(program, table, logical, axis_sizes,
-                               batch_size)
+        # price the candidate on its REAL per-edge reshard plan
+        # (analysis.sharding: every implicit collective the SPMD
+        # partitioner will insert, ring-priced); the pre-PR-20 matmul
+        # heuristic stays as the fallback when the pass cannot plan
+        resh = None
+        try:
+            from ..analysis.sharding import plan_sharding
+            resh = plan_sharding(program, fetch_names,
+                                 batch_size=batch_size, specs=specs,
+                                 axis_sizes=axis_sizes,
+                                 rules=table.name)
+        except Exception:
+            resh = None
+        if resh is not None:
+            comm_ms = resh.est_ms
+        else:
+            comm_ms = _est_comm_ms(program, table, logical, axis_sizes,
+                                   batch_size)
         report.append({
             "rules": table.name,
             "per_shard_peak_bytes": int(plan.peak_bytes),
@@ -517,6 +587,13 @@ def choose_rules(program, axis_sizes: Dict[str, int], fetch_names=(),
             "est_compute_ms": round(compute_ms, 4),
             "bound": "comm" if comm_ms > compute_ms else "compute",
             "sharded_params": len(specs),
+            "reshard_edges": None if resh is None else len(resh.edges),
+            "reshard_bytes": None if resh is None
+            else int(resh.payload_bytes),
+            "reshard_wire_bytes": None if resh is None
+            else int(resh.wire_bytes),
+            "reshard_fingerprint": None if resh is None
+            else resh.fingerprint,
         })
 
     if budget is None:
@@ -578,6 +655,7 @@ def partition_fingerprint(stamp: Optional[dict]) -> Optional[str]:
     if not stamp:
         return None
     body = repr((sorted((stamp.get("mesh_axes") or {}).items()),
-                 sorted((stamp.get("params") or {}).items())))
+                 sorted((stamp.get("params") or {}).items()),
+                 int(stamp.get("zero_stage") or 0)))
     return (hashlib.sha1(body.encode()).hexdigest()
             + f"#rules={stamp.get('rules')}")

@@ -275,10 +275,19 @@ class DecodeEngine:
     def __init__(self, cfg, params_or_scope, max_slots: int = 4,
                  page_len: Optional[int] = None,
                  n_pages: Optional[int] = None,
-                 max_seq: Optional[int] = None):
+                 max_seq: Optional[int] = None,
+                 program=None):
         from ..flags import get_flags
         fl = get_flags(["FLAGS_serving_kv_page_len",
                         "FLAGS_serving_kv_pages"])
+        if program is not None:
+            # static GSPMD-serving gate (analysis.sharding): the paged
+            # pools below host full per-head pages and full unsharded
+            # params on ONE chip, so a model-parallel-sharded decode
+            # program is refused HERE, naming its offending specs,
+            # instead of producing silently-wrong gathers at step time
+            from ..analysis.sharding import check_decode_hostable
+            check_decode_hostable(program)
         self.cfg = cfg
         self.page_len = int(page_len or fl["FLAGS_serving_kv_page_len"])
         self.max_seq = int(max_seq or cfg.max_pos)

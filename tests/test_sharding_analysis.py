@@ -1,0 +1,383 @@
+"""Static sharding analysis (analysis.sharding): PartitionSpec
+propagation, reshard-edge pricing, the spec_conflict /
+shard_divisibility / mesh_axis_overuse checks (trip + near-miss each),
+optimize-time refusal with zero dispatches, the #resh= fingerprint
+fold + step-barrier refusal naming both ranks' reshard plans,
+choose_rules pricing off the per-edge plan, and the static-plan ==
+measured-collective-bytes invariant."""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, monitor
+from paddle_tpu import optimizer as opt
+from paddle_tpu.analysis import ProgramVerificationError
+from paddle_tpu.analysis.sharding import (check_decode_hostable,
+                                          plan_sharding,
+                                          runtime_comms_plan)
+from paddle_tpu.analysis.verifier import collective_fingerprint
+from paddle_tpu.framework import Executor, Program, program_guard
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.parallel import choose_rules, partition_program
+
+MESH = {"dp": 2, "mp": 2}
+#: embed AND mlp onto "mp" — every matmul operand carries ('mp', 'mp')
+BAD_RULES = {"embed": "mp", "mlp": "mp", "batch": "dp"}
+
+
+def _build_mlp(prefix="sa", hidden=16):
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=hidden, act="relu", name=f"{prefix}_fc1")
+    pred = layers.fc(h, size=4, act="softmax", name=f"{prefix}_fc2")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    opt.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def _mlp_program(prefix="sa", hidden=16):
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        loss = _build_mlp(prefix, hidden)
+    return main, loss
+
+
+# ---------------------------------------------------------------------------
+# propagation + explained edges
+# ---------------------------------------------------------------------------
+
+def test_plan_sharding_mlp_explained_and_priced():
+    """mp_hidden on the MLP: every edge carries a semantic reason, the
+    specs table shards the hidden weight on mp, and grad-sync traffic
+    is priced per param."""
+    main, loss = _mlp_program("exp")
+    partition_program(main, MESH, rules="mp_hidden",
+                      fetch_names=[loss.name], batch_size=16)
+    plan = plan_sharding(main, [loss.name], batch_size=16)
+    assert plan is not None
+    assert plan.edges and not plan.unexplained, \
+        [(e.var, e.reason) for e in plan.unexplained]
+    assert plan.payload_bytes > 0 and plan.wire_bytes > 0
+    assert plan.est_ms > 0
+    w1 = next(v for v in plan.specs if "exp_fc1.w" in v)
+    assert "mp" in plan.specs[w1]
+    reasons = {e.reason for e in plan.edges}
+    assert "grad_sync" in reasons          # zero_stage=0 path
+    # column-parallel fc1 -> row-parallel fc2 contraction: partial sum
+    assert "partial_sum" in reasons
+
+
+def test_plan_sharding_zero1_traffic():
+    """ZeRO-1 swaps each dp grad all_reduce for a reduce_scatter +
+    param all_gather pair; the pair's payloads sum to the param bytes
+    scaled by the shard fraction."""
+    main, loss = _mlp_program("z1")
+    stamp = partition_program(main, MESH, rules="mp_hidden",
+                              fetch_names=[loss.name], batch_size=16)
+    stamp["zero_stage"] = 1
+    plan = plan_sharding(main, [loss.name], batch_size=16)
+    reasons = {e.reason for e in plan.edges}
+    assert "zero1_grad" in reasons and "zero1_param" in reasons
+    assert "grad_sync" not in reasons
+    rs = {e.var: e for e in plan.edges if e.reason == "zero1_grad"}
+    ag = {e.var: e for e in plan.edges if e.reason == "zero1_param"}
+    assert set(rs) == set(ag)
+    for v in rs:
+        assert rs[v].kind == "reduce_scatter"
+        assert ag[v].kind == "all_gather"
+        assert rs[v].payload_bytes == ag[v].payload_bytes
+
+
+def test_plan_sharding_none_for_unpartitioned():
+    main, loss = _mlp_program("un")
+    assert plan_sharding(main, [loss.name], batch_size=16) is None
+    assert runtime_comms_plan(main, [loss.name], batch_size=16) is None
+
+
+# ---------------------------------------------------------------------------
+# the three checks: trip + near-miss
+# ---------------------------------------------------------------------------
+
+def test_mesh_axis_overuse_trips_on_overcommitted_table():
+    main, loss = _mlp_program("ov")
+    partition_program(main, MESH, rules=BAD_RULES,
+                      fetch_names=[loss.name], batch_size=16)
+    plan = plan_sharding(main, [loss.name], batch_size=16)
+    errs = [d for d in plan.diagnostics
+            if d.check == "mesh_axis_overuse" and d.severity == "error"]
+    assert errs, plan.diagnostics
+    assert "mp" in errs[0].message
+
+
+def test_mesh_axis_overuse_near_miss_blessed_tables():
+    for rules in ("replicated", "mp_hidden"):
+        main, loss = _mlp_program(f"nm_{rules}")
+        partition_program(main, MESH, rules=rules,
+                          fetch_names=[loss.name], batch_size=16)
+        plan = plan_sharding(main, [loss.name], batch_size=16)
+        assert not [d for d in plan.diagnostics
+                    if d.severity == "error"], (rules, plan.diagnostics)
+
+
+def test_spec_conflict_trip_and_near_miss():
+    """Both contraction operands sharded on DIFFERENT axes -> error;
+    one-sided mismatch -> a priced all_gather + warning only."""
+    main, loss = _mlp_program("sc")
+    blk = main.global_block()
+    w1 = next(n for n in blk.vars if "sc_fc1.w" in n)
+    w2 = next(n for n in blk.vars if "sc_fc2.w" in n)
+    # fc2's matmul contracts fc1's activation against sc_fc2.w: find
+    # that activation name so a constraint can pin its layout
+    mm2 = next(op for op in blk.ops
+               if op.type in ("mul", "matmul", "matmul_v2")
+               and w2 in op.inputs.get("Y", op.inputs.get("W", [])))
+    act = mm2.inputs["X"][0]
+    # trip: the activation sharded mp on its contraction dim, w sharded
+    # dp on ITS contraction dim — no layout satisfies both
+    plan = plan_sharding(
+        main, [loss.name], batch_size=16,
+        specs={w1: (None, "mp"), act: (None, "mp"), w2: ("dp", None)},
+        axis_sizes=MESH, rules="adhoc_conflict")
+    errs = [d for d in plan.diagnostics
+            if d.check == "spec_conflict" and d.severity == "error"]
+    assert errs, plan.diagnostics
+    # near-miss: only w sharded on its contraction dim -> the pass
+    # prices the gather and warns, but does not refuse
+    plan2 = plan_sharding(
+        main, [loss.name], batch_size=16,
+        specs={w1: ("mp", None)},
+        axis_sizes=MESH, rules="adhoc_onesided")
+    assert not [d for d in plan2.diagnostics if d.severity == "error"]
+    warns = [d for d in plan2.diagnostics if d.check == "spec_conflict"]
+    assert warns
+    assert any(e.kind == "all_gather" and e.reason == "spec_mismatch"
+               for e in plan2.edges)
+
+
+def test_shard_divisibility_warns_and_drops():
+    """A 6-wide fc under mp=4: apply_rules drops the dim (warn-once
+    through debugger.format_diagnostics) and the plan re-surfaces the
+    drop as a shard_divisibility warning."""
+    from paddle_tpu.parallel import partitioner as _part
+    with _part._DROP_WARNED_LOCK:
+        # the memo is keyed on the partition fingerprint; other tests
+        # (test_gspmd's divisibility guard) build the same ragged
+        # layout and would suppress this test's warning in a full run
+        _part._DROP_WARNED.clear()
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=6, act="relu", name="sa_rag_fc")
+        loss = layers.mean(h)
+        opt.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            stamp = partition_program(main, {"dp": 2, "mp": 4},
+                                      rules="mp_hidden",
+                                      fetch_names=[loss.name])
+    assert stamp.get("dropped"), "divisibility drop not recorded"
+    msgs = [str(w.message) for w in caught
+            if "shard_divisibility" in str(w.message)]
+    assert msgs, [str(w.message) for w in caught]
+    assert "sa_rag_fc" in msgs[0]
+    plan = plan_sharding(main, [loss.name], batch_size=16)
+    divs = [d for d in plan.diagnostics
+            if d.check == "shard_divisibility"]
+    assert divs and all(d.severity == "warning" for d in divs)
+
+
+def test_shard_divisibility_near_miss_divisible_dims():
+    main, loss = _mlp_program("dv")          # 16 % 2 == 0 everywhere
+    stamp = partition_program(main, MESH, rules="mp_hidden",
+                              fetch_names=[loss.name])
+    assert not stamp.get("dropped")
+    plan = plan_sharding(main, [loss.name], batch_size=16)
+    assert not [d for d in plan.diagnostics
+                if d.check == "shard_divisibility"]
+
+
+# ---------------------------------------------------------------------------
+# optimize-time refusal
+# ---------------------------------------------------------------------------
+
+def _dispatched():
+    return monitor.counter_totals().get(
+        "paddle_tpu_executor_steps_dispatched", 0)
+
+
+def test_optimize_refuses_conflicting_table_zero_dispatches():
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        loss = _build_mlp("ref")
+        compiled = pt.CompiledProgram(main).with_gspmd(
+            axes={"dp": 2, "mp": 4}, rules=BAD_RULES,
+            fetch_names=[loss.name], batch_size=16)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=99)
+        d0 = _dispatched()
+        rng = np.random.RandomState(3)
+        with pytest.raises(ProgramVerificationError,
+                           match="mesh_axis_overuse"):
+            exe.run(compiled,
+                    feed={"x": rng.rand(16, 8).astype(np.float32),
+                          "y": rng.randint(0, 4, (16, 1)).astype(
+                              np.int64)},
+                    fetch_list=[loss.name])
+        assert _dispatched() - d0 == 0, \
+            "refused program must not dispatch"
+
+
+# ---------------------------------------------------------------------------
+# fingerprint fold + barrier refusal
+# ---------------------------------------------------------------------------
+
+def _partitioned_fp(prefix, hidden=16, rules="mp_hidden"):
+    main, loss = _mlp_program(prefix, hidden)
+    partition_program(main, MESH, rules=rules,
+                      fetch_names=[loss.name])
+    plan = plan_sharding(main, [loss.name], batch_size=1)
+    return collective_fingerprint(main), plan
+
+
+def test_collective_fingerprint_folds_reshard_token():
+    fp, plan = _partitioned_fp("fp")
+    assert fp.endswith("#rules=mp_hidden")
+    assert f"#resh={plan.resh_token}" in fp
+    assert fp.index("#resh=") < fp.index("#rules="), fp
+    # name-insensitivity: the plan token hashes traffic, not var names
+    # — a same-shape model with different param names plans identically
+    # (so graph fusion's var renames can't shift it), while the full
+    # fingerprint still differs through the program digest
+    fp2, plan2 = _partitioned_fp("fq")
+    assert plan2.fingerprint == plan.fingerprint
+    assert f"#resh={plan.resh_token}" in fp2
+    assert fp2 != fp
+
+
+def test_step_barrier_names_divergent_reshard_plans():
+    """Same rule table, different models: the barrier refusal names
+    both ranks' reshard-plan tokens instead of the (identical) table."""
+    from paddle_tpu.distributed.coordinator import (GangClient,
+                                                    GangCoordinator,
+                                                    GangFingerprintError)
+    fp0, plan0 = _partitioned_fp("br0", hidden=16)
+    fp1, plan1 = _partitioned_fp("br1", hidden=32)
+    assert fp0 != fp1 and plan0.resh_token != plan1.resh_token
+    coord = GangCoordinator(world_size=2, heartbeat_timeout_s=30).start()
+    c0 = GangClient(coord.address, rank=0, world_size=2).connect()
+    c1 = GangClient(coord.address, rank=1, world_size=2).connect()
+    errs = {}
+
+    def arrive(c, fp):
+        try:
+            c.step_barrier(1, fp, timeout_s=10)
+        except Exception as e:       # noqa: BLE001 — recorded for assert
+            errs[c.rank] = e
+    try:
+        t = threading.Thread(target=arrive, args=(c0, fp0), daemon=True)
+        t.start()
+        time.sleep(0.15)
+        arrive(c1, fp1)
+        t.join(5)
+        assert set(errs) == {0, 1}
+        for e in errs.values():
+            assert isinstance(e, GangFingerprintError)
+            msg = str(e)
+            assert "divergent GSPMD reshard plans" in msg
+            assert plan0.resh_token in msg and plan1.resh_token in msg
+    finally:
+        c0.close()
+        c1.close()
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# choose_rules pricing
+# ---------------------------------------------------------------------------
+
+def test_choose_rules_priced_by_reshard_plan():
+    """Every candidate row's comm estimate is reproduced by the stamped
+    per-edge plan (same specs, same batch) — the planner prices real
+    reshard bytes, not the old per-param heuristic."""
+    main, loss = _mlp_program("cr")
+    table, report = choose_rules(main, MESH, fetch_names=[loss.name],
+                                 batch_size=16)
+    priced = [r for r in report if r["reshard_fingerprint"]]
+    assert priced, report
+    for row in priced:
+        assert row["reshard_edges"] > 0
+        assert row["reshard_bytes"] >= 0
+        main2, loss2 = _mlp_program(f"cr_{row['rules']}")
+        partition_program(main2, MESH, rules=row["rules"],
+                          fetch_names=[loss2.name], batch_size=16)
+        plan = plan_sharding(main2, [loss2.name], batch_size=16)
+        assert row["reshard_edges"] == len(plan.edges), row
+        assert row["reshard_bytes"] == plan.payload_bytes, row
+        assert row["est_comm_ms"] == round(plan.est_ms, 4), row
+    chosen = next(r for r in report if r["chosen"])
+    assert chosen["rules"] == table.name
+
+
+# ---------------------------------------------------------------------------
+# static plan == measured collective bytes
+# ---------------------------------------------------------------------------
+
+def test_static_plan_matches_measured_collective_bytes():
+    """N dispatched gspmd steps move paddle_tpu_collective_bytes_total
+    by exactly N x the static plan's payload — the executor's byte
+    cells are bound from the reshard-plan projection."""
+    steps = 3
+    main, start = Program(), Program()
+    with program_guard(main, start), scope_guard(Scope()):
+        loss = _build_mlp("mb")
+        main.random_seed = 7
+        compiled = pt.CompiledProgram(main).with_gspmd(
+            axes={"dp": 2, "mp": 4}, rules="mp_hidden", zero_stage=1,
+            fetch_names=[loss.name], batch_size=16)
+        exe = Executor()
+        exe.run(pt.default_startup_program(), seed=99)
+        rng = np.random.RandomState(3)
+
+        def step():
+            return exe.run(
+                compiled,
+                feed={"x": rng.rand(16, 8).astype(np.float32),
+                      "y": rng.randint(0, 4, (16, 1)).astype(np.int64)},
+                fetch_list=[loss.name])
+        step()                                  # compile + verify
+        plan = plan_sharding(main, [loss.name], batch_size=16)
+        assert plan is not None and plan.edges
+        ctr = "paddle_tpu_collective_bytes_total"
+        b0 = monitor.counter_totals().get(ctr, 0)
+        for _ in range(steps):
+            step()
+        exe.drain()
+        db = monitor.counter_totals().get(ctr, 0) - b0
+        assert db == steps * plan.payload_bytes, \
+            (db, steps, plan.payload_bytes)
+
+
+# ---------------------------------------------------------------------------
+# serving gate
+# ---------------------------------------------------------------------------
+
+def test_decode_hostable_gate():
+    main, loss = _mlp_program("kv")
+    # unpartitioned: hostable
+    assert check_decode_hostable(main) == []
+    partition_program(main, MESH, rules="mp_hidden",
+                      fetch_names=[loss.name])
+    with pytest.raises(ValueError, match="model-parallel sharded"):
+        check_decode_hostable(main)
+    offending = check_decode_hostable(main, raise_on_violation=False)
+    assert offending and all("mp" in spec for _, spec in offending)
+    # dp-only sharding hosts fine (pure data parallel)
+    main2, loss2 = _mlp_program("kv2")
+    partition_program(main2, {"dp": 4}, rules="replicated",
+                      fetch_names=[loss2.name])
+    assert check_decode_hostable(main2) == []

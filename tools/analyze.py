@@ -1,14 +1,27 @@
 #!/usr/bin/env python
 """Whole-program static analysis over a SAVED program, no dispatch:
 the verifier's full diagnostic report (``--verify``), the static HBM
-peak-memory plan (``--memory``), and/or the graph-fusion candidate
-report (``--fusion``) — the offline entry point to the same
-``paddle_tpu.analysis`` suite ``compiler.optimize`` runs inline.
+peak-memory plan (``--memory``), the graph-fusion candidate report
+(``--fusion``), and/or the GSPMD sharding analysis (``--sharding``) —
+the offline entry point to the same ``paddle_tpu.analysis`` suite
+``compiler.optimize`` runs inline.
 
 Usage::
 
     python tools/analyze.py [--verify] [--memory] [--fusion] [--json]
+        [--sharding --mesh dp:2,mp:2 [--rules TABLE] [--zero N]]
         [--fetch name[,name...]] [--batch N] PROGRAM
+
+``--sharding`` applies a ``LogicalAxisRules`` table offline (program
+blobs don't carry the runtime partition stamp) and reports the
+propagated PartitionSpec per var, every priced reshard edge
+(kind / mesh axis / payload bytes through the ring model), the
+spec_conflict / shard_divisibility / mesh_axis_overuse diagnostics,
+and the PER-SHARD static HBM peak (``plan_sharded_memory``).
+``--mesh`` is required; ``--rules`` defaults to ``auto`` (the planner
+picks under ``FLAGS_memory_budget_mb``); ``--zero 1`` prices ZeRO-1
+optimizer traffic.  Error-severity findings exit 1 — the same refusal
+``compiler.optimize`` enforces.
 
 ``PROGRAM`` is either a serialized program blob
 (``Program.serialize_to_string`` — e.g. ``main_program`` from
@@ -63,9 +76,13 @@ def main(argv=None) -> int:
     want_verify = "--verify" in argv
     want_memory = "--memory" in argv
     want_fusion = "--fusion" in argv
+    want_sharding = "--sharding" in argv
     as_json = "--json" in argv
     fetch = ()
     batch = 1
+    mesh = None
+    rules = "auto"
+    zero = 0
     paths = []
     skip = set()
     for i, a in enumerate(argv):
@@ -84,8 +101,35 @@ def main(argv=None) -> int:
                 return 2
             batch = int(argv[i + 1])
             skip.add(i + 1)
+        elif a == "--mesh":
+            if i + 1 >= len(argv):
+                print("analyze: --mesh needs axis:size[,axis:size...]",
+                      file=sys.stderr)
+                return 2
+            try:
+                mesh = {k: int(v) for k, v in
+                        (kv.split(":") for kv in argv[i + 1].split(","))}
+            except ValueError:
+                print(f"analyze: bad --mesh spec {argv[i + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            skip.add(i + 1)
+        elif a == "--rules":
+            if i + 1 >= len(argv):
+                print("analyze: --rules needs a table name",
+                      file=sys.stderr)
+                return 2
+            rules = argv[i + 1]
+            skip.add(i + 1)
+        elif a == "--zero":
+            if i + 1 >= len(argv):
+                print("analyze: --zero needs 0 or 1", file=sys.stderr)
+                return 2
+            zero = int(argv[i + 1])
+            skip.add(i + 1)
         elif a.startswith("--"):
-            if a not in ("--verify", "--memory", "--fusion", "--json"):
+            if a not in ("--verify", "--memory", "--fusion",
+                         "--sharding", "--json"):
                 print(f"analyze: unknown flag {a!r}", file=sys.stderr)
                 return 2
         else:
@@ -94,7 +138,12 @@ def main(argv=None) -> int:
         print("analyze: exactly one PROGRAM path required",
               file=sys.stderr)
         return 2
-    if not want_verify and not want_memory and not want_fusion:
+    if want_sharding and mesh is None:
+        print("analyze: --sharding needs --mesh axis:size[,...] "
+              "(saved blobs carry no partition stamp)", file=sys.stderr)
+        return 2
+    if not want_verify and not want_memory and not want_fusion \
+            and not want_sharding:
         want_verify = want_memory = True
 
     try:
@@ -151,6 +200,53 @@ def main(argv=None) -> int:
     if want_fusion:
         fusion_report = analyze_program(program, fetch, batch_size=batch)
         out["fusion"] = fusion_report.as_dict()
+    shard_plan = None
+    shard_peak = None
+    if want_sharding:
+        from paddle_tpu.analysis import sharding as _shard
+        from paddle_tpu.analysis.memory import plan_sharded_memory
+        from paddle_tpu.parallel import partitioner as _part
+        stamp = _part.partition_program(program, mesh, rules=rules,
+                                        fetch_names=fetch,
+                                        batch_size=batch)
+        stamp["zero_stage"] = zero
+        shard_plan = _shard.plan_sharding(program, fetch,
+                                          batch_size=batch)
+        shard_peak = plan_sharded_memory(
+            program, fetch, batch_size=batch,
+            specs={**stamp["params"], **stamp["activations"]},
+            axis_sizes=stamp["mesh_axes"])
+        n_err = sum(1 for d in shard_plan.diagnostics
+                    if d.severity == "error")
+        if n_err:
+            rc = 1
+        out["sharding"] = {
+            "rules": shard_plan.rules,
+            "mesh": dict(shard_plan.mesh_axes),
+            "zero_stage": shard_plan.zero_stage,
+            "batch": batch,
+            "specs": {k: list(v)
+                      for k, v in sorted(shard_plan.specs.items())},
+            "edges": [
+                {"direction": e.direction, "kind": e.kind,
+                 "mesh_axis": e.mesh_axis, "var": e.var,
+                 "payload_bytes": e.payload_bytes,
+                 "wire_bytes": e.wire_bytes, "reason": e.reason,
+                 "exact": e.exact} for e in shard_plan.edges],
+            "n_edges": len(shard_plan.edges),
+            "n_unexplained": len(shard_plan.unexplained),
+            "payload_bytes": shard_plan.payload_bytes,
+            "wire_bytes": shard_plan.wire_bytes,
+            "est_ms": shard_plan.est_ms,
+            "errors": n_err,
+            "diagnostics": [
+                {"check": d.check, "severity": d.severity,
+                 "message": d.message, "var": d.var}
+                for d in shard_plan.diagnostics],
+            "fingerprint": shard_plan.fingerprint,
+            "per_shard_peak_bytes": int(shard_peak.peak_bytes),
+            "per_shard_steady_bytes": int(shard_peak.steady_bytes),
+        }
     if as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
         return rc
@@ -169,6 +265,18 @@ def main(argv=None) -> int:
     if want_memory and plan is not None:
         print("== memory ==")
         print(plan.report())
+    if want_sharding and shard_plan is not None:
+        r = out["sharding"]
+        print(f"== sharding: {'FAILED' if r['errors'] else 'OK'} "
+              f"({r['n_edges']} edge(s), {r['n_unexplained']} "
+              f"unexplained, {r['errors']} error(s)) ==")
+        print(shard_plan.report())
+        if shard_plan.diagnostics:
+            print(debugger.format_diagnostics(shard_plan.diagnostics))
+        for var, spec in sorted(shard_plan.specs.items()):
+            print(f"  spec {var:<40} {tuple(spec)}")
+        print(f"per-shard peak: {r['per_shard_peak_bytes']} B "
+              f"(steady {r['per_shard_steady_bytes']} B)")
     if fusion_report is not None:
         r = out["fusion"]
         print(f"== fusion: {r['applied']} applicable candidate(s) of "

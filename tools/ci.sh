@@ -101,6 +101,9 @@ JAX_PLATFORMS=cpu python tools/hbm_smoke.py
 echo "== gspmd smoke (planner pick under memory pressure, sharded-vs-single-chip parity, ZeRO-1 opt_state gauge) =="
 JAX_PLATFORMS=cpu python tools/gspmd_smoke.py
 
+echo "== sharding smoke (mp_hidden analyzes 0-unexplained, overcommitted table refused pre-dispatch, plan == measured bytes) =="
+JAX_PLATFORMS=cpu python tools/sharding_smoke.py
+
 echo "== serving smoke (continuous batching, 2 tenants, fault absorption, SIGTERM drain) =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
